@@ -1,0 +1,212 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+The capabilities of Ray (tasks, actors, objects, placement groups,
+collectives, Train/Tune/Data/Serve/RLlib equivalents) re-designed TPU-first:
+the control/object plane is accelerator-agnostic RPC + shared memory; the
+tensor plane is XLA collectives over ICI/DCN inside jitted SPMD programs.
+
+Public API parity map (reference file:line):
+  init/shutdown        ~ python/ray/_private/worker.py:1219
+  remote/get/put/wait  ~ worker.py:3153/:2583/:2695/:2760
+  kill/cancel          ~ worker.py:2941/:2972
+  get_actor            ~ worker.py:2906
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu import exceptions
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import (
+    MODE_DRIVER, Worker, global_worker, global_worker_or_none,
+    set_global_worker,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, method
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
+
+__version__ = "0.1.0"
+
+_init_lock = threading.Lock()
+_local_node = None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    object_store_memory: Optional[int] = None,
+    namespace: Optional[str] = None,
+    ignore_reinit_error: bool = False,
+    _system_config: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Start (or connect to) a cluster and attach this process as the driver."""
+    global _local_node
+    with _init_lock:
+        if global_worker_or_none() is not None:
+            if ignore_reinit_error:
+                return {"already_initialized": True}
+            raise RuntimeError("ray_tpu.init() called twice; pass "
+                               "ignore_reinit_error=True to ignore")
+        from ray_tpu._private.node import Node
+        from ray_tpu._private.rpc import RpcClient
+
+        if address is None:
+            node = Node(head=True, num_cpus=num_cpus, num_tpus=num_tpus,
+                        resources=resources, labels=labels,
+                        object_store_memory=object_store_memory,
+                        system_config=_system_config)
+            _local_node = node
+            gcs_addr = node.gcs_addr
+            raylet_addr = node.raylet_addr
+            node_id = node.node_id.binary()
+            session_dir = node.session_dir
+        else:
+            host, port = address.rsplit(":", 1)
+            gcs_addr = (host, int(port))
+            probe = RpcClient(*gcs_addr)
+            GlobalConfig.load_system_config(probe.call("get_system_config",
+                                                       timeout=10))
+            nodes = [n for n in probe.call("get_all_nodes", timeout=10)
+                     if n["state"] == "ALIVE"]
+            probe.close()
+            if not nodes:
+                raise ConnectionError(f"no alive nodes at {address}")
+            raylet_addr = tuple(nodes[0]["addr"])
+            node_id = nodes[0]["node_id"]
+            session_dir = ""
+
+        gcs = RpcClient(*gcs_addr)
+        job_int = gcs.call("next_job_id", timeout=10)
+        job_id = JobID.from_int(job_int)
+        worker = Worker(mode=MODE_DRIVER, gcs_addr=gcs_addr,
+                        raylet_addr=raylet_addr, node_id=node_id,
+                        job_id=job_id, session_dir=session_dir)
+        worker.namespace = namespace or f"job-{job_id.hex()}"
+        set_global_worker(worker)
+        gcs.call("register_job", job_id=job_id.binary(),
+                 driver_addr=worker.addr,
+                 metadata={"namespace": worker.namespace})
+        gcs.close()
+        return {"gcs_address": f"{gcs_addr[0]}:{gcs_addr[1]}",
+                "node_id": node_id.hex(), "job_id": job_id.hex(),
+                "session_dir": session_dir}
+
+
+def shutdown() -> None:
+    global _local_node
+    with _init_lock:
+        w = global_worker_or_none()
+        if w is not None:
+            try:
+                w.gcs.call("mark_job_finished", job_id=w.job_id.binary(),
+                           timeout=5)
+            except Exception:
+                pass
+            w.shutdown()
+        if _local_node is not None:
+            _local_node.shutdown()
+            _local_node = None
+
+
+def is_initialized() -> bool:
+    return global_worker_or_none() is not None
+
+
+def remote(*args, **options) -> Union[RemoteFunction, ActorClass]:
+    """``@ray_tpu.remote`` / ``@ray_tpu.remote(num_tpus=1, ...)``."""
+    if len(args) == 1 and not options and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes only keyword options")
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    return decorator
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return global_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    single = isinstance(refs, ObjectRef)
+    batch = [refs] if single else list(refs)
+    for r in batch:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRefs, got {type(r)}")
+    values = global_worker().get_objects(batch, timeout)
+    return values[0] if single else values
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    refs = list(refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() got duplicate ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return global_worker().wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    global_worker().kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    global_worker().cancel_task(ref, force)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    return global_worker().get_actor(name, namespace)
+
+
+# -- cluster state ----------------------------------------------------------
+
+def nodes() -> List[Dict[str, Any]]:
+    out = []
+    for n in global_worker().gcs.call("get_all_nodes", timeout=10):
+        out.append({
+            "NodeID": n["node_id"].hex(), "Alive": n["state"] == "ALIVE",
+            "Resources": n["total"], "Available": n["available"],
+            "Labels": n["labels"], "RayletAddr": n["addr"],
+        })
+    return out
+
+
+def cluster_resources() -> Dict[str, float]:
+    return global_worker().gcs.call("cluster_resources", timeout=10)
+
+
+def available_resources() -> Dict[str, float]:
+    return global_worker().gcs.call("available_resources", timeout=10)
+
+
+def timeline() -> List[Dict]:
+    return global_worker().gcs.call("get_task_events", timeout=10)
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "put", "get", "wait",
+    "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
+    "available_resources", "get_runtime_context", "ObjectRef", "ActorHandle",
+    "exceptions", "__version__",
+]

@@ -1,0 +1,145 @@
+"""Global runtime config registry.
+
+Equivalent in role to the reference's `src/ray/common/ray_config_def.h` macro
+table (218 `RAY_CONFIG(type, name, default)` entries): a single source of truth
+of typed, defaulted knobs, each overridable by an environment variable
+``RAY_TPU_<name>`` on any process, or by a ``_system_config`` dict passed to
+``ray_tpu.init`` on the head node and propagated to every other process through
+the GCS at registration time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+@dataclass
+class _ConfigEntry:
+    name: str
+    type: type
+    default: Any
+    doc: str = ""
+
+
+_REGISTRY: Dict[str, _ConfigEntry] = {}
+
+
+def _define(name: str, type_: type, default: Any, doc: str = "") -> None:
+    _REGISTRY[name] = _ConfigEntry(name, type_, default, doc)
+
+
+# ---------------------------------------------------------------------------
+# Config table. Names intentionally parallel the reference's where the knob is
+# the same concept (e.g. max_direct_call_object_size ~ ray_config_def.h:206).
+# ---------------------------------------------------------------------------
+
+# --- object store / objects ---
+_define("max_direct_call_object_size", int, 100 * 1024,
+        "Objects <= this many bytes are inlined in task replies / the "
+        "in-process memory store instead of the shared-memory store.")
+_define("object_store_memory", int, 2 * 1024 * 1024 * 1024,
+        "Default per-node shared-memory object store capacity in bytes.")
+_define("object_manager_chunk_size", int, 5 * 1024 * 1024,
+        "Chunk size for node-to-node object transfer.")
+_define("object_spilling_threshold", float, 0.8,
+        "Fraction of store capacity above which primary copies spill to disk.")
+_define("object_store_fallback_directory", str, "",
+        "Directory for disk spillover; defaults under the session dir.")
+
+# --- scheduling ---
+_define("scheduler_top_k_fraction", float, 0.2,
+        "Hybrid scheduling policy considers the top max(1, k*n_nodes) nodes.")
+_define("scheduler_spread_threshold", float, 0.5,
+        "Critical resource utilization below which the hybrid policy packs "
+        "onto the local/first node instead of spreading.")
+_define("worker_lease_timeout_ms", int, 30000, "")
+_define("max_workers_per_node", int, 0,
+        "Cap on pooled workers per node; 0 means #CPUs.")
+_define("worker_pool_idle_ttl_s", float, 600.0,
+        "Idle pooled workers beyond the soft limit are reaped after this.")
+
+# --- fault tolerance ---
+_define("health_check_period_ms", int, 1000, "")
+_define("health_check_failure_threshold", int, 5,
+        "Consecutive missed health checks before a node is marked dead.")
+_define("task_max_retries_default", int, 3, "")
+_define("actor_max_restarts_default", int, 0, "")
+
+# --- rpc / transport ---
+_define("rpc_connect_timeout_s", float, 10.0, "")
+_define("rpc_call_timeout_s", float, 120.0, "")
+_define("gcs_rpc_port", int, 0, "0 = pick a free port.")
+
+# --- workers ---
+_define("worker_register_timeout_s", float, 30.0, "")
+_define("worker_startup_batch", int, 4, "Prestarted workers per node.")
+
+# --- logging / events ---
+_define("event_stats", bool, True,
+        "Track per-handler latency stats on runtime event loops.")
+_define("task_events_buffer_size", int, 100_000,
+        "Ring buffer capacity of task lifecycle events kept on the head "
+        "(reference: gcs task manager ring buffer).")
+
+# --- tpu ---
+_define("tpu_chips_per_host_default", int, 4, "")
+_define("fake_tpu_hosts", int, 0,
+        "If >0, accelerator detection fakes this many TPU hosts for tests.")
+
+
+class _Config:
+    """Resolved view: env var > system_config > default."""
+
+    def __init__(self):
+        self._system_config: Dict[str, Any] = {}
+
+    def initialize(self, system_config: Dict[str, Any] | None) -> None:
+        if not system_config:
+            return
+        for key, value in system_config.items():
+            if key not in _REGISTRY:
+                raise ValueError(f"Unknown system config key: {key}")
+            self._system_config[key] = value
+
+    def get(self, name: str) -> Any:
+        entry = _REGISTRY[name]
+        env_val = os.environ.get(_ENV_PREFIX + name)
+        if env_val is not None:
+            return _PARSERS[entry.type](env_val)
+        if name in self._system_config:
+            return entry.type(self._system_config[name])
+        return entry.default
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self.get(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def dump_system_config(self) -> str:
+        return json.dumps(self._system_config)
+
+    def load_system_config(self, payload: str) -> None:
+        self._system_config.update(json.loads(payload))
+
+
+GlobalConfig = _Config()

@@ -1,0 +1,682 @@
+"""GCS — the head-node control plane.
+
+Role-equivalent to the reference's `src/ray/gcs/gcs_server/gcs_server.cc:187-232`
+which installs node / resource / health / job / actor / placement-group / KV /
+pubsub / task-event managers. One GCS per cluster, run as its own process
+(``python -m ray_tpu._private.gcs_server``). State lives in an in-memory store
+(the reference's default `gcs_storage="memory"`); a file-backed snapshot hook
+exists for restart tolerance.
+
+Actors are scheduled *centrally* here (reference: `gcs_actor_scheduler.cc:49`),
+unlike normal tasks which use the distributed raylet lease protocol.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.rpc import RpcClient, RpcServer, get_io_loop
+from ray_tpu._private.scheduling_policy import ClusterView, pick_node
+from ray_tpu._private.task_spec import SchedulingStrategySpec
+
+ALIVE = "ALIVE"
+DEAD = "DEAD"
+
+# Actor lifecycle states (reference: src/ray/design_docs/actor_states.rst)
+PENDING_CREATION = "PENDING_CREATION"
+RESTARTING = "RESTARTING"
+
+
+class Pubsub:
+    """Long-poll pub/sub (reference: `src/ray/pubsub/`)."""
+
+    def __init__(self):
+        self._channels: Dict[str, List[Tuple[int, Any]]] = defaultdict(list)
+        self._events: Dict[str, asyncio.Event] = defaultdict(asyncio.Event)
+        self._seq = 0
+
+    def publish(self, channel: str, message: Any) -> None:
+        self._seq += 1
+        log = self._channels[channel]
+        log.append((self._seq, message))
+        if len(log) > 10000:
+            del log[: len(log) - 10000]
+        ev = self._events[channel]
+        ev.set()
+        self._events[channel] = asyncio.Event()
+
+    async def poll(self, channel: str, cursor: int, timeout: float):
+        deadline = time.monotonic() + timeout
+        while True:
+            msgs = [(s, m) for s, m in self._channels[channel] if s > cursor]
+            if msgs:
+                return msgs
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return []
+            try:
+                await asyncio.wait_for(
+                    asyncio.shield(self._events[channel].wait()), remaining)
+            except asyncio.TimeoutError:
+                return []
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = RpcServer(host, port)
+        self.view = ClusterView()
+        self.pubsub = Pubsub()
+
+        # node_id(bytes) -> node info dict
+        self.nodes: Dict[bytes, Dict[str, Any]] = {}
+        self._node_clients: Dict[bytes, RpcClient] = {}
+        self._last_heartbeat: Dict[bytes, float] = {}
+
+        # actors
+        self.actors: Dict[bytes, Dict[str, Any]] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}
+        self._actor_events: Dict[bytes, asyncio.Event] = {}
+
+        # kv: namespace -> key -> bytes
+        self.kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)
+
+        # placement groups
+        self.placement_groups: Dict[bytes, Dict[str, Any]] = {}
+
+        # jobs
+        self._next_job_int = 0
+        self.jobs: Dict[bytes, Dict[str, Any]] = {}
+
+        # task events ring buffer (reference: gcs_task_manager.h:85)
+        self.task_events: deque = deque(
+            maxlen=GlobalConfig.task_events_buffer_size)
+
+        # internal worker info registry (worker_id -> info)
+        self.workers: Dict[bytes, Dict[str, Any]] = {}
+
+        self._register_handlers()
+        self._health_task = None
+
+    # ------------------------------------------------------------------ boot
+    def start(self) -> int:
+        port = self.server.start()
+        self._health_task = get_io_loop().submit(self._health_loop())
+        return port
+
+    def _register_handlers(self):
+        s = self.server
+        for name in [
+            "register_node", "heartbeat", "get_all_nodes", "drain_node",
+            "kv_put", "kv_get", "kv_del", "kv_keys", "kv_exists",
+            "register_actor", "get_actor_info", "get_named_actor",
+            "list_named_actors", "kill_actor", "report_actor_death",
+            "wait_actor_ready", "list_actors",
+            "create_placement_group", "remove_placement_group",
+            "get_placement_group", "wait_placement_group_ready",
+            "list_placement_groups",
+            "next_job_id", "register_job", "mark_job_finished", "list_jobs",
+            "publish", "poll", "push_task_events", "get_task_events",
+            "register_worker", "list_workers", "get_system_config",
+            "cluster_resources", "available_resources", "internal_stats",
+        ]:
+            s.register(name, getattr(self, f"_h_{name}"))
+
+    # ------------------------------------------------------------- node mgmt
+    async def _h_register_node(self, node_id, addr, resources, labels,
+                               object_store_capacity=0):
+        self.nodes[node_id] = {
+            "node_id": node_id,
+            "addr": addr,  # (host, port) of the raylet RPC server
+            "state": ALIVE,
+            "labels": labels,
+            "resources_total": resources,
+            "object_store_capacity": object_store_capacity,
+            "start_time": time.time(),
+        }
+        nr = NodeResources(ResourceSet(resources), labels)
+        self.view.update_node(node_id, nr)
+        self._last_heartbeat[node_id] = time.monotonic()
+        self.pubsub.publish("node", {"event": "ALIVE", "node_id": node_id,
+                                     "addr": addr})
+        return {"system_config": GlobalConfig.dump_system_config(),
+                "nodes": self._nodes_snapshot()}
+
+    async def _h_heartbeat(self, node_id, available, total, idle=True):
+        if node_id not in self.nodes:
+            return {"unknown": True}
+        self._last_heartbeat[node_id] = time.monotonic()
+        nr = NodeResources(ResourceSet(total), self.nodes[node_id]["labels"])
+        nr.available = ResourceSet(available)
+        self.view.update_node(node_id, nr)
+        return {"nodes": self._nodes_snapshot()}
+
+    def _nodes_snapshot(self):
+        out = []
+        for node_id, info in self.nodes.items():
+            nr = self.view.get(node_id)
+            out.append({
+                "node_id": node_id,
+                "addr": info["addr"],
+                "state": info["state"],
+                "labels": info["labels"],
+                "total": nr.total.to_dict() if nr else {},
+                "available": nr.available.to_dict() if nr else {},
+            })
+        return out
+
+    async def _h_get_all_nodes(self):
+        return self._nodes_snapshot()
+
+    async def _h_drain_node(self, node_id):
+        await self._mark_node_dead(node_id, "drained")
+        return True
+
+    async def _mark_node_dead(self, node_id, reason):
+        info = self.nodes.get(node_id)
+        if info is None or info["state"] == DEAD:
+            return
+        info["state"] = DEAD
+        self.view.remove_node(node_id)
+        self.pubsub.publish("node", {"event": "DEAD", "node_id": node_id,
+                                     "reason": reason})
+        # Fail/restart actors that lived on this node.
+        for actor_id, a in list(self.actors.items()):
+            if a.get("node_id") == node_id and a["state"] == ALIVE:
+                await self._on_actor_failure(actor_id, f"node died: {reason}")
+
+    async def _health_loop(self):
+        period = GlobalConfig.health_check_period_ms / 1000
+        threshold = GlobalConfig.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node_id, last in list(self._last_heartbeat.items()):
+                info = self.nodes.get(node_id)
+                if info is None or info["state"] == DEAD:
+                    continue
+                if now - last > period * threshold:
+                    await self._mark_node_dead(node_id, "health check failed")
+
+    def _client_for_node(self, node_id) -> Optional[RpcClient]:
+        info = self.nodes.get(node_id)
+        if info is None or info["state"] == DEAD:
+            return None
+        if node_id not in self._node_clients:
+            host, port = info["addr"]
+            self._node_clients[node_id] = RpcClient(host, port)
+        return self._node_clients[node_id]
+
+    # --------------------------------------------------------------------- kv
+    async def _h_kv_put(self, namespace, key, value, overwrite=True):
+        ns = self.kv[namespace]
+        if not overwrite and key in ns:
+            return False
+        ns[key] = value
+        return True
+
+    async def _h_kv_get(self, namespace, key):
+        return self.kv[namespace].get(key)
+
+    async def _h_kv_del(self, namespace, key):
+        return self.kv[namespace].pop(key, None) is not None
+
+    async def _h_kv_keys(self, namespace, prefix=""):
+        return [k for k in self.kv[namespace] if k.startswith(prefix)]
+
+    async def _h_kv_exists(self, namespace, key):
+        return key in self.kv[namespace]
+
+    # ------------------------------------------------------------------ actors
+    async def _h_register_actor(self, spec):
+        """spec: pickled TaskSpec for the actor-creation task."""
+        actor_id = spec.actor_id.binary()
+        name_key = (spec.actor_name, spec.namespace)
+        if spec.actor_name:
+            existing = self.named_actors.get(name_key)
+            if existing is not None and self.actors[existing]["state"] != DEAD:
+                return {"error": f"actor name {spec.actor_name!r} already taken",
+                        "existing_actor_id": existing}
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "spec": spec,
+            "state": PENDING_CREATION,
+            "node_id": None,
+            "addr": None,
+            "worker_id": None,
+            "restarts_used": 0,
+            "name": spec.actor_name,
+            "namespace": spec.namespace,
+            "death_cause": None,
+            "class_name": spec.function.qualname,
+        }
+        if spec.actor_name:
+            self.named_actors[name_key] = actor_id
+        self._actor_events[actor_id] = asyncio.Event()
+        asyncio.ensure_future(self._schedule_actor(actor_id))
+        return {"ok": True}
+
+    async def _schedule_actor(self, actor_id):
+        a = self.actors[actor_id]
+        spec = a["spec"]
+        delay = 0.05
+        deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_ms / 1000
+        while time.monotonic() < deadline:
+            pg_res = None
+            if spec.scheduling.kind == "PLACEMENT_GROUP":
+                pg_res = self._pg_demand(spec.scheduling, spec.resources)
+                if pg_res is None:
+                    await asyncio.sleep(delay)
+                    continue
+            node_id = pick_node(self.view, spec.resources, spec.scheduling,
+                                None, pg_res)
+            if node_id is None:
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 1.0)
+                continue
+            client = self._client_for_node(node_id)
+            if client is None:
+                continue
+            try:
+                reply = await client.acall(
+                    "lease_worker_for_actor", spec=spec,
+                    demand=(pg_res or spec.resources).to_dict(),
+                    timeout=60)
+            except Exception as exc:
+                await asyncio.sleep(delay)
+                continue
+            if not reply.get("ok"):
+                await asyncio.sleep(delay)
+                continue
+            # Worker is up and dedicated; tell it to become the actor.
+            worker_addr = reply["worker_addr"]
+            worker_id = reply["worker_id"]
+            wclient = RpcClient(*worker_addr)
+            try:
+                result = await wclient.acall("create_actor", spec=spec,
+                                             timeout=120)
+            except Exception as exc:
+                wclient.close()
+                await asyncio.sleep(delay)
+                continue
+            if not result.get("ok"):
+                a["state"] = DEAD
+                a["death_cause"] = result.get("error", "actor __init__ failed")
+                self._actor_events[actor_id].set()
+                self.pubsub.publish("actor", {"actor_id": actor_id,
+                                              "state": DEAD,
+                                              "cause": a["death_cause"]})
+                wclient.close()
+                return
+            if a["state"] == DEAD:
+                # kill() raced with creation: tear the new worker down.
+                try:
+                    await wclient.acall("kill_self", timeout=5)
+                except Exception:
+                    pass
+                wclient.close()
+                return
+            a.update(state=ALIVE, node_id=node_id, addr=tuple(worker_addr),
+                     worker_id=worker_id)
+            self._actor_events[actor_id].set()
+            self._actor_events[actor_id] = asyncio.Event()
+            self.pubsub.publish("actor", {"actor_id": actor_id, "state": ALIVE,
+                                          "addr": worker_addr})
+            wclient.close()
+            return
+        a["state"] = DEAD
+        a["death_cause"] = "failed to schedule actor (no feasible node)"
+        self._actor_events[actor_id].set()
+
+    def _pg_demand(self, sched: SchedulingStrategySpec,
+                   demand: ResourceSet) -> Optional[ResourceSet]:
+        """Rewrite demand onto bundle-formatted resources (reference trick:
+        tasks in a PG consume `name_group_{index}_{pg_id}` resources)."""
+        pg = self.placement_groups.get(sched.placement_group_id)
+        if pg is None or pg["state"] != "CREATED":
+            return None
+        from ray_tpu._private.resources import pg_task_demand
+
+        return pg_task_demand(demand, sched.placement_group_id.hex(),
+                              sched.bundle_index)
+
+    async def _on_actor_failure(self, actor_id, cause):
+        a = self.actors.get(actor_id)
+        if a is None or a["state"] == DEAD:
+            return
+        spec = a["spec"]
+        if a["restarts_used"] < spec.max_restarts or spec.max_restarts == -1:
+            a["restarts_used"] += 1
+            a["state"] = RESTARTING
+            a["addr"] = None
+            self.pubsub.publish("actor", {"actor_id": actor_id,
+                                          "state": RESTARTING})
+            asyncio.ensure_future(self._schedule_actor(actor_id))
+        else:
+            a["state"] = DEAD
+            a["death_cause"] = cause
+            self.pubsub.publish("actor", {"actor_id": actor_id, "state": DEAD,
+                                          "cause": cause})
+            self._actor_events.setdefault(actor_id, asyncio.Event()).set()
+            name_key = (a["name"], a["namespace"])
+            if a["name"] and self.named_actors.get(name_key) == actor_id:
+                del self.named_actors[name_key]
+
+    async def _h_report_actor_death(self, actor_id, cause, from_node=None):
+        await self._on_actor_failure(actor_id, cause)
+        return True
+
+    async def _h_wait_actor_ready(self, actor_id, wait_timeout=60.0):
+        deadline = time.monotonic() + wait_timeout
+        while time.monotonic() < deadline:
+            a = self.actors.get(actor_id)
+            if a is None:
+                return {"error": "unknown actor"}
+            if a["state"] == ALIVE:
+                return {"state": ALIVE, "addr": a["addr"]}
+            if a["state"] == DEAD:
+                return {"state": DEAD, "cause": a["death_cause"]}
+            ev = self._actor_events.get(actor_id)
+            try:
+                await asyncio.wait_for(asyncio.shield(ev.wait()),
+                                       max(deadline - time.monotonic(), 0.01))
+            except asyncio.TimeoutError:
+                pass
+        return {"error": "timeout"}
+
+    async def _h_get_actor_info(self, actor_id):
+        a = self.actors.get(actor_id)
+        if a is None:
+            return None
+        return {k: a[k] for k in
+                ("actor_id", "state", "node_id", "addr", "worker_id", "name",
+                 "namespace", "death_cause", "restarts_used", "class_name")}
+
+    async def _h_get_named_actor(self, name, namespace):
+        actor_id = self.named_actors.get((name, namespace))
+        if actor_id is None:
+            return None
+        info = await self._h_get_actor_info(actor_id)
+        if info is not None:
+            info["spec"] = self.actors[actor_id]["spec"]
+        return info
+
+    async def _h_list_named_actors(self, namespace=None):
+        return [
+            {"name": n, "namespace": ns, "actor_id": aid}
+            for (n, ns), aid in self.named_actors.items()
+            if namespace is None or ns == namespace
+        ]
+
+    async def _h_list_actors(self):
+        return [await self._h_get_actor_info(aid) for aid in self.actors]
+
+    async def _h_kill_actor(self, actor_id, no_restart=True):
+        a = self.actors.get(actor_id)
+        if a is None:
+            return False
+        if no_restart:
+            a["spec"].max_restarts = 0
+        if a["addr"] is not None:
+            client = RpcClient(*a["addr"])
+            try:
+                await client.acall("kill_self", timeout=5)
+            except Exception:
+                pass
+            client.close()
+        await self._on_actor_failure(actor_id, "killed via kill_actor")
+        return True
+
+    # ------------------------------------------------------- placement groups
+    async def _h_create_placement_group(self, pg_id, bundles, strategy, name=""):
+        """2-phase commit against raylets (reference:
+        `gcs_placement_group_scheduler.h`, raylet PrepareBundles/CommitBundles
+        at `placement_group_resource_manager.h:54-61`)."""
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+            "name": name, "state": "PENDING", "bundle_nodes": [None] * len(bundles),
+        }
+        asyncio.ensure_future(self._schedule_pg(pg_id))
+        return True
+
+    async def _schedule_pg(self, pg_id):
+        pg = self.placement_groups[pg_id]
+        bundles = [ResourceSet(b) for b in pg["bundles"]]
+        strategy = pg["strategy"]
+        deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_ms / 1000
+        while time.monotonic() < deadline and pg["state"] == "PENDING":
+            placement = self._plan_pg(bundles, strategy)
+            if placement is None:
+                await asyncio.sleep(0.1)
+                continue
+            # Phase 1: prepare all bundles.
+            prepared = []
+            ok = True
+            for idx, node_id in enumerate(placement):
+                client = self._client_for_node(node_id)
+                if client is None:
+                    ok = False
+                    break
+                try:
+                    r = await client.acall(
+                        "prepare_bundle", pg_id=pg_id, bundle_index=idx,
+                        resources=bundles[idx].to_dict(), timeout=30)
+                    if not r:
+                        ok = False
+                        break
+                    prepared.append((idx, node_id))
+                except Exception:
+                    ok = False
+                    break
+            if not ok:
+                for idx, node_id in prepared:
+                    client = self._client_for_node(node_id)
+                    if client:
+                        try:
+                            await client.acall("return_bundle", pg_id=pg_id,
+                                               bundle_index=idx, timeout=10)
+                        except Exception:
+                            pass
+                await asyncio.sleep(0.1)
+                continue
+            # Phase 2: commit.
+            for idx, node_id in enumerate(placement):
+                client = self._client_for_node(node_id)
+                await client.acall("commit_bundle", pg_id=pg_id,
+                                   bundle_index=idx, timeout=30)
+            pg["bundle_nodes"] = list(placement)
+            pg["state"] = "CREATED"
+            self.pubsub.publish("pg", {"pg_id": pg_id, "state": "CREATED"})
+            return
+        if pg["state"] == "PENDING":
+            pg["state"] = "INFEASIBLE"
+            self.pubsub.publish("pg", {"pg_id": pg_id, "state": "INFEASIBLE"})
+
+    def _plan_pg(self, bundles: List[ResourceSet], strategy: str
+                 ) -> Optional[List[bytes]]:
+        """Bin-pack bundles onto nodes honoring PACK/SPREAD/STRICT_*."""
+        avail = {nid: ResourceSet(nr.available.to_dict())
+                 for nid, nr in self.view.nodes.items()}
+        if not avail:
+            return None
+        placement: List[Optional[bytes]] = [None] * len(bundles)
+        order = sorted(avail.keys())
+
+        def fits(nid, demand):
+            return avail[nid].is_superset_of(demand)
+
+        if strategy == "STRICT_PACK":
+            for nid in order:
+                total = ResourceSet({})
+                for b in bundles:
+                    total = total.add(b)
+                if fits(nid, total):
+                    return [nid] * len(bundles)
+            return None
+
+        if strategy == "STRICT_SPREAD":
+            if len(bundles) > len(order):
+                return None
+            used = set()
+            for i, b in enumerate(bundles):
+                chosen = None
+                for nid in order:
+                    if nid not in used and fits(nid, b):
+                        chosen = nid
+                        break
+                if chosen is None:
+                    return None
+                used.add(chosen)
+                placement[i] = chosen
+            return placement
+
+        # PACK (best effort pack) / SPREAD (best effort spread)
+        prefer_spread = strategy == "SPREAD"
+        last = None
+        for i, b in enumerate(bundles):
+            candidates = [n for n in order if fits(n, b)]
+            if not candidates:
+                return None
+            if prefer_spread:
+                fresh = [n for n in candidates if n != last]
+                chosen = (fresh or candidates)[0]
+            else:
+                chosen = candidates[0] if last is None or last not in candidates \
+                    else last
+            placement[i] = chosen
+            avail[chosen] = avail[chosen].subtract(b)
+            last = chosen
+        return placement
+
+    async def _h_remove_placement_group(self, pg_id):
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return False
+        for idx, node_id in enumerate(pg["bundle_nodes"]):
+            if node_id is None:
+                continue
+            client = self._client_for_node(node_id)
+            if client is not None:
+                try:
+                    await client.acall("return_bundle", pg_id=pg_id,
+                                       bundle_index=idx, timeout=10)
+                except Exception:
+                    pass
+        pg["state"] = "REMOVED"
+        return True
+
+    async def _h_get_placement_group(self, pg_id):
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return None
+        return {k: pg[k] for k in ("pg_id", "bundles", "strategy", "name",
+                                   "state", "bundle_nodes")}
+
+    async def _h_wait_placement_group_ready(self, pg_id, wait_timeout=60.0):
+        deadline = time.monotonic() + wait_timeout
+        while time.monotonic() < deadline:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None:
+                return {"error": "unknown placement group"}
+            if pg["state"] in ("CREATED", "INFEASIBLE", "REMOVED"):
+                return {"state": pg["state"]}
+            await asyncio.sleep(0.02)
+        return {"state": "PENDING"}
+
+    async def _h_list_placement_groups(self):
+        return [await self._h_get_placement_group(p)
+                for p in self.placement_groups]
+
+    # -------------------------------------------------------------------- jobs
+    async def _h_next_job_id(self):
+        self._next_job_int += 1
+        return self._next_job_int
+
+    async def _h_register_job(self, job_id, driver_addr, metadata=None):
+        self.jobs[job_id] = {"job_id": job_id, "driver_addr": driver_addr,
+                             "metadata": metadata or {}, "state": "RUNNING",
+                             "start_time": time.time()}
+        return True
+
+    async def _h_mark_job_finished(self, job_id):
+        if job_id in self.jobs:
+            self.jobs[job_id]["state"] = "FINISHED"
+            self.jobs[job_id]["end_time"] = time.time()
+        return True
+
+    async def _h_list_jobs(self):
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------ pubsub
+    async def _h_publish(self, channel, message):
+        self.pubsub.publish(channel, message)
+        return True
+
+    async def _h_poll(self, channel, cursor, wait_timeout=10.0):
+        return await self.pubsub.poll(channel, cursor, wait_timeout)
+
+    # ------------------------------------------------------------- task events
+    async def _h_push_task_events(self, events):
+        self.task_events.extend(events)
+        return True
+
+    async def _h_get_task_events(self, job_id=None, limit=1000):
+        out = [e for e in self.task_events
+               if job_id is None or e.get("job_id") == job_id]
+        return out[-limit:]
+
+    # ----------------------------------------------------------------- workers
+    async def _h_register_worker(self, worker_id, info):
+        self.workers[worker_id] = info
+        return True
+
+    async def _h_list_workers(self):
+        return list(self.workers.values())
+
+    # ------------------------------------------------------------------- misc
+    async def _h_get_system_config(self):
+        return GlobalConfig.dump_system_config()
+
+    async def _h_cluster_resources(self):
+        total = ResourceSet({})
+        for nr in self.view.nodes.values():
+            total = total.add(nr.total)
+        return total.to_dict()
+
+    async def _h_available_resources(self):
+        total = ResourceSet({})
+        for nr in self.view.nodes.values():
+            total = total.add(nr.available)
+        return total.to_dict()
+
+    async def _h_internal_stats(self):
+        return {"event_stats": self.server.stats.snapshot(),
+                "num_nodes": len([n for n in self.nodes.values()
+                                  if n["state"] == ALIVE]),
+                "num_actors": len(self.actors)}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--system-config", default="{}")
+    args = parser.parse_args()
+
+    GlobalConfig.load_system_config(args.system_config)
+    gcs = GcsServer(args.host, args.port)
+    port = gcs.start()
+    # Parent discovers the port from stdout.
+    print(f"GCS_PORT={port}", flush=True)
+    import threading
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""ObjectRef — the handle to a (possibly pending) immutable object.
+
+Reference-counted by the owning worker: creating and destroying Python
+ObjectRef instances adjusts the owner's local refcount (reference:
+`src/ray/core_worker/reference_count.h:61`). Serializing a ref into a task
+argument or another object marks it *shared*, which pins it until job end in
+this round (the full borrower protocol is future work; leak-safe by design).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_addr", "_owner_worker_id", "_registered",
+                 "__weakref__")
+
+    def __init__(self, object_id: bytes, owner_addr: Tuple[str, int],
+                 owner_worker_id: bytes, _register: bool = True):
+        self._id = object_id
+        self._owner_addr = tuple(owner_addr) if owner_addr else None
+        self._owner_worker_id = owner_worker_id
+        self._registered = False
+        if _register:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker_or_none()
+            if w is not None:
+                w.reference_counter.add_local_ref(self._id)
+                self._registered = True
+
+    # -- identity -----------------------------------------------------------
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    @property
+    def owner_addr(self) -> Optional[Tuple[str, int]]:
+        return self._owner_addr
+
+    @property
+    def owner_worker_id(self) -> bytes:
+        return self._owner_worker_id
+
+    def object_id(self) -> ObjectID:
+        return ObjectID(self._id)
+
+    def task_id(self):
+        return ObjectID(self._id).task_id()
+
+    # -- lifecycle ----------------------------------------------------------
+    def __del__(self):
+        if not self._registered:
+            return
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker_or_none()
+            if w is not None:
+                w.reference_counter.remove_local_ref(self._id)
+        except BaseException:
+            # Interpreter teardown: module globals may already be gone.
+            pass
+
+    # -- hashing / equality -------------------------------------------------
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self.hex()})"
+
+    # -- awaitable ----------------------------------------------------------
+    def __await__(self):
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod.global_worker().async_get([self]).__await__()
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        import concurrent.futures
+        import threading
+
+        from ray_tpu._private import worker as worker_mod
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        w = worker_mod.global_worker()
+
+        def _wait():
+            try:
+                fut.set_result(w.get_objects([self], timeout=None)[0])
+            except BaseException as exc:  # noqa: BLE001
+                fut.set_exception(exc)
+
+        threading.Thread(target=_wait, daemon=True).start()
+        return fut
+
+
+def reduce_object_ref(ref: ObjectRef):
+    """Pickle reducer: mark shared with the owner, rehydrate on load."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker_or_none()
+    if w is not None:
+        w.reference_counter.mark_shared(ref.binary())
+    return _rehydrate_ref, (ref.binary(), ref.owner_addr, ref.owner_worker_id)
+
+
+def _rehydrate_ref(object_id, owner_addr, owner_worker_id):
+    return ObjectRef(object_id, owner_addr, owner_worker_id)

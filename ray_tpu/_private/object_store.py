@@ -1,0 +1,268 @@
+"""Per-node shared-memory object store (plasma-equivalent).
+
+Role-equivalent to the reference's plasma store
+(`src/ray/object_manager/plasma/store.cc:1`, `object_lifecycle_manager.h`,
+`eviction_policy.h`): one store per node, hosted *inside the raylet process*
+(as plasma runs inside the raylet — `object_manager.cc:32`), holding sealed
+immutable objects in shared memory with LRU eviction, pinning for primary
+copies, and disk fallback (spilling) when memory pressure demands.
+
+Implementation: each object is a file in ``/dev/shm`` (tmpfs) mmap'd by
+clients — the moral equivalent of plasma's mmap'd arenas with FD passing; the
+"FD pass" is opening the same tmpfs path, which yields the same zero-copy
+shared pages. A C++ arena allocator can replace the per-object-file scheme
+behind this same interface (see native/).
+
+Clients (workers/drivers on the node) call create/seal/get via the raylet RPC
+channel and then mmap the returned path directly — data never crosses the RPC.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import mmap
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+@dataclass
+class _Entry:
+    object_id: bytes
+    size: int
+    path: str
+    sealed: bool = False
+    pinned: bool = False
+    spilled_path: Optional[str] = None
+    last_access: float = field(default_factory=time.monotonic)
+    seal_event: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+class NodeObjectStore:
+    """The node-side store state machine. All methods run on the raylet loop."""
+
+    def __init__(self, capacity_bytes: int, shm_dir: str, spill_dir: str,
+                 node_hex: str):
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._shm_dir = shm_dir
+        self._spill_dir = spill_dir
+        self._prefix = f"rtpu-{node_hex[:12]}-"
+        self._entries: Dict[bytes, _Entry] = {}
+        os.makedirs(spill_dir, exist_ok=True)
+        self.num_evictions = 0
+        self.num_spills = 0
+        self.num_restores = 0
+
+    # -- paths --------------------------------------------------------------
+    def _path_for(self, object_id: bytes) -> str:
+        return os.path.join(self._shm_dir, self._prefix + object_id.hex())
+
+    # -- create / seal ------------------------------------------------------
+    def create(self, object_id: bytes, size: int) -> str:
+        if object_id in self._entries:
+            entry = self._entries[object_id]
+            if entry.sealed or entry.size == size:
+                return entry.path  # idempotent re-create
+            raise ValueError("object already being created with different size")
+        if size > self.capacity:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes exceeds store capacity {self.capacity}")
+        self._ensure_space(size)
+        path = self._path_for(object_id)
+        with open(path, "wb") as f:
+            f.truncate(size)
+        self._entries[object_id] = _Entry(object_id, size, path)
+        self.used += size
+        return path
+
+    def seal(self, object_id: bytes) -> None:
+        entry = self._entries.get(object_id)
+        if entry is None:
+            raise KeyError(f"seal of unknown object {object_id.hex()}")
+        entry.sealed = True
+        entry.last_access = time.monotonic()
+        entry.seal_event.set()
+
+    def put_bytes(self, object_id: bytes, payload: bytes) -> None:
+        """Create+write+seal in one step (used by the pull path)."""
+        if self.contains(object_id):
+            return
+        path = self.create(object_id, len(payload))
+        with open(path, "r+b") as f:
+            f.write(payload)
+        self.seal(object_id)
+
+    # -- read ---------------------------------------------------------------
+    def contains(self, object_id: bytes) -> bool:
+        e = self._entries.get(object_id)
+        return e is not None and e.sealed and e.spilled_path is None
+
+    async def get(self, object_id: bytes, timeout: Optional[float]
+                  ) -> Optional[Tuple[str, int]]:
+        """Wait for a local sealed copy; returns (path, size) or None."""
+        entry = self._entries.get(object_id)
+        if entry is None:
+            if timeout is None or timeout <= 0:
+                return None
+            deadline = time.monotonic() + timeout
+            while entry is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.005)
+                entry = self._entries.get(object_id)
+            if entry is None:
+                return None
+        if not entry.sealed:
+            try:
+                await asyncio.wait_for(
+                    entry.seal_event.wait(),
+                    None if timeout is None else max(timeout, 0.001),
+                )
+            except asyncio.TimeoutError:
+                return None
+        if entry.spilled_path is not None:
+            self._restore(entry)
+        entry.last_access = time.monotonic()
+        return entry.path, entry.size
+
+    def read_bytes(self, object_id: bytes, offset: int, length: int) -> bytes:
+        """Server-side read for serving remote pulls (chunked)."""
+        entry = self._entries[object_id]
+        if entry.spilled_path is not None:
+            self._restore(entry)
+        with open(entry.path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def size_of(self, object_id: bytes) -> int:
+        return self._entries[object_id].size
+
+    # -- pin / delete -------------------------------------------------------
+    def pin(self, object_id: bytes) -> None:
+        e = self._entries.get(object_id)
+        if e is not None:
+            e.pinned = True
+
+    def unpin(self, object_id: bytes) -> None:
+        e = self._entries.get(object_id)
+        if e is not None:
+            e.pinned = False
+
+    def delete(self, object_ids: List[bytes]) -> None:
+        for oid in object_ids:
+            entry = self._entries.pop(oid, None)
+            if entry is None:
+                continue
+            self.used -= entry.size if entry.spilled_path is None else 0
+            for p in (entry.path, entry.spilled_path):
+                if p is not None:
+                    try:
+                        os.unlink(p)
+                    except FileNotFoundError:
+                        pass
+
+    # -- eviction / spilling ------------------------------------------------
+    def _ensure_space(self, needed: int) -> None:
+        if self.used + needed <= self.capacity:
+            return
+        # Evict or spill LRU sealed objects until there is room.
+        candidates = sorted(
+            (e for e in self._entries.values()
+             if e.sealed and e.spilled_path is None),
+            key=lambda e: e.last_access,
+        )
+        for entry in candidates:
+            if self.used + needed <= self.capacity:
+                break
+            if entry.pinned:
+                self._spill(entry)
+            else:
+                # Secondary/unpinned copy: safe to drop entirely.
+                self.used -= entry.size
+                self.num_evictions += 1
+                try:
+                    os.unlink(entry.path)
+                except FileNotFoundError:
+                    pass
+                del self._entries[entry.object_id]
+        if self.used + needed > self.capacity:
+            raise ObjectStoreFullError(
+                f"need {needed} bytes but only "
+                f"{self.capacity - self.used} available after eviction")
+
+    def _spill(self, entry: _Entry) -> None:
+        dest = os.path.join(self._spill_dir, os.path.basename(entry.path))
+        shutil.move(entry.path, dest)
+        entry.spilled_path = dest
+        self.used -= entry.size
+        self.num_spills += 1
+
+    def _restore(self, entry: _Entry) -> None:
+        self._ensure_space(entry.size)
+        shutil.move(entry.spilled_path, entry.path)
+        entry.spilled_path = None
+        self.used += entry.size
+        self.num_restores += 1
+
+    # -- stats --------------------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        return {
+            "capacity": self.capacity,
+            "used": self.used,
+            "num_objects": len(self._entries),
+            "num_evictions": self.num_evictions,
+            "num_spills": self.num_spills,
+            "num_restores": self.num_restores,
+        }
+
+    def cleanup(self) -> None:
+        self.delete(list(self._entries.keys()))
+
+
+class MappedObject:
+    """A client-side zero-copy view of a sealed store object."""
+
+    __slots__ = ("_file", "_mmap", "view")
+
+    def __init__(self, path: str, size: int):
+        self._file = open(path, "rb")
+        if size > 0:
+            self._mmap = mmap.mmap(self._file.fileno(), size,
+                                   prot=mmap.PROT_READ)
+            self.view = memoryview(self._mmap)
+        else:
+            self._mmap = None
+            self.view = memoryview(b"")
+
+    def close(self):
+        try:
+            self.view.release()
+            if self._mmap is not None:
+                self._mmap.close()
+            self._file.close()
+        except (BufferError, ValueError, OSError):
+            pass
+
+
+class WritableObject:
+    """A client-side writable mapping used between create() and seal()."""
+
+    __slots__ = ("_file", "_mmap", "view")
+
+    def __init__(self, path: str, size: int):
+        self._file = open(path, "r+b")
+        self._mmap = mmap.mmap(self._file.fileno(), size)
+        self.view = memoryview(self._mmap)
+
+    def close(self):
+        try:
+            self.view.release()
+            self._mmap.close()
+            self._file.close()
+        except (BufferError, ValueError, OSError):
+            pass

@@ -1,0 +1,660 @@
+"""Raylet — the per-node daemon.
+
+Role-equivalent to the reference's `src/ray/raylet/` NodeManager: hosts the
+node's shared-memory object store (as plasma runs inside the raylet —
+`object_manager.cc:32`), manages the warm worker pool
+(`worker_pool.h:104` PopWorker), serves the worker-lease protocol with
+hybrid-policy spillback (`node_manager.cc:1714` HandleRequestWorkerLease,
+`cluster_task_manager.h:70`), performs placement-group bundle 2-phase-commit
+(`placement_group_resource_manager.h:54-61`), transfers objects node-to-node
+in chunks (`pull_manager.h:52`), and assigns TPU chip instances to leases so
+workers can set `TPU_VISIBLE_CHIPS` (reference: `accelerators/tpu.py:158`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.object_store import NodeObjectStore
+from ray_tpu._private.resources import (
+    CPU, MEM, OBJECT_STORE_MEM, TPU, NodeResources, ResourceSet,
+)
+from ray_tpu._private.rpc import RpcClient, RpcServer, get_io_loop
+from ray_tpu._private.scheduling_policy import (
+    ClusterView, is_feasible_anywhere, pick_node,
+)
+
+
+class _WorkerHandle:
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen,
+                 addr: Tuple[str, int], job_id: bytes):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.addr = addr
+        self.job_id = job_id
+        self.lease: Optional[Dict[str, Any]] = None  # demand + tpu ids
+        self.is_actor = False
+        self.actor_id: Optional[bytes] = None
+        self.last_idle = time.monotonic()
+
+
+class Raylet:
+    def __init__(self, node_id: bytes, host: str, gcs_addr: Tuple[str, int],
+                 resources: Dict[str, float], labels: Dict[str, str],
+                 session_dir: str, object_store_capacity: int,
+                 port: int = 0):
+        self.node_id = node_id
+        self.host = host
+        self.session_dir = session_dir
+        self.gcs = RpcClient(*gcs_addr)
+        self.gcs_addr = gcs_addr
+
+        self.server = RpcServer(host, port)
+        self._register_handlers()
+
+        # --- resources ---
+        self.labels = labels
+        self.total = ResourceSet(resources)
+        self.local = NodeResources(self.total, labels)
+        # TPU chip instance pool for TPU_VISIBLE_CHIPS assignment.
+        n_tpu = int(resources.get(TPU, 0))
+        self._free_tpu_chips: List[int] = list(range(n_tpu))
+        # Chip dedicated to fractional (<1 chip) leases; refcounted so it is
+        # never co-assigned to a whole-chip lease.
+        self._frac_chip: Optional[int] = None
+        self._frac_users = 0
+
+        # --- cluster view (replicated from GCS heartbeats) ---
+        self.view = ClusterView()
+        self._node_addrs: Dict[bytes, Tuple[str, int]] = {}
+
+        # --- object store ---
+        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else session_dir
+        self.store = NodeObjectStore(
+            object_store_capacity, shm_dir,
+            os.path.join(session_dir, "spill", node_id.hex()[:12]),
+            node_id.hex())
+
+        # --- worker pool ---
+        self.workers: Dict[bytes, _WorkerHandle] = {}
+        self._idle: Dict[bytes, deque] = defaultdict(deque)  # job -> handles
+        self._starting: Dict[bytes, int] = defaultdict(int)
+        self._pending_pop: Dict[bytes, deque] = defaultdict(deque)
+        self._max_workers = (GlobalConfig.max_workers_per_node
+                             or max(int(resources.get(CPU, 1)), 1) * 4)
+
+        # --- queued lease requests waiting for local resources ---
+        self._lease_queue: deque = deque()
+        self._lease_queue_event = asyncio.Event()
+
+        # --- placement group bundles ---
+        # (pg_id, idx) -> {"resources": ResourceSet, "committed": bool}
+        self._bundles: Dict[Tuple[bytes, int], Dict[str, Any]] = {}
+
+        self._remote_raylets: Dict[Tuple[str, int], RpcClient] = {}
+        self._dead = False
+
+    # ------------------------------------------------------------------- boot
+    def start(self) -> int:
+        port = self.server.start()
+        reply = self.gcs.call(
+            "register_node", node_id=self.node_id,
+            addr=(self.host, port),
+            resources=self.total.to_dict(), labels=self.labels,
+            object_store_capacity=self.store.capacity)
+        GlobalConfig.load_system_config(reply["system_config"])
+        self._apply_nodes_snapshot(reply["nodes"])
+        io = get_io_loop()
+        io.submit(self._heartbeat_loop())
+        io.submit(self._reaper_loop())
+        io.submit(self._lease_dispatch_loop())
+        return port
+
+    def _register_handlers(self):
+        s = self.server
+        for name in [
+            "request_worker_lease", "return_worker", "lease_worker_for_actor",
+            "register_worker", "worker_exiting",
+            "create_object", "seal_object", "get_object", "contains_object",
+            "delete_objects", "pin_object", "unpin_object", "read_chunk",
+            "object_info", "store_stats",
+            "prepare_bundle", "commit_bundle", "return_bundle",
+            "kill_worker", "node_stats", "shutdown_node", "get_tasks_info",
+        ]:
+            s.register(name, getattr(self, f"_h_{name}"))
+
+    # -------------------------------------------------------------- heartbeat
+    async def _heartbeat_loop(self):
+        period = GlobalConfig.health_check_period_ms / 1000
+        while not self._dead:
+            try:
+                reply = await self.gcs.acall(
+                    "heartbeat", node_id=self.node_id,
+                    available=self.local.available.to_dict(),
+                    total=self.local.total.to_dict(),
+                    timeout=10)
+                if "nodes" in reply:
+                    self._apply_nodes_snapshot(reply["nodes"])
+            except Exception:
+                pass
+            await asyncio.sleep(period)
+
+    def _apply_nodes_snapshot(self, nodes):
+        seen = set()
+        for n in nodes:
+            if n["state"] != "ALIVE":
+                self.view.remove_node(n["node_id"])
+                continue
+            seen.add(n["node_id"])
+            self._node_addrs[n["node_id"]] = tuple(n["addr"])
+            if n["node_id"] == self.node_id:
+                # Authoritative local view is self.local; skip.
+                self.view.update_node(n["node_id"], self.local)
+                continue
+            nr = NodeResources(ResourceSet(n["total"]), n["labels"])
+            nr.available = ResourceSet(n["available"])
+            self.view.update_node(n["node_id"], nr)
+        for node_id in list(self.view.nodes.keys()):
+            if node_id not in seen and node_id != self.node_id:
+                self.view.remove_node(node_id)
+
+    # ------------------------------------------------------------ worker pool
+    def _worker_env(self) -> Dict[str, str]:
+        env = dict(os.environ)
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        return env
+
+    def _spawn_worker(self, job_id: bytes) -> None:
+        self._starting[job_id] += 1
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        worker_id = WorkerID.from_random()
+        out = open(os.path.join(
+            log_dir, f"worker-{worker_id.hex()[:12]}.out"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main",
+             "--raylet-host", self.host,
+             "--raylet-port", str(self.server.port),
+             "--gcs-host", self.gcs_addr[0],
+             "--gcs-port", str(self.gcs_addr[1]),
+             "--node-id", self.node_id.hex(),
+             "--worker-id", worker_id.hex(),
+             "--job-id", job_id.hex(),
+             "--session-dir", self.session_dir],
+            stdout=out, stderr=subprocess.STDOUT, env=self._worker_env(),
+            start_new_session=True)
+        # Handle is completed when the worker registers back.
+        handle = _WorkerHandle(worker_id.binary(), proc, ("", 0), job_id)
+        self.workers[worker_id.binary()] = handle
+
+    async def _h_register_worker(self, worker_id, port, pid, job_id):
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return {"ok": False}
+        handle.addr = (self.host, port)
+        self._starting[job_id] = max(0, self._starting[job_id] - 1)
+        self._offer_worker(handle)
+        return {"ok": True, "system_config": GlobalConfig.dump_system_config()}
+
+    def _offer_worker(self, handle: _WorkerHandle):
+        waiters = self._pending_pop[handle.job_id]
+        while waiters:
+            fut = waiters.popleft()
+            if not fut.done():
+                fut.set_result(handle)
+                return
+        handle.last_idle = time.monotonic()
+        self._idle[handle.job_id].append(handle)
+
+    async def _pop_worker(self, job_id: bytes, timeout: float = 60.0
+                          ) -> Optional[_WorkerHandle]:
+        idle = self._idle[job_id]
+        while idle:
+            handle = idle.popleft()
+            if handle.proc.poll() is None:
+                return handle
+            self.workers.pop(handle.worker_id, None)
+        n_live = sum(1 for w in self.workers.values()
+                     if w.job_id == job_id)
+        if n_live < self._max_workers:
+            # Python worker cold-start is expensive; prestart a batch on first
+            # demand so bursts don't serialize on process spawn (reference:
+            # worker pool prestart, `worker_pool.cc`).
+            n_spawn = 1
+            if n_live == 0:
+                n_spawn = min(GlobalConfig.worker_startup_batch,
+                              self._max_workers)
+            for _ in range(n_spawn):
+                self._spawn_worker(job_id)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending_pop[job_id].append(fut)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    async def _reaper_loop(self):
+        """Detect dead worker processes; report actor deaths to GCS."""
+        while not self._dead:
+            await asyncio.sleep(0.2)
+            for worker_id, handle in list(self.workers.items()):
+                code = handle.proc.poll()
+                if code is None:
+                    continue
+                self.workers.pop(worker_id, None)
+                try:
+                    self._idle[handle.job_id].remove(handle)
+                except ValueError:
+                    pass
+                if handle.lease is not None:
+                    self._release_lease(handle)
+                if handle.is_actor and handle.actor_id is not None:
+                    try:
+                        await self.gcs.acall(
+                            "report_actor_death", actor_id=handle.actor_id,
+                            cause=f"worker process exited with code {code}",
+                            timeout=10)
+                    except Exception:
+                        pass
+
+    # ---------------------------------------------------------- lease protocol
+    def _strategy_allows_local(self, strategy) -> bool:
+        """May a queued request be granted on THIS node once resources free
+        up?  Hard affinity/labels elsewhere must never fall back to local."""
+        if strategy.kind == "NODE_AFFINITY":
+            return strategy.node_id == self.node_id or strategy.soft
+        if strategy.kind == "NODE_LABEL":
+            from ray_tpu._private.scheduling_policy import _label_filter
+
+            return self.node_id in _label_filter(self.view,
+                                                 strategy.hard_labels)
+        return True
+
+    async def _h_request_worker_lease(self, demand, job_id, strategy_kind="DEFAULT",
+                                      strategy_node=None, soft=False,
+                                      hard_labels=None, soft_labels=None,
+                                      lease_timeout=25.0):
+        """Returns {granted, worker_addr, worker_id, tpu_ids} |
+        {spillback_to: addr} | {infeasible: True} | {timeout: True}."""
+        from ray_tpu._private.task_spec import SchedulingStrategySpec
+
+        timeout = lease_timeout
+        demand_rs = ResourceSet(demand)
+        strategy = SchedulingStrategySpec(kind=strategy_kind,
+                                          node_id=strategy_node, soft=soft,
+                                          hard_labels=hard_labels or {},
+                                          soft_labels=soft_labels or {})
+        # Fast path: local node can serve now (and the strategy permits it).
+        if (strategy_kind in ("DEFAULT", "PLACEMENT_GROUP")
+                and self.local.available.is_superset_of(demand_rs)):
+            return await self._grant_local(demand_rs, job_id, timeout,
+                                           strategy)
+
+        target = pick_node(self.view, demand_rs, strategy, self.node_id)
+        if target == self.node_id:
+            return await self._grant_local(demand_rs, job_id, timeout,
+                                           strategy)
+        if target is not None:
+            return {"spillback_to": self._node_addrs.get(target),
+                    "spillback_node": target}
+        # No node can serve *now*. Queue locally only if this node is both
+        # feasible and allowed by the strategy; otherwise let the owner retry
+        # (the right node's raylet will queue it when targeted directly).
+        if (self.local.is_feasible(demand_rs)
+                and self._strategy_allows_local(strategy)):
+            fut = asyncio.get_running_loop().create_future()
+            self._lease_queue.append((demand_rs, job_id, strategy, fut))
+            self._lease_queue_event.set()
+            try:
+                return await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                return {"timeout": True}
+        if strategy.kind == "NODE_AFFINITY" and not strategy.soft:
+            node = self.view.get(strategy.node_id)
+            if node is None:
+                return {"infeasible": True}
+            if strategy.node_id != self.node_id:
+                return {"spillback_to": self._node_addrs.get(strategy.node_id),
+                        "spillback_node": strategy.node_id}
+        if not is_feasible_anywhere(self.view, demand_rs):
+            return {"infeasible": True}
+        return {"retry": True}
+
+    async def _grant_local(self, demand: ResourceSet, job_id: bytes,
+                           timeout: float, strategy=None):
+        if not self.local.try_allocate(demand):
+            fut = asyncio.get_running_loop().create_future()
+            self._lease_queue.append((demand, job_id, strategy, fut))
+            self._lease_queue_event.set()
+            try:
+                return await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                return {"timeout": True}
+        tpu_ids = self._take_tpu_chips(demand)
+        handle = await self._pop_worker(job_id)
+        if handle is None:
+            self.local.release(demand)
+            self._release_tpu_chips(demand, tpu_ids)
+            return {"timeout": True}
+        handle.lease = {"demand": demand, "tpu_ids": tpu_ids}
+        return {"granted": True, "worker_addr": handle.addr,
+                "worker_id": handle.worker_id, "tpu_ids": tpu_ids}
+
+    def _take_tpu_chips(self, demand: ResourceSet) -> List[int]:
+        qty = demand.get(TPU)
+        n = int(qty)
+        if n <= 0:
+            if qty <= 0:
+                return []
+            # Fractional share: dedicate one chip to all fractional leases.
+            if self._frac_chip is None:
+                if not self._free_tpu_chips:
+                    return []
+                self._frac_chip = self._free_tpu_chips.pop(0)
+            self._frac_users += 1
+            return [self._frac_chip]
+        take, self._free_tpu_chips = (self._free_tpu_chips[:n],
+                                      self._free_tpu_chips[n:])
+        return take
+
+    def _release_tpu_chips(self, demand: ResourceSet, chips: List[int]) -> None:
+        qty = demand.get(TPU)
+        if 0 < qty < 1:
+            self._frac_users -= 1
+            if self._frac_users <= 0 and self._frac_chip is not None:
+                self._free_tpu_chips.append(self._frac_chip)
+                self._free_tpu_chips.sort()
+                self._frac_chip = None
+                self._frac_users = 0
+            return
+        for c in chips:
+            if c not in self._free_tpu_chips and c != self._frac_chip:
+                self._free_tpu_chips.append(c)
+        self._free_tpu_chips.sort()
+
+    def _release_lease(self, handle: _WorkerHandle):
+        lease = handle.lease
+        handle.lease = None
+        if lease is None:
+            return
+        self.local.release(lease["demand"])
+        self._release_tpu_chips(lease["demand"], lease["tpu_ids"])
+        self._lease_queue_event.set()
+
+    async def _lease_dispatch_loop(self):
+        while not self._dead:
+            await self._lease_queue_event.wait()
+            self._lease_queue_event.clear()
+            pending = len(self._lease_queue)
+            for _ in range(pending):
+                demand, job_id, strategy, fut = self._lease_queue.popleft()
+                if fut.done():
+                    continue
+                if self.local.available.is_superset_of(demand):
+                    reply = await self._grant_local(demand, job_id, 60.0,
+                                                    strategy)
+                    if not fut.done():
+                        fut.set_result(reply)
+                else:
+                    self._lease_queue.append((demand, job_id, strategy, fut))
+            await asyncio.sleep(0.005)
+
+    async def _h_return_worker(self, worker_id, kill=False):
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return False
+        self._release_lease(handle)
+        if kill or handle.proc.poll() is not None:
+            self.workers.pop(worker_id, None)
+            if handle.proc.poll() is None:
+                handle.proc.kill()
+        else:
+            self._offer_worker(handle)
+        return True
+
+    async def _h_lease_worker_for_actor(self, spec, demand):
+        demand_rs = ResourceSet(demand)
+        if not self.local.try_allocate(demand_rs):
+            return {"ok": False, "reason": "resources busy"}
+        tpu_ids = self._take_tpu_chips(demand_rs)
+        handle = await self._pop_worker(spec.job_id.binary())
+        if handle is None:
+            self.local.release(demand_rs)
+            self._release_tpu_chips(demand_rs, tpu_ids)
+            return {"ok": False, "reason": "no worker"}
+        handle.lease = {"demand": demand_rs, "tpu_ids": tpu_ids}
+        handle.is_actor = True
+        handle.actor_id = spec.actor_id.binary()
+        return {"ok": True, "worker_addr": handle.addr,
+                "worker_id": handle.worker_id, "tpu_ids": tpu_ids}
+
+    async def _h_worker_exiting(self, worker_id):
+        handle = self.workers.pop(worker_id, None)
+        if handle is not None:
+            self._release_lease(handle)
+            try:
+                self._idle[handle.job_id].remove(handle)
+            except ValueError:
+                pass
+        return True
+
+    async def _h_kill_worker(self, worker_id, force=True):
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            return False
+        if force:
+            handle.proc.kill()
+        else:
+            handle.proc.terminate()
+        return True
+
+    # ------------------------------------------------------------ object store
+    async def _h_create_object(self, object_id, size):
+        return self.store.create(object_id, size)
+
+    async def _h_seal_object(self, object_id):
+        self.store.seal(object_id)
+        return True
+
+    async def _h_get_object(self, object_id, wait_timeout=None, locations=None):
+        timeout = wait_timeout
+        """Wait locally; if absent and locations are known, pull from a
+        remote raylet in chunks (reference: PullManager + ObjectManager)."""
+        found = await self.store.get(object_id, timeout=0.0)
+        if found is not None:
+            return {"path": found[0], "size": found[1]}
+        if locations:
+            for node_id in locations:
+                if node_id == self.node_id:
+                    continue
+                addr = self._node_addrs.get(node_id)
+                if addr is None:
+                    continue
+                try:
+                    await self._pull_from(object_id, addr)
+                    found = await self.store.get(object_id, timeout=1.0)
+                    if found is not None:
+                        return {"path": found[0], "size": found[1]}
+                except Exception:
+                    continue
+        found = await self.store.get(object_id, timeout=timeout)
+        if found is None:
+            return {"not_found": True}
+        return {"path": found[0], "size": found[1]}
+
+    async def _pull_from(self, object_id, addr: Tuple[str, int]):
+        client = self._remote_client(addr)
+        info = await client.acall("object_info", object_id=object_id,
+                                  timeout=30)
+        if info is None:
+            raise KeyError("remote object gone")
+        size = info["size"]
+        chunk = GlobalConfig.object_manager_chunk_size
+        path = self.store.create(object_id, size)
+        with open(path, "r+b") as f:
+            for offset in range(0, size, chunk):
+                data = await client.acall(
+                    "read_chunk", object_id=object_id, offset=offset,
+                    length=min(chunk, size - offset), timeout=60)
+                f.seek(offset)
+                f.write(data)
+        self.store.seal(object_id)
+
+    def _remote_client(self, addr) -> RpcClient:
+        addr = tuple(addr)
+        if addr not in self._remote_raylets:
+            self._remote_raylets[addr] = RpcClient(*addr)
+        return self._remote_raylets[addr]
+
+    async def _h_contains_object(self, object_id):
+        return self.store.contains(object_id)
+
+    async def _h_object_info(self, object_id):
+        if not self.store.contains(object_id):
+            return None
+        return {"size": self.store.size_of(object_id)}
+
+    async def _h_read_chunk(self, object_id, offset, length):
+        return self.store.read_bytes(object_id, offset, length)
+
+    async def _h_delete_objects(self, object_ids):
+        self.store.delete(object_ids)
+        return True
+
+    async def _h_pin_object(self, object_id):
+        self.store.pin(object_id)
+        return True
+
+    async def _h_unpin_object(self, object_id):
+        self.store.unpin(object_id)
+        return True
+
+    async def _h_store_stats(self):
+        return self.store.stats()
+
+    # -------------------------------------------------------------- PG bundles
+    async def _h_prepare_bundle(self, pg_id, bundle_index, resources):
+        """Phase 1: reserve the bundle's resources (reversible)."""
+        key = (pg_id, bundle_index)
+        if key in self._bundles:
+            return True
+        demand = ResourceSet(resources)
+        if not self.local.try_allocate(demand):
+            return False
+        self._bundles[key] = {"resources": demand, "committed": False}
+        return True
+
+    async def _h_commit_bundle(self, pg_id, bundle_index):
+        """Phase 2: mint the bundle-formatted resources on this node
+        (reference formatted-resource scheme: `CPU_group_{i}_{pg}` etc.)."""
+        from ray_tpu._private.resources import pg_bundle_grant
+
+        key = (pg_id, bundle_index)
+        bundle = self._bundles.get(key)
+        if bundle is None or bundle["committed"]:
+            return bundle is not None
+        add = pg_bundle_grant(bundle["resources"], pg_id.hex(), bundle_index)
+        self.local.total = self.local.total.add(add)
+        self.local.available = self.local.available.add(add)
+        bundle["committed"] = True
+        bundle["formatted"] = add
+        self._lease_queue_event.set()
+        return True
+
+    async def _h_return_bundle(self, pg_id, bundle_index):
+        key = (pg_id, bundle_index)
+        bundle = self._bundles.pop(key, None)
+        if bundle is None:
+            return True
+        if bundle["committed"]:
+            add = bundle["formatted"]
+            self.local.total = self.local.total.subtract(add)
+            self.local.available = self.local.available.subtract(add)
+            # Clamp negatives (a task may still hold formatted resources).
+            if self.local.available.has_negative():
+                fixed = {k: max(0, v) for k, v in
+                         self.local.available._fixed.items()}
+                self.local.available = ResourceSet(_fixed=fixed)
+        self.local.release(bundle["resources"])
+        return True
+
+    # ------------------------------------------------------------------- misc
+    async def _h_node_stats(self):
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.local.total.to_dict(),
+            "resources_available": self.local.available.to_dict(),
+            "num_workers": len(self.workers),
+            "store": self.store.stats(),
+            "event_stats": self.server.stats.snapshot(),
+        }
+
+    async def _h_get_tasks_info(self):
+        out = []
+        for w in self.workers.values():
+            if w.lease is not None:
+                out.append({"worker_id": w.worker_id, "is_actor": w.is_actor,
+                            "actor_id": w.actor_id})
+        return out
+
+    async def _h_shutdown_node(self):
+        asyncio.get_running_loop().call_later(0.05, self.shutdown)
+        return True
+
+    def shutdown(self):
+        self._dead = True
+        for handle in self.workers.values():
+            try:
+                handle.proc.kill()
+            except Exception:
+                pass
+        self.store.cleanup()
+        os._exit(0)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--resources", required=True)
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--object-store-capacity", type=int, default=0)
+    args = parser.parse_args()
+
+    capacity = args.object_store_capacity or GlobalConfig.object_store_memory
+    import signal
+
+    raylet = Raylet(
+        node_id=bytes.fromhex(args.node_id),
+        host=args.host,
+        gcs_addr=(args.gcs_host, args.gcs_port),
+        resources=json.loads(args.resources),
+        labels=json.loads(args.labels),
+        session_dir=args.session_dir,
+        object_store_capacity=capacity,
+        port=args.port,
+    )
+    # Graceful termination must clean the node's /dev/shm store files.
+    signal.signal(signal.SIGTERM, lambda *_: raylet.shutdown())
+    port = raylet.start()
+    print(f"RAYLET_PORT={port}", flush=True)
+    import threading
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

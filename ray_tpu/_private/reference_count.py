@@ -1,0 +1,141 @@
+"""Owner-side reference counting + object directory.
+
+Role-equivalent to the reference's distributed ref counter and
+ownership-based object directory (`reference_count.h:61`,
+`ownership_based_object_directory.h`): the worker that created an object is
+its *owner*; it tracks (a) local Python refs, (b) pending submitted tasks
+that depend on the object, (c) whether the ref was serialized out (shared —
+conservatively pinned this round in lieu of the full borrower protocol), and
+(d) the set of nodes holding a sealed copy. When counts hit zero the object
+is freed everywhere via the on_free callback.
+
+Pure, single-threaded-per-owner state machine — tested standalone like
+`reference_count_test.cc` does.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Set
+
+
+@dataclass
+class _Ref:
+    local: int = 0
+    task_deps: int = 0
+    shared: bool = False
+    freed: bool = False
+    locations: Set[bytes] = field(default_factory=set)
+    is_owned_by_us: bool = True
+
+
+class ReferenceCounter:
+    def __init__(self, on_free: Optional[Callable[[bytes, Set[bytes]], None]] = None):
+        self._refs: Dict[bytes, _Ref] = {}
+        self._lock = threading.RLock()
+        self._on_free = on_free
+
+    # -- ref lifecycle ------------------------------------------------------
+    def add_owned(self, object_id: bytes) -> None:
+        with self._lock:
+            self._refs.setdefault(object_id, _Ref())
+
+    def add_borrowed(self, object_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, _Ref())
+            ref.is_owned_by_us = False
+
+    def add_local_ref(self, object_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, _Ref())
+            ref.local += 1
+
+    def remove_local_ref(self, object_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            ref.local = max(0, ref.local - 1)
+            self._maybe_free(object_id, ref)
+
+    def add_task_dependency(self, object_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, _Ref())
+            ref.task_deps += 1
+
+    def remove_task_dependency(self, object_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            ref.task_deps = max(0, ref.task_deps - 1)
+            self._maybe_free(object_id, ref)
+
+    def mark_shared(self, object_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, _Ref())
+            ref.shared = True
+
+    # -- directory ----------------------------------------------------------
+    def add_location(self, object_id: bytes, node_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, _Ref())
+            ref.locations.add(node_id)
+
+    def remove_location(self, object_id: bytes, node_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is not None:
+                ref.locations.discard(node_id)
+
+    def locations(self, object_id: bytes) -> Set[bytes]:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return set(ref.locations) if ref else set()
+
+    # -- queries ------------------------------------------------------------
+    def has_ref(self, object_id: bytes) -> bool:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref is not None and not ref.freed
+
+    def is_freed(self, object_id: bytes) -> bool:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref is not None and ref.freed
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._refs.values() if not r.freed)
+
+    def snapshot(self, object_id: bytes) -> Optional[dict]:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return None
+            return {"local": ref.local, "task_deps": ref.task_deps,
+                    "shared": ref.shared, "freed": ref.freed,
+                    "locations": set(ref.locations)}
+
+    # -- freeing ------------------------------------------------------------
+    def _maybe_free(self, object_id: bytes, ref: _Ref) -> None:
+        if (ref.local == 0 and ref.task_deps == 0 and not ref.shared
+                and not ref.freed and ref.is_owned_by_us):
+            ref.freed = True
+            locations = set(ref.locations)
+            ref.locations.clear()
+            if self._on_free is not None:
+                self._on_free(object_id, locations)
+
+    def force_free(self, object_id: bytes) -> None:
+        """Explicit free (`ray_tpu.internal.free`) regardless of counts."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None or ref.freed:
+                return
+            ref.freed = True
+            locations = set(ref.locations)
+            ref.locations.clear()
+            if self._on_free is not None:
+                self._on_free(object_id, locations)

@@ -1,0 +1,314 @@
+"""Control-plane RPC: asyncio TCP with length-prefixed pickled frames.
+
+Role-equivalent to the reference's `src/ray/rpc/` gRPC scaffolding plus the
+instrumented asio event loop (`asio/instrumented_io_context.h:27`,
+`event_stats.h:104`): every server lives on a dedicated event-loop thread, all
+handler invocations are latency-tracked, and clients support concurrent
+in-flight calls with per-call timeouts and automatic reconnect.
+
+This plane is hardware-agnostic (DCN-level) by design — tensors NEVER travel
+here; they move via XLA collectives inside jitted programs (see
+ray_tpu.util.collective) or through the shared-memory object store.
+
+Wire format: 8-byte big-endian length || pickle((req_id, kind, method, payload)).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import struct
+import threading
+import time
+import traceback
+from collections import defaultdict
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+_HEADER = struct.Struct(">Q")
+
+_KIND_REQUEST = 0
+_KIND_RESPONSE = 1
+_KIND_ERROR = 2
+
+# Payloads bigger than this are rejected to catch framing corruption early.
+_MAX_FRAME = 1 << 33
+
+
+class RpcError(Exception):
+    """Remote handler raised; carries the remote traceback string."""
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+class EventStats:
+    """Per-handler count/total-time tracking (reference: event_stats.h:104)."""
+
+    def __init__(self):
+        self._stats: Dict[str, Tuple[int, float]] = defaultdict(lambda: (0, 0.0))
+        self._lock = threading.Lock()
+
+    def record(self, name: str, elapsed: float) -> None:
+        with self._lock:
+            count, total = self._stats[name]
+            self._stats[name] = (count + 1, total + elapsed)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                k: {"count": c, "total_s": t, "mean_s": t / c if c else 0.0}
+                for k, (c, t) in self._stats.items()
+            }
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    header = await reader.readexactly(_HEADER.size)
+    (length,) = _HEADER.unpack(header)
+    if length > _MAX_FRAME:
+        raise ConnectionLost(f"oversized frame: {length}")
+    body = await reader.readexactly(length)
+    return pickle.loads(body)
+
+
+def _encode_frame(msg) -> bytes:
+    body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    return _HEADER.pack(len(body)) + body
+
+
+class EventLoopThread:
+    """An asyncio loop running on a daemon thread; sync-callable."""
+
+    def __init__(self, name: str = "ray_tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro: Awaitable, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def submit(self, coro: Awaitable):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        def _shutdown():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.stop()
+
+        self.loop.call_soon_threadsafe(_shutdown)
+        self._thread.join(timeout=5)
+
+
+_global_loop: Optional[EventLoopThread] = None
+_global_loop_lock = threading.Lock()
+
+
+def get_io_loop() -> EventLoopThread:
+    global _global_loop
+    with _global_loop_lock:
+        if _global_loop is None or not _global_loop._thread.is_alive():
+            _global_loop = EventLoopThread()
+        return _global_loop
+
+
+Handler = Callable[..., Awaitable[Any]]
+
+
+class RpcServer:
+    """Serves registered async handlers over TCP.
+
+    Handlers have signature ``async def handler(**payload) -> reply``.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 io: Optional[EventLoopThread] = None):
+        self._host = host
+        self._requested_port = port
+        self._handlers: Dict[str, Handler] = {}
+        self._io = io or get_io_loop()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.stats = EventStats()
+        self.port: Optional[int] = None
+
+    def register(self, method: str, handler: Handler) -> None:
+        self._handlers[method] = handler
+
+    def register_service(self, service: object, prefix: str = "") -> None:
+        """Register every public async method of an object."""
+        for name in dir(service):
+            if name.startswith("_"):
+                continue
+            fn = getattr(service, name)
+            if asyncio.iscoroutinefunction(fn):
+                self._handlers[prefix + name] = fn
+
+    def start(self) -> int:
+        async def _start():
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._host, self._requested_port
+            )
+            return self._server.sockets[0].getsockname()[1]
+
+        self.port = self._io.run(_start())
+        return self.port
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self._host, self.port)
+
+    async def _handle_conn(self, reader, writer):
+        write_lock = asyncio.Lock()
+        try:
+            while True:
+                try:
+                    req_id, kind, method, payload = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError,
+                        ConnectionLost):
+                    break
+                if kind != _KIND_REQUEST:
+                    continue
+                asyncio.ensure_future(
+                    self._dispatch(req_id, method, payload, writer, write_lock)
+                )
+        finally:
+            writer.close()
+
+    async def _dispatch(self, req_id, method, payload, writer, write_lock):
+        start = time.monotonic()
+        try:
+            handler = self._handlers.get(method)
+            if handler is None:
+                raise RpcError(f"no handler registered for {method!r}")
+            reply = await handler(**payload)
+            frame = _encode_frame((req_id, _KIND_RESPONSE, method, reply))
+        except Exception as exc:  # noqa: BLE001 — forwarded to caller
+            err = (type(exc).__name__, str(exc), traceback.format_exc(), exc)
+            try:
+                frame = _encode_frame((req_id, _KIND_ERROR, method, err))
+            except Exception:
+                # Exception object itself unpicklable — send string form only.
+                frame = _encode_frame((req_id, _KIND_ERROR, method,
+                                       (type(exc).__name__, str(exc),
+                                        traceback.format_exc(), None)))
+        finally:
+            self.stats.record(method, time.monotonic() - start)
+        try:
+            async with write_lock:
+                writer.write(frame)
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, RuntimeError):
+            pass
+
+    def stop(self):
+        async def _stop():
+            if self._server is not None:
+                self._server.close()
+                await self._server.wait_closed()
+
+        try:
+            self._io.run(_stop(), timeout=5)
+        except Exception:
+            pass
+
+
+class RpcClient:
+    """Persistent connection to one RpcServer; thread-safe concurrent calls."""
+
+    def __init__(self, host: str, port: int,
+                 io: Optional[EventLoopThread] = None,
+                 connect_timeout: float = 10.0):
+        self.host, self.port = host, port
+        self._io = io or get_io_loop()
+        self._connect_timeout = connect_timeout
+        self._reader = None
+        self._writer = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._conn_lock: Optional[asyncio.Lock] = None
+        self._write_lock: Optional[asyncio.Lock] = None
+        self._closed = False
+
+    async def _ensure_connected(self):
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+            self._write_lock = asyncio.Lock()
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            deadline = time.monotonic() + self._connect_timeout
+            delay = 0.05
+            while True:
+                try:
+                    self._reader, self._writer = await asyncio.open_connection(
+                        self.host, self.port
+                    )
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 1.0)
+            asyncio.ensure_future(self._read_loop(self._reader))
+
+    async def _read_loop(self, reader):
+        try:
+            while True:
+                req_id, kind, method, payload = await _read_frame(reader)
+                fut = self._pending.pop(req_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == _KIND_RESPONSE:
+                    fut.set_result(payload)
+                else:
+                    name, msg, tb, exc = payload
+                    if exc is not None and isinstance(exc, Exception):
+                        fut.set_exception(exc)
+                    else:
+                        fut.set_exception(RpcError(f"{name}: {msg}\n{tb}"))
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                ConnectionLost, Exception):
+            self._writer = None
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost(
+                        f"connection to {self.host}:{self.port} lost"))
+            self._pending.clear()
+
+    async def acall(self, method: str, timeout: Optional[float] = None, **payload):
+        await self._ensure_connected()
+        self._next_id += 1
+        req_id = self._next_id
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        frame = _encode_frame((req_id, _KIND_REQUEST, method, payload))
+        async with self._write_lock:
+            self._writer.write(frame)
+            await self._writer.drain()
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    def call(self, method: str, timeout: Optional[float] = None, **payload):
+        """Blocking call from any non-loop thread."""
+        outer = None if timeout is None else timeout + 5
+        return self._io.submit(
+            self.acall(method, timeout=timeout, **payload)
+        ).result(outer)
+
+    def close(self):
+        self._closed = True
+
+        async def _close():
+            if self._writer is not None:
+                self._writer.close()
+
+        try:
+            self._io.run(_close(), timeout=2)
+        except Exception:
+            pass

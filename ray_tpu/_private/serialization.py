@@ -1,0 +1,151 @@
+"""Object serialization with zero-copy out-of-band buffers.
+
+Role-equivalent to the reference's `_private/serialization.py:110`
+(`SerializationContext`): cloudpickle for arbitrary Python objects, with numpy
+(and jax-on-host) array payloads carried out-of-band via pickle protocol 5 so
+they land in / are read from shared memory without copies.
+
+Store layout for a sealed object::
+
+    u32 magic | u32 n_buffers | u64 pickle_len | n*u64 buffer_lens
+    | pickle bytes | pad to 64 | buffer0 | pad to 64 | buffer1 | ...
+
+ObjectRefs and ActorHandles embedded inside values are reduced to portable
+descriptors and rehydrated against the current worker on load (the hook is
+installed by `ray_tpu._private.worker`).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+_MAGIC = 0x52545055  # "RTPU"
+_ALIGN = 64
+_HDR = struct.Struct("<II Q")
+
+
+class SerializedObject:
+    """A pickled payload plus out-of-band buffers, ready to write."""
+
+    __slots__ = ("meta", "buffers", "total_size")
+
+    def __init__(self, meta: bytes, buffers: Sequence[memoryview]):
+        self.meta = meta
+        self.buffers = [b.cast("B") if b.format != "B" or b.ndim != 1 else b
+                        for b in map(memoryview, buffers)]
+        size = _HDR.size + 8 * len(self.buffers)
+        size = _aligned(size + len(meta))
+        for b in self.buffers:
+            size = _aligned(size + b.nbytes)
+        self.total_size = size
+
+    def write_into(self, dest: memoryview) -> None:
+        off = _HDR.size + 8 * len(self.buffers)
+        _HDR.pack_into(dest, 0, _MAGIC, len(self.buffers), len(self.meta))
+        for i, b in enumerate(self.buffers):
+            struct.pack_into("<Q", dest, _HDR.size + 8 * i, b.nbytes)
+        dest[off:off + len(self.meta)] = self.meta
+        off = _aligned(off + len(self.meta))
+        for b in self.buffers:
+            dest[off:off + b.nbytes] = b
+            off = _aligned(off + b.nbytes)
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class SerializationContext:
+    """Per-worker serializer; custom reducer hooks are pluggable."""
+
+    def __init__(self):
+        # type -> reducer(obj) -> (reconstructor, args)
+        self._custom_reducers: dict = {}
+        self._on_deserialize: List[Callable[[Any], None]] = []
+
+    def register_reducer(self, type_: type, reducer: Callable) -> None:
+        self._custom_reducers[type_] = reducer
+
+    def serialize(self, value: Any) -> SerializedObject:
+        buffers: List[pickle.PickleBuffer] = []
+
+        class _Pickler(cloudpickle.Pickler):
+            dispatch_table = dict(getattr(cloudpickle.Pickler, "dispatch_table", {}))
+
+        for type_, reducer in self._custom_reducers.items():
+            _Pickler.dispatch_table[type_] = reducer
+
+        import io
+
+        sink = io.BytesIO()
+        pickler = _Pickler(sink, protocol=5, buffer_callback=buffers.append)
+        pickler.dump(value)
+        views = [b.raw() for b in buffers]
+        return SerializedObject(sink.getvalue(), views)
+
+    def deserialize(self, data: memoryview, keepalive: Any = None) -> Any:
+        data = memoryview(data)
+        magic, n_buffers, meta_len = _HDR.unpack_from(data, 0)
+        if magic != _MAGIC:
+            raise ValueError("corrupt object payload (bad magic)")
+        sizes = [
+            struct.unpack_from("<Q", data, _HDR.size + 8 * i)[0]
+            for i in range(n_buffers)
+        ]
+        off = _HDR.size + 8 * n_buffers
+        meta = bytes(data[off:off + meta_len])
+        off = _aligned(off + meta_len)
+        bufs = []
+        for size in sizes:
+            view = data[off:off + size]
+            if keepalive is not None:
+                view = _KeepaliveView(view, keepalive)
+            bufs.append(view)
+            off = _aligned(off + size)
+        return pickle.loads(meta, buffers=bufs)
+
+
+class _KeepaliveView:
+    """memoryview proxy that pins a backing resource (e.g. SharedMemory)."""
+
+    def __init__(self, view: memoryview, keepalive: Any):
+        self._view = view
+        self._keepalive = keepalive
+
+    def __buffer__(self, flags):
+        return self._view.__buffer__(flags)
+
+    def __len__(self):
+        return len(self._view)
+
+    def __getitem__(self, item):
+        return self._view[item]
+
+    @property
+    def nbytes(self):
+        return self._view.nbytes
+
+
+def serialize_error(exc: BaseException) -> bytes:
+    """Best-effort pickling of an exception for cross-process propagation."""
+    import traceback
+
+    try:
+        return cloudpickle.dumps((exc, traceback.format_exc()))
+    except Exception:
+        return cloudpickle.dumps(
+            (RuntimeError(f"{type(exc).__name__}: {exc}"), traceback.format_exc())
+        )
+
+
+def deserialize_error(payload: bytes) -> Tuple[BaseException, str]:
+    return cloudpickle.loads(payload)

@@ -1,0 +1,110 @@
+"""Task specifications — the unit handed from submitters to schedulers.
+
+Role-equivalent to the reference's `src/ray/common/task/task_spec.h` +
+`function_descriptor.h`. A TaskSpec is fully picklable and self-contained:
+function descriptor (resolved against the GCS function table), serialized
+args (inline values or ObjectRef descriptors), resource demand, scheduling
+strategy, and retry/return metadata (option surface mirrors
+`python/ray/_private/ray_option_utils.py`).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.resources import ResourceSet
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class FunctionDescriptor:
+    """Identifies a remote function/actor class in the GCS function table."""
+
+    module: str
+    qualname: str
+    function_hash: str  # content hash; key in the GCS KV function table
+
+    def key(self) -> str:
+        return f"fn:{self.function_hash}"
+
+    def __repr__(self):
+        return f"{self.module}.{self.qualname}"
+
+
+@dataclass
+class ArgSpec:
+    """One task argument: either an inline serialized value or an ObjectRef."""
+
+    is_ref: bool
+    # inline payload (SerializedObject bytes) when not a ref
+    inline_data: Optional[bytes] = None
+    # object id + owner address when a ref
+    object_id: Optional[bytes] = None
+    owner_addr: Optional[Tuple[str, int]] = None
+
+
+@dataclass
+class SchedulingStrategySpec:
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP | NODE_LABEL
+    node_id: Optional[bytes] = None
+    soft: bool = False
+    placement_group_id: Optional[bytes] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+    hard_labels: Dict[str, List[str]] = field(default_factory=dict)
+    soft_labels: Dict[str, List[str]] = field(default_factory=dict)
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    function: FunctionDescriptor
+    args: List[ArgSpec]
+    kwargs_keys: List[str]  # last len(kwargs_keys) args are kwargs
+    num_returns: int
+    resources: ResourceSet
+    owner_addr: Tuple[str, int]  # core-worker RPC address of the owner
+    owner_worker_id: WorkerID
+    name: str = ""
+    scheduling: SchedulingStrategySpec = field(default_factory=SchedulingStrategySpec)
+    max_retries: int = 0
+    retry_exceptions: Any = False  # bool or list of exception types (pickled ok)
+    runtime_env: Optional[Dict[str, Any]] = None
+    # actor tasks
+    actor_id: Optional[ActorID] = None
+    sequence_number: int = -1
+    concurrency_group: str = ""
+    # actor creation
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    is_async_actor: bool = False
+    is_detached: bool = False
+    actor_name: str = ""
+    namespace: str = ""
+    # generators
+    is_streaming_generator: bool = False
+    generator_backpressure: int = -1
+    # tracing
+    parent_task_id: Optional[TaskID] = None
+    depth: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def return_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.for_task_return(self.task_id, i + 1)
+            for i in range(self.num_returns)
+        ]
+
+    def dependencies(self) -> List[bytes]:
+        return [a.object_id for a in self.args if a.is_ref]

@@ -1,0 +1,63 @@
+"""Worker process entry point.
+
+Role-equivalent to the reference's `default_worker.py` + `worker.main_loop`
+(`_private/worker.py:869`): boot a core worker, register with the local
+raylet, then serve task-execution RPCs forever. The process exits when its
+raylet kills it, when `kill_self` arrives, or when the raylet connection is
+lost (fate-sharing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import JobID, WorkerID
+from ray_tpu._private.worker import MODE_WORKER, Worker, set_global_worker
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-host", required=True)
+    parser.add_argument("--raylet-port", type=int, required=True)
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--job-id", required=True)
+    parser.add_argument("--session-dir", required=True)
+    args = parser.parse_args()
+
+    worker = Worker(
+        mode=MODE_WORKER,
+        gcs_addr=(args.gcs_host, args.gcs_port),
+        raylet_addr=(args.raylet_host, args.raylet_port),
+        node_id=bytes.fromhex(args.node_id),
+        job_id=JobID(bytes.fromhex(args.job_id)),
+        worker_id=WorkerID(bytes.fromhex(args.worker_id)),
+        session_dir=args.session_dir,
+    )
+    set_global_worker(worker)
+
+    reply = worker.raylet.call(
+        "register_worker", worker_id=worker.worker_id.binary(),
+        port=worker.port, pid=os.getpid(), job_id=worker.job_id.binary())
+    if not reply.get("ok"):
+        print("raylet refused worker registration; exiting", file=sys.stderr)
+        sys.exit(1)
+    GlobalConfig.load_system_config(reply.get("system_config", "{}"))
+
+    # Fate-share with the raylet: if pings start failing, exit.
+    while True:
+        time.sleep(2.0)
+        try:
+            worker.raylet.call("node_stats", timeout=10)
+        except Exception:
+            os._exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+from ray_tpu.accelerators.accelerator import AcceleratorManager
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+__all__ = ["AcceleratorManager", "TPUAcceleratorManager"]

@@ -1,0 +1,74 @@
+"""@ray_tpu.remote on functions (reference: `python/ray/remote_function.py`)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+# Option surface mirrors the reference's central validation table
+# (`python/ray/_private/ray_option_utils.py`).
+_VALID_TASK_OPTIONS = {
+    "num_cpus", "num_tpus", "num_gpus", "resources", "memory",
+    "accelerator_type", "max_retries", "retry_exceptions", "num_returns",
+    "scheduling_strategy", "runtime_env", "name", "_labels",
+}
+
+
+def validate_task_options(options: Dict[str, Any]) -> None:
+    for key in options:
+        if key not in _VALID_TASK_OPTIONS:
+            raise ValueError(
+                f"invalid option {key!r} for a remote function; valid: "
+                f"{sorted(_VALID_TASK_OPTIONS)}")
+    nr = options.get("num_returns", 1)
+    if not (isinstance(nr, int) and nr >= 0):
+        raise ValueError("num_returns must be a non-negative int")
+    if options.get("num_gpus"):
+        raise ValueError(
+            "ray_tpu is a TPU-native framework: use num_tpus instead of "
+            "num_gpus")
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._function = fn
+        self._options = dict(options or {})
+        validate_task_options(self._options)
+        self._pickled: Optional[bytes] = None
+        self._fn_hash: Optional[str] = None
+        self.__name__ = getattr(fn, "__name__", "remote_function")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def _ensure_exported(self, worker) -> str:
+        if self._pickled is None:
+            self._pickled = cloudpickle.dumps(self._function)
+        if self._fn_hash is None:
+            self._fn_hash = worker.export_function(self._pickled)
+        return self._fn_hash
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        fn_hash = self._ensure_exported(w)
+        refs = w.submit_task(fn_hash, self.__name__, args, kwargs,
+                             self._options)
+        nr = self._options.get("num_returns", 1)
+        if nr == 0:
+            return None
+        if nr == 1:
+            return refs[0]
+        return refs
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = {**self._options, **options}
+        clone = RemoteFunction(self._function, merged)
+        clone._pickled = self._pickled
+        clone._fn_hash = self._fn_hash
+        return clone
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__} cannot be called directly; use "
+            f"{self.__name__}.remote()")

@@ -1,0 +1,59 @@
+"""Test fixtures (reference model: `python/ray/tests/conftest.py`).
+
+JAX runs on the CPU backend with 8 virtual devices — the moral equivalent of
+the reference's `_fake_gpus` / gloo tiers (SURVEY §4): sharding/collective
+code is exercised on a faked device mesh without TPU hardware. The
+environment preloads jax before conftest runs, so platform selection must go
+through `jax.config` (env vars are too late).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    """A real single-node cluster shared by a test module."""
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=8, num_tpus=0,
+                        object_store_memory=256 * 1024 * 1024,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_isolated():
+    """A fresh single-node cluster per test (for failure-injection tests)."""
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-raylet in-process cluster builder (reference: `Cluster`)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False)
+    yield cluster
+    cluster.shutdown()

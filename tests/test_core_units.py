@@ -1,0 +1,220 @@
+"""Unit tests of the common substrate: IDs, resources, refcount, policies,
+serialization. (Reference analogues: id_test, fixed-point/scheduling tests,
+reference_count_test.cc — tested as pure state machines.)"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import (
+    ActorID, JobID, ObjectID, TaskID, WorkerID,
+)
+from ray_tpu._private.reference_count import ReferenceCounter
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.scheduling_policy import ClusterView, pick_node
+from ray_tpu._private.serialization import SerializationContext
+from ray_tpu._private.task_spec import SchedulingStrategySpec
+
+
+class TestIDs:
+    def test_nesting(self):
+        job = JobID.from_int(7)
+        actor = ActorID.of(job)
+        task = TaskID.for_actor_task(actor)
+        obj = ObjectID.for_task_return(task, 1)
+        assert actor.job_id() == job
+        assert task.actor_id() == actor
+        assert task.job_id() == job
+        assert obj.task_id() == task
+        assert obj.return_index() == 1
+        assert obj.job_id() == job
+
+    def test_sizes(self):
+        assert len(JobID.from_int(1).binary()) == 4
+        assert len(ActorID.of(JobID.from_int(1)).binary()) == 16
+        assert len(TaskID.for_normal_task(JobID.from_int(1)).binary()) == 24
+        t = TaskID.for_normal_task(JobID.from_int(1))
+        assert len(ObjectID.for_task_return(t, 1).binary()) == 28
+
+    def test_put_vs_return_index(self):
+        t = TaskID.for_normal_task(JobID.from_int(1))
+        ret = ObjectID.for_task_return(t, 3)
+        put = ObjectID.for_put(t, 3)
+        assert ret != put
+        assert put.is_put() and not ret.is_put()
+        assert put.return_index() == 3
+
+    def test_pickle_roundtrip(self):
+        t = TaskID.for_normal_task(JobID.from_int(9))
+        assert pickle.loads(pickle.dumps(t)) == t
+
+    def test_hex_roundtrip(self):
+        w = WorkerID.from_random()
+        assert WorkerID.from_hex(w.hex()) == w
+
+
+class TestResources:
+    def test_fixed_point(self):
+        rs = ResourceSet({"CPU": 0.1})
+        total = ResourceSet({})
+        for _ in range(10):
+            total = total.add(rs)
+        assert total == ResourceSet({"CPU": 1.0})  # no float drift
+
+    def test_superset_and_subtract(self):
+        a = ResourceSet({"CPU": 4, "TPU": 4})
+        b = ResourceSet({"CPU": 2, "TPU": 4})
+        assert a.is_superset_of(b)
+        assert not b.is_superset_of(a)
+        c = a.subtract(b)
+        assert c == ResourceSet({"CPU": 2})
+
+    def test_node_allocate_release(self):
+        node = NodeResources(ResourceSet({"CPU": 4}))
+        assert node.try_allocate(ResourceSet({"CPU": 3}))
+        assert not node.try_allocate(ResourceSet({"CPU": 2}))
+        node.release(ResourceSet({"CPU": 3}))
+        assert node.try_allocate(ResourceSet({"CPU": 4}))
+
+    def test_critical_utilization(self):
+        node = NodeResources(ResourceSet({"CPU": 4, "TPU": 4}))
+        node.try_allocate(ResourceSet({"TPU": 4}))
+        assert node.critical_utilization() == 1.0
+
+    def test_zero_dropped(self):
+        assert ResourceSet({"CPU": 0}).is_empty()
+
+
+class TestReferenceCounter:
+    def test_free_on_zero(self):
+        freed = []
+        rc = ReferenceCounter(on_free=lambda oid, locs: freed.append(oid))
+        rc.add_owned(b"x")
+        rc.add_local_ref(b"x")
+        rc.add_local_ref(b"x")
+        rc.remove_local_ref(b"x")
+        assert not freed
+        rc.remove_local_ref(b"x")
+        assert freed == [b"x"]
+
+    def test_task_dep_pins(self):
+        freed = []
+        rc = ReferenceCounter(on_free=lambda oid, locs: freed.append(oid))
+        rc.add_owned(b"x")
+        rc.add_local_ref(b"x")
+        rc.add_task_dependency(b"x")
+        rc.remove_local_ref(b"x")
+        assert not freed
+        rc.remove_task_dependency(b"x")
+        assert freed == [b"x"]
+
+    def test_shared_pins_forever(self):
+        freed = []
+        rc = ReferenceCounter(on_free=lambda oid, locs: freed.append(oid))
+        rc.add_owned(b"x")
+        rc.add_local_ref(b"x")
+        rc.mark_shared(b"x")
+        rc.remove_local_ref(b"x")
+        assert not freed
+
+    def test_locations_passed_to_free(self):
+        captured = {}
+        rc = ReferenceCounter(
+            on_free=lambda oid, locs: captured.setdefault(oid, locs))
+        rc.add_owned(b"x")
+        rc.add_local_ref(b"x")
+        rc.add_location(b"x", b"node1")
+        rc.add_location(b"x", b"node2")
+        rc.remove_local_ref(b"x")
+        assert captured[b"x"] == {b"node1", b"node2"}
+
+    def test_borrowed_never_freed_by_us(self):
+        freed = []
+        rc = ReferenceCounter(on_free=lambda oid, locs: freed.append(oid))
+        rc.add_borrowed(b"x")
+        rc.add_local_ref(b"x")
+        rc.remove_local_ref(b"x")
+        assert not freed
+
+    def test_double_free_is_noop(self):
+        freed = []
+        rc = ReferenceCounter(on_free=lambda oid, locs: freed.append(oid))
+        rc.add_owned(b"x")
+        rc.force_free(b"x")
+        rc.force_free(b"x")
+        assert freed == [b"x"]
+
+
+def _view(nodes):
+    view = ClusterView()
+    for node_id, total, used in nodes:
+        nr = NodeResources(ResourceSet(total))
+        nr.try_allocate(ResourceSet(used))
+        view.update_node(node_id, nr)
+    return view
+
+
+class TestSchedulingPolicy:
+    def test_hybrid_prefers_local_below_threshold(self):
+        view = _view([(b"a", {"CPU": 4}, {}), (b"b", {"CPU": 4}, {})])
+        got = pick_node(view, ResourceSet({"CPU": 1}),
+                        SchedulingStrategySpec(), b"b")
+        assert got == b"b"
+
+    def test_hybrid_spills_when_local_busy(self):
+        view = _view([(b"a", {"CPU": 4}, {}), (b"b", {"CPU": 4}, {"CPU": 4})])
+        got = pick_node(view, ResourceSet({"CPU": 1}),
+                        SchedulingStrategySpec(), b"b")
+        assert got == b"a"
+
+    def test_infeasible_returns_none(self):
+        view = _view([(b"a", {"CPU": 4}, {})])
+        got = pick_node(view, ResourceSet({"TPU": 4}),
+                        SchedulingStrategySpec(), b"a")
+        assert got is None
+
+    def test_spread_picks_least_utilized(self):
+        view = _view([(b"a", {"CPU": 4}, {"CPU": 2}),
+                      (b"b", {"CPU": 4}, {"CPU": 1})])
+        got = pick_node(view, ResourceSet({"CPU": 1}),
+                        SchedulingStrategySpec(kind="SPREAD"), b"a")
+        assert got == b"b"
+
+    def test_node_affinity_hard(self):
+        view = _view([(b"a", {"CPU": 4}, {}), (b"b", {"CPU": 4}, {})])
+        strat = SchedulingStrategySpec(kind="NODE_AFFINITY", node_id=b"a")
+        assert pick_node(view, ResourceSet({"CPU": 1}), strat, b"b") == b"a"
+
+    def test_node_label(self):
+        view = ClusterView()
+        nr = NodeResources(ResourceSet({"CPU": 4}), {"zone": "us-1"})
+        view.update_node(b"a", nr)
+        nr2 = NodeResources(ResourceSet({"CPU": 4}), {"zone": "eu-1"})
+        view.update_node(b"b", nr2)
+        strat = SchedulingStrategySpec(kind="NODE_LABEL",
+                                       hard_labels={"zone": ["eu-1"]})
+        assert pick_node(view, ResourceSet({"CPU": 1}), strat, None) == b"b"
+
+
+class TestSerialization:
+    def test_roundtrip_plain(self):
+        ctx = SerializationContext()
+        sobj = ctx.serialize({"a": [1, 2, 3], "b": "hi"})
+        assert ctx.deserialize(memoryview(sobj.to_bytes())) == {
+            "a": [1, 2, 3], "b": "hi"}
+
+    def test_numpy_out_of_band(self):
+        ctx = SerializationContext()
+        arr = np.arange(1000, dtype=np.float32)
+        sobj = ctx.serialize({"x": arr})
+        assert len(sobj.buffers) >= 1  # array went out-of-band
+        out = ctx.deserialize(memoryview(sobj.to_bytes()))
+        np.testing.assert_array_equal(out["x"], arr)
+
+    def test_large_array_size_accounting(self):
+        ctx = SerializationContext()
+        arr = np.zeros((1024, 1024), dtype=np.float32)
+        sobj = ctx.serialize(arr)
+        assert sobj.total_size >= arr.nbytes
+        assert sobj.total_size < arr.nbytes + 64 * 1024
