@@ -116,7 +116,8 @@ class GcsServer:
             "register_node", "heartbeat", "get_all_nodes", "drain_node",
             "kv_put", "kv_get", "kv_del", "kv_keys", "kv_exists",
             "register_actor", "get_actor_info", "get_named_actor",
-            "list_named_actors", "kill_actor", "report_actor_death",
+            "list_named_actors", "kill_actor", "gc_actor",
+            "report_actor_death",
             "wait_actor_ready", "list_actors",
             "create_placement_group", "remove_placement_group",
             "get_placement_group", "wait_placement_group_ready",
@@ -402,10 +403,23 @@ class GcsServer:
         actor_id = self.named_actors.get((name, namespace))
         if actor_id is None:
             return None
+        # A name lookup hands a handle to a process the creator's local GC
+        # cannot see — pin against creator-side garbage collection.
+        self.actors[actor_id]["pinned_by_lookup"] = True
         info = await self._h_get_actor_info(actor_id)
         if info is not None:
             info["spec"] = self.actors[actor_id]["spec"]
         return info
+
+    async def _h_gc_actor(self, actor_id):
+        """Creator-side handle GC; unlike kill_actor this is advisory — a
+        lookup-pinned or detached actor survives it."""
+        a = self.actors.get(actor_id)
+        if a is None:
+            return False
+        if a.get("pinned_by_lookup") or a["spec"].is_detached:
+            return False
+        return await self._h_kill_actor(actor_id, no_restart=True)
 
     async def _h_list_named_actors(self, namespace=None):
         return [

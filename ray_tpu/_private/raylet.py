@@ -322,7 +322,10 @@ class Raylet:
         if strategy.kind == "NODE_AFFINITY" and not strategy.soft:
             node = self.view.get(strategy.node_id)
             if node is None:
-                return {"infeasible": True}
+                # Target node unknown here — may be dead or just not yet in
+                # this raylet's replicated view; let the owner retry until
+                # its lease deadline rather than failing eagerly.
+                return {"retry": True}
             if strategy.node_id != self.node_id:
                 return {"spillback_to": self._node_addrs.get(strategy.node_id),
                         "spillback_node": strategy.node_id}
@@ -350,7 +353,27 @@ class Raylet:
         return {"granted": True, "worker_addr": handle.addr,
                 "worker_id": handle.worker_id, "tpu_ids": tpu_ids}
 
+    @staticmethod
+    def _pg_tpu_demand(demand: ResourceSet):
+        """(quantity, pg_hex) for placement-group-formatted TPU names
+        (``TPU_group_{i}_{pg}`` / ``TPU_group_{pg}``), or (0, None)."""
+        for name in demand.names():
+            if name.startswith(f"{TPU}_group_"):
+                return demand.get(name), name.rsplit("_", 1)[-1]
+        return 0.0, None
+
     def _take_tpu_chips(self, demand: ResourceSet) -> List[int]:
+        pg_qty, pg_hex = self._pg_tpu_demand(demand)
+        if pg_hex is not None:
+            # Chips for PG-formatted leases come from the bundle's own
+            # reserved pool — indexed and wildcard names share one pool, so
+            # a bundle's chips can never be double-assigned, and the node's
+            # free list is untouched.
+            pool = self._bundle_tpu_pool(pg_hex)
+            n = max(1, int(pg_qty)) if pg_qty > 0 else 0
+            take, remainder = pool[:n], pool[n:]
+            self._set_bundle_tpu_pool(pg_hex, remainder)
+            return take
         qty = demand.get(TPU)
         n = int(qty)
         if n <= 0:
@@ -363,11 +386,22 @@ class Raylet:
                 self._frac_chip = self._free_tpu_chips.pop(0)
             self._frac_users += 1
             return [self._frac_chip]
+        if len(self._free_tpu_chips) < n:
+            # Logical accounting granted more chips than physically free —
+            # never hand out a short allocation silently.
+            raise RuntimeError(
+                f"TPU chip accounting out of sync: need {n}, free "
+                f"{self._free_tpu_chips}")
         take, self._free_tpu_chips = (self._free_tpu_chips[:n],
                                       self._free_tpu_chips[n:])
         return take
 
     def _release_tpu_chips(self, demand: ResourceSet, chips: List[int]) -> None:
+        pg_qty, pg_hex = self._pg_tpu_demand(demand)
+        if pg_hex is not None:
+            self._set_bundle_tpu_pool(
+                pg_hex, sorted(self._bundle_tpu_pool(pg_hex) + list(chips)))
+            return
         qty = demand.get(TPU)
         if 0 < qty < 1:
             self._frac_users -= 1
@@ -382,6 +416,28 @@ class Raylet:
                 self._free_tpu_chips.append(c)
         self._free_tpu_chips.sort()
 
+    def _bundle_tpu_pool(self, pg_hex: str) -> List[int]:
+        out = []
+        for (pg_id, _idx), bundle in self._bundles.items():
+            if pg_id.hex() == pg_hex:
+                out.extend(bundle.get("tpu_chips", []))
+        return sorted(out)
+
+    def _set_bundle_tpu_pool(self, pg_hex: str, chips: List[int]) -> None:
+        """Redistribute the pool across the pg's bundles (pool is logically
+        per-PG on this node; storage is per-bundle for return_bundle)."""
+        chips = list(chips)
+        entries = [(key, b) for key, b in self._bundles.items()
+                   if key[0].hex() == pg_hex]
+        for i, (key, bundle) in enumerate(entries):
+            if i == len(entries) - 1:
+                bundle["tpu_chips"] = chips
+                chips = []
+            else:
+                cap = int(bundle["resources"].get(TPU))
+                bundle["tpu_chips"] = chips[:cap]
+                chips = chips[cap:]
+
     def _release_lease(self, handle: _WorkerHandle):
         lease = handle.lease
         handle.lease = None
@@ -392,8 +448,18 @@ class Raylet:
         self._lease_queue_event.set()
 
     async def _lease_dispatch_loop(self):
+        """Re-schedule queued lease requests whenever resources free up or the
+        cluster view changes — including spilling a queued task to another
+        node that became (or became known to be) available, mirroring the
+        reference's ScheduleAndDispatchTasks re-runs."""
+        from ray_tpu._private.task_spec import SchedulingStrategySpec
+
+        default = SchedulingStrategySpec()
         while not self._dead:
-            await self._lease_queue_event.wait()
+            try:
+                await asyncio.wait_for(self._lease_queue_event.wait(), 0.1)
+            except asyncio.TimeoutError:
+                pass
             self._lease_queue_event.clear()
             pending = len(self._lease_queue)
             for _ in range(pending):
@@ -405,8 +471,17 @@ class Raylet:
                                                     strategy)
                     if not fut.done():
                         fut.set_result(reply)
-                else:
-                    self._lease_queue.append((demand, job_id, strategy, fut))
+                    continue
+                target = pick_node(self.view, demand, strategy or default,
+                                   self.node_id)
+                if (target is not None and target != self.node_id
+                        and target in self._node_addrs):
+                    if not fut.done():
+                        fut.set_result(
+                            {"spillback_to": self._node_addrs[target],
+                             "spillback_node": target})
+                    continue
+                self._lease_queue.append((demand, job_id, strategy, fut))
             await asyncio.sleep(0.005)
 
     async def _h_return_worker(self, worker_id, kill=False):
@@ -551,7 +626,11 @@ class Raylet:
         demand = ResourceSet(resources)
         if not self.local.try_allocate(demand):
             return False
-        self._bundles[key] = {"resources": demand, "committed": False}
+        # Reserve physical TPU chips for the bundle now; PG-formatted leases
+        # later draw from this pool instead of the node's free list.
+        tpu_chips = self._take_tpu_chips(demand)
+        self._bundles[key] = {"resources": demand, "committed": False,
+                              "tpu_chips": tpu_chips}
         return True
 
     async def _h_commit_bundle(self, pg_id, bundle_index):
@@ -576,6 +655,10 @@ class Raylet:
         bundle = self._bundles.pop(key, None)
         if bundle is None:
             return True
+        for c in bundle.get("tpu_chips", []):
+            if c not in self._free_tpu_chips:
+                self._free_tpu_chips.append(c)
+        self._free_tpu_chips.sort()
         if bundle["committed"]:
             add = bundle["formatted"]
             self.local.total = self.local.total.subtract(add)

@@ -102,6 +102,76 @@ class _ActorState:
         return self.executors[group]
 
 
+class ActorHandleTracker:
+    """Owner-side actor handle GC (reference: actors die when all handles go
+    out of scope, AFTER their outstanding tasks drain). Serialized handles
+    conservatively pin the actor.
+
+    All state mutation runs on the io event loop — finalizers (`__del__`)
+    must not take locks, since cyclic GC can fire them on a thread already
+    inside this tracker.
+    """
+
+    def __init__(self, worker: "Worker"):
+        self._worker = worker
+        self._counts: Dict[bytes, int] = defaultdict(int)
+        self._inflight: Dict[bytes, int] = defaultdict(int)
+        self._shared: set = set()
+        self._created_by_us: set = set()
+        self._kill_when_drained: set = set()
+
+    def _post(self, fn) -> None:
+        if not self._worker._dead:
+            try:
+                self._worker.io.loop.call_soon_threadsafe(fn)
+            except Exception:
+                pass
+
+    def mark_created(self, actor_id: bytes) -> None:
+        self._post(lambda: self._created_by_us.add(actor_id))
+
+    def mark_shared(self, actor_id: bytes) -> None:
+        self._post(lambda: self._shared.add(actor_id))
+
+    def add_ref(self, actor_id: bytes) -> None:
+        self._post(lambda: self._counts.__setitem__(
+            actor_id, self._counts[actor_id] + 1))
+
+    def remove_ref(self, actor_id: bytes) -> None:
+        def _dec():
+            self._counts[actor_id] -= 1
+            self._maybe_gc(actor_id)
+
+        self._post(_dec)
+
+    # Called from the io loop only (submit/complete paths).
+    def task_submitted(self, actor_id: bytes) -> None:
+        self._inflight[actor_id] += 1
+
+    def task_completed(self, actor_id: bytes) -> None:
+        self._inflight[actor_id] -= 1
+        if actor_id in self._kill_when_drained:
+            self._maybe_gc(actor_id)
+
+    def _maybe_gc(self, actor_id: bytes) -> None:
+        if (self._counts[actor_id] > 0
+                or actor_id not in self._created_by_us
+                or actor_id in self._shared):
+            return
+        if self._inflight[actor_id] > 0:
+            # Reference semantics: let submitted work finish first.
+            self._kill_when_drained.add(actor_id)
+            return
+        self._created_by_us.discard(actor_id)
+        self._kill_when_drained.discard(actor_id)
+        if not self._worker._dead:
+            try:
+                self._worker.io.submit(self._worker.gcs.acall(
+                    "gc_actor", actor_id=actor_id, timeout=10))
+            except Exception:
+                pass
+
+
 class _TaskContext(threading.local):
     def __init__(self):
         self.task_id: Optional[TaskID] = None
@@ -144,6 +214,7 @@ class Worker:
 
         # object state
         self.reference_counter = ReferenceCounter(on_free=self._free_object)
+        self.actor_handles = ActorHandleTracker(self)
         self._objects: Dict[bytes, _PendingObject] = {}
         self._objects_lock = threading.Lock()
         self._mapped: Dict[bytes, MappedObject] = {}
@@ -619,7 +690,11 @@ class Worker:
                 client = self._raylet_client(tuple(reply["spillback_to"]))
                 continue
             if reply.get("infeasible"):
-                return None, None
+                # Infeasible *now* may become feasible (node still joining,
+                # PG bundle resources propagating); back off and retry until
+                # the lease deadline, as the reference's infeasible queue does.
+                await asyncio.sleep(0.2)
+                continue
             await asyncio.sleep(0.05)
         return None, None
 
@@ -692,6 +767,9 @@ class Worker:
                 return self.get_actor(options["name"],
                                       options.get("namespace") or "default")
             raise ValueError(reply["error"])
+        if not spec.is_detached:
+            # Non-detached actors die when all local handles go out of scope.
+            self.actor_handles.mark_created(actor_id.binary())
         return ActorHandle(actor_id.binary(), cls_name,
                            options.get("max_task_retries", 0))
 
@@ -737,11 +815,14 @@ class Worker:
         return lock
 
     async def _run_actor_task(self, spec: TaskSpec) -> None:
+        self.actor_handles.task_submitted(spec.actor_id.binary())
         try:
             await self._run_actor_task_inner(spec)
         except Exception as e:  # noqa: BLE001
             self._fail_task(spec, serialize_error(e))
             self._release_deps(spec)
+        finally:
+            self.actor_handles.task_completed(spec.actor_id.binary())
 
     async def _run_actor_task_inner(self, spec: TaskSpec) -> None:
         actor_id = spec.actor_id.binary()

@@ -66,6 +66,25 @@ class ActorHandle:
         self._class_name = class_name
         self._max_task_retries = max_task_retries
         self._method_options = method_options or {}
+        self._gc_registered = False
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker_or_none()
+        if w is not None:
+            w.actor_handles.add_ref(actor_id)
+            self._gc_registered = True
+
+    def __del__(self):
+        if not self._gc_registered:
+            return
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker_or_none()
+            if w is not None:
+                w.actor_handles.remove_ref(self._actor_id)
+        except BaseException:
+            pass
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -90,6 +109,13 @@ class ActorHandle:
 
 
 def reduce_actor_handle(handle: ActorHandle):
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker_or_none()
+    if w is not None:
+        # Handle escapes this process: pin the actor (conservative stand-in
+        # for the reference's distributed handle counting).
+        w.actor_handles.mark_shared(handle._actor_id)
     return (_rehydrate_handle, (handle._actor_id, handle._class_name,
                                 handle._max_task_retries,
                                 handle._method_options))

@@ -1,0 +1,218 @@
+"""Actor semantics: creation, ordering, concurrency, naming, restarts, kill.
+(Reference model: `python/ray/tests/test_actor.py` + `test_actor_failures.py`.)"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+    def fail(self):
+        raise RuntimeError("actor method failed")
+
+    def get_pid(self):
+        import os
+
+        return os.getpid()
+
+    def crash(self):
+        import os
+
+        os._exit(1)
+
+
+class TestActorBasics:
+    def test_create_and_call(self, ray_start_regular):
+        c = Counter.remote()
+        assert ray_tpu.get(c.increment.remote(), timeout=60) == 1
+        assert ray_tpu.get(c.increment.remote(5), timeout=30) == 6
+
+    def test_init_args(self, ray_start_regular):
+        c = Counter.remote(start=100)
+        assert ray_tpu.get(c.get.remote(), timeout=60) == 100
+
+    def test_ordering(self, ray_start_regular):
+        c = Counter.remote()
+        refs = [c.increment.remote() for _ in range(50)]
+        assert ray_tpu.get(refs, timeout=60) == list(range(1, 51))
+
+    def test_method_error(self, ray_start_regular):
+        c = Counter.remote()
+        with pytest.raises(RuntimeError, match="actor method failed"):
+            ray_tpu.get(c.fail.remote(), timeout=60)
+        # Actor stays alive after an app-level method error.
+        assert ray_tpu.get(c.increment.remote(), timeout=30) == 1
+
+    def test_init_error_marks_dead(self, ray_start_regular):
+        @ray_tpu.remote
+        class Broken:
+            def __init__(self):
+                raise ValueError("bad init")
+
+            def f(self):
+                return 1
+
+        b = Broken.remote()
+        with pytest.raises((exc.ActorDiedError, exc.RayTpuError)):
+            ray_tpu.get(b.f.remote(), timeout=60)
+
+    def test_handle_passing(self, ray_start_regular):
+        c = Counter.remote()
+        ray_tpu.get(c.increment.remote(), timeout=60)
+
+        @ray_tpu.remote
+        def bump(counter):
+            return ray_tpu.get(counter.increment.remote())
+
+        assert ray_tpu.get(bump.remote(c), timeout=60) == 2
+
+    def test_two_actors_isolated(self, ray_start_regular):
+        a, b = Counter.remote(), Counter.remote()
+        ray_tpu.get(a.increment.remote(), timeout=60)
+        assert ray_tpu.get(b.get.remote(), timeout=60) == 0
+
+
+class TestNamedActors:
+    def test_named_get(self, ray_start_regular):
+        original = Counter.options(name="shared-counter").remote()
+        handle = ray_tpu.get_actor("shared-counter")
+        assert ray_tpu.get(handle.increment.remote(), timeout=60) == 1
+        del original
+
+    def test_dropping_all_handles_kills_actor(self, ray_start_regular):
+        """Non-detached actors are GC'd when the last handle goes away
+        (reference semantics), releasing their worker + resources."""
+        import gc
+
+        a = Counter.remote()
+        ray_tpu.get(a.get.remote(), timeout=60)
+        actor_id = a._actor_id
+        del a
+        gc.collect()
+        from ray_tpu._private.worker import global_worker
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            info = global_worker().gcs.call("get_actor_info",
+                                            actor_id=actor_id)
+            if info["state"] == "DEAD":
+                return
+            time.sleep(0.1)
+        raise AssertionError("actor was not GC'd after handle drop")
+
+    def test_name_collision_rejected(self, ray_start_regular):
+        keep = Counter.options(name="dup").remote()
+        time.sleep(0.2)
+        with pytest.raises(ValueError):
+            Counter.options(name="dup").remote()
+        del keep
+
+    def test_get_if_exists(self, ray_start_regular):
+        a = Counter.options(name="gie").remote()
+        ray_tpu.get(a.increment.remote(), timeout=60)
+        b = Counter.options(name="gie", get_if_exists=True).remote()
+        assert ray_tpu.get(b.get.remote(), timeout=30) == 1
+
+    def test_unknown_name(self, ray_start_regular):
+        with pytest.raises(ValueError):
+            ray_tpu.get_actor("never-created")
+
+
+class TestAsyncActors:
+    def test_async_methods_overlap(self, ray_start_regular):
+        @ray_tpu.remote(max_concurrency=4)
+        class AsyncActor:
+            async def slow(self, t):
+                import asyncio
+
+                await asyncio.sleep(t)
+                return t
+
+        a = AsyncActor.remote()
+        # Warm up (actor creation).
+        ray_tpu.get(a.slow.remote(0.01), timeout=60)
+        start = time.monotonic()
+        out = ray_tpu.get([a.slow.remote(0.3) for _ in range(4)], timeout=30)
+        elapsed = time.monotonic() - start
+        assert out == [0.3] * 4
+        assert elapsed < 1.0  # 4 x 0.3s overlapped, not 1.2s serial
+
+    def test_signal_pattern(self, ray_start_regular):
+        """Wait + send on the same actor from one caller must not deadlock
+        (requires in-order start w/ concurrent execution)."""
+
+        @ray_tpu.remote(max_concurrency=2)
+        class SignalActor:
+            def __init__(self):
+                import asyncio
+
+                self.event = asyncio.Event()
+
+            async def wait(self):
+                await self.event.wait()
+                return "signalled"
+
+            async def send(self):
+                self.event.set()
+                return "sent"
+
+        s = SignalActor.remote()
+        waiter = s.wait.remote()
+        time.sleep(0.1)
+        sender = s.send.remote()
+        assert ray_tpu.get(waiter, timeout=60) == "signalled"
+        assert ray_tpu.get(sender, timeout=10) == "sent"
+
+
+class TestActorLifecycle:
+    def test_kill(self, ray_start_regular):
+        c = Counter.remote()
+        ray_tpu.get(c.get.remote(), timeout=60)
+        ray_tpu.kill(c)
+        with pytest.raises((exc.ActorDiedError, exc.ActorUnavailableError)):
+            ray_tpu.get(c.get.remote(), timeout=60)
+
+    def test_restart_on_crash(self, ray_start_regular):
+        # max_task_retries stays 0 so the crashing call itself is NOT retried
+        # (a retried crash would burn the restart budget every attempt).
+        c = Counter.options(max_restarts=1).remote()
+        pid1 = ray_tpu.get(c.get_pid.remote(), timeout=60)
+        try:
+            ray_tpu.get(c.crash.remote(), timeout=30)
+        except exc.RayTpuError:
+            pass
+        # Restarted actor serves calls from a fresh process/state.
+        deadline = time.monotonic() + 120
+        pid2 = None
+        while time.monotonic() < deadline:
+            try:
+                pid2 = ray_tpu.get(c.get_pid.remote(), timeout=30)
+                break
+            except exc.RayTpuError:
+                time.sleep(0.3)
+        assert pid2 is not None and pid2 != pid1
+        assert ray_tpu.get(c.get.remote(), timeout=30) == 0  # state reset
+
+    def test_no_restart_without_budget(self, ray_start_regular):
+        c = Counter.remote()  # max_restarts=0
+        ray_tpu.get(c.get.remote(), timeout=60)
+        try:
+            ray_tpu.get(c.crash.remote(), timeout=30)
+        except exc.RayTpuError:
+            pass
+        with pytest.raises((exc.ActorDiedError, exc.ActorUnavailableError)):
+            ray_tpu.get(c.get.remote(), timeout=60)

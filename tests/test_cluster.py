@@ -1,0 +1,216 @@
+"""Multi-node behavior via the in-process Cluster fixture: spillback,
+cross-node object transfer, node failure, placement groups.
+(Reference model: `python/ray/tests/test_multi_node.py`, `test_placement_group.py`.)"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.util.placement_group import (
+    placement_group, remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy,
+)
+
+
+@ray_tpu.remote
+def where_am_i():
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+class TestMultiNode:
+    def test_spillback_uses_both_nodes(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.head_node = __import__(
+            "ray_tpu._private.node", fromlist=["Node"]).Node(
+                head=True, num_cpus=2, num_tpus=0)
+        cluster.add_node(num_cpus=2, num_tpus=0)
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=2)
+        def hog():
+            time.sleep(1.0)
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        nodes = ray_tpu.get([hog.remote(), hog.remote()], timeout=120)
+        assert len(set(nodes)) == 2  # both 2-CPU tasks can't fit on one node
+
+    def test_node_affinity(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.head_node = __import__(
+            "ray_tpu._private.node", fromlist=["Node"]).Node(
+                head=True, num_cpus=2, num_tpus=0)
+        node2 = cluster.add_node(num_cpus=2, num_tpus=0)
+        ray_tpu.init(address=cluster.address)
+        target = node2.node_id.binary()
+        got = ray_tpu.get(
+            where_am_i.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=target)).remote(),
+            timeout=120)
+        assert got == target.hex()
+
+    def test_cross_node_object_transfer(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.head_node = __import__(
+            "ray_tpu._private.node", fromlist=["Node"]).Node(
+                head=True, num_cpus=2, num_tpus=0)
+        node2 = cluster.add_node(num_cpus=2, num_tpus=0)
+        ray_tpu.init(address=cluster.address)
+        target = node2.node_id.binary()
+
+        @ray_tpu.remote
+        def produce():
+            return np.full((512, 1024), 7.0)  # 4 MiB -> plasma
+
+        @ray_tpu.remote
+        def consume(arr):
+            return float(arr.sum()), ray_tpu.get_runtime_context().get_node_id()
+
+        ref = produce.remote()  # lands wherever
+        total, node = ray_tpu.get(
+            consume.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=target)).remote(ref),
+            timeout=120)
+        assert total == 7.0 * 512 * 1024
+        assert node == target.hex()
+
+    def test_node_death_detected(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.head_node = __import__(
+            "ray_tpu._private.node", fromlist=["Node"]).Node(
+                head=True, num_cpus=2, num_tpus=0)
+        node2 = cluster.add_node(num_cpus=2, num_tpus=0)
+        ray_tpu.init(address=cluster.address)
+        assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 2
+        cluster.remove_node(node2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+            if len(alive) == 1:
+                return
+            time.sleep(0.25)
+        raise AssertionError("dead node was not detected")
+
+    def test_actor_restarts_on_other_node_after_node_death(
+            self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.head_node = __import__(
+            "ray_tpu._private.node", fromlist=["Node"]).Node(
+                head=True, num_cpus=2, num_tpus=0)
+        node2 = cluster.add_node(num_cpus=2, num_tpus=0)
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(max_restarts=1)
+        class Pinned:
+            def node(self):
+                return ray_tpu.get_runtime_context().get_node_id()
+
+        a = Pinned.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node2.node_id.binary(), soft=True)).remote()
+        first = ray_tpu.get(a.node.remote(), timeout=120)
+        if first != node2.node_id.hex():
+            pytest.skip("actor landed on head; can't exercise node death")
+        cluster.remove_node(node2)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                second = ray_tpu.get(a.node.remote(), timeout=30)
+                assert second != first
+                return
+            except exc.RayTpuError:
+                time.sleep(0.5)
+        raise AssertionError("actor did not restart on surviving node")
+
+
+class TestPlacementGroups:
+    def test_strict_spread(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.head_node = __import__(
+            "ray_tpu._private.node", fromlist=["Node"]).Node(
+                head=True, num_cpus=2, num_tpus=0)
+        cluster.add_node(num_cpus=2, num_tpus=0)
+        ray_tpu.init(address=cluster.address)
+
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+        assert pg.wait(30)
+
+        nodes = ray_tpu.get([
+            where_am_i.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg,
+                    placement_group_bundle_index=i)).remote()
+            for i in range(2)
+        ], timeout=120)
+        assert len(set(nodes)) == 2
+        remove_placement_group(pg)
+
+    def test_strict_pack(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.head_node = __import__(
+            "ray_tpu._private.node", fromlist=["Node"]).Node(
+                head=True, num_cpus=4, num_tpus=0)
+        cluster.add_node(num_cpus=4, num_tpus=0)
+        ray_tpu.init(address=cluster.address)
+
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+        assert pg.wait(30)
+        nodes = ray_tpu.get([
+            where_am_i.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg,
+                    placement_group_bundle_index=i)).remote()
+            for i in range(2)
+        ], timeout=120)
+        assert len(set(nodes)) == 1
+        remove_placement_group(pg)
+
+    def test_infeasible_pg(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.head_node = __import__(
+            "ray_tpu._private.node", fromlist=["Node"]).Node(
+                head=True, num_cpus=2, num_tpus=0)
+        ray_tpu.init(address=cluster.address)
+        pg = placement_group([{"CPU": 64}], strategy="PACK")
+        assert not pg.wait(5)
+
+    def test_fake_tpu_gang(self, ray_start_cluster):
+        """Pod-slice gang: 2 fake TPU hosts x 4 chips, STRICT_SPREAD PG
+        claims the whole slice (the TPU-native multi-host pattern)."""
+        cluster = ray_start_cluster
+        cluster.head_node = __import__(
+            "ray_tpu._private.node", fromlist=["Node"]).Node(
+                head=True, num_cpus=2, num_tpus=4)
+        cluster.add_node(num_cpus=2, num_tpus=4)
+        ray_tpu.init(address=cluster.address)
+
+        assert ray_tpu.cluster_resources().get("TPU") == 8
+
+        pg = placement_group([{"TPU": 4}, {"TPU": 4}],
+                             strategy="STRICT_SPREAD")
+        assert pg.wait(30)
+
+        # num_cpus=0: the bundle reserves only TPU, so the task must not
+        # demand CPU (same idiom as GPU tasks in reference PGs).
+        @ray_tpu.remote(num_tpus=4, num_cpus=0)
+        def tpu_host(rank):
+            ctx = ray_tpu.get_runtime_context()
+            return rank, ctx.get_node_id(), ctx.get_tpu_ids()
+
+        out = ray_tpu.get([
+            tpu_host.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg,
+                    placement_group_bundle_index=i)).remote(i)
+            for i in range(2)
+        ], timeout=120)
+        nodes = {node for _, node, _ in out}
+        assert len(nodes) == 2
+        for _, _, tpu_ids in out:
+            assert sorted(tpu_ids) == [0, 1, 2, 3]
+        remove_placement_group(pg)
