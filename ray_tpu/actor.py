@@ -87,7 +87,9 @@ class ActorHandle:
             pass
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        # Real attributes resolve via __dict__ first; only dunders must not
+        # fall through to method synthesis (pickle/copy probe them).
+        if name.startswith("__"):
             raise AttributeError(name)
         return ActorMethod(self, name, self._method_options.get(name))
 

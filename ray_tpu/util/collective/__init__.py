@@ -1,0 +1,15 @@
+from ray_tpu.util.collective.collective import (
+    allgather, allreduce, barrier, broadcast, create_collective_group,
+    destroy_collective_group, get_collective_group_size, get_group_mesh,
+    get_rank, init_collective_group, is_group_initialized, recv, reduce,
+    reducescatter, send,
+)
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+__all__ = [
+    "init_collective_group", "create_collective_group",
+    "destroy_collective_group", "is_group_initialized", "get_rank",
+    "get_collective_group_size", "get_group_mesh", "allreduce", "barrier",
+    "reduce", "broadcast", "allgather", "reducescatter", "send", "recv",
+    "Backend", "ReduceOp",
+]

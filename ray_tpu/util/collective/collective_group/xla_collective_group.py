@@ -1,0 +1,170 @@
+"""XLA collective backend — the TPU-native tensor plane.
+
+This is the component the reference gains in the TPU build (SURVEY §2.3,
+§5): the equivalent of `nccl_collective_group.py` where
+
+- rendezvous = a named coordinator actor (exactly the `NCCLUniqueIDStore`
+  pattern at `nccl_collective_group.py:28`): rank 0 publishes the
+  `jax.distributed` coordinator address; every member calls
+  `jax.distributed.initialize(coordinator, world_size, rank)`;
+- the data plane = XLA collectives compiled over the global device mesh:
+  over ICI within a pod slice, DCN across slices — never gRPC/sockets.
+
+Two usage tiers:
+1. Host-level API parity (`allreduce(numpy_tensor)` etc.): implemented with
+   jitted psum/all_gather over the global 1-D process mesh. Convenient, pays
+   host<->device transfer per call.
+2. The REAL training path: get the group's `Mesh` via `get_mesh()` (or
+   `device_mesh(axes=...)`) and write pjit/shard_map programs whose
+   `jax.lax.psum/all_gather/ppermute` lower directly onto ICI. The Train
+   JaxBackend does exactly this.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.util.collective.collective_group.base_collective_group import (
+    BaseGroup,
+)
+from ray_tpu.util.collective.types import ReduceOp
+from ray_tpu.util.collective.util import get_or_create_coordinator
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class XLAGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str,
+                 platform: Optional[str] = None,
+                 local_device_count: Optional[int] = None):
+        super().__init__(world_size, rank, group_name)
+        self._hub = get_or_create_coordinator(group_name, world_size)
+        self._init_jax_distributed(platform, local_device_count)
+        import jax
+
+        self._jax = jax
+        self._mesh_cache: dict = {}
+
+    # ------------------------------------------------------------ rendezvous
+    def _init_jax_distributed(self, platform, local_device_count) -> None:
+        import jax
+
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        if local_device_count and platform == "cpu":
+            jax.config.update("jax_num_cpu_devices", local_device_count)
+
+        if self.world_size == 1:
+            return  # single-process: plain jax, no distributed runtime
+
+        key = "jax_coordinator"
+        if self.rank == 0:
+            coordinator = f"127.0.0.1:{_free_port()}"
+            host = os.environ.get("RAY_TPU_NODE_IP")
+            if host:
+                coordinator = f"{host}:{_free_port()}"
+            ray_tpu.get(self._hub.put.remote(key, coordinator), timeout=60)
+        else:
+            coordinator = ray_tpu.get(self._hub.get.remote(key, 120.0),
+                                      timeout=130)
+            if coordinator is None:
+                raise TimeoutError(
+                    "rank 0 never published the jax coordinator address")
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=self.world_size,
+            process_id=self.rank,
+        )
+
+    # ---------------------------------------------------------------- meshes
+    def get_mesh(self, axis_name: str = "x"):
+        """1-D mesh over every device in the group — the substrate for
+        in-jit collectives over ICI."""
+        return self.device_mesh((-1,), (axis_name,))
+
+    def device_mesh(self, shape: Sequence[int], axis_names: Sequence[str]):
+        """An N-D `jax.sharding.Mesh` over the group's global devices."""
+        key = (tuple(shape), tuple(axis_names))
+        if key not in self._mesh_cache:
+            jax = self._jax
+            devices = np.array(jax.devices())
+            self._mesh_cache[key] = jax.sharding.Mesh(
+                devices.reshape(shape), tuple(axis_names))
+        return self._mesh_cache[key]
+
+    # ---------------------------------------------------- host-level parity
+    def _process_allgather(self, tensor) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(np.asarray(tensor)))
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        gathered = self._gather_stack(tensor)
+        if op == ReduceOp.SUM:
+            return gathered.sum(axis=0)
+        if op == ReduceOp.PRODUCT:
+            return gathered.prod(axis=0)
+        if op == ReduceOp.MIN:
+            return gathered.min(axis=0)
+        if op == ReduceOp.MAX:
+            return gathered.max(axis=0)
+        if op == ReduceOp.AVERAGE:
+            return gathered.mean(axis=0)
+        raise ValueError(f"unsupported op {op}")
+
+    def _gather_stack(self, tensor) -> np.ndarray:
+        if self.world_size == 1:
+            return np.asarray(tensor)[None]
+        return self._process_allgather(tensor)
+
+    def barrier(self):
+        if self.world_size == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(
+            f"ray_tpu:{self.group_name}:barrier")
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        out = self.allreduce(tensor, op)
+        return out if self.rank == dst_rank else tensor
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        gathered = self._gather_stack(tensor)
+        return gathered[src_rank]
+
+    def allgather(self, tensor) -> List[Any]:
+        gathered = self._gather_stack(tensor)
+        return [gathered[r] for r in range(self.world_size)]
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        full = self.allreduce(tensor, op)
+        return np.array_split(full, self.world_size, axis=0)[self.rank]
+
+    def send(self, tensor, dst_rank: int):
+        # Point-to-point doesn't fit SPMD; route via the coordinator actor.
+        ray_tpu.get(self._hub.send.remote(
+            self.rank, dst_rank, "xla_p2p", np.asarray(tensor)), timeout=300)
+
+    def recv(self, src_rank: int):
+        return ray_tpu.get(self._hub.recv.remote(
+            src_rank, self.rank, "xla_p2p"), timeout=300)
+
+    def destroy(self):
+        if self.world_size > 1:
+            try:
+                self._jax.distributed.shutdown()
+            except Exception:
+                pass

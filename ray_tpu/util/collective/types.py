@@ -1,0 +1,30 @@
+"""Collective types (reference: `python/ray/util/collective/types.py`)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class Backend:
+    XLA = "xla"      # jax.distributed + XLA collectives over ICI/DCN (TPU path)
+    SHM = "shm"      # hub-actor CPU backend (gloo-equivalent for host tensors)
+    # Alias kept for API familiarity with the reference ("gloo" on CPU).
+    GLOO = "shm"
+
+    @staticmethod
+    def validate(name: str) -> str:
+        if name in (Backend.XLA,):
+            return Backend.XLA
+        if name in ("shm", "gloo", "cpu"):
+            return Backend.SHM
+        raise ValueError(
+            f"unknown collective backend {name!r}; ray_tpu supports 'xla' "
+            "(TPU/ICI via jax) and 'shm'/'gloo' (CPU host tensors)")
+
+
+class ReduceOp(enum.Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+    AVERAGE = 4
