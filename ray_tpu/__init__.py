@@ -93,9 +93,16 @@ def init(
                         job_id=job_id, session_dir=session_dir)
         worker.namespace = namespace or f"job-{job_id.hex()}"
         set_global_worker(worker)
+        import sys as _sys
+
         gcs.call("register_job", job_id=job_id.binary(),
                  driver_addr=worker.addr,
-                 metadata={"namespace": worker.namespace})
+                 metadata={"namespace": worker.namespace,
+                           # Workers mirror the driver's import environment
+                           # (same-filesystem equivalent of the reference's
+                           # working_dir runtime env).
+                           "sys_path": list(_sys.path),
+                           "cwd": os.getcwd()})
         gcs.close()
         return {"gcs_address": f"{gcs_addr[0]}:{gcs_addr[1]}",
                 "node_id": node_id.hex(), "job_id": job_id.hex(),

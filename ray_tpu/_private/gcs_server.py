@@ -123,6 +123,7 @@ class GcsServer:
             "get_placement_group", "wait_placement_group_ready",
             "list_placement_groups",
             "next_job_id", "register_job", "mark_job_finished", "list_jobs",
+            "get_job_info",
             "publish", "poll", "push_task_events", "get_task_events",
             "register_worker", "list_workers", "get_system_config",
             "cluster_resources", "available_resources", "internal_stats",
@@ -301,6 +302,7 @@ class GcsServer:
             wclient = RpcClient(*worker_addr)
             try:
                 result = await wclient.acall("create_actor", spec=spec,
+                                             tpu_ids=reply.get("tpu_ids", []),
                                              timeout=120)
             except Exception as exc:
                 wclient.close()
@@ -626,6 +628,9 @@ class GcsServer:
 
     async def _h_list_jobs(self):
         return list(self.jobs.values())
+
+    async def _h_get_job_info(self, job_id):
+        return self.jobs.get(job_id)
 
     # ------------------------------------------------------------------ pubsub
     async def _h_publish(self, channel, message):

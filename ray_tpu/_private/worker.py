@@ -1029,11 +1029,17 @@ class Worker:
         return out
 
     # ---- actor execution --------------------------------------------------
-    async def _h_create_actor(self, spec: TaskSpec):
+    async def _h_create_actor(self, spec: TaskSpec, tpu_ids=None):
         loop = asyncio.get_running_loop()
 
         def _construct():
             # Blocking work (KV fetch, arg gets, __init__) stays off the loop.
+            if tpu_ids:
+                from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+                TPUAcceleratorManager.set_current_process_visible_accelerator_ids(
+                    [str(i) for i in tpu_ids])
+            self._actor_tpu_ids = list(tpu_ids or [])
             cls = self._load_function(spec.function.function_hash)
             args, kwargs = self._resolve_args(spec)
             return cls(*args, **kwargs)
@@ -1111,6 +1117,8 @@ class Worker:
         return self._ctx.task_id
 
     def current_tpu_ids(self) -> List[int]:
+        if self._actor is not None:
+            return list(getattr(self, "_actor_tpu_ids", []))
         return list(self._ctx.tpu_ids)
 
     def current_actor_id(self) -> Optional[bytes]:

@@ -50,6 +50,22 @@ def main():
         sys.exit(1)
     GlobalConfig.load_system_config(reply.get("system_config", "{}"))
 
+    # Mirror the driver's import environment so by-reference pickled
+    # functions (module-level in driver-local files) resolve here.
+    try:
+        job_info = worker.gcs.call("get_job_info",
+                                   job_id=worker.job_id.binary(), timeout=10)
+        if job_info:
+            meta = job_info.get("metadata", {})
+            for p in meta.get("sys_path", []):
+                if p and p not in sys.path:
+                    sys.path.append(p)
+            cwd = meta.get("cwd")
+            if cwd and os.path.isdir(cwd):
+                os.chdir(cwd)
+    except Exception:
+        pass
+
     # Fate-share with the raylet: if pings start failing, exit.
     while True:
         time.sleep(2.0)

@@ -1,0 +1,19 @@
+"""ray_tpu.train — distributed training orchestration (Ray Train parity,
+TPU-native: JaxTrainer/JaxBackend instead of Torch/DDP)."""
+
+from ray_tpu.train._internal.session import get_context, report
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig, FailureConfig, Result, RunConfig, ScalingConfig,
+)
+from ray_tpu.train.jax_backend import JaxBackend, JaxConfig
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+from ray_tpu.train._internal.backend_executor import TrainingFailedError
+
+__all__ = [
+    "JaxTrainer", "DataParallelTrainer", "JaxBackend", "JaxConfig",
+    "Backend", "BackendConfig", "ScalingConfig", "RunConfig",
+    "FailureConfig", "CheckpointConfig", "Checkpoint", "Result",
+    "report", "get_context", "TrainingFailedError",
+]

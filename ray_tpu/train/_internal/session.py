@@ -1,0 +1,143 @@
+"""Worker-side training session.
+
+Reference: `train/_internal/session.py:109` (`_TrainSession`) — the user's
+``train_loop_per_worker`` runs in a dedicated thread; ``report(metrics,
+checkpoint)`` passes results through a bounded queue (`session.py:202`) back
+to the driver poll loop; checkpoints persist to experiment storage before the
+metrics that reference them are released.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session: Optional["_TrainSession"] = None
+
+
+FINISHED = "__finished__"
+ERRORED = "__errored__"
+REPORT = "__report__"
+
+
+@dataclass
+class TrainContext:
+    world_rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    experiment_name: str
+    storage_dir: str
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        s = get_session()
+        return s.latest_checkpoint if s else None
+
+    def get_trial_dir(self) -> str:
+        return self.storage_dir
+
+
+class _TrainSession:
+    def __init__(self, train_fn: Callable, config: Dict[str, Any],
+                 context: TrainContext,
+                 latest_checkpoint: Optional[Checkpoint]):
+        self.context = context
+        self.latest_checkpoint = latest_checkpoint
+        self._result_queue: "queue.Queue" = queue.Queue(maxsize=8)
+        self._train_fn = train_fn
+        self._config = config
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        def _run():
+            global _session
+            _session = self
+            try:
+                if self._takes_config():
+                    self._train_fn(self._config)
+                else:
+                    self._train_fn()
+                self._result_queue.put((FINISHED, None, None))
+            except BaseException as e:  # noqa: BLE001
+                self._result_queue.put(
+                    (ERRORED, f"{type(e).__name__}: {e}\n"
+                     f"{traceback.format_exc()}", None))
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="train-loop")
+        self._thread.start()
+
+    def _takes_config(self) -> bool:
+        import inspect
+
+        try:
+            sig = inspect.signature(self._train_fn)
+            return len(sig.parameters) >= 1
+        except (TypeError, ValueError):
+            return False
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        ckpt_path = None
+        if checkpoint is not None:
+            persisted = checkpoint.persist(
+                self.context.storage_dir,
+                name=f"checkpoint_{metrics.get('training_iteration', 'x')}"
+                     f"_rank{self.context.world_rank}")
+            self.latest_checkpoint = persisted
+            ckpt_path = persisted.path
+        # Blocks when the driver falls behind (backpressure, reference
+        # bounded-queue behavior).
+        self._result_queue.put((REPORT, metrics, ckpt_path))
+
+    def next_result(self, timeout: Optional[float] = None):
+        try:
+            return self._result_queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+def get_session() -> Optional[_TrainSession]:
+    return _session
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """`ray_tpu.train.report` — from inside train_loop_per_worker."""
+    s = get_session()
+    if s is None:
+        raise RuntimeError(
+            "train.report() called outside a training session")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = get_session()
+    if s is None:
+        raise RuntimeError("no active training session")
+    return s.context

@@ -1,0 +1,90 @@
+"""Checkpoint — a directory of files, with jax-pytree conveniences.
+
+Reference: `train/_checkpoint.py:56` (a directory on a pyarrow filesystem).
+Here: a local/NFS/gcsfuse directory path. Pytree save/restore uses
+orbax-style flat numpy ``.npz`` plus pickled structure — simple, portable,
+and jax-native (no torch state_dicts).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        with open(os.path.join(d, "data.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    @classmethod
+    def from_pytree(cls, tree: Any) -> "Checkpoint":
+        """Save a jax pytree (params/opt state) as npz + structure."""
+        import jax
+        import numpy as np
+
+        d = tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        leaves, treedef = jax.tree.flatten(tree)
+        np.savez(os.path.join(d, "arrays.npz"),
+                 **{str(i): np.asarray(leaf) for i, leaf in enumerate(leaves)})
+        with open(os.path.join(d, "treedef.pkl"), "wb") as f:
+            pickle.dump(treedef, f)
+        return cls(d)
+
+    # -- reading ------------------------------------------------------------
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            return self.path
+        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    def as_directory(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            yield self.path
+
+        return _ctx()
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_pytree(self) -> Any:
+        import jax
+        import numpy as np
+
+        data = np.load(os.path.join(self.path, "arrays.npz"))
+        leaves = [data[str(i)] for i in range(len(data.files))]
+        with open(os.path.join(self.path, "treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        return jax.tree.unflatten(treedef, leaves)
+
+    # -- persistence --------------------------------------------------------
+    def persist(self, storage_dir: str, name: Optional[str] = None) -> "Checkpoint":
+        """Copy into experiment storage; returns the persisted checkpoint."""
+        os.makedirs(storage_dir, exist_ok=True)
+        dest = os.path.join(storage_dir,
+                            name or f"checkpoint_{uuid.uuid4().hex[:8]}")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return Checkpoint(dest)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"

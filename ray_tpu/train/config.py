@@ -1,0 +1,66 @@
+"""Train/AIR configuration dataclasses.
+
+Reference: `python/ray/air/config.py` — ScalingConfig (`:101`),
+FailureConfig (`:375`), CheckpointConfig (`:425`), RunConfig.
+TPU-first deltas: `use_tpu`/`chips_per_worker` replace `use_gpu`, and
+`topology` lets a trainer claim a whole pod slice via gang resources.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 4
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None  # e.g. "v5e-16": claim a whole pod slice
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        if self.use_tpu:
+            return {"CPU": 1, "TPU": self.chips_per_worker}
+        return {"CPU": 1}
+
+    def bundle(self) -> Dict[str, float]:
+        return self.worker_resources()
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.expanduser("~/ray_tpu_results")
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional["Any"]  # ray_tpu.train.Checkpoint
+    path: str
+    metrics_dataframe: Optional[List[Dict[str, Any]]] = None
+    error: Optional[BaseException] = None

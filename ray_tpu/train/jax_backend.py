@@ -1,0 +1,104 @@
+"""JaxBackend — the TPU-native Train backend.
+
+This is the component BASELINE.json's north star names: the analogue of the
+reference's TorchBackend/TorchConfig (`train/torch/config.py:146` — pick
+process-group backend, broadcast rank-0 address, `dist.init_process_group`
+at `:108`), re-designed for jax:
+
+- on_start: rank 0 picks a coordinator port; every worker calls
+  `jax.distributed.initialize(coordinator, num_processes, process_id)`.
+  After that, `jax.devices()` on any worker sees the GLOBAL device set —
+  on a TPU pod slice, collectives between them ride ICI, and the SPMD
+  mesh spans the slice.
+- Workers then build meshes via `ray_tpu.train.jax_utils` / collective
+  `get_group_mesh` and run pjit'd steps; there is no DDP wrapper — data/
+  model parallelism are sharding annotations, not engines.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    # "tpu" on real hardware; "cpu" for the fake-mesh test tier
+    # (the moral equivalent of the reference's _fake_gpus/gloo tiers).
+    platform: Optional[str] = None
+    # CPU tier only: per-process virtual device count
+    # (jax.config jax_num_cpu_devices).
+    num_cpu_devices: Optional[int] = None
+
+    @property
+    def backend_cls(self):
+        return JaxBackend
+
+
+def _setup_jax_distributed(coordinator: Optional[str], world_size: int,
+                           rank: int, platform: Optional[str],
+                           num_cpu_devices: Optional[int]) -> int:
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if num_cpu_devices and (platform == "cpu"):
+        jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+    if world_size > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world_size,
+            process_id=rank,
+        )
+    return jax.device_count()
+
+
+def _shutdown_jax_distributed() -> None:
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig) -> None:
+        import ray_tpu
+
+        world_size = worker_group.num_workers
+        coordinator = None
+        if world_size > 1:
+            meta0 = worker_group.metadata()[0]
+            port = worker_group.execute_single(0, _free_port_on_worker)
+            coordinator = f"{meta0['ip']}:{port}"
+        device_counts = ray_tpu.get([
+            w.execute.remote(_setup_jax_distributed, coordinator, world_size,
+                             rank, backend_config.platform,
+                             backend_config.num_cpu_devices)
+            for rank, w in enumerate(worker_group.workers)
+        ], timeout=600)
+        # All workers must agree on the global device count — a mismatch
+        # means a partial gang (some host failed to join its slice).
+        if len(set(device_counts)) != 1:
+            raise RuntimeError(
+                f"inconsistent global device count across workers: "
+                f"{device_counts}")
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig) -> None:
+        try:
+            worker_group.execute(_shutdown_jax_distributed)
+        except Exception:
+            pass
+
+
+def _free_port_on_worker() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

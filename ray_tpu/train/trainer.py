@@ -1,0 +1,212 @@
+"""Trainers.
+
+Reference: `train/base_trainer.py:567` (`BaseTrainer.fit`),
+`train/data_parallel_trainer.py` (`DataParallelTrainer`). The TPU-native
+`JaxTrainer` = DataParallelTrainer + JaxConfig: N worker processes, one per
+TPU host, forming a single jax.distributed gang; the training loop runs
+pjit'd SPMD steps over the pod's global mesh.
+
+`fit()` runs the trial inline (the Tune-equivalent's Tuner can also wrap any
+trainer via `as_trainable()` — see ray_tpu.tune).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train._internal.backend_executor import (
+    BackendExecutor, TrainingFailedError,
+)
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig, FailureConfig, Result, RunConfig, ScalingConfig,
+)
+
+
+class DataParallelTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._train_fn = train_loop_per_worker
+        self._config = train_loop_config or {}
+        self._backend_config = backend_config or BackendConfig()
+        self._scaling = scaling_config or ScalingConfig()
+        self._run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+        self._resume_checkpoint = resume_from_checkpoint
+
+    # ------------------------------------------------------------------- fit
+    def fit(self) -> Result:
+        name = self._run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+        experiment_dir = os.path.join(
+            self._run_config.resolved_storage_path(), name)
+        os.makedirs(experiment_dir, exist_ok=True)
+
+        executor = BackendExecutor(self._backend_config, self._scaling,
+                                   self._run_config, experiment_dir)
+        failures = 0
+        max_failures = self._run_config.failure_config.max_failures
+        latest_ckpt_path = (self._resume_checkpoint.path
+                            if self._resume_checkpoint else None)
+        history: list = []
+        checkpoints: list = []  # (score, path) for top-k retention
+        last_metrics: Dict[str, Any] = {}
+        error: Optional[BaseException] = None
+
+        executor.start()
+        try:
+            while True:
+                try:
+                    self._start_and_poll(executor, latest_ckpt_path, history,
+                                         checkpoints)
+                    break  # finished cleanly
+                except (TrainingFailedError, Exception) as e:  # noqa: BLE001
+                    if history:
+                        last_metrics = history[-1]
+                    if checkpoints:
+                        latest_ckpt_path = checkpoints[-1][1]
+                    failures += 1
+                    if max_failures >= 0 and failures > max_failures:
+                        error = e
+                        break
+                    executor.restart()
+        finally:
+            executor.shutdown()
+
+        if history:
+            last_metrics = history[-1]
+        latest = Checkpoint(checkpoints[-1][1]) if checkpoints else (
+            Checkpoint(latest_ckpt_path) if latest_ckpt_path else None)
+        if error is not None:
+            raise TrainingFailedError(
+                f"training failed after {failures} failure(s); "
+                f"last metrics {last_metrics}") from error
+        return Result(metrics=last_metrics, checkpoint=latest,
+                      path=experiment_dir, metrics_dataframe=history)
+
+    def _start_and_poll(self, executor: BackendExecutor,
+                        latest_ckpt_path: Optional[str], history: list,
+                        checkpoints: list) -> None:
+        config = dict(self._config)
+        if self._datasets:
+            config["__datasets__"] = self._shard_datasets(executor)
+        executor.start_training(self._train_fn, config, latest_ckpt_path)
+        ckpt_cfg = self._run_config.checkpoint_config
+        while True:
+            results = executor.get_next_results()
+            if results is None:
+                return
+            reports = {rank: (metrics, ckpt)
+                       for rank, metrics, ckpt in results}
+            if not reports:
+                continue
+            # Rank 0's metrics are authoritative (reference semantics).
+            rank0 = min(reports)
+            metrics, _ = reports[rank0]
+            if metrics is not None:
+                metrics = dict(metrics)
+                metrics.setdefault("training_iteration", len(history) + 1)
+                metrics["timestamp"] = time.time()
+                history.append(metrics)
+            for rank, (_, ckpt_path) in sorted(reports.items()):
+                if ckpt_path is not None:
+                    score = None
+                    if ckpt_cfg.checkpoint_score_attribute and metrics:
+                        score = metrics.get(
+                            ckpt_cfg.checkpoint_score_attribute)
+                    checkpoints.append((score, ckpt_path))
+            self._enforce_keep_k(checkpoints)
+
+    def _enforce_keep_k(self, checkpoints: list) -> None:
+        keep = self._run_config.checkpoint_config.num_to_keep
+        if keep is None or len(checkpoints) <= keep:
+            return
+        attr = self._run_config.checkpoint_config.checkpoint_score_attribute
+        if attr:
+            order = self._run_config.checkpoint_config.checkpoint_score_order
+            ranked = sorted(
+                checkpoints,
+                key=lambda sc: (sc[0] is None,
+                                -sc[0] if order == "max" and sc[0] is not None
+                                else sc[0] if sc[0] is not None else 0))
+            doomed = ranked[keep:]
+        else:
+            doomed = checkpoints[:-keep]
+        for item in doomed:
+            if item in checkpoints and len(checkpoints) > keep:
+                checkpoints.remove(item)
+                shutil.rmtree(item[1], ignore_errors=True)
+
+    def _shard_datasets(self, executor: BackendExecutor) -> Dict[str, Any]:
+        """Split datasets across workers via streaming_split (Train<->Data
+        ingestion, reference `train/_internal/data_config.py:61`)."""
+        out = {}
+        n = self._scaling.num_workers
+        for key, ds in self._datasets.items():
+            if hasattr(ds, "streaming_split"):
+                out[key] = ds.streaming_split(n)
+            else:
+                out[key] = [ds] * n
+        return out
+
+    def as_trainable(self):
+        """Wrap into a Tune-compatible trainable (reference
+        base_trainer.py:724)."""
+        trainer = self
+
+        def _trainable(config: Dict[str, Any]):
+            import copy
+
+            t = copy.copy(trainer)
+            merged = dict(trainer._config)
+            merged.update(config.get("train_loop_config", config))
+            t._config = merged
+            result = t.fit()
+            from ray_tpu import tune
+
+            tune.report(result.metrics,
+                        checkpoint=result.checkpoint)
+
+        _trainable.__name__ = f"{type(self).__name__}_trainable"
+        return _trainable
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship TPU trainer (north star: `JaxTrainer`/`JaxBackend`).
+
+    Usage::
+
+        def train_loop(config):
+            import jax
+            mesh = jax.make_mesh((jax.device_count(),), ("data",))
+            ...pjit'd SPMD training; ray_tpu.train.report(...) per epoch...
+
+        trainer = JaxTrainer(
+            train_loop,
+            scaling_config=ScalingConfig(num_workers=4, use_tpu=True,
+                                         chips_per_worker=4),
+            jax_config=JaxConfig(),  # platform autodetected
+        )
+        result = trainer.fit()
+    """
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 jax_config: Optional["Any"] = None, **kwargs):
+        from ray_tpu.train.jax_backend import JaxConfig
+
+        backend_config = jax_config or JaxConfig()
+        super().__init__(train_loop_per_worker,
+                         backend_config=backend_config, **kwargs)

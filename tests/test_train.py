@@ -1,0 +1,207 @@
+"""Ray-Train-equivalent e2e: JaxTrainer data-parallel training on a fake
+2-host x 4-device CPU mesh — THE e2e milestone from SURVEY §7 M5."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint, CheckpointConfig, FailureConfig, JaxConfig, JaxTrainer,
+    RunConfig, ScalingConfig, TrainingFailedError,
+)
+
+
+def mlp_train_loop(config):
+    """Data-parallel MLP regression with a pjit'd step over the global mesh.
+    Runs inside each train worker (2 processes x 4 virtual CPU devices)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    world = ctx.get_world_size()
+    rank = ctx.get_world_rank()
+
+    # Global mesh over ALL devices of the gang (both processes).
+    mesh = jax.make_mesh((jax.device_count(),), ("data",))
+    repl = NamedSharding(mesh, P())
+    data_sharded = NamedSharding(mesh, P("data"))
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(8, 1).astype(np.float32)
+
+    def init_params(key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "w1": jax.random.normal(k1, (8, 32)) * 0.1,
+            "b1": jnp.zeros(32),
+            "w2": jax.random.normal(k2, (32, 1)) * 0.1,
+            "b2": jnp.zeros(1),
+        }
+
+    start_epoch = 0
+    ckpt = ctx.get_checkpoint()
+    if ckpt is not None:
+        state = ckpt.to_pytree()
+        params = jax.device_put(state["params"], repl)
+        start_epoch = int(state["epoch"]) + 1
+    else:
+        params = jax.device_put(init_params(jax.random.key(0)), repl)
+
+    def loss_fn(p, x, y):
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        pred = h @ p["w2"] + p["b2"]
+        return jnp.mean((pred - y) ** 2)
+
+    @jax.jit
+    def step(p, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        new_p = jax.tree.map(lambda a, g: a - 0.1 * g, p, grads)
+        return new_p, loss
+
+    batch_global = 64
+    epochs = config.get("epochs", 4)
+    for epoch in range(start_epoch, epochs):
+        # Each process contributes its local shard of the global batch.
+        x_local = rng.randn(batch_global // world, 8).astype(np.float32)
+        y_local = x_local @ w_true
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.host_local_array_to_global_array(
+            x_local, mesh, P("data"))
+        y = multihost_utils.host_local_array_to_global_array(
+            y_local, mesh, P("data"))
+        params, loss = step(params, x, y)
+        loss_val = float(loss)
+
+        checkpoint = None
+        if rank == 0:
+            checkpoint = Checkpoint.from_pytree(
+                {"params": jax.device_get(params), "epoch": epoch})
+        train.report({"loss": loss_val, "epoch": epoch},
+                     checkpoint=checkpoint)
+
+
+@pytest.fixture(scope="module")
+def train_cluster():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=8, num_tpus=0,
+                        object_store_memory=256 * 1024 * 1024,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+class TestJaxTrainer:
+    def test_dp_training_2workers(self, train_cluster, tmp_path):
+        trainer = JaxTrainer(
+            mlp_train_loop,
+            train_loop_config={"epochs": 4},
+            scaling_config=ScalingConfig(num_workers=2),
+            jax_config=JaxConfig(platform="cpu", num_cpu_devices=4),
+            run_config=RunConfig(name="mlp-dp", storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        assert result.metrics["epoch"] == 3
+        assert len(result.metrics_dataframe) == 4
+        losses = [m["loss"] for m in result.metrics_dataframe]
+        assert losses[-1] < losses[0]  # actually learning
+        assert result.checkpoint is not None
+        state = result.checkpoint.to_pytree()
+        assert state["epoch"] == 3
+
+    def test_resume_from_checkpoint(self, train_cluster, tmp_path):
+        trainer = JaxTrainer(
+            mlp_train_loop,
+            train_loop_config={"epochs": 2},
+            scaling_config=ScalingConfig(num_workers=2),
+            jax_config=JaxConfig(platform="cpu", num_cpu_devices=4),
+            run_config=RunConfig(name="mlp-r1", storage_path=str(tmp_path)),
+        )
+        r1 = trainer.fit()
+        assert r1.metrics["epoch"] == 1
+
+        trainer2 = JaxTrainer(
+            mlp_train_loop,
+            train_loop_config={"epochs": 4},
+            scaling_config=ScalingConfig(num_workers=2),
+            jax_config=JaxConfig(platform="cpu", num_cpu_devices=4),
+            run_config=RunConfig(name="mlp-r2", storage_path=str(tmp_path)),
+            resume_from_checkpoint=r1.checkpoint,
+        )
+        r2 = trainer2.fit()
+        # Resumed at epoch 2, so only epochs 2..3 ran.
+        assert r2.metrics["epoch"] == 3
+        assert len(r2.metrics_dataframe) == 2
+
+    def test_single_worker(self, train_cluster, tmp_path):
+        trainer = JaxTrainer(
+            mlp_train_loop,
+            train_loop_config={"epochs": 2},
+            scaling_config=ScalingConfig(num_workers=1),
+            jax_config=JaxConfig(platform="cpu", num_cpu_devices=4),
+            run_config=RunConfig(name="mlp-1w", storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        assert result.metrics["epoch"] == 1
+
+    def test_failure_restart(self, train_cluster, tmp_path):
+        """Worker crash mid-training: gang restarts from latest checkpoint
+        (FailureConfig.max_failures, reference backend_executor._restart)."""
+
+        def crashing_loop(config):
+            import os
+
+            from ray_tpu import train
+            from ray_tpu.train import Checkpoint
+
+            ctx = train.get_context()
+            start = 0
+            ckpt = ctx.get_checkpoint()
+            if ckpt is not None:
+                start = ckpt.to_dict()["epoch"] + 1
+            marker = config["marker"]
+            for epoch in range(start, 4):
+                if epoch == 2 and ctx.get_world_rank() == 0 \
+                        and not os.path.exists(marker):
+                    open(marker, "w").close()
+                    os._exit(1)  # hard crash, like a dead TPU host
+                checkpoint = None
+                if ctx.get_world_rank() == 0:
+                    checkpoint = Checkpoint.from_dict({"epoch": epoch})
+                train.report({"epoch": epoch}, checkpoint=checkpoint)
+
+        marker = str(tmp_path / "crashed.marker")
+        trainer = JaxTrainer(
+            crashing_loop,
+            train_loop_config={"marker": marker},
+            scaling_config=ScalingConfig(num_workers=2),
+            jax_config=JaxConfig(platform="cpu", num_cpu_devices=2),
+            run_config=RunConfig(
+                name="mlp-ft", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1)),
+        )
+        result = trainer.fit()
+        assert os.path.exists(marker)  # the crash really happened
+        assert result.metrics["epoch"] == 3
+
+    def test_failure_budget_exhausted(self, train_cluster, tmp_path):
+        def always_fail(config):
+            raise RuntimeError("deliberate")
+
+        trainer = JaxTrainer(
+            always_fail,
+            scaling_config=ScalingConfig(num_workers=1),
+            jax_config=JaxConfig(platform="cpu", num_cpu_devices=1),
+            run_config=RunConfig(name="mlp-fail", storage_path=str(tmp_path)),
+        )
+        with pytest.raises(TrainingFailedError):
+            trainer.fit()
