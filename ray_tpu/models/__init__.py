@@ -1,0 +1,4 @@
+from ray_tpu.models import llama
+from ray_tpu.models.llama import LlamaConfig
+
+__all__ = ["llama", "LlamaConfig"]

@@ -1,0 +1,268 @@
+"""Llama-2-family decoder — the flagship model, TPU-first.
+
+Design (not a port — the reference has no in-repo model zoo; its Llama runs
+arrive via HF/DeepSpeed through the generic worker group, e.g.
+`train/examples/deepspeed/deepspeed_torch_trainer.py`):
+
+- Pure-functional: params are a pytree of arrays; no module framework in the
+  hot path, so pjit sharding rules are plain pytrees too (parallel/sharding.py).
+- Layers are STACKED along a leading [n_layers, ...] axis and iterated with
+  `lax.scan` — one compiled layer body instead of n_layers inlined copies:
+  small XLA programs, fast compiles, and the idiomatic substrate for
+  pipeline parallelism (a stage = a slice of the stacked tree).
+- bfloat16 activations/matmuls (MXU-native), fp32 params + softmax/norm
+  accumulators.
+- GQA (n_kv_heads <= n_heads), RoPE, RMSNorm, SwiGLU — Llama-2/3 shapes.
+- Attention is pluggable: "xla" einsum (fused by XLA), "flash"
+  (ray_tpu.ops pallas kernel on TPU), or "ring" (context parallel over a
+  mesh axis) — selected by config or overridden per call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    hidden_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16   # activation/matmul dtype
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "xla"      # "xla" | "flash" | "ring"
+    remat: bool = False          # jax.checkpoint each layer (HBM for FLOPs)
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama2_7b(**overrides) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=32, hidden_dim=11008, max_seq_len=4096), **overrides})
+
+    @staticmethod
+    def llama3_8b(**overrides) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, hidden_dim=14336, max_seq_len=8192,
+            rope_theta=500000.0), **overrides})
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """Test-size config: runs on CPU in milliseconds."""
+        return LlamaConfig(**{**dict(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            hidden_dim=128, max_seq_len=128), **overrides})
+
+    def num_params(self) -> int:
+        d, h, v = self.dim, self.hidden_dim, self.vocab_size
+        per_layer = (self.dim * self.head_dim * self.n_heads      # wq
+                     + 2 * self.dim * self.head_dim * self.n_kv_heads  # wk,wv
+                     + self.dim * self.dim                         # wo
+                     + 3 * d * h                                   # ffn
+                     + 2 * d)                                      # norms
+        out_head = 0 if self.tie_embeddings else d * v
+        return v * d + self.n_layers * per_layer + d + out_head
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Stacked-layer parameter pytree."""
+    c = config
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    initializer = jax.nn.initializers.normal(0.02)
+
+    def dense(key, shape):
+        return initializer(key, shape, c.param_dtype)
+
+    kd = c.head_dim
+    lk = jax.random.split(k_layers, 7)
+
+    def stacked(key, shape):
+        return dense(key, (c.n_layers, *shape))
+
+    params = {
+        "embed": dense(k_embed, (c.vocab_size, c.dim)),
+        "layers": {
+            "attn_norm": jnp.ones((c.n_layers, c.dim), c.param_dtype),
+            "wq": stacked(lk[0], (c.dim, c.n_heads * kd)),
+            "wk": stacked(lk[1], (c.dim, c.n_kv_heads * kd)),
+            "wv": stacked(lk[2], (c.dim, c.n_kv_heads * kd)),
+            "wo": stacked(lk[3], (c.n_heads * kd, c.dim)),
+            "ffn_norm": jnp.ones((c.n_layers, c.dim), c.param_dtype),
+            "w_gate": stacked(lk[4], (c.dim, c.hidden_dim)),
+            "w_up": stacked(lk[5], (c.dim, c.hidden_dim)),
+            "w_down": stacked(lk[6], (c.hidden_dim, c.dim)),
+        },
+        "norm_f": jnp.ones((c.dim,), c.param_dtype),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(k_out, (c.dim, c.vocab_size))
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    rrms = lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return ((x32 * rrms).astype(orig_dtype)
+            * weight.astype(orig_dtype))
+
+
+def rope_freqs(head_dim: int, max_len: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                       # [S, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, H, D]; cos/sin: [S, D/2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True,
+                  positions: Optional[jax.Array] = None) -> jax.Array:
+    """Reference attention, [B, S, H, D] layout; fp32 softmax accumulator.
+    XLA fuses this well on TPU for short/medium sequences; flash/ring
+    kernels take over for long context."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        if positions is None:
+            q_pos = jnp.arange(s_q)[:, None]
+        else:
+            q_pos = positions[:, None]
+        mask = q_pos >= jnp.arange(s_k)[None, :]
+        scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=2)
+
+
+def _get_attention_fn(impl: str):
+    if impl == "flash":
+        from ray_tpu.ops.attention import flash_attention
+
+        return flash_attention
+    if impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention
+    return xla_attention
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _layer(config: LlamaConfig, cos, sin, attn_fn, x, layer_params):
+    c = config
+    p = layer_params
+    B, S, _ = x.shape
+    kd = c.head_dim
+
+    h = rms_norm(x, p["attn_norm"], c.norm_eps)
+    q = (h @ p["wq"].astype(c.dtype)).reshape(B, S, c.n_heads, kd)
+    k = (h @ p["wk"].astype(c.dtype)).reshape(B, S, c.n_kv_heads, kd)
+    v = (h @ p["wv"].astype(c.dtype)).reshape(B, S, c.n_kv_heads, kd)
+    q = apply_rope(q, cos[:S], sin[:S])
+    k = apply_rope(k, cos[:S], sin[:S])
+    k = _repeat_kv(k, c.n_heads // c.n_kv_heads)
+    v = _repeat_kv(v, c.n_heads // c.n_kv_heads)
+    attn = attn_fn(q, k, v, causal=True)
+    x = x + attn.reshape(B, S, -1) @ p["wo"].astype(c.dtype)
+
+    h = rms_norm(x, p["ffn_norm"], c.norm_eps)
+    gate = jax.nn.silu(h @ p["w_gate"].astype(c.dtype))
+    up = h @ p["w_up"].astype(c.dtype)
+    x = x + (gate * up) @ p["w_down"].astype(c.dtype)
+    return x
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            config: LlamaConfig,
+            attn_impl: Optional[str] = None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V]."""
+    c = config
+    impl = attn_impl or c.attn_impl
+    attn_fn = _get_attention_fn(impl)
+    cos, sin = rope_freqs(c.head_dim, c.max_seq_len, c.rope_theta)
+
+    x = params["embed"].astype(c.dtype)[tokens]
+
+    layer_fn = partial(_layer, c, cos, sin, attn_fn)
+    if c.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(x, layer_params):
+        return layer_fn(x, layer_params), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["norm_f"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    # bf16 matmul on the MXU (fp32 here costs ~4x), fp32 accumulation for
+    # the softmax/loss that follows.
+    logits = jax.lax.dot_general(
+        x, head.astype(c.dtype), (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return logits
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            config: LlamaConfig,
+            attn_impl: Optional[str] = None) -> jax.Array:
+    """Next-token cross-entropy. batch: tokens [B, S] (+ optional mask)."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], config, attn_impl)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return nll.mean()
+
+
+def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    """Approximate training FLOPs/token (fwd+bwd ~ 6*N + attention)."""
+    n = config.num_params()
+    attn = 12 * config.n_layers * config.dim * seq_len  # score+value matmuls
+    return 6.0 * n + attn

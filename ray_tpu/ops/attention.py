@@ -1,0 +1,405 @@
+"""Flash attention — pallas TPU kernels, forward + backward.
+
+The hot op of the flagship model (net-new vs the reference, which has no
+in-repo kernels — SURVEY §5 long-context). FlashAttention-2 style:
+
+- forward: blockwise streaming attention with online softmax; per-row
+  logsumexp (LSE) is written out for the backward pass. The [S, S] score
+  matrix never exists in HBM.
+- backward: two pallas passes plus a cheap elementwise delta precompute:
+  (1) dk/dv: for each key block, stream query blocks, recomputing P from
+      Q,K and the saved LSE; (2) dq: for each query block, stream key
+      blocks. Peak memory stays O(S * D) — this is what lets batch and
+      sequence scale on a 16G v5e chip (the XLA fallback's O(S^2) f32
+      probabilities OOM first).
+
+Layout: [B, S, H, D] public API (matches models/llama.py); kernels run in
+[B, H, S, D]. Non-TPU platforms fall back to the XLA path end to end.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+_LANE = 128
+
+
+def _cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
+                acc_scratch, *, scale: float, causal: bool,
+                block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True if not causal else (k_start <= q_start + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_scratch[:, 0][:, None]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scratch[:, 0][:, None] + jnp.sum(
+            p, axis=1, keepdims=True)
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scratch[:] = acc_scratch[:] * alpha + pv
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        m = m_scratch[:, 0][:, None]
+        l = l_scratch[:, 0][:, None]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _flash_fwd_bhsd(q, k, v, causal, block_q, block_k, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    grid = (B, H, _cdiv(S, block_q), _cdiv(Sk, block_k))
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, _LANE), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANE),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse[:, :, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scratch, dv_scratch, *,
+                    scale: float, causal: bool, block_q: int, block_k: int):
+    """grid (B, H, nk, nq): one key block accumulates over query blocks."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True if not causal else (q_start + block_q - 1 >= k_start)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]                                  # [bq, D]
+        k = k_ref[0, 0]                                  # [bk, D]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)            # [bq, D]
+        lse = lse_ref[0, 0][:, 0][:, None]               # [bq, 1]
+        delta = delta_ref[0, 0][:, 0][:, None]           # [bq, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                             # [bq, bk] f32
+        # dv += P^T dO
+        dv_scratch[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dP = dO V^T ; dS = P * (dP - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta) * scale
+        # dk += dS^T q
+        dk_scratch[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scratch, *, scale: float, causal: bool,
+                   block_q: int, block_k: int):
+    """grid (B, H, nq, nk): one query block accumulates over key blocks."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = True if not causal else (k_start <= q_start + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0][:, None]
+        delta = delta_ref[0, 0][:, 0][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scratch[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _bhsd_bwd(q, k, v, do, o, lse, causal, block_q, block_k, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                              # [B,H,S]
+    lse_l = jnp.broadcast_to(lse[..., None], (B, H, S, _LANE))
+    delta_l = jnp.broadcast_to(delta[..., None], (B, H, S, _LANE))
+
+    row_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANE),
+                     lambda b, h, ki, qi: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANE),
+                     lambda b, h, ki, qi: (b, h, qi, 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, Sk, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), q.dtype),
+        ),
+        grid=(B, H, _cdiv(Sk, block_k), _cdiv(S, block_q)),
+        in_specs=row_specs,
+        out_specs=(
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, ki, qi: (b, h, ki, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+    )(q, k, v, do, lse_l, delta_l)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        grid=(B, H, _cdiv(S, block_q), _cdiv(Sk, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANE),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANE),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, qi, ki: (b, h, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+    )(q, k, v, do, lse_l, delta_l)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API with XLA fallback + custom VJP
+# ---------------------------------------------------------------------------
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _xla_attention(q, k, v, causal):
+    from ray_tpu.models.llama import xla_attention
+
+    return xla_attention(q, k, v, causal=causal)
+
+
+def _blocks(S: int, Sk: int) -> Tuple[int, int]:
+    bq = 512 if S % 512 == 0 else 128
+    bk = 512 if Sk % 512 == 0 else 128
+    return bq, bk
+
+
+def _use_kernel(q, k) -> bool:
+    return _on_tpu() and q.shape[1] >= 128 and k.shape[1] >= 128
+
+
+def _prep(x, block, lane=_LANE):
+    """[B,S,H,D] -> padded [B,H,S,D]."""
+    return _pad_to(_pad_to(x.transpose(0, 2, 1, 3), 2, block), 3, lane)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True) -> jax.Array:
+    """q,k,v: [B, S, H, D] -> [B, S, H, D]."""
+    out, _ = _flash_fwd(q, k, v, causal)
+    return out
+
+
+def _flash_fwd(q, k, v, causal):
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    if not _use_kernel(q, k):
+        return _xla_attention(q, k, v, causal), (q, k, v, None, None)
+    if not causal and (S % 128 or Sk % 128):
+        raise NotImplementedError(
+            "non-causal flash requires seq_len % 128 == 0")
+    block_q, block_k = _blocks(S, Sk)
+    qt, kt, vt = _prep(q, block_q), _prep(k, block_k), _prep(v, block_k)
+    out, lse = _flash_fwd_bhsd(qt, kt, vt, causal, block_q, block_k,
+                               scale=1.0 / math.sqrt(D))
+    public = out[:, :, :S, :D].transpose(0, 2, 1, 3)
+    return public, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, residuals, g):
+    q, k, v, o_pad, lse = residuals
+    B, S, H, D = q.shape
+    if o_pad is None:  # XLA fallback path
+        _, vjp = jax.vjp(
+            lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
+        return vjp(g)
+    Sk = k.shape[1]
+    block_q, block_k = _blocks(S, Sk)
+    qt, kt, vt = _prep(q, block_q), _prep(k, block_k), _prep(v, block_k)
+    do = _prep(g.astype(q.dtype), block_q)
+    dq, dk, dv = _bhsd_bwd(qt, kt, vt, do, o_pad, lse, causal,
+                           block_q, block_k, scale=1.0 / math.sqrt(D))
+    dq = dq[:, :, :S, :D].transpose(0, 2, 1, 3)
+    dk = dk[:, :, :Sk, :D].transpose(0, 2, 1, 3)
+    dv = dv[:, :, :Sk, :D].transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+flash_attention.defvjp(
+    lambda q, k, v, causal: _flash_fwd(q, k, v, causal),
+    _flash_bwd,
+)

@@ -1,0 +1,66 @@
+"""Device-mesh construction helpers.
+
+The sharding/collective design follows the standard TPU recipe: pick a mesh,
+annotate shardings, let XLA (GSPMD) insert the collectives, profile, iterate.
+Axes used across ray_tpu:
+
+  data   — pure data parallelism (gradient psum)
+  fsdp   — sharded data parallelism (params sharded, ZeRO-equivalent via
+           GSPMD all-gather/reduce-scatter)
+  tensor — tensor (Megatron-style) parallelism within a layer
+  pipe   — pipeline stages
+  seq    — sequence/context parallelism (ring attention)
+
+On a TPU slice, order axes so that tensor/seq (highest-bandwidth traffic)
+map to contiguous ICI neighbours; data/pipe tolerate DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+AXIS_ORDER = ("data", "fsdp", "pipe", "seq", "tensor")
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
+    """Build a Mesh from {axis: size}; one axis may be -1 (absorbs the rest).
+
+    Axis order follows AXIS_ORDER so tensor-parallel neighbours are adjacent
+    in the device list (innermost => ICI-contiguous on TPU).
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(axes)
+    wild = [k for k, v in sizes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError("only one axis may be -1")
+    fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+    if wild:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by {fixed}")
+        sizes[wild[0]] = n // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices but {n} are available")
+    names = [a for a in AXIS_ORDER if a in sizes]
+    names += [a for a in sizes if a not in names]
+    shape = [sizes[a] for a in names]
+    return jax.sharding.Mesh(
+        np.array(devices).reshape(shape), tuple(names))
+
+
+def data_parallel_mesh():
+    return make_mesh({"data": -1})
+
+
+def fsdp_mesh(tensor: int = 1):
+    return make_mesh({"fsdp": -1, "tensor": tensor})
+
+
+def mesh_axis_size(mesh, name: str) -> int:
+    return mesh.shape[name] if name in mesh.axis_names else 1

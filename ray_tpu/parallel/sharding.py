@@ -1,0 +1,85 @@
+"""Sharding rules for model parameter pytrees.
+
+DP / FSDP / TP / (SP, PP) are mesh-axis annotations over one pjit'd program —
+not separate engines (the core TPU-first design decision; contrast the
+reference, which delegates TP/PP/FSDP to user libraries — SURVEY §2.7).
+
+GSPMD then derives the collectives: batch sharded over (data, fsdp) gives
+gradient psum; params sharded over fsdp gives ZeRO-style all-gather /
+reduce-scatter; tensor-axis shards give Megatron-style allreduce — all over
+ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.parallel.mesh import mesh_axis_size
+
+
+def _ax(mesh, name: str) -> Optional[str]:
+    """Axis name if present in the mesh with size > 1, else None (replicate)."""
+    return name if mesh_axis_size(mesh, name) > 1 else None
+
+
+def llama_param_specs(config: LlamaConfig, mesh) -> Dict[str, Any]:
+    """PartitionSpecs for the stacked Llama param tree.
+
+    Megatron layout on the ``tensor`` axis (attention heads + ffn hidden),
+    ZeRO-style on ``fsdp`` (the model dim), replication elsewhere.
+    """
+    fsdp = _ax(mesh, "fsdp")
+    tp = _ax(mesh, "tensor")
+    if tp is not None and config.n_kv_heads % mesh_axis_size(mesh, "tensor"):
+        raise ValueError(
+            f"tensor axis ({mesh_axis_size(mesh, 'tensor')}) must divide "
+            f"n_kv_heads ({config.n_kv_heads})")
+    specs = {
+        "embed": P(tp, fsdp),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, fsdp, tp),
+            "wk": P(None, fsdp, tp),
+            "wv": P(None, fsdp, tp),
+            "wo": P(None, tp, fsdp),
+            "ffn_norm": P(None, None),
+            "w_gate": P(None, fsdp, tp),
+            "w_up": P(None, fsdp, tp),
+            "w_down": P(None, tp, fsdp),
+        },
+        "norm_f": P(None),
+    }
+    if not config.tie_embeddings:
+        specs["lm_head"] = P(fsdp, tp)
+    return specs
+
+
+def llama_param_shardings(config: LlamaConfig, mesh) -> Dict[str, Any]:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        llama_param_specs(config, mesh),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_spec(mesh) -> P:
+    """Global batch sharded over every data-like axis present."""
+    axes = [a for a in ("data", "fsdp") if mesh_axis_size(mesh, a) > 1]
+    if not axes:
+        return P()
+    return P(tuple(axes))
+
+
+def batch_sharding(mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh))
+
+
+def shard_params(params, shardings):
+    """Place (or re-place) a param tree onto its shardings."""
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def replicated(mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
