@@ -172,6 +172,10 @@ class Raylet:
     def _worker_env(self) -> Dict[str, str]:
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        # The node's routable address: workers bind/advertise their RPC
+        # servers on it (not loopback) so cross-host owner RPCs, object
+        # pulls, and jax.distributed rendezvous work on real clusters.
+        env["RAY_TPU_NODE_IP"] = self.host
         return env
 
     def _spawn_worker(self, job_id: bytes) -> None:
@@ -404,6 +408,10 @@ class Raylet:
             return
         qty = demand.get(TPU)
         if 0 < qty < 1:
+            if not chips:
+                # The acquire returned [] (no chip was free); this lease
+                # never became a fractional user — don't unbalance the count.
+                return
             self._frac_users -= 1
             if self._frac_users <= 0 and self._frac_chip is not None:
                 self._free_tpu_chips.append(self._frac_chip)

@@ -196,14 +196,18 @@ class Worker:
         self.raylet = RpcClient(*raylet_addr)
         self.raylet_addr = raylet_addr
 
-        # Core worker RPC service (worker<->worker plane).
-        self.server = RpcServer("127.0.0.1", 0)
+        # Core worker RPC service (worker<->worker plane). Bind the node's
+        # routable interface (exported by the raylet) so two physical hosts
+        # can exchange owner RPCs and object pulls; loopback only when
+        # standalone.
+        bind_host = os.environ.get("RAY_TPU_NODE_IP") or raylet_addr[0]
+        self.server = RpcServer(bind_host, 0)
         for name in ["push_task", "create_actor", "push_actor_task",
                      "get_object_status", "kill_self", "cancel_task", "ping",
                      "delete_object_notification"]:
             self.server.register(name, getattr(self, f"_h_{name}"))
         self.port = self.server.start()
-        self.addr = ("127.0.0.1", self.port)
+        self.addr = (bind_host, self.port)
 
         # serialization
         self.serialization = SerializationContext()

@@ -252,8 +252,11 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
     tokens = batch["tokens"]
     logits = forward(params, tokens[:, :-1], config, attn_impl)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # NLL via logsumexp - target_logit: one [B,S,V] reduction instead of a
+    # materialized log_softmax plus gather (halves loss-stage HBM traffic).
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - tgt
     mask = batch.get("mask")
     if mask is not None:
         m = mask[:, 1:].astype(jnp.float32)

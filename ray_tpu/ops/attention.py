@@ -29,6 +29,11 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 _LANE = 128
 
+# Test hook: when True, the pallas kernels run (in interpret mode off-TPU)
+# instead of falling back to XLA — lets CPU tests exercise the real kernel
+# bodies (values AND grads) against the reference attention.
+FORCE_PALLAS_INTERPRET = False
+
 
 def _cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
@@ -99,6 +104,7 @@ def _flash_fwd_bhsd(q, k, v, causal, block_q, block_k, scale):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    interpret = not _on_tpu()
     B, H, S, D = q.shape
     Sk = k.shape[2]
     grid = (B, H, _cdiv(S, block_q), _cdiv(Sk, block_k))
@@ -130,6 +136,7 @@ def _flash_fwd_bhsd(q, k, v, causal, block_q, block_k, scale):
             pltpu.VMEM((block_q, _LANE), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
+        interpret=interpret,
     )(q, k, v)
     return out, lse[:, :, :, 0]
 
@@ -162,7 +169,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, 0]                                  # [bq, D]
         k = k_ref[0, 0]                                  # [bk, D]
         v = v_ref[0, 0]
-        do = do_ref[0, 0].astype(jnp.float32)            # [bq, D]
+        do = do_ref[0, 0]                                # [bq, D] bf16
         lse = lse_ref[0, 0][:, 0][:, None]               # [bq, 1]
         delta = delta_ref[0, 0][:, 0][:, None]           # [bq, 1]
 
@@ -176,18 +183,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)                             # [bq, bk] f32
+        # All matmul INPUTS stay bf16 (f32 operands run the MXU at a
+        # fraction of peak on TPU); accumulation is f32 via
+        # preferred_element_type.
+        p_lo = p.astype(q.dtype)
         # dv += P^T dO
         dv_scratch[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_lo, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         # dP = dO V^T ; dS = P * (dP - delta) * scale
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bq, bk]
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         # dk += dS^T q
         dk_scratch[:] += jax.lax.dot_general(
-            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
@@ -219,7 +230,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
-        do = do_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, 0][:, None]
         delta = delta_ref[0, 0][:, 0][:, None]
 
@@ -234,11 +245,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dq_scratch[:] += jax.lax.dot_general(
-            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -250,6 +261,7 @@ def _bhsd_bwd(q, k, v, do, o, lse, causal, block_q, block_k, scale):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    interpret = not _on_tpu()
     B, H, S, D = q.shape
     Sk = k.shape[2]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -286,6 +298,7 @@ def _bhsd_bwd(q, k, v, do, o, lse, causal, block_q, block_k, scale):
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
+        interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)
 
     dq = pl.pallas_call(
@@ -310,6 +323,7 @@ def _bhsd_bwd(q, k, v, do, o, lse, causal, block_q, block_k, scale):
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, qi, ki: (b, h, qi, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
     )(q, k, v, do, lse_l, delta_l)
     return dq, dk, dv
 
@@ -348,7 +362,9 @@ def _blocks(S: int, Sk: int) -> Tuple[int, int]:
 
 
 def _use_kernel(q, k) -> bool:
-    return _on_tpu() and q.shape[1] >= 128 and k.shape[1] >= 128
+    if q.shape[1] < 128 or k.shape[1] < 128:
+        return False
+    return _on_tpu() or FORCE_PALLAS_INTERPRET
 
 
 def _prep(x, block, lane=_LANE):

@@ -31,31 +31,34 @@ def state_shardings(param_shardings, optimizer, params_shape, mesh
                     ) -> TrainState:
     """Shardings for the full TrainState: opt-state mirrors params (moments
     inherit each param's sharding — automatic ZeRO partitioning of optimizer
-    state when fsdp is on)."""
+    state when fsdp is on).
+
+    The mapping is STRUCTURAL: any subtree of the optimizer state whose
+    pytree structure (and leaf shapes) mirrors the param tree — e.g. Adam's
+    mu/nu — takes the param shardings subtree wholesale; everything else
+    (step counters, empty states) is replicated.  Keying by leaf shape would
+    silently mis-shard two same-shaped params with different PartitionSpecs.
+    """
     repl = NamedSharding(mesh, P())
+    opt_shape = jax.eval_shape(lambda p: optimizer.init(p), params_shape)
+    params_td = jax.tree.structure(params_shape)
+    param_leaf_shapes = [leaf.shape for leaf in jax.tree.leaves(params_shape)]
 
-    opt_shape = jax.eval_shape(
-        lambda p: optimizer.init(p), params_shape)
+    def mirrors_params(node) -> bool:
+        try:
+            if jax.tree.structure(node) != params_td:
+                return False
+            leaves = jax.tree.leaves(node)
+        except Exception:
+            return False
+        return [getattr(l, "shape", None) for l in leaves] == param_leaf_shapes
 
-    flat_params, _ = jax.tree.flatten_with_path(params_shape)
-    by_shape = {}
-    for path, leaf in flat_params:
-        sh = _lookup_path(param_shardings, path)
-        by_shape.setdefault((leaf.shape, leaf.dtype), sh)
-
-    def opt_leaf_sharding(leaf):
-        return by_shape.get((leaf.shape, leaf.dtype), repl)
-
-    opt_sh = jax.tree.map(opt_leaf_sharding, opt_shape)
+    opt_sh = jax.tree.map(
+        lambda node: param_shardings if mirrors_params(node) else repl,
+        opt_shape,
+        is_leaf=lambda n: mirrors_params(n) or jax.tree.structure(
+            n).num_leaves <= 1)
     return TrainState(params=param_shardings, opt_state=opt_sh, step=repl)
-
-
-def _lookup_path(tree, path):
-    node = tree
-    for key in path:
-        name = getattr(key, "key", getattr(key, "idx", None))
-        node = node[name]
-    return node
 
 
 def build_train_step(
@@ -97,10 +100,6 @@ def build_train_step(
         return new_state, {"loss": loss, "grad_norm": grad_norm,
                            "step": new_state.step}
 
-    repl = NamedSharding(mesh, P())
-    st_sh = TrainState(params=param_shardings,
-                       opt_state=None,  # filled by caller via shardings arg
-                       step=repl)
     return jax.jit(
         step_fn,
         in_shardings=(None, batch_shardings),

@@ -72,6 +72,7 @@ class _TrainSession:
         self._train_fn = train_fn
         self._config = config
         self._thread: Optional[threading.Thread] = None
+        self._report_counter = 0
 
     def start(self):
         def _run():
@@ -105,10 +106,15 @@ class _TrainSession:
                checkpoint: Optional[Checkpoint] = None):
         ckpt_path = None
         if checkpoint is not None:
+            # Name by a session-side monotonic counter, never user metrics:
+            # duplicate names would alias directories and break driver-side
+            # top-k retention (reference names checkpoints driver-side with
+            # a monotonic index for the same reason).
             persisted = checkpoint.persist(
                 self.context.storage_dir,
-                name=f"checkpoint_{metrics.get('training_iteration', 'x')}"
+                name=f"checkpoint_{self._report_counter:06d}"
                      f"_rank{self.context.world_rank}")
+            self._report_counter += 1
             self.latest_checkpoint = persisted
             ckpt_path = persisted.path
         # Blocks when the driver falls behind (backpressure, reference

@@ -148,7 +148,11 @@ class DataParallelTrainer:
         for item in doomed:
             if item in checkpoints and len(checkpoints) > keep:
                 checkpoints.remove(item)
-                shutil.rmtree(item[1], ignore_errors=True)
+                # A path may legitimately appear under several retention
+                # entries; only delete from disk once no kept entry
+                # references it.
+                if all(path != item[1] for _, path in checkpoints):
+                    shutil.rmtree(item[1], ignore_errors=True)
 
     def _shard_datasets(self, executor: BackendExecutor) -> Dict[str, Any]:
         """Split datasets across workers via streaming_split (Train<->Data

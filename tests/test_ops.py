@@ -1,0 +1,91 @@
+"""Pallas kernel parity tests (CPU, interpret mode).
+
+The public ops fall back to XLA off-TPU, so these tests force the pallas
+kernel bodies through `pl.pallas_call(..., interpret=True)` and check values
+AND gradients against the reference `xla_attention`.  (VERDICT round 1: the
+hand-written backward had never executed before the bench.)
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models.llama import xla_attention  # noqa: E402
+from ray_tpu.ops import attention as attn_mod  # noqa: E402
+from ray_tpu.ops.attention import flash_attention  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _force_interpret():
+    attn_mod.FORCE_PALLAS_INTERPRET = True
+    yield
+    attn_mod.FORCE_PALLAS_INTERPRET = False
+
+
+def _rand_qkv(key, B, S, H, D, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D), dtype)
+    k = jax.random.normal(kk, (B, S, H, D), dtype)
+    v = jax.random.normal(kv, (B, S, H, D), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_matches_xla(causal):
+    q, k, v = _rand_qkv(jax.random.key(0), 2, 256, 2, 64)
+    out = flash_attention(q, k, v, causal)
+    ref = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_grads_match_xla(causal):
+    q, k, v = _rand_qkv(jax.random.key(1), 1, 128, 2, 64)
+
+    def mk_loss(f):
+        def loss(q, k, v):
+            o = f(q, k, v)
+            # Non-uniform weighting so dq/dk/dv are all exercised.
+            w = jnp.arange(o.size, dtype=o.dtype).reshape(o.shape) / o.size
+            return jnp.sum(o * w)
+        return loss
+
+    gf = jax.grad(mk_loss(lambda q, k, v: flash_attention(q, k, v, causal)),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(mk_loss(
+        lambda q, k, v: xla_attention(q, k, v, causal=causal)),
+        argnums=(0, 1, 2))(q, k, v)
+    for got, ref, name in zip(gf, gr, "q k v".split()):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=5e-4, atol=5e-4,
+            err_msg=f"d{name} mismatch (causal={causal})")
+
+
+def test_flash_uneven_seq_pads():
+    # 200 is not a multiple of the 128 block; causal path pads internally.
+    q, k, v = _rand_qkv(jax.random.key(2), 1, 200, 1, 64)
+    out = flash_attention(q, k, v, True)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_bf16_close_to_f32_reference():
+    q, k, v = _rand_qkv(jax.random.key(3), 1, 128, 2, 64, jnp.bfloat16)
+    out = flash_attention(q, k, v, True).astype(jnp.float32)
+    ref = xla_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                        v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
+
+
+def test_short_seq_falls_back_to_xla():
+    # Below the 128-token threshold the public API must still be exact.
+    q, k, v = _rand_qkv(jax.random.key(4), 2, 64, 2, 64)
+    out = flash_attention(q, k, v, True)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)

@@ -1,0 +1,89 @@
+"""Ring attention parity on the 8-device virtual CPU mesh.
+
+Checks the context-parallel path end to end: values and grads match the
+single-device reference, the kv rotation really crosses devices
+(shard_map + ppermute), and the unbound-axis fallback stays exact.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from ray_tpu.models.llama import xla_attention  # noqa: E402
+from ray_tpu.ops.ring_attention import (  # noqa: E402
+    ring_attention, ring_attention_global,
+)
+
+
+def _mesh(n=8, name="sp"):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (name,))
+
+
+def _rand(key, shape):
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(causal):
+    B, S, H, D = 2, 256, 2, 32
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (_rand(ks[i], (B, S, H, D)) for i in range(3))
+    mesh = _mesh()
+    out = ring_attention_global(q, k, v, mesh, causal=causal)
+    ref = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_grads_match_reference():
+    B, S, H, D = 1, 128, 2, 16
+    ks = jax.random.split(jax.random.key(1), 3)
+    q, k, v = (_rand(ks[i], (B, S, H, D)) for i in range(3))
+    mesh = _mesh()
+
+    def mk(f):
+        def loss(q, k, v):
+            o = f(q, k, v)
+            w = jnp.arange(o.size, dtype=o.dtype).reshape(o.shape) / o.size
+            return jnp.sum(o * w)
+        return loss
+
+    g_ring = jax.grad(mk(lambda q, k, v: ring_attention_global(
+        q, k, v, mesh, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(mk(lambda q, k, v: xla_attention(q, k, v, causal=True)),
+                     argnums=(0, 1, 2))(q, k, v)
+    for got, ref, name in zip(g_ring, g_ref, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=5e-5, atol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_ring_under_jit_with_sharded_inputs():
+    """The production shape: jit + device_put onto the seq-sharded layout."""
+    B, S, H, D = 2, 512, 4, 32
+    ks = jax.random.split(jax.random.key(2), 3)
+    q, k, v = (_rand(ks[i], (B, S, H, D)) for i in range(3))
+    mesh = _mesh()
+    sh = jax.sharding.NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks_, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda q, k, v: ring_attention_global(
+        q, k, v, mesh, causal=True))(qs, ks_, vs)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_unbound_axis_falls_back_exact():
+    B, S, H, D = 2, 64, 2, 16
+    ks = jax.random.split(jax.random.key(3), 3)
+    q, k, v = (_rand(ks[i], (B, S, H, D)) for i in range(3))
+    out = ring_attention(q, k, v, causal=True, axis_name="nope")
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
