@@ -1,0 +1,78 @@
+"""ray_tpu.data — lazy streaming datasets for accelerator ingestion.
+
+Public API parity (reference `python/ray/data/__init__.py`): read_* creation
+functions, Dataset transforms (map/map_batches/filter/flat_map/limit/
+repartition/random_shuffle), consumption (iter_batches/take/count), and
+`streaming_split` train ingestion.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ray_tpu.data._internal import plan as _plan
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import Dataset, MaterializedDataset
+from ray_tpu.data.datasource import (
+    BinaryDatasource, CSVDatasource, Datasource, ItemsDatasource,
+    NumpyDatasource, ParquetDatasource, RangeDatasource, TextDatasource,
+)
+from ray_tpu.data.iterator import DataIterator
+
+
+def _read(ds: Datasource, parallelism: int = -1) -> Dataset:
+    return Dataset([_plan.Read(ds, parallelism)])
+
+
+def range(n: int, *, override_num_blocks: int = -1, **_ignored) -> Dataset:  # noqa: A001
+    return _read(RangeDatasource(n),
+                 override_num_blocks if override_num_blocks > 0 else 8)
+
+
+def from_items(items: List[Any], *, override_num_blocks: int = -1,
+               **_ignored) -> Dataset:
+    return _read(ItemsDatasource(items),
+                 override_num_blocks if override_num_blocks > 0 else 8)
+
+
+def from_numpy(arr: "np.ndarray", column: str = "data",
+               *, override_num_blocks: int = -1) -> Dataset:
+    return _read(NumpyDatasource(arr, column),
+                 override_num_blocks if override_num_blocks > 0 else 8)
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    return MaterializedDataset.from_blocks(
+        [pa.Table.from_pandas(df, preserve_index=False)])
+
+
+def from_arrow(table) -> Dataset:
+    return MaterializedDataset.from_blocks([table])
+
+
+def read_parquet(paths, **_ignored) -> Dataset:
+    return _read(ParquetDatasource(paths))
+
+
+def read_csv(paths, **_ignored) -> Dataset:
+    return _read(CSVDatasource(paths))
+
+
+def read_text(paths, **_ignored) -> Dataset:
+    return _read(TextDatasource(paths))
+
+
+def read_binary_files(paths, **_ignored) -> Dataset:
+    return _read(BinaryDatasource(paths))
+
+
+__all__ = [
+    "Block", "BlockAccessor", "DataIterator", "Dataset",
+    "MaterializedDataset", "Datasource", "range", "from_items",
+    "from_numpy", "from_pandas", "from_arrow", "read_parquet", "read_csv",
+    "read_text", "read_binary_files",
+]

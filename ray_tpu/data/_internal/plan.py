@@ -1,0 +1,130 @@
+"""Logical plan: lazy operator DAG with map fusion.
+
+Reference model: `python/ray/data/_internal/logical_plan.py` + operator
+fusion in `_internal/planner/`.  Consecutive row/batch transforms fuse into
+one per-block function, so a `read -> map_batches -> filter` pipeline runs
+as a single wave of remote tasks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional
+
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.datasource import Datasource
+
+
+@dataclasses.dataclass
+class Op:
+    """Base logical operator."""
+
+
+@dataclasses.dataclass
+class Read(Op):
+    datasource: Datasource
+    parallelism: int = -1
+
+
+@dataclasses.dataclass
+class InputBlocks(Op):
+    """Pre-materialized blocks (object refs or inline tables)."""
+    refs: List[Any] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class MapBatches(Op):
+    fn: Callable
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    fn_kwargs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class MapRows(Op):
+    fn: Callable
+
+
+@dataclasses.dataclass
+class FlatMap(Op):
+    fn: Callable
+
+
+@dataclasses.dataclass
+class Filter(Op):
+    fn: Callable
+
+
+@dataclasses.dataclass
+class Limit(Op):
+    n: int = 0
+
+
+@dataclasses.dataclass
+class Repartition(Op):
+    n: int = 1
+
+
+@dataclasses.dataclass
+class RandomShuffle(Op):
+    seed: Optional[int] = None
+
+
+MAP_LIKE = (MapBatches, MapRows, FlatMap, Filter)
+
+
+def compile_block_fn(ops: List[Op]) -> Callable[[Any], Any]:
+    """Fuse a run of map-like ops into one block -> block function."""
+
+    def apply(block):
+        import pyarrow as pa
+
+        for op in ops:
+            acc = BlockAccessor(block)
+            if isinstance(op, MapBatches):
+                outs = []
+                n = acc.num_rows()
+                bs = op.batch_size or n or 1
+                for lo in range(0, max(n, 1), bs):
+                    if n == 0:
+                        break
+                    sub = BlockAccessor(acc.slice(lo, min(lo + bs, n)))
+                    out = op.fn(sub.to_batch(op.batch_format),
+                                **op.fn_kwargs)
+                    outs.append(BlockAccessor.from_batch(out))
+                block = (BlockAccessor.concat([o for o in outs])
+                         if outs else pa.table({}))
+            elif isinstance(op, MapRows):
+                block = BlockAccessor.from_rows(
+                    [op.fn(dict(r)) for r in acc.rows()])
+            elif isinstance(op, FlatMap):
+                rows = []
+                for r in acc.rows():
+                    rows.extend(op.fn(dict(r)))
+                block = BlockAccessor.from_rows(rows)
+            elif isinstance(op, Filter):
+                block = BlockAccessor.from_rows(
+                    [dict(r) for r in acc.rows() if op.fn(dict(r))])
+            else:
+                raise TypeError(f"not a map-like op: {op}")
+        return block
+
+    return apply
+
+
+def split_stages(ops: List[Op]) -> List[Any]:
+    """Group the op list into stages: each stage is either a source op, a
+    barrier op, or a fused list of map-like ops."""
+    stages: List[Any] = []
+    run: List[Op] = []
+    for op in ops:
+        if isinstance(op, MAP_LIKE):
+            run.append(op)
+        else:
+            if run:
+                stages.append(list(run))
+                run = []
+            stages.append(op)
+    if run:
+        stages.append(list(run))
+    return stages

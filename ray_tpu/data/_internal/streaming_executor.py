@@ -1,0 +1,199 @@
+"""Pull-based streaming executor.
+
+Reference model: `python/ray/data/_internal/execution/streaming_executor.py`
+— operators execute as waves of remote tasks with a bounded in-flight
+window; downstream consumption pulls blocks through the pipeline, so a slow
+consumer backpressures the reads instead of materializing the dataset.
+
+TPU-first framing: the ops plane (this executor) runs on CPU workers via
+ray_tpu tasks; it exists to keep the accelerator-side input queue full.
+When no cluster is initialized the executor degrades to inline execution —
+same plan, local thunks — so Datasets work in plain unit tests and inside
+already-remote workers without nested clusters.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu._private.worker import global_worker_or_none
+from ray_tpu.data._internal import plan as plan_mod
+from ray_tpu.data.block import BlockAccessor
+
+DEFAULT_IN_FLIGHT = 8
+
+
+def _cluster_available() -> bool:
+    return global_worker_or_none() is not None
+
+
+@ray_tpu.remote
+def _run_read(read_task, fused_fn) -> List[Any]:
+    blocks = []
+    for block in read_task():
+        if fused_fn is not None:
+            block = fused_fn(block)
+        blocks.append(block)
+    return blocks
+
+
+@ray_tpu.remote
+def _run_transform(blocks: List[Any], fused_fn) -> List[Any]:
+    return [fused_fn(b) for b in blocks]
+
+
+@ray_tpu.remote
+def _gather_slices(parts: List[Any]) -> List[Any]:
+    """parts: list of (blocks_list, lo, hi) row-ranges to concat."""
+    out = []
+    for blocks, lo, hi in parts:
+        acc = 0
+        for b in blocks:
+            n = b.num_rows
+            s, e = max(lo - acc, 0), min(hi - acc, n)
+            if s < e:
+                out.append(b.slice(s, e - s))
+            acc += n
+    return [BlockAccessor.concat(out)] if out else []
+
+
+class StreamingExecutor:
+    """Executes a logical op list, yielding blocks (arrow tables)."""
+
+    def __init__(self, ops: List[Any], in_flight: int = DEFAULT_IN_FLIGHT):
+        self._ops = ops
+        self._in_flight = in_flight
+
+    # ------------------------------------------------------------- public
+    def stream_blocks(self) -> Iterator[Any]:
+        """Yield output blocks with streaming/backpressure semantics."""
+        stages = plan_mod.split_stages(self._ops)
+        yield from self._run_stages(stages)
+
+    # ------------------------------------------------------------ internal
+    def _run_stages(self, stages: List[Any]) -> Iterator[Any]:
+        if not stages:
+            return
+        first, rest = stages[0], stages[1:]
+
+        # Fuse a map-stage directly into the source wave.
+        fused: Optional[Callable] = None
+        if rest and isinstance(rest[0], list):
+            fused = plan_mod.compile_block_fn(rest[0])
+            rest = rest[1:]
+
+        if isinstance(first, plan_mod.Read):
+            tasks = first.datasource.get_read_tasks(
+                first.parallelism if first.parallelism > 0 else 8)
+            source = self._stream_tasks(tasks, fused)
+        elif isinstance(first, plan_mod.InputBlocks):
+            source = self._stream_input(first.refs, fused)
+        else:
+            raise TypeError(f"bad source op {first}")
+
+        yield from self._apply_rest(source, rest)
+
+    def _apply_rest(self, source: Iterator[Any], stages: List[Any]
+                    ) -> Iterator[Any]:
+        if not stages:
+            yield from source
+            return
+        head, rest = stages[0], stages[1:]
+        if isinstance(head, list):
+            fn = plan_mod.compile_block_fn(head)
+            yield from self._apply_rest((fn(b) for b in source), rest)
+        elif isinstance(head, plan_mod.Limit):
+            def limited():
+                seen = 0
+                for b in source:
+                    take = min(b.num_rows, head.n - seen)
+                    if take < b.num_rows:
+                        b = b.slice(0, take)
+                    seen += take
+                    yield b
+                    if seen >= head.n:
+                        return  # early exit stops upstream submission
+            yield from self._apply_rest(limited(), rest)
+        elif isinstance(head, plan_mod.Repartition):
+            yield from self._apply_rest(
+                self._repartition(list(source), head.n), rest)
+        elif isinstance(head, plan_mod.RandomShuffle):
+            yield from self._apply_rest(
+                self._shuffle(list(source), head.seed), rest)
+        else:
+            raise TypeError(f"unsupported stage {head}")
+
+    # -------------------------------------------------------------- waves
+    def _stream_tasks(self, read_tasks: List[Any], fused) -> Iterator[Any]:
+        if not _cluster_available():
+            for t in read_tasks:
+                for block in t():
+                    yield fused(block) if fused is not None else block
+            return
+        pending: deque = deque()
+        it = iter(read_tasks)
+        exhausted = False
+        while pending or not exhausted:
+            while not exhausted and len(pending) < self._in_flight:
+                try:
+                    t = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append(_run_read.remote(t, fused))
+            if pending:
+                blocks = ray_tpu.get(pending.popleft(), timeout=600)
+                yield from blocks
+
+    def _stream_input(self, refs: List[Any], fused) -> Iterator[Any]:
+        from ray_tpu import ObjectRef
+
+        for r in refs:
+            block = (ray_tpu.get(r, timeout=600)
+                     if isinstance(r, ObjectRef) else r)
+            blocks = block if isinstance(block, list) else [block]
+            for b in blocks:
+                yield fused(b) if fused is not None else b
+
+    # ------------------------------------------------------------ barriers
+    def _repartition(self, blocks: List[Any], n: int) -> Iterator[Any]:
+        total = sum(b.num_rows for b in blocks)
+        per = total // n if n else 0
+        extras = total - per * n
+        lo = 0
+        for i in range(n):
+            size = per + (1 if i < extras else 0)
+            hi = lo + size
+            out = []
+            acc = 0
+            for b in blocks:
+                bn = b.num_rows
+                s, e = max(lo - acc, 0), min(hi - acc, bn)
+                if s < e:
+                    out.append(b.slice(s, e - s))
+                acc += bn
+            yield (BlockAccessor.concat(out) if out
+                   else BlockAccessor.from_rows([]))
+            lo = hi
+
+    def _shuffle(self, blocks: List[Any], seed: Optional[int]
+                 ) -> Iterator[Any]:
+        """Global random shuffle: concatenate -> permute -> re-split.
+
+        Driver-side materialization (the reference's all-to-all shuffle is
+        a scale-out version of the same barrier; at this executor's scale
+        the permutation happens in one process)."""
+        import numpy as np
+
+        if not blocks:
+            return
+        table = BlockAccessor.concat(blocks)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(table.num_rows)
+        table = table.take(perm)
+        nb = max(len(blocks), 1)
+        per = (table.num_rows + nb - 1) // nb or 1
+        for lo in range(0, table.num_rows, per):
+            yield table.slice(lo, min(per, table.num_rows - lo))

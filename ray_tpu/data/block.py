@@ -1,0 +1,130 @@
+"""Block model: the unit of distributed data.
+
+A block is a pyarrow.Table (reference: `python/ray/data/block.py` — blocks
+are arrow tables / pandas frames moved through the object store).  The
+BlockAccessor converts between user-facing batch formats ("numpy" dict of
+arrays, "pandas", "pyarrow", or plain row dicts) and the canonical arrow
+representation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover - pyarrow is in the base image
+    pa = None
+
+Block = "pa.Table"
+Batch = Union[Dict[str, np.ndarray], "pa.Table", Any]
+
+
+def _ensure_pa():
+    if pa is None:
+        raise ImportError("pyarrow is required for ray_tpu.data")
+
+
+class BlockAccessor:
+    """Wraps one arrow-table block."""
+
+    def __init__(self, table: "pa.Table"):
+        self._t = table
+
+    # ------------------------------------------------------------ construct
+    @staticmethod
+    def from_rows(rows: List[Any]) -> "pa.Table":
+        _ensure_pa()
+        if not rows:
+            return pa.table({})
+        if isinstance(rows[0], dict):
+            cols: Dict[str, list] = {}
+            for r in rows:
+                for k in r:
+                    cols.setdefault(k, [])
+            for r in rows:
+                for k in cols:
+                    cols[k].append(r.get(k))
+            return pa.table(
+                {k: pa.array(v) for k, v in cols.items()})
+        # Plain values -> single "item" column (reference convention).
+        return pa.table({"item": pa.array(rows)})
+
+    @staticmethod
+    def from_batch(batch: Batch) -> "pa.Table":
+        _ensure_pa()
+        if pa is not None and isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, dict):
+            arrays = {}
+            for k, v in batch.items():
+                v = np.asarray(v)
+                if v.ndim > 1:
+                    # Tensor column: arrow list-of-list via nested lists.
+                    arrays[k] = pa.array(list(v))
+                else:
+                    arrays[k] = pa.array(v)
+            return pa.table(arrays)
+        try:  # pandas
+            import pandas as pd
+
+            if isinstance(batch, pd.DataFrame):
+                return pa.Table.from_pandas(batch, preserve_index=False)
+        except ImportError:
+            pass
+        raise TypeError(f"unsupported batch type: {type(batch)}")
+
+    # ------------------------------------------------------------- convert
+    def to_batch(self, batch_format: str = "numpy") -> Batch:
+        if batch_format in ("pyarrow", "arrow"):
+            return self._t
+        if batch_format == "pandas":
+            return self._t.to_pandas()
+        if batch_format in ("numpy", "default"):
+            out: Dict[str, np.ndarray] = {}
+            for name in self._t.column_names:
+                col = self._t.column(name)
+                try:
+                    out[name] = col.to_numpy(zero_copy_only=False)
+                except (pa.ArrowInvalid, ValueError):
+                    out[name] = np.asarray(col.to_pylist())
+                if out[name].dtype == object:
+                    try:
+                        out[name] = np.stack(
+                            [np.asarray(x) for x in out[name]])
+                    except Exception:
+                        pass
+            return out
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def rows(self) -> Iterable[Dict[str, Any]]:
+        cols = self._t.column_names
+        for i in range(self._t.num_rows):
+            yield {c: self._t.column(c)[i].as_py() for c in cols}
+
+    # --------------------------------------------------------------- shape
+    @property
+    def table(self) -> "pa.Table":
+        return self._t
+
+    def num_rows(self) -> int:
+        return self._t.num_rows
+
+    def size_bytes(self) -> int:
+        return self._t.nbytes
+
+    def slice(self, start: int, end: int) -> "pa.Table":
+        return self._t.slice(start, end - start)
+
+    @staticmethod
+    def concat(blocks: List["pa.Table"]) -> "pa.Table":
+        _ensure_pa()
+        blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+        if not blocks:
+            return pa.table({})
+        return pa.concat_tables(blocks, promote_options="default")
+
+    def schema(self) -> Optional["pa.Schema"]:
+        return self._t.schema

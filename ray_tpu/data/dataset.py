@@ -1,0 +1,166 @@
+"""Dataset: lazy, distributed data pipelines.
+
+Reference surface: `python/ray/data/dataset.py` (Dataset) — lazy logical
+plan, map fusion, pull-based streaming execution over the tasks/actors
+runtime, `streaming_split` for train ingestion.  Out of scope in this slice:
+sort/groupby/join and the arrow-native all-to-all shuffle service (shuffle
+here is a driver-side barrier).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._internal import plan as plan_mod
+from ray_tpu.data._internal.streaming_executor import (
+    DEFAULT_IN_FLIGHT, StreamingExecutor, _cluster_available,
+)
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.iterator import DataIterator, SplitIterator, _SplitCoordinator
+
+
+class Dataset:
+    def __init__(self, ops: List[plan_mod.Op]):
+        self._ops = ops
+
+    # ------------------------------------------------------------ transforms
+    def _with(self, op: plan_mod.Op) -> "Dataset":
+        return Dataset(self._ops + [op])
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    fn_kwargs: Optional[Dict] = None, **_ignored) -> "Dataset":
+        return self._with(plan_mod.MapBatches(
+            fn, batch_size=batch_size, batch_format=batch_format,
+            fn_kwargs=fn_kwargs or {}))
+
+    def map(self, fn: Callable, **_ignored) -> "Dataset":
+        return self._with(plan_mod.MapRows(fn))
+
+    def flat_map(self, fn: Callable, **_ignored) -> "Dataset":
+        return self._with(plan_mod.FlatMap(fn))
+
+    def filter(self, fn: Callable, **_ignored) -> "Dataset":
+        return self._with(plan_mod.Filter(fn))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(plan_mod.Limit(n))
+
+    def repartition(self, n: int, **_ignored) -> "Dataset":
+        return self._with(plan_mod.Repartition(n))
+
+    def random_shuffle(self, *, seed: Optional[int] = None, **_ignored
+                       ) -> "Dataset":
+        return self._with(plan_mod.RandomShuffle(seed))
+
+    # ----------------------------------------------------------- consumption
+    def _stream(self, in_flight: int = DEFAULT_IN_FLIGHT) -> Iterator[Any]:
+        return StreamingExecutor(self._ops, in_flight).stream_blocks()
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return DataIterator(self._stream).iter_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return DataIterator(self._stream).iter_rows()
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._stream)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for block in self.limit(n)._stream():
+            out.extend(BlockAccessor(block).rows())
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for block in self._stream():
+            out.extend(BlockAccessor(block).rows())
+        return out
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self._stream())
+
+    def sum(self, column: str) -> Any:
+        total = 0
+        for b in self._stream():
+            arr = BlockAccessor(b).to_batch("numpy").get(column)
+            if arr is not None and len(arr):
+                total += np.asarray(arr).sum()
+        return total
+
+    def schema(self):
+        for block in self.limit(1)._stream():
+            if block.num_rows or block.num_columns:
+                return BlockAccessor(block).schema()
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        return list(s.names) if s is not None else None
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
+    def stats(self) -> str:
+        stages = plan_mod.split_stages(self._ops)
+        return f"Dataset({len(self._ops)} ops, {len(stages)} stages)"
+
+    # ------------------------------------------------------------- splitting
+    def materialize(self) -> "MaterializedDataset":
+        blocks = list(self._stream())
+        return MaterializedDataset.from_blocks(blocks)
+
+    def split(self, n: int, *, equal: bool = False, **_ignored
+              ) -> List["MaterializedDataset"]:
+        blocks = list(self.repartition(max(n, 1))._stream()) if equal else \
+            list(self._stream())
+        parts: List[List[Any]] = [[] for _ in range(n)]
+        for i, b in enumerate(blocks):
+            parts[i % n].append(b)
+        return [MaterializedDataset.from_blocks(p) for p in parts]
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints: Optional[List] = None
+                        ) -> List[DataIterator]:
+        """n coordinated iterators over one shared streaming execution
+        (the train-ingestion path: one per train worker)."""
+        if not _cluster_available():
+            # Local fallback: pre-split materialized data.
+            return [DataIterator((lambda p=p: iter(p)))
+                    for p in self._split_blocks_local(n)]
+        coord = _SplitCoordinator.options(
+            name=f"split-coord-{id(self)}-{np.random.randint(1 << 30)}",
+        ).remote(self._ops)
+        return [SplitIterator(coord, i) for i in range(n)]
+
+    def _split_blocks_local(self, n: int) -> List[List[Any]]:
+        blocks = list(self.repartition(n)._stream())
+        parts: List[List[Any]] = [[] for _ in range(n)]
+        for i, b in enumerate(blocks):
+            parts[i % n].append(b)
+        return parts
+
+    # ---------------------------------------------------------------- misc
+    def __repr__(self) -> str:  # pragma: no cover
+        return self.stats()
+
+
+class MaterializedDataset(Dataset):
+    """Dataset backed by already-computed blocks (kept as object refs when a
+    cluster is up, inline tables otherwise)."""
+
+    @staticmethod
+    def from_blocks(blocks: List[Any]) -> "MaterializedDataset":
+        if _cluster_available():
+            refs = [ray_tpu.put(b) for b in blocks]
+        else:
+            refs = blocks
+        return MaterializedDataset([plan_mod.InputBlocks(refs)])

@@ -1,0 +1,159 @@
+"""Datasources: produce ReadTasks — serializable thunks yielding blocks.
+
+Reference model: `python/ray/data/datasource/datasource.py` (Datasource /
+ReadTask).  A read op materializes into N ReadTasks; the streaming executor
+runs each as a remote task, so reads scale out and interleave with
+downstream transforms.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import BlockAccessor
+
+# A ReadTask is a zero-arg callable returning an iterable of blocks.
+ReadTask = Callable[[], Iterable[Any]]
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int):
+        self._n = n
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = self._n
+        parallelism = max(1, min(parallelism, n) if n else 1)
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks: List[ReadTask] = []
+        for i in range(parallelism):
+            lo, hi = i * chunk, min((i + 1) * chunk, n)
+            if lo >= hi:
+                break
+
+            def make(lo=lo, hi=hi):
+                def read():
+                    yield BlockAccessor.from_batch(
+                        {"id": np.arange(lo, hi, dtype=np.int64)})
+                return read
+            tasks.append(make())
+        return tasks or [lambda: iter(
+            [BlockAccessor.from_batch({"id": np.zeros(0, np.int64)})])]
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        items = self._items
+        n = len(items)
+        parallelism = max(1, min(parallelism, n) if n else 1)
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks: List[ReadTask] = []
+        for i in range(parallelism):
+            part = items[i * chunk:(i + 1) * chunk]
+            if not part:
+                break
+
+            def make(part=part):
+                def read():
+                    yield BlockAccessor.from_rows(part)
+                return read
+            tasks.append(make())
+        return tasks or [lambda: iter([BlockAccessor.from_rows([])])]
+
+
+class _FileDatasource(Datasource):
+    """One read task per file."""
+
+    def __init__(self, paths: Any):
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        expanded: List[str] = []
+        for p in paths:
+            p = os.fspath(p)
+            if os.path.isdir(p):
+                expanded.extend(
+                    sorted(os.path.join(p, f) for f in os.listdir(p)
+                           if not f.startswith(".")))
+            else:
+                expanded.append(p)
+        self._paths = expanded
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self._paths:
+            def make(path=path):
+                def read():
+                    yield from self._read_file(path)
+                return read
+            tasks.append(make())
+        return tasks
+
+    def _read_file(self, path: str):
+        raise NotImplementedError
+
+
+class ParquetDatasource(_FileDatasource):
+    def _read_file(self, path: str):
+        import pyarrow.parquet as pq
+
+        yield pq.read_table(path)
+
+
+class CSVDatasource(_FileDatasource):
+    def _read_file(self, path: str):
+        import pyarrow.csv as pacsv
+
+        yield pacsv.read_csv(path)
+
+
+class TextDatasource(_FileDatasource):
+    def _read_file(self, path: str):
+        with open(path, "r", encoding="utf-8") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        yield BlockAccessor.from_batch({"text": np.asarray(lines, object)})
+
+
+class BinaryDatasource(_FileDatasource):
+    def _read_file(self, path: str):
+        with open(path, "rb") as f:
+            data = f.read()
+        import pyarrow as pa
+
+        yield pa.table({"bytes": pa.array([data], pa.binary()),
+                        "path": pa.array([path])})
+
+
+class NumpyDatasource(Datasource):
+    def __init__(self, arr: np.ndarray, column: str = "data"):
+        self._arr = np.asarray(arr)
+        self._col = column
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self._arr)
+        parallelism = max(1, min(parallelism, n) if n else 1)
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks: List[ReadTask] = []
+        for i in range(parallelism):
+            part = self._arr[i * chunk:(i + 1) * chunk]
+            if len(part) == 0:
+                break
+
+            def make(part=part):
+                def read():
+                    yield BlockAccessor.from_batch({self._col: part})
+                return read
+            tasks.append(make())
+        return tasks

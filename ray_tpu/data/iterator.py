@@ -1,0 +1,142 @@
+"""DataIterator: the consumption-side handle (train-ingestion surface).
+
+Reference model: `python/ray/data/iterator.py` (DataIterator) and
+`_internal/execution/streaming_split` — `streaming_split(n)` returns n
+iterators sharing one coordinator actor; output blocks are dispatched to
+whichever consumer asks next (dynamic balancing), and every epoch re-executes
+the plan from the start.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor
+
+
+def _rebatch(blocks: Iterator[Any], batch_size: Optional[int],
+             batch_format: str, drop_last: bool,
+             shuffle_buffer: Optional[int] = None,
+             seed: Optional[int] = None) -> Iterator[Any]:
+    """Slice a stream of arrow blocks into exact-size batches."""
+    import pyarrow as pa
+
+    rng = np.random.default_rng(seed)
+    buf: List[Any] = []
+    buffered = 0
+
+    def emit(table):
+        return BlockAccessor(table).to_batch(batch_format)
+
+    for block in blocks:
+        if block.num_rows == 0:
+            continue
+        if shuffle_buffer:
+            idx = rng.permutation(block.num_rows)
+            block = block.take(idx)
+        if batch_size is None:
+            yield emit(block)
+            continue
+        buf.append(block)
+        buffered += block.num_rows
+        while buffered >= batch_size:
+            table = BlockAccessor.concat(buf)
+            out = table.slice(0, batch_size)
+            remainder = table.slice(batch_size, table.num_rows - batch_size)
+            buf = [remainder] if remainder.num_rows else []
+            buffered = remainder.num_rows
+            yield emit(out)
+    if buffered and batch_size is not None and not drop_last:
+        yield emit(BlockAccessor.concat(buf))
+
+
+class DataIterator:
+    """Iterates one split (or the whole dataset) epoch by epoch."""
+
+    def __init__(self, block_source: Callable[[], Iterator[Any]]):
+        self._block_source = block_source
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Any]:
+        yield from _rebatch(self._block_source(), batch_size, batch_format,
+                            drop_last, local_shuffle_buffer_size,
+                            local_shuffle_seed)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self._block_source():
+            yield from BlockAccessor(block).rows()
+
+    def materialize(self):
+        from ray_tpu.data.dataset import MaterializedDataset
+
+        return MaterializedDataset.from_blocks(list(self._block_source()))
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class _SplitCoordinator:
+    """Owns the streaming execution for streaming_split consumers.
+
+    One instance per split() call; consumers pull with `next_block(epoch)`.
+    The first request of a new epoch restarts the stream; blocks go to
+    whichever consumer asks next (reference: output-bundle dispatch in
+    streaming_split's coordinator).
+    """
+
+    def __init__(self, ops: List[Any], in_flight: int = 4):
+        self._ops = ops
+        self._in_flight = in_flight
+        self._epoch = -1
+        self._stream: Optional[Iterator[Any]] = None
+        self._lock = threading.Lock()
+
+    def next_block(self, epoch: int):
+        with self._lock:
+            if epoch > self._epoch:
+                from ray_tpu.data._internal.streaming_executor import (
+                    StreamingExecutor,
+                )
+
+                self._epoch = epoch
+                self._stream = StreamingExecutor(
+                    self._ops, self._in_flight).stream_blocks()
+            if epoch < self._epoch or self._stream is None:
+                return None  # stale epoch: treat as exhausted
+            try:
+                return next(self._stream)
+            except StopIteration:
+                self._stream = None
+                return None
+
+
+class SplitIterator(DataIterator):
+    """One consumer of a streaming_split; picklable across workers."""
+
+    def __init__(self, coordinator, split_index: int):
+        self._coord = coordinator
+        self._index = split_index
+        self._epoch = 0
+        super().__init__(self._pull_blocks)
+
+    def _pull_blocks(self) -> Iterator[Any]:
+        epoch = self._epoch
+        self._epoch += 1
+        while True:
+            block = ray_tpu.get(self._coord.next_block.remote(epoch),
+                                timeout=600)
+            if block is None:
+                return
+            yield block
+
+    def __reduce__(self):
+        return (_rebuild_split_iterator, (self._coord, self._index))
+
+
+def _rebuild_split_iterator(coord, index):
+    return SplitIterator(coord, index)

@@ -1,7 +1,9 @@
 """ray_tpu.train — distributed training orchestration (Ray Train parity,
 TPU-native: JaxTrainer/JaxBackend instead of Torch/DDP)."""
 
-from ray_tpu.train._internal.session import get_context, report
+from ray_tpu.train._internal.session import (
+    get_context, get_dataset_shard, report,
+)
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import (
@@ -15,5 +17,5 @@ __all__ = [
     "JaxTrainer", "DataParallelTrainer", "JaxBackend", "JaxConfig",
     "Backend", "BackendConfig", "ScalingConfig", "RunConfig",
     "FailureConfig", "CheckpointConfig", "Checkpoint", "Result",
-    "report", "get_context", "TrainingFailedError",
+    "report", "get_context", "get_dataset_shard", "TrainingFailedError",
 ]

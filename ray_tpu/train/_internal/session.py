@@ -70,6 +70,15 @@ class _TrainSession:
         self.latest_checkpoint = latest_checkpoint
         self._result_queue: "queue.Queue" = queue.Queue(maxsize=8)
         self._train_fn = train_fn
+        config = dict(config or {})
+        # Dataset shards ride alongside user config (trainer `datasets=`);
+        # exposed via train.get_dataset_shard, not the config dict.
+        shards = config.pop("__datasets__", {})
+        self.dataset_shards = {
+            name: per_rank[context.world_rank]
+            for name, per_rank in shards.items()
+            if context.world_rank < len(per_rank)
+        }
         self._config = config
         self._thread: Optional[threading.Thread] = None
         self._report_counter = 0
@@ -147,3 +156,14 @@ def get_context() -> TrainContext:
     if s is None:
         raise RuntimeError("no active training session")
     return s.context
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's split of `JaxTrainer(datasets={name: ds})` — a
+    DataIterator when the dataset supports streaming_split (reference
+    `session.get_dataset_shard`)."""
+    s = get_session()
+    if s is None:
+        raise RuntimeError(
+            "train.get_dataset_shard() called outside a training session")
+    return s.dataset_shards.get(name)

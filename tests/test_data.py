@@ -1,0 +1,163 @@
+"""ray_tpu.data — Dataset transforms, streaming execution, train ingestion.
+
+Reference model: `python/ray/data/tests/test_basic.py` +
+`test_streaming_integration.py` (streaming_split).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestLocalExecution:
+    """Dataset works without a cluster (inline executor)."""
+
+    def test_range_count_take(self):
+        ds = rdata.range(100)
+        assert ds.count() == 100
+        assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
+
+    def test_from_items_roundtrip(self):
+        ds = rdata.from_items([{"x": i, "y": str(i)} for i in range(10)])
+        rows = ds.take_all()
+        assert len(rows) == 10
+        assert rows[3] == {"x": 3, "y": "3"}
+
+    def test_map_batches_numpy(self):
+        ds = rdata.range(32).map_batches(lambda b: {"id": b["id"] * 2})
+        assert [r["id"] for r in ds.take(4)] == [0, 2, 4, 6]
+
+    def test_map_filter_flat_map_fusion(self):
+        ds = (rdata.range(20)
+              .map(lambda r: {"v": r["id"] + 1})
+              .filter(lambda r: r["v"] % 2 == 0)
+              .flat_map(lambda r: [{"v": r["v"]}, {"v": -r["v"]}]))
+        vals = [r["v"] for r in ds.take_all()]
+        assert vals[:4] == [2, -2, 4, -4]
+        assert len(vals) == 20
+
+    def test_limit_short_circuits(self):
+        ds = rdata.range(1_000_000, override_num_blocks=100)
+        t0 = time.monotonic()
+        assert len(ds.take(10)) == 10
+        assert time.monotonic() - t0 < 10
+
+    def test_repartition_and_split(self):
+        parts = rdata.range(100).split(4, equal=True)
+        sizes = [p.count() for p in parts]
+        assert sum(sizes) == 100
+        assert max(sizes) - min(sizes) <= 1
+
+    def test_random_shuffle_preserves_rows(self):
+        ds = rdata.range(50).random_shuffle(seed=7)
+        vals = sorted(r["id"] for r in ds.take_all())
+        assert vals == list(range(50))
+        assert [r["id"] for r in ds.take_all()] != list(range(50))
+
+    def test_iter_batches_sizes(self):
+        ds = rdata.range(103)
+        batches = list(ds.iter_batches(batch_size=25))
+        assert [len(b["id"]) for b in batches] == [25, 25, 25, 25, 3]
+        batches = list(ds.iter_batches(batch_size=25, drop_last=True))
+        assert [len(b["id"]) for b in batches] == [25, 25, 25, 25]
+
+    def test_iter_batches_formats(self):
+        ds = rdata.from_items([{"a": 1, "b": 2.5}])
+        (npb,) = ds.iter_batches(batch_size=None, batch_format="numpy")
+        assert npb["a"][0] == 1
+        (pdb,) = ds.iter_batches(batch_size=None, batch_format="pandas")
+        assert pdb["b"][0] == 2.5
+
+    def test_tensor_columns(self):
+        arr = np.arange(24, dtype=np.float32).reshape(6, 4)
+        ds = rdata.from_numpy(arr, column="x")
+        (b,) = ds.iter_batches(batch_size=6)
+        np.testing.assert_array_equal(b["x"], arr)
+
+    def test_sum_and_schema(self):
+        ds = rdata.range(10)
+        assert ds.sum("id") == 45
+        assert ds.columns() == ["id"]
+
+
+class TestFileIO:
+    def test_parquet_roundtrip(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        for i in range(3):
+            pq.write_table(pa.table({"v": list(range(i * 10, i * 10 + 10))}),
+                           tmp_path / f"part-{i}.parquet")
+        ds = rdata.read_parquet(tmp_path)
+        assert ds.count() == 30
+        assert sorted(r["v"] for r in ds.take_all()) == list(range(30))
+
+    def test_text_and_binary(self, tmp_path):
+        p = tmp_path / "f.txt"
+        p.write_text("alpha\nbeta\n")
+        assert [r["text"] for r in rdata.read_text(p).take_all()] == [
+            "alpha", "beta"]
+        rows = rdata.read_binary_files(p).take_all()
+        assert rows[0]["bytes"] == b"alpha\nbeta\n"
+
+    def test_csv(self, tmp_path):
+        p = tmp_path / "f.csv"
+        p.write_text("a,b\n1,x\n2,y\n")
+        rows = rdata.read_csv(p).take_all()
+        assert rows == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+
+
+class TestDistributedExecution:
+    def test_map_batches_runs_as_tasks(self, ray_cluster):
+        ds = rdata.range(64, override_num_blocks=8).map_batches(
+            lambda b: {"id": b["id"], "pid": np.full(len(b["id"]),
+                                                     os.getpid())})
+        rows = ds.take_all()
+        assert len(rows) == 64
+        # Work actually ran in worker processes, not the driver.
+        assert all(r["pid"] != os.getpid() for r in rows)
+
+    def test_streaming_split_disjoint_and_complete(self, ray_cluster):
+        ds = rdata.range(80, override_num_blocks=8)
+        it_a, it_b = ds.streaming_split(2)
+        got = {}
+
+        def consume(name, it):
+            vals = []
+            for b in it.iter_batches(batch_size=None):
+                vals.extend(int(x) for x in b["id"])
+            got[name] = vals
+
+        ta = threading.Thread(target=consume, args=("a", it_a))
+        tb = threading.Thread(target=consume, args=("b", it_b))
+        ta.start(); tb.start(); ta.join(120); tb.join(120)
+        assert sorted(got["a"] + got["b"]) == list(range(80))
+        assert got["a"] and got["b"]  # both consumers actually got data
+
+    def test_streaming_split_multiple_epochs(self, ray_cluster):
+        ds = rdata.range(20, override_num_blocks=2)
+        (it,) = ds.streaming_split(1)
+        for _ in range(2):  # two full passes through the same iterator
+            vals = []
+            for b in it.iter_batches(batch_size=None):
+                vals.extend(int(x) for x in b["id"])
+            assert sorted(vals) == list(range(20))
+
+    def test_materialize_uses_object_store(self, ray_cluster):
+        ds = rdata.range(32).map_batches(lambda b: {"id": b["id"] + 1})
+        mat = ds.materialize()
+        assert mat.count() == 32
+        assert sorted(r["id"] for r in mat.take_all()) == list(range(1, 33))

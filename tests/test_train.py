@@ -205,3 +205,59 @@ class TestJaxTrainer:
         )
         with pytest.raises(TrainingFailedError):
             trainer.fit()
+
+
+def ingestion_train_loop(config):
+    """Consumes a streaming_split Data shard (Train<->Data ingestion,
+    reference `train/_internal/data_config.py`)."""
+    import numpy as np
+
+    from ray_tpu import train
+
+    it = train.get_dataset_shard("train")
+    assert it is not None, "dataset shard missing"
+    w = np.zeros(4, np.float32)
+    for epoch in range(config.get("epochs", 2)):
+        n_rows = 0
+        loss_sum = 0.0
+        for batch in it.iter_batches(batch_size=16):
+            x = np.stack(batch["x"]).astype(np.float32)
+            y = np.asarray(batch["y"], np.float32)
+            pred = x @ w
+            err = pred - y
+            loss_sum += float((err ** 2).sum())
+            n_rows += len(y)
+            w -= 0.05 * (x.T @ err) / max(len(y), 1)  # SGD on the shard
+        train.report({"loss": loss_sum / max(n_rows, 1), "rows": n_rows,
+                      "epoch": epoch})
+
+
+class TestTrainDataIngestion:
+    def test_streaming_split_feeds_two_workers(self, train_cluster, tmp_path):
+        from ray_tpu import data as rdata
+
+        rng = np.random.RandomState(7)
+        xs = rng.randn(256, 4).astype(np.float32)
+        w_true = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+        ys = xs @ w_true
+        ds = rdata.from_items(
+            [{"x": xs[i], "y": float(ys[i])} for i in range(256)],
+            override_num_blocks=8,
+        ).map_batches(lambda b: b)  # exercise a fused transform stage
+
+        trainer = JaxTrainer(
+            ingestion_train_loop,
+            train_loop_config={"epochs": 2},
+            datasets={"train": ds},
+            scaling_config=ScalingConfig(num_workers=2),
+            jax_config=JaxConfig(platform="cpu", num_cpu_devices=1),
+            run_config=RunConfig(name="ingest", storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        history = result.metrics_dataframe
+        # Both epochs ran and the split streamed every row exactly once
+        # per epoch across the two workers (rank-0 metrics are recorded;
+        # totals are per-worker so just check rows > 0 and loss decreased).
+        assert result.metrics["epoch"] == 1
+        assert all(m["rows"] > 0 for m in history)
+        assert history[-1]["loss"] < history[0]["loss"]
