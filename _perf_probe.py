@@ -1,0 +1,59 @@
+"""Throwaway perf probe (not part of the package)."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models.llama import LlamaConfig, flops_per_token, init_params, loss_fn
+from ray_tpu.parallel import (
+    batch_sharding, build_train_step, create_train_state,
+    llama_param_shardings, make_mesh, shard_params,
+)
+
+PEAK = 197e12
+
+
+def timeit(tag, config, batch, seq, iters=10, loss=loss_fn):
+    mesh = make_mesh({"data": -1})
+    params = init_params(config, jax.random.key(0))
+    sh = llama_param_shardings(config, mesh)
+    bsh = batch_sharding(mesh)
+    optimizer = optax.adamw(1e-4)
+    state = create_train_state(shard_params(params, sh), optimizer)
+    step = build_train_step(lambda p, b: loss(p, b, config), optimizer,
+                            mesh, sh, bsh)
+    rng = np.random.RandomState(0)
+    b = {"tokens": jax.device_put(
+        rng.randint(0, config.vocab_size, (batch, seq)).astype("int32"), bsh)}
+    state, metrics = step(state, b)
+    float(metrics["loss"])  # sync
+    t0 = time.perf_counter(); float(metrics["loss"]); rt = time.perf_counter() - t0
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, b)
+    float(metrics["loss"])
+    el = max(time.perf_counter() - start - rt, 1e-9)
+    step_ms = el / iters * 1000
+    toks = batch * (seq - 1) * iters / el
+    mfu = toks * flops_per_token(config, seq) / PEAK
+    print(f"{tag:40s} step={step_ms:8.1f}ms tok/s={toks:9.0f} mfu={mfu:.3f}",
+          flush=True)
+    return step_ms
+
+
+base = dict(vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+            n_kv_heads=16, hidden_dim=2816, max_seq_len=1024)
+
+which = sys.argv[1] if len(sys.argv) > 1 else "all"
+
+if which in ("all", "a"):
+    timeit("flash b8 (round1 bench)", LlamaConfig(**base, attn_impl="flash"), 8, 1024)
+if which in ("all", "b"):
+    timeit("xla   b8", LlamaConfig(**base, attn_impl="xla"), 8, 1024)
+if which in ("all", "c"):
+    timeit("xla   b32 remat", LlamaConfig(**base, attn_impl="xla", remat=True), 32, 1024)
+if which in ("all", "d"):
+    timeit("flash b32 remat", LlamaConfig(**base, attn_impl="flash", remat=True), 32, 1024)

@@ -1,0 +1,80 @@
+"""Component-level probe: dispatch overhead, matmul ceiling, attention."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK = 197e12
+
+
+def chain_time(tag, fn, args, iters=20, flops=None):
+    """Time `iters` chained invocations (out feeds in)."""
+    out = fn(*args)
+    jax.tree.map(lambda x: x.block_until_ready(), out)
+    # sync via scalar readback
+    first = jax.tree.leaves(out)[0]
+    float(jnp.sum(first))
+    t0 = time.perf_counter(); float(jnp.sum(first)); rt = time.perf_counter() - t0
+    start = time.perf_counter()
+    o = args[0]
+    rest = args[1:]
+    for _ in range(iters):
+        o = fn(o, *rest)
+        if isinstance(o, tuple):
+            o = o[0]
+    float(jnp.sum(jax.tree.leaves(o)[0]))
+    el = max(time.perf_counter() - start - rt, 1e-9)
+    ms = el / iters * 1000
+    line = f"{tag:36s} {ms:8.2f} ms/iter  (roundtrip {rt*1000:.0f}ms)"
+    if flops:
+        line += f"  mfu={flops / (el / iters) / PEAK:.3f}"
+    print(line, flush=True)
+
+
+which = sys.argv[1] if len(sys.argv) > 1 else "all"
+
+if which in ("all", "disp"):
+    @jax.jit
+    def triv(x):
+        return x + 1.0
+    chain_time("trivial step (dispatch overhead)", triv, (jnp.zeros(()),), 50)
+
+if which in ("all", "mm"):
+    N = 4096
+    a = jax.random.normal(jax.random.key(0), (N, N), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (N, N), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        # 8 chained matmuls to amortize dispatch
+        for _ in range(8):
+            a = (a @ b) * (1.0 / N)
+        return a
+    chain_time("bf16 4096^3 matmul x8", mm, (a, b), 20, flops=8 * 2 * N**3)
+
+if which in ("all", "attn"):
+    from ray_tpu.ops.attention import flash_attention
+    from ray_tpu.models.llama import xla_attention
+
+    B, S, H, D = 8, 1024, 16, 64
+    q = jax.random.normal(jax.random.key(0), (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, S, H, D), jnp.bfloat16)
+    # causal ~ half the FLOPs of full
+    attn_flops = 2 * 2 * B * H * S * S * D  # qk + pv, full (causal halves)
+
+    def mk(f):
+        @jax.jit
+        def fwd_bwd(q, k, v):
+            def loss(q):
+                return jnp.sum(f(q, k, v, True).astype(jnp.float32))
+            l, g = jax.value_and_grad(loss)(q)
+            return g, l
+        return fwd_bwd
+
+    chain_time("flash fwd+bwd B8 S1024 H16 D64", mk(flash_attention), (q, k, v), 10,
+               flops=3 * attn_flops / 2)
+    chain_time("xla   fwd+bwd B8 S1024 H16 D64", mk(lambda q, k, v, c: xla_attention(q, k, v, causal=c)), (q, k, v), 10,
+               flops=3 * attn_flops / 2)

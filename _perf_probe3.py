@@ -1,0 +1,86 @@
+"""Probe round 2: fixed bf16 bwd kernels + remat/batch sweep."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK = 197e12
+
+
+def attn_probe():
+    from ray_tpu.ops.attention import flash_attention
+
+    B, S, H, D = 8, 1024, 16, 64
+    q = jax.random.normal(jax.random.key(0), (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, S, H, D), jnp.bfloat16)
+    attn_flops = 4 * B * H * S * S * D / 2 * 3  # causal fwd+bwd~3x
+
+    @jax.jit
+    def fwd_bwd(q, k, v):
+        def loss(q):
+            return jnp.sum(flash_attention(q, k, v, True).astype(jnp.float32))
+        l, g = jax.value_and_grad(loss)(q)
+        return g
+
+    g = fwd_bwd(q, k, v); float(jnp.sum(g))
+    t0 = time.perf_counter(); float(jnp.sum(g)); rt = time.perf_counter() - t0
+    iters = 30
+    start = time.perf_counter()
+    x = q
+    for _ in range(iters):
+        x = fwd_bwd(x, k, v).astype(jnp.bfloat16)
+    float(jnp.sum(x))
+    el = max(time.perf_counter() - start - rt, 1e-9)
+    ms = el / iters * 1000
+    print(f"flash fwd+bwd bf16-dots: {ms:.2f} ms  mfu={attn_flops/(el/iters)/PEAK:.3f}",
+          flush=True)
+
+
+def model_probe(tag, batch, remat, seq=1024, iters=15, attn="flash"):
+    import optax
+    from ray_tpu.models.llama import LlamaConfig, flops_per_token, init_params, loss_fn
+    from ray_tpu.parallel import (
+        batch_sharding, build_train_step, create_train_state,
+        llama_param_shardings, make_mesh, shard_params,
+    )
+    config = LlamaConfig(
+        vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+        n_kv_heads=16, hidden_dim=2816, max_seq_len=seq,
+        attn_impl=attn, remat=remat)
+    mesh = make_mesh({"data": -1})
+    params = init_params(config, jax.random.key(0))
+    sh = llama_param_shardings(config, mesh)
+    bsh = batch_sharding(mesh)
+    optimizer = optax.adamw(1e-4)
+    state = create_train_state(shard_params(params, sh), optimizer)
+    step = build_train_step(lambda p, b: loss_fn(p, b, config), optimizer,
+                            mesh, sh, bsh)
+    rng = np.random.RandomState(0)
+    b = {"tokens": jax.device_put(
+        rng.randint(0, config.vocab_size, (batch, seq)).astype("int32"), bsh)}
+    state, metrics = step(state, b)
+    float(metrics["loss"])
+    t0 = time.perf_counter(); float(metrics["loss"]); rt = time.perf_counter() - t0
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, b)
+    float(metrics["loss"])
+    el = max(time.perf_counter() - start - rt, 1e-9)
+    toks = batch * (seq - 1) * iters / el
+    mfu = toks * flops_per_token(config, seq) / PEAK
+    print(f"{tag:30s} step={el/iters*1000:7.1f}ms tok/s={toks:9.0f} mfu={mfu:.3f}",
+          flush=True)
+
+
+which = sys.argv[1] if len(sys.argv) > 1 else "all"
+if which in ("all", "attn"):
+    attn_probe()
+if which in ("all", "m8"):
+    model_probe("flash b8", 8, False)
+if which in ("all", "m16r"):
+    model_probe("flash b16 remat", 16, True)
+if which in ("all", "m32r"):
+    model_probe("flash b32 remat", 32, True)

@@ -1,0 +1,89 @@
+"""Bisect the train step: fwd only vs fwd+bwd vs full step."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models.llama import LlamaConfig, flops_per_token, init_params, loss_fn, forward
+from ray_tpu.parallel import (
+    batch_sharding, build_train_step, create_train_state,
+    llama_param_shardings, make_mesh, shard_params,
+)
+
+PEAK = 197e12
+B, S = 8, 1024
+config = LlamaConfig(
+    vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+    n_kv_heads=16, hidden_dim=2816, max_seq_len=S, attn_impl="flash")
+
+mesh = make_mesh({"data": -1})
+params = init_params(config, jax.random.key(0))
+sh = llama_param_shardings(config, mesh)
+bsh = batch_sharding(mesh)
+params = shard_params(params, sh)
+rng = np.random.RandomState(0)
+tokens = jax.device_put(
+    rng.randint(0, config.vocab_size, (B, S)).astype("int32"), bsh)
+batch = {"tokens": tokens}
+
+fwd_flops = 2 * config.num_params() * B * (S - 1)
+step_flops = flops_per_token(config, S) * B * (S - 1)
+
+
+def timeloop(tag, fn, args, iters, flops):
+    out = fn(*args)
+    lv = jax.tree.leaves(out)[0]
+    float(jnp.sum(lv))
+    t0 = time.perf_counter(); float(jnp.sum(lv)); rt = time.perf_counter() - t0
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(jnp.sum(jax.tree.leaves(out)[0]))
+    el = max(time.perf_counter() - start - rt, 1e-9)
+    print(f"{tag:34s} {el/iters*1000:8.1f} ms  eff-mfu={flops/(el/iters)/PEAK:.3f}",
+          flush=True)
+
+
+which = sys.argv[1] if len(sys.argv) > 1 else "all"
+
+if which in ("all", "fwd"):
+    f = jax.jit(lambda p, b: loss_fn(p, b, config))
+    timeloop("fwd loss", f, (params, batch), 20, fwd_flops)
+
+if which in ("all", "fwdnl"):
+    # forward WITHOUT the lm_head/loss stage: logits replaced by x.sum()
+    cfg2 = config
+    def fwd_body(p, t):
+        x = forward(p, t, cfg2)
+        return jnp.sum(x)
+    timeloop("fwd incl head (sum)", jax.jit(fwd_body), (params, tokens), 20, fwd_flops)
+
+if which in ("all", "grad"):
+    g = jax.jit(lambda p, b: jax.value_and_grad(lambda pp: loss_fn(pp, b, config))(p)[1])
+    timeloop("fwd+bwd grads", g, (params, batch), 10, 3 * fwd_flops)
+
+if which in ("all", "embed"):
+    # embedding gather+scatter alone
+    def emb_loss(p, t):
+        x = p["embed"].astype(jnp.bfloat16)[t]
+        return jnp.sum(x.astype(jnp.float32))
+    g = jax.jit(jax.grad(emb_loss))
+    timeloop("embed gather+scatter bwd", g, (params, tokens), 20, 1e9)
+
+if which in ("all", "head"):
+    # lm_head + loss alone on a fixed activation
+    x = jax.random.normal(jax.random.key(3), (B, S - 1, 1024), jnp.bfloat16)
+    tgt = tokens[:, 1:]
+    def head_loss(p, x, tgt):
+        logits = jax.lax.dot_general(
+            x, p["lm_head"].astype(jnp.bfloat16), (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        t = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - t)
+    g = jax.jit(jax.grad(head_loss, argnums=(0,)))
+    head_flops = 3 * 2 * B * (S - 1) * 1024 * 32000
+    timeloop("lm_head+xent fwd+bwd", g, (params, x, tgt), 10, head_flops)

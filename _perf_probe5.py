@@ -1,0 +1,74 @@
+"""Is it adamw, or the chained-vs-independent measurement?"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models.llama import LlamaConfig, flops_per_token, init_params, loss_fn
+from ray_tpu.parallel import (
+    batch_sharding, build_train_step, create_train_state,
+    llama_param_shardings, make_mesh, shard_params,
+)
+
+PEAK = 197e12
+B, S = 8, 1024
+config = LlamaConfig(
+    vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+    n_kv_heads=16, hidden_dim=2816, max_seq_len=S, attn_impl="flash")
+mesh = make_mesh({"data": -1})
+
+
+def fresh_params():
+    return shard_params(init_params(config, jax.random.key(0)),
+                        llama_param_shardings(config, mesh))
+
+
+params = None
+bsh = batch_sharding(mesh)
+rng = np.random.RandomState(0)
+batch = {"tokens": jax.device_put(
+    rng.randint(0, config.vocab_size, (B, S)).astype("int32"), bsh)}
+step_flops = flops_per_token(config, S) * B * (S - 1)
+
+
+def run(tag, optimizer, iters=15):
+    state = create_train_state(fresh_params(), optimizer)
+    step = build_train_step(lambda p, b: loss_fn(p, b, config), optimizer,
+                            mesh, llama_param_shardings(config, mesh), bsh)
+    state, m = step(state, batch)
+    float(m["loss"])
+    t0 = time.perf_counter(); float(m["loss"]); rt = time.perf_counter() - t0
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, batch)
+    float(m["loss"])
+    el = max(time.perf_counter() - start - rt, 1e-9)
+    print(f"{tag:26s} step={el/iters*1000:8.1f}ms mfu={step_flops/(el/iters)/PEAK:.3f}",
+          flush=True)
+
+
+which = sys.argv[1] if len(sys.argv) > 1 else "all"
+if which in ("all", "sgd"):
+    run("sgd", optax.sgd(0.0))
+if which in ("all", "adamw"):
+    run("adamw", optax.adamw(1e-4))
+if which in ("all", "chaingrad"):
+    # grads chained through params, no optimizer state at all
+    @jax.jit
+    def gstep(p, b):
+        l, g = jax.value_and_grad(lambda pp: loss_fn(pp, b, config))(p)
+        newp = jax.tree.map(lambda a, b_: a - 0.0 * b_, p, g)
+        return newp, l
+    p = fresh_params()
+    p, l = gstep(p, batch); float(l)
+    t0 = time.perf_counter(); float(l); rt = time.perf_counter() - t0
+    start = time.perf_counter()
+    for _ in range(15):
+        p, l = gstep(p, batch)
+    float(l)
+    el = max(time.perf_counter() - start - rt, 1e-9)
+    print(f"{'chained grads+0update':26s} step={el/15*1000:8.1f}ms mfu={step_flops/(el/15)/PEAK:.3f}",
+          flush=True)

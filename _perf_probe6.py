@@ -1,0 +1,79 @@
+"""Bisect build_train_step jit options."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models.llama import LlamaConfig, flops_per_token, init_params, loss_fn
+from ray_tpu.parallel import (
+    batch_sharding, create_train_state, llama_param_shardings, make_mesh,
+    shard_params,
+)
+from ray_tpu.parallel.train_step import TrainState
+
+PEAK = 197e12
+B, S = 8, 1024
+config = LlamaConfig(
+    vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+    n_kv_heads=16, hidden_dim=2816, max_seq_len=S, attn_impl="flash")
+mesh = make_mesh({"data": -1})
+bsh = batch_sharding(mesh)
+rng = np.random.RandomState(0)
+batch = {"tokens": jax.device_put(
+    rng.randint(0, config.vocab_size, (B, S)).astype("int32"), bsh)}
+step_flops = flops_per_token(config, S) * B * (S - 1)
+optimizer = optax.adamw(1e-4)
+
+
+def build(with_donate, with_insh, with_gnorm):
+    def step_fn(state, b):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, b, config))(state.params)
+        metrics = {"loss": loss, "step": state.step + 1}
+        if with_gnorm:
+            metrics["grad_norm"] = optax.global_norm(grads)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(new_params, new_opt, state.step + 1), metrics
+
+    kw = {}
+    if with_insh:
+        kw["in_shardings"] = (None, bsh)
+    if with_donate:
+        kw["donate_argnums"] = (0,)
+    return jax.jit(step_fn, **kw)
+
+
+def run(tag, **kws):
+    step = build(**kws)
+    state = create_train_state(
+        shard_params(init_params(config, jax.random.key(0)),
+                     llama_param_shardings(config, mesh)), optimizer)
+    state, m = step(state, batch)
+    float(m["loss"])
+    t0 = time.perf_counter(); float(m["loss"]); rt = time.perf_counter() - t0
+    iters = 10
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, batch)
+    float(m["loss"])
+    el = max(time.perf_counter() - start - rt, 1e-9)
+    print(f"{tag:34s} step={el/iters*1000:8.1f}ms mfu={step_flops/(el/iters)/PEAK:.3f}",
+          flush=True)
+
+
+which = sys.argv[1]
+if which == "full":
+    run("donate+insh+gnorm", with_donate=True, with_insh=True, with_gnorm=True)
+elif which == "nodonate":
+    run("insh+gnorm (no donate)", with_donate=False, with_insh=True, with_gnorm=True)
+elif which == "noinsh":
+    run("donate+gnorm (no insh)", with_donate=True, with_insh=False, with_gnorm=True)
+elif which == "nognorm":
+    run("donate+insh (no gnorm)", with_donate=True, with_insh=True, with_gnorm=False)
+elif which == "none":
+    run("plain jit", with_donate=False, with_insh=False, with_gnorm=False)

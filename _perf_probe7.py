@@ -1,0 +1,75 @@
+"""Multi-step-in-jit probe: device-limited throughput per config."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ray_tpu.models.llama import LlamaConfig, flops_per_token, init_params, loss_fn
+from ray_tpu.parallel import (
+    batch_sharding, create_train_state, llama_param_shardings, make_mesh,
+    shard_params,
+)
+from ray_tpu.parallel.train_step import TrainState
+
+PEAK = 197e12
+S = 1024
+K = 8  # steps per jit call
+
+
+def run(tag, batch, remat, attn="flash", iters=3):
+    config = LlamaConfig(
+        vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+        n_kv_heads=16, hidden_dim=2816, max_seq_len=S,
+        attn_impl=attn, remat=remat)
+    mesh = make_mesh({"data": -1})
+    bsh = batch_sharding(mesh)
+    opt = optax.adamw(1e-4)
+    state = create_train_state(
+        shard_params(init_params(config, jax.random.key(0)),
+                     llama_param_shardings(config, mesh)), opt)
+
+    def one(st, toks):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, {"tokens": toks}, config))(st.params)
+        updates, new_opt = opt.update(grads, st.opt_state, st.params)
+        return TrainState(optax.apply_updates(st.params, updates), new_opt,
+                          st.step + 1), loss
+
+    @jax.jit
+    def multi(st, toks_k):                       # [K, B, S]
+        return lax.scan(one, st, toks_k)
+
+    rng = np.random.RandomState(0)
+    toks = jax.device_put(
+        rng.randint(0, config.vocab_size, (K, batch, S)).astype("int32"),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    state, losses = multi(state, toks)
+    float(losses[-1])
+    t0 = time.perf_counter(); float(losses[-1]); rt = time.perf_counter() - t0
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, losses = multi(state, toks)
+    float(losses[-1])
+    el = max(time.perf_counter() - start - rt, 1e-9)
+    per_step = el / (iters * K)
+    toks_s = batch * (S - 1) / per_step
+    mfu = toks_s * flops_per_token(config, S) / PEAK
+    print(f"{tag:26s} step={per_step*1000:7.1f}ms tok/s={toks_s:9.0f} mfu={mfu:.3f}",
+          flush=True)
+
+
+which = sys.argv[1]
+if which == "b8":
+    run("flash b8", 8, False)
+elif which == "b16r":
+    run("flash b16 remat", 16, True)
+elif which == "b32r":
+    run("flash b32 remat", 32, True)
+elif which == "b16":
+    run("flash b16 no-remat", 16, False)
+elif which == "xb16r":
+    run("xla b16 remat", 16, True)

@@ -1,0 +1,71 @@
+"""Probe a wide (dim-4096, head-dim-128) ~1B model for bench viability."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ray_tpu.models.llama import LlamaConfig, flops_per_token, init_params, loss_fn
+from ray_tpu.parallel import (
+    batch_sharding, create_train_state, llama_param_shardings, make_mesh,
+    shard_params,
+)
+from ray_tpu.parallel.train_step import TrainState
+
+PEAK = 197e12
+S = 1024
+K = 4
+
+
+def run(tag, batch, remat, layers=4, iters=3, attn="flash"):
+    config = LlamaConfig(
+        vocab_size=32000, dim=4096, n_layers=layers, n_heads=32,
+        n_kv_heads=8, hidden_dim=11008, max_seq_len=S,
+        attn_impl=attn, remat=remat,
+        param_dtype=jnp.bfloat16)
+    mesh = make_mesh({"data": -1})
+    opt = optax.adamw(1e-4)
+    state = create_train_state(
+        shard_params(init_params(config, jax.random.key(0)),
+                     llama_param_shardings(config, mesh)), opt)
+
+    def one(st, toks):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, {"tokens": toks}, config))(st.params)
+        updates, new_opt = opt.update(grads, st.opt_state, st.params)
+        return TrainState(optax.apply_updates(st.params, updates), new_opt,
+                          st.step + 1), loss
+
+    @jax.jit
+    def multi(st, toks_k):
+        return lax.scan(one, st, toks_k)
+
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 32000, (K, batch, S)).astype("int32"))
+    state, losses = multi(state, toks)
+    float(losses[-1])
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, losses = multi(state, toks)
+    float(losses[-1])
+    el = time.perf_counter() - start
+    per_step = el / (iters * K)
+    toks_s = batch * (S - 1) / per_step
+    mfu = toks_s * flops_per_token(config, S) / PEAK
+    print(f"{tag:28s} params={config.num_params()/1e9:.2f}B "
+          f"step={per_step*1000:7.1f}ms tok/s={toks_s:9.0f} mfu={mfu:.3f}",
+          flush=True)
+
+
+which = sys.argv[1]
+if which == "b8":
+    run("1B b8 remat", 8, True)
+elif which == "b8nr":
+    run("1B b8 no-remat", 8, False)
+elif which == "b16":
+    run("1B b16 remat", 16, True)
+elif which == "xla8":
+    run("1B b8 remat xla-attn", 8, True, attn="xla")

@@ -1,0 +1,77 @@
+"""Isolate embed/lm_head backward cost on the 1B model."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ray_tpu.models.llama import LlamaConfig, flops_per_token, forward, init_params
+from ray_tpu.parallel import (
+    batch_sharding, create_train_state, llama_param_shardings, make_mesh,
+    shard_params,
+)
+from ray_tpu.parallel.train_step import TrainState
+
+PEAK = 197e12
+S = 1024
+K = 4
+B = 8
+
+config = LlamaConfig(
+    vocab_size=32000, dim=4096, n_layers=4, n_heads=32,
+    n_kv_heads=8, hidden_dim=11008, max_seq_len=S,
+    attn_impl="flash", remat=True, param_dtype=jnp.bfloat16)
+
+
+def loss_variant(params, toks, mode):
+    if mode == "sg_embed":
+        params = dict(params, embed=lax.stop_gradient(params["embed"]))
+    if mode == "sg_both":
+        params = dict(params, embed=lax.stop_gradient(params["embed"]),
+                      lm_head=lax.stop_gradient(params["lm_head"]))
+    logits = forward(params, toks[:, :-1], config)
+    targets = toks[:, 1:]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return (lse - tgt).mean()
+
+
+def run(tag, mode, iters=3):
+    mesh = make_mesh({"data": -1})
+    opt = optax.adamw(1e-4)
+    state = create_train_state(
+        shard_params(init_params(config, jax.random.key(0)),
+                     llama_param_shardings(config, mesh)), opt)
+
+    def one(st, toks):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_variant(p, toks, mode))(st.params)
+        updates, new_opt = opt.update(grads, st.opt_state, st.params)
+        return TrainState(optax.apply_updates(st.params, updates), new_opt,
+                          st.step + 1), loss
+
+    @jax.jit
+    def multi(st, toks_k):
+        return lax.scan(one, st, toks_k)
+
+    multi_d = jax.jit(multi, donate_argnums=(0,))
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 32000, (K, B, S)).astype("int32"))
+    state, losses = multi_d(state, toks)
+    float(losses[-1])
+    start = time.perf_counter()
+    for _ in range(iters):
+        state, losses = multi_d(state, toks)
+    float(losses[-1])
+    per_step = (time.perf_counter() - start) / (iters * K)
+    toks_s = B * (S - 1) / per_step
+    mfu = toks_s * flops_per_token(config, S) / PEAK
+    print(f"{tag:22s} step={per_step*1000:7.1f}ms mfu={mfu:.3f}", flush=True)
+
+
+run({"base": "1B base", "sge": "1B sg(embed)",
+     "sgb": "1B sg(embed+head)"}[sys.argv[1]],
+    {"base": "base", "sge": "sg_embed", "sgb": "sg_both"}[sys.argv[1]])

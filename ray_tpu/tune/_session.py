@@ -1,0 +1,88 @@
+"""Tune trial session: tune.report plumbing inside the trial actor.
+
+Mirrors the train session's queue model (reference
+`tune/trainable/function_trainable.py`: the user function runs in a thread;
+reports flow through a bounded queue back to the controller's poll loop).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+FINISHED = "__finished__"
+ERRORED = "__errored__"
+REPORT = "__report__"
+
+_session: Optional["_TuneSession"] = None
+
+
+class _TuneSession:
+    def __init__(self, fn: Callable, config: Dict[str, Any],
+                 trial_dir: str, checkpoint=None):
+        self._fn = fn
+        self._config = config
+        self.trial_dir = trial_dir
+        self.latest_checkpoint = checkpoint
+        self._queue: "queue.Queue" = queue.Queue(maxsize=8)
+        self._counter = 0
+        self._stop = threading.Event()
+
+    def start(self):
+        def _run():
+            global _session
+            _session = self
+            try:
+                self._fn(self._config)
+                self._queue.put((FINISHED, None, None))
+            except _StopTrial:
+                self._queue.put((FINISHED, None, None))
+            except BaseException as e:  # noqa: BLE001
+                self._queue.put((ERRORED,
+                                 f"{type(e).__name__}: {e}\n"
+                                 f"{traceback.format_exc()}", None))
+
+        threading.Thread(target=_run, daemon=True, name="tune-trial").start()
+
+    def report(self, metrics: Dict[str, Any], checkpoint=None):
+        if self._stop.is_set():
+            raise _StopTrial()
+        ckpt_path = None
+        if checkpoint is not None:
+            persisted = checkpoint.persist(
+                self.trial_dir, name=f"checkpoint_{self._counter:06d}")
+            self.latest_checkpoint = persisted
+            ckpt_path = persisted.path
+        self._counter += 1
+        self._queue.put((REPORT, metrics, ckpt_path))
+
+    def next_result(self, timeout: Optional[float] = None):
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def request_stop(self):
+        self._stop.set()
+
+
+class _StopTrial(BaseException):
+    """Raised inside the user fn at the next report() after a STOP."""
+
+
+def get_session() -> Optional[_TuneSession]:
+    return _session
+
+
+def report(metrics: Dict[str, Any], checkpoint=None) -> None:
+    s = get_session()
+    if s is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint():
+    s = get_session()
+    return s.latest_checkpoint if s else None

@@ -1,0 +1,210 @@
+"""TuneController — the trial execution engine.
+
+Reference: `python/ray/tune/execution/tune_controller.py:72` — owns the
+trial list, launches trial actors up to the concurrency cap, consumes
+results, applies scheduler decisions, snapshots experiment state for
+restore, and surfaces each trial's Result.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import Result
+from ray_tpu.tune import _session as tsession
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERRORED = "ERRORED"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+    stopped_early: bool = False
+
+    @property
+    def last_result(self) -> Dict[str, Any]:
+        return self.history[-1] if self.history else {}
+
+
+@ray_tpu.remote(num_cpus=1)
+class _TrialActor:
+    """Runs one function-trainable trial."""
+
+    def run(self, fn: Callable, config: Dict[str, Any], trial_dir: str,
+            checkpoint_path: Optional[str]) -> bool:
+        os.makedirs(trial_dir, exist_ok=True)
+        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        self._session = tsession._TuneSession(fn, config, trial_dir, ckpt)
+        self._session.start()
+        return True
+
+    def next_result(self):
+        return self._session.next_result(timeout=600.0)
+
+    def request_stop(self) -> bool:
+        self._session.request_stop()
+        return True
+
+
+class TuneController:
+    def __init__(self, trainable: Callable, trials: List[Trial],
+                 experiment_dir: str, metric: Optional[str] = None,
+                 mode: str = "max", scheduler=None,
+                 max_concurrent: int = 4,
+                 trial_resources: Optional[Dict[str, float]] = None):
+        self._trainable = trainable
+        self.trials = trials
+        self._dir = experiment_dir
+        self._metric = metric
+        self._mode = mode
+        self._scheduler = scheduler or FIFOScheduler()
+        self._max_concurrent = max(1, max_concurrent)
+        self._resources = trial_resources or {"CPU": 1}
+        os.makedirs(experiment_dir, exist_ok=True)
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> List[Trial]:
+        pending = [t for t in self.trials if t.status == PENDING]
+        running: Dict[str, Any] = {}   # trial_id -> (actor, in-flight ref)
+        trial_by_id = {t.trial_id: t for t in self.trials}
+        self._save_experiment_state()
+
+        while pending or running:
+            while pending and len(running) < self._max_concurrent:
+                trial = pending.pop(0)
+                actor = _TrialActor.options(
+                    num_cpus=self._resources.get("CPU", 1)).remote()
+                trial_dir = os.path.join(self._dir, trial.trial_id)
+                ray_tpu.get(actor.run.remote(
+                    self._trainable, trial.config, trial_dir,
+                    trial.checkpoint_path), timeout=300)
+                trial.status = RUNNING
+                running[trial.trial_id] = (actor, actor.next_result.remote())
+
+            if not running:
+                continue
+            refs = [ref for (_, ref) in running.values()]
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=1.0)
+            if not ready:
+                continue
+            ready_ref = ready[0]
+            trial_id = next(tid for tid, (_, ref) in running.items()
+                            if ref == ready_ref)
+            actor, _ = running[trial_id]
+            trial = trial_by_id[trial_id]
+            try:
+                item = ray_tpu.get(ready_ref, timeout=30)
+            except Exception as e:  # actor died
+                trial.status = ERRORED
+                trial.error = f"trial actor died: {e}"
+                running.pop(trial_id)
+                self._save_experiment_state()
+                continue
+
+            if item is None:  # poll timeout inside actor; re-arm
+                running[trial_id] = (actor, actor.next_result.remote())
+                continue
+            kind, payload, ckpt_path = item
+            if kind == tsession.FINISHED:
+                trial.status = TERMINATED
+                running.pop(trial_id)
+                ray_tpu.kill(actor)
+            elif kind == tsession.ERRORED:
+                trial.status = ERRORED
+                trial.error = payload
+                running.pop(trial_id)
+                ray_tpu.kill(actor)
+            else:
+                metrics = dict(payload or {})
+                metrics.setdefault("training_iteration",
+                                   len(trial.history) + 1)
+                trial.history.append(metrics)
+                if ckpt_path:
+                    trial.checkpoint_path = ckpt_path
+                decision = CONTINUE
+                if self._metric and self._metric in metrics:
+                    decision = self._scheduler.on_result(
+                        trial_id, metrics["training_iteration"],
+                        float(metrics[self._metric]))
+                if decision == STOP:
+                    trial.stopped_early = True
+                    trial.status = TERMINATED
+                    try:
+                        ray_tpu.get(actor.request_stop.remote(), timeout=10)
+                    except Exception:
+                        pass
+                    running.pop(trial_id)
+                    ray_tpu.kill(actor)
+                else:
+                    running[trial_id] = (actor, actor.next_result.remote())
+            self._save_experiment_state()
+        return self.trials
+
+    # ---------------------------------------------------------- persistence
+    def _save_experiment_state(self) -> None:
+        state = [{
+            "trial_id": t.trial_id, "status": t.status,
+            "history": t.history, "checkpoint_path": t.checkpoint_path,
+            "error": t.error, "stopped_early": t.stopped_early,
+        } for t in self.trials]
+        tmp = os.path.join(self._dir, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(self._dir, "experiment_state.json"))
+        cfg_path = os.path.join(self._dir, "trial_configs.pkl")
+        if not os.path.exists(cfg_path):
+            with open(cfg_path, "wb") as f:
+                pickle.dump({t.trial_id: t.config for t in self.trials}, f)
+
+    @staticmethod
+    def load_experiment_state(experiment_dir: str) -> List[Trial]:
+        with open(os.path.join(experiment_dir,
+                               "experiment_state.json")) as f:
+            state = json.load(f)
+        with open(os.path.join(experiment_dir, "trial_configs.pkl"),
+                  "rb") as f:
+            configs = pickle.load(f)
+        trials = []
+        for s in state:
+            t = Trial(trial_id=s["trial_id"],
+                      config=configs.get(s["trial_id"], {}),
+                      status=s["status"], history=s["history"],
+                      checkpoint_path=s["checkpoint_path"],
+                      error=s["error"],
+                      stopped_early=s.get("stopped_early", False))
+            if t.status in (RUNNING, ERRORED):
+                # Interrupted mid-flight: resume from latest checkpoint.
+                t.status = PENDING
+            trials.append(t)
+        return trials
+
+    # ---------------------------------------------------------------- query
+    def results(self) -> List[Result]:
+        out = []
+        for t in self.trials:
+            out.append(Result(
+                metrics=t.last_result,
+                checkpoint=(Checkpoint(t.checkpoint_path)
+                            if t.checkpoint_path else None),
+                path=os.path.join(self._dir, t.trial_id),
+                metrics_dataframe=t.history,
+                error=t.error,
+                config=t.config,
+            ))
+        return out

@@ -1,0 +1,54 @@
+"""Trial schedulers.
+
+Reference: `python/ray/tune/schedulers/async_hyperband.py` — ASHA: rungs at
+grace_period * reduction_factor^k; at each rung a trial continues only if its
+result is in the top 1/reduction_factor of results recorded at that rung.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, iteration: int, value: float) -> str:
+        return CONTINUE
+
+
+class AsyncHyperBandScheduler:
+    def __init__(self, metric: str = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 3):
+        self.metric = metric
+        self.mode = mode
+        self._max_t = max_t
+        self._grace = grace_period
+        self._rf = reduction_factor
+        rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            rungs.append(int(t))
+            t *= reduction_factor
+        self._rungs = rungs                       # ascending milestones
+        self._recorded: Dict[int, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, iteration: int, value: float) -> str:
+        if self.mode == "min":
+            value = -value
+        if iteration >= self._max_t:
+            return STOP
+        for rung in reversed(self._rungs):
+            if iteration == rung:
+                recorded = self._recorded[rung]
+                recorded.append(value)
+                k = max(1, int(math.ceil(len(recorded) / self._rf)))
+                cutoff = sorted(recorded, reverse=True)[k - 1]
+                if value < cutoff:
+                    return STOP
+                break
+        return CONTINUE

@@ -1,0 +1,129 @@
+"""Search spaces + basic variant generation.
+
+Reference: `python/ray/tune/search/sample.py` (Domain objects) and
+`search/basic_variant.py` (BasicVariantGenerator): grid_search entries are
+expanded cross-product; stochastic domains are sampled once per trial;
+`num_samples` repeats the whole expansion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class _GridSearch:
+    values: List[Any]
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class Categorical(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclasses.dataclass
+class Uniform(Domain):
+    lower: float
+    upper: float
+
+    def sample(self, rng):
+        return rng.uniform(self.lower, self.upper)
+
+
+@dataclasses.dataclass
+class LogUniform(Domain):
+    lower: float
+    upper: float
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(math.log(self.lower),
+                                    math.log(self.upper)))
+
+
+@dataclasses.dataclass
+class Randint(Domain):
+    lower: int
+    upper: int
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+@dataclasses.dataclass
+class SampleFrom(Domain):
+    fn: Callable[[Dict[str, Any]], Any]
+
+    def sample(self, rng):  # resolved against the partial config later
+        raise NotImplementedError
+
+
+def grid_search(values: List[Any]) -> _GridSearch:
+    return _GridSearch(list(values))
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(list(categories))
+
+
+def uniform(lower: float, upper: float) -> Uniform:
+    return Uniform(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> LogUniform:
+    return LogUniform(lower, upper)
+
+
+def randint(lower: int, upper: int) -> Randint:
+    return Randint(lower, upper)
+
+
+def sample_from(fn: Callable[[Dict[str, Any]], Any]) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+class BasicVariantGenerator:
+    """Expands a param_space into concrete trial configs."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+
+    def generate(self, param_space: Dict[str, Any], num_samples: int = 1
+                 ) -> List[Dict[str, Any]]:
+        grids: List[tuple] = []
+        for key, value in (param_space or {}).items():
+            if isinstance(value, _GridSearch):
+                grids.append((key, value.values))
+        combos: List[Dict[str, Any]] = [{}]
+        for key, values in grids:
+            combos = [dict(c, **{key: v}) for c in combos for v in values]
+
+        out: List[Dict[str, Any]] = []
+        for _ in range(max(num_samples, 1)):
+            for combo in combos:
+                cfg: Dict[str, Any] = {}
+                for key, value in (param_space or {}).items():
+                    if isinstance(value, _GridSearch):
+                        cfg[key] = combo[key]
+                    elif isinstance(value, SampleFrom):
+                        pass  # resolved after the rest
+                    elif isinstance(value, Domain):
+                        cfg[key] = value.sample(self._rng)
+                    else:
+                        cfg[key] = value
+                for key, value in (param_space or {}).items():
+                    if isinstance(value, SampleFrom):
+                        cfg[key] = value.fn(dict(cfg))
+                out.append(cfg)
+        return out
