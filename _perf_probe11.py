@@ -1,0 +1,76 @@
+"""Donated-state variants: can donation unlock batch 12/16 or 6 layers?"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ray_tpu.models.llama import LlamaConfig, flops_per_token, init_params, loss_fn
+from ray_tpu.parallel import (
+    create_train_state, llama_param_shardings, make_mesh, shard_params,
+)
+from ray_tpu.parallel.train_step import TrainState
+
+PEAK = 197e12
+S = 1024
+K = 2
+
+
+def run(tag, batch, remat, layers=4, dim=4096, heads=32, kv=8, hidden=11008,
+        timed=4):
+    config = LlamaConfig(
+        vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
+        n_kv_heads=kv, hidden_dim=hidden, max_seq_len=S,
+        attn_impl="flash", remat=remat, param_dtype=jnp.bfloat16)
+    mesh = make_mesh({"data": -1})
+    opt = optax.adamw(1e-4)
+    state = create_train_state(
+        shard_params(init_params(config, jax.random.key(0)),
+                     llama_param_shardings(config, mesh)), opt)
+
+    def one(st, toks):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, {"tokens": toks}, config))(st.params)
+        updates, new_opt = opt.update(grads, st.opt_state, st.params)
+        return TrainState(optax.apply_updates(st.params, updates), new_opt,
+                          st.step + 1), loss
+
+    @jax.jit
+    def multi(st, toks_k):
+        return lax.scan(one, st, toks_k)
+
+    multi = jax.jit(lambda st, toks_k: lax.scan(one, st, toks_k),
+                    donate_argnums=(0,))
+
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 32000, (K, batch, S)).astype("int32"))
+    for _ in range(2):
+        state, losses = multi(state, toks)
+        float(losses[-1])
+    times = []
+    for _ in range(timed):
+        t0 = time.perf_counter()
+        state, losses = multi(state, toks)
+        float(losses[-1])
+        times.append((time.perf_counter() - t0) / K)
+    per_step = min(times)
+    toks_s = batch * (S - 1) / per_step
+    mfu = toks_s * flops_per_token(config, S) / PEAK
+    print(f"{tag:26s} step={per_step*1000:7.1f}ms "
+          f"tok/s={toks_s:9.0f} mfu={mfu:.3f}", flush=True)
+
+
+which = sys.argv[1]
+if which == "b12r":
+    run("1B b12 remat don", 12, True)
+elif which == "b16r":
+    run("1B b16 remat don", 16, True)
+elif which == "l6b8":
+    run("1.4B L6 b8 remat don", 8, True, layers=6)
+elif which == "l8b8":
+    run("1.8B L8 b8 remat don", 8, True, layers=8)
+elif which == "b24r":
+    run("1B b24 remat don", 24, True)

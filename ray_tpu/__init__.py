@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 from ray_tpu import exceptions
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import JobID
-from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu._private.worker import (
     MODE_DRIVER, Worker, global_worker, global_worker_or_none,
     set_global_worker,
@@ -178,7 +178,10 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     global_worker().kill_actor(actor._actor_id, no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+def cancel(ref: Union[ObjectRef, ObjectRefGenerator], *,
+           force: bool = False) -> None:
+    if isinstance(ref, ObjectRefGenerator):
+        ref = ref._ref0
     global_worker().cancel_task(ref, force)
 
 

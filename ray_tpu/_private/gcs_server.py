@@ -686,9 +686,13 @@ def main():
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--system-config", default="{}")
+    parser.add_argument("--fate-share-pid", type=int, default=0)
     args = parser.parse_args()
 
     GlobalConfig.load_system_config(args.system_config)
+    from ray_tpu._private.fate_share import watch_parent
+
+    watch_parent(args.fate_share_pid)
     gcs = GcsServer(args.host, args.port)
     port = gcs.start()
     # Parent discovers the port from stdout.

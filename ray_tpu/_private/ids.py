@@ -98,6 +98,11 @@ class ActorID(BaseID):
     def nil_for_job(cls, job_id: JobID) -> "ActorID":
         return cls(b"\xff" * (cls.SIZE - JobID.SIZE) + job_id.binary())
 
+    def is_nil(self) -> bool:
+        # Normal tasks carry nil_for_job (0xff prefix + job suffix).
+        n = self.SIZE - JobID.SIZE
+        return self._bytes[:n] == b"\xff" * n
+
     def job_id(self) -> JobID:
         return JobID(self._bytes[-JobID.SIZE:])
 

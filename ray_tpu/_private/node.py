@@ -124,7 +124,8 @@ class Node:
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.gcs_server",
              "--host", self.host, "--port", "0",
-             "--system-config", json.dumps(self._system_config)],
+             "--system-config", json.dumps(self._system_config),
+             "--fate-share-pid", str(os.getpid())],
             stdout=subprocess.PIPE, stderr=log, env=self._daemon_env(),
             start_new_session=True)
         port = _read_port(proc, "GCS_PORT=")
@@ -145,7 +146,8 @@ class Node:
              "--labels", json.dumps(self.labels),
              "--session-dir", self.session_dir,
              "--object-store-capacity",
-             str(object_store_memory or GlobalConfig.object_store_memory)],
+             str(object_store_memory or GlobalConfig.object_store_memory),
+             "--fate-share-pid", str(os.getpid())],
             stdout=subprocess.PIPE, stderr=log, env=self._daemon_env(),
             start_new_session=True)
         port = _read_port(proc, "RAYLET_PORT=")

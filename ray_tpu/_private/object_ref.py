@@ -115,3 +115,42 @@ def reduce_object_ref(ref: ObjectRef):
 
 def _rehydrate_ref(object_id, owner_addr, owner_worker_id):
     return ObjectRef(object_id, owner_addr, owner_worker_id)
+
+
+class ObjectRefGenerator:
+    """Iterator over the return refs of a generator task
+    (``num_returns="streaming"``).
+
+    Reference: `python/ray/_raylet.pyx:272` (ObjectRefGenerator): the remote
+    call returns this handle immediately; item refs become available
+    incrementally as the executing worker reports them
+    (ReportGeneratorItemReturns — here the `report_generator_item` owner
+    RPC). Iterating blocks until the next item exists or the generator
+    finishes (StopIteration). Only usable in the owner process.
+    """
+
+    def __init__(self, task_id: bytes, owner_addr, owner_worker_id: bytes):
+        self._task_id = task_id
+        self._owner_addr = tuple(owner_addr)
+        self._owner_worker_id = owner_worker_id
+        self._next_index = 0
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from ray_tpu._private import worker as worker_mod
+
+        ref = worker_mod.global_worker().next_generator_ref(
+            self._task_id, self._next_index)
+        self._next_index += 1
+        return ref
+
+    def completed(self) -> int:
+        """Number of item refs produced so far."""
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod.global_worker().generator_progress(self._task_id)[0]
+
+    def __repr__(self):
+        return f"ObjectRefGenerator(task={self._task_id.hex()})"

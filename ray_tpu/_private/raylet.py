@@ -220,12 +220,29 @@ class Raylet:
         handle.last_idle = time.monotonic()
         self._idle[handle.job_id].append(handle)
 
+    def _maybe_replenish(self, job_id: bytes) -> None:
+        """Keep a floor of warm workers so the next actor creation (e.g.
+        tune trials launched after kills) never serializes on a Python
+        cold start."""
+        # Workers still starting but already promised to waiting pops are
+        # not warm capacity.
+        warm = (len(self._idle[job_id]) + self._starting[job_id]
+                - len(self._pending_pop[job_id]))
+        n_live = sum(1 for w in self.workers.values()
+                     if w.job_id == job_id)
+        want = GlobalConfig.worker_pool_min_idle
+        while warm < want and n_live < self._max_workers:
+            self._spawn_worker(job_id)
+            warm += 1
+            n_live += 1
+
     async def _pop_worker(self, job_id: bytes, timeout: float = 60.0
                           ) -> Optional[_WorkerHandle]:
         idle = self._idle[job_id]
         while idle:
             handle = idle.popleft()
             if handle.proc.poll() is None:
+                self._maybe_replenish(job_id)
                 return handle
             self.workers.pop(handle.worker_id, None)
         n_live = sum(1 for w in self.workers.values()
@@ -260,6 +277,10 @@ class Raylet:
                     self._idle[handle.job_id].remove(handle)
                 except ValueError:
                     pass
+                if handle.is_actor:
+                    # Replace the dead actor worker eagerly so the next
+                    # actor creation finds a warm process.
+                    self._maybe_replenish(handle.job_id)
                 if handle.lease is not None:
                     self._release_lease(handle)
                 if handle.is_actor and handle.actor_id is not None:
@@ -570,7 +591,14 @@ class Raylet:
                         return {"path": found[0], "size": found[1]}
                 except Exception:
                     continue
-        found = await self.store.get(object_id, timeout=timeout)
+            # The owner's directory said where the copies are and every
+            # pull failed (nodes dead / object gone). Fail fast: the owner
+            # can reconstruct via lineage; blocking the full client timeout
+            # here just delays recovery.
+            found = await self.store.get(object_id,
+                                         timeout=min(timeout or 2.0, 2.0))
+        else:
+            found = await self.store.get(object_id, timeout=timeout)
         if found is None:
             return {"not_found": True}
         return {"path": found[0], "size": found[1]}
@@ -724,6 +752,7 @@ def main():
     parser.add_argument("--labels", default="{}")
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--object-store-capacity", type=int, default=0)
+    parser.add_argument("--fate-share-pid", type=int, default=0)
     args = parser.parse_args()
 
     capacity = args.object_store_capacity or GlobalConfig.object_store_memory
@@ -741,6 +770,10 @@ def main():
     )
     # Graceful termination must clean the node's /dev/shm store files.
     signal.signal(signal.SIGTERM, lambda *_: raylet.shutdown())
+    from ray_tpu._private.fate_share import watch_parent
+
+    # Clean the object store before exiting on spawner death too.
+    watch_parent(args.fate_share_pid, on_death=raylet.shutdown)
     port = raylet.start()
     print(f"RAYLET_PORT={port}", flush=True)
     import threading

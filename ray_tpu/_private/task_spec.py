@@ -101,10 +101,17 @@ class TaskSpec:
     labels: Dict[str, str] = field(default_factory=dict)
 
     def return_ids(self) -> List[ObjectID]:
+        # Generator tasks (num_returns < 0: -1 dynamic, -2 streaming) have
+        # one visible return — the generator ref at index 1; yielded items
+        # take indices 2, 3, ... as they are produced.
+        n = 1 if self.num_returns < 0 else self.num_returns
         return [
             ObjectID.for_task_return(self.task_id, i + 1)
-            for i in range(self.num_returns)
+            for i in range(n)
         ]
+
+    def generator_item_id(self, item_index: int) -> ObjectID:
+        return ObjectID.for_task_return(self.task_id, item_index + 2)
 
     def dependencies(self) -> List[bytes]:
         return [a.object_id for a in self.args if a.is_ref]

@@ -76,6 +76,18 @@ class _PendingObject:
         self.waiters: List[asyncio.Future] = []
 
 
+class _GeneratorState:
+    """Owner-side progress of one streaming/dynamic generator task."""
+
+    __slots__ = ("produced", "total", "error", "cond")
+
+    def __init__(self):
+        self.produced = 0               # item refs completed so far
+        self.total: Optional[int] = None  # set when the generator finishes
+        self.error: Optional[bytes] = None
+        self.cond = threading.Condition()
+
+
 class _ActorState:
     """Executing-side actor state (instance + ordered scheduling queues)."""
 
@@ -204,7 +216,8 @@ class Worker:
         self.server = RpcServer(bind_host, 0)
         for name in ["push_task", "create_actor", "push_actor_task",
                      "get_object_status", "kill_self", "cancel_task", "ping",
-                     "delete_object_notification"]:
+                     "delete_object_notification", "report_generator_item",
+                     "recover_object"]:
             self.server.register(name, getattr(self, f"_h_{name}"))
         self.port = self.server.start()
         self.addr = (bind_host, self.port)
@@ -236,6 +249,17 @@ class Worker:
         self._actor_submit_locks: Dict[bytes, asyncio.Lock] = {}
         self._exported_functions: set = set()
         self._cancelled_tasks: set = set()
+        # task_id -> executing worker addr, while a push RPC is in flight
+        # (real cancel needs the executing worker, not a broadcast).
+        self._inflight_push: Dict[bytes, Tuple[str, int]] = {}
+        # Streaming/dynamic generator tasks: task_id -> production state.
+        self._generators: Dict[bytes, _GeneratorState] = {}
+        # Lineage (object reconstruction): task_id -> spec of the creating
+        # task, dropped when all its return objects are freed
+        # (reference: `task_manager.cc` lineage + `object_recovery_manager.h:90`).
+        self._lineage: Dict[bytes, TaskSpec] = {}
+        self._lineage_live: Dict[bytes, int] = {}
+        self._recovering: Dict[bytes, threading.Event] = {}
 
         # execution state
         self._fn_cache: Dict[str, Any] = {}
@@ -245,6 +269,8 @@ class Worker:
         self._actor: Optional[_ActorState] = None
         self._ctx = _TaskContext()
         self._running_task_threads: Dict[bytes, threading.Thread] = {}
+        # task_id -> thread ident, for async cancel of a RUNNING task.
+        self._executing_tids: Dict[bytes, int] = {}
 
         self._dead = False
 
@@ -365,14 +391,24 @@ class Worker:
         return self._borrowed_get(ref, timeout)
 
     def _materialize(self, oid: bytes, entry: _PendingObject,
-                     timeout: Optional[float]) -> Any:
+                     timeout: Optional[float], _recovered: bool = False) -> Any:
         if entry.error is not None:
             self._raise_task_error(entry.error)
         if entry.inline is not None:
             return self.serialization.deserialize(memoryview(entry.inline))
         if entry.in_plasma:
-            return self._plasma_get(oid, timeout,
-                                    self.reference_counter.locations(oid))
+            try:
+                return self._plasma_get(
+                    oid, timeout, self.reference_counter.locations(oid))
+            except exc.ObjectLostError:
+                if _recovered or not self._try_recover_object(oid, timeout):
+                    raise
+                entry = self._entry(oid)
+                if not entry.event.wait(timeout if timeout is not None
+                                        else 300):
+                    raise
+                return self._materialize(oid, entry, timeout,
+                                         _recovered=True)
         raise exc.ObjectLostError(f"object {oid.hex()} has no value")
 
     def _raise_task_error(self, payload: bytes):
@@ -387,6 +423,7 @@ class Worker:
         deadline = None if timeout is None else time.monotonic() + timeout
         owner = self._client_for(tuple(ref.owner_addr))
         delay = 0.002
+        recovery_attempts = 0
         while True:
             try:
                 status = owner.call("get_object_status", object_id=oid,
@@ -400,11 +437,32 @@ class Worker:
                 return self.serialization.deserialize(
                     memoryview(status["data"]))
             if kind == "plasma":
-                return self._plasma_get(
-                    oid,
-                    None if deadline is None else max(
-                        0.1, deadline - time.monotonic()),
-                    status["locations"])
+                try:
+                    return self._plasma_get(
+                        oid,
+                        None if deadline is None else max(
+                            0.1, deadline - time.monotonic()),
+                        status["locations"])
+                except exc.ObjectLostError:
+                    # All copies gone — ask the owner to reconstruct via
+                    # lineage, then re-resolve. Bounded by the caller's
+                    # remaining get() budget.
+                    recovery_attempts += 1
+                    if recovery_attempts > 2:
+                        raise
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise exc.GetTimeoutError(
+                            f"get() timed out during recovery of "
+                            f"{oid.hex()}") from None
+                    reply = owner.call(
+                        "recover_object", object_id=oid,
+                        timeout=(310 if remaining is None
+                                 else min(remaining + 10, 310)))
+                    if not reply.get("ok"):
+                        raise
+                    continue
             if kind == "error":
                 self._raise_task_error(status["error"])
             if kind == "freed":
@@ -451,6 +509,14 @@ class Worker:
         """ReferenceCounter callback — remove the value everywhere."""
         with self._objects_lock:
             self._objects.pop(oid, None)
+        tid = bytes(oid[:TaskID.SIZE])
+        live = self._lineage_live.get(tid)
+        if live is not None:
+            live -= 1
+            if live <= 0:
+                self._drop_lineage(tid)
+            else:
+                self._lineage_live[tid] = live
         mobj = self._mapped.pop(oid, None)
         if mobj is not None:
             mobj.close()
@@ -533,6 +599,9 @@ class Worker:
         task_id = TaskID.for_normal_task(self.job_id)
         arg_specs, kw_keys = self._serialize_args(args, kwargs)
         num_returns = options.get("num_returns", 1)
+        streaming = num_returns == "streaming"
+        if isinstance(num_returns, str):
+            num_returns = {"dynamic": -1, "streaming": -2}[num_returns]
         resources = _resources_from_options(options)
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id,
@@ -556,7 +625,22 @@ class Worker:
             self._entry(rid.binary())
             refs.append(ObjectRef(rid.binary(), self.addr,
                                   self.worker_id.binary()))
+        if spec.max_retries != 0:
+            tid = task_id.binary()
+            self._lineage[tid] = spec
+            self._lineage_live[tid] = len(refs)
+        if num_returns < 0:
+            # Register generator state before dispatch: a streaming item
+            # push may arrive before the submit coroutine even runs.
+            self._generators[task_id.binary()] = _GeneratorState()
         self.io.submit(self._run_normal_task(spec))
+        if streaming:
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+
+            gen = ObjectRefGenerator(task_id.binary(), self.addr,
+                                     self.worker_id.binary())
+            gen._ref0 = refs[0]  # keeps the generator ref (and lineage) alive
+            return [gen]
         return refs
 
     async def _resolve_deps(self, spec: TaskSpec) -> Optional[bytes]:
@@ -616,19 +700,41 @@ class Worker:
                 return
             worker_addr = tuple(lease["worker_addr"])
             worker_id = lease["worker_id"]
+            if spec.task_id.binary() in self._cancelled_tasks:
+                # Cancelled while the lease was being acquired.
+                try:
+                    await lessor.acall("return_worker", worker_id=worker_id,
+                                       kill=False, timeout=10)
+                except Exception:
+                    pass
+                self._fail_task(spec, serialize_error(
+                    exc.TaskCancelledError(f"task {spec.name} was cancelled")))
+                self._release_deps(spec)
+                return
             crashed = False
+            self._inflight_push[spec.task_id.binary()] = worker_addr
             try:
                 reply = await self._client_for(worker_addr).acall(
                     "push_task", spec=spec, tpu_ids=lease.get("tpu_ids", []))
             except (ConnectionLost, OSError):
                 crashed = True
                 reply = None
+            finally:
+                self._inflight_push.pop(spec.task_id.binary(), None)
             try:
                 await lessor.acall("return_worker", worker_id=worker_id,
                                    kill=crashed, timeout=10)
             except Exception:
                 pass
             if crashed:
+                if spec.task_id.binary() in self._cancelled_tasks:
+                    # force-cancel kills the executing worker; that death
+                    # is the cancellation, not a crash to retry.
+                    self._fail_task(spec, serialize_error(
+                        exc.TaskCancelledError(
+                            f"task {spec.name} was cancelled (force)")))
+                    self._release_deps(spec)
+                    return
                 if attempt < spec.max_retries:
                     attempt += 1
                     await asyncio.sleep(min(0.05 * (2 ** attempt), 2.0))
@@ -639,8 +745,9 @@ class Worker:
                 self._release_deps(spec)
                 return
             if reply.get("app_error") is not None:
-                if self._should_retry_app_error(spec, reply["app_error"],
-                                                attempt):
+                if (spec.task_id.binary() not in self._cancelled_tasks
+                        and self._should_retry_app_error(
+                            spec, reply["app_error"], attempt)):
                     attempt += 1
                     continue
                 self._fail_task(spec, reply["app_error"])
@@ -715,6 +822,9 @@ class Worker:
                               strategy.bundle_index)
 
     def _accept_results(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
+        if spec.num_returns < 0:
+            self._accept_generator_results(spec, reply)
+            return
         for oid, kind, payload in reply["results"]:
             if kind == "inline":
                 self._complete_object(oid, inline=payload)
@@ -724,11 +834,38 @@ class Worker:
             elif kind == "error":
                 self._complete_object(oid, error=payload)
 
+    def _accept_generator_results(self, spec: TaskSpec,
+                                  reply: Dict[str, Any]) -> None:
+        tid = spec.task_id.binary()
+        count = reply.get("generator_count", len(reply["results"]))
+        for i, item in enumerate(reply["results"]):
+            self._on_generator_item(tid, i, item)  # no-op if already pushed
+        state = self._generators.setdefault(tid, _GeneratorState())
+        with state.cond:
+            state.total = count
+            state.cond.notify_all()
+        # The generator ref (index 1) resolves to the list of item refs
+        # (num_returns="dynamic" semantics).
+        refs = [ObjectRef(spec.generator_item_id(i).binary(), self.addr,
+                          self.worker_id.binary()) for i in range(count)]
+        self._store_value(spec.return_ids()[0].binary(), refs)
+
     def _fail_task(self, spec: TaskSpec, error_payload: bytes) -> None:
         for rid in spec.return_ids():
             self._complete_object(rid.binary(), error=error_payload)
+        state = self._generators.get(spec.task_id.binary())
+        if state is not None:
+            with state.cond:
+                state.error = error_payload
+                state.cond.notify_all()
 
     def _release_deps(self, spec: TaskSpec) -> None:
+        # Lineage pinning (reference: lineage pinning in reference_count.cc):
+        # while the task's spec is kept for reconstruction, its args must
+        # stay resolvable — their deps are released only when the lineage is
+        # dropped (_drop_lineage), not when the task completes.
+        if spec.task_id.binary() in self._lineage:
+            return
         for arg in spec.args:
             if arg.is_ref and tuple(arg.owner_addr) == self.addr:
                 self.reference_counter.remove_task_dependency(arg.object_id)
@@ -907,18 +1044,29 @@ class Worker:
         self.gcs.call("kill_actor", actor_id=actor_id, no_restart=no_restart)
 
     def cancel_task(self, ref: ObjectRef, force: bool = False) -> None:
-        task_id = ObjectID(ref.binary()).task_id().binary()
+        """Cancel a task: pre-dispatch it simply never runs; a RUNNING task
+        is interrupted on its executing worker (async exception; `force=`
+        kills the worker process — reference `CancelTask` force-kill path,
+        `core_worker.proto:425`)."""
+        tid = ObjectID(ref.binary()).task_id()
+        task_id = tid.binary()
         self._cancelled_tasks.add(task_id)
+        actor_id = tid.actor_id()
+        addr = self._inflight_push.get(task_id)
+        if addr is None and not actor_id.is_nil():
+            # Actor task: its executing worker is the actor's worker.
+            addr = self._actor_addr_cache.get(actor_id.binary())
+        if addr is None:
+            return
 
-        async def _broadcast():
-            for client in list(self._worker_clients.values()):
-                try:
-                    await client.acall("cancel_task", task_id=task_id,
-                                       force=force, timeout=5)
-                except Exception:
-                    pass
+        async def _cancel_running():
+            try:
+                await self._client_for(addr).acall(
+                    "cancel_task", task_id=task_id, force=force, timeout=5)
+            except Exception:
+                pass
 
-        self.io.submit(_broadcast())
+        self.io.submit(_cancel_running())
 
     # ======================================================================
     # Execution side (RPC handlers)
@@ -951,6 +1099,21 @@ class Worker:
 
     async def _h_cancel_task(self, task_id, force=False):
         self._cancelled_tasks.add(task_id)
+        tid_thread = self._executing_tids.get(task_id)
+        if tid_thread is not None:
+            if force:
+                # Reply first, then die: the owner maps the connection loss
+                # of a cancelled task to TaskCancelledError, never a retry.
+                asyncio.get_running_loop().call_later(0.02, os._exit, 1)
+            else:
+                import ctypes
+
+                # Raised at the next bytecode boundary of the executing
+                # thread (cannot interrupt a blocking C call — same limit
+                # as the reference's non-force cancel).
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(tid_thread),
+                    ctypes.py_object(exc.TaskCancelledError))
         return True
 
     async def _h_push_task(self, spec: TaskSpec, tpu_ids):
@@ -998,14 +1161,20 @@ class Worker:
 
             TPUAcceleratorManager.set_current_process_visible_accelerator_ids(
                 [str(i) for i in tpu_ids])
+        tid = spec.task_id.binary()
+        self._executing_tids[tid] = threading.get_ident()
         try:
             fn = self._load_function(spec.function.function_hash)
             args, kwargs = self._resolve_args(spec)
             result = fn(*args, **kwargs)
+            if spec.num_returns < 0:
+                results, count = self._store_generator_returns(spec, result)
+                return {"results": results, "generator_count": count}
             return {"results": self._store_returns(spec, result)}
         except Exception as e:  # noqa: BLE001 — application error
             return {"results": [], "app_error": serialize_error(e)}
         finally:
+            self._executing_tids.pop(tid, None)
             self._ctx.task_id = None
             self._ctx.task_name = ""
 
@@ -1031,6 +1200,166 @@ class Worker:
                 self._plasma_put(oid, sobj)
                 out.append((oid, "plasma", self.node_id))
         return out
+
+    def _store_generator_returns(self, spec: TaskSpec, result: Any):
+        """Execution side of num_returns="dynamic"/"streaming": store each
+        yielded item as its own object; streaming additionally reports every
+        item to the owner as it is produced (reference:
+        `ReportGeneratorItemReturns`, `core_worker.proto:425`)."""
+        streaming = spec.num_returns == -2
+        owner = None
+        if streaming and tuple(spec.owner_addr) != self.addr:
+            owner = self._client_for(tuple(spec.owner_addr))
+        items = []
+        count = 0
+        for value in result:
+            oid = spec.generator_item_id(count).binary()
+            sobj = self.serialization.serialize(value)
+            if sobj.total_size <= GlobalConfig.max_direct_call_object_size:
+                entry = (oid, "inline", sobj.to_bytes())
+            else:
+                self._plasma_put(oid, sobj)
+                entry = (oid, "plasma", self.node_id)
+            items.append(entry)
+            if streaming:
+                if owner is not None:
+                    # Fire-and-forget, pipelined on the io loop: the
+                    # producing thread never blocks a network round trip
+                    # per item. A lost push self-heals — the final task
+                    # reply re-delivers every item (owner-side dedup).
+                    self.io.submit(owner.acall(
+                        "report_generator_item",
+                        task_id=spec.task_id.binary(), index=count,
+                        item=entry, timeout=60))
+                else:  # owner == executing worker (self-lease)
+                    self._on_generator_item(spec.task_id.binary(), count,
+                                            entry)
+            count += 1
+        return items, count
+
+    # ---- generator plane (owner side) -------------------------------------
+    def _on_generator_item(self, task_id: bytes, index: int, item) -> None:
+        oid, kind, payload = item
+        entry = self._entry(oid)
+        if not entry.event.is_set():
+            if (not self.reference_counter.has_ref(oid)
+                    and not self.reference_counter.is_freed(oid)):
+                # First arrival only — re-produced items after a lineage
+                # recovery are already tracked and must not inflate the
+                # lineage live count.
+                self.reference_counter.add_owned(oid)
+                if task_id in self._lineage_live:
+                    self._lineage_live[task_id] += 1
+            if kind == "inline":
+                self._complete_object(oid, inline=payload)
+            else:
+                self.reference_counter.add_location(oid, payload)
+                self._complete_object(oid, in_plasma=True)
+        state = self._generators.get(task_id)
+        if state is not None:
+            with state.cond:
+                state.produced = max(state.produced, index + 1)
+                state.cond.notify_all()
+
+    async def _h_report_generator_item(self, task_id, index, item):
+        self._on_generator_item(task_id, index, item)
+        return True
+
+    def next_generator_ref(self, task_id: bytes, index: int) -> ObjectRef:
+        """Blocks until item `index` of the generator task exists; raises
+        StopIteration at the end (ObjectRefGenerator protocol)."""
+        state = self._generators.get(task_id)
+        if state is None:
+            raise RuntimeError(
+                f"no generator state for task {task_id.hex()} "
+                "(ObjectRefGenerator is only usable in the owner process)")
+        with state.cond:
+            while True:
+                if index < state.produced:
+                    break
+                if state.error is not None:
+                    self._raise_task_error(state.error)
+                if state.total is not None and index >= state.total:
+                    raise StopIteration
+                if not state.cond.wait(timeout=300):
+                    raise exc.GetTimeoutError(
+                        f"generator item {index} of {task_id.hex()} did not "
+                        "arrive within 300s")
+        ref_oid = ObjectID.for_task_return(TaskID(task_id),
+                                           index + 2).binary()
+        return ObjectRef(ref_oid, self.addr, self.worker_id.binary())
+
+    def generator_progress(self, task_id: bytes):
+        state = self._generators.get(task_id)
+        if state is None:
+            return 0, None
+        with state.cond:
+            return state.produced, state.total
+
+    # ---- lineage / object recovery (owner side) ---------------------------
+    def _drop_lineage(self, tid: bytes) -> None:
+        self._lineage_live.pop(tid, None)
+        spec = self._lineage.pop(tid, None)
+        self._generators.pop(tid, None)
+        if spec is not None:
+            # Release the lineage-pinned arg deps (deferred _release_deps).
+            for arg in spec.args:
+                if arg.is_ref and tuple(arg.owner_addr) == self.addr:
+                    self.reference_counter.remove_task_dependency(
+                        arg.object_id)
+
+    def _task_return_oids(self, spec: TaskSpec) -> List[bytes]:
+        oids = [rid.binary() for rid in spec.return_ids()]
+        if spec.num_returns < 0:
+            state = self._generators.get(spec.task_id.binary())
+            produced = state.produced if state is not None else 0
+            oids += [spec.generator_item_id(i).binary()
+                     for i in range(produced)]
+        return oids
+
+    def _try_recover_object(self, oid: bytes,
+                            timeout: Optional[float] = None) -> bool:
+        """Reconstruct a lost plasma object by re-executing its creating
+        task (reference: `object_recovery_manager.h:90` RecoverObject +
+        lineage in `task_manager.cc:896`). Waits at most `timeout` (caller's
+        get() budget) for the re-execution to finish."""
+        tid = bytes(oid[:TaskID.SIZE])
+        spec = self._lineage.get(tid)
+        if spec is None:
+            return False
+        with self._objects_lock:
+            ev = self._recovering.get(tid)
+            fresh = ev is None
+            if fresh:
+                ev = self._recovering[tid] = threading.Event()
+        if fresh:
+            for roid in self._task_return_oids(spec):
+                with self._objects_lock:
+                    self._objects[roid] = _PendingObject()
+                for node in self.reference_counter.locations(roid):
+                    self.reference_counter.remove_location(roid, node)
+            state = self._generators.get(tid)
+            if state is not None:
+                with state.cond:
+                    state.produced = 0
+                    state.total = None
+                    state.error = None
+            fut = self.io.submit(self._run_normal_task(spec))
+
+            def _done(_f):
+                ev.set()
+                self._recovering.pop(tid, None)
+
+            fut.add_done_callback(_done)
+        wait_s = 300.0 if timeout is None else min(timeout, 300.0)
+        if not ev.wait(timeout=wait_s):
+            return False
+        return True
+
+    async def _h_recover_object(self, object_id):
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self._try_recover_object, object_id)
+        return {"ok": ok}
 
     # ---- actor execution --------------------------------------------------
     async def _h_create_actor(self, spec: TaskSpec, tpu_ids=None):

@@ -188,32 +188,44 @@ def _get_attention_fn(impl: str):
     return xla_attention
 
 
-@jax.custom_vjp
-def embed_lookup(embed: jax.Array, tokens: jax.Array) -> jax.Array:
+def _make_embed_lookup(vocab: int, dtype_name: str):
     """Embedding gather whose BACKWARD is a one-hot matmul, not a scatter.
 
     XLA lowers the gather's transpose to a serialized scatter-add on TPU —
     hundreds of ms at [V, D] scale; the MXU does the same reduction as a
-    [V, B*S] x [B*S, D] matmul in milliseconds."""
-    return embed[tokens]
+    [V, B*S] x [B*S, D] matmul in milliseconds. Static (vocab, dtype) live
+    in this closure: custom_vjp residuals must be JAX arrays only.
+    """
+
+    @jax.custom_vjp
+    def lookup(embed, tokens):
+        return embed[tokens]
+
+    def fwd(embed, tokens):
+        return embed[tokens], tokens
+
+    def bwd(tokens, g):
+        flat_tok = tokens.reshape(-1)
+        flat_g = g.reshape(flat_tok.shape[0], -1)
+        onehot = jax.nn.one_hot(flat_tok, vocab, dtype=flat_g.dtype, axis=0)
+        d_embed = jax.lax.dot_general(
+            onehot, flat_g, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return d_embed.astype(dtype_name), None
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
 
 
-def _embed_fwd(embed, tokens):
-    return embed[tokens], (tokens, embed.shape[0], embed.dtype)
+_EMBED_LOOKUP_CACHE: Dict[Tuple[int, str], Any] = {}
 
 
-def _embed_bwd(res, g):
-    tokens, vocab, dtype = res
-    flat_tok = tokens.reshape(-1)
-    flat_g = g.reshape(len(flat_tok), -1)
-    onehot = jax.nn.one_hot(flat_tok, vocab, dtype=flat_g.dtype, axis=0)
-    d_embed = jax.lax.dot_general(
-        onehot, flat_g, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    return d_embed.astype(dtype), None
-
-
-embed_lookup.defvjp(_embed_fwd, _embed_bwd)
+def embed_lookup(embed: jax.Array, tokens: jax.Array) -> jax.Array:
+    key = (embed.shape[0], jnp.dtype(embed.dtype).name)
+    fn = _EMBED_LOOKUP_CACHE.get(key)
+    if fn is None:
+        fn = _EMBED_LOOKUP_CACHE[key] = _make_embed_lookup(*key)
+    return fn(embed, tokens)
 
 
 # ---------------------------------------------------------------------------

@@ -22,7 +22,11 @@ def validate_task_options(options: Dict[str, Any]) -> None:
                 f"invalid option {key!r} for a remote function; valid: "
                 f"{sorted(_VALID_TASK_OPTIONS)}")
     nr = options.get("num_returns", 1)
-    if not (isinstance(nr, int) and nr >= 0):
+    if isinstance(nr, str):
+        if nr not in ("dynamic", "streaming"):
+            raise ValueError(
+                'num_returns must be an int, "dynamic", or "streaming"')
+    elif not (isinstance(nr, int) and nr >= 0):
         raise ValueError("num_returns must be a non-negative int")
     if options.get("num_gpus"):
         raise ValueError(
@@ -57,7 +61,9 @@ class RemoteFunction:
         nr = self._options.get("num_returns", 1)
         if nr == 0:
             return None
-        if nr == 1:
+        if nr == 1 or isinstance(nr, str):
+            # "dynamic" -> ref resolving to the item-ref list;
+            # "streaming" -> an ObjectRefGenerator.
             return refs[0]
         return refs
 

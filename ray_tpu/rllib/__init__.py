@@ -1,0 +1,13 @@
+"""ray_tpu.rllib — RL library (minimal new-API-stack equivalent).
+
+Reference: `rllib/core/` (RLModule / Learner / LearnerGroup),
+`rllib/env/single_agent_env_runner.py`, `rllib/algorithms/ppo/ppo.py`.
+TPU-first: the learner update is a single pjit'd SPMD step over the learner
+gang's global mesh (gradients psum over ICI), not DDP-wrapped modules.
+"""
+
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
+
+__all__ = ["PPO", "PPOConfig", "LearnerGroup", "MLPModule", "RLModuleSpec"]

@@ -1,0 +1,171 @@
+"""Algorithm base + config builder.
+
+Reference: `rllib/algorithms/algorithm.py` (Algorithm is a Tune Trainable
+whose `train()` runs one `training_step`) and `algorithm_config.py` (fluent
+builder: .environment().training().env_runners().learners()).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.cartpole import make_env
+from ray_tpu.rllib.env.env_runner import EnvRunner
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.jax_backend import JaxConfig
+
+
+class AlgorithmConfig:
+    def __init__(self):
+        self.env = "CartPole-v1"
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.grad_clip = 0.5
+        self.train_batch_size = 2048
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 4
+        self.num_learners = 1
+        self.jax_platform: Optional[str] = None
+        self.module_hidden = (64, 64)
+        self.seed = 0
+
+    # fluent builder sections (reference algorithm_config.py style)
+    def environment(self, env) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training option '{k}'")
+            setattr(self, k, v)
+        return self
+
+    def env_runners(self, num_env_runners: int = None,
+                    num_envs_per_runner: int = None) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_runner is not None:
+            self.num_envs_per_runner = num_envs_per_runner
+        return self
+
+    def learners(self, num_learners: int = None,
+                 jax_platform: str = None) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if jax_platform is not None:
+            self.jax_platform = jax_platform
+        return self
+
+    def rl_module(self, hidden=None) -> "AlgorithmConfig":
+        if hidden is not None:
+            self.module_hidden = tuple(hidden)
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)
+
+
+class Algorithm:
+    """Owns the env-runner fleet + learner group; `train()` = one iteration.
+
+    Subclasses set `learner_class` and implement `training_step()`.
+    """
+
+    learner_class = None
+
+    def __init__(self, config: AlgorithmConfig):
+        from ray_tpu.rllib.core.learner_group import LearnerGroup
+
+        self.config = config
+        probe_env = make_env(config.env)
+        self.module_spec = RLModuleSpec(
+            observation_space=probe_env.observation_space,
+            action_space=probe_env.action_space,
+            hidden=config.module_hidden)
+        self.env_runners = [
+            EnvRunner.remote(config.env, self.module_spec,
+                             num_envs=config.num_envs_per_runner,
+                             seed=config.seed + i)
+            for i in range(config.num_env_runners)
+        ]
+        self.learner_group = LearnerGroup(
+            self.learner_class, self.module_spec,
+            learner_config=self._learner_config(),
+            scaling_config=ScalingConfig(num_workers=config.num_learners),
+            jax_config=JaxConfig(platform=config.jax_platform))
+        self._iteration = 0
+        self._recent_returns: List[float] = []
+        self._sync_weights()
+
+    def _learner_config(self) -> Dict[str, Any]:
+        return {"lr": self.config.lr, "grad_clip": self.config.grad_clip,
+                "seed": self.config.seed}
+
+    # ------------------------------------------------------------------ train
+    def train(self) -> Dict[str, Any]:
+        self._iteration += 1
+        metrics = self.training_step()
+        metrics["training_iteration"] = self._iteration
+        if self._recent_returns:
+            window = self._recent_returns[-100:]
+            metrics["episode_return_mean"] = float(np.mean(window))
+            metrics["num_episodes"] = len(window)
+        return metrics
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ utils
+    def sample_batch(self, num_steps_per_runner: int
+                     ) -> List[Dict[str, np.ndarray]]:
+        """Parallel rollouts from all runners, time-major fragments."""
+        refs = [r.sample.remote(num_steps_per_runner)
+                for r in self.env_runners]
+        rollouts = ray_tpu.get(refs, timeout=600)
+        for ro in rollouts:
+            self._recent_returns.extend(ro.pop("episode_returns"))
+        return rollouts
+
+    def _sync_weights(self) -> None:
+        weights = self.learner_group.get_weights()
+        ref = ray_tpu.put(weights)
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners],
+                    timeout=600)
+
+    def stop(self) -> None:
+        self.learner_group.shutdown()
+        for r in self.env_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def as_trainable(self):
+        """Function-trainable for the Tuner (reference: Algorithm IS a
+        Trainable; here the function API wraps the loop)."""
+        algo_config = self.config
+
+        def _trainable(config: Dict[str, Any]):
+            from ray_tpu import tune
+
+            cfg = algo_config.copy()
+            for k, v in config.items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+            algo = cfg.build()
+            try:
+                for _ in range(int(config.get("iterations", 10))):
+                    tune.report(algo.train())
+            finally:
+                algo.stop()
+
+        return _trainable

@@ -1,0 +1,113 @@
+"""LearnerGroup — the distributed fleet of Learner actors.
+
+Reference: `rllib/core/learner/learner_group.py:39,149-169` — which builds
+its learner actors by REUSING Ray Train's BackendExecutor. This does the
+same: the executor creates the placement group + worker gang and the
+JaxBackend rendezvouses `jax.distributed` across it, so the learners form
+one global mesh and every `update()` is a lockstep SPMD step.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.train._internal.backend_executor import BackendExecutor
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.jax_backend import JaxConfig
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+_LEARNER = None  # worker-process singleton
+
+
+def _build_learner(learner_cls, module_spec, config):
+    global _LEARNER
+    _LEARNER = learner_cls(module_spec, config)
+    _LEARNER.build()
+    return True
+
+
+def _learner_update(batch, rng_seed):
+    return _LEARNER.update(batch, rng_seed)
+
+
+def _learner_get_weights():
+    return _LEARNER.get_weights()
+
+
+def _learner_set_weights(w):
+    _LEARNER.set_weights(w)
+    return True
+
+
+def _learner_get_state():
+    return _LEARNER.get_state()
+
+
+def _learner_set_state(s):
+    _LEARNER.set_state(s)
+    return True
+
+
+class LearnerGroup:
+    def __init__(self, learner_cls, module_spec: RLModuleSpec,
+                 learner_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 jax_config: Optional[JaxConfig] = None):
+        self._scaling = scaling_config or ScalingConfig(num_workers=1)
+        self._executor = BackendExecutor(
+            jax_config or JaxConfig(), self._scaling, RunConfig(),
+            tempfile.mkdtemp(prefix="rtpu-learners-"))
+        self._executor.start()
+        self._group = self._executor.worker_group
+        self._group.execute(_build_learner, learner_cls, module_spec,
+                            dict(learner_config or {}))
+        self._step = 0
+
+    @property
+    def num_learners(self) -> int:
+        return self._group.num_workers
+
+    # ----------------------------------------------------------------- update
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One lockstep SPMD gradient step: the global batch is split evenly;
+        each learner feeds its process-local shard into the shared mesh."""
+        n = self.num_learners
+        self._step += 1
+        shards = _split_batch(batch, n)
+        refs = [w.execute.remote(_learner_update, shards[i], self._step)
+                for i, w in enumerate(self._group.workers)]
+        metrics = ray_tpu.get(refs, timeout=600)
+        return metrics[0]
+
+    # ---------------------------------------------------------------- weights
+    def get_weights(self) -> Any:
+        return self._group.execute_single(0, _learner_get_weights)
+
+    def set_weights(self, weights: Any) -> None:
+        self._group.execute(_learner_set_weights, weights)
+
+    def get_state(self) -> Any:
+        return self._group.execute_single(0, _learner_get_state)
+
+    def set_state(self, state: Any) -> None:
+        self._group.execute(_learner_set_state, state)
+
+    def shutdown(self) -> None:
+        self._executor.shutdown()
+
+
+def _split_batch(batch: Dict[str, np.ndarray], n: int
+                 ) -> List[Dict[str, np.ndarray]]:
+    if n == 1:
+        return [batch]
+    out: List[Dict[str, np.ndarray]] = [{} for _ in range(n)]
+    for k, v in batch.items():
+        v = np.asarray(v)
+        per = len(v) // n
+        for i in range(n):
+            out[i][k] = v[i * per:(i + 1) * per]
+    return out

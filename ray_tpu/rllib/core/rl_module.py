@@ -1,0 +1,93 @@
+"""RLModule — the neural-network abstraction of the new API stack.
+
+Reference: `rllib/core/rl_module/rl_module.py` (forward_exploration /
+forward_inference / forward_train over a spec-built module). TPU-first:
+a module is a flax.linen network plus pure functions over a param pytree,
+so the learner can pjit the whole update and env runners can run the same
+apply on CPU — one definition, two execution tiers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.env.spaces import Box, Discrete
+
+
+class RLModule:
+    """Pure-functional module: params live outside; methods are jittable."""
+
+    def init(self, rng: jax.Array) -> Any:
+        raise NotImplementedError
+
+    def forward_train(self, params: Any, obs: jax.Array) -> Dict[str, jax.Array]:
+        """Returns at least {"action_logits", "vf"} for actor-critic."""
+        raise NotImplementedError
+
+    def forward_inference(self, params, obs):
+        out = self.forward_train(params, obs)
+        return {"actions": jnp.argmax(out["action_logits"], axis=-1)}
+
+    def forward_exploration(self, params, obs, rng):
+        out = self.forward_train(params, obs)
+        logits = out["action_logits"]
+        actions = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), actions]
+        return {"actions": actions, "logp": logp, "vf": out["vf"]}
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Builds a module from spaces (reference: `rl_module.py` SingleAgent
+    RLModuleSpec)."""
+
+    observation_space: Box
+    action_space: Discrete
+    hidden: Sequence[int] = (64, 64)
+    module_class: Optional[type] = None
+
+    def build(self) -> "RLModule":
+        cls = self.module_class or MLPModule
+        return cls(self.observation_space, self.action_space, self.hidden)
+
+
+class MLPModule(RLModule):
+    """Actor-critic MLP over flax.linen, for vector observations."""
+
+    def __init__(self, observation_space: Box, action_space: Discrete,
+                 hidden: Sequence[int] = (64, 64)):
+        import flax.linen as nn
+
+        obs_dim = int(np.prod(observation_space.shape))
+        n_actions = action_space.n
+
+        class _Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = x
+                for width in hidden:
+                    h = nn.tanh(nn.Dense(width)(h))
+                logits = nn.Dense(n_actions,
+                                  kernel_init=nn.initializers.normal(0.01))(h)
+                hv = x
+                for width in hidden:
+                    hv = nn.tanh(nn.Dense(width)(hv))
+                vf = nn.Dense(1)(hv)
+                return logits, vf[..., 0]
+
+        self._net = _Net()
+        self._obs_dim = obs_dim
+
+    def init(self, rng: jax.Array) -> Any:
+        dummy = jnp.zeros((1, self._obs_dim), jnp.float32)
+        return self._net.init(rng, dummy)
+
+    def forward_train(self, params, obs):
+        logits, vf = self._net.apply(params, obs)
+        return {"action_logits": logits, "vf": vf}

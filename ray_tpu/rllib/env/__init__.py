@@ -1,0 +1,4 @@
+from ray_tpu.rllib.env.cartpole import CartPoleEnv, make_env
+from ray_tpu.rllib.env.spaces import Box, Discrete
+
+__all__ = ["Box", "CartPoleEnv", "Discrete", "make_env"]

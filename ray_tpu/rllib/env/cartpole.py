@@ -1,0 +1,95 @@
+"""CartPole-v1 (classic cart-pole balancing, Barto/Sutton/Anderson 1983).
+
+Implemented from the published dynamics (the textbook Euler-integrated
+equations); the environment image has no gymnasium, so this is the in-repo
+regression env — same physics constants, termination bounds, and 500-step
+cap as the public CartPole-v1, so published reward targets (475) apply.
+Reference analog: RLlib's tuned-example envs (`rllib/tuned_examples/ppo/`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.env.spaces import Box, Discrete
+
+
+class CartPoleEnv:
+    GRAVITY = 9.8
+    MASS_CART = 1.0
+    MASS_POLE = 0.1
+    HALF_POLE_LEN = 0.5
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * math.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, seed: Optional[int] = None):
+        hi = np.array([self.X_LIMIT * 2, np.finfo(np.float32).max,
+                       self.THETA_LIMIT * 2, np.finfo(np.float32).max],
+                      np.float32)
+        self.observation_space = Box(-hi, hi)
+        self.action_space = Discrete(2)
+        self._rng = np.random.RandomState(seed)
+        self._state: Optional[np.ndarray] = None
+        self._steps = 0
+
+    def reset(self, *, seed: Optional[int] = None
+              ) -> Tuple[np.ndarray, Dict[str, Any]]:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self._steps = 0
+        return self._state.copy(), {}
+
+    def step(self, action: int
+             ) -> Tuple[np.ndarray, float, bool, bool, Dict[str, Any]]:
+        assert self._state is not None, "call reset() first"
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE_MAG if action == 1 else -self.FORCE_MAG
+        cos_t, sin_t = math.cos(theta), math.sin(theta)
+        total_mass = self.MASS_CART + self.MASS_POLE
+        pole_ml = self.MASS_POLE * self.HALF_POLE_LEN
+
+        temp = (force + pole_ml * theta_dot ** 2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.HALF_POLE_LEN
+            * (4.0 / 3.0 - self.MASS_POLE * cos_t ** 2 / total_mass))
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+
+        x += self.TAU * x_dot
+        x_dot += self.TAU * x_acc
+        theta += self.TAU * theta_dot
+        theta_dot += self.TAU * theta_acc
+        self._state = np.array([x, x_dot, theta, theta_dot], np.float32)
+        self._steps += 1
+
+        terminated = bool(abs(x) > self.X_LIMIT
+                          or abs(theta) > self.THETA_LIMIT)
+        truncated = self._steps >= self.MAX_STEPS
+        return self._state.copy(), 1.0, terminated, truncated, {}
+
+
+_ENV_REGISTRY = {"CartPole-v1": CartPoleEnv}
+
+
+def register_env(name: str, ctor) -> None:
+    _ENV_REGISTRY[name] = ctor
+
+
+def make_env(spec, seed: Optional[int] = None):
+    """spec: an env id string, a constructor, or an instance factory."""
+    if callable(spec):
+        return spec()
+    ctor = _ENV_REGISTRY.get(spec)
+    if ctor is None:
+        raise KeyError(f"unknown env '{spec}' "
+                       f"(registered: {sorted(_ENV_REGISTRY)})")
+    try:
+        return ctor(seed=seed)
+    except TypeError:
+        return ctor()

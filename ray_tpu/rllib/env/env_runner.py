@@ -1,0 +1,98 @@
+"""EnvRunner — rollout-collection actors.
+
+Reference: `rllib/env/single_agent_env_runner.py` (vectorized gymnasium
+envs + RLModule.forward_exploration). Here the runner steps N env copies in
+lockstep with a batched CPU forward (jax pinned to the host CPU device so a
+TPU-holding driver never contends for the chip).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env.cartpole import make_env
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+
+@ray_tpu.remote(num_cpus=1)
+class EnvRunner:
+    def __init__(self, env_spec, module_spec: RLModuleSpec,
+                 num_envs: int = 1, seed: int = 0):
+        import jax
+
+        self._cpu = jax.devices("cpu")[0]
+        self._envs = [make_env(env_spec, seed=seed * 10007 + i)
+                      for i in range(num_envs)]
+        with jax.default_device(self._cpu):
+            self._module = module_spec.build()
+            self._params = self._module.init(jax.random.key(seed))
+            self._fwd = jax.jit(self._module.forward_exploration)
+        self._rng = jax.random.key(seed + 1)
+        self._obs = np.stack([e.reset(seed=seed * 31 + i)[0]
+                              for i, e in enumerate(self._envs)])
+        self._episode_returns = np.zeros(num_envs)
+        self._completed: List[float] = []
+
+    def set_weights(self, weights) -> bool:
+        import jax
+
+        with jax.default_device(self._cpu):
+            self._params = jax.device_put(weights, self._cpu)
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, Any]:
+        """Collect `num_steps * num_envs` transitions (fragments allowed:
+        episodes are cut at the horizon and bootstrapped by the algorithm
+        via the value head)."""
+        import jax
+
+        n_envs = len(self._envs)
+        obs_buf, act_buf, logp_buf, rew_buf = [], [], [], []
+        done_buf, vf_buf = [], []
+
+        with jax.default_device(self._cpu):
+            for _ in range(num_steps):
+                self._rng, key = jax.random.split(self._rng)
+                out = self._fwd(self._params,
+                                self._obs.astype(np.float32), key)
+                actions = np.asarray(out["actions"])
+                obs_buf.append(self._obs.copy())
+                act_buf.append(actions)
+                logp_buf.append(np.asarray(out["logp"]))
+                vf_buf.append(np.asarray(out["vf"]))
+
+                rewards = np.zeros(n_envs, np.float32)
+                dones = np.zeros(n_envs, bool)
+                for i, env in enumerate(self._envs):
+                    obs, r, term, trunc, _ = env.step(int(actions[i]))
+                    rewards[i] = r
+                    self._episode_returns[i] += r
+                    if term or trunc:
+                        dones[i] = True
+                        self._completed.append(self._episode_returns[i])
+                        self._episode_returns[i] = 0.0
+                        obs, _ = env.reset()
+                    self._obs[i] = obs
+                rew_buf.append(rewards)
+                done_buf.append(dones)
+
+            # Bootstrap value for the final observation of each env lane.
+            self._rng, key = jax.random.split(self._rng)
+            last_vf = np.asarray(self._fwd(
+                self._params, self._obs.astype(np.float32), key)["vf"])
+
+        completed, self._completed = self._completed, []
+        return {
+            # [T, N, ...] time-major rollout fragments
+            "obs": np.stack(obs_buf),
+            "actions": np.stack(act_buf),
+            "logp": np.stack(logp_buf),
+            "rewards": np.stack(rew_buf),
+            "dones": np.stack(done_buf),
+            "vf": np.stack(vf_buf),
+            "last_vf": last_vf,
+            "episode_returns": completed,
+        }

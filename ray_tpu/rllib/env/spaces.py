@@ -1,0 +1,54 @@
+"""Minimal observation/action spaces (gymnasium-compatible surface).
+
+The environment image has no gymnasium; these carry exactly what the
+RLModule/EnvRunner need: shapes, dtypes, and sampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Discrete:
+    n: int
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return ()
+
+    dtype = np.int64
+
+    def sample(self, rng: np.random.RandomState):
+        return int(rng.randint(self.n))
+
+    def contains(self, x) -> bool:
+        return 0 <= int(x) < self.n
+
+
+@dataclasses.dataclass
+class Box:
+    low: np.ndarray
+    high: np.ndarray
+
+    def __post_init__(self):
+        self.low = np.asarray(self.low, np.float32)
+        self.high = np.asarray(self.high, np.float32)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.low.shape
+
+    dtype = np.float32
+
+    def sample(self, rng: np.random.RandomState):
+        return rng.uniform(
+            np.clip(self.low, -10, 10),
+            np.clip(self.high, -10, 10)).astype(np.float32)
+
+    def contains(self, x) -> bool:
+        x = np.asarray(x)
+        return x.shape == self.shape

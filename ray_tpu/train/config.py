@@ -63,4 +63,5 @@ class Result:
     checkpoint: Optional["Any"]  # ray_tpu.train.Checkpoint
     path: str
     metrics_dataframe: Optional[List[Dict[str, Any]]] = None
-    error: Optional[BaseException] = None
+    error: Optional[Any] = None  # str or exception
+    config: Optional[Dict[str, Any]] = None  # trial config (tune runs)

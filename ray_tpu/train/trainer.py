@@ -12,6 +12,7 @@ trainer via `as_trainable()` — see ray_tpu.tune).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import shutil
 import time
@@ -50,9 +51,24 @@ class DataParallelTrainer:
 
     # ------------------------------------------------------------------- fit
     def fit(self) -> Result:
+        """Run as a single-trial Tune experiment (reference
+        `base_trainer.py:567`: every Trainer.fit wraps itself in a Tuner)."""
+        from ray_tpu.tune.tuner import TuneConfig, Tuner
+
         name = self._run_config.name or f"train_{uuid.uuid4().hex[:8]}"
-        experiment_dir = os.path.join(
-            self._run_config.resolved_storage_path(), name)
+        run_config = dataclasses.replace(self._run_config, name=name)
+        tuner = Tuner(
+            self,
+            tune_config=TuneConfig(num_samples=1, max_concurrent_trials=1),
+            run_config=run_config)
+        result = tuner.fit()[0]
+        if result.error:
+            raise TrainingFailedError(str(result.error))
+        return result
+
+    def _run_training(self, experiment_dir: str,
+                      on_report=None) -> Result:
+        """The training orchestration loop (runs inside the trial)."""
         os.makedirs(experiment_dir, exist_ok=True)
 
         executor = BackendExecutor(self._backend_config, self._scaling,
@@ -71,7 +87,7 @@ class DataParallelTrainer:
             while True:
                 try:
                     self._start_and_poll(executor, latest_ckpt_path, history,
-                                         checkpoints)
+                                         checkpoints, on_report)
                     break  # finished cleanly
                 except (TrainingFailedError, Exception) as e:  # noqa: BLE001
                     if history:
@@ -99,7 +115,7 @@ class DataParallelTrainer:
 
     def _start_and_poll(self, executor: BackendExecutor,
                         latest_ckpt_path: Optional[str], history: list,
-                        checkpoints: list) -> None:
+                        checkpoints: list, on_report=None) -> None:
         config = dict(self._config)
         if self._datasets:
             config["__datasets__"] = self._shard_datasets(executor)
@@ -121,6 +137,7 @@ class DataParallelTrainer:
                 metrics.setdefault("training_iteration", len(history) + 1)
                 metrics["timestamp"] = time.time()
                 history.append(metrics)
+            new_ckpt = None
             for rank, (_, ckpt_path) in sorted(reports.items()):
                 if ckpt_path is not None:
                     score = None
@@ -128,6 +145,12 @@ class DataParallelTrainer:
                         score = metrics.get(
                             ckpt_cfg.checkpoint_score_attribute)
                     checkpoints.append((score, ckpt_path))
+                    new_ckpt = ckpt_path
+            # Report before retention: score-based keep-k may evict the
+            # checkpoint that was just created, and the consumer must never
+            # receive an already-deleted path.
+            if on_report is not None and metrics is not None:
+                on_report(metrics, new_ckpt)
             self._enforce_keep_k(checkpoints)
 
     def _enforce_keep_k(self, checkpoints: list) -> None:
@@ -168,21 +191,38 @@ class DataParallelTrainer:
 
     def as_trainable(self):
         """Wrap into a Tune-compatible trainable (reference
-        base_trainer.py:724)."""
+        base_trainer.py:724): the trial runs this trainer's orchestration
+        loop, streaming each worker report to the Tune session so schedulers
+        see intermediate results and checkpoints survive trial restarts."""
         trainer = self
 
         def _trainable(config: Dict[str, Any]):
             import copy
 
+            from ray_tpu import tune
+            from ray_tpu.tune import _session as tsession
+
             t = copy.copy(trainer)
             merged = dict(trainer._config)
             merged.update(config.get("train_loop_config", config))
             t._config = merged
-            result = t.fit()
-            from ray_tpu import tune
 
-            tune.report(result.metrics,
-                        checkpoint=result.checkpoint)
+            session = tsession.get_session()
+            trial_dir = session.trial_dir if session else os.path.join(
+                trainer._run_config.resolved_storage_path(),
+                f"train_{uuid.uuid4().hex[:8]}")
+            resume = tune.get_checkpoint() if session else None
+            if resume is not None:
+                t._resume_checkpoint = resume
+
+            def on_report(metrics, ckpt_path):
+                if tsession.get_session() is None:
+                    return  # running outside a trial: nothing to stream to
+                tune.report(metrics,
+                            checkpoint=(Checkpoint(ckpt_path)
+                                        if ckpt_path else None))
+
+            t._run_training(trial_dir, on_report=on_report)
 
         _trainable.__name__ = f"{type(self).__name__}_trainable"
         return _trainable

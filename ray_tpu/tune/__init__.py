@@ -1,0 +1,19 @@
+"""ray_tpu.tune — hyperparameter search & trial execution.
+
+Reference: `python/ray/tune/` (Tuner, TuneConfig, tune.report, search
+spaces, schedulers). The execution engine (TuneController over trial
+actors) also backs every trainer's `fit()`.
+"""
+
+from ray_tpu.tune._session import get_checkpoint, get_session, report
+from ray_tpu.tune.schedulers import AsyncHyperBandScheduler, FIFOScheduler
+from ray_tpu.tune.search import (
+    choice, grid_search, loguniform, randint, sample_from, uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+__all__ = [
+    "AsyncHyperBandScheduler", "FIFOScheduler", "ResultGrid", "TuneConfig",
+    "Tuner", "choice", "get_checkpoint", "get_session", "grid_search",
+    "loguniform", "randint", "report", "sample_from", "uniform",
+]

@@ -7,6 +7,7 @@ reports flow through a bounded queue back to the controller's poll loop).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import traceback
@@ -51,8 +52,14 @@ class _TuneSession:
             raise _StopTrial()
         ckpt_path = None
         if checkpoint is not None:
-            persisted = checkpoint.persist(
-                self.trial_dir, name=f"checkpoint_{self._counter:06d}")
+            if checkpoint.path.startswith(
+                    os.path.abspath(self.trial_dir) + os.sep):
+                # Already persisted under this trial (e.g. by a nested
+                # trainer's worker session) — no second copy.
+                persisted = checkpoint
+            else:
+                persisted = checkpoint.persist(
+                    self.trial_dir, name=f"checkpoint_{self._counter:06d}")
             self.latest_checkpoint = persisted
             ckpt_path = persisted.path
         self._counter += 1

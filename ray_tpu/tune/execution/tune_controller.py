@@ -88,12 +88,28 @@ class TuneController:
         while pending or running:
             while pending and len(running) < self._max_concurrent:
                 trial = pending.pop(0)
-                actor = _TrialActor.options(
-                    num_cpus=self._resources.get("CPU", 1)).remote()
                 trial_dir = os.path.join(self._dir, trial.trial_id)
-                ray_tpu.get(actor.run.remote(
-                    self._trainable, trial.config, trial_dir,
-                    trial.checkpoint_path), timeout=300)
+                launched = False
+                for attempt in range(2):
+                    actor = _TrialActor.options(
+                        num_cpus=self._resources.get("CPU", 1)).remote()
+                    try:
+                        ray_tpu.get(actor.run.remote(
+                            self._trainable, trial.config, trial_dir,
+                            trial.checkpoint_path), timeout=300)
+                        launched = True
+                        break
+                    except Exception as e:  # actor/worker died at launch
+                        launch_error = e
+                        try:
+                            ray_tpu.kill(actor)
+                        except Exception:
+                            pass
+                if not launched:
+                    trial.status = ERRORED
+                    trial.error = f"trial launch failed: {launch_error}"
+                    self._save_experiment_state()
+                    continue
                 trial.status = RUNNING
                 running[trial.trial_id] = (actor, actor.next_result.remote())
 

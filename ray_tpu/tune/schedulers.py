@@ -36,14 +36,19 @@ class AsyncHyperBandScheduler:
             t *= reduction_factor
         self._rungs = rungs                       # ascending milestones
         self._recorded: Dict[int, List[float]] = defaultdict(list)
+        self._reached: Dict[str, set] = defaultdict(set)  # trial -> rungs
 
     def on_result(self, trial_id: str, iteration: int, value: float) -> str:
         if self.mode == "min":
             value = -value
         if iteration >= self._max_t:
             return STOP
+        # A trial reporting a coarser iteration cadence may skip past a
+        # milestone; evaluate at the highest rung reached but not yet
+        # scored for this trial (reference ASHA: `>= milestone`).
         for rung in reversed(self._rungs):
-            if iteration == rung:
+            if iteration >= rung and rung not in self._reached[trial_id]:
+                self._reached[trial_id].add(rung)
                 recorded = self._recorded[rung]
                 recorded.append(value)
                 k = max(1, int(math.ceil(len(recorded) / self._rf)))

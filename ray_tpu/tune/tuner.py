@@ -1,0 +1,130 @@
+"""Tuner — the user-facing experiment API.
+
+Reference: `python/ray/tune/tuner.py` (Tuner.fit -> tune.run ->
+TuneController) and `tune/result_grid.py` (ResultGrid). Every trainer's
+`fit()` routes through this engine as a single-trial experiment, exactly as
+the reference's `BaseTrainer.fit` wraps itself in a Tuner
+(`train/base_trainer.py:567`).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.config import Result, RunConfig
+from ray_tpu.tune.execution.tune_controller import (
+    ERRORED, Trial, TuneController,
+)
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    search_seed: Optional[int] = None
+    trial_resources: Optional[Dict[str, float]] = None
+
+
+class ResultGrid:
+    """Indexable view over per-trial Results (reference
+    `tune/result_grid.py`)."""
+
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("get_best_result requires a metric")
+        scored = [r for r in self._results
+                  if r.error is None and metric in (r.metrics or {})]
+        if not scored:
+            raise RuntimeError(f"no completed trial reported '{metric}'")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+
+class Tuner:
+    def __init__(self, trainable: Callable = None, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 _restore_path: Optional[str] = None):
+        if trainable is not None and hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._restore_path = _restore_path
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        if self._restore_path:
+            experiment_dir = self._restore_path
+            trials = TuneController.load_experiment_state(experiment_dir)
+        else:
+            name = self._run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+            experiment_dir = os.path.join(
+                self._run_config.resolved_storage_path(), name)
+            configs = BasicVariantGenerator(tc.search_seed).generate(
+                self._param_space, tc.num_samples)
+            trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
+                      for i, cfg in enumerate(configs)]
+
+        scheduler = tc.scheduler
+        if scheduler is not None:
+            # Reference Tune copies TuneConfig metric/mode into the
+            # scheduler; a min-mode experiment with a max-mode scheduler
+            # would prune its BEST trials.
+            if getattr(scheduler, "metric", None) is None:
+                scheduler.metric = tc.metric
+            if tc.mode and getattr(scheduler, "mode", None) != tc.mode:
+                scheduler.mode = tc.mode
+        controller = TuneController(
+            self._trainable, trials, experiment_dir,
+            metric=tc.metric, mode=tc.mode, scheduler=scheduler,
+            max_concurrent=tc.max_concurrent_trials,
+            trial_resources=tc.trial_resources)
+        controller.run()
+        return ResultGrid(controller.results(), tc.metric, tc.mode)
+
+    # -------------------------------------------------------------- restore
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory: finished
+        trials keep their results; interrupted/errored ones restart from
+        their latest checkpoint (reference `tune/tuner.py` Tuner.restore +
+        `tune/execution/experiment_state.py`)."""
+        if not os.path.exists(os.path.join(path, "experiment_state.json")):
+            raise FileNotFoundError(f"no experiment state under {path}")
+        return cls(trainable, tune_config=tune_config,
+                   run_config=run_config, _restore_path=path)

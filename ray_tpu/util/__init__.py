@@ -1,0 +1,5 @@
+"""ray_tpu.util — user-facing utilities (reference: `python/ray/util/`)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+
+__all__ = ["ActorPool"]

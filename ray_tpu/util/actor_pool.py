@@ -1,0 +1,102 @@
+"""ActorPool — round-robin work distribution over a fixed set of actors.
+
+Reference: `python/ray/util/actor_pool.py` (submit/get_next/
+get_next_unordered/map/map_unordered/has_next/has_free/push/pop_idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        self._future_to_actor = {}   # ref -> (index, actor)
+        self._index_to_future = {}   # submission index -> ref
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    # -------------------------------------------------------------- submit
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queues if no actor is free."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            i = self._next_task_index
+            self._next_task_index += 1
+            self._future_to_actor[ref] = (i, actor)
+            self._index_to_future[i] = ref
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    # --------------------------------------------------------------- fetch
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        i = self._next_return_index
+        ref = self._index_to_future[i]
+        # Fetch BEFORE consuming bookkeeping: a get() timeout must leave the
+        # pool intact so the caller can retry.
+        value = ray_tpu.get(ref, timeout=timeout or 600)
+        self._next_return_index += 1
+        self._index_to_future.pop(i)
+        self._return_actor(ref)
+        return value
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next result in completion order."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout or 600)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        ref = ready[0]
+        i, _ = self._future_to_actor[ref]
+        self._index_to_future.pop(i, None)
+        value = ray_tpu.get(ref, timeout=60)
+        self._return_actor(ref)
+        return value
+
+    def _return_actor(self, ref) -> None:
+        _, actor = self._future_to_actor.pop(ref)
+        self._idle.append(actor)
+        while self._pending_submits and self._idle:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    # ----------------------------------------------------------------- map
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # ------------------------------------------------------------ mutation
+    def push(self, actor: Any) -> None:
+        self._idle.append(actor)
+        while self._pending_submits and self._idle:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,132 @@
+"""Distributed FIFO queue backed by a detached-capable actor.
+
+Reference: `python/ray/util/queue.py` (Queue over an _QueueActor with
+put/get/qsize/empty/full + *_nowait + batch variants).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    def put_nowait(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def get_nowait(self):
+        try:
+            return True, self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    def put_nowait_batch(self, items: List[Any]) -> int:
+        n = 0
+        for item in items:
+            if not self.put_nowait(item):
+                break
+            n += 1
+        return n
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        out = []
+        for _ in range(num_items):
+            ok, item = self.get_nowait()
+            if not ok:
+                break
+            out.append(item)
+        return out
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        self.maxsize = maxsize
+        self.actor = _QueueActor.options(**(actor_options or {})).remote(
+            maxsize)
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            if not ray_tpu.get(self.actor.put_nowait.remote(item),
+                               timeout=30):
+                raise Full()
+            return
+        ok = ray_tpu.get(self.actor.put.remote(item, timeout),
+                         timeout=(timeout or 3600) + 30)
+        if not ok:
+            raise Full()
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote(),
+                                   timeout=30)
+        else:
+            ok, item = ray_tpu.get(self.actor.get.remote(timeout),
+                                   timeout=(timeout or 3600) + 30)
+        if not ok:
+            raise Empty()
+        return item
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        n = ray_tpu.get(self.actor.put_nowait_batch.remote(list(items)),
+                        timeout=60)
+        if n < len(items):
+            raise Full(f"only {n}/{len(items)} items fit")
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        return ray_tpu.get(self.actor.get_nowait_batch.remote(num_items),
+                           timeout=60)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote(), timeout=30)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self.actor)

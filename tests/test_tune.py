@@ -1,0 +1,155 @@
+"""Tune-equivalent: Tuner/TuneController trial execution, search spaces,
+ASHA early stopping, experiment restore, and Trainer.fit routed through the
+tune engine (reference: `tune/execution/tune_controller.py:72`,
+`tune/tuner.py`, `tune/schedulers/async_hyperband.py`)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune import AsyncHyperBandScheduler, TuneConfig, Tuner
+
+
+def quadratic(config):
+    # Converges toward score = 10 - (x-3)^2 over iterations.
+    x = config["x"]
+    best = 10 - (x - 3.0) ** 2
+    for i in range(1, config.get("iters", 5) + 1):
+        frac = i / config.get("iters", 5)
+        tune.report({"score": best * frac, "x": x})
+
+
+def test_grid_search_runs_all_trials(ray_start_regular, tmp_path):
+    tuner = Tuner(
+        quadratic,
+        param_space={"x": tune.grid_search([1.0, 3.0, 5.0]), "iters": 3},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 3
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.config["x"] == 3.0
+    assert best.metrics["score"] == pytest.approx(10.0)
+
+
+def test_random_search_and_num_samples(ray_start_regular, tmp_path):
+    tuner = Tuner(
+        quadratic,
+        param_space={"x": tune.uniform(0.0, 6.0), "iters": 2},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=4,
+                               search_seed=7),
+        run_config=RunConfig(name="rand", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 4
+    xs = [r.config["x"] for r in grid]
+    assert len(set(xs)) == 4  # sampled, not repeated
+    assert all(0.0 <= x <= 6.0 for x in xs)
+
+
+def test_asha_stops_bad_trials_early(ray_start_regular, tmp_path):
+    tuner = Tuner(
+        quadratic,
+        param_space={"x": tune.grid_search([3.0, 30.0, 40.0]), "iters": 9},
+        tune_config=TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=3,
+            scheduler=AsyncHyperBandScheduler(max_t=9, grace_period=1,
+                                              reduction_factor=3)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    # The good trial reaches max_t; at least one bad trial stops early.
+    by_x = {r.config["x"]: r for r in grid}
+    assert len(by_x[3.0].metrics_dataframe) >= \
+        max(len(by_x[30.0].metrics_dataframe),
+            len(by_x[40.0].metrics_dataframe))
+    assert any(len(r.metrics_dataframe) < 9 for r in grid)
+
+
+def failing_trial(config):
+    tune.report({"score": 1.0})
+    if config["x"] > 0:
+        raise RuntimeError("boom")
+    tune.report({"score": 2.0})
+
+
+def test_errored_trial_is_isolated(ray_start_regular, tmp_path):
+    tuner = Tuner(
+        failing_trial,
+        param_space={"x": tune.grid_search([0, 1])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid.errors) == 1
+    best = grid.get_best_result()
+    assert best.config["x"] == 0
+    assert best.metrics["score"] == 2.0
+
+
+def checkpointed_trial(config):
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    ckpt = tune.get_checkpoint()
+    start = ckpt.to_dict()["i"] + 1 if ckpt is not None else 0
+    marker = config["marker_dir"]
+    for i in range(start, 6):
+        tune.report({"i": i, "score": float(i)},
+                    checkpoint=Checkpoint.from_dict({"i": i}))
+        if i == 2 and not os.path.exists(os.path.join(marker, "died")):
+            open(os.path.join(marker, "died"), "w").close()
+            os._exit(1)  # hard-kill the trial actor mid-experiment
+
+
+def test_experiment_restore_resumes_from_checkpoint(ray_start_regular,
+                                                    tmp_path):
+    marker = str(tmp_path / "marker")
+    os.makedirs(marker)
+    run = RunConfig(name="resume", storage_path=str(tmp_path))
+    tuner = Tuner(
+        checkpointed_trial,
+        param_space={"marker_dir": marker},
+        tune_config=TuneConfig(metric="score", mode="max"))
+    tuner._run_config = run
+    grid = tuner.fit()
+    assert len(grid.errors) == 1  # killed mid-flight
+
+    exp_dir = str(tmp_path / "resume")
+    restored = Tuner.restore(
+        exp_dir, checkpointed_trial,
+        tune_config=TuneConfig(metric="score", mode="max"))
+    grid2 = restored.fit()
+    assert not grid2.errors
+    result = grid2[0]
+    assert result.metrics["i"] == 5
+    # Resumed from the iteration-2 checkpoint, not from scratch: the marker
+    # prevented a second death, and history contains only post-resume iters.
+    iters = [m["i"] for m in result.metrics_dataframe]
+    assert iters[0] == 3
+
+
+def test_trainer_fit_routes_through_tune(ray_start_regular, tmp_path):
+    """JaxTrainer.fit() runs as a single-trial tune experiment."""
+    from ray_tpu.train import JaxConfig, JaxTrainer, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+
+        for i in range(3):
+            train.report({"loss": 1.0 / (i + 1)})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        jax_config=JaxConfig(platform="cpu", num_cpu_devices=2),
+        run_config=RunConfig(name="fit_via_tune",
+                             storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.metrics["loss"] == pytest.approx(1.0 / 3)
+    assert len(result.metrics_dataframe) == 3
+    # Experiment state persisted by the tune engine.
+    assert os.path.exists(
+        str(tmp_path / "fit_via_tune" / "experiment_state.json"))

@@ -1,0 +1,76 @@
+"""ray_tpu.util: ActorPool + Queue (reference: `python/ray/util/
+actor_pool.py`, `util/queue.py`)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_map_ordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_actor_pool_map_unordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v), range(8)))
+    assert sorted(out) == [2 * i for i in range(8)]
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    pool = ActorPool([Doubler.remote()])
+    assert pool.has_free()
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 2)  # queued
+    assert pool.has_next()
+    assert pool.get_next() == 2
+    assert pool.get_next() == 4
+    assert not pool.has_next()
+
+
+def test_actor_pool_push_pop(ray_start_regular):
+    pool = ActorPool([Doubler.remote()])
+    a = pool.pop_idle()
+    assert a is not None
+    assert pool.pop_idle() is None
+    pool.push(a)
+    assert pool.has_free()
+
+
+def test_queue_fifo(ray_start_regular):
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get() for _ in range(5)] == list(range(5))
+    assert q.empty()
+    q.shutdown()
+
+
+def test_queue_nowait_and_batch(ray_start_regular):
+    q = Queue(maxsize=3)
+    q.put_nowait_batch([1, 2, 3])
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(4)
+    assert q.get_nowait_batch(2) == [1, 2]
+    assert q.get_nowait() == 3
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_blocking_timeout(ray_start_regular):
+    q = Queue()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
