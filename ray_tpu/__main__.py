@@ -1,0 +1,3 @@
+from ray_tpu.scripts.cli import main
+
+main()

@@ -90,6 +90,9 @@ _define("gcs_rpc_port", int, 0, "0 = pick a free port.")
 # --- workers ---
 _define("worker_register_timeout_s", float, 30.0, "")
 _define("worker_startup_batch", int, 4, "Prestarted workers per node.")
+_define("object_store_backend", str, "native",
+        "Per-node store backend: 'native' (C++ arena allocator, "
+        "native/arena_store.cpp) or 'files' (file-per-object fallback).")
 _define("worker_pool_min_idle", int, 2,
         "Keep at least this many warm workers per active job so actor "
         "creation after kills never pays a Python cold start "

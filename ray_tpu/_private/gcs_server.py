@@ -127,8 +127,102 @@ class GcsServer:
             "publish", "poll", "push_task_events", "get_task_events",
             "register_worker", "list_workers", "get_system_config",
             "cluster_resources", "available_resources", "internal_stats",
+            "metrics_text",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
+
+    # --------------------------------------------------------------- metrics
+    async def _h_metrics_text(self) -> str:
+        """Cluster metrics in Prometheus exposition format (reference:
+        `stats/metric_defs.h` + MetricsAgent -> Prometheus scrape)."""
+        lines = [
+            "# HELP rtpu_nodes_total Nodes by liveness state.",
+            "# TYPE rtpu_nodes_total gauge",
+        ]
+        by_state: Dict[str, int] = defaultdict(int)
+        for info in self.nodes.values():
+            by_state[info["state"]] += 1
+        for state, n in by_state.items():
+            lines.append(f'rtpu_nodes_total{{state="{state}"}} {n}')
+
+        lines += ["# HELP rtpu_actors_total Actors by lifecycle state.",
+                  "# TYPE rtpu_actors_total gauge"]
+        actor_states: Dict[str, int] = defaultdict(int)
+        for a in self.actors.values():
+            actor_states[a.get("state", "UNKNOWN")] += 1
+        for state, n in actor_states.items():
+            lines.append(f'rtpu_actors_total{{state="{state}"}} {n}')
+
+        lines += ["# HELP rtpu_tasks_events_total Task lifecycle events "
+                  "recorded (ring buffer).",
+                  "# TYPE rtpu_tasks_events_total gauge"]
+        task_states: Dict[str, int] = defaultdict(int)
+        for e in self.task_events:
+            task_states[e.get("state", "UNKNOWN")] += 1
+        for state, n in task_states.items():
+            lines.append(f'rtpu_tasks_events_total{{state="{state}"}} {n}')
+
+        lines += ["# HELP rtpu_resource_total Cluster resource capacity.",
+                  "# TYPE rtpu_resource_total gauge",
+                  "# HELP rtpu_resource_available Cluster resource "
+                  "availability.",
+                  "# TYPE rtpu_resource_available gauge"]
+        for snap in self._nodes_snapshot():
+            if snap["state"] != ALIVE:
+                continue
+            nid = snap["node_id"].hex()[:12]
+            for key, val in snap["total"].items():
+                lines.append(
+                    f'rtpu_resource_total{{node="{nid}",resource="{key}"}} '
+                    f'{val}')
+            for key, val in snap["available"].items():
+                lines.append(
+                    f'rtpu_resource_available{{node="{nid}",'
+                    f'resource="{key}"}} {val}')
+
+        lines += ["# HELP rtpu_placement_groups_total Placement groups by "
+                  "state.",
+                  "# TYPE rtpu_placement_groups_total gauge"]
+        pg_states: Dict[str, int] = defaultdict(int)
+        for pg in self.placement_groups.values():
+            pg_states[pg.get("state", "UNKNOWN")] += 1
+        for state, n in pg_states.items():
+            lines.append(
+                f'rtpu_placement_groups_total{{state="{state}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+    def start_metrics_http(self, port: int = 0) -> int:
+        """Serve GET /metrics for Prometheus scrapers (stdlib HTTP)."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        gcs = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                fut = get_io_loop().submit(gcs._h_metrics_text())
+                body = fut.result(timeout=30).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer((self.server._host, port), _Handler)
+        threading.Thread(target=server.serve_forever, daemon=True,
+                         name="gcs-metrics-http").start()
+        self._metrics_http = server
+        port = server.server_address[1]
+        self.kv["__internal__"]["metrics_port"] = str(port).encode()
+        return port
 
     # ------------------------------------------------------------- node mgmt
     async def _h_register_node(self, node_id, addr, resources, labels,
@@ -687,6 +781,8 @@ def main():
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--system-config", default="{}")
     parser.add_argument("--fate-share-pid", type=int, default=0)
+    # Identification only (lets `pkill -f <session-dir>` target one cluster).
+    parser.add_argument("--session-dir", default="")
     args = parser.parse_args()
 
     GlobalConfig.load_system_config(args.system_config)
@@ -695,8 +791,10 @@ def main():
     watch_parent(args.fate_share_pid)
     gcs = GcsServer(args.host, args.port)
     port = gcs.start()
-    # Parent discovers the port from stdout.
+    metrics_port = gcs.start_metrics_http()
+    # Parent discovers the ports from stdout.
     print(f"GCS_PORT={port}", flush=True)
+    print(f"METRICS_PORT={metrics_port}", flush=True)
     import threading
     threading.Event().wait()
 

@@ -1,0 +1,149 @@
+"""ctypes binding for the native arena store (native/arena_store.cpp).
+
+The .so builds on first use with the in-image g++ (no pybind11 — plain
+C ABI). `load()` returns None when the toolchain is unavailable, and the
+store falls back to the file-per-object backend.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libarena_store.so")
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+_LOAD_FAILED = False
+
+
+def _configure(lib) -> None:
+    u64 = ctypes.c_uint64
+    lib.rtpu_store_open.restype = ctypes.c_void_p
+    lib.rtpu_store_open.argtypes = [ctypes.c_char_p, u64]
+    lib.rtpu_store_close.argtypes = [ctypes.c_void_p]
+    lib.rtpu_store_create.restype = u64
+    lib.rtpu_store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64]
+    lib.rtpu_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.POINTER(u64), ctypes.POINTER(u64)]
+    lib.rtpu_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_store_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+    lib.rtpu_store_addref.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int]
+    lib.rtpu_store_evict.argtypes = [ctypes.c_void_p, u64, ctypes.c_char_p,
+                                     u64]
+    lib.rtpu_store_lru_pinned.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, u64,
+        ctypes.POINTER(u64), ctypes.POINTER(u64)]
+    lib.rtpu_store_stats.argtypes = [ctypes.c_void_p, u64 * 4]
+
+
+def load():
+    """Build (once) + dlopen the arena store; None if unavailable."""
+    global _LIB, _LOAD_FAILED
+    if _LIB is not None or _LOAD_FAILED:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None or _LOAD_FAILED:
+            return _LIB
+        try:
+            src = os.path.join(_NATIVE_DIR, "arena_store.cpp")
+            if (not os.path.exists(_SO_PATH)
+                    or os.path.getmtime(_SO_PATH) < os.path.getmtime(src)):
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_SO_PATH)
+            _configure(lib)
+            _LIB = lib
+        except Exception:
+            _LOAD_FAILED = True
+    return _LIB
+
+
+_UINT64_MAX = 2 ** 64 - 1
+
+
+class ArenaStore:
+    """Thin OO wrapper over the C handle (ids are hex strings)."""
+
+    def __init__(self, arena_path: str, capacity: int):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native arena store unavailable")
+        self._h = self._lib.rtpu_store_open(arena_path.encode(), capacity)
+        if not self._h:
+            raise RuntimeError(f"could not open arena at {arena_path}")
+        self.path = arena_path
+        self.capacity = capacity
+
+    def create(self, oid: bytes, size: int) -> Optional[int]:
+        off = self._lib.rtpu_store_create(self._h, oid.hex().encode(), size)
+        return None if off == _UINT64_MAX else off
+
+    def seal(self, oid: bytes) -> bool:
+        return self._lib.rtpu_store_seal(self._h, oid.hex().encode()) == 0
+
+    def get(self, oid: bytes) -> Optional[Tuple[int, int]]:
+        """(offset, size) of a sealed object, else None."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rtpu_store_get(self._h, oid.hex().encode(),
+                                      ctypes.byref(off), ctypes.byref(size))
+        return (off.value, size.value) if rc == 0 else None
+
+    def contains(self, oid: bytes) -> bool:
+        return bool(self._lib.rtpu_store_contains(self._h,
+                                                  oid.hex().encode()))
+
+    def delete(self, oid: bytes) -> bool:
+        return self._lib.rtpu_store_delete(self._h, oid.hex().encode()) == 0
+
+    def addref(self, oid: bytes, delta: int) -> int:
+        return self._lib.rtpu_store_addref(self._h, oid.hex().encode(),
+                                           delta)
+
+    def pin(self, oid: bytes, pinned: bool) -> None:
+        self._lib.rtpu_store_pin(self._h, oid.hex().encode(),
+                                 1 if pinned else 0)
+
+    def evict_for(self, needed: int) -> List[bytes]:
+        buf = ctypes.create_string_buffer(64 * 1024)
+        n = self._lib.rtpu_store_evict(self._h, needed, buf, len(buf))
+        out: List[bytes] = []
+        raw = buf.raw
+        pos = 0
+        for _ in range(n):
+            end = raw.index(b"\0", pos)
+            if end == pos:
+                break
+            out.append(bytes.fromhex(raw[pos:end].decode()))
+            pos = end + 1
+        return out
+
+    def lru_pinned(self) -> Optional[Tuple[bytes, int, int]]:
+        buf = ctypes.create_string_buffer(128)
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rtpu_store_lru_pinned(
+            self._h, buf, len(buf), ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        return bytes.fromhex(buf.value.decode()), off.value, size.value
+
+    def stats(self) -> Tuple[int, int, int, int]:
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.rtpu_store_stats(self._h, out)
+        return tuple(out)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rtpu_store_close(self._h)
+            self._h = None

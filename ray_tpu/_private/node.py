@@ -88,9 +88,15 @@ class Node:
         object_store_memory: Optional[int] = None,
         system_config: Optional[Dict] = None,
         session_dir: Optional[str] = None,
+        fate_share: bool = True,
+        gcs_port: int = 0,
     ):
         self.head = head
         self.host = "127.0.0.1"
+        # CLI-started nodes (`ray_tpu start`) outlive the starting process;
+        # init()-started ones die with their driver.
+        self._fate_share = fate_share
+        self._gcs_port = gcs_port
         self.node_id = NodeID.from_random()
         self._procs: list = []
         self.session_dir = session_dir or os.path.join(
@@ -111,7 +117,8 @@ class Node:
             object_store_memory=object_store_memory)
         self.labels = labels or {}
         self.raylet_addr = self._start_raylet(object_store_memory)
-        atexit.register(self.shutdown)
+        if fate_share:
+            atexit.register(self.shutdown)
 
     # ------------------------------------------------------------------ procs
     def _daemon_env(self):
@@ -123,9 +130,11 @@ class Node:
         log = open(os.path.join(self.session_dir, "logs", "gcs.err"), "wb")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.gcs_server",
-             "--host", self.host, "--port", "0",
+             "--host", self.host, "--port", str(self._gcs_port),
              "--system-config", json.dumps(self._system_config),
-             "--fate-share-pid", str(os.getpid())],
+             "--session-dir", self.session_dir,
+             "--fate-share-pid",
+             str(os.getpid() if self._fate_share else 0)],
             stdout=subprocess.PIPE, stderr=log, env=self._daemon_env(),
             start_new_session=True)
         port = _read_port(proc, "GCS_PORT=")
@@ -147,7 +156,8 @@ class Node:
              "--session-dir", self.session_dir,
              "--object-store-capacity",
              str(object_store_memory or GlobalConfig.object_store_memory),
-             "--fate-share-pid", str(os.getpid())],
+             "--fate-share-pid",
+             str(os.getpid() if self._fate_share else 0)],
             stdout=subprocess.PIPE, stderr=log, env=self._daemon_env(),
             start_new_session=True)
         port = _read_port(proc, "RAYLET_PORT=")

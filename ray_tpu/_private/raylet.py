@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import hashlib
 import json
 import os
 import subprocess
@@ -37,11 +38,18 @@ from ray_tpu._private.scheduling_policy import (
 
 class _WorkerHandle:
     def __init__(self, worker_id: bytes, proc: subprocess.Popen,
-                 addr: Tuple[str, int], job_id: bytes):
+                 addr: Tuple[str, int], job_id: bytes,
+                 pool_key: Optional[bytes] = None,
+                 runtime_env: Optional[Dict[str, Any]] = None):
         self.worker_id = worker_id
         self.proc = proc
         self.addr = addr
         self.job_id = job_id
+        # Pool identity: (job, runtime-env hash) — reference worker_pool
+        # keys cached workers the same way so a task never runs in another
+        # env's worker.
+        self.pool_key = pool_key if pool_key is not None else job_id
+        self.runtime_env = runtime_env
         self.lease: Optional[Dict[str, Any]] = None  # demand + tpu ids
         self.is_actor = False
         self.actor_id: Optional[bytes] = None
@@ -87,7 +95,8 @@ class Raylet:
 
         # --- worker pool ---
         self.workers: Dict[bytes, _WorkerHandle] = {}
-        self._idle: Dict[bytes, deque] = defaultdict(deque)  # job -> handles
+        # Keyed by pool_key = job_id (+ runtime-env hash when set).
+        self._idle: Dict[bytes, deque] = defaultdict(deque)
         self._starting: Dict[bytes, int] = defaultdict(int)
         self._pending_pop: Dict[bytes, deque] = defaultdict(deque)
         self._max_workers = (GlobalConfig.max_workers_per_node
@@ -102,6 +111,10 @@ class Raylet:
         self._bundles: Dict[Tuple[bytes, int], Dict[str, Any]] = {}
 
         self._remote_raylets: Dict[Tuple[str, int], RpcClient] = {}
+        # client (worker_id) -> oids it holds arena mappings of; released
+        # in bulk when the client process dies (plasma: per-client object
+        # refs cleared on disconnect).
+        self._client_mapped: Dict[bytes, Set[bytes]] = defaultdict(set)
         self._dead = False
 
     # ------------------------------------------------------------------- boot
@@ -127,6 +140,7 @@ class Raylet:
             "register_worker", "worker_exiting",
             "create_object", "seal_object", "get_object", "contains_object",
             "delete_objects", "pin_object", "unpin_object", "read_chunk",
+            "release_object", "release_objects",
             "object_info", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "kill_worker", "node_stats", "shutdown_node", "get_tasks_info",
@@ -178,13 +192,32 @@ class Raylet:
         env["RAY_TPU_NODE_IP"] = self.host
         return env
 
-    def _spawn_worker(self, job_id: bytes) -> None:
-        self._starting[job_id] += 1
+    @staticmethod
+    def _pool_key(job_id: bytes, runtime_env: Optional[Dict[str, Any]]
+                  ) -> bytes:
+        if not runtime_env:
+            return job_id
+        digest = hashlib.md5(json.dumps(
+            runtime_env, sort_keys=True, default=str).encode()).digest()
+        return job_id + digest[:8]
+
+    def _spawn_worker(self, job_id: bytes,
+                      runtime_env: Optional[Dict[str, Any]] = None) -> None:
+        pool_key = self._pool_key(job_id, runtime_env)
+        self._starting[pool_key] += 1
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         worker_id = WorkerID.from_random()
         out = open(os.path.join(
             log_dir, f"worker-{worker_id.hex()[:12]}.out"), "wb")
+        env = self._worker_env()
+        if runtime_env:
+            # Applied at worker spawn (reference: RuntimeEnvContext.exec_worker
+            # runs the worker inside the env) — not mutated per-task.
+            for key, val in (runtime_env.get("env_vars") or {}).items():
+                env[str(key)] = str(val)
+            if runtime_env.get("working_dir"):
+                env["RAY_TPU_WORKING_DIR"] = str(runtime_env["working_dir"])
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main",
              "--raylet-host", self.host,
@@ -195,10 +228,11 @@ class Raylet:
              "--worker-id", worker_id.hex(),
              "--job-id", job_id.hex(),
              "--session-dir", self.session_dir],
-            stdout=out, stderr=subprocess.STDOUT, env=self._worker_env(),
+            stdout=out, stderr=subprocess.STDOUT, env=env,
             start_new_session=True)
         # Handle is completed when the worker registers back.
-        handle = _WorkerHandle(worker_id.binary(), proc, ("", 0), job_id)
+        handle = _WorkerHandle(worker_id.binary(), proc, ("", 0), job_id,
+                               pool_key=pool_key, runtime_env=runtime_env)
         self.workers[worker_id.binary()] = handle
 
     async def _h_register_worker(self, worker_id, port, pid, job_id):
@@ -206,43 +240,50 @@ class Raylet:
         if handle is None:
             return {"ok": False}
         handle.addr = (self.host, port)
-        self._starting[job_id] = max(0, self._starting[job_id] - 1)
+        key = handle.pool_key
+        self._starting[key] = max(0, self._starting[key] - 1)
         self._offer_worker(handle)
         return {"ok": True, "system_config": GlobalConfig.dump_system_config()}
 
     def _offer_worker(self, handle: _WorkerHandle):
-        waiters = self._pending_pop[handle.job_id]
+        waiters = self._pending_pop[handle.pool_key]
         while waiters:
             fut = waiters.popleft()
             if not fut.done():
                 fut.set_result(handle)
                 return
         handle.last_idle = time.monotonic()
-        self._idle[handle.job_id].append(handle)
+        self._idle[handle.pool_key].append(handle)
 
-    def _maybe_replenish(self, job_id: bytes) -> None:
+    def _maybe_replenish(self, job_id: bytes,
+                         runtime_env: Optional[Dict[str, Any]] = None
+                         ) -> None:
         """Keep a floor of warm workers so the next actor creation (e.g.
         tune trials launched after kills) never serializes on a Python
         cold start."""
+        pool_key = self._pool_key(job_id, runtime_env)
         # Workers still starting but already promised to waiting pops are
         # not warm capacity.
-        warm = (len(self._idle[job_id]) + self._starting[job_id]
-                - len(self._pending_pop[job_id]))
+        warm = (len(self._idle[pool_key]) + self._starting[pool_key]
+                - len(self._pending_pop[pool_key]))
         n_live = sum(1 for w in self.workers.values()
                      if w.job_id == job_id)
         want = GlobalConfig.worker_pool_min_idle
         while warm < want and n_live < self._max_workers:
-            self._spawn_worker(job_id)
+            self._spawn_worker(job_id, runtime_env)
             warm += 1
             n_live += 1
 
-    async def _pop_worker(self, job_id: bytes, timeout: float = 60.0
+    async def _pop_worker(self, job_id: bytes,
+                          runtime_env: Optional[Dict[str, Any]] = None,
+                          timeout: float = 60.0
                           ) -> Optional[_WorkerHandle]:
-        idle = self._idle[job_id]
+        pool_key = self._pool_key(job_id, runtime_env)
+        idle = self._idle[pool_key]
         while idle:
             handle = idle.popleft()
             if handle.proc.poll() is None:
-                self._maybe_replenish(job_id)
+                self._maybe_replenish(job_id, runtime_env)
                 return handle
             self.workers.pop(handle.worker_id, None)
         n_live = sum(1 for w in self.workers.values()
@@ -252,13 +293,13 @@ class Raylet:
             # demand so bursts don't serialize on process spawn (reference:
             # worker pool prestart, `worker_pool.cc`).
             n_spawn = 1
-            if n_live == 0:
+            if n_live == 0 and not runtime_env:
                 n_spawn = min(GlobalConfig.worker_startup_batch,
                               self._max_workers)
             for _ in range(n_spawn):
-                self._spawn_worker(job_id)
+                self._spawn_worker(job_id, runtime_env)
         fut = asyncio.get_running_loop().create_future()
-        self._pending_pop[job_id].append(fut)
+        self._pending_pop[pool_key].append(fut)
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
@@ -273,14 +314,21 @@ class Raylet:
                 if code is None:
                     continue
                 self.workers.pop(worker_id, None)
+                if handle.addr == ("", 0):
+                    # Died before registering: undo its _starting slot or the
+                    # warm-pool floor is suppressed forever.
+                    self._starting[handle.pool_key] = max(
+                        0, self._starting[handle.pool_key] - 1)
+                for oid in self._client_mapped.pop(worker_id, ()):
+                    self.store.release_client(oid)
                 try:
-                    self._idle[handle.job_id].remove(handle)
+                    self._idle[handle.pool_key].remove(handle)
                 except ValueError:
                     pass
                 if handle.is_actor:
                     # Replace the dead actor worker eagerly so the next
                     # actor creation finds a warm process.
-                    self._maybe_replenish(handle.job_id)
+                    self._maybe_replenish(handle.job_id, handle.runtime_env)
                 if handle.lease is not None:
                     self._release_lease(handle)
                 if handle.is_actor and handle.actor_id is not None:
@@ -308,7 +356,7 @@ class Raylet:
     async def _h_request_worker_lease(self, demand, job_id, strategy_kind="DEFAULT",
                                       strategy_node=None, soft=False,
                                       hard_labels=None, soft_labels=None,
-                                      lease_timeout=25.0):
+                                      lease_timeout=25.0, runtime_env=None):
         """Returns {granted, worker_addr, worker_id, tpu_ids} |
         {spillback_to: addr} | {infeasible: True} | {timeout: True}."""
         from ray_tpu._private.task_spec import SchedulingStrategySpec
@@ -323,12 +371,12 @@ class Raylet:
         if (strategy_kind in ("DEFAULT", "PLACEMENT_GROUP")
                 and self.local.available.is_superset_of(demand_rs)):
             return await self._grant_local(demand_rs, job_id, timeout,
-                                           strategy)
+                                           strategy, runtime_env)
 
         target = pick_node(self.view, demand_rs, strategy, self.node_id)
         if target == self.node_id:
             return await self._grant_local(demand_rs, job_id, timeout,
-                                           strategy)
+                                           strategy, runtime_env)
         if target is not None:
             return {"spillback_to": self._node_addrs.get(target),
                     "spillback_node": target}
@@ -338,7 +386,8 @@ class Raylet:
         if (self.local.is_feasible(demand_rs)
                 and self._strategy_allows_local(strategy)):
             fut = asyncio.get_running_loop().create_future()
-            self._lease_queue.append((demand_rs, job_id, strategy, fut))
+            self._lease_queue.append((demand_rs, job_id, strategy, fut,
+                                      runtime_env))
             self._lease_queue_event.set()
             try:
                 return await asyncio.wait_for(fut, timeout)
@@ -359,17 +408,18 @@ class Raylet:
         return {"retry": True}
 
     async def _grant_local(self, demand: ResourceSet, job_id: bytes,
-                           timeout: float, strategy=None):
+                           timeout: float, strategy=None, runtime_env=None):
         if not self.local.try_allocate(demand):
             fut = asyncio.get_running_loop().create_future()
-            self._lease_queue.append((demand, job_id, strategy, fut))
+            self._lease_queue.append((demand, job_id, strategy, fut,
+                                      runtime_env))
             self._lease_queue_event.set()
             try:
                 return await asyncio.wait_for(fut, timeout)
             except asyncio.TimeoutError:
                 return {"timeout": True}
         tpu_ids = self._take_tpu_chips(demand)
-        handle = await self._pop_worker(job_id)
+        handle = await self._pop_worker(job_id, runtime_env)
         if handle is None:
             self.local.release(demand)
             self._release_tpu_chips(demand, tpu_ids)
@@ -492,12 +542,13 @@ class Raylet:
             self._lease_queue_event.clear()
             pending = len(self._lease_queue)
             for _ in range(pending):
-                demand, job_id, strategy, fut = self._lease_queue.popleft()
+                (demand, job_id, strategy, fut,
+                 runtime_env) = self._lease_queue.popleft()
                 if fut.done():
                     continue
                 if self.local.available.is_superset_of(demand):
                     reply = await self._grant_local(demand, job_id, 60.0,
-                                                    strategy)
+                                                    strategy, runtime_env)
                     if not fut.done():
                         fut.set_result(reply)
                     continue
@@ -510,7 +561,8 @@ class Raylet:
                             {"spillback_to": self._node_addrs[target],
                              "spillback_node": target})
                     continue
-                self._lease_queue.append((demand, job_id, strategy, fut))
+                self._lease_queue.append((demand, job_id, strategy, fut,
+                                          runtime_env))
             await asyncio.sleep(0.005)
 
     async def _h_return_worker(self, worker_id, kill=False):
@@ -531,7 +583,8 @@ class Raylet:
         if not self.local.try_allocate(demand_rs):
             return {"ok": False, "reason": "resources busy"}
         tpu_ids = self._take_tpu_chips(demand_rs)
-        handle = await self._pop_worker(spec.job_id.binary())
+        handle = await self._pop_worker(spec.job_id.binary(),
+                                        getattr(spec, "runtime_env", None))
         if handle is None:
             self.local.release(demand_rs)
             self._release_tpu_chips(demand_rs, tpu_ids)
@@ -564,19 +617,28 @@ class Raylet:
 
     # ------------------------------------------------------------ object store
     async def _h_create_object(self, object_id, size):
-        return self.store.create(object_id, size)
+        path, offset = self.store.create(object_id, size)
+        return {"path": path, "offset": offset}
 
     async def _h_seal_object(self, object_id):
         self.store.seal(object_id)
         return True
 
-    async def _h_get_object(self, object_id, wait_timeout=None, locations=None):
+    def _track_client_ref(self, object_id, client_id) -> None:
+        self.store.addref_client(object_id)
+        if client_id:
+            self._client_mapped[client_id].add(object_id)
+
+    async def _h_get_object(self, object_id, wait_timeout=None, locations=None,
+                            client_id=None):
         timeout = wait_timeout
         """Wait locally; if absent and locations are known, pull from a
         remote raylet in chunks (reference: PullManager + ObjectManager)."""
         found = await self.store.get(object_id, timeout=0.0)
         if found is not None:
-            return {"path": found[0], "size": found[1]}
+            self._track_client_ref(object_id, client_id)
+            return {"path": found[0], "size": found[1],
+                    "offset": found[2]}
         if locations:
             for node_id in locations:
                 if node_id == self.node_id:
@@ -588,7 +650,9 @@ class Raylet:
                     await self._pull_from(object_id, addr)
                     found = await self.store.get(object_id, timeout=1.0)
                     if found is not None:
-                        return {"path": found[0], "size": found[1]}
+                        self._track_client_ref(object_id, client_id)
+                        return {"path": found[0], "size": found[1],
+                                "offset": found[2]}
                 except Exception:
                     continue
             # The owner's directory said where the copies are and every
@@ -601,7 +665,8 @@ class Raylet:
             found = await self.store.get(object_id, timeout=timeout)
         if found is None:
             return {"not_found": True}
-        return {"path": found[0], "size": found[1]}
+        self._track_client_ref(object_id, client_id)
+        return {"path": found[0], "size": found[1], "offset": found[2]}
 
     async def _pull_from(self, object_id, addr: Tuple[str, int]):
         client = self._remote_client(addr)
@@ -611,14 +676,12 @@ class Raylet:
             raise KeyError("remote object gone")
         size = info["size"]
         chunk = GlobalConfig.object_manager_chunk_size
-        path = self.store.create(object_id, size)
-        with open(path, "r+b") as f:
-            for offset in range(0, size, chunk):
-                data = await client.acall(
-                    "read_chunk", object_id=object_id, offset=offset,
-                    length=min(chunk, size - offset), timeout=60)
-                f.seek(offset)
-                f.write(data)
+        self.store.create(object_id, size)
+        for offset in range(0, size, chunk):
+            data = await client.acall(
+                "read_chunk", object_id=object_id, offset=offset,
+                length=min(chunk, size - offset), timeout=60)
+            self.store.write_into(object_id, offset, data)
         self.store.seal(object_id)
 
     def _remote_client(self, addr) -> RpcClient:
@@ -626,6 +689,19 @@ class Raylet:
         if addr not in self._remote_raylets:
             self._remote_raylets[addr] = RpcClient(*addr)
         return self._remote_raylets[addr]
+
+    async def _h_release_object(self, object_id, client_id=None):
+        self.store.release_client(object_id)
+        if client_id:
+            self._client_mapped[client_id].discard(object_id)
+        return True
+
+    async def _h_release_objects(self, object_ids, client_id=None):
+        for oid in object_ids:
+            self.store.release_client(oid)
+            if client_id:
+                self._client_mapped[client_id].discard(oid)
+        return True
 
     async def _h_contains_object(self, object_id):
         return self.store.contains(object_id)

@@ -260,6 +260,10 @@ class Worker:
         self._lineage: Dict[bytes, TaskSpec] = {}
         self._lineage_live: Dict[bytes, int] = {}
         self._recovering: Dict[bytes, threading.Event] = {}
+        # Task lifecycle events, flushed to the GCS task manager in batches
+        # (reference: `task_event_buffer.h:206` -> `gcs_task_manager.h:85`).
+        self._task_events: List[Dict[str, Any]] = []
+        self._task_events_lock = threading.Lock()
 
         # execution state
         self._fn_cache: Dict[str, Any] = {}
@@ -269,8 +273,11 @@ class Worker:
         self._actor: Optional[_ActorState] = None
         self._ctx = _TaskContext()
         self._running_task_threads: Dict[bytes, threading.Thread] = {}
-        # task_id -> thread ident, for async cancel of a RUNNING task.
+        # task_id -> thread ident, for async cancel of a RUNNING task,
+        # plus the inverse so cancel can verify the thread still runs THAT
+        # task before injecting (thread reuse race).
         self._executing_tids: Dict[bytes, int] = {}
+        self._thread_task: Dict[int, bytes] = {}
 
         self._dead = False
 
@@ -279,6 +286,13 @@ class Worker:
                             "node_id": node_id, "mode": mode,
                             "addr": self.addr, "pid": os.getpid(),
                             "job_id": job_id.binary()})
+
+        async def _task_event_flusher():
+            while not self._dead:
+                await asyncio.sleep(2.0)
+                self.flush_task_events()
+
+        self.io.submit(_task_event_flusher())
 
     # ======================================================================
     # Object plane
@@ -340,9 +354,10 @@ class Worker:
             self._complete_object(oid, in_plasma=True)
 
     def _plasma_put(self, oid: bytes, sobj: SerializedObject) -> None:
-        path = self.raylet.call("create_object", object_id=oid,
-                                size=sobj.total_size)
-        wobj = WritableObject(path, sobj.total_size)
+        reply = self.raylet.call("create_object", object_id=oid,
+                                 size=sobj.total_size)
+        wobj = WritableObject(reply["path"], sobj.total_size,
+                              reply.get("offset", 0))
         try:
             sobj.write_into(wobj.view)
         finally:
@@ -357,11 +372,13 @@ class Worker:
         else:
             reply = self.raylet.call("get_object", object_id=oid,
                                      wait_timeout=timeout,
-                                     locations=list(locations))
+                                     locations=list(locations),
+                                     client_id=self.worker_id.binary())
             if reply.get("not_found"):
                 raise exc.ObjectLostError(
                     f"object {oid.hex()} not found in the cluster")
-            mobj = MappedObject(reply["path"], reply["size"])
+            mobj = MappedObject(reply["path"], reply["size"],
+                                reply.get("offset", 0))
             self._mapped[oid] = mobj
         return self.serialization.deserialize(mobj.view, keepalive=mobj)
 
@@ -509,6 +526,13 @@ class Worker:
         """ReferenceCounter callback — remove the value everywhere."""
         with self._objects_lock:
             self._objects.pop(oid, None)
+        if oid in self._mapped and not self._dead:
+            try:
+                self.io.submit(self.raylet.acall(
+                    "release_object", object_id=oid,
+                    client_id=self.worker_id.binary(), timeout=5))
+            except Exception:
+                pass
         tid = bytes(oid[:TaskID.SIZE])
         live = self._lineage_live.get(tid)
         if live is not None:
@@ -633,6 +657,7 @@ class Worker:
             # Register generator state before dispatch: a streaming item
             # push may arrive before the submit coroutine even runs.
             self._generators[task_id.binary()] = _GeneratorState()
+        self._record_task_event(spec, "PENDING")
         self.io.submit(self._run_normal_task(spec))
         if streaming:
             from ray_tpu._private.object_ref import ObjectRefGenerator
@@ -642,6 +667,37 @@ class Worker:
             gen._ref0 = refs[0]  # keeps the generator ref (and lineage) alive
             return [gen]
         return refs
+
+    def _record_task_event(self, spec: TaskSpec, state: str,
+                           **extra) -> None:
+        event = {
+            "task_id": spec.task_id.binary(), "name": spec.name,
+            "job_id": spec.job_id.binary(), "state": state,
+            "ts": time.time(), "owner_pid": os.getpid(), **extra,
+        }
+        with self._task_events_lock:
+            self._task_events.append(event)
+            flush = len(self._task_events) >= 100
+        if flush:
+            self.flush_task_events()
+
+    def flush_task_events(self) -> None:
+        with self._task_events_lock:
+            batch, self._task_events = self._task_events, []
+        if not batch or self._dead:
+            return
+
+        async def _push():
+            try:
+                await self.gcs.acall("push_task_events", events=batch,
+                                     timeout=10)
+            except Exception:
+                pass
+
+        try:
+            self.io.submit(_push())
+        except Exception:
+            pass
 
     async def _resolve_deps(self, spec: TaskSpec) -> Optional[bytes]:
         """Wait for owned arg refs to be available; returns error payload if a
@@ -713,6 +769,8 @@ class Worker:
                 return
             crashed = False
             self._inflight_push[spec.task_id.binary()] = worker_addr
+            self._record_task_event(spec, "RUNNING",
+                                    worker_addr=list(worker_addr))
             try:
                 reply = await self._client_for(worker_addr).acall(
                     "push_task", spec=spec, tpu_ids=lease.get("tpu_ids", []))
@@ -755,6 +813,7 @@ class Worker:
                 return
             self._accept_results(spec, reply)
             self._release_deps(spec)
+            self._record_task_event(spec, "FINISHED")
             return
 
     def _should_retry_app_error(self, spec: TaskSpec, payload: bytes,
@@ -790,7 +849,8 @@ class Worker:
                     strategy_node=strategy.node_id, soft=strategy.soft,
                     hard_labels=strategy.hard_labels,
                     soft_labels=strategy.soft_labels,
-                    lease_timeout=25.0, timeout=30.0)
+                    lease_timeout=25.0, runtime_env=spec.runtime_env,
+                    timeout=30.0)
             except (ConnectionLost, OSError):
                 await asyncio.sleep(0.2)
                 client = self.raylet
@@ -851,6 +911,7 @@ class Worker:
         self._store_value(spec.return_ids()[0].binary(), refs)
 
     def _fail_task(self, spec: TaskSpec, error_payload: bytes) -> None:
+        self._record_task_event(spec, "FAILED")
         for rid in spec.return_ids():
             self._complete_object(rid.binary(), error=error_payload)
         state = self._generators.get(spec.task_id.binary())
@@ -1091,6 +1152,12 @@ class Worker:
         mobj = self._mapped.pop(object_id, None)
         if mobj is not None:
             mobj.close()
+            try:
+                await self.raylet.acall(
+                    "release_object", object_id=object_id,
+                    client_id=self.worker_id.binary(), timeout=5)
+            except Exception:
+                pass
         return True
 
     async def _h_kill_self(self):
@@ -1105,7 +1172,10 @@ class Worker:
                 # Reply first, then die: the owner maps the connection loss
                 # of a cancelled task to TaskCancelledError, never a retry.
                 asyncio.get_running_loop().call_later(0.02, os._exit, 1)
-            else:
+            elif self._thread_task.get(tid_thread) == task_id:
+                # The inverse-map check guards against the thread having
+                # finished this task and picked up another (async-exc must
+                # never land in an innocent task).
                 import ctypes
 
                 # Raised at the next bytecode boundary of the executing
@@ -1163,6 +1233,7 @@ class Worker:
                 [str(i) for i in tpu_ids])
         tid = spec.task_id.binary()
         self._executing_tids[tid] = threading.get_ident()
+        self._thread_task[threading.get_ident()] = tid
         try:
             fn = self._load_function(spec.function.function_hash)
             args, kwargs = self._resolve_args(spec)
@@ -1175,6 +1246,7 @@ class Worker:
             return {"results": [], "app_error": serialize_error(e)}
         finally:
             self._executing_tids.pop(tid, None)
+            self._thread_task.pop(threading.get_ident(), None)
             self._ctx.task_id = None
             self._ctx.task_name = ""
 
@@ -1463,6 +1535,23 @@ class Worker:
         return asyncio.to_thread(self.get_objects, refs, None)
 
     def shutdown(self):
+        # Final task-event flush before the GCS connection closes
+        # (synchronous: the io loop dies with us).
+        try:
+            with self._task_events_lock:
+                batch, self._task_events = self._task_events, []
+            if batch:
+                self.gcs.call("push_task_events", events=batch, timeout=5)
+        except Exception:
+            pass
+        if self._mapped:
+            try:
+                self.raylet.call("release_objects",
+                                 object_ids=list(self._mapped),
+                                 client_id=self.worker_id.binary(),
+                                 timeout=5)
+            except Exception:
+                pass
         self._dead = True
         try:
             self.server.stop()

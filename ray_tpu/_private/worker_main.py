@@ -31,6 +31,14 @@ def main():
     parser.add_argument("--session-dir", required=True)
     args = parser.parse_args()
 
+    # runtime_env working_dir: the raylet exports it when this worker's
+    # pool was spawned for an env that sets one (env_vars arrive directly
+    # in this process's environment, applied at spawn).
+    wd = os.environ.get("RAY_TPU_WORKING_DIR")
+    if wd and os.path.isdir(wd):
+        os.chdir(wd)
+        sys.path.insert(0, wd)
+
     worker = Worker(
         mode=MODE_WORKER,
         gcs_addr=(args.gcs_host, args.gcs_port),
@@ -61,7 +69,9 @@ def main():
                 if p and p not in sys.path:
                     sys.path.append(p)
             cwd = meta.get("cwd")
-            if cwd and os.path.isdir(cwd):
+            # runtime_env working_dir (chdir'd above) takes precedence over
+            # mirroring the driver's cwd.
+            if cwd and os.path.isdir(cwd) and not wd:
                 os.chdir(cwd)
     except Exception:
         pass

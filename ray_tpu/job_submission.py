@@ -1,0 +1,136 @@
+"""Job submission — run driver scripts on a cluster under supervision.
+
+Reference: `dashboard/modules/job/job_manager.py` (JobManager spawns a
+JobSupervisor actor per job; the supervisor runs the entrypoint as a
+subprocess, captures logs, and records terminal status) + `job/sdk.py`
+(JobSubmissionClient).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+_KV_NS = "job_submissions"
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class JobSupervisor:
+    """Runs one job's entrypoint as a child process and reports status."""
+
+    def run(self, submission_id: str, entrypoint: str, gcs_addr: str,
+            env: Dict[str, str], working_dir: Optional[str]) -> int:
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+
+        def put_status(**fields):
+            record = json.loads(
+                w.gcs.call("kv_get", namespace=_KV_NS,
+                           key=submission_id) or b"{}")
+            record.update(fields)
+            w.gcs.call("kv_put", namespace=_KV_NS, key=submission_id,
+                       value=json.dumps(record).encode())
+
+        log_path = os.path.join(
+            w.session_dir or "/tmp", "logs",
+            f"job-{submission_id}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        child_env = dict(os.environ)
+        child_env.update(env or {})
+        child_env["RAY_TPU_ADDRESS"] = gcs_addr
+        # The driver must import this framework no matter its cwd/script
+        # location (equivalent of a pip-installed package).
+        import ray_tpu as _pkg
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)))
+        child_env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root] + [p for p in
+                          child_env.get("PYTHONPATH", "").split(os.pathsep)
+                          if p])
+        put_status(status="RUNNING", log_path=log_path,
+                   start_time=time.time(), pid=os.getpid())
+        with open(log_path, "wb") as log:
+            proc = subprocess.Popen(
+                entrypoint, shell=True, stdout=log,
+                stderr=subprocess.STDOUT, env=child_env,
+                cwd=working_dir or None)
+            rc = proc.wait()
+        put_status(status="SUCCEEDED" if rc == 0 else "FAILED",
+                   returncode=rc, end_time=time.time())
+        return rc
+
+
+class JobSubmissionClient:
+    """Submit/inspect jobs against an initialized cluster connection."""
+
+    def __init__(self):
+        from ray_tpu._private.worker import global_worker
+
+        self._worker = global_worker()
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   env: Optional[Dict[str, str]] = None,
+                   working_dir: Optional[str] = None) -> str:
+        submission_id = submission_id or f"job_{uuid.uuid4().hex[:10]}"
+        gcs_addr = "%s:%d" % self._worker.gcs_addr
+        self._worker.gcs.call(
+            "kv_put", namespace=_KV_NS, key=submission_id,
+            value=json.dumps({
+                "submission_id": submission_id,
+                "entrypoint": entrypoint,
+                "status": "PENDING",
+                "submit_time": time.time(),
+            }).encode())
+        supervisor = JobSupervisor.options(
+            name=f"_job_supervisor:{submission_id}",
+            lifetime="detached").remote()
+        # Fire and track: the ref resolves when the job process exits.
+        self._refs = getattr(self, "_refs", {})
+        self._refs[submission_id] = supervisor.run.remote(
+            submission_id, entrypoint, gcs_addr, env or {}, working_dir)
+        return submission_id
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._record(submission_id).get("status", "UNKNOWN")
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        return self._record(submission_id)
+
+    def get_job_logs(self, submission_id: str) -> str:
+        path = self._record(submission_id).get("log_path")
+        if not path or not os.path.exists(path):
+            return ""
+        with open(path, "r", errors="replace") as f:
+            return f.read()
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        keys = self._worker.gcs.call("kv_keys", namespace=_KV_NS)
+        return [self._record(k if isinstance(k, str) else k.decode())
+                for k in keys]
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 600.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"job {submission_id} still {status} after {timeout}s")
+
+    def _record(self, submission_id: str) -> Dict[str, Any]:
+        raw = self._worker.gcs.call("kv_get", namespace=_KV_NS,
+                                    key=submission_id)
+        if raw is None:
+            raise KeyError(f"no such job: {submission_id}")
+        return json.loads(raw)

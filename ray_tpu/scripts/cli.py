@@ -1,0 +1,223 @@
+"""ray_tpu CLI — cluster lifecycle, state inspection, jobs, metrics.
+
+Reference: `python/ray/scripts/scripts.py` (`ray start/stop/status`),
+`python/ray/util/state` CLI (`ray list ...`), and the job CLI
+(`dashboard/modules/job/cli.py`). argparse-based (no click in the image).
+
+Usage:
+  python -m ray_tpu start --head [--num-cpus N] [--port P] [--block]
+  python -m ray_tpu start --address HOST:PORT [--num-cpus N]
+  python -m ray_tpu stop
+  python -m ray_tpu status [--address HOST:PORT]
+  python -m ray_tpu list nodes|actors|workers|jobs|tasks|pgs|objects
+  python -m ray_tpu job submit -- <shell entrypoint>
+  python -m ray_tpu job status|logs <submission-id>
+  python -m ray_tpu job list
+  python -m ray_tpu metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Any, List, Optional
+
+
+def _address(args) -> Optional[str]:
+    return args.address or os.environ.get("RAY_TPU_ADDRESS")
+
+
+def _connect(args):
+    import ray_tpu
+
+    addr = _address(args)
+    if addr:
+        ray_tpu.init(address=addr)
+    else:
+        raise SystemExit(
+            "no cluster address: pass --address or set RAY_TPU_ADDRESS")
+    return ray_tpu
+
+
+def _print_table(rows: List[dict]) -> None:
+    if not rows:
+        print("(empty)")
+        return
+    cols = list(rows[0].keys())
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    print("  ".join("-" * widths[c] for c in cols))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+
+
+# ----------------------------------------------------------------- commands
+
+def cmd_start(args) -> None:
+    from ray_tpu._private.node import Node
+
+    if args.head:
+        node = Node(head=True, num_cpus=args.num_cpus,
+                    num_tpus=args.num_tpus, fate_share=False,
+                    gcs_port=args.port or 0)
+        addr = "%s:%d" % node.gcs_addr
+        print(f"started head node; cluster address: {addr}")
+        print(f"session dir: {node.session_dir}")
+        print(f"  export RAY_TPU_ADDRESS={addr}")
+    else:
+        addr = _address(args)
+        if not addr:
+            raise SystemExit("start requires --head or --address")
+        host, port = addr.rsplit(":", 1)
+        node = Node(head=False, gcs_addr=(host, int(port)),
+                    num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                    fate_share=False)
+        print(f"joined cluster at {addr} as node {node.node_id.hex()[:12]}")
+    if args.block:
+        try:
+            signal.pause()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            node.shutdown()
+
+
+def cmd_stop(args) -> None:
+    import subprocess
+
+    out = subprocess.run(
+        ["pkill", "-f", "ray_tpu._private.(gcs_server|raylet|worker_main)"],
+        capture_output=True)
+    print("stopped" if out.returncode == 0 else "no daemons found")
+
+
+def cmd_status(args) -> None:
+    ray_tpu = _connect(args)
+    from ray_tpu.util import state
+
+    s = state.summary()
+    print(f"nodes: {s['nodes_alive']} alive / {s['nodes_dead']} dead")
+    print(f"actors: {s['actors']}   workers: {s['workers']}")
+    print("resources:")
+    total, avail = s["cluster_resources"], s["available_resources"]
+    for key in sorted(total):
+        print(f"  {avail.get(key, 0):.1f}/{total[key]:.1f} {key}")
+    ray_tpu.shutdown()
+
+
+def cmd_list(args) -> None:
+    ray_tpu = _connect(args)
+    from ray_tpu.util import state
+
+    kind = args.kind
+    fns = {
+        "nodes": state.list_nodes, "actors": state.list_actors,
+        "workers": state.list_workers, "jobs": state.list_jobs,
+        "tasks": state.list_tasks, "pgs": state.list_placement_groups,
+        "placement-groups": state.list_placement_groups,
+        "objects": state.list_objects,
+    }
+    rows = fns[kind]()
+    if args.json:
+        print(json.dumps(rows, default=str, indent=2))
+    else:
+        _print_table([{k: v for k, v in r.items()
+                       if not isinstance(v, (dict, list))} for r in rows])
+    ray_tpu.shutdown()
+
+
+def cmd_job(args) -> None:
+    ray_tpu = _connect(args)
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        parts = list(args.entrypoint)
+        if parts and parts[0] == "--":
+            parts = parts[1:]
+        entrypoint = " ".join(parts)
+        sid = client.submit_job(entrypoint=entrypoint,
+                                working_dir=args.working_dir)
+        print(f"submitted: {sid}")
+        if args.wait:
+            status = client.wait_until_finished(sid, timeout=args.timeout)
+            print(f"{sid}: {status}")
+            print(client.get_job_logs(sid))
+            if status != "SUCCEEDED":
+                sys.exit(1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id))
+    elif args.job_cmd == "list":
+        _print_table(client.list_jobs())
+    ray_tpu.shutdown()
+
+
+def cmd_metrics(args) -> None:
+    ray_tpu = _connect(args)
+    from ray_tpu._private.worker import global_worker
+
+    print(global_worker().gcs.call("metrics_text", timeout=30), end="")
+    ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------------- main
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    parser.add_argument("--address", default=None,
+                        help="cluster address HOST:PORT "
+                             "(default: $RAY_TPU_ADDRESS)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--block", action="store_true",
+                   help="stay attached; Ctrl-C stops the node")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop all local daemons")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster summary")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("kind", choices=["nodes", "actors", "workers", "jobs",
+                                    "tasks", "pgs", "placement-groups",
+                                    "objects"])
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("job", help="job submission")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    ps = jsub.add_parser("submit")
+    ps.add_argument("--working-dir", default=None)
+    ps.add_argument("--wait", action="store_true")
+    ps.add_argument("--timeout", type=float, default=600.0)
+    ps.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="shell entrypoint (after --)")
+    for name in ("status", "logs"):
+        pj = jsub.add_parser(name)
+        pj.add_argument("submission_id")
+    jsub.add_parser("list")
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("metrics", help="prometheus metrics text")
+    p.set_defaults(fn=cmd_metrics)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

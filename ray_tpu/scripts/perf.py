@@ -1,0 +1,149 @@
+"""Microbenchmark CLI (reference: `python/ray/_private/ray_perf.py:120-241`
+— `ray microbenchmark`). Named suites, one result line each:
+
+  python -m ray_tpu.scripts.perf [--suite NAME] [--backend native|files]
+
+Suites: tasks (roundtrips/s), actor_calls (sync 1:1 calls/s), put_small
+(1 KiB puts/s), put_large + get_large (10 MiB GB/s), wait_many
+(ray.wait over 1k inlined refs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _timeit(fn, n: int) -> float:
+    start = time.perf_counter()
+    fn()
+    return n / (time.perf_counter() - start)
+
+
+def suite_tasks(ray_tpu, n=200):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote(), timeout=60)  # warm the pool
+
+    def run():
+        ray_tpu.get([nop.remote() for _ in range(n)], timeout=120)
+
+    return "tasks_per_s", _timeit(run, n)
+
+
+def suite_actor_calls(ray_tpu, n=500):
+    @ray_tpu.remote
+    class A:
+        def nop(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.nop.remote(), timeout=60)
+
+    def run():
+        ray_tpu.get([a.nop.remote() for _ in range(n)], timeout=120)
+
+    rate = _timeit(run, n)
+    ray_tpu.kill(a)
+    return "actor_calls_per_s", rate
+
+
+def suite_put_small(ray_tpu, n=500):
+    # Above the inline threshold so every put hits the node store.
+    payload = np.zeros(128 * 1024 // 8)
+
+    def run():
+        refs = [ray_tpu.put(payload) for _ in range(n)]
+        del refs
+
+    return "store_puts_per_s_128k", _timeit(run, n)
+
+
+def suite_put_large(ray_tpu, n=20):
+    payload = np.zeros(10 * 1024 * 1024 // 8)  # 10 MiB
+
+    def run():
+        refs = [ray_tpu.put(payload) for _ in range(n)]
+        del refs
+
+    rate = _timeit(run, n)
+    return "store_put_gb_per_s", rate * 10 / 1024
+
+
+def suite_get_large(ray_tpu, n=50):
+    payload = np.zeros(10 * 1024 * 1024 // 8)
+    ref = ray_tpu.put(payload)
+    ray_tpu.get(ref, timeout=60)
+
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+
+    def run():
+        for _ in range(n):
+            # Drop the client mapping cache so each get pays the full path.
+            w._mapped.pop(ref.binary(), None)
+            ray_tpu.get(ref, timeout=60)
+
+    rate = _timeit(run, n)
+    return "store_get_gb_per_s", rate * 10 / 1024
+
+
+def suite_wait_many(ray_tpu, n=1000):
+    refs = [ray_tpu.put(i) for i in range(n)]
+
+    def run():
+        ready, rest = ray_tpu.wait(refs, num_returns=n, timeout=60)
+        assert len(ready) == n
+
+    return "wait_1k_refs_per_s", _timeit(run, n)
+
+
+SUITES = {
+    "tasks": suite_tasks,
+    "actor_calls": suite_actor_calls,
+    "put_small": suite_put_small,
+    "put_large": suite_put_large,
+    "get_large": suite_get_large,
+    "wait_many": suite_wait_many,
+}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu.perf")
+    parser.add_argument("--suite", choices=sorted(SUITES), default=None)
+    parser.add_argument("--backend", choices=["native", "files"],
+                        default=None)
+    parser.add_argument("--num-cpus", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    if args.backend:
+        os.environ["RAY_TPU_object_store_backend"] = args.backend
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=0,
+                 object_store_memory=512 * 1024 * 1024)
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        backend = global_worker().raylet.call(
+            "node_stats", timeout=15)["store"].get("backend")
+        names = [args.suite] if args.suite else sorted(SUITES)
+        for name in names:
+            metric, value = SUITES[name](ray_tpu)
+            print(json.dumps({"suite": name, "metric": metric,
+                              "value": round(value, 2),
+                              "store_backend": backend}))
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""ray_tpu.serve — model serving (reference: `python/ray/serve/`).
+
+Minimal-but-real equivalent of the reference architecture: a singleton
+ServeController actor reconciles deployment specs into replica actors
+(`serve/_private/controller.py:84`, `deployment_state.py:1229`); the data
+plane routes requests through a power-of-two-choices replica scheduler
+(`replica_scheduler/pow_2_scheduler.py:44`); an HTTP proxy exposes
+deployments over REST (`_private/proxy.py`). TPU-relevant: replicas can
+claim TPU chips for accelerated inference (jitted model calls), while the
+control plane stays on CPU.
+"""
+
+from ray_tpu.serve.api import (
+    Application, Deployment, delete, deployment, get_app_handle, run,
+    shutdown, start, status,
+)
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = [
+    "Application", "Deployment", "DeploymentHandle", "delete", "deployment",
+    "get_app_handle", "run", "shutdown", "start", "status",
+]

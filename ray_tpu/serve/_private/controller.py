@@ -1,0 +1,166 @@
+"""ServeController — the serving control plane, as a singleton actor.
+
+Reference: `serve/_private/controller.py:84` (deploy_application at
+`:700`) + `deployment_state.py:1229`: the controller holds the goal state
+(deployment specs) and a reconcile loop converges actual replica actors to
+it — scaling up/down, replacing crashed replicas, and bumping a routing
+version so handles/proxies refresh their replica sets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class ServeController:
+    def __init__(self):
+        from ray_tpu.serve._private.replica import Replica
+
+        self._replica_cls = Replica
+        # app -> deployment name -> spec dict
+        self._apps: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        # (app, deployment) -> list of replica handles
+        self._replicas: Dict[tuple, List[Any]] = {}
+        self._version = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._reconcile_loop, daemon=True,
+                         name="serve-reconcile").start()
+
+    # ------------------------------------------------------------- deploy
+    def deploy_application(self, app_name: str,
+                           deployments: List[Dict[str, Any]]) -> bool:
+        with self._lock:
+            self._apps[app_name] = {d["name"]: d for d in deployments}
+        self._reconcile_once()
+        return True
+
+    def delete_application(self, app_name: str) -> bool:
+        with self._lock:
+            deployments = self._apps.pop(app_name, {})
+            for name in deployments:
+                for replica in self._replicas.pop((app_name, name), []):
+                    try:
+                        ray_tpu.kill(replica)
+                    except Exception:
+                        pass
+            self._version += 1
+        return True
+
+    # ---------------------------------------------------------- reconcile
+    def _reconcile_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:
+                pass
+            self._stop.wait(2.0)
+
+    def _reconcile_once(self):
+        with self._lock:
+            goal = [(app, dict(spec))
+                    for app, deps in self._apps.items()
+                    for spec in deps.values()]
+        changed = False
+        for app, spec in goal:
+            key = (app, spec["name"])
+            replicas = self._replicas.setdefault(key, [])
+            # Drop dead replicas (health probe).
+            live = []
+            for r in replicas:
+                try:
+                    ray_tpu.get(r.check_health.remote(), timeout=30)
+                    live.append(r)
+                except Exception:
+                    changed = True
+            replicas[:] = live
+            want = spec.get("num_replicas", 1)
+            while len(replicas) < want:
+                options: Dict[str, Any] = dict(
+                    num_cpus=spec.get("num_cpus", 1))
+                if spec.get("num_tpus"):
+                    options["num_tpus"] = spec["num_tpus"]
+                replicas.append(self._replica_cls.options(**options).remote(
+                    spec["name"], spec["serialized_callable"],
+                    tuple(spec.get("init_args", ())),
+                    dict(spec.get("init_kwargs", {}))))
+                changed = True
+            while len(replicas) > want:
+                doomed = replicas.pop()
+                try:
+                    ray_tpu.kill(doomed)
+                except Exception:
+                    pass
+                changed = True
+        if changed:
+            with self._lock:
+                self._version += 1
+
+    # -------------------------------------------------------------- query
+    def get_replicas(self, app_name: str, deployment_name: str):
+        """Returns (version, [replica handles]) for router refresh."""
+        with self._lock:
+            version = self._version
+        return version, list(self._replicas.get((app_name, deployment_name),
+                                                []))
+
+    def routing_version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def list_deployments(self, app_name: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            for name, spec in self._apps.get(app_name, {}).items():
+                out.append({
+                    "name": name,
+                    "num_replicas": spec.get("num_replicas", 1),
+                    "live_replicas": len(
+                        self._replicas.get((app_name, name), [])),
+                    "route_prefix": spec.get("route_prefix"),
+                    "is_ingress": spec.get("is_ingress", False),
+                })
+            return out
+
+    def list_applications(self) -> List[str]:
+        with self._lock:
+            return list(self._apps)
+
+    def get_ingress(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            for name, spec in self._apps.get(app_name, {}).items():
+                if spec.get("is_ingress"):
+                    return name
+        return None
+
+    def graceful_shutdown(self) -> bool:
+        self._stop.set()
+        for key, replicas in list(self._replicas.items()):
+            for r in replicas:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        self._replicas.clear()
+        self._apps.clear()
+        return True
+
+
+def get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    try:
+        return ServeController.options(
+            name=CONTROLLER_NAME, lifetime="detached").remote()
+    except Exception:
+        # Raced with another creator.
+        return ray_tpu.get_actor(CONTROLLER_NAME)

@@ -1,0 +1,93 @@
+"""HTTP proxy — exposes deployed applications over REST.
+
+Reference: `serve/_private/proxy.py` (per-node ProxyActor). Stdlib
+ThreadingHTTPServer (the image ships no ASGI stack): each request resolves
+the app by route prefix, forwards the JSON body (or raw bytes) to the
+app's ingress deployment through the same pow-2 router as Python handles,
+and returns the JSON-encoded response.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class ProxyActor:
+    def __init__(self, port: int = 0):
+        from ray_tpu.serve._private.controller import get_or_create_controller
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        self._controller = get_or_create_controller()
+        self._handles: Dict[str, DeploymentHandle] = {}
+        proxy = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _dispatch(self):
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b""
+                    if raw:
+                        try:
+                            payload = json.loads(raw)
+                        except ValueError:
+                            payload = raw.decode("utf-8", "replace")
+                    else:
+                        payload = None
+                    result = proxy._route(self.path, payload)
+                    body = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except KeyError as e:
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(404)
+                except Exception as e:  # noqa: BLE001
+                    body = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = _dispatch
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="serve-proxy").start()
+
+    def _route(self, path: str, payload: Any) -> Any:
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        app_name = path.strip("/").split("/")[0] or "default"
+        apps = ray_tpu.get(self._controller.list_applications.remote(),
+                           timeout=30)
+        if app_name not in apps:
+            raise KeyError(f"no application '{app_name}'")
+        ingress = ray_tpu.get(
+            self._controller.get_ingress.remote(app_name), timeout=30)
+        if ingress is None:
+            raise KeyError(f"application '{app_name}' has no ingress")
+        handle = self._handles.get(app_name)
+        if handle is None:
+            handle = self._handles[app_name] = DeploymentHandle(
+                app_name, ingress)
+        if payload is None:
+            response = handle.remote()
+        else:
+            response = handle.remote(payload)
+        return response.result(timeout=120)
+
+    def get_port(self) -> int:
+        return self.port
+
+    def healthz(self) -> bool:
+        return True

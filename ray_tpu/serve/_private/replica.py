@@ -1,0 +1,58 @@
+"""Replica actor — hosts one copy of a deployment's user callable.
+
+Reference: `serve/_private/replica.py` (user callable wrapper, health
+checks, graceful shutdown).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Replica:
+    def __init__(self, deployment_name: str, serialized_callable: bytes,
+                 init_args: tuple, init_kwargs: dict):
+        import cloudpickle
+
+        self._name = deployment_name
+        cls_or_fn = cloudpickle.loads(serialized_callable)
+        if isinstance(cls_or_fn, type):
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = cls_or_fn
+            self._is_function = True
+        self._num_ongoing = 0
+        self._num_served = 0
+
+    def handle_request(self, method_name: str, args: tuple,
+                       kwargs: dict) -> Any:
+        self._num_ongoing += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            elif method_name == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            out = target(*args, **kwargs)
+            self._num_served += 1
+            return out
+        finally:
+            self._num_ongoing -= 1
+
+    def check_health(self) -> bool:
+        checker = getattr(self._callable, "check_health", None)
+        if checker is not None and not self._is_function:
+            checker()
+        return True
+
+    def stats(self) -> Dict[str, int]:
+        return {"ongoing": self._num_ongoing, "served": self._num_served}
+
+    def prepare_shutdown(self) -> bool:
+        hook = getattr(self._callable, "__del__", None)
+        return True

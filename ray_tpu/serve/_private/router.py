@@ -1,0 +1,78 @@
+"""Router — picks a replica for each request.
+
+Reference: `serve/_private/router.py:254` + power-of-two-choices scheduler
+(`replica_scheduler/pow_2_scheduler.py:44`): sample two random replicas,
+send to the one with fewer locally-tracked in-flight requests. The replica
+set refreshes from the controller when its routing version bumps.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class Router:
+    def __init__(self, controller, app_name: str, deployment_name: str):
+        self._controller = controller
+        self._app = app_name
+        self._deployment = deployment_name
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._inflight: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+        self._refresh(force=True)
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_refresh < 1.0:
+            return
+        self._last_refresh = now
+        version, replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self._app, self._deployment),
+            timeout=60)
+        with self._lock:
+            if version != self._version:
+                self._version = version
+                self._replicas = replicas
+                self._inflight = {r: self._inflight.get(r, 0)
+                                  for r in replicas}
+
+    def assign_request(self, method_name: str, args: tuple, kwargs: dict):
+        """Returns an ObjectRef for the response."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no live replicas for {self._app}/{self._deployment}")
+            self._refresh(force=True)
+            time.sleep(0.1)
+
+        with self._lock:
+            if len(replicas) == 1:
+                chosen = replicas[0]
+            else:
+                a, b = random.sample(replicas, 2)
+                chosen = (a if self._inflight.get(a, 0)
+                          <= self._inflight.get(b, 0) else b)
+            self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
+
+        ref = chosen.handle_request.remote(method_name, args, kwargs)
+
+        def _done(_fut):
+            with self._lock:
+                if chosen in self._inflight:
+                    self._inflight[chosen] -= 1
+
+        ref.future().add_done_callback(_done)
+        return ref

@@ -1,0 +1,157 @@
+"""Public serve API: @serve.deployment, serve.run, handles, status.
+
+Reference: `serve/api.py` (`serve.run :521`), `serve/deployment.py`
+(@deployment decorator producing Deployment objects whose `.bind()` builds
+an Application graph).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.handle import DeploymentHandle
+
+_PROXY_NAME = "SERVE_PROXY"
+
+
+@dataclasses.dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    num_cpus: float = 1
+    num_tpus: float = 0
+    route_prefix: Optional[str] = None
+
+    def options(self, **overrides) -> "Deployment":
+        return dataclasses.replace(self, **overrides)
+
+    def bind(self, *init_args, **init_kwargs) -> "Application":
+        return Application(self, init_args, init_kwargs)
+
+
+class Application:
+    """A bound deployment graph node (reference: `serve/_private/build_app`).
+
+    Binding another Application as an init arg expresses composition: the
+    inner deployment is deployed too and the outer replica receives a
+    DeploymentHandle in its place.
+    """
+
+    def __init__(self, deployment: Deployment, init_args: tuple,
+                 init_kwargs: dict):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+
+    def _collect(self, app_name: str, out: List[Dict[str, Any]],
+                 is_ingress: bool) -> DeploymentHandle:
+        args = tuple(
+            a._collect(app_name, out, False) if isinstance(a, Application)
+            else a for a in self.init_args)
+        kwargs = {
+            k: (v._collect(app_name, out, False)
+                if isinstance(v, Application) else v)
+            for k, v in self.init_kwargs.items()
+        }
+        d = self.deployment
+        if not any(spec["name"] == d.name for spec in out):
+            out.append({
+                "name": d.name,
+                "serialized_callable": cloudpickle.dumps(d.func_or_class),
+                "init_args": args,
+                "init_kwargs": kwargs,
+                "num_replicas": d.num_replicas,
+                "num_cpus": d.num_cpus,
+                "num_tpus": d.num_tpus,
+                "route_prefix": d.route_prefix,
+                "is_ingress": is_ingress,
+            })
+        return DeploymentHandle(app_name, d.name)
+
+
+def deployment(func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, num_cpus: float = 1,
+               num_tpus: float = 0, route_prefix: Optional[str] = None):
+    def wrap(target):
+        return Deployment(
+            func_or_class=target,
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas, num_cpus=num_cpus,
+            num_tpus=num_tpus, route_prefix=route_prefix)
+
+    return wrap(func_or_class) if func_or_class is not None else wrap
+
+
+# ---------------------------------------------------------------- lifecycle
+
+def start(http_port: int = 0):
+    """Start the proxy (controller starts lazily on first run())."""
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    get_or_create_controller()
+    try:
+        return ray_tpu.get_actor(_PROXY_NAME)
+    except Exception:
+        from ray_tpu.serve._private.proxy import ProxyActor
+
+        return ProxyActor.options(name=_PROXY_NAME,
+                                  lifetime="detached").remote(http_port)
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy (or redeploy) an application; returns its ingress handle."""
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    controller = get_or_create_controller()
+    specs: List[Dict[str, Any]] = []
+    handle = app._collect(name, specs, True)
+    if route_prefix is not None:
+        for spec in specs:
+            if spec["is_ingress"]:
+                spec["route_prefix"] = route_prefix
+    ray_tpu.get(controller.deploy_application.remote(name, specs),
+                timeout=120)
+    return handle
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    controller = get_or_create_controller()
+    ingress = ray_tpu.get(controller.get_ingress.remote(name), timeout=30)
+    if ingress is None:
+        raise KeyError(f"no application '{name}'")
+    return DeploymentHandle(name, ingress)
+
+
+def status(name: str = "default") -> List[Dict[str, Any]]:
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    return ray_tpu.get(
+        get_or_create_controller().list_deployments.remote(name), timeout=30)
+
+
+def delete(name: str) -> None:
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    ray_tpu.get(get_or_create_controller().delete_application.remote(name),
+                timeout=60)
+
+
+def shutdown() -> None:
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    for actor_name in (_PROXY_NAME, CONTROLLER_NAME):
+        try:
+            actor = ray_tpu.get_actor(actor_name)
+            if actor_name == CONTROLLER_NAME:
+                ray_tpu.get(actor.graceful_shutdown.remote(), timeout=60)
+            ray_tpu.kill(actor)
+        except Exception:
+            pass

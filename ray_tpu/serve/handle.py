@@ -1,0 +1,72 @@
+"""DeploymentHandle — the Python-level way to call a deployment.
+
+Reference: `serve/handle.py` (DeploymentHandle.remote -> DeploymentResponse
+with .result()); supports composition (handles passed into other
+deployments rehydrate in the replica process).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: float = 120.0) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._call(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, deployment_name: str):
+        self._app = app_name
+        self._deployment = deployment_name
+        self._router = None
+
+    def _get_router(self):
+        if self._router is None:
+            from ray_tpu.serve._private.controller import (
+                get_or_create_controller,
+            )
+            from ray_tpu.serve._private.router import Router
+
+            self._router = Router(get_or_create_controller(), self._app,
+                                  self._deployment)
+        return self._router
+
+    def _call(self, method: str, args: tuple,
+              kwargs: dict) -> DeploymentResponse:
+        ref = self._get_router().assign_request(method, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def __getattr__(self, name: str) -> _MethodCaller:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    # Handles serialize into replicas for model composition; the router is
+    # process-local state and rebuilds lazily after rehydration.
+    def __reduce__(self):
+        return DeploymentHandle, (self._app, self._deployment)
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._app}/{self._deployment})"

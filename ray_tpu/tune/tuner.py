@@ -99,13 +99,15 @@ class Tuner:
                       for i, cfg in enumerate(configs)]
 
         scheduler = tc.scheduler
-        if scheduler is not None:
-            # Reference Tune copies TuneConfig metric/mode into the
-            # scheduler; a min-mode experiment with a max-mode scheduler
-            # would prune its BEST trials.
-            if getattr(scheduler, "metric", None) is None:
-                scheduler.metric = tc.metric
-            if tc.mode and getattr(scheduler, "mode", None) != tc.mode:
+        if scheduler is not None and getattr(scheduler, "metric",
+                                             None) is None:
+            # Reference Tune copies TuneConfig metric/mode into a scheduler
+            # that wasn't explicitly configured (metric unset). A scheduler
+            # constructed with its own metric/mode is left alone — blindly
+            # overwriting mode would flip a min-mode ASHA to max and prune
+            # the best trials.
+            scheduler.metric = tc.metric
+            if tc.mode:
                 scheduler.mode = tc.mode
         controller = TuneController(
             self._trainable, trials, experiment_dir,

@@ -41,14 +41,23 @@ class ActorPool:
 
     # --------------------------------------------------------------- fetch
     def get_next(self, timeout: Optional[float] = None) -> Any:
-        """Next result in submission order."""
+        """Next result in submission order. An application error from the
+        task is re-raised once — the actor returns to the pool and the pool
+        advances past the failed index (a timeout leaves state untouched so
+        the caller can retry)."""
         if not self.has_next():
             raise StopIteration("no more results")
         i = self._next_return_index
         ref = self._index_to_future[i]
-        # Fetch BEFORE consuming bookkeeping: a get() timeout must leave the
-        # pool intact so the caller can retry.
-        value = ray_tpu.get(ref, timeout=timeout or 600)
+        try:
+            value = ray_tpu.get(ref, timeout=timeout or 600)
+        except (ray_tpu.exceptions.GetTimeoutError, TimeoutError):
+            raise
+        except Exception:
+            self._next_return_index += 1
+            self._index_to_future.pop(i)
+            self._return_actor(ref)
+            raise
         self._next_return_index += 1
         self._index_to_future.pop(i)
         self._return_actor(ref)
@@ -65,7 +74,11 @@ class ActorPool:
         ref = ready[0]
         i, _ = self._future_to_actor[ref]
         self._index_to_future.pop(i, None)
-        value = ray_tpu.get(ref, timeout=60)
+        try:
+            value = ray_tpu.get(ref, timeout=60)
+        except Exception:
+            self._return_actor(ref)
+            raise
         self._return_actor(ref)
         return value
 

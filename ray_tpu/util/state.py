@@ -1,0 +1,142 @@
+"""State API SDK — programmatic cluster introspection.
+
+Reference: `python/ray/util/state/api.py` (`ray.util.state.list_actors`
+etc. over the GCS + per-raylet state RPCs,
+`node_manager.proto:420-422`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+def _gcs():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker().gcs
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return ray_tpu.nodes()
+
+
+def list_actors(detail: bool = False) -> List[Dict[str, Any]]:
+    out = []
+    for info in _gcs().call("list_actors", timeout=30):
+        row = {
+            "actor_id": info["actor_id"].hex(),
+            "class_name": info.get("class_name", ""),
+            "state": info.get("state"),
+            "name": info.get("name", ""),
+            "node_id": (info.get("node_id") or b"").hex(),
+            "worker_id": (info.get("worker_id") or b"").hex(),
+        }
+        if detail:
+            row["death_cause"] = info.get("death_cause")
+            row["num_restarts"] = info.get("restarts_used", 0)
+        out.append(row)
+    return out
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    return [{
+        "worker_id": w["worker_id"].hex(),
+        "node_id": w["node_id"].hex(),
+        "mode": w.get("mode"),
+        "pid": w.get("pid"),
+    } for w in _gcs().call("list_workers", timeout=30)]
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return [{
+        "job_id": j["job_id"].hex(),
+        "state": j.get("state"),
+        "metadata": j.get("metadata") or {},
+    } for j in _gcs().call("list_jobs", timeout=30)]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return [{
+        "placement_group_id": p["pg_id"].hex(),
+        "state": p.get("state"),
+        "strategy": p.get("strategy"),
+        "bundles": p.get("bundles"),
+        "name": p.get("name", ""),
+    } for p in _gcs().call("list_placement_groups", timeout=30)]
+
+
+def list_tasks(job_id: Optional[bytes] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    """Latest lifecycle state per task from the GCS task-event table."""
+    events = _gcs().call("get_task_events", job_id=job_id, limit=limit * 4,
+                         timeout=30)
+    latest: Dict[bytes, Dict[str, Any]] = {}
+    for e in events:
+        latest[e["task_id"]] = e
+    out = []
+    for e in list(latest.values())[-limit:]:
+        out.append({
+            "task_id": e["task_id"].hex(),
+            "name": e.get("name"),
+            "state": e.get("state"),
+            "job_id": e["job_id"].hex() if e.get("job_id") else None,
+            "ts": e.get("ts"),
+        })
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Per-node shared-memory store summaries (via raylet node_stats)."""
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    out = []
+    for node in _gcs().call("get_all_nodes", timeout=30):
+        if node.get("state") != "ALIVE":
+            continue
+        client = w._raylet_for_node(node["node_id"])
+        if client is None:
+            continue
+        try:
+            stats = client.call("node_stats", timeout=15)
+        except Exception:
+            continue
+        row = dict(stats.get("store") or {})
+        row["node_id"] = node["node_id"].hex()
+        row["num_workers"] = stats.get("num_workers")
+        out.append(row)
+    return out
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for node in _gcs().call("get_all_nodes", timeout=30):
+        if node.get("state") != "ALIVE":
+            continue
+        for k, v in (node.get("total") or {}).items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    avail: Dict[str, float] = {}
+    for node in _gcs().call("get_all_nodes", timeout=30):
+        if node.get("state") != "ALIVE":
+            continue
+        for k, v in (node.get("available") or {}).items():
+            avail[k] = avail.get(k, 0) + v
+    return avail
+
+
+def summary() -> Dict[str, Any]:
+    nodes = ray_tpu.nodes()
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["Alive"]),
+        "nodes_dead": sum(1 for n in nodes if not n["Alive"]),
+        "actors": len(list_actors()),
+        "workers": len(list_workers()),
+        "cluster_resources": cluster_resources(),
+        "available_resources": available_resources(),
+    }

@@ -1,0 +1,137 @@
+"""Ops plane: state API SDK, task events, Prometheus metrics, job
+submission, CLI (reference: `python/ray/util/state/api.py`,
+`dashboard/modules/job/job_manager.py`, `scripts/scripts.py`)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+def test_state_summary_and_lists(ray_start_regular):
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    actor = Pinger.remote()
+    ray_tpu.get(actor.ping.remote(), timeout=60)
+
+    s = state.summary()
+    assert s["nodes_alive"] >= 1
+    assert s["cluster_resources"]["CPU"] >= 1
+
+    actors = state.list_actors()
+    assert any(a["class_name"] == "Pinger" and a["state"] == "ALIVE"
+               for a in actors)
+    assert len(state.list_workers()) >= 1
+    assert len(state.list_nodes()) >= 1
+    ray_tpu.kill(actor)
+
+
+def test_task_events_reach_state_api(ray_start_regular):
+    @ray_tpu.remote
+    def traced(x):
+        return x + 1
+
+    assert ray_tpu.get(traced.remote(1), timeout=60) == 2
+    from ray_tpu._private.worker import global_worker
+
+    global_worker().flush_task_events()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks()
+        finished = [t for t in tasks
+                    if t["name"] == "traced" and t["state"] == "FINISHED"]
+        if finished:
+            break
+        time.sleep(0.5)
+    assert finished, f"no FINISHED traced task in {tasks}"
+
+
+def test_prometheus_metrics_rpc_and_http(ray_start_regular):
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    text = w.gcs.call("metrics_text", timeout=30)
+    assert "rtpu_nodes_total" in text
+    assert 'rtpu_resource_total{' in text
+
+    port_raw = w.gcs.call("kv_get", namespace="__internal__",
+                          key="metrics_port")
+    assert port_raw, "GCS did not start its metrics HTTP endpoint"
+    port = int(port_raw.decode())
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+    assert "rtpu_nodes_total" in body
+
+
+def test_job_submission_lifecycle(ray_start_regular, tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import os\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS'])\n"
+        "@ray_tpu.remote\n"
+        "def f(x): return 2 * x\n"
+        "print('total:', sum(ray_tpu.get([f.remote(i) for i in range(4)],"
+        " timeout=60)))\n"
+        "ray_tpu.shutdown()\n")
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    status = client.wait_until_finished(sid, timeout=180)
+    assert status == "SUCCEEDED", client.get_job_logs(sid)
+    assert "total: 12" in client.get_job_logs(sid)
+    assert any(j["submission_id"] == sid for j in client.list_jobs())
+
+
+def test_failed_job_reports_failure(ray_start_regular):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c 'import sys; "
+                                       f"print(\"dying\"); sys.exit(3)'")
+    assert client.wait_until_finished(sid, timeout=120) == "FAILED"
+    info = client.get_job_info(sid)
+    assert info["returncode"] == 3
+    assert "dying" in client.get_job_logs(sid)
+
+
+def test_cli_start_status_stop(tmp_path):
+    """Full CLI lifecycle in a subprocess-started standalone cluster."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "2"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert "cluster address:" in out.stdout, out.stderr
+    addr = out.stdout.split("cluster address:")[1].split()[0]
+    session_dir = out.stdout.split("session dir:")[1].split()[0]
+    try:
+        st = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "--address", addr, "status"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert "nodes: 1 alive" in st.stdout, st.stderr
+        ls = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "--address", addr, "list",
+             "nodes"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert "NodeID" in ls.stdout or "node" in ls.stdout.lower()
+    finally:
+        # Selective stop: only THIS cluster's daemons (a global `stop`
+        # would nuke the other test modules' clusters).
+        subprocess.run(["pkill", "-f", session_dir],
+                       capture_output=True, timeout=60)

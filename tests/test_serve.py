@@ -1,0 +1,148 @@
+"""Serve-equivalent: controller/replica/router/proxy (reference:
+`serve/_private/controller.py:84`, `pow_2_scheduler.py:44`,
+`serve/_private/proxy.py`)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def _serve_cleanup(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+@serve.deployment
+class Doubler:
+    def __call__(self, x):
+        return 2 * x
+
+    def triple(self, x):
+        return 3 * x
+
+
+def test_deploy_and_handle_call():
+    handle = serve.run(Doubler.bind(), name="doubler")
+    assert handle.remote(21).result() == 42
+    assert handle.triple.remote(5).result() == 15
+
+
+def test_multiple_replicas_share_load():
+    import os
+
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __call__(self, _):
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind(), name="who")
+    pids = {handle.remote(None).result(timeout=60) for _ in range(20)}
+    assert len(pids) == 2  # pow-2 routing reaches both replicas
+
+
+def test_function_deployment():
+    @serve.deployment
+    def add_one(x):
+        return x + 1
+
+    handle = serve.run(add_one.bind(), name="fn")
+    assert handle.remote(41).result() == 42
+
+
+def test_composition_with_inner_handle():
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x * 10
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, pre):
+            self._pre = pre  # DeploymentHandle (rehydrated in the replica)
+
+        def __call__(self, x):
+            pre = self._pre.remote(x).result(timeout=60)
+            return pre + 1
+
+    app = Pipeline.bind(Preprocess.bind())
+    handle = serve.run(app, name="pipeline")
+    assert handle.remote(4).result(timeout=60) == 41
+
+
+def test_redeploy_scales_replicas():
+    @serve.deployment(num_replicas=1)
+    class S:
+        def __call__(self, _):
+            return "ok"
+
+    serve.run(S.bind(), name="scale")
+    assert serve.status("scale")[0]["num_replicas"] == 1
+
+    serve.run(S.options(num_replicas=3).bind(), name="scale")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = serve.status("scale")[0]
+        if st["live_replicas"] == 3:
+            break
+        time.sleep(0.5)
+    assert serve.status("scale")[0]["live_replicas"] == 3
+
+
+def test_replica_crash_recovery():
+    import os
+
+    @serve.deployment(num_replicas=1)
+    class Fragile:
+        def __call__(self, cmd):
+            if cmd == "die":
+                os._exit(1)
+            return "alive"
+
+    handle = serve.run(Fragile.bind(), name="fragile")
+    assert handle.remote("ping").result(timeout=60) == "alive"
+    try:
+        handle.remote("die").result(timeout=30)
+    except Exception:
+        pass
+    # The controller's reconcile loop replaces the dead replica.
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        try:
+            assert handle.remote("ping").result(timeout=30) == "alive"
+            break
+        except Exception:
+            time.sleep(1.0)
+    else:
+        pytest.fail("replica never recovered")
+
+
+def test_http_proxy():
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"echoed": payload}
+
+    serve.run(Echo.bind(), name="echo")
+    proxy = serve.start()
+    port = ray_tpu.get(proxy.get_port.remote(), timeout=60)
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo",
+        data=json.dumps({"msg": "hi"}).encode(),
+        headers={"Content-Type": "application/json"})
+    body = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert body["result"] == {"echoed": {"msg": "hi"}}
+
+    # Unknown app -> 404
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nosuchapp", timeout=30)
+        pytest.fail("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
