@@ -4,8 +4,10 @@ Role-equivalent to the reference's `src/ray/gcs/gcs_server/gcs_server.cc:187-232
 which installs node / resource / health / job / actor / placement-group / KV /
 pubsub / task-event managers. One GCS per cluster, run as its own process
 (``python -m ray_tpu._private.gcs_server``). State lives in an in-memory store
-(the reference's default `gcs_storage="memory"`); a file-backed snapshot hook
-exists for restart tolerance.
+(the reference's default `gcs_storage="memory"`), with a periodic
+file-backed snapshot of the durable tables (KV, jobs, named-actor registry)
+so a restarted GCS recovers them (reference analog:
+`store_client/redis_store_client.h:33` — Redis-backed FT).
 
 Actors are scheduled *centrally* here (reference: `gcs_actor_scheduler.cc:49`),
 unlike normal tasks which use the distributed raylet lease protocol.
@@ -15,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import time
 from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Tuple
@@ -103,12 +106,78 @@ class GcsServer:
 
         self._register_handlers()
         self._health_task = None
+        self._snapshot_path: Optional[str] = None
+        self._snapshot_task = None
+        self._snapshot_dirty = False
+        self._snapshot_errors = 0
 
     # ------------------------------------------------------------------ boot
     def start(self) -> int:
         port = self.server.start()
         self._health_task = get_io_loop().submit(self._health_loop())
+        if self._snapshot_path:
+            self._snapshot_task = get_io_loop().submit(self._snapshot_loop())
         return port
+
+    # ------------------------------------------------------- persistence
+    def enable_snapshots(self, path: str) -> None:
+        """Persist the durable tables (KV, jobs, named actors) to `path`
+        periodically; load an existing snapshot now. Runtime state (nodes,
+        leases, live actors) intentionally rebuilds via re-registration."""
+        import pickle
+
+        self._snapshot_path = path
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    snap = pickle.load(f)
+                for ns, entries in snap.get("kv", {}).items():
+                    self.kv[ns].update(entries)
+                self.jobs.update(snap.get("jobs", {}))
+                self._next_job_int = max(self._next_job_int,
+                                         snap.get("next_job_int", 0))
+            except Exception as e:  # corrupt snapshot: recover empty, SAY SO
+                import sys
+
+                print(f"[gcs] WARNING: snapshot at {path} unreadable "
+                      f"({type(e).__name__}: {e}); starting without "
+                      "recovered state", file=sys.stderr, flush=True)
+
+    def _write_snapshot(self) -> None:
+        import pickle
+
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({
+                "kv": {ns: dict(entries)
+                       for ns, entries in self.kv.items()},
+                "jobs": dict(self.jobs),
+                "next_job_int": self._next_job_int,
+            }, f)
+        os.replace(tmp, self._snapshot_path)
+
+    async def _snapshot_loop(self):
+        import sys
+
+        while True:
+            await asyncio.sleep(5.0)
+            if not self._snapshot_dirty:
+                continue
+            self._snapshot_dirty = False
+            try:
+                # The pickle+write runs off-loop: a large KV (exported
+                # functions) must not stall heartbeat handling.
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._write_snapshot)
+                self._snapshot_errors = 0
+            except Exception as e:
+                self._snapshot_dirty = True
+                self._snapshot_errors += 1
+                if self._snapshot_errors in (1, 10, 100):
+                    print(f"[gcs] WARNING: snapshot write failed x"
+                          f"{self._snapshot_errors} "
+                          f"({type(e).__name__}: {e})",
+                          file=sys.stderr, flush=True)
 
     def _register_handlers(self):
         s = self.server
@@ -127,7 +196,7 @@ class GcsServer:
             "publish", "poll", "push_task_events", "get_task_events",
             "register_worker", "list_workers", "get_system_config",
             "cluster_resources", "available_resources", "internal_stats",
-            "metrics_text",
+            "metrics_text", "get_cluster_load",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
 
@@ -244,14 +313,35 @@ class GcsServer:
         return {"system_config": GlobalConfig.dump_system_config(),
                 "nodes": self._nodes_snapshot()}
 
-    async def _h_heartbeat(self, node_id, available, total, idle=True):
+    async def _h_heartbeat(self, node_id, available, total, idle=True,
+                           pending_demands=None, num_workers=0):
         if node_id not in self.nodes:
             return {"unknown": True}
         self._last_heartbeat[node_id] = time.monotonic()
         nr = NodeResources(ResourceSet(total), self.nodes[node_id]["labels"])
         nr.available = ResourceSet(available)
         self.view.update_node(node_id, nr)
+        self.nodes[node_id]["pending_demands"] = pending_demands or []
+        self.nodes[node_id]["num_workers"] = num_workers
         return {"nodes": self._nodes_snapshot()}
+
+    async def _h_get_cluster_load(self):
+        """Autoscaler state (reference: gcs_autoscaler_state_manager.h):
+        per-node availability plus demands queued with no feasible home."""
+        out = []
+        for node_id, info in self.nodes.items():
+            if info["state"] != ALIVE:
+                continue
+            nr = self.view.get(node_id)
+            out.append({
+                "node_id": node_id,
+                "total": nr.total.to_dict() if nr else {},
+                "available": nr.available.to_dict() if nr else {},
+                "pending_demands": info.get("pending_demands", []),
+                "num_workers": info.get("num_workers", 0),
+                "labels": info.get("labels", {}),
+            })
+        return out
 
     def _nodes_snapshot(self):
         out = []
@@ -310,7 +400,11 @@ class GcsServer:
         return self._node_clients[node_id]
 
     # --------------------------------------------------------------------- kv
+    def _mark_dirty(self) -> None:
+        self._snapshot_dirty = True
+
     async def _h_kv_put(self, namespace, key, value, overwrite=True):
+        self._mark_dirty()
         ns = self.kv[namespace]
         if not overwrite and key in ns:
             return False
@@ -321,6 +415,7 @@ class GcsServer:
         return self.kv[namespace].get(key)
 
     async def _h_kv_del(self, namespace, key):
+        self._mark_dirty()
         return self.kv[namespace].pop(key, None) is not None
 
     async def _h_kv_keys(self, namespace, prefix=""):
@@ -709,12 +804,14 @@ class GcsServer:
         return self._next_job_int
 
     async def _h_register_job(self, job_id, driver_addr, metadata=None):
+        self._mark_dirty()
         self.jobs[job_id] = {"job_id": job_id, "driver_addr": driver_addr,
                              "metadata": metadata or {}, "state": "RUNNING",
                              "start_time": time.time()}
         return True
 
     async def _h_mark_job_finished(self, job_id):
+        self._mark_dirty()
         if job_id in self.jobs:
             self.jobs[job_id]["state"] = "FINISHED"
             self.jobs[job_id]["end_time"] = time.time()
@@ -790,6 +887,20 @@ def main():
 
     watch_parent(args.fate_share_pid)
     gcs = GcsServer(args.host, args.port)
+    if args.session_dir:
+        gcs.enable_snapshots(
+            os.path.join(args.session_dir, "gcs_snapshot.pkl"))
+
+        def _final_snapshot(*_):
+            try:
+                gcs._write_snapshot()
+            except Exception:
+                pass
+            os._exit(0)
+
+        import signal
+
+        signal.signal(signal.SIGTERM, _final_snapshot)
     port = gcs.start()
     metrics_port = gcs.start_metrics_http()
     # Parent discovers the ports from stdout.

@@ -105,6 +105,11 @@ class Raylet:
         # --- queued lease requests waiting for local resources ---
         self._lease_queue: deque = deque()
         self._lease_queue_event = asyncio.Event()
+        # Demands recently rejected as infeasible-anywhere: the autoscaler's
+        # scale-up signal (owners retry from their side, so these never sit
+        # in _lease_queue). Deduped by shape — lease retries of one task
+        # must not read as N distinct demands.
+        self._unfulfilled: Dict[tuple, float] = {}
 
         # --- placement group bundles ---
         # (pg_id, idx) -> {"resources": ResourceSet, "committed": bool}
@@ -152,10 +157,20 @@ class Raylet:
         period = GlobalConfig.health_check_period_ms / 1000
         while not self._dead:
             try:
+                now = time.monotonic()
+                pending = [item[0].to_dict()
+                           for item in list(self._lease_queue)[:64]]
+                for key, ts in list(self._unfulfilled.items()):
+                    if now - ts >= 10.0:
+                        del self._unfulfilled[key]
+                    else:
+                        pending.append(dict(key))
                 reply = await self.gcs.acall(
                     "heartbeat", node_id=self.node_id,
                     available=self.local.available.to_dict(),
                     total=self.local.total.to_dict(),
+                    pending_demands=pending,
+                    num_workers=len(self.workers),
                     timeout=10)
                 if "nodes" in reply:
                     self._apply_nodes_snapshot(reply["nodes"])
@@ -404,6 +419,8 @@ class Raylet:
                 return {"spillback_to": self._node_addrs.get(strategy.node_id),
                         "spillback_node": strategy.node_id}
         if not is_feasible_anywhere(self.view, demand_rs):
+            key = tuple(sorted(demand_rs.to_dict().items()))
+            self._unfulfilled[key] = time.monotonic()
             return {"infeasible": True}
         return {"retry": True}
 

@@ -217,7 +217,7 @@ class Worker:
         for name in ["push_task", "create_actor", "push_actor_task",
                      "get_object_status", "kill_self", "cancel_task", "ping",
                      "delete_object_notification", "report_generator_item",
-                     "recover_object"]:
+                     "recover_object", "wait_object_status"]:
             self.server.register(name, getattr(self, f"_h_{name}"))
         self.port = self.server.start()
         self.addr = (bind_host, self.port)
@@ -439,12 +439,23 @@ class Worker:
         oid = ref.binary()
         deadline = None if timeout is None else time.monotonic() + timeout
         owner = self._client_for(tuple(ref.owner_addr))
-        delay = 0.002
         recovery_attempts = 0
+        first = True
         while True:
             try:
-                status = owner.call("get_object_status", object_id=oid,
-                                    timeout=30)
+                if first:
+                    # Fast path: object usually already resolved.
+                    status = owner.call("get_object_status", object_id=oid,
+                                        timeout=30)
+                    first = False
+                else:
+                    window = 10.0
+                    if deadline is not None:
+                        window = max(0.05, min(
+                            window, deadline - time.monotonic()))
+                    status = owner.call("wait_object_status", object_id=oid,
+                                        wait_timeout=window,
+                                        timeout=window + 30)
             except (ConnectionLost, OSError):
                 raise exc.OwnerDiedError(
                     f"owner of {oid.hex()} at {ref.owner_addr} is unreachable; "
@@ -488,26 +499,32 @@ class Worker:
             if deadline is not None and time.monotonic() > deadline:
                 raise exc.GetTimeoutError(
                     f"get() timed out waiting for borrowed {oid.hex()}")
-            time.sleep(delay)
-            delay = min(delay * 1.5, 0.1)
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True
              ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         deadline = None if timeout is None else time.monotonic() + timeout
         refs = list(refs)
+        ready_ids: set = set()   # sticky: a ready object stays ready
+        delay = 0.002
         while True:
             ready, not_ready = [], []
             for ref in refs:
-                (ready if self._is_ready(ref) else not_ready).append(ref)
+                if ref.binary() in ready_ids or self._is_ready(ref):
+                    ready_ids.add(ref.binary())
+                    ready.append(ref)
+                else:
+                    not_ready.append(ref)
             if len(ready) >= num_returns or (
                     deadline is not None and time.monotonic() >= deadline):
                 # Reference semantics: at most num_returns refs are reported
                 # ready; the surplus stays in the not-ready list, in order.
                 capped = ready[:num_returns]
-                rest = [r for r in refs if r not in capped]
+                capped_ids = {id(r) for r in capped}
+                rest = [r for r in refs if id(r) not in capped_ids]
                 return capped, rest
-            time.sleep(0.002)
+            time.sleep(delay)
+            delay = min(delay * 1.5, 0.05)
 
     def _is_ready(self, ref: ObjectRef) -> bool:
         entry = self._entry(ref.binary(), create=False)
@@ -1134,6 +1151,39 @@ class Worker:
     # ======================================================================
     async def _h_ping(self):
         return "pong"
+
+    async def _h_wait_object_status(self, object_id, wait_timeout=10.0):
+        """Long-poll variant of get_object_status: blocks server-side until
+        the object resolves (or the poll window closes), replacing
+        borrower-side fixed-rate polling (reference: owner push/long-poll,
+        `core_worker.proto:425`). Never fabricates entries: freed/unknown
+        ids answer immediately (a freed object must not block the window,
+        and phantom entries would leak)."""
+        deadline = asyncio.get_running_loop().time() + min(wait_timeout, 30.0)
+        while True:
+            status = await self._h_get_object_status(object_id)
+            if status.get("status") != "pending":
+                return status
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                return status
+            entry = self._entry(object_id, create=False)
+            if entry is None:
+                # Unknown here (not yet submitted / already dropped):
+                # cheap re-check without creating state.
+                await asyncio.sleep(min(0.05, remaining))
+                continue
+            fut = asyncio.get_running_loop().create_future()
+            entry.waiters.append(fut)
+            if entry.event.is_set() and not fut.done():
+                fut.set_result(None)
+            try:
+                await asyncio.wait_for(fut, remaining)
+            except asyncio.TimeoutError:
+                try:
+                    entry.waiters.remove(fut)
+                except ValueError:
+                    pass
 
     async def _h_get_object_status(self, object_id):
         entry = self._entry(object_id, create=False)

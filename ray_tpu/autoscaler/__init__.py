@@ -1,0 +1,6 @@
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (
+    FakeMultiNodeProvider, NodeProvider,
+)
+
+__all__ = ["FakeMultiNodeProvider", "NodeProvider", "StandardAutoscaler"]

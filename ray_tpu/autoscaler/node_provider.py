@@ -1,0 +1,87 @@
+"""NodeProvider — the pluggable infrastructure backend of the autoscaler.
+
+Reference: `python/ray/autoscaler/node_provider.py` (the ABC all cloud
+providers implement) and the fake in-process provider used for autoscaler
+e2e tests without a cloud
+(`autoscaler/_private/fake_multi_node/node_provider.py`).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Creates/terminates worker nodes of named types."""
+
+    def create_node(self, node_type: str,
+                    node_config: Dict[str, Any]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_type_of(self, provider_node_id: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def internal_node_id(self, provider_node_id: str) -> Optional[bytes]:
+        """The cluster NodeID once the node has joined, else None."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Starts real raylet processes on this machine as 'cloud nodes' —
+    scale-up/down runs the true join/leave path with no cloud."""
+
+    def __init__(self, gcs_addr, session_dir: str):
+        self._gcs_addr = tuple(gcs_addr)
+        self._session_dir = session_dir
+        self._nodes: Dict[str, Any] = {}      # provider id -> Node
+        self._types: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: str,
+                    node_config: Dict[str, Any]) -> str:
+        from ray_tpu._private.node import Node
+
+        resources = dict(node_config.get("resources", {}))
+        num_cpus = resources.pop("CPU", 1)
+        node = Node(head=False, gcs_addr=self._gcs_addr,
+                    num_cpus=num_cpus, num_tpus=resources.pop("TPU", 0),
+                    resources=resources, session_dir=self._session_dir,
+                    labels={"autoscaler-node-type": node_type})
+        pid = f"fake-{node_type}-{uuid.uuid4().hex[:6]}"
+        with self._lock:
+            self._nodes[pid] = node
+            self._types[pid] = node_type
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(provider_node_id, None)
+            self._types.pop(provider_node_id, None)
+        if node is not None:
+            node.shutdown(cleanup_session=False)
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def node_type_of(self, provider_node_id: str) -> Optional[str]:
+        return self._types.get(provider_node_id)
+
+    def internal_node_id(self, provider_node_id: str) -> Optional[bytes]:
+        node = self._nodes.get(provider_node_id)
+        return node.node_id.binary() if node is not None else None
+
+    def shutdown(self) -> None:
+        for pid in list(self._nodes):
+            self.terminate_node(pid)

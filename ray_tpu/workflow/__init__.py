@@ -1,0 +1,204 @@
+"""ray_tpu.workflow — durable task DAGs with storage-backed resume.
+
+Reference: `python/ray/workflow/` (`workflow_executor.py:32`,
+`workflow_state.py`, `workflow_state_from_storage.py`): steps compose into
+a DAG; every step's output is checkpointed to storage as it completes, so
+a crashed/interrupted workflow resumes from its last finished step —
+completed steps replay from storage, never re-execute.
+
+API (classic step style)::
+
+    from ray_tpu import workflow
+
+    workflow.init("/path/to/storage")
+
+    @workflow.step
+    def fetch(x): ...
+
+    @workflow.step
+    def combine(a, b): ...
+
+    out = combine.step(fetch.step(1), fetch.step(2)).run("my_wf")
+    # after a crash: workflow.resume("my_wf")
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+
+_storage_dir: Optional[str] = None
+
+
+def init(storage_dir: str) -> None:
+    global _storage_dir
+    _storage_dir = os.path.abspath(storage_dir)
+    os.makedirs(_storage_dir, exist_ok=True)
+
+
+def _storage() -> str:
+    if _storage_dir is None:
+        raise RuntimeError("call workflow.init(storage_dir) first")
+    return _storage_dir
+
+
+class Step:
+    """One DAG node: a function + args (args may be other Steps)."""
+
+    def __init__(self, fn, args: tuple, kwargs: dict, name: str):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name
+        self.step_id: Optional[str] = None  # assigned at run (deterministic)
+
+    def run(self, workflow_id: str) -> Any:
+        return run(self, workflow_id)
+
+    def run_async(self, workflow_id: str):
+        raise NotImplementedError("use run(); async execution TBD")
+
+
+class _StepBuilder:
+    def __init__(self, fn):
+        self._fn = fn
+        self.__name__ = getattr(fn, "__name__", "step")
+
+    def step(self, *args, **kwargs) -> Step:
+        return Step(self._fn, args, kwargs, self.__name__)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def step(fn) -> _StepBuilder:
+    return _StepBuilder(fn)
+
+
+# ---------------------------------------------------------------- executor
+
+def _assign_ids(root: Step) -> List[Step]:
+    """Deterministic ids from DAG structure (stable across resumes)."""
+    order: List[Step] = []
+    counter: Dict[str, int] = {}
+
+    def visit(node: Step):
+        for a in list(node.args) + list(node.kwargs.values()):
+            if isinstance(a, Step):
+                visit(a)
+        if node.step_id is None:
+            idx = counter.get(node.name, 0)
+            counter[node.name] = idx + 1
+            node.step_id = f"{node.name}_{idx}"
+            order.append(node)
+
+    visit(root)
+    return order  # topological: dependencies before dependents
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage(), workflow_id)
+
+
+def _step_output_path(workflow_id: str, step_id: str) -> str:
+    return os.path.join(_wf_dir(workflow_id), f"step_{step_id}.pkl")
+
+
+def _set_status(workflow_id: str, status: str) -> None:
+    meta = os.path.join(_wf_dir(workflow_id), "status.json")
+    with open(meta + ".tmp", "w") as f:
+        json.dump({"status": status, "ts": time.time()}, f)
+    os.replace(meta + ".tmp", meta)
+
+
+def run(dag: Step, workflow_id: str) -> Any:
+    """Execute the DAG durably. The DAG definition itself persists first so
+    `resume(workflow_id)` works without re-supplying code."""
+    wf_dir = _wf_dir(workflow_id)
+    os.makedirs(wf_dir, exist_ok=True)
+    dag_path = os.path.join(wf_dir, "dag.pkl")
+    if not os.path.exists(dag_path):
+        with open(dag_path, "wb") as f:
+            cloudpickle.dump(dag, f)
+    return _execute(dag, workflow_id)
+
+
+def _execute(dag: Step, workflow_id: str) -> Any:
+    _set_status(workflow_id, "RUNNING")
+    steps = _assign_ids(dag)
+    results: Dict[str, Any] = {}
+
+    try:
+        for node in steps:  # topological order
+            out_path = _step_output_path(workflow_id, node.step_id)
+            if os.path.exists(out_path):
+                with open(out_path, "rb") as f:
+                    results[node.step_id] = pickle.load(f)
+                continue  # checkpointed by a previous run: replay, not rerun
+
+            def resolve(v):
+                return results[v.step_id] if isinstance(v, Step) else v
+
+            args = tuple(resolve(a) for a in node.args)
+            kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+            remote_fn = ray_tpu.remote(node.fn)
+            value = ray_tpu.get(remote_fn.remote(*args, **kwargs),
+                                timeout=3600)
+            with open(out_path + ".tmp", "wb") as f:
+                pickle.dump(value, f)
+            os.replace(out_path + ".tmp", out_path)  # atomic checkpoint
+            results[node.step_id] = value
+    except BaseException:
+        _set_status(workflow_id, "FAILED")
+        raise
+    _set_status(workflow_id, "SUCCEEDED")
+    return results[dag.step_id]
+
+
+def resume(workflow_id: str) -> Any:
+    """Continue an interrupted workflow from its persisted DAG + completed
+    step checkpoints."""
+    dag_path = os.path.join(_wf_dir(workflow_id), "dag.pkl")
+    if not os.path.exists(dag_path):
+        raise KeyError(f"no persisted workflow '{workflow_id}'")
+    with open(dag_path, "rb") as f:
+        dag = cloudpickle.load(f)
+    return _execute(dag, workflow_id)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    meta = os.path.join(_wf_dir(workflow_id), "status.json")
+    if not os.path.exists(meta):
+        return None
+    with open(meta) as f:
+        return json.load(f)["status"]
+
+
+def get_output(workflow_id: str) -> Any:
+    """Output of a finished workflow (from storage; no re-execution)."""
+    with open(os.path.join(_wf_dir(workflow_id), "dag.pkl"), "rb") as f:
+        dag = cloudpickle.load(f)
+    steps = _assign_ids(dag)
+    out_path = _step_output_path(workflow_id, steps[-1].step_id)
+    if not os.path.exists(out_path):
+        raise RuntimeError(f"workflow '{workflow_id}' has no final output "
+                           "(resume it first)")
+    with open(out_path, "rb") as f:
+        return pickle.load(f)
+
+
+def list_all() -> List[Dict[str, Any]]:
+    out = []
+    root = _storage()
+    for wf_id in sorted(os.listdir(root)):
+        status = get_status(wf_id)
+        if status is not None:
+            out.append({"workflow_id": wf_id, "status": status})
+    return out

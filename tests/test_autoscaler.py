@@ -1,0 +1,78 @@
+"""Autoscaler: demand-driven scale-up + idle scale-down over the fake
+in-process provider (reference: `autoscaler/_private/autoscaler.py`,
+`fake_multi_node/node_provider.py`, v2 GCS load source)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import FakeMultiNodeProvider, StandardAutoscaler
+
+
+def test_scales_up_for_infeasible_demand_and_down_when_idle(
+        ray_start_isolated):
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    provider = FakeMultiNodeProvider(w.gcs_addr, w.session_dir)
+    autoscaler = StandardAutoscaler(
+        w.gcs_addr, provider,
+        available_node_types={
+            "gpuless.big": {"resources": {"CPU": 2, "bigmem": 1},
+                            "min_workers": 0, "max_workers": 3},
+        },
+        max_workers=3, idle_timeout_s=3.0)
+    try:
+        # Demand that no current node can satisfy.
+        @ray_tpu.remote(resources={"bigmem": 0.5})
+        def needs_bigmem():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        ref = needs_bigmem.remote()
+
+        # Let the raylet queue the infeasible demand and heartbeat it up.
+        launched = 0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and launched == 0:
+            time.sleep(1.0)
+            launched = autoscaler.update()["launched"]
+        assert launched == 1, "autoscaler never scaled up"
+
+        # The task schedules on the new node once it joins.
+        node_id = ray_tpu.get(ref, timeout=120)
+        new_pid = provider.non_terminated_nodes()[0]
+        assert provider.internal_node_id(new_pid).hex() == node_id
+
+        # Once idle past the timeout, the node scales back down.
+        deadline = time.monotonic() + 90
+        terminated = 0
+        while time.monotonic() < deadline and terminated == 0:
+            time.sleep(1.0)
+            terminated = autoscaler.update()["terminated"]
+        assert terminated == 1, "autoscaler never scaled down"
+        assert provider.non_terminated_nodes() == []
+    finally:
+        provider.shutdown()
+
+
+def test_min_workers_maintained(ray_start_isolated):
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    provider = FakeMultiNodeProvider(w.gcs_addr, w.session_dir)
+    autoscaler = StandardAutoscaler(
+        w.gcs_addr, provider,
+        available_node_types={
+            "small": {"resources": {"CPU": 1}, "min_workers": 2},
+        },
+        max_workers=4, idle_timeout_s=9999)
+    try:
+        autoscaler.update()
+        assert len(provider.non_terminated_nodes()) == 2
+        # Killing one gets replaced on the next pass.
+        provider.terminate_node(provider.non_terminated_nodes()[0])
+        autoscaler.update()
+        assert len(provider.non_terminated_nodes()) == 2
+    finally:
+        provider.shutdown()
