@@ -17,7 +17,7 @@ from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.dataset import Dataset, MaterializedDataset
 from ray_tpu.data.datasource import (
     BinaryDatasource, CSVDatasource, Datasource, ItemsDatasource,
-    NumpyDatasource, ParquetDatasource, RangeDatasource, TextDatasource,
+    NumpyDatasource, ParquetDatasource, RangeDatasource, TextDatasource, JSONDatasource,
 )
 from ray_tpu.data.iterator import DataIterator
 
@@ -62,6 +62,10 @@ def read_csv(paths, **_ignored) -> Dataset:
     return _read(CSVDatasource(paths))
 
 
+def read_json(paths, **_ignored) -> Dataset:
+    return _read(JSONDatasource(paths))
+
+
 def read_text(paths, **_ignored) -> Dataset:
     return _read(TextDatasource(paths))
 
@@ -74,5 +78,5 @@ __all__ = [
     "Block", "BlockAccessor", "DataIterator", "Dataset",
     "MaterializedDataset", "Datasource", "range", "from_items",
     "from_numpy", "from_pandas", "from_arrow", "read_parquet", "read_csv",
-    "read_text", "read_binary_files",
+    "read_json", "read_text", "read_binary_files",
 ]

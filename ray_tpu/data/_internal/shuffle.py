@@ -1,0 +1,174 @@
+"""All-to-all ops: distributed sort and hash groupby over tasks.
+
+Reference: `python/ray/data/_internal/planner/exchange/` (sort/shuffle
+task schedulers): map tasks partition each input block (by sampled range
+boundaries for sort, by key hash for groupby), reduce tasks combine one
+partition each. Partitioned chunks stay in the object store between the
+map and reduce stages (map tasks return one ref per partition; reduce
+tasks take refs), so the dataset never round-trips through the driver.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, List
+
+import numpy as np
+
+import ray_tpu
+
+
+def _sort_table(table, key: str, descending: bool):
+    import pyarrow.compute as pc
+
+    order = "descending" if descending else "ascending"
+    idx = pc.sort_indices(table, sort_keys=[(key, order)])
+    return table.take(idx)
+
+
+def _partition_ids(col: np.ndarray, boundaries: List[Any],
+                   descending: bool) -> np.ndarray:
+    """Partition index per row. `boundaries` are sorted in output order
+    (ascending or descending). No negation tricks — works for strings and
+    unsigned ints too."""
+    if descending:
+        # partition p = #{boundaries >= value}; count via the ascending
+        # view of the boundaries.
+        asc = np.asarray(boundaries[::-1])
+        return len(boundaries) - np.searchsorted(asc, col, side="left")
+    return np.searchsorted(np.asarray(boundaries), col, side="right")
+
+
+@ray_tpu.remote
+def _range_partition_block(table, key: str, boundaries: List[Any],
+                           descending: bool):
+    """Split one block into len(boundaries)+1 range chunks (unsorted —
+    the reduce stage sorts)."""
+    import pyarrow as pa
+
+    idx = _partition_ids(np.asarray(table.column(key)), boundaries,
+                         descending)
+    return [table.filter(pa.array(idx == p))
+            for p in range(len(boundaries) + 1)]
+
+
+@ray_tpu.remote
+def _merge_sorted(*chunks, key: str, descending: bool):
+    import pyarrow as pa
+
+    non_empty = [c for c in chunks if c.num_rows]
+    if not non_empty:
+        return pa.table({})
+    return _sort_table(pa.concat_tables(non_empty, promote_options="default"),
+                       key, descending)
+
+
+def distributed_sort(blocks: List[Any], key: str,
+                     descending: bool = False) -> List[Any]:
+    """blocks: arrow tables (values, not refs). Returns sorted blocks."""
+    blocks = [b for b in blocks if b.num_rows]
+    if not blocks:
+        return []
+    if len(blocks) == 1:
+        return [_sort_table(blocks[0], key, descending)]
+
+    # Sample range boundaries from the key distribution.
+    samples = np.concatenate([
+        np.random.default_rng(0).choice(
+            np.asarray(b.column(key)), size=min(100, b.num_rows),
+            replace=False)
+        for b in blocks
+    ])
+    samples = np.sort(samples)
+    if descending:
+        samples = samples[::-1]
+    n_parts = len(blocks)
+    boundaries = [samples[int(len(samples) * (i + 1) / n_parts)]
+                  for i in range(n_parts - 1)]
+
+    # Map stage: one ref per (block, partition) — chunks stay in plasma.
+    part_refs = [
+        _range_partition_block.options(num_returns=n_parts).remote(
+            b, key, boundaries, descending)
+        for b in blocks
+    ]
+    merged = [
+        _merge_sorted.remote(*[refs[p] for refs in part_refs],
+                             key=key, descending=descending)
+        for p in range(n_parts)
+    ]
+    return [b for b in ray_tpu.get(merged, timeout=600) if b.num_rows]
+
+
+def _stable_hash(value: Any) -> int:
+    """Process-independent hash (builtin hash() is randomized per worker
+    for str/bytes, which would scatter one group across partitions)."""
+    data = value if isinstance(value, bytes) else repr(value).encode()
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+@ray_tpu.remote
+def _hash_partition_block(table, key: str, n_parts: int):
+    import pyarrow as pa
+
+    col = np.asarray(table.column(key))
+    hashes = np.fromiter((_stable_hash(x) % n_parts for x in col.tolist()),
+                         dtype=np.int64, count=len(col))
+    return [table.filter(pa.array(hashes == p)) for p in range(n_parts)]
+
+
+@ray_tpu.remote
+def _aggregate_partition(*chunks, key: str, aggs: List[tuple]):
+    """aggs: [(column, fn)] with fn in {count,sum,mean,min,max}."""
+    import pyarrow as pa
+
+    non_empty = [c for c in chunks if c.num_rows]
+    if not non_empty:
+        return pa.table({})
+    table = pa.concat_tables(non_empty, promote_options="default")
+    return table.group_by(key).aggregate(list(aggs))
+
+
+def distributed_groupby(blocks: List[Any], key: str,
+                        aggs: List[tuple]) -> List[Any]:
+    blocks = [b for b in blocks if b.num_rows]
+    if not blocks:
+        return []
+    n_parts = max(1, min(len(blocks), 16))
+    part_refs = [
+        _hash_partition_block.options(num_returns=n_parts).remote(
+            b, key, n_parts)
+        for b in blocks
+    ]
+    agg_refs = [
+        _aggregate_partition.remote(*[refs[p] for refs in part_refs],
+                                    key=key, aggs=aggs)
+        for p in range(n_parts)
+    ]
+    return [b for b in ray_tpu.get(agg_refs, timeout=600) if b.num_rows]
+
+
+# ------------------------------------------------------------------ local
+# Single-process fallbacks (no cluster up) sharing one concat path.
+
+def _concat(blocks):
+    import pyarrow as pa
+
+    non_empty = [b for b in blocks if b.num_rows]
+    if not non_empty:
+        return None
+    return pa.concat_tables(non_empty, promote_options="default")
+
+
+def local_sort(blocks: List[Any], key: str, descending: bool) -> List[Any]:
+    table = _concat(blocks)
+    return [] if table is None else [_sort_table(table, key, descending)]
+
+
+def local_groupby(blocks: List[Any], key: str,
+                  aggs: List[tuple]) -> List[Any]:
+    table = _concat(blocks)
+    if table is None:
+        return []
+    return [table.group_by(key).aggregate(list(aggs))]

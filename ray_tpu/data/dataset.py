@@ -2,9 +2,9 @@
 
 Reference surface: `python/ray/data/dataset.py` (Dataset) — lazy logical
 plan, map fusion, pull-based streaming execution over the tasks/actors
-runtime, `streaming_split` for train ingestion.  Out of scope in this slice:
-sort/groupby/join and the arrow-native all-to-all shuffle service (shuffle
-here is a driver-side barrier).
+runtime, `streaming_split` for train ingestion, all-to-all sort/groupby
+over tasks (`_internal/shuffle.py`), and file sinks. Out of scope: joins
+and the arrow-native shuffle service.
 """
 
 from __future__ import annotations
@@ -56,6 +56,22 @@ class Dataset:
     def random_shuffle(self, *, seed: Optional[int] = None, **_ignored
                        ) -> "Dataset":
         return self._with(plan_mod.RandomShuffle(seed))
+
+    # ------------------------------------------------------------ all-to-all
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed range sort (sample boundaries -> partition ->
+        per-partition merge; see `_internal/shuffle.py`)."""
+        from ray_tpu.data._internal import shuffle
+
+        blocks = list(self._stream())
+        if _cluster_available():
+            out = shuffle.distributed_sort(blocks, key, descending)
+        else:
+            out = shuffle.local_sort(blocks, key, descending)
+        return MaterializedDataset.from_blocks(out)
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
 
     # ----------------------------------------------------------- consumption
     def _stream(self, in_flight: int = DEFAULT_IN_FLIGHT) -> Iterator[Any]:
@@ -148,9 +164,89 @@ class Dataset:
             parts[i % n].append(b)
         return parts
 
+    # -------------------------------------------------------------- writing
+    def _write_files(self, path: str, ext: str, write_one) -> List[str]:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in enumerate(self._stream()):
+            if not block.num_rows:
+                continue
+            dest = os.path.join(path, f"block-{i:06d}.{ext}")
+            write_one(block, dest)
+            out.append(dest)
+        return out
+
+    def write_parquet(self, path: str) -> List[str]:
+        import pyarrow.parquet as pq
+
+        return self._write_files(path, "parquet",
+                                 lambda b, d: pq.write_table(b, d))
+
+    def write_csv(self, path: str) -> List[str]:
+        from pyarrow import csv as pacsv
+
+        return self._write_files(path, "csv",
+                                 lambda b, d: pacsv.write_csv(b, d))
+
+    def write_json(self, path: str) -> List[str]:
+        import json
+
+        def _write(block, dest):
+            with open(dest, "w") as f:
+                for row in BlockAccessor(block).rows():
+                    f.write(json.dumps(row, default=str) + "\n")
+
+        return self._write_files(path, "json", _write)
+
     # ---------------------------------------------------------------- misc
     def __repr__(self) -> str:  # pragma: no cover
         return self.stats()
+
+
+class GroupedData:
+    """`ds.groupby(key)` result (reference: `data/grouped_data.py`):
+    hash-partitioned distributed aggregation."""
+
+    _AGGS = {"count", "sum", "mean", "min", "max"}
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, pairs) -> Dataset:
+        from ray_tpu.data._internal import shuffle
+
+        blocks = list(self._ds._stream())
+        if _cluster_available():
+            out = shuffle.distributed_groupby(blocks, self._key, pairs)
+        else:
+            out = shuffle.local_groupby(blocks, self._key, pairs)
+        return MaterializedDataset.from_blocks(out)
+
+    def count(self) -> Dataset:
+        return self._agg([(self._key, "count")])
+
+    def sum(self, column: str) -> Dataset:
+        return self._agg([(column, "sum")])
+
+    def mean(self, column: str) -> Dataset:
+        return self._agg([(column, "mean")])
+
+    def min(self, column: str) -> Dataset:
+        return self._agg([(column, "min")])
+
+    def max(self, column: str) -> Dataset:
+        return self._agg([(column, "max")])
+
+    def aggregate(self, **column_fns) -> Dataset:
+        pairs = []
+        for column, fn in column_fns.items():
+            if fn not in self._AGGS:
+                raise ValueError(f"unknown aggregation '{fn}'")
+            pairs.append((column, fn))
+        return self._agg(pairs)
 
 
 class MaterializedDataset(Dataset):

@@ -119,6 +119,15 @@ class CSVDatasource(_FileDatasource):
         yield pacsv.read_csv(path)
 
 
+class JSONDatasource(_FileDatasource):
+    """Newline-delimited JSON (reference: `datasource/json_datasource.py`)."""
+
+    def _read_file(self, path: str):
+        import pyarrow.json as pajson
+
+        yield pajson.read_json(path)
+
+
 class TextDatasource(_FileDatasource):
     def _read_file(self, path: str):
         with open(path, "r", encoding="utf-8") as f:

@@ -69,6 +69,12 @@ class DataIterator:
                             drop_last, local_shuffle_buffer_size,
                             local_shuffle_seed)
 
+    def iter_jax_batches(self, *, sharding=None, dtypes=None, **kw):
+        """Batches as jax arrays placed on device (the TPU-native analog of
+        the reference's `iter_torch_batches`, `data/iterator.py:258`).
+        `sharding`: optional jax Sharding for the host->device put."""
+        return _iter_jax_batches(self.iter_batches(**kw), sharding, dtypes)
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for block in self._block_source():
             yield from BlockAccessor(block).rows()
@@ -140,3 +146,18 @@ class SplitIterator(DataIterator):
 
 def _rebuild_split_iterator(coord, index):
     return SplitIterator(coord, index)
+
+
+def _iter_jax_batches(batch_iter, sharding=None, dtypes=None):
+    import jax
+    import jax.numpy as jnp
+
+    for batch in batch_iter:
+        out = {}
+        for k, v in batch.items():
+            arr = jnp.asarray(v) if dtypes is None else jnp.asarray(
+                v, dtype=dtypes.get(k))
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            out[k] = arr
+        yield out

@@ -176,7 +176,11 @@ def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     return jnp.repeat(x, n_rep, axis=2)
 
 
-def _get_attention_fn(impl: str):
+def _get_attention_fn(impl):
+    if callable(impl):
+        # e.g. parallel.context_parallel_attention(mesh): ring attention
+        # with the mesh/axis already bound.
+        return impl
     if impl == "flash":
         from ray_tpu.ops.attention import flash_attention
 
@@ -309,3 +313,160 @@ def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
     n = config.num_params()
     attn = 12 * config.n_layers * config.dim * seq_len  # score+value matmuls
     return 6.0 * n + attn
+
+
+# ---------------------------------------------------------------------------
+# Inference: KV-cache decode + generation (the Serve-on-TPU path)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(config: LlamaConfig, batch_size: int,
+                  max_len: Optional[int] = None) -> Dict[str, jax.Array]:
+    """Stacked per-layer cache [L, B, S, n_kv, head_dim] (bf16)."""
+    c = config
+    S = max_len or c.max_seq_len
+    shape = (c.n_layers, batch_size, S, c.n_kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def _decode_attention(q, k_cache, v_cache, pos):
+    """q [B,1,H,D]; caches [B,S,kvH,D]; attends to positions <= pos."""
+    B, S, KVH, D = k_cache.shape
+    H = q.shape[2]
+    rep = H // KVH
+    k = jnp.repeat(k_cache, rep, axis=2)
+    v = jnp.repeat(v_cache, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.arange(S)[None, None, None, :] <= pos[:, None, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def decode_step(params: Dict[str, Any], cache: Dict[str, jax.Array],
+                tokens: jax.Array, positions: jax.Array,
+                config: LlamaConfig):
+    """One incremental token: tokens [B] int32 at `positions` [B].
+    Returns (logits [B, V], updated cache). Jittable; scan over layers."""
+    c = config
+    cos, sin = rope_freqs(c.head_dim, cache["k"].shape[2], c.rope_theta)
+    x = embed_lookup(params["embed"].astype(c.dtype), tokens[:, None])
+    B = tokens.shape[0]
+    kd = c.head_dim
+    pos_cos = cos[positions][:, None, :]       # [B, 1, D/2]
+    pos_sin = sin[positions][:, None, :]
+
+    def rope1(t):  # [B, 1, H, D]
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        pc = pos_cos[:, :, None, :]
+        ps = pos_sin[:, :, None, :]
+        return jnp.concatenate(
+            [t1 * pc - t2 * ps, t2 * pc + t1 * ps], axis=-1).astype(t.dtype)
+
+    def layer(carry, inputs):
+        x = carry
+        p, k_cache, v_cache = inputs
+        h = rms_norm(x, p["attn_norm"], c.norm_eps)
+        q = (h @ p["wq"].astype(c.dtype)).reshape(B, 1, c.n_heads, kd)
+        k = (h @ p["wk"].astype(c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
+        v = (h @ p["wv"].astype(c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
+        q, k = rope1(q), rope1(k)
+        # Write this token's k/v at its position.
+        bidx = jnp.arange(B)
+        k_cache = k_cache.at[bidx, positions].set(k[:, 0])
+        v_cache = v_cache.at[bidx, positions].set(v[:, 0])
+        attn = _decode_attention(q, k_cache, v_cache, positions)
+        x = x + attn.reshape(B, 1, -1) @ p["wo"].astype(c.dtype)
+        h = rms_norm(x, p["ffn_norm"], c.norm_eps)
+        gate = jax.nn.silu(h @ p["w_gate"].astype(c.dtype))
+        up = h @ p["w_up"].astype(c.dtype)
+        x = x + (gate * up) @ p["w_down"].astype(c.dtype)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["norm_f"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jax.lax.dot_general(
+        x[:, 0], head.astype(c.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def prefill(params: Dict[str, Any], tokens: jax.Array,
+            config: LlamaConfig, max_len: Optional[int] = None):
+    """Fill the cache from a prompt [B, P] in ONE batched forward pass
+    (all prompt positions hit the MXU together; the per-layer pre-repeat
+    k/v come out of the layer scan and land in the cache with a single
+    dynamic_update_slice). Returns (last-token logits [B, V], cache)."""
+    c = config
+    B, P = tokens.shape
+    S = max_len or c.max_seq_len
+    cos, sin = rope_freqs(c.head_dim, S, c.rope_theta)
+    attn_fn = _get_attention_fn(c.attn_impl)
+    kd = c.head_dim
+
+    x = embed_lookup(params["embed"].astype(c.dtype), tokens)
+
+    def scan_body(x, p):
+        h = rms_norm(x, p["attn_norm"], c.norm_eps)
+        q = (h @ p["wq"].astype(c.dtype)).reshape(B, P, c.n_heads, kd)
+        k = (h @ p["wk"].astype(c.dtype)).reshape(B, P, c.n_kv_heads, kd)
+        v = (h @ p["wv"].astype(c.dtype)).reshape(B, P, c.n_kv_heads, kd)
+        q = apply_rope(q, cos[:P], sin[:P])
+        k = apply_rope(k, cos[:P], sin[:P])
+        rep = c.n_heads // c.n_kv_heads
+        attn = attn_fn(q, _repeat_kv(k, rep), _repeat_kv(v, rep),
+                       causal=True)
+        x = x + attn.reshape(B, P, -1) @ p["wo"].astype(c.dtype)
+        h = rms_norm(x, p["ffn_norm"], c.norm_eps)
+        gate = jax.nn.silu(h @ p["w_gate"].astype(c.dtype))
+        up = h @ p["w_up"].astype(c.dtype)
+        x = x + (gate * up) @ p["w_down"].astype(c.dtype)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["norm_f"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = jax.lax.dot_general(
+        x[:, -1], head.astype(c.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    cache = init_kv_cache(c, B, S)
+    cache = {
+        "k": lax.dynamic_update_slice(
+            cache["k"], ks.astype(c.dtype), (0, 0, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(
+            cache["v"], vs.astype(c.dtype), (0, 0, 0, 0, 0)),
+    }
+    return logits, cache
+
+
+def generate(params: Dict[str, Any], prompt: jax.Array,
+             config: LlamaConfig, max_new_tokens: int,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Greedy (or temperature) generation, fully jit-compatible:
+    prompt [B, P] -> [B, max_new_tokens]."""
+    B, P = prompt.shape
+    logits, cache = prefill(params, prompt, config,
+                            max_len=P + max_new_tokens)
+    rng = rng if rng is not None else jax.random.key(0)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature).astype(jnp.int32)
+
+    def body(carry, i):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        pos = jnp.full((B,), P, jnp.int32) + i
+        logits, cache = decode_step(params, cache, tok, pos, config)
+        return (cache, logits, key), tok
+
+    (_, _, _), toks = lax.scan(
+        body, (cache, logits, rng), jnp.arange(max_new_tokens))
+    return toks.T  # [B, max_new_tokens]

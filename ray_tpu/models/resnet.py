@@ -1,0 +1,157 @@
+"""ResNet family — the vision flagship (BASELINE.md ladder step 2:
+data-parallel ResNet-50 ImageNet).
+
+TPU-first notes: NHWC layout (TPU conv native), bf16 compute with fp32
+batch-norm statistics, `flax.linen` modules (convs have per-layer shapes,
+so the stacked-scan trick used for the Llama decoder does not apply).
+Reference analog: the reference trains ResNet via torchvision through its
+generic worker group (`release/air_tests/air_benchmarks/`); the model
+itself is net-new here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    bottleneck: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def resnet18(**kw) -> "ResNetConfig":
+        return ResNetConfig(**{**dict(stage_sizes=(2, 2, 2, 2),
+                                      bottleneck=False), **kw})
+
+    @staticmethod
+    def resnet50(**kw) -> "ResNetConfig":
+        return ResNetConfig(**{**dict(stage_sizes=(3, 4, 6, 3),
+                                      bottleneck=True), **kw})
+
+    @staticmethod
+    def tiny(**kw) -> "ResNetConfig":
+        """CPU-test size: 8x8 inputs train in milliseconds."""
+        return ResNetConfig(**{**dict(stage_sizes=(1, 1), num_classes=10,
+                                      width=8, bottleneck=False), **kw})
+
+    def num_params(self, params) -> int:
+        return sum(x.size for x in jax.tree.leaves(params))
+
+
+class _Block(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    bottleneck: bool
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        residual = x
+        if self.bottleneck:
+            y = conv(self.filters, (1, 1))(x)
+            y = nn.relu(norm()(y))
+            y = conv(self.filters, (3, 3), self.strides)(y)
+            y = nn.relu(norm()(y))
+            y = conv(self.filters * 4, (1, 1))(y)
+            y = norm(scale_init=nn.initializers.zeros)(y)
+            out_filters = self.filters * 4
+        else:
+            y = conv(self.filters, (3, 3), self.strides)(x)
+            y = nn.relu(norm()(y))
+            y = conv(self.filters, (3, 3))(y)
+            y = norm(scale_init=nn.initializers.zeros)(y)
+            out_filters = self.filters
+        if residual.shape != y.shape:
+            residual = conv(out_filters, (1, 1), self.strides,
+                            name="shortcut")(residual)
+            residual = norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = self.config
+        x = x.astype(c.dtype)
+        x = nn.Conv(c.width, (7, 7), (2, 2), use_bias=False,
+                    dtype=c.dtype, name="stem")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, dtype=jnp.float32)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, stage_size in enumerate(c.stage_sizes):
+            for j in range(stage_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = _Block(c.width * (2 ** i), strides, c.bottleneck,
+                           c.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))                      # global avg pool
+        x = nn.Dense(c.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def init_params(config: ResNetConfig, key: jax.Array,
+                image_size: int = 224) -> Dict[str, Any]:
+    """Returns {"params", "batch_stats"} variables."""
+    model = ResNet(config)
+    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    return model.init(key, dummy, train=True)
+
+
+def forward(variables: Dict[str, Any], images: jax.Array,
+            config: ResNetConfig, train: bool = False):
+    """images [B, H, W, 3] -> logits [B, num_classes]. In train mode also
+    returns updated batch_stats."""
+    model = ResNet(config)
+    if train:
+        return model.apply(variables, images, train=True,
+                           mutable=["batch_stats"])
+    return model.apply(variables, images, train=False)
+
+
+def loss_fn(variables: Dict[str, Any], batch: Dict[str, jax.Array],
+            config: ResNetConfig):
+    """Softmax cross-entropy; returns (loss, new_batch_stats)."""
+    logits, updates = forward(variables, batch["image"], config, train=True)
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return nll.mean(), updates["batch_stats"]
+
+
+def make_train_step(config: ResNetConfig, optimizer) -> Callable:
+    """Data-parallel jitted step over (variables, opt_state, batch):
+    params replicated, batch sharded over the data axis (GSPMD inserts the
+    gradient psum)."""
+
+    def step(variables, opt_state, batch):
+        def wrapped(params):
+            return loss_fn({"params": params,
+                            "batch_stats": variables["batch_stats"]},
+                           batch, config)
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            wrapped, has_aux=True)(variables["params"])
+        updates, new_opt = optimizer.update(grads, opt_state,
+                                            variables["params"])
+        import optax
+
+        new_params = optax.apply_updates(variables["params"], updates)
+        return ({"params": new_params, "batch_stats": new_stats},
+                new_opt, loss)
+
+    return jax.jit(step, donate_argnums=(0, 1))

@@ -2,8 +2,8 @@ from ray_tpu.parallel.mesh import (
     data_parallel_mesh, fsdp_mesh, make_mesh, mesh_axis_size,
 )
 from ray_tpu.parallel.sharding import (
-    batch_sharding, batch_spec, llama_param_shardings, llama_param_specs,
-    replicated, shard_params,
+    batch_sharding, batch_spec, context_parallel_attention,
+    llama_param_shardings, llama_param_specs, replicated, shard_params,
 )
 from ray_tpu.parallel.train_step import (
     TrainState, build_eval_step, build_train_step, create_train_state,
@@ -11,6 +11,7 @@ from ray_tpu.parallel.train_step import (
 
 __all__ = [
     "make_mesh", "data_parallel_mesh", "fsdp_mesh", "mesh_axis_size",
+    "context_parallel_attention",
     "llama_param_specs", "llama_param_shardings", "batch_spec",
     "batch_sharding", "shard_params", "replicated", "TrainState",
     "create_train_state", "build_train_step", "build_eval_step",

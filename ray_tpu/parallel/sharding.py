@@ -83,3 +83,18 @@ def shard_params(params, shardings):
 
 def replicated(mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def context_parallel_attention(mesh, seq_axis: str = "seq"):
+    """Attention callable for context-parallel training (SURVEY §7 M11):
+    plug into ``LlamaConfig(attn_impl=...)`` / ``forward(attn_impl=...)``
+    and the model's attention runs as ring attention over ``mesh[seq_axis]``
+    (KV blocks rotate via ppermute while everything else stays jit/GSPMD).
+    """
+    from ray_tpu.ops.ring_attention import ring_attention_global
+
+    def attn(q, k, v, causal=True, positions=None):
+        return ring_attention_global(q, k, v, mesh, causal=causal,
+                                     seq_axis=seq_axis)
+
+    return attn

@@ -1,0 +1,85 @@
+"""ResNet vision family: shapes, training convergence, DP sharding
+(BASELINE.md ladder step 2)."""
+
+import numpy as np
+import pytest
+
+
+def test_forward_shapes():
+    import jax
+
+    from ray_tpu.models.resnet import ResNetConfig, forward, init_params
+
+    config = ResNetConfig.tiny()
+    variables = init_params(config, jax.random.key(0), image_size=8)
+    logits = forward(variables, np.zeros((2, 8, 8, 3), np.float32), config)
+    assert logits.shape == (2, 10)
+
+
+def test_tiny_resnet_learns():
+    import jax
+    import optax
+
+    from ray_tpu.models.resnet import (
+        ResNetConfig, init_params, make_train_step,
+    )
+
+    config = ResNetConfig.tiny()
+    variables = init_params(config, jax.random.key(0), image_size=8)
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(variables["params"])
+    step = make_train_step(config, optimizer)
+
+    rng = np.random.RandomState(0)
+    images = rng.randn(16, 8, 8, 3).astype(np.float32)
+    labels = rng.randint(0, 10, 16)
+    batch = {"image": images, "label": labels}
+
+    losses = []
+    for _ in range(30):
+        variables, opt_state, loss = step(variables, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+def test_data_parallel_sharded_batch():
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.models.resnet import (
+        ResNetConfig, init_params, make_train_step,
+    )
+
+    config = ResNetConfig.tiny()
+    mesh = jax.make_mesh((jax.device_count(),), ("data",))
+    repl = NamedSharding(mesh, P())
+    dsh = NamedSharding(mesh, P("data"))
+
+    variables = jax.device_put(
+        init_params(config, jax.random.key(0), image_size=8), repl)
+    optimizer = optax.adam(1e-2)
+    opt_state = jax.device_put(optimizer.init(variables["params"]), repl)
+    step = make_train_step(config, optimizer)
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": jax.device_put(
+            rng.randn(16, 8, 8, 3).astype(np.float32), dsh),
+        "label": jax.device_put(rng.randint(0, 10, 16), dsh),
+    }
+    variables, opt_state, loss1 = step(variables, opt_state, batch)
+    variables, opt_state, loss2 = step(variables, opt_state, batch)
+    assert float(loss2) < float(loss1)
+
+
+def test_resnet50_param_count():
+    import jax
+
+    from ray_tpu.models.resnet import ResNetConfig, init_params
+
+    config = ResNetConfig.resnet50(num_classes=1000)
+    variables = init_params(config, jax.random.key(0), image_size=32)
+    n = config.num_params(variables["params"])
+    # Published ResNet-50 size: ~25.6M params.
+    assert 24e6 < n < 27e6, n
