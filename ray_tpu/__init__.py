@@ -47,6 +47,7 @@ def init(
     object_store_memory: Optional[int] = None,
     namespace: Optional[str] = None,
     ignore_reinit_error: bool = False,
+    log_to_driver: bool = True,
     _system_config: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Start (or connect to) a cluster and attach this process as the driver."""
@@ -104,14 +105,74 @@ def init(
                            "sys_path": list(_sys.path),
                            "cwd": os.getcwd()})
         gcs.close()
+        if log_to_driver:
+            _start_log_listener(gcs_addr, job_id.hex())
         return {"gcs_address": f"{gcs_addr[0]}:{gcs_addr[1]}",
                 "node_id": node_id.hex(), "job_id": job_id.hex(),
                 "session_dir": session_dir}
 
 
+_log_listener_stop = None
+
+
+def _start_log_listener(gcs_addr, job_id_hex: Optional[str] = None) -> None:
+    """Subscribe to the "logs" pubsub channel and echo worker output
+    (reference: the driver-side subscriber fed by `log_monitor.py`)."""
+    global _log_listener_stop
+    import sys
+    import threading
+
+    from ray_tpu._private.log_monitor import echo_to_driver
+    from ray_tpu._private.rpc import RpcClient
+
+    stop = threading.Event()
+    _log_listener_stop = stop
+
+    my_job = job_id_hex
+
+    def run():
+        client = None
+        cursor = None
+        while not stop.is_set():
+            try:
+                if client is None:
+                    client = RpcClient(*gcs_addr)
+                if cursor is None:
+                    cursor = client.call("pubsub_seq", timeout=10)
+                msgs = client.call("poll", channel="logs", cursor=cursor,
+                                   wait_timeout=2.0, timeout=30)
+                for seq, msg in msgs:
+                    cursor = max(cursor, seq)
+                    # Only this driver's job (other drivers echo their own).
+                    if msg.get("job_id") not in (None, my_job):
+                        continue
+                    echo_to_driver(msg, msg.get("ip", "?"),
+                                   sys.stderr.write)
+            except Exception:
+                # Transient GCS hiccup: drop the connection, retry. The
+                # cursor survives so no lines are replayed.
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:
+                        pass
+                    client = None
+                stop.wait(1.0)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    threading.Thread(target=run, daemon=True,
+                     name="ray_tpu_log_listener").start()
+
+
 def shutdown() -> None:
     global _local_node
     with _init_lock:
+        if _log_listener_stop is not None:
+            _log_listener_stop.set()
         w = global_worker_or_none()
         if w is not None:
             try:

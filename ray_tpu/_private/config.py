@@ -98,6 +98,18 @@ _define("worker_pool_min_idle", int, 2,
         "creation after kills never pays a Python cold start "
         "(reference: worker_pool.cc prestart).")
 
+# --- memory monitor / OOM (reference: memory_monitor.h:52,
+# worker_killing_policy.h:34; threshold default mirrors
+# RAY_memory_usage_threshold) ---
+_define("memory_usage_threshold", float, 0.95,
+        "Node memory fraction above which the raylet OOM-kills a leased "
+        "task worker (retriable-newest-first policy).")
+_define("memory_monitor_refresh_ms", int, 250,
+        "Memory monitor poll period; 0 disables OOM killing.")
+_define("memory_monitor_test_usage_path", str, "",
+        "Test hook: read the usage fraction from this file instead of "
+        "psutil/cgroup.")
+
 # --- logging / events ---
 _define("event_stats", bool, True,
         "Track per-handler latency stats on runtime event loops.")

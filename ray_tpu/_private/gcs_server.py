@@ -193,7 +193,8 @@ class GcsServer:
             "list_placement_groups",
             "next_job_id", "register_job", "mark_job_finished", "list_jobs",
             "get_job_info",
-            "publish", "poll", "push_task_events", "get_task_events",
+            "publish", "poll", "pubsub_seq", "push_task_events",
+            "get_task_events",
             "register_worker", "list_workers", "get_system_config",
             "cluster_resources", "available_resources", "internal_stats",
             "metrics_text", "get_cluster_load",
@@ -830,6 +831,11 @@ class GcsServer:
 
     async def _h_poll(self, channel, cursor, wait_timeout=10.0):
         return await self.pubsub.poll(channel, cursor, wait_timeout)
+
+    async def _h_pubsub_seq(self):
+        """Current global sequence — subscribe-from-now cursor for late
+        joiners (a new driver must not replay old worker logs)."""
+        return self.pubsub._seq
 
     # ------------------------------------------------------------- task events
     async def _h_push_task_events(self, events):

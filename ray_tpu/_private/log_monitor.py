@@ -1,0 +1,102 @@
+"""Per-node log aggregation: tail worker logs, publish lines to drivers.
+
+Reference: `python/ray/_private/log_monitor.py:103` — a per-node monitor
+tails `session_latest/logs/*` and publishes new lines over GCS pubsub;
+every driver subscribes and echoes them, which is how a `print` inside a
+remote task shows up on the driver's terminal.
+
+Here the monitor runs as an async task inside the raylet (no extra
+process): it scans `{session_dir}/logs/worker-*.out`, remembers a byte
+offset per file, and publishes batches of complete lines on the "logs"
+pubsub channel. Runtime noise (jax backend preload warnings every worker
+emits at import) is filtered before publishing.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Callable, Dict, List, Optional
+
+# Lines every spawned worker emits on interpreter start that carry no
+# user signal; echoing them once per worker would drown the driver.
+_NOISE = [
+    re.compile(rb"WARNING:.*xla_bridge.*experimental"),
+    re.compile(rb"^\s*$"),
+]
+
+_FILE_RE = re.compile(r"worker-([0-9a-f]+)\.out$")
+
+# Per-file, per-scan read cap: a crash-looping task spewing hundreds of MB
+# must not block the raylet event loop in one read() or ship a single
+# giant pubsub message. The remainder is picked up next scan.
+MAX_READ_PER_SCAN = 256 * 1024
+
+
+class LogMonitor:
+    """Incremental tailer for one node's worker log directory."""
+
+    def __init__(self, log_dir: str,
+                 pid_of: Optional[Callable[[str], Optional[int]]] = None,
+                 max_read: int = MAX_READ_PER_SCAN):
+        self.log_dir = log_dir
+        self._pid_of = pid_of or (lambda _wid: None)
+        self._max_read = max_read
+        self._offsets: Dict[str, int] = {}
+        # Trailing bytes of a file that did not end in a newline yet.
+        self._partial: Dict[str, bytes] = {}
+
+    def scan(self) -> List[dict]:
+        """Collect new complete lines per worker file since the last scan.
+        Returns pubsub-ready messages: {worker_id, pid, lines}."""
+        out: List[dict] = []
+        try:
+            names = os.listdir(self.log_dir)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            m = _FILE_RE.search(name)
+            if not m:
+                continue
+            path = os.path.join(self.log_dir, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(path, 0)
+            if size <= offset:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(min(size - offset, self._max_read))
+            except OSError:
+                continue
+            self._offsets[path] = offset + len(data)
+            data = self._partial.pop(path, b"") + data
+            if not data.endswith(b"\n"):
+                data, _, rest = data.rpartition(b"\n")
+                if rest:
+                    self._partial[path] = rest
+                if not data:
+                    continue
+            lines = [ln for ln in data.split(b"\n")
+                     if ln and not any(p.search(ln) for p in _NOISE)]
+            if not lines:
+                continue
+            wid = m.group(1)
+            out.append({
+                "worker_id": wid,
+                "pid": self._pid_of(wid),
+                "lines": [ln.decode("utf-8", "replace") for ln in lines],
+            })
+        return out
+
+
+def echo_to_driver(message: dict, node_host: str, write) -> None:
+    """Driver-side rendering of one pubsub "logs" message (reference
+    format: `(pid=…, ip=…) line`)."""
+    pid = message.get("pid")
+    prefix = f"({'pid=' + str(pid) + ', ' if pid else ''}ip={node_host})"
+    for line in message.get("lines", ()):
+        write(f"{prefix} {line}\n")

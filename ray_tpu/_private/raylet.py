@@ -121,6 +121,11 @@ class Raylet:
         # refs cleared on disconnect).
         self._client_mapped: Dict[bytes, Set[bytes]] = defaultdict(set)
         self._dead = False
+        self._oom_kills = 0
+        # worker_id -> True for workers the memory monitor shot; owners ask
+        # via get_worker_exit_info to turn the crash into OutOfMemoryError.
+        self._oom_killed: Set[bytes] = set()
+        self._worker_info_cache: Dict[bytes, Any] = {}
 
     # ------------------------------------------------------------------- boot
     def start(self) -> int:
@@ -136,6 +141,8 @@ class Raylet:
         io.submit(self._heartbeat_loop())
         io.submit(self._reaper_loop())
         io.submit(self._lease_dispatch_loop())
+        io.submit(self._log_monitor_loop())
+        io.submit(self._memory_monitor_loop())
         return port
 
     def _register_handlers(self):
@@ -149,6 +156,7 @@ class Raylet:
             "object_info", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "kill_worker", "node_stats", "shutdown_node", "get_tasks_info",
+            "get_worker_exit_info",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
 
@@ -355,6 +363,80 @@ class Raylet:
                     except Exception:
                         pass
 
+    async def _log_monitor_loop(self):
+        """Tail worker logs and publish new lines to drivers (reference:
+        `_private/log_monitor.py:103` — how task `print`s reach the
+        driver terminal)."""
+        from ray_tpu._private.log_monitor import LogMonitor
+
+        def info_of(wid_prefix: str):
+            for worker_id, handle in self._worker_info_cache.items():
+                if worker_id.hex().startswith(wid_prefix):
+                    return handle
+            return None
+
+        def pid_of(wid_prefix: str):
+            h = info_of(wid_prefix)
+            return h.proc.pid if h else None
+
+        monitor = LogMonitor(os.path.join(self.session_dir, "logs"),
+                             pid_of=pid_of)
+        while not self._dead:
+            await asyncio.sleep(0.5)
+            # Snapshot incl. recently-dead workers: their last lines must
+            # still route to the right driver after the reaper pops them.
+            for wid, h in self.workers.items():
+                self._worker_info_cache[wid] = h
+            while len(self._worker_info_cache) > 4096:
+                self._worker_info_cache.pop(
+                    next(iter(self._worker_info_cache)))
+            for msg in monitor.scan():
+                msg["ip"] = self.host
+                msg["node_id"] = self.node_id.hex()
+                h = info_of(msg["worker_id"])
+                msg["job_id"] = h.job_id.hex() if h else None
+                try:
+                    await self.gcs.acall("publish", channel="logs",
+                                         message=msg, timeout=10)
+                except Exception:
+                    pass
+
+    async def _memory_monitor_loop(self):
+        """OOM watchdog (reference: memory_monitor.h + worker_killing
+        _policy.h): above the usage threshold, kill a leased task worker
+        (newest lease first) so the task retries instead of the kernel
+        OOM killer shooting the raylet or a TPU-holding actor."""
+        from ray_tpu._private import memory_monitor
+
+        period = GlobalConfig.memory_monitor_refresh_ms / 1000
+        if period <= 0:
+            return
+        threshold = GlobalConfig.memory_usage_threshold
+        test_path = GlobalConfig.memory_monitor_test_usage_path
+        while not self._dead:
+            await asyncio.sleep(period)
+            usage = memory_monitor.usage_fraction(test_path)
+            if usage is None or usage <= threshold:
+                continue
+            victim = memory_monitor.pick_victim(self.workers.values())
+            if victim is None:
+                continue
+            self._oom_kills += 1
+            self._oom_killed.add(victim.worker_id)
+            if len(self._oom_killed) > 1024:
+                self._oom_killed.pop()
+            sys.stderr.write(
+                f"[raylet {self.node_id.hex()[:8]}] memory usage "
+                f"{usage:.2f} > {threshold:.2f}: OOM-killing worker "
+                f"pid={victim.proc.pid} (actor={victim.is_actor})\n")
+            try:
+                victim.proc.kill()
+            except Exception:
+                pass
+            # Let the reaper pick up the death before re-sampling, so one
+            # spike doesn't massacre the whole pool.
+            await asyncio.sleep(max(period, 1.0))
+
     # ---------------------------------------------------------- lease protocol
     def _strategy_allows_local(self, strategy) -> bool:
         """May a queued request be granted on THIS node once resources free
@@ -442,6 +524,7 @@ class Raylet:
             self._release_tpu_chips(demand, tpu_ids)
             return {"timeout": True}
         handle.lease = {"demand": demand, "tpu_ids": tpu_ids}
+        handle.lease_ts = time.monotonic()
         return {"granted": True, "worker_addr": handle.addr,
                 "worker_id": handle.worker_id, "tpu_ids": tpu_ids}
 
@@ -607,6 +690,7 @@ class Raylet:
             self._release_tpu_chips(demand_rs, tpu_ids)
             return {"ok": False, "reason": "no worker"}
         handle.lease = {"demand": demand_rs, "tpu_ids": tpu_ids}
+        handle.lease_ts = time.monotonic()
         handle.is_actor = True
         handle.actor_id = spec.actor_id.binary()
         return {"ok": True, "worker_addr": handle.addr,
@@ -809,7 +893,14 @@ class Raylet:
             "num_workers": len(self.workers),
             "store": self.store.stats(),
             "event_stats": self.server.stats.snapshot(),
+            "oom_kills": self._oom_kills,
         }
+
+    async def _h_get_worker_exit_info(self, worker_id):
+        """Why did this worker die? Lets the owner raise OutOfMemoryError
+        instead of a generic WorkerCrashedError (reference: exit-type
+        plumbing in worker failure RPCs)."""
+        return {"oom_killed": worker_id in self._oom_killed}
 
     async def _h_get_tasks_info(self):
         out = []

@@ -814,9 +814,19 @@ class Worker:
                     attempt += 1
                     await asyncio.sleep(min(0.05 * (2 ** attempt), 2.0))
                     continue
-                self._fail_task(spec, serialize_error(exc.WorkerCrashedError(
+                err_cls = exc.WorkerCrashedError
+                detail = ""
+                try:
+                    info = await lessor.acall("get_worker_exit_info",
+                                              worker_id=worker_id, timeout=5)
+                    if info.get("oom_killed"):
+                        err_cls = exc.OutOfMemoryError
+                        detail = " (OOM-killed by the node memory monitor)"
+                except Exception:
+                    pass
+                self._fail_task(spec, serialize_error(err_cls(
                     f"worker died while executing task {spec.name} "
-                    f"(after {attempt} retries)")))
+                    f"(after {attempt} retries){detail}")))
                 self._release_deps(spec)
                 return
             if reply.get("app_error") is not None:

@@ -85,3 +85,9 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class RaySystemError(RayTpuError):
     pass
+
+
+class OutOfMemoryError(WorkerCrashedError):
+    """Raised when a worker was OOM-killed by the raylet memory monitor
+    (reference: `ray.exceptions.OutOfMemoryError`). Subclasses
+    WorkerCrashedError so existing retry/except paths keep working."""

@@ -1,0 +1,142 @@
+"""Log aggregation + memory monitor (reference: `_private/log_monitor.py`,
+`memory_monitor.h` + `worker_killing_policy.h`)."""
+
+import os
+import sys
+import time
+
+import pytest
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_for(pred, timeout=30.0, period=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return False
+
+
+def test_log_monitor_scan_units(tmp_path):
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    d = tmp_path / "logs"
+    d.mkdir()
+    f = d / "worker-abc123def456.out"
+    f.write_bytes(b"hello\npartial")
+    mon = LogMonitor(str(d), pid_of=lambda w: 42 if w else None)
+    msgs = mon.scan()
+    assert len(msgs) == 1
+    assert msgs[0]["lines"] == ["hello"]
+    assert msgs[0]["pid"] == 42
+    assert msgs[0]["worker_id"] == "abc123def456"
+    # Nothing new -> nothing published; the partial line stays buffered.
+    assert mon.scan() == []
+    with open(f, "ab") as fh:
+        fh.write(b"-done\nWARNING:x:jax._src.xla_bridge:1: Platform 'axon'"
+                 b" is experimental\n")
+    msgs = mon.scan()
+    assert msgs[0]["lines"] == ["partial-done"]  # noise line filtered
+
+
+def test_task_print_reaches_driver(tmp_path):
+    """A print() inside a remote task shows up on the driver's stderr."""
+    import subprocess
+
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import time\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=2)\n"
+        "@ray_tpu.remote\n"
+        "def noisy():\n"
+        "    print('marker-from-remote-task')\n"
+        "    return 1\n"
+        "assert ray_tpu.get(noisy.remote(), timeout=60) == 1\n"
+        "time.sleep(2.5)\n"
+        "ray_tpu.shutdown()\n")
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": _repo_root()})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    echoed = [ln for ln in proc.stderr.splitlines()
+              if "marker-from-remote-task" in ln and "ip=" in ln]
+    assert echoed, proc.stderr[-2000:]
+    assert echoed[0].startswith("(pid=")
+
+
+def test_memory_monitor_units(tmp_path):
+    from ray_tpu._private import memory_monitor
+
+    usage = tmp_path / "usage"
+    usage.write_text("0.42")
+    assert memory_monitor.usage_fraction(str(usage)) == pytest.approx(0.42)
+
+    class H:
+        def __init__(self, actor, ts):
+            self.lease = {}
+            self.is_actor = actor
+            self.lease_ts = ts
+
+    task_old, task_new, actor = H(False, 1.0), H(False, 2.0), H(True, 3.0)
+    # Task workers beat actors even when the actor lease is newer.
+    assert memory_monitor.pick_victim([task_old, actor, task_new]) is task_new
+    assert memory_monitor.pick_victim([actor]) is actor
+    idle = H(False, 0.0)
+    idle.lease = None
+    assert memory_monitor.pick_victim([idle]) is None
+
+
+def test_oom_kill_and_retry(tmp_path):
+    """Over-threshold memory -> raylet kills the leased task worker; the
+    task retries and completes once pressure clears."""
+    import subprocess
+
+    usage = tmp_path / "usage"
+    usage.write_text("0.10")
+    attempts = tmp_path / "attempts"
+    script = tmp_path / "driver.py"
+    script.write_text(f"""
+import os, time
+import ray_tpu
+ray_tpu.init(num_cpus=2, _system_config={{
+    "memory_monitor_test_usage_path": {str(usage)!r},
+    "memory_usage_threshold": 0.9,
+    "memory_monitor_refresh_ms": 100,
+}})
+
+@ray_tpu.remote
+def hog():
+    with open({str(attempts)!r}, "a") as f:
+        f.write(str(os.getpid()) + chr(10))
+    time.sleep(4.0)
+    return "done"
+
+ref = hog.options(max_retries=3).remote()
+# Wait until the first attempt is running, then spike memory.
+while not os.path.exists({str(attempts)!r}):
+    time.sleep(0.05)
+with open({str(usage)!r}, "w") as f:
+    f.write("0.99")
+time.sleep(1.0)   # give the monitor a poll cycle to kill
+with open({str(usage)!r}, "w") as f:
+    f.write("0.10")
+print("RESULT:" + ray_tpu.get(ref, timeout=90))
+ray_tpu.shutdown()
+""")
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=180, env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": _repo_root()})
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "RESULT:done" in proc.stdout
+    # >= 2 attempt pids proves the monitor killed attempt 1 mid-sleep
+    # (without a kill the 4s first attempt completes and writes once).
+    pids = [p for p in attempts.read_text().split() if p]
+    assert len(pids) >= 2, (pids, proc.stderr[-2000:])
