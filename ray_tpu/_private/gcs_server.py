@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import os
+import sys
 import time
 from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Tuple
@@ -318,6 +319,9 @@ class GcsServer:
                            pending_demands=None, num_workers=0):
         if node_id not in self.nodes:
             return {"unknown": True}
+        if os.environ.get("RAY_TPU_DEBUG_SCHED"):
+            print(f"[gcs-hb {time.monotonic():.3f}] handled",
+                  file=sys.stderr, flush=True)
         self._last_heartbeat[node_id] = time.monotonic()
         nr = NodeResources(ResourceSet(total), self.nodes[node_id]["labels"])
         nr.available = ResourceSet(available)
@@ -369,6 +373,10 @@ class GcsServer:
         info = self.nodes.get(node_id)
         if info is None or info["state"] == DEAD:
             return
+        last = self._last_heartbeat.get(node_id)
+        age = f"{time.monotonic() - last:.2f}s" if last else "never"
+        print(f"[gcs] node {node_id.hex()[:8]} marked DEAD: {reason} "
+              f"(last heartbeat {age} ago)", file=sys.stderr, flush=True)
         info["state"] = DEAD
         self.view.remove_node(node_id)
         self.pubsub.publish("node", {"event": "DEAD", "node_id": node_id,
@@ -455,6 +463,9 @@ class GcsServer:
         return {"ok": True}
 
     async def _schedule_actor(self, actor_id):
+        from ray_tpu._private.rpc import debug_log
+
+        _dbg = debug_log(f"sched {actor_id.hex()[:6]}")
         a = self.actors[actor_id]
         spec = a["spec"]
         delay = 0.05
@@ -473,14 +484,23 @@ class GcsServer:
                 delay = min(delay * 1.5, 1.0)
                 continue
             client = self._client_for_node(node_id)
+            _dbg("picked node", node_id.hex()[:6] if hasattr(node_id, 'hex') else node_id, "client", client is not None)
             if client is None:
+                # view said schedulable but the node is gone/DEAD: the two
+                # structures can lag during node death. MUST yield — a bare
+                # continue here busy-spins the whole GCS event loop.
+                self.view.remove_node(node_id)
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 1.0)
                 continue
             try:
                 reply = await client.acall(
                     "lease_worker_for_actor", spec=spec,
                     demand=(pg_res or spec.resources).to_dict(),
                     timeout=60)
+                _dbg("lease reply", reply)
             except Exception as exc:
+                _dbg("lease EXC", repr(exc))
                 await asyncio.sleep(delay)
                 continue
             if not reply.get("ok"):
@@ -491,10 +511,13 @@ class GcsServer:
             worker_id = reply["worker_id"]
             wclient = RpcClient(*worker_addr)
             try:
+                _dbg("create_actor ->", worker_addr)
                 result = await wclient.acall("create_actor", spec=spec,
                                              tpu_ids=reply.get("tpu_ids", []),
                                              timeout=120)
+                _dbg("create_actor reply", result)
             except Exception as exc:
+                _dbg("create EXC", repr(exc))
                 wclient.close()
                 await asyncio.sleep(delay)
                 continue
@@ -879,6 +902,14 @@ class GcsServer:
 
 
 def main():
+    # SIGUSR1 dumps all thread stacks to stderr (the daemon log) — the
+    # first tool for a wedged control plane (reference: ray's SIGTERM
+    # stack-dump handlers in util/logging).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)

@@ -162,10 +162,14 @@ class Raylet:
 
     # -------------------------------------------------------------- heartbeat
     async def _heartbeat_loop(self):
+        from ray_tpu._private.rpc import debug_log
+
+        _dbg = debug_log("hb")
         period = GlobalConfig.health_check_period_ms / 1000
         while not self._dead:
             try:
                 now = time.monotonic()
+                _dbg("send")
                 pending = [item[0].to_dict()
                            for item in list(self._lease_queue)[:64]]
                 for key, ts in list(self._unfulfilled.items()):
@@ -180,10 +184,11 @@ class Raylet:
                     pending_demands=pending,
                     num_workers=len(self.workers),
                     timeout=10)
+                _dbg("reply ok")
                 if "nodes" in reply:
                     self._apply_nodes_snapshot(reply["nodes"])
-            except Exception:
-                pass
+            except Exception as e:
+                _dbg("EXC", repr(e))
             await asyncio.sleep(period)
 
     def _apply_nodes_snapshot(self, nodes):
@@ -228,6 +233,16 @@ class Raylet:
                       runtime_env: Optional[Dict[str, Any]] = None) -> None:
         pool_key = self._pool_key(job_id, runtime_env)
         self._starting[pool_key] += 1
+        asyncio.ensure_future(
+            self._spawn_worker_async(job_id, runtime_env, pool_key))
+
+    async def _spawn_worker_async(self, job_id: bytes,
+                                  runtime_env: Optional[Dict[str, Any]],
+                                  pool_key: bytes) -> None:
+        """Fork/exec OFF the event loop: Popen of this jax-preloaded
+        process takes ~100ms+, and a replenish burst of spawns on the
+        loop thread stalls heartbeats long enough for the GCS to declare
+        this node dead (observed: actor churn → 5s+ gap → node DEAD)."""
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         worker_id = WorkerID.from_random()
@@ -241,18 +256,35 @@ class Raylet:
                 env[str(key)] = str(val)
             if runtime_env.get("working_dir"):
                 env["RAY_TPU_WORKING_DIR"] = str(runtime_env["working_dir"])
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main",
-             "--raylet-host", self.host,
-             "--raylet-port", str(self.server.port),
-             "--gcs-host", self.gcs_addr[0],
-             "--gcs-port", str(self.gcs_addr[1]),
-             "--node-id", self.node_id.hex(),
-             "--worker-id", worker_id.hex(),
-             "--job-id", job_id.hex(),
-             "--session-dir", self.session_dir],
-            stdout=out, stderr=subprocess.STDOUT, env=env,
-            start_new_session=True)
+        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
+               "--raylet-host", self.host,
+               "--raylet-port", str(self.server.port),
+               "--gcs-host", self.gcs_addr[0],
+               "--gcs-port", str(self.gcs_addr[1]),
+               "--node-id", self.node_id.hex(),
+               "--worker-id", worker_id.hex(),
+               "--job-id", job_id.hex(),
+               "--session-dir", self.session_dir]
+        loop = asyncio.get_running_loop()
+        try:
+            proc = await loop.run_in_executor(
+                None, lambda: subprocess.Popen(
+                    cmd, stdout=out, stderr=subprocess.STDOUT, env=env,
+                    start_new_session=True))
+        except Exception as e:
+            out.close()
+            self._starting[pool_key] = max(0, self._starting[pool_key] - 1)
+            sys.stderr.write(f"[raylet] worker spawn failed: {e}\n")
+            # Fail one parked lease waiter fast instead of letting it ride
+            # out the full pop timeout (pre-async-spawn, Popen errors
+            # propagated synchronously into the lease handler).
+            waiters = self._pending_pop[pool_key]
+            while waiters:
+                fut = waiters.popleft()
+                if not fut.done():
+                    fut.set_result(None)
+                    break
+            return
         # Handle is completed when the worker registers back.
         handle = _WorkerHandle(worker_id.binary(), proc, ("", 0), job_id,
                                pool_key=pool_key, runtime_env=runtime_env)
@@ -309,8 +341,13 @@ class Raylet:
                 self._maybe_replenish(job_id, runtime_env)
                 return handle
             self.workers.pop(handle.worker_id, None)
+        # Count async-starting workers too: they only land in self.workers
+        # after the off-loop Popen, so without _starting a request burst in
+        # that window would overshoot the cap.
         n_live = sum(1 for w in self.workers.values()
                      if w.job_id == job_id)
+        n_live += sum(v for k, v in self._starting.items()
+                      if k[:len(job_id)] == job_id)
         if n_live < self._max_workers:
             # Python worker cold-start is expensive; prestart a batch on first
             # demand so bursts don't serialize on process spawn (reference:
@@ -926,6 +963,12 @@ class Raylet:
 
 
 def main():
+    # SIGUSR1 dumps all thread stacks to the daemon log (see gcs_server).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)

@@ -34,6 +34,17 @@ _KIND_ERROR = 2
 _MAX_FRAME = 1 << 33
 
 
+def debug_log(tag: str, env_var: str = "RAY_TPU_DEBUG_SCHED"):
+    """Env-gated stderr debug logger shared by the runtime daemons."""
+    import os
+    import sys
+
+    if not os.environ.get(env_var):
+        return lambda *m: None
+    return lambda *m: print(f"[{tag} {time.monotonic():.3f}]", *m,
+                            file=sys.stderr, flush=True)
+
+
 class RpcError(Exception):
     """Remote handler raised; carries the remote traceback string."""
 
@@ -89,6 +100,14 @@ class EventLoopThread:
         self.loop.run_forever()
 
     def run(self, coro: Awaitable, timeout: Optional[float] = None):
+        if threading.current_thread() is self._thread:
+            # Blocking on our own loop can never complete — it stalls the
+            # loop for the full timeout (observed: GCS heartbeat outages
+            # from close() in handlers). Fail loudly instead.
+            coro.close()
+            raise RuntimeError(
+                "EventLoopThread.run() called from its own loop thread; "
+                "use 'await' or asyncio.ensure_future instead")
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 
@@ -296,6 +315,10 @@ class RpcClient:
 
     def call(self, method: str, timeout: Optional[float] = None, **payload):
         """Blocking call from any non-loop thread."""
+        if threading.current_thread() is self._io._thread:
+            raise RuntimeError(
+                f"RpcClient.call({method!r}) from the io-loop thread would "
+                "stall the loop; use 'await client.acall(...)' instead")
         outer = None if timeout is None else timeout + 5
         return self._io.submit(
             self.acall(method, timeout=timeout, **payload)
@@ -309,6 +332,13 @@ class RpcClient:
                 self._writer.close()
 
         try:
-            self._io.run(_close(), timeout=2)
+            if threading.current_thread() is self._io._thread:
+                # Called from the loop thread itself (e.g. a GCS handler
+                # closing a worker client): blocking on _io.run here stalls
+                # the WHOLE event loop for the timeout — heartbeats stop
+                # and nodes get declared dead. Schedule and return.
+                asyncio.ensure_future(_close())
+            else:
+                self._io.run(_close(), timeout=2)
         except Exception:
             pass

@@ -1221,6 +1221,10 @@ class Worker:
         return True
 
     async def _h_kill_self(self):
+        # Stop accepting work NOW: a task pushed in the window between this
+        # reply and os._exit must fail as killed, not silently execute
+        # (ray.kill() has already returned to the user by then).
+        self._killed = True
         asyncio.get_running_loop().call_later(0.02, os._exit, 1)
         return True
 
@@ -1524,6 +1528,9 @@ class Worker:
         sequence numbers). Tasks start strictly in sequence order; with
         max_concurrency > 1 they may overlap after starting."""
         actor = self._actor
+        if getattr(self, "_killed", False):
+            return {"results": [], "app_error": serialize_error(
+                exc.ActorDiedError("actor was killed via ray.kill"))}
         if actor is None:
             return {"results": [], "app_error": serialize_error(
                 exc.ActorUnavailableError("actor is not initialized yet"))}
@@ -1555,7 +1562,17 @@ class Worker:
             return {"results": [], "app_error": serialize_error(
                 exc.TaskCancelledError(f"task {spec.name} cancelled"))}
         method_name = spec.function.qualname
-        method = getattr(actor.instance, method_name, None)
+        from ray_tpu.dag import COMPILED_STAGE_METHOD
+
+        if method_name == COMPILED_STAGE_METHOD:
+            # Compiled-DAG resident stage loop (ray_tpu.dag): occupies
+            # this actor's executor until the DAG is torn down.
+            from ray_tpu.dag import run_compiled_stage
+
+            method = lambda payload: run_compiled_stage(  # noqa: E731
+                actor.instance, payload)
+        else:
+            method = getattr(actor.instance, method_name, None)
         if method is None:
             return {"results": [], "app_error": serialize_error(
                 AttributeError(f"actor has no method {method_name!r}"))}

@@ -52,6 +52,13 @@ class ActorMethod:
         return ActorMethod(self._handle, self._name,
                            {**self._options, **options})
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference `actor.py` bind); compose with
+        ray_tpu.dag.InputNode and experimental_compile()."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"actor method {self._name} cannot be called directly; use "
@@ -173,7 +180,8 @@ class ActorClass:
 
     def bind(self, *args, **kwargs):
         raise NotImplementedError(
-            "compiled DAGs are not yet supported in ray_tpu")
+            "ActorClass.bind is not supported: create the actor with "
+            ".remote() and bind its methods (actor.method.bind(...))")
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -1,0 +1,3 @@
+from ray_tpu.experimental.channel import Channel, ChannelClosedError
+
+__all__ = ["Channel", "ChannelClosedError"]

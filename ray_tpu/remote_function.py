@@ -74,6 +74,12 @@ class RemoteFunction:
         clone._fn_hash = self._fn_hash
         return clone
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference `remote_function.py` bind)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self.__name__} cannot be called directly; use "

@@ -1,0 +1,319 @@
+"""Compiled DAGs + mutable shm channels (reference: `python/ray/dag/`
+`compiled_dag_node.py:141`, `experimental_mutable_object_manager.h`)."""
+
+import threading
+import time
+
+import pytest
+
+
+# ---------------------------------------------------------------- channels
+class TestChannel:
+    def test_spsc_roundtrip(self):
+        from ray_tpu.experimental import Channel
+
+        ch = Channel(create=True, buffer_size=1 << 16)
+        try:
+            ch.write({"x": 1, "arr": list(range(100))})
+            assert ch.read(timeout=5)["x"] == 1
+            ch.write(2)
+            assert ch.read(timeout=5) == 2
+        finally:
+            ch.release()
+
+    def test_backpressure_blocks_writer(self):
+        from ray_tpu.experimental import Channel
+
+        ch = Channel(create=True, buffer_size=1 << 12)
+        try:
+            ch.write("a")
+            with pytest.raises(TimeoutError):
+                ch.write("b", timeout=0.2)   # unread value -> blocked
+            assert ch.read(timeout=5) == "a"
+            ch.write("b", timeout=5)         # reader consumed -> unblocked
+            assert ch.read(timeout=5) == "b"
+        finally:
+            ch.release()
+
+    def test_too_large_value(self):
+        from ray_tpu.experimental import Channel
+        from ray_tpu.experimental.channel import ChannelFullError
+
+        ch = Channel(create=True, buffer_size=128)
+        try:
+            with pytest.raises(ChannelFullError):
+                ch.write(b"x" * 1024)
+        finally:
+            ch.release()
+
+    def test_close_wakes_blocked_reader(self):
+        from ray_tpu.experimental import Channel, ChannelClosedError
+
+        ch = Channel(create=True, buffer_size=1 << 12)
+        errs = []
+
+        def reader():
+            try:
+                ch.read(timeout=10)
+            except Exception as e:   # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.1)
+        ch.close()
+        t.join(5)
+        assert not t.is_alive()
+        assert isinstance(errs[0], ChannelClosedError)
+        ch.release()
+
+    def test_attach_by_name(self):
+        from ray_tpu.experimental import Channel
+
+        owner = Channel(create=True, buffer_size=1 << 12)
+        try:
+            peer = Channel(owner.name)
+            owner.write(41)
+            assert peer.read(timeout=5) == 41
+        finally:
+            owner.release()
+
+
+# -------------------------------------------------------------------- DAGs
+@pytest.fixture(scope="module")
+def dag_cluster():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=8, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _worker_cls():
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Worker:
+        def __init__(self, scale):
+            self.scale = scale
+            self.calls = 0
+
+        def mul(self, x):
+            self.calls += 1
+            return x * self.scale
+
+        def add(self, x, y):
+            return x + y
+
+        def boom(self, x):
+            raise ValueError(f"boom-{x}")
+
+        def num_calls(self):
+            return self.calls
+
+    return Worker
+
+
+def _kill(*actors):
+    import ray_tpu
+
+    for a in actors:
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+
+
+def test_interpreted_execute(dag_cluster):
+    import ray_tpu
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    Worker = _worker_cls()
+    a, b = Worker.remote(2), Worker.remote(10)
+
+    @ray_tpu.remote
+    def plus_one(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = b.mul.bind(plus_one.bind(a.mul.bind(inp)))
+    assert ray_tpu.get(dag.execute(3), timeout=60) == 70  # (3*2+1)*10
+
+    with InputNode() as inp:
+        multi = MultiOutputNode([a.mul.bind(inp), b.mul.bind(inp)])
+    refs = multi.execute(4)
+    assert ray_tpu.get(refs, timeout=60) == [8, 40]
+    _kill(a, b)
+
+
+def test_compiled_chain_and_reuse(dag_cluster):
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    Worker = _worker_cls()
+    a, b = Worker.remote(2), Worker.remote(10)
+    with InputNode() as inp:
+        dag = b.mul.bind(a.mul.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(20):
+            assert compiled.execute(i).get(timeout=30) == i * 20
+    finally:
+        compiled.teardown()
+    # Actors are released and usable again after teardown.
+    assert ray_tpu.get(a.mul.remote(5), timeout=60) == 10
+    # The stage loop ran all 20 executions in-place on the actor.
+    assert ray_tpu.get(a.num_calls.remote(), timeout=60) >= 20
+    _kill(a, b)
+
+
+def test_compiled_multi_output_and_input_key(dag_cluster):
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    Worker = _worker_cls()
+    a, c = Worker.remote(2), Worker.remote(3)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.mul.bind(inp["x"]), c.mul.bind(inp["y"])])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute({"x": 4, "y": 5}).get(timeout=30) == [8, 15]
+        assert compiled.execute({"x": 0, "y": 1}).get(timeout=30) == [0, 3]
+    finally:
+        compiled.teardown()
+        _kill(a, c)
+
+
+def test_compiled_stage_error_propagates(dag_cluster):
+    from ray_tpu.dag import InputNode
+
+    Worker = _worker_cls()
+    a, b = Worker.remote(2), Worker.remote(10)
+    with InputNode() as inp:
+        dag = b.mul.bind(a.boom.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="boom-7"):
+            compiled.execute(7).get(timeout=30)
+        # The pipeline survives the error and keeps serving.
+        with pytest.raises(ValueError, match="boom-8"):
+            compiled.execute(8).get(timeout=30)
+    finally:
+        compiled.teardown()
+        _kill(a, b)
+
+
+def test_compile_rejects_function_nodes_and_actor_reuse(dag_cluster):
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    Worker = _worker_cls()
+    a = Worker.remote(2)
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    with InputNode() as inp:
+        bad = a.mul.bind(f.bind(inp))
+    with pytest.raises(TypeError, match="actor-method"):
+        bad.experimental_compile()
+
+    with InputNode() as inp:
+        twice = a.mul.bind(a.mul.bind(inp))
+    with pytest.raises(ValueError, match="one method per actor"):
+        twice.experimental_compile()
+    _kill(a)
+
+
+def test_compiled_fifo_and_in_flight_cap(dag_cluster):
+    from ray_tpu.dag import InputNode
+
+    Worker = _worker_cls()
+    a = Worker.remote(2)
+    with InputNode() as inp:
+        dag = a.mul.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        r1 = compiled.execute(1)
+        r2 = compiled.execute(2)
+        with pytest.raises(RuntimeError, match="in flight"):
+            compiled.execute(3)          # cap = 2
+        with pytest.raises(RuntimeError, match="FIFO"):
+            r2.get(timeout=10)           # out-of-order consumption
+        assert r1.get(timeout=10) == 2
+        assert r2.get(timeout=10) == 4
+        assert compiled.execute(3).get(timeout=10) == 6
+    finally:
+        compiled.teardown()
+        _kill(a)
+
+
+def test_compiled_missing_method_surfaces(dag_cluster):
+    from ray_tpu.dag import InputNode
+
+    Worker = _worker_cls()
+    a = Worker.remote(2)
+    with InputNode() as inp:
+        dag = a.no_such_method.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(AttributeError, match="no_such_method"):
+            compiled.execute(1).get(timeout=15)
+    finally:
+        compiled.teardown()
+        _kill(a)
+
+
+def test_compiled_oversized_result_fails_that_execution_only(dag_cluster):
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+    from ray_tpu.experimental.channel import ChannelFullError
+
+    @ray_tpu.remote
+    class Blob:
+        def make(self, n):
+            return b"x" * n
+
+    a = Blob.remote()
+    with InputNode() as inp:
+        dag = a.make.bind(inp)
+    compiled = dag.experimental_compile(_buffer_size_bytes=1 << 16)
+    try:
+        with pytest.raises(ChannelFullError):
+            compiled.execute(1 << 20).get(timeout=15)
+        # Pipeline still alive afterwards.
+        assert compiled.execute(8).get(timeout=15) == b"x" * 8
+    finally:
+        compiled.teardown()
+        _kill(a)
+
+
+def test_compiled_faster_than_task_path(dag_cluster):
+    """The whole point: channel hops beat per-call task RPCs."""
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    Worker = _worker_cls()
+    a, b = Worker.remote(2), Worker.remote(10)
+    with InputNode() as inp:
+        dag = b.mul.bind(a.mul.bind(inp))
+    compiled = dag.experimental_compile()
+    compiled.execute(0).get(timeout=30)   # warm
+    t0 = time.perf_counter()
+    n = 100
+    for i in range(n):
+        compiled.execute(i).get(timeout=30)
+    compiled_dt = (time.perf_counter() - t0) / n
+    compiled.teardown()
+
+    t0 = time.perf_counter()
+    m = 30
+    for i in range(m):
+        ray_tpu.get(
+            b.mul.remote(ray_tpu.get(a.mul.remote(i), timeout=30)),
+            timeout=30)
+    task_dt = (time.perf_counter() - t0) / m
+    _kill(a, b)
+    assert compiled_dt < task_dt, (compiled_dt, task_dt)

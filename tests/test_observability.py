@@ -93,6 +93,38 @@ def test_memory_monitor_units(tmp_path):
     assert memory_monitor.pick_victim([idle]) is None
 
 
+def test_actor_churn_does_not_wedge_cluster(tmp_path):
+    """Regression: waves of actor create/kill used to stall the GCS event
+    loop (sync RpcClient.close() from the loop thread blocked 2s per
+    close) until heartbeats lapsed and the only node was declared dead."""
+    import subprocess
+
+    script = tmp_path / "churn.py"
+    script.write_text(
+        "import time\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=8)\n"
+        "@ray_tpu.remote\n"
+        "class A:\n"
+        "    def ping(self): return 'pong'\n"
+        "for wave in range(3):\n"
+        "    actors = [A.remote() for _ in range(4)]\n"
+        "    out = ray_tpu.get([a.ping.remote() for a in actors],\n"
+        "                      timeout=40)\n"
+        "    assert out == ['pong'] * 4, (wave, out)\n"
+        "    for a in actors:\n"
+        "        ray_tpu.kill(a)\n"
+        "    time.sleep(0.5)\n"
+        "ray_tpu.shutdown()\n"
+        "print('CHURN-OK')\n")
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=150, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                          "PYTHONPATH": _repo_root()})
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "CHURN-OK" in proc.stdout
+
+
 def test_oom_kill_and_retry(tmp_path):
     """Over-threshold memory -> raylet kills the leased task worker; the
     task retries and completes once pressure clears."""
