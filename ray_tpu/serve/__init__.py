@@ -14,9 +14,12 @@ from ray_tpu.serve.api import (
     Application, Deployment, delete, deployment, get_app_handle, run,
     shutdown, start, status,
 )
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
-    "Application", "Deployment", "DeploymentHandle", "delete", "deployment",
-    "get_app_handle", "run", "shutdown", "start", "status",
+    "Application", "Deployment", "DeploymentHandle", "batch", "delete",
+    "deployment", "get_app_handle", "get_multiplexed_model_id",
+    "multiplexed", "run", "shutdown", "start", "status",
 ]

@@ -28,6 +28,12 @@ class ServeController:
         self._apps: Dict[str, Dict[str, Dict[str, Any]]] = {}
         # (app, deployment) -> list of replica handles
         self._replicas: Dict[tuple, List[Any]] = {}
+        # (app, deployment) -> router_id -> (inflight, ts): handle-side
+        # load reports driving the autoscaler.
+        self._handle_metrics: Dict[tuple, Dict[str, tuple]] = {}
+        # (app, deployment) -> {"desired", "since"}: scale-decision
+        # hysteresis state.
+        self._scale_state: Dict[tuple, Dict[str, Any]] = {}
         self._version = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -51,6 +57,8 @@ class ServeController:
                         ray_tpu.kill(replica)
                     except Exception:
                         pass
+                self._handle_metrics.pop((app_name, name), None)
+                self._scale_state.pop((app_name, name), None)
             self._version += 1
         return True
 
@@ -61,7 +69,7 @@ class ServeController:
                 self._reconcile_once()
             except Exception:
                 pass
-            self._stop.wait(2.0)
+            self._stop.wait(1.0)
 
     def _reconcile_once(self):
         with self._lock:
@@ -81,10 +89,11 @@ class ServeController:
                 except Exception:
                     changed = True
             replicas[:] = live
-            want = spec.get("num_replicas", 1)
+            want = self._desired_replicas(key, spec, len(live))
             while len(replicas) < want:
                 options: Dict[str, Any] = dict(
-                    num_cpus=spec.get("num_cpus", 1))
+                    num_cpus=spec.get("num_cpus", 1),
+                    max_concurrency=spec.get("max_ongoing_requests", 8))
                 if spec.get("num_tpus"):
                     options["num_tpus"] = spec["num_tpus"]
                 replicas.append(self._replica_cls.options(**options).remote(
@@ -92,16 +101,91 @@ class ServeController:
                     tuple(spec.get("init_args", ())),
                     dict(spec.get("init_kwargs", {}))))
                 changed = True
-            while len(replicas) > want:
-                doomed = replicas.pop()
-                try:
-                    ray_tpu.kill(doomed)
-                except Exception:
-                    pass
+            if len(replicas) > want:
+                doomed_list = replicas[want:]
+                del replicas[want:]
                 changed = True
+                # Remove from routing first, then drain before killing —
+                # autoscaling makes downscale routine; in-flight requests
+                # must finish (reference: graceful replica shutdown).
+                with self._lock:
+                    self._version += 1
+                for doomed in doomed_list:
+                    self._drain_and_kill(doomed)
         if changed:
             with self._lock:
                 self._version += 1
+
+    def _drain_and_kill(self, replica, timeout_s: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                stats = ray_tpu.get(replica.stats.remote(), timeout=10)
+                if stats.get("ongoing", 0) == 0:
+                    break
+            except Exception:
+                break
+            time.sleep(0.25)
+        try:
+            ray_tpu.get(replica.prepare_shutdown.remote(), timeout=5)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(replica)
+        except Exception:
+            pass
+
+    # --------------------------------------------------------- autoscaling
+    def record_handle_metrics(self, app_name: str, deployment_name: str,
+                              router_id: str, inflight: int) -> bool:
+        """Handle-side ongoing-request report (reference: handles push
+        metrics the controller's autoscaler aggregates)."""
+        key = (app_name, deployment_name)
+        with self._lock:
+            self._handle_metrics.setdefault(key, {})[router_id] = (
+                inflight, time.monotonic())
+        return True
+
+    def _total_inflight(self, key: tuple) -> int:
+        now = time.monotonic()
+        with self._lock:
+            reports = self._handle_metrics.get(key, {})
+            # Routers report every ~2s; prune dead routers' entries so a
+            # long-lived controller doesn't accumulate them forever.
+            for rid, (_, ts) in list(reports.items()):
+                if now - ts >= 10.0:
+                    del reports[rid]
+            return sum(v for v, _ in reports.values())
+
+    def _desired_replicas(self, key: tuple, spec: Dict[str, Any],
+                          current: int) -> int:
+        # Defaults live in api.py's spec build (single source of truth);
+        # specs arriving here always carry the full config.
+        cfg = spec.get("autoscaling_config")
+        if not cfg:
+            return spec.get("num_replicas", 1)
+        import math
+
+        lo, hi = cfg["min_replicas"], cfg["max_replicas"]
+        target = max(cfg["target_ongoing_requests"], 1e-9)
+        raw = math.ceil(self._total_inflight(key) / target)
+        desired = max(lo, min(hi, max(raw, 0)))
+        if desired == current:
+            self._scale_state.pop(key, None)
+            return current
+        # Hysteresis: the desire must hold for upscale/downscale_delay_s
+        # before acting (reference: autoscaling_policy delays).
+        now = time.monotonic()
+        st = self._scale_state.get(key)
+        if st is None or st["desired"] != desired:
+            self._scale_state[key] = {"desired": desired, "since": now}
+            return current
+        delay = (cfg["upscale_delay_s"] if desired > current
+                 else cfg["downscale_delay_s"])
+        if now - st["since"] < delay:
+            return current
+        self._scale_state.pop(key, None)
+        return desired
 
     # -------------------------------------------------------------- query
     def get_replicas(self, app_name: str, deployment_name: str):

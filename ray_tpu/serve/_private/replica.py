@@ -25,12 +25,21 @@ class Replica:
         else:
             self._callable = cls_or_fn
             self._is_function = True
+        import threading
+
         self._num_ongoing = 0
         self._num_served = 0
+        # handle_request runs on a thread pool (max_ongoing_requests ->
+        # actor max_concurrency); bare += on counters would drift.
+        self._stats_lock = threading.Lock()
 
     def handle_request(self, method_name: str, args: tuple,
-                       kwargs: dict) -> Any:
-        self._num_ongoing += 1
+                       kwargs: dict, model_id: str = "") -> Any:
+        from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
+
+        with self._stats_lock:
+            self._num_ongoing += 1
+        token = _set_model_id(model_id)
         try:
             if self._is_function:
                 target = self._callable
@@ -39,10 +48,13 @@ class Replica:
             else:
                 target = getattr(self._callable, method_name)
             out = target(*args, **kwargs)
-            self._num_served += 1
+            with self._stats_lock:
+                self._num_served += 1
             return out
         finally:
-            self._num_ongoing -= 1
+            _reset_model_id(token)
+            with self._stats_lock:
+                self._num_ongoing -= 1
 
     def check_health(self) -> bool:
         checker = getattr(self._callable, "check_health", None)

@@ -18,6 +18,8 @@ import ray_tpu
 
 class Router:
     def __init__(self, controller, app_name: str, deployment_name: str):
+        import uuid
+
         self._controller = controller
         self._app = app_name
         self._deployment = deployment_name
@@ -26,7 +28,32 @@ class Router:
         self._inflight: Dict[Any, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        self._router_id = uuid.uuid4().hex[:12]
+        self._push_thread_started = False
         self._refresh(force=True)
+
+    def _maybe_push_metrics(self) -> None:
+        """Start the periodic load reporter on first traffic. A background
+        thread (not push-on-assign) keeps reports fresh while long
+        requests run with no new arrivals — otherwise the controller sees
+        stale-then-zero load and downscales mid-traffic."""
+        if self._push_thread_started:
+            return
+        self._push_thread_started = True
+
+        def run():
+            while True:
+                time.sleep(2.0)
+                with self._lock:
+                    total = sum(self._inflight.values())
+                try:
+                    self._controller.record_handle_metrics.remote(
+                        self._app, self._deployment, self._router_id, total)
+                except Exception:
+                    return    # cluster gone; let the thread die
+
+        threading.Thread(target=run, daemon=True,
+                         name="serve-metrics-push").start()
 
     def _refresh(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -43,7 +70,8 @@ class Router:
                 self._inflight = {r: self._inflight.get(r, 0)
                                   for r in replicas}
 
-    def assign_request(self, method_name: str, args: tuple, kwargs: dict):
+    def assign_request(self, method_name: str, args: tuple, kwargs: dict,
+                       model_id: str = ""):
         """Returns an ObjectRef for the response."""
         deadline = time.monotonic() + 30.0
         while True:
@@ -61,13 +89,24 @@ class Router:
         with self._lock:
             if len(replicas) == 1:
                 chosen = replicas[0]
+            elif model_id:
+                # Cache affinity: one stable replica per model id so its
+                # weights load once, not on every replica (reference:
+                # multiplexed routing).
+                from ray_tpu.serve.multiplex import rendezvous_pick
+
+                chosen = rendezvous_pick(
+                    sorted(replicas, key=lambda r: r._actor_id),
+                    model_id)
             else:
                 a, b = random.sample(replicas, 2)
                 chosen = (a if self._inflight.get(a, 0)
                           <= self._inflight.get(b, 0) else b)
             self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
+        self._maybe_push_metrics()
 
-        ref = chosen.handle_request.remote(method_name, args, kwargs)
+        ref = chosen.handle_request.remote(method_name, args, kwargs,
+                                           model_id)
 
         def _done(_fut):
             with self._lock:

@@ -22,10 +22,17 @@ _PROXY_NAME = "SERVE_PROXY"
 class Deployment:
     func_or_class: Any
     name: str
-    num_replicas: int = 1
+    num_replicas: Any = 1          # int or "auto" (autoscaling defaults)
     num_cpus: float = 1
     num_tpus: float = 0
     route_prefix: Optional[str] = None
+    # Per-replica concurrency (reference: max_ongoing_requests) — maps to
+    # the replica actor's max_concurrency; also what @serve.batch needs to
+    # see concurrent requests at all.
+    max_ongoing_requests: int = 8
+    # {"min_replicas", "max_replicas", "target_ongoing_requests",
+    #  "upscale_delay_s", "downscale_delay_s"}
+    autoscaling_config: Optional[Dict[str, Any]] = None
 
     def options(self, **overrides) -> "Deployment":
         return dataclasses.replace(self, **overrides)
@@ -59,30 +66,48 @@ class Application:
             for k, v in self.init_kwargs.items()
         }
         d = self.deployment
+        autoscaling = d.autoscaling_config
+        num_replicas = d.num_replicas
+        if num_replicas == "auto":
+            autoscaling = autoscaling or {}
+            num_replicas = autoscaling.get("min_replicas", 1)
+        if autoscaling is not None:
+            autoscaling = {
+                "min_replicas": 1, "max_replicas": 4,
+                "target_ongoing_requests": 2,
+                "upscale_delay_s": 2.0, "downscale_delay_s": 10.0,
+                **autoscaling,
+            }
         if not any(spec["name"] == d.name for spec in out):
             out.append({
                 "name": d.name,
                 "serialized_callable": cloudpickle.dumps(d.func_or_class),
                 "init_args": args,
                 "init_kwargs": kwargs,
-                "num_replicas": d.num_replicas,
+                "num_replicas": num_replicas,
                 "num_cpus": d.num_cpus,
                 "num_tpus": d.num_tpus,
                 "route_prefix": d.route_prefix,
                 "is_ingress": is_ingress,
+                "max_ongoing_requests": d.max_ongoing_requests,
+                "autoscaling_config": autoscaling,
             })
         return DeploymentHandle(app_name, d.name)
 
 
 def deployment(func_or_class=None, *, name: Optional[str] = None,
-               num_replicas: int = 1, num_cpus: float = 1,
-               num_tpus: float = 0, route_prefix: Optional[str] = None):
+               num_replicas: Any = 1, num_cpus: float = 1,
+               num_tpus: float = 0, route_prefix: Optional[str] = None,
+               max_ongoing_requests: int = 8,
+               autoscaling_config: Optional[Dict[str, Any]] = None):
     def wrap(target):
         return Deployment(
             func_or_class=target,
             name=name or getattr(target, "__name__", "deployment"),
             num_replicas=num_replicas, num_cpus=num_cpus,
-            num_tpus=num_tpus, route_prefix=route_prefix)
+            num_tpus=num_tpus, route_prefix=route_prefix,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config)
 
     return wrap(func_or_class) if func_or_class is not None else wrap
 

@@ -34,10 +34,20 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, app_name: str, deployment_name: str):
+    def __init__(self, app_name: str, deployment_name: str,
+                 multiplexed_model_id: str = ""):
         self._app = app_name
         self._deployment = deployment_name
+        self._model_id = multiplexed_model_id
         self._router = None
+
+    def options(self, *, multiplexed_model_id: str = "") -> "DeploymentHandle":
+        """Request options (reference: handle.options(multiplexed_model_id=…)
+        routes to a replica already holding that model)."""
+        clone = DeploymentHandle(self._app, self._deployment,
+                                 multiplexed_model_id)
+        clone._router = self._router    # share the router + inflight view
+        return clone
 
     def _get_router(self):
         if self._router is None:
@@ -52,7 +62,8 @@ class DeploymentHandle:
 
     def _call(self, method: str, args: tuple,
               kwargs: dict) -> DeploymentResponse:
-        ref = self._get_router().assign_request(method, args, kwargs)
+        ref = self._get_router().assign_request(method, args, kwargs,
+                                                model_id=self._model_id)
         return DeploymentResponse(ref)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
@@ -66,7 +77,8 @@ class DeploymentHandle:
     # Handles serialize into replicas for model composition; the router is
     # process-local state and rebuilds lazily after rehydration.
     def __reduce__(self):
-        return DeploymentHandle, (self._app, self._deployment)
+        return DeploymentHandle, (self._app, self._deployment,
+                                  self._model_id)
 
     def __repr__(self):
         return f"DeploymentHandle({self._app}/{self._deployment})"

@@ -1,0 +1,199 @@
+"""Serve batching, multiplexing, autoscaling (reference:
+`serve/batching.py`, `serve/multiplex.py`, `serve/autoscaling_policy.py`)."""
+
+import threading
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    import ray_tpu
+    from ray_tpu import serve
+
+    info = ray_tpu.init(num_cpus=8, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024,
+                        ignore_reinit_error=True)
+    yield info
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_batch_decorator_units():
+    from ray_tpu.serve import batch
+
+    seen_batches = []
+
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+    def double(items):
+        seen_batches.append(len(items))
+        return [x * 2 for x in items]
+
+    results = {}
+
+    def call(i):
+        results[i] = double(i)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert results == {i: i * 2 for i in range(8)}
+    assert max(seen_batches) > 1          # real coalescing happened
+    assert sum(seen_batches) == 8
+
+    # A non-list return surfaces as an error to the caller.
+    @batch(max_batch_size=2, batch_wait_timeout_s=0.05)
+    def bad(items):
+        return 42
+
+    with pytest.raises(TypeError, match="one per input"):
+        bad("x")
+
+
+def test_multiplexed_lru_units():
+    from ray_tpu.serve import multiplexed
+
+    class Host:
+        def __init__(self):
+            self.loads = []
+
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.loads.append(model_id)
+            return f"model-{model_id}"
+
+    h = Host()
+    assert h.get_model("a") == "model-a"
+    assert h.get_model("a") == "model-a"      # cached
+    assert h.loads == ["a"]
+    h.get_model("b")
+    h.get_model("c")                          # evicts "a" (LRU)
+    assert h.loads == ["a", "b", "c"]
+    h.get_model("a")                          # reload after eviction
+    assert h.loads == ["a", "b", "c", "a"]
+
+
+def test_serve_batching_e2e(serve_cluster):
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=8)
+    class Batcher:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [x + 100 for x in items]
+
+        def __call__(self, x):
+            return self.handle(x)
+
+        def get_batch_sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batcher.bind(), name="batch_app")
+    responses = [handle.remote(i) for i in range(16)]
+    assert [r.result(timeout=60) for r in responses] == [
+        i + 100 for i in range(16)]
+    sizes = handle.get_batch_sizes.remote().result(timeout=60)
+    assert sum(sizes) == 16
+    assert max(sizes) > 1, sizes              # batched on the replica
+    serve.delete("batch_app")
+
+
+def test_serve_multiplex_e2e(serve_cluster):
+    import os
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class MultiModel:
+        def __init__(self):
+            self.loaded = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.loaded.append(model_id)
+            return f"weights-{model_id}"
+
+        def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = self.get_model(model_id)
+            return (os.getpid(), model_id, model)
+
+    handle = serve.run(MultiModel.bind(), name="mux_app")
+    # Each model id lands on ONE stable replica across repeats.
+    pid_by_model = {}
+    for _ in range(3):
+        for mid in ("m1", "m2", "m3", "m4"):
+            pid, got_mid, model = handle.options(
+                multiplexed_model_id=mid).remote(0).result(timeout=60)
+            assert got_mid == mid
+            assert model == f"weights-{mid}"
+            pid_by_model.setdefault(mid, set()).add(pid)
+    for mid, pids in pid_by_model.items():
+        assert len(pids) == 1, (mid, pids)
+    serve.delete("mux_app")
+
+
+def test_serve_autoscaling_e2e(serve_cluster):
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_ongoing_requests": 2,
+            "upscale_delay_s": 1.0, "downscale_delay_s": 3.0,
+        })
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    handle = serve.run(Slow.bind(), name="auto_app")
+    assert handle.remote(0).result(timeout=60) == 0
+
+    def replica_count():
+        for d in serve.status("auto_app"):
+            if d["name"] == "Slow":
+                return d["live_replicas"]
+        return 0
+
+    assert replica_count() == 1
+    # Sustained pressure: keep ~12 requests in flight for a while.
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            refs = [handle.remote(i) for i in range(12)]
+            for r in refs:
+                try:
+                    r.result(timeout=60)
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=pound) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline and replica_count() < 2:
+            time.sleep(0.5)
+        assert replica_count() >= 2, "never scaled up"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(70)
+    # Idle: scales back down to min after the downscale delay.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and replica_count() > 1:
+        time.sleep(1.0)
+    assert replica_count() == 1, "never scaled down"
+    serve.delete("auto_app")
