@@ -46,6 +46,11 @@ class LlamaConfig:
     attn_impl: str = "xla"      # "xla" | "flash" | "ring"
     remat: bool = False          # jax.checkpoint each layer (HBM for FLOPs)
     tie_embeddings: bool = False
+    # Mixture-of-Experts FFN (0 = dense). Experts shard over the mesh
+    # "expert" axis (SURVEY §2.7 EP; see models/moe.py).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -73,10 +78,12 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         d, h, v = self.dim, self.hidden_dim, self.vocab_size
+        ffn = (self.n_experts * 3 * d * h + d * self.n_experts
+               if self.n_experts else 3 * d * h)
         per_layer = (self.dim * self.head_dim * self.n_heads      # wq
                      + 2 * self.dim * self.head_dim * self.n_kv_heads  # wk,wv
                      + self.dim * self.dim                         # wo
-                     + 3 * d * h                                   # ffn
+                     + ffn                                         # ffn/moe
                      + 2 * d)                                      # norms
         out_head = 0 if self.tie_embeddings else d * v
         return v * d + self.n_layers * per_layer + d + out_head
@@ -96,7 +103,7 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
         return initializer(key, shape, c.param_dtype)
 
     kd = c.head_dim
-    lk = jax.random.split(k_layers, 7)
+    lk = jax.random.split(k_layers, 8)
 
     def stacked(key, shape):
         return dense(key, (c.n_layers, *shape))
@@ -110,9 +117,21 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
             "wv": stacked(lk[2], (c.dim, c.n_kv_heads * kd)),
             "wo": stacked(lk[3], (c.n_heads * kd, c.dim)),
             "ffn_norm": jnp.ones((c.n_layers, c.dim), c.param_dtype),
-            "w_gate": stacked(lk[4], (c.dim, c.hidden_dim)),
-            "w_up": stacked(lk[5], (c.dim, c.hidden_dim)),
-            "w_down": stacked(lk[6], (c.hidden_dim, c.dim)),
+            **(
+                {
+                    "router": stacked(lk[7], (c.dim, c.n_experts)),
+                    "w_gate": stacked(lk[4], (c.n_experts, c.dim,
+                                              c.hidden_dim)),
+                    "w_up": stacked(lk[5], (c.n_experts, c.dim,
+                                            c.hidden_dim)),
+                    "w_down": stacked(lk[6], (c.n_experts, c.hidden_dim,
+                                              c.dim)),
+                } if c.n_experts else {
+                    "w_gate": stacked(lk[4], (c.dim, c.hidden_dim)),
+                    "w_up": stacked(lk[5], (c.dim, c.hidden_dim)),
+                    "w_down": stacked(lk[6], (c.hidden_dim, c.dim)),
+                }
+            ),
         },
         "norm_f": jnp.ones((c.dim,), c.param_dtype),
     }
@@ -254,16 +273,29 @@ def _layer(config: LlamaConfig, cos, sin, attn_fn, x, layer_params):
     x = x + attn.reshape(B, S, -1) @ p["wo"].astype(c.dtype)
 
     h = rms_norm(x, p["ffn_norm"], c.norm_eps)
+    if c.n_experts:
+        from ray_tpu.models.moe import MoEConfig, moe_layer
+
+        mcfg = MoEConfig(
+            dim=c.dim, hidden_dim=c.hidden_dim, n_experts=c.n_experts,
+            top_k=c.moe_top_k, capacity_factor=c.moe_capacity_factor,
+            dtype=c.dtype)
+        delta, aux = moe_layer(h, {
+            "router": p["router"], "w_gate": p["w_gate"],
+            "w_up": p["w_up"], "w_down": p["w_down"]}, mcfg)
+        return x + delta, aux
     gate = jax.nn.silu(h @ p["w_gate"].astype(c.dtype))
     up = h @ p["w_up"].astype(c.dtype)
     x = x + (gate * up) @ p["w_down"].astype(c.dtype)
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def forward(params: Dict[str, Any], tokens: jax.Array,
             config: LlamaConfig,
-            attn_impl: Optional[str] = None) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, V]."""
+            attn_impl: Optional[str] = None,
+            return_aux: bool = False):
+    """tokens [B, S] int32 -> logits [B, S, V] (or (logits, aux_loss)
+    with return_aux — the MoE router load-balance term)."""
     c = config
     impl = attn_impl or c.attn_impl
     attn_fn = _get_attention_fn(impl)
@@ -276,9 +308,9 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
         layer_fn = jax.checkpoint(layer_fn)
 
     def scan_body(x, layer_params):
-        return layer_fn(x, layer_params), None
+        return layer_fn(x, layer_params)
 
-    x, _ = lax.scan(scan_body, x, params["layers"])
+    x, aux = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["norm_f"], c.norm_eps)
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
     # bf16 matmul on the MXU (fp32 here costs ~4x), fp32 accumulation for
@@ -286,6 +318,8 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
     logits = jax.lax.dot_general(
         x, head.astype(c.dtype), (((2,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
+    if return_aux:
+        return logits, jnp.sum(aux)
     return logits
 
 
@@ -294,7 +328,8 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
             attn_impl: Optional[str] = None) -> jax.Array:
     """Next-token cross-entropy. batch: tokens [B, S] (+ optional mask)."""
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], config, attn_impl)
+    logits, aux = forward(params, tokens[:, :-1], config, attn_impl,
+                          return_aux=True)
     targets = tokens[:, 1:]
     # NLL via logsumexp - target_logit: one [B,S,V] reduction instead of a
     # materialized log_softmax plus gather (halves loss-stage HBM traffic).
@@ -304,8 +339,8 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
     mask = batch.get("mask")
     if mask is not None:
         m = mask[:, 1:].astype(jnp.float32)
-        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    return nll.mean()
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0) + aux
+    return nll.mean() + aux
 
 
 def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
@@ -348,6 +383,10 @@ def decode_step(params: Dict[str, Any], cache: Dict[str, jax.Array],
                 config: LlamaConfig):
     """One incremental token: tokens [B] int32 at `positions` [B].
     Returns (logits [B, V], updated cache). Jittable; scan over layers."""
+    if config.n_experts:
+        raise NotImplementedError(
+            "KV-cache decode for MoE configs is not implemented yet; "
+            "use forward() for scoring")
     c = config
     cos, sin = rope_freqs(c.head_dim, cache["k"].shape[2], c.rope_theta)
     x = embed_lookup(params["embed"].astype(c.dtype), tokens[:, None])

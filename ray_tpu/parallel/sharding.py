@@ -38,6 +38,27 @@ def llama_param_specs(config: LlamaConfig, mesh) -> Dict[str, Any]:
         raise ValueError(
             f"tensor axis ({mesh_axis_size(mesh, 'tensor')}) must divide "
             f"n_kv_heads ({config.n_kv_heads})")
+    ep = _ax(mesh, "expert")
+    if ep is not None and config.n_experts \
+            and config.n_experts % mesh_axis_size(mesh, "expert"):
+        raise ValueError(
+            f"expert axis ({mesh_axis_size(mesh, 'expert')}) must divide "
+            f"n_experts ({config.n_experts})")
+    if config.n_experts:
+        # MoE FFN: experts over the "expert" axis (EP), expert-internal
+        # dims over tp/fsdp as usual; router tiny -> replicated.
+        ffn_specs = {
+            "router": P(None, None, None),
+            "w_gate": P(None, ep, fsdp, tp),
+            "w_up": P(None, ep, fsdp, tp),
+            "w_down": P(None, ep, tp, fsdp),
+        }
+    else:
+        ffn_specs = {
+            "w_gate": P(None, fsdp, tp),
+            "w_up": P(None, fsdp, tp),
+            "w_down": P(None, tp, fsdp),
+        }
     specs = {
         "embed": P(tp, fsdp),
         "layers": {
@@ -47,9 +68,7 @@ def llama_param_specs(config: LlamaConfig, mesh) -> Dict[str, Any]:
             "wv": P(None, fsdp, tp),
             "wo": P(None, tp, fsdp),
             "ffn_norm": P(None, None),
-            "w_gate": P(None, fsdp, tp),
-            "w_up": P(None, fsdp, tp),
-            "w_down": P(None, tp, fsdp),
+            **ffn_specs,
         },
         "norm_f": P(None),
     }
