@@ -6,8 +6,11 @@ TPU-first: the learner update is a single pjit'd SPMD step over the learner
 gang's global mesh (gradients psum over ICI), not DDP-wrapped modules.
 """
 
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
 
-__all__ = ["PPO", "PPOConfig", "LearnerGroup", "MLPModule", "RLModuleSpec"]
+__all__ = ["DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "PPO", "PPOConfig",
+           "LearnerGroup", "MLPModule", "RLModuleSpec"]

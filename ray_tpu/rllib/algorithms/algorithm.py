@@ -81,6 +81,7 @@ class Algorithm:
     """
 
     learner_class = None
+    rl_module_class = None    # None -> default actor-critic MLP
 
     def __init__(self, config: AlgorithmConfig):
         from ray_tpu.rllib.core.learner_group import LearnerGroup
@@ -90,7 +91,8 @@ class Algorithm:
         self.module_spec = RLModuleSpec(
             observation_space=probe_env.observation_space,
             action_space=probe_env.action_space,
-            hidden=config.module_hidden)
+            hidden=config.module_hidden,
+            module_class=self.rl_module_class)
         self.env_runners = [
             EnvRunner.remote(config.env, self.module_spec,
                              num_envs=config.num_envs_per_runner,
@@ -135,8 +137,9 @@ class Algorithm:
             self._recent_returns.extend(ro.pop("episode_returns"))
         return rollouts
 
-    def _sync_weights(self) -> None:
-        weights = self.learner_group.get_weights()
+    def _sync_weights(self, weights=None) -> None:
+        if weights is None:
+            weights = self.learner_group.get_weights()
         ref = ray_tpu.put(weights)
         ray_tpu.get([r.set_weights.remote(ref) for r in self.env_runners],
                     timeout=600)

@@ -1,0 +1,221 @@
+"""DQN — double Q-learning with a replay buffer and target network.
+
+Reference: `rllib/algorithms/dqn/dqn.py` (training_step: sample →
+replay-buffer add → N TD updates → periodic target sync) and
+`dqn/dqn_rainbow_learner.py` (double-Q TD loss). TPU-first shape: the
+target network is an extra entry in the learner's jitted state pytree,
+the TD update is one pjit'd step, and epsilon rides inside the weight
+pytree so env runners need no extra plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env.spaces import Box, Discrete
+
+
+class QModule(RLModule):
+    """Q-network: forward_train returns {"q": [B, A]}; exploration is
+    epsilon-greedy with epsilon carried IN the param pytree (the driver
+    anneals it, weight sync ships it to runners for free)."""
+
+    def __init__(self, observation_space: Box, action_space: Discrete,
+                 hidden: Sequence[int] = (64, 64)):
+        import flax.linen as nn
+
+        obs_dim = int(np.prod(observation_space.shape))
+        n_actions = action_space.n
+
+        class _Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = x
+                for width in hidden:
+                    h = nn.relu(nn.Dense(width)(h))
+                return nn.Dense(n_actions)(h)
+
+        self._net = _Net()
+        self._obs_dim = obs_dim
+        self._n_actions = n_actions
+
+    def init(self, rng: jax.Array) -> Any:
+        dummy = jnp.zeros((1, self._obs_dim), jnp.float32)
+        return {"net": self._net.init(rng, dummy),
+                "epsilon": jnp.asarray(1.0, jnp.float32)}
+
+    def forward_train(self, params, obs):
+        q = self._net.apply(params["net"], obs)
+        return {"q": q, "action_logits": q, "vf": q.max(axis=-1)}
+
+    def forward_exploration(self, params, obs, rng):
+        q = self._net.apply(params["net"], obs)
+        greedy = jnp.argmax(q, axis=-1)
+        k_eps, k_act = jax.random.split(rng)
+        random_a = jax.random.randint(k_act, greedy.shape, 0,
+                                      self._n_actions)
+        explore = jax.random.uniform(k_eps, greedy.shape) < params["epsilon"]
+        actions = jnp.where(explore, random_a, greedy)
+        return {"actions": actions,
+                "logp": jnp.zeros_like(q[..., 0]),
+                "vf": q.max(axis=-1)}
+
+
+class DQNLearner(Learner):
+    def init_extra_state(self, params) -> Dict[str, Any]:
+        # Distinct buffers: the update donates the whole state, and XLA
+        # rejects donating one buffer twice (params aliasing target).
+        return {"target": jax.tree.map(jnp.copy, params)}
+
+    def sync_target(self) -> bool:
+        """Snapshot online params as the target network."""
+        self._state["target"] = jax.tree.map(jnp.copy,
+                                             self._state["params"])
+        return True
+
+    def compute_loss_from_state(self, state, batch, rng):
+        gamma = self.config.get("gamma", 0.99)
+        q_all = self.module.forward_train(state["params"],
+                                          batch["obs"])["q"]
+        q = jnp.take_along_axis(
+            q_all, batch["actions"].astype(jnp.int32)[:, None], -1)[:, 0]
+
+        # Double DQN: online net picks the argmax, target net scores it.
+        q_next_online = self.module.forward_train(
+            state["params"], batch["next_obs"])["q"]
+        a_star = jnp.argmax(q_next_online, axis=-1)
+        q_next_target = self.module.forward_train(
+            state["target"], batch["next_obs"])["q"]
+        q_star = jnp.take_along_axis(q_next_target, a_star[:, None], -1)[:, 0]
+        td_target = batch["rewards"] + gamma * (
+            1.0 - batch["dones"].astype(jnp.float32)
+        ) * jax.lax.stop_gradient(q_star)
+
+        err = q - jax.lax.stop_gradient(td_target)
+        huber = jnp.where(jnp.abs(err) <= 1.0, 0.5 * err * err,
+                          jnp.abs(err) - 0.5)
+        loss = huber.mean()
+        return loss, {"td_loss": loss, "q_mean": q.mean()}
+
+
+class ReplayBuffer:
+    """Uniform ring buffer over flat transitions (driver-side numpy;
+    reference: `utils/replay_buffers/`)."""
+
+    def __init__(self, capacity: int, obs_shape):
+        self._cap = capacity
+        self._obs = np.zeros((capacity, *obs_shape), np.float32)
+        self._next_obs = np.zeros((capacity, *obs_shape), np.float32)
+        self._actions = np.zeros((capacity,), np.int32)
+        self._rewards = np.zeros((capacity,), np.float32)
+        self._dones = np.zeros((capacity,), np.float32)
+        self._idx = 0
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones) -> None:
+        n = len(obs)
+        if n > self._cap:    # keep only the newest capacity-full
+            obs, actions = obs[-self._cap:], actions[-self._cap:]
+            rewards, next_obs = rewards[-self._cap:], next_obs[-self._cap:]
+            dones = dones[-self._cap:]
+            n = self._cap
+        idx = (self._idx + np.arange(n)) % self._cap
+        self._obs[idx] = obs
+        self._next_obs[idx] = next_obs
+        self._actions[idx] = actions
+        self._rewards[idx] = rewards
+        self._dones[idx] = dones
+        self._idx = int((self._idx + n) % self._cap)
+        self._size = min(self._size + n, self._cap)
+
+    def sample(self, n: int, rng: np.random.RandomState
+               ) -> Dict[str, np.ndarray]:
+        idx = rng.randint(0, self._size, n)
+        return {
+            "obs": self._obs[idx], "next_obs": self._next_obs[idx],
+            "actions": self._actions[idx], "rewards": self._rewards[idx],
+            "dones": self._dones[idx],
+        }
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.train_batch_size = 64
+        self.rollout_fragment_length = 16
+        self.num_updates_per_iteration = 32
+        self.target_update_freq = 200       # in gradient updates
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 4000     # in env steps
+
+    algo_class = property(lambda self: DQN)
+
+
+class DQN(Algorithm):
+    learner_class = DQNLearner
+    rl_module_class = QModule
+
+    def __init__(self, config: DQNConfig):
+        super().__init__(config)
+        self._buffer = ReplayBuffer(
+            config.buffer_capacity,
+            self.module_spec.observation_space.shape)
+        self._rng = np.random.RandomState(config.seed)
+        self._env_steps = 0
+        self._updates = 0
+
+    def _learner_config(self) -> Dict[str, Any]:
+        out = super()._learner_config()
+        out["gamma"] = self.config.gamma
+        return out
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(cfg.epsilon_decay_steps, 1))
+        return float(cfg.epsilon_initial
+                     + frac * (cfg.epsilon_final - cfg.epsilon_initial))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollouts = self.sample_batch(cfg.rollout_fragment_length)
+        for ro in rollouts:
+            T, N = ro["actions"].shape
+            self._env_steps += T * N
+            obs = ro["obs"]                       # [T, N, obs]
+            next_obs = np.concatenate(
+                [obs[1:], ro["last_obs"][None]], axis=0)
+            flat = lambda a: a.reshape(T * N, *a.shape[2:])  # noqa: E731
+            self._buffer.add_batch(flat(obs), flat(ro["actions"]),
+                                   flat(ro["rewards"]), flat(next_obs),
+                                   flat(ro["dones"]))
+
+        metrics: Dict[str, Any] = {"env_steps": self._env_steps,
+                                   "buffer_size": len(self._buffer),
+                                   "epsilon": self._epsilon()}
+        if len(self._buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                batch = self._buffer.sample(cfg.train_batch_size, self._rng)
+                metrics.update(self.learner_group.update(batch))
+                self._updates += 1
+                if self._updates % cfg.target_update_freq == 0:
+                    self.learner_group.foreach_learner("sync_target")
+        # Ship annealed epsilon with the weights.
+        weights = self.learner_group.get_weights()
+        weights["epsilon"] = np.asarray(self._epsilon(), np.float32)
+        self._sync_weights(weights)
+        metrics["num_gradient_updates"] = self._updates
+        return metrics

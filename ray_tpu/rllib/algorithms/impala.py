@@ -1,0 +1,183 @@
+"""IMPALA — asynchronous actor-learner with V-trace off-policy correction.
+
+Reference: `rllib/algorithms/impala/impala.py:667` (training_step: async
+sampling + learner updates) and the V-trace returns of `impala/vtrace.py`.
+TPU-first shape: env runners sample continuously (futures resubmitted as
+they land, never a barrier), the learner consumes whatever rollouts are
+ready, and the staleness between behavior and target policy is exactly
+what the V-trace rho/c clipping corrects. The V-trace recursion is a
+`lax.scan` over reversed time inside the jitted update — no Python loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+
+
+def vtrace(behavior_logp, target_logp, rewards, dones, values,
+           bootstrap_value, gamma: float,
+           rho_bar: float = 1.0, c_bar: float = 1.0):
+    """V-trace targets (Espeholt et al. 2018, eqs. 1-2). All inputs
+    time-major [T, B]; returns (vs [T, B], pg_advantages [T, B])."""
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_bar)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_bar)
+    discounts = gamma * (1.0 - dones.astype(jnp.float32))
+
+    values_next = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = rho * (rewards + discounts * values_next - values)
+
+    def backwards(acc, t):
+        acc = deltas[t] + discounts[t] * c[t] * acc
+        return acc, acc
+
+    T = rewards.shape[0]
+    _, vs_minus_v = jax.lax.scan(
+        backwards, jnp.zeros_like(bootstrap_value),
+        jnp.arange(T - 1, -1, -1))
+    vs_minus_v = vs_minus_v[::-1]
+    vs = values + vs_minus_v
+
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rho * (rewards + discounts * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALALearner(Learner):
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+        ent_coeff = cfg.get("entropy_coeff", 0.01)
+
+        # Batch arrives batch-major [B, T, ...]: dim 0 is sharded over the
+        # mesh, so the network flattens (B*T) keeping the sharded dim
+        # major (a [T,B]->[T*B] merge would be an illegal sharded
+        # reshape); only the small per-step tensors transpose to
+        # time-major for the V-trace scan.
+        obs = batch["obs"]                                   # [B, T, obs]
+        actions = batch["actions"].astype(jnp.int32)         # [B, T]
+        B, T = actions.shape
+        out = self.module.forward_train(params, obs.reshape(B * T, -1))
+        logits = out["action_logits"].reshape(B, T, -1)
+        values_bt = out["vf"].reshape(B, T)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp_bt = jnp.take_along_axis(
+            logp_all, actions[..., None], axis=-1)[..., 0]
+
+        behavior_logp = batch["logp"].T                      # [T, B]
+        target_logp = target_logp_bt.T
+        rewards = batch["rewards"].T
+        dones = batch["dones"].T
+        values = values_bt.T
+        bootstrap = batch["bootstrap_value"]                 # [B]
+
+        vs, pg_adv = vtrace(behavior_logp, target_logp, rewards, dones,
+                            values, bootstrap, gamma,
+                            cfg.get("rho_bar", 1.0), cfg.get("c_bar", 1.0))
+
+        policy_loss = -(target_logp * pg_adv).mean()
+        vf_loss = 0.5 * ((values - vs) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return total, {
+            "policy_loss": policy_loss, "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": jnp.exp(target_logp - behavior_logp).mean(),
+        }
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.rollout_fragment_length = 32
+        self.num_rollouts_per_iteration = 8
+        # Rollouts concatenated per SGD step: the batch-major dim (total
+        # env lanes) must divide the learner mesh's device count.
+        self.num_rollouts_per_update = 2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rho_bar = 1.0
+        self.c_bar = 1.0
+
+    algo_class = property(lambda self: IMPALA)
+
+
+class IMPALA(Algorithm):
+    learner_class = IMPALALearner
+
+    def __init__(self, config: IMPALAConfig):
+        super().__init__(config)
+        if config.num_rollouts_per_update > config.num_rollouts_per_iteration:
+            raise ValueError(
+                "num_rollouts_per_update must be <= "
+                "num_rollouts_per_iteration or no update ever fires")
+        # Continuous sampling: one outstanding sample() per runner.
+        self._inflight: Dict[Any, Any] = {}
+        # Rollouts awaiting an SGD step; carried ACROSS iterations so a
+        # partial group is never dropped.
+        self._pending: List[Dict[str, np.ndarray]] = []
+        for runner in self.env_runners:
+            self._inflight[runner.sample.remote(
+                config.rollout_fragment_length)] = runner
+
+    def _learner_config(self) -> Dict[str, Any]:
+        out = super()._learner_config()
+        out.update(gamma=self.config.gamma,
+                   vf_loss_coeff=self.config.vf_loss_coeff,
+                   entropy_coeff=self.config.entropy_coeff,
+                   rho_bar=self.config.rho_bar,
+                   c_bar=self.config.c_bar)
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        metrics: Dict[str, Any] = {}
+        consumed = 0
+        pending = self._pending
+        while consumed < cfg.num_rollouts_per_iteration:
+            ready, _ = ray_tpu.wait(list(self._inflight),
+                                    num_returns=1, timeout=120)
+            if not ready:
+                raise TimeoutError("no rollout arrived within 120s")
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            rollout = ray_tpu.get(ref, timeout=60)
+            self._recent_returns.extend(rollout.pop("episode_returns"))
+            # Immediately resubmit — sampling never waits on learning.
+            self._inflight[runner.sample.remote(
+                cfg.rollout_fragment_length)] = runner
+
+            pending.append({
+                # [T, N, ...] -> batch-major [N, T, ...] for mesh sharding.
+                "obs": np.swapaxes(rollout["obs"], 0, 1),
+                "actions": np.swapaxes(rollout["actions"], 0, 1),
+                "logp": np.swapaxes(rollout["logp"], 0, 1),
+                "rewards": np.swapaxes(rollout["rewards"], 0, 1),
+                "dones": np.swapaxes(rollout["dones"], 0, 1),
+                "bootstrap_value": rollout["last_vf"],
+            })
+            consumed += 1
+            if len(pending) >= cfg.num_rollouts_per_update:
+                batch = {k: np.concatenate([p[k] for p in pending])
+                         for k in pending[0]}
+                pending.clear()
+                metrics.update(self.learner_group.update(batch))
+        # Weight sync once per iteration: the gap IS the off-policyness
+        # V-trace corrects.
+        self._sync_weights()
+        metrics["num_rollouts"] = consumed
+        return metrics
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()

@@ -49,11 +49,13 @@ class Learner:
             optax.adam(self.config.get("lr", 3e-4)),
         )
         opt_state = jax.device_put(self._optimizer.init(params), self._repl)
-        self._state = {"params": params, "opt_state": opt_state}
+        self._state = {"params": params, "opt_state": opt_state,
+                       **self.init_extra_state(params)}
 
         def _update(state, batch, rng):
             def loss_fn(p):
-                return self.compute_loss(p, batch, rng)
+                return self.compute_loss_from_state(
+                    {**state, "params": p}, batch, rng)
 
             (loss, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state["params"])
@@ -63,7 +65,8 @@ class Learner:
             metrics = dict(metrics)
             metrics["total_loss"] = loss
             metrics["grad_norm"] = optax.global_norm(grads)
-            return {"params": new_params, "opt_state": new_opt}, metrics
+            return {**state, "params": new_params,
+                    "opt_state": new_opt}, metrics
 
         self._update_fn = jax.jit(_update, donate_argnums=(0,))
 
@@ -71,6 +74,16 @@ class Learner:
     def compute_loss(self, params, batch: Dict[str, jax.Array],
                      rng: jax.Array) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         raise NotImplementedError
+
+    def compute_loss_from_state(self, state, batch, rng):
+        """Override when the loss needs learner state beyond params (e.g.
+        DQN's target network); default delegates to compute_loss."""
+        return self.compute_loss(state["params"], batch, rng)
+
+    def init_extra_state(self, params) -> Dict[str, Any]:
+        """Extra entries merged into the learner state pytree (carried
+        through jitted updates untouched)."""
+        return {}
 
     # ----------------------------------------------------------------- update
     def update(self, batch: Dict[str, np.ndarray],

@@ -43,6 +43,10 @@ def _learner_set_weights(w):
     return True
 
 
+def _learner_call(method, *args, **kwargs):
+    return getattr(_LEARNER, method)(*args, **kwargs)
+
+
 def _learner_get_state():
     return _LEARNER.get_state()
 
@@ -82,6 +86,11 @@ class LearnerGroup:
                 for i, w in enumerate(self._group.workers)]
         metrics = ray_tpu.get(refs, timeout=600)
         return metrics[0]
+
+    def foreach_learner(self, method: str, *args, **kwargs) -> List[Any]:
+        """Invoke a learner method on every learner (e.g. DQN
+        sync_target)."""
+        return self._group.execute(_learner_call, method, *args, **kwargs)
 
     # ---------------------------------------------------------------- weights
     def get_weights(self) -> Any:

@@ -94,5 +94,8 @@ class EnvRunner:
             "dones": np.stack(done_buf),
             "vf": np.stack(vf_buf),
             "last_vf": last_vf,
+            # Final observation per env lane: lets value-based algorithms
+            # (DQN) form next_obs for the last transition of the fragment.
+            "last_obs": self._obs.copy(),
             "episode_returns": completed,
         }
