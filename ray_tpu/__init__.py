@@ -48,6 +48,7 @@ def init(
     namespace: Optional[str] = None,
     ignore_reinit_error: bool = False,
     log_to_driver: bool = True,
+    include_dashboard: bool = False,
     _system_config: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Start (or connect to) a cluster and attach this process as the driver."""
@@ -65,7 +66,8 @@ def init(
             node = Node(head=True, num_cpus=num_cpus, num_tpus=num_tpus,
                         resources=resources, labels=labels,
                         object_store_memory=object_store_memory,
-                        system_config=_system_config)
+                        system_config=_system_config,
+                        include_dashboard=include_dashboard)
             _local_node = node
             gcs_addr = node.gcs_addr
             raylet_addr = node.raylet_addr

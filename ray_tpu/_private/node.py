@@ -90,6 +90,7 @@ class Node:
         session_dir: Optional[str] = None,
         fate_share: bool = True,
         gcs_port: int = 0,
+        include_dashboard: bool = False,
     ):
         self.head = head
         self.host = "127.0.0.1"
@@ -117,6 +118,16 @@ class Node:
             object_store_memory=object_store_memory)
         self.labels = labels or {}
         self.raylet_addr = self._start_raylet(object_store_memory)
+        self.dashboard_url: Optional[str] = None
+        if head and include_dashboard:
+            try:
+                self.dashboard_url = self._start_dashboard()
+            except Exception as e:
+                # Non-essential: a broken dashboard (missing aiohttp,
+                # port trouble) must not take the head node down.
+                sys.stderr.write(
+                    f"[node] dashboard failed to start ({e}); "
+                    "continuing without it\n")
         if fate_share:
             atexit.register(self.shutdown)
 
@@ -163,6 +174,22 @@ class Node:
         port = _read_port(proc, "RAYLET_PORT=")
         self._procs.append(proc)
         return (self.host, port)
+
+    def _start_dashboard(self) -> str:
+        log = open(os.path.join(self.session_dir, "logs",
+                                "dashboard.err"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.dashboard.head",
+             "--host", self.host, "--port", "0",
+             "--gcs-host", self.gcs_addr[0],
+             "--gcs-port", str(self.gcs_addr[1]),
+             "--fate-share-pid",
+             str(os.getpid() if self._fate_share else 0)],
+            stdout=subprocess.PIPE, stderr=log, env=self._daemon_env(),
+            start_new_session=True)
+        port = _read_port(proc, "DASHBOARD_PORT=")
+        self._procs.append(proc)
+        return f"http://{self.host}:{port}"
 
     # --------------------------------------------------------------- teardown
     def kill_raylet(self):

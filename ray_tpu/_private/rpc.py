@@ -260,6 +260,9 @@ class RpcClient:
         async with self._conn_lock:
             if self._writer is not None and not self._writer.is_closing():
                 return
+            # The loop that creates the connection owns it; close() must
+            # route the transport close back here.
+            self._owner_loop = asyncio.get_running_loop()
             deadline = time.monotonic() + self._connect_timeout
             delay = 0.05
             while True:
@@ -332,13 +335,25 @@ class RpcClient:
                 self._writer.close()
 
         try:
-            if threading.current_thread() is self._io._thread:
-                # Called from the loop thread itself (e.g. a GCS handler
-                # closing a worker client): blocking on _io.run here stalls
-                # the WHOLE event loop for the timeout — heartbeats stop
-                # and nodes get declared dead. Schedule and return.
+            owner = getattr(self, "_owner_loop", None)
+            try:
+                current = asyncio.get_running_loop()
+            except RuntimeError:
+                current = None
+            if owner is None:
+                return    # never connected; nothing to close
+            if current is owner:
+                # Closing from the owning loop (a GCS handler, a
+                # dashboard handler): blocking would stall the loop for
+                # the full timeout — heartbeats stop, nodes get declared
+                # dead. Schedule and return.
                 asyncio.ensure_future(_close())
             else:
-                self._io.run(_close(), timeout=2)
+                # Transports are loop-affine: hand the close to the loop
+                # that created the connection, without blocking if we are
+                # ourselves on some other loop.
+                fut = asyncio.run_coroutine_threadsafe(_close(), owner)
+                if current is None:
+                    fut.result(2)
         except Exception:
             pass

@@ -1,0 +1,229 @@
+"""Dashboard head — aiohttp REST + HTML over GCS/raylet state.
+
+Reference: `dashboard/head.py` (aiohttp head server), `dashboard/
+state_aggregator.py` (GCS+raylet aggregation), `modules/metrics` (the
+Prometheus endpoint). Runs as its own process per head node
+(`python -m ray_tpu.dashboard.head --gcs-host ... --gcs-port ...`);
+the URL is registered in the GCS KV under "dashboard_url" so clients
+and the CLI can find it.
+
+Endpoints:
+  GET /               minimal HTML page (auto-refreshing tables)
+  GET /api/cluster    resource totals/availability
+  GET /api/nodes      nodes + per-raylet stats (workers, store, OOM)
+  GET /api/actors     actor table
+  GET /api/jobs       job table
+  GET /api/tasks      recent task lifecycle events
+  GET /metrics        Prometheus text (scrape target)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+from typing import Any, Dict, List
+
+from aiohttp import web
+
+from ray_tpu._private.rpc import RpcClient
+
+_HTML = """<!DOCTYPE html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ table { border-collapse: collapse; margin-top: .5rem; }
+ th, td { border: 1px solid #ccc; padding: .3rem .6rem; font-size: .85rem; }
+ th { background: #f4f4f4; text-align: left; }
+ code { background: #f4f4f4; padding: 0 .2rem; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="cluster"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<script>
+function table(el, rows) {
+  // Build with createElement/textContent only: actor names, class names
+  // etc. are user-controlled strings; innerHTML would be stored XSS.
+  const t = document.getElementById(el);
+  t.replaceChildren();
+  if (!rows.length) {
+    const td = document.createElement("td");
+    td.textContent = "none";
+    t.appendChild(document.createElement("tr")).appendChild(td);
+    return;
+  }
+  const cols = Object.keys(rows[0]);
+  const hr = document.createElement("tr");
+  for (const c of cols) {
+    const th = document.createElement("th");
+    th.textContent = c;
+    hr.appendChild(th);
+  }
+  t.appendChild(hr);
+  for (const r of rows) {
+    const tr = document.createElement("tr");
+    for (const c of cols) {
+      const td = document.createElement("td");
+      td.textContent = JSON.stringify(r[c]);
+      tr.appendChild(td);
+    }
+    t.appendChild(tr);
+  }
+}
+async function refresh() {
+  const cl = await (await fetch("/api/cluster")).json();
+  document.getElementById("cluster").innerText =
+    "total: " + JSON.stringify(cl.total) +
+    "  available: " + JSON.stringify(cl.available);
+  table("nodes", await (await fetch("/api/nodes")).json());
+  table("actors", await (await fetch("/api/actors")).json());
+  table("jobs", await (await fetch("/api/jobs")).json());
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+class DashboardHead:
+    def __init__(self, gcs_host: str, gcs_port: int):
+        self._gcs = RpcClient(gcs_host, gcs_port)
+
+    # ------------------------------------------------------------ handlers
+    async def index(self, _req) -> web.Response:
+        return web.Response(text=_HTML, content_type="text/html")
+
+    async def cluster(self, _req) -> web.Response:
+        total = await self._gcs.acall("cluster_resources", timeout=10)
+        avail = await self._gcs.acall("available_resources", timeout=10)
+        return web.json_response({"total": total, "available": avail})
+
+    async def nodes(self, _req) -> web.Response:
+        nodes = await self._gcs.acall("get_all_nodes", timeout=10)
+        out: List[Dict[str, Any]] = []
+        for n in nodes:
+            row = {
+                "node_id": n["node_id"].hex()[:12],
+                "state": n["state"],
+                "addr": f"{n['addr'][0]}:{n['addr'][1]}",
+                "total": n.get("total", {}),
+                "available": n.get("available", {}),
+            }
+            if n["state"] == "ALIVE":
+                client = RpcClient(*tuple(n["addr"]))
+                try:
+                    st = await client.acall("node_stats", timeout=5)
+                    row.update(workers=st.get("num_workers"),
+                               oom_kills=st.get("oom_kills"),
+                               store=st.get("store", {}))
+                except Exception as e:
+                    row["stats_error"] = str(e)
+                finally:
+                    client.close()
+            out.append(row)
+        return web.json_response(out)
+
+    async def actors(self, _req) -> web.Response:
+        actors = await self._gcs.acall("list_actors", timeout=10)
+        out = []
+        for a in actors or []:
+            if a is None:
+                continue
+            aid = a.get("actor_id")
+            out.append({
+                "actor_id": aid.hex()[:12] if isinstance(aid, bytes)
+                else str(aid),
+                "class": a.get("class_name", ""),
+                "state": a.get("state", ""),
+                "name": a.get("name") or "",
+                "restarts": a.get("restarts_used", 0),
+            })
+        return web.json_response(out)
+
+    async def jobs(self, _req) -> web.Response:
+        jobs = await self._gcs.acall("list_jobs", timeout=10)
+        out = []
+        for j in jobs or []:
+            jid = j.get("job_id")
+            out.append({
+                "job_id": jid.hex() if isinstance(jid, bytes) else str(jid),
+                "state": j.get("state", ""),
+                "namespace": (j.get("metadata") or {}).get("namespace", ""),
+            })
+        return web.json_response(out)
+
+    async def tasks(self, req) -> web.Response:
+        limit = int(req.query.get("limit", 200))
+        events = await self._gcs.acall("get_task_events", limit=limit,
+                                       timeout=10)
+        safe = []
+        for e in events or []:
+            safe.append({k: (v.hex() if isinstance(v, bytes) else v)
+                         for k, v in e.items()})
+        return web.json_response(safe)
+
+    async def metrics(self, _req) -> web.Response:
+        text = await self._gcs.acall("metrics_text", timeout=10)
+        return web.Response(text=text, content_type="text/plain")
+
+    # --------------------------------------------------------------- serve
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/", self.index)
+        app.router.add_get("/api/cluster", self.cluster)
+        app.router.add_get("/api/nodes", self.nodes)
+        app.router.add_get("/api/actors", self.actors)
+        app.router.add_get("/api/jobs", self.jobs)
+        app.router.add_get("/api/tasks", self.tasks)
+        app.router.add_get("/metrics", self.metrics)
+        return app
+
+
+async def _serve(head: DashboardHead, host: str, port: int) -> int:
+    runner = web.AppRunner(head.build_app())
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    port = runner.addresses[0][1]
+    # Register for discovery (CLI / clients read this KV key). Same event
+    # loop as every other GCS call — RpcClient connections are loop-bound.
+    try:
+        await head._gcs.acall(
+            "kv_put", namespace="dashboard", key="dashboard_url",
+            value=f"http://{host}:{port}".encode(), timeout=10)
+    except Exception:
+        pass
+    return port
+
+
+def main() -> None:
+    import os
+    import sys
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--fate-share-pid", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.fate_share_pid:
+        from ray_tpu._private.fate_share import watch_parent
+
+        watch_parent(args.fate_share_pid)
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    head = DashboardHead(args.gcs_host, args.gcs_port)
+    port = loop.run_until_complete(_serve(head, args.host, args.port))
+    print(f"DASHBOARD_PORT={port}", flush=True)
+    sys.stdout.flush()
+    loop.run_forever()
+
+
+if __name__ == "__main__":
+    main()

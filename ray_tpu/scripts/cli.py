@@ -64,10 +64,14 @@ def cmd_start(args) -> None:
     if args.head:
         node = Node(head=True, num_cpus=args.num_cpus,
                     num_tpus=args.num_tpus, fate_share=False,
-                    gcs_port=args.port or 0)
+                    gcs_port=args.port or 0,
+                    include_dashboard=not getattr(
+                        args, "no_dashboard", False))
         addr = "%s:%d" % node.gcs_addr
         print(f"started head node; cluster address: {addr}")
         print(f"session dir: {node.session_dir}")
+        if node.dashboard_url:
+            print(f"dashboard: {node.dashboard_url}")
         print(f"  export RAY_TPU_ADDRESS={addr}")
     else:
         addr = _address(args)
@@ -181,6 +185,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--num-cpus", type=int, default=None)
     p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--no-dashboard", action="store_true",
+                   help="skip starting the dashboard head")
     p.add_argument("--block", action="store_true",
                    help="stay attached; Ctrl-C stops the node")
     p.set_defaults(fn=cmd_start)

@@ -17,13 +17,37 @@ from typing import Any, Dict, Optional
 
 
 class Checkpoint:
-    def __init__(self, path: str):
-        self.path = os.path.abspath(path)
+    def __init__(self, path: Optional[str] = None, *,
+                 uri: Optional[str] = None, filesystem=None):
+        if path is None and uri is None:
+            raise ValueError("Checkpoint needs a path or a uri")
+        self._local_path = os.path.abspath(path) if path else None
+        self.uri = uri
+        self._fs = filesystem
+
+    @property
+    def path(self) -> str:
+        """Local directory (lazily downloaded from storage when this
+        checkpoint lives on a remote pyarrow filesystem)."""
+        if self._local_path is None:
+            from ray_tpu.train.storage import download_dir, resolve
+
+            fs, fs_path = resolve(self.uri, self._fs)
+            local = tempfile.mkdtemp(prefix="rtpu-ckpt-dl-")
+            download_dir(fs, fs_path, local)
+            self._local_path = local
+        return self._local_path
 
     # -- construction -------------------------------------------------------
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path)
+
+    @classmethod
+    def from_uri(cls, uri: str, filesystem=None) -> "Checkpoint":
+        """A checkpoint stored on a (possibly remote) pyarrow filesystem
+        (reference: `Checkpoint.from_uri`)."""
+        return cls(uri=uri, filesystem=filesystem)
 
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
@@ -86,5 +110,16 @@ class Checkpoint:
             shutil.copytree(self.path, dest, dirs_exist_ok=True)
         return Checkpoint(dest)
 
+    def to_uri(self, uri: str, filesystem=None) -> "Checkpoint":
+        """Upload into storage; returns the storage-backed checkpoint."""
+        from ray_tpu.train.storage import StorageContext
+
+        storage = StorageContext(uri, filesystem=filesystem)
+        storage.makedirs()
+        storage.upload_dir(self.path, "")
+        return Checkpoint(uri=uri, filesystem=filesystem)
+
     def __repr__(self):
-        return f"Checkpoint({self.path})"
+        if self._local_path is None:
+            return f"Checkpoint(uri={self.uri})"
+        return f"Checkpoint({self._local_path})"

@@ -48,12 +48,31 @@ class CheckpointConfig:
 @dataclass
 class RunConfig:
     name: Optional[str] = None
+    # Local path OR a pyarrow-fs URI (s3://, gs://, file://); with a URI
+    # (or an explicit storage_filesystem) the run stages locally and syncs
+    # checkpoints to storage (reference: train/_internal/storage.py).
     storage_path: Optional[str] = None
+    storage_filesystem: Optional[Any] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
 
+    def is_remote_storage(self) -> bool:
+        from ray_tpu.train.storage import is_uri
+
+        return self.storage_filesystem is not None or is_uri(
+            self.storage_path)
+
     def resolved_storage_path(self) -> str:
+        """LOCAL working root: remote storage stages under a local dir
+        and syncs up per checkpoint."""
+        if self.is_remote_storage():
+            import hashlib
+
+            digest = hashlib.md5(
+                str(self.storage_path).encode()).hexdigest()[:10]
+            return os.path.join(os.path.expanduser("~/ray_tpu_staging"),
+                                digest)
         return self.storage_path or os.path.expanduser("~/ray_tpu_results")
 
 

@@ -64,12 +64,40 @@ class DataParallelTrainer:
         result = tuner.fit()[0]
         if result.error:
             raise TrainingFailedError(str(result.error))
+        if (run_config.is_remote_storage() and result.checkpoint is not None
+                and result.checkpoint.uri is None):
+            # Tune rebuilds results from trial state with bare local
+            # paths; rehydrate the storage URI from the mirrored layout.
+            from ray_tpu.train.storage import StorageContext
+
+            staging_root = run_config.resolved_storage_path()
+            rel = os.path.relpath(result.checkpoint.path, staging_root)
+            storage = StorageContext(
+                run_config.storage_path or "",
+                filesystem=run_config.storage_filesystem)
+            result.checkpoint.uri = storage.uri_for(*rel.split(os.sep))
+            result.checkpoint._fs = storage.fs
         return result
 
     def _run_training(self, experiment_dir: str,
                       on_report=None) -> Result:
         """The training orchestration loop (runs inside the trial)."""
         os.makedirs(experiment_dir, exist_ok=True)
+        # Remote storage: checkpoints stage locally under experiment_dir
+        # and sync to the pyarrow filesystem after each report.
+        self._storage = None
+        if self._run_config.is_remote_storage():
+            from ray_tpu.train.storage import StorageContext
+
+            # Mirror the local staging layout (<name>/<trial>/...) so a
+            # checkpoint's URI is derivable from its staging path.
+            rel = os.path.relpath(
+                experiment_dir, self._run_config.resolved_storage_path())
+            self._storage = StorageContext(
+                self._run_config.storage_path or "",
+                "/".join(rel.split(os.sep)) if rel != "." else "",
+                filesystem=self._run_config.storage_filesystem)
+            self._storage.makedirs()
 
         executor = BackendExecutor(self._backend_config, self._scaling,
                                    self._run_config, experiment_dir)
@@ -104,8 +132,15 @@ class DataParallelTrainer:
 
         if history:
             last_metrics = history[-1]
-        latest = Checkpoint(checkpoints[-1][1]) if checkpoints else (
-            Checkpoint(latest_ckpt_path) if latest_ckpt_path else None)
+        latest = None
+        if checkpoints:
+            local = checkpoints[-1][1]
+            latest = Checkpoint(local)
+            if self._storage is not None:
+                latest.uri = self._storage.uri_for(os.path.basename(local))
+                latest._fs = self._storage.fs
+        elif latest_ckpt_path:
+            latest = Checkpoint(latest_ckpt_path)
         if error is not None:
             raise TrainingFailedError(
                 f"training failed after {failures} failure(s); "
@@ -146,6 +181,9 @@ class DataParallelTrainer:
                             ckpt_cfg.checkpoint_score_attribute)
                     checkpoints.append((score, ckpt_path))
                     new_ckpt = ckpt_path
+                    if self._storage is not None:
+                        self._storage.upload_dir(
+                            ckpt_path, os.path.basename(ckpt_path))
             # Report before retention: score-based keep-k may evict the
             # checkpoint that was just created, and the consumer must never
             # receive an already-deleted path.
@@ -176,6 +214,8 @@ class DataParallelTrainer:
                 # references it.
                 if all(path != item[1] for _, path in checkpoints):
                     shutil.rmtree(item[1], ignore_errors=True)
+                    if getattr(self, "_storage", None) is not None:
+                        self._storage.delete(os.path.basename(item[1]))
 
     def _shard_datasets(self, executor: BackendExecutor) -> Dict[str, Any]:
         """Split datasets across workers via streaming_split (Train<->Data

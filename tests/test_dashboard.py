@@ -1,0 +1,85 @@
+"""Dashboard head (reference: `dashboard/head.py` + state_aggregator)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def dash_cluster():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024,
+                        include_dashboard=True,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=15) as resp:
+        body = resp.read()
+        return resp.status, resp.headers.get_content_type(), body
+
+
+def _dashboard_url() -> str:
+    import ray_tpu
+    from ray_tpu import _local_node
+
+    assert _local_node is not None and _local_node.dashboard_url
+    return _local_node.dashboard_url
+
+
+def test_dashboard_endpoints(dash_cluster):
+    import ray_tpu
+
+    # Some cluster activity to observe.
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    actor = Pinger.options(name="dash_pinger").remote()
+    assert ray_tpu.get(actor.ping.remote(), timeout=60) == "pong"
+
+    base = _dashboard_url()
+
+    status, ctype, body = _get(base + "/")
+    assert status == 200 and ctype == "text/html"
+    assert b"ray_tpu dashboard" in body
+
+    status, _, body = _get(base + "/api/cluster")
+    cluster = json.loads(body)
+    assert cluster["total"].get("CPU") == 4.0
+
+    status, _, body = _get(base + "/api/nodes")
+    nodes = json.loads(body)
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+    assert nodes[0]["workers"] >= 1
+
+    status, _, body = _get(base + "/api/actors")
+    actors = json.loads(body)
+    assert any(a["class"].endswith("Pinger") for a in actors), actors
+
+    status, _, body = _get(base + "/api/jobs")
+    assert json.loads(body), "driver job missing"
+
+    status, ctype, body = _get(base + "/metrics")
+    assert ctype == "text/plain"
+
+    ray_tpu.kill(actor)
+
+
+def test_dashboard_url_registered_in_kv(dash_cluster):
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    url = w.gcs.call("kv_get", namespace="dashboard", key="dashboard_url",
+                     timeout=10)
+    assert url is not None
+    assert url.decode().startswith("http://")
+    assert url.decode() == _dashboard_url()

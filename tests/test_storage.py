@@ -1,0 +1,112 @@
+"""pyarrow-fs checkpoint storage (reference: train/_internal/storage.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _mock_fs():
+    import pyarrow.fs as pafs
+
+    return pafs._MockFileSystem()
+
+
+class TestStorageContext:
+    def test_upload_download_roundtrip(self, tmp_path):
+        from ray_tpu.train.storage import StorageContext, download_dir
+
+        src = tmp_path / "src"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.txt").write_text("alpha")
+        (src / "sub" / "b.bin").write_bytes(b"\x00\x01\x02")
+
+        fs = _mock_fs()
+        storage = StorageContext("exp", "trial1", filesystem=fs)
+        storage.makedirs()
+        storage.upload_dir(str(src), "ckpt_0")
+        assert storage.exists("ckpt_0")
+        assert storage.exists("ckpt_0/a.txt")
+
+        dest = tmp_path / "dest"
+        download_dir(fs, storage.join("ckpt_0"), str(dest))
+        assert (dest / "a.txt").read_text() == "alpha"
+        assert (dest / "sub" / "b.bin").read_bytes() == b"\x00\x01\x02"
+
+        storage.delete("ckpt_0")
+        assert not storage.exists("ckpt_0")
+
+    def test_local_uri(self, tmp_path):
+        from ray_tpu.train.storage import StorageContext
+
+        src = tmp_path / "data"
+        src.mkdir()
+        (src / "x").write_text("1")
+        storage = StorageContext(f"file://{tmp_path}/store", "run")
+        storage.makedirs()
+        storage.upload_dir(str(src), "c")
+        assert (tmp_path / "store" / "run" / "c" / "x").read_text() == "1"
+
+
+class TestCheckpointUri:
+    def test_pytree_roundtrip_through_mock_fs(self, tmp_path):
+        import jax.numpy as jnp
+
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        fs = _mock_fs()
+        tree = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.asarray(7)}
+        ckpt = Checkpoint.from_pytree(tree)
+        remote = ckpt.to_uri("bucket/ckpts/c1", filesystem=fs)
+        assert remote.uri == "bucket/ckpts/c1"
+
+        # Fresh object: downloads lazily on first .path access.
+        back = Checkpoint.from_uri("bucket/ckpts/c1", filesystem=fs)
+        restored = back.to_pytree()
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(6.0).reshape(2, 3))
+        assert int(restored["step"]) == 7
+
+
+def test_trainer_syncs_checkpoints_to_storage(tmp_path):
+    """JaxTrainer with URI storage: every reported checkpoint syncs to
+    the pyarrow filesystem; Result.checkpoint restores from the URI.
+    (file:// here — mock fs is not picklable across trial actors;
+    real object-store filesystems are.)"""
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.train import Checkpoint, JaxTrainer
+    from ray_tpu.train.config import (
+        CheckpointConfig, RunConfig, ScalingConfig,
+    )
+    from ray_tpu.train.jax_backend import JaxConfig
+
+    def loop(config):
+        for step in range(3):
+            ckpt = Checkpoint.from_dict({"step": step})
+            train.report({"loss": 1.0 / (step + 1)}, checkpoint=ckpt)
+
+    ray_tpu.init(num_cpus=4, num_tpus=0,
+                 object_store_memory=128 * 1024 * 1024,
+                 ignore_reinit_error=True)
+    try:
+        trainer = JaxTrainer(
+            loop,
+            jax_config=JaxConfig(platform="cpu"),
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="storage_e2e",
+                storage_path=f"file://{tmp_path}/bucket",
+                checkpoint_config=CheckpointConfig(num_to_keep=2)))
+        result = trainer.fit()
+        assert result.checkpoint is not None
+        assert result.checkpoint.uri is not None
+        # Restore through the URI only (fresh download path).
+        restored = Checkpoint.from_uri(result.checkpoint.uri)
+        assert restored.to_dict()["step"] == 2
+        # num_to_keep=2 held remotely too: exactly 2 checkpoint dirs.
+        bucket = tmp_path / "bucket"
+        trial_dirs = list(bucket.rglob("checkpoint_*"))
+        assert len({d.name for d in trial_dirs}) == 2, trial_dirs
+    finally:
+        ray_tpu.shutdown()
