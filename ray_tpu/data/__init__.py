@@ -16,8 +16,10 @@ from ray_tpu.data._internal import plan as _plan
 from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.dataset import Dataset, MaterializedDataset
 from ray_tpu.data.datasource import (
-    BinaryDatasource, CSVDatasource, Datasource, ItemsDatasource,
-    NumpyDatasource, ParquetDatasource, RangeDatasource, TextDatasource, JSONDatasource,
+    BinaryDatasource, CSVDatasink, CSVDatasource, Datasink, Datasource,
+    ImageDatasource, ItemsDatasource, JSONDatasink, JSONDatasource,
+    NumpyDatasource, ParquetDatasink, ParquetDatasource, RangeDatasource,
+    TextDatasource,
 )
 from ray_tpu.data.iterator import DataIterator
 
@@ -70,6 +72,40 @@ def read_text(paths, **_ignored) -> Dataset:
     return _read(TextDatasource(paths))
 
 
+def read_images(paths, *, size=None, mode="RGB", **_ignored) -> Dataset:
+    """Image directory/files -> rows with a dense "image" tensor column
+    (reference: `read_api.py` read_images). `size=(H, W)` resizes for the
+    static shapes a TPU input pipeline needs."""
+    return _read(ImageDatasource(paths, size=size, mode=mode))
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """Zero-copy-ish ingest of a `datasets.Dataset` (reference:
+    `read_api.py` from_huggingface): its arrow table becomes blocks."""
+    if getattr(hf_dataset, "_indices", None) is not None:
+        # Row selection/order (select/shuffle/train_test_split) lives in
+        # the indices mapping, not the underlying table.
+        hf_dataset = hf_dataset.flatten_indices()
+    table = hf_dataset.data.table.combine_chunks()
+    return MaterializedDataset.from_blocks([table])
+
+
+def from_torch(torch_dataset) -> Dataset:
+    """Materialize a torch Dataset as rows under an "item" column
+    (reference: `read_api.py` from_torch). Map-style datasets index
+    through __len__ (bare iteration never terminates unless __getitem__
+    raises IndexError); iterable-style datasets just iterate."""
+    import builtins
+
+    if hasattr(torch_dataset, "__len__"):
+        # builtins.range: this module's own range() API shadows it.
+        items = [torch_dataset[i]
+                 for i in builtins.range(len(torch_dataset))]
+    else:
+        items = list(torch_dataset)
+    return from_items([{"item": x} for x in items])
+
+
 def read_binary_files(paths, **_ignored) -> Dataset:
     return _read(BinaryDatasource(paths))
 
@@ -78,5 +114,7 @@ __all__ = [
     "Block", "BlockAccessor", "DataIterator", "Dataset",
     "MaterializedDataset", "Datasource", "range", "from_items",
     "from_numpy", "from_pandas", "from_arrow", "read_parquet", "read_csv",
-    "read_json", "read_text", "read_binary_files",
+    "read_json", "read_text", "read_binary_files", "read_images",
+    "from_huggingface", "from_torch", "Datasink", "ParquetDatasink",
+    "CSVDatasink", "JSONDatasink",
 ]

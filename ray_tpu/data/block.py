@@ -62,8 +62,13 @@ class BlockAccessor:
             for k, v in batch.items():
                 v = np.asarray(v)
                 if v.ndim > 1:
-                    # Tensor column: arrow list-of-list via nested lists.
-                    arrays[k] = pa.array(list(v))
+                    # Tensor column: dense fixed-shape tensors (images,
+                    # embeddings); nested lists only for object dtypes.
+                    try:
+                        arrays[k] = pa.FixedShapeTensorArray\
+                            .from_numpy_ndarray(np.ascontiguousarray(v))
+                    except (ValueError, pa.ArrowInvalid, TypeError):
+                        arrays[k] = pa.array(v.tolist())
                 else:
                     arrays[k] = pa.array(v)
             return pa.table(arrays)
@@ -86,6 +91,14 @@ class BlockAccessor:
             out: Dict[str, np.ndarray] = {}
             for name in self._t.column_names:
                 col = self._t.column(name)
+                if isinstance(col.type, getattr(pa, "FixedShapeTensorType",
+                                                ())):
+                    # Tensor column (e.g. images): dense ndarray, not
+                    # object-of-lists.
+                    arr = (col.combine_chunks()
+                           if isinstance(col, pa.ChunkedArray) else col)
+                    out[name] = arr.to_numpy_ndarray()
+                    continue
                 try:
                     out[name] = col.to_numpy(zero_copy_only=False)
                 except (pa.ArrowInvalid, ValueError):

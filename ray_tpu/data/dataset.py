@@ -165,44 +165,43 @@ class Dataset:
         return parts
 
     # -------------------------------------------------------------- writing
-    def _write_files(self, path: str, ext: str, write_one) -> List[str]:
-        import os
-
-        os.makedirs(path, exist_ok=True)
-        out = []
-        for i, block in enumerate(self._stream()):
-            if not block.num_rows:
-                continue
-            dest = os.path.join(path, f"block-{i:06d}.{ext}")
-            write_one(block, dest)
-            out.append(dest)
-        return out
+    def write_datasink(self, sink) -> List[Any]:
+        """Write every block through a Datasink plugin (reference:
+        `datasource/datasink.py`); blocks write in parallel tasks when a
+        cluster is up."""
+        sink.prepare()
+        if _cluster_available():
+            refs = [_write_block_task.remote(sink, block, i)
+                    for i, block in enumerate(self._stream())
+                    if block.num_rows]
+            return ray_tpu.get(refs, timeout=600)
+        return [sink.write_block(block, i)
+                for i, block in enumerate(self._stream())
+                if block.num_rows]
 
     def write_parquet(self, path: str) -> List[str]:
-        import pyarrow.parquet as pq
+        from ray_tpu.data.datasource import ParquetDatasink
 
-        return self._write_files(path, "parquet",
-                                 lambda b, d: pq.write_table(b, d))
+        return self.write_datasink(ParquetDatasink(path))
 
     def write_csv(self, path: str) -> List[str]:
-        from pyarrow import csv as pacsv
+        from ray_tpu.data.datasource import CSVDatasink
 
-        return self._write_files(path, "csv",
-                                 lambda b, d: pacsv.write_csv(b, d))
+        return self.write_datasink(CSVDatasink(path))
 
     def write_json(self, path: str) -> List[str]:
-        import json
+        from ray_tpu.data.datasource import JSONDatasink
 
-        def _write(block, dest):
-            with open(dest, "w") as f:
-                for row in BlockAccessor(block).rows():
-                    f.write(json.dumps(row, default=str) + "\n")
-
-        return self._write_files(path, "json", _write)
+        return self.write_datasink(JSONDatasink(path))
 
     # ---------------------------------------------------------------- misc
     def __repr__(self) -> str:  # pragma: no cover
         return self.stats()
+
+
+@ray_tpu.remote
+def _write_block_task(sink, block, idx):
+    return sink.write_block(block, idx)
 
 
 class GroupedData:

@@ -128,6 +128,46 @@ class JSONDatasource(_FileDatasource):
         yield pajson.read_json(path)
 
 
+class ImageDatasource(_FileDatasource):
+    """Image files -> {"image": fixed-shape tensor, "path"} rows
+    (reference: `datasource/image_datasource.py`). `size=(H, W)` resizes
+    so a directory of mixed sizes yields one uniform tensor column —
+    what a TPU input pipeline needs for static shapes."""
+
+    _EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def __init__(self, paths: Any, size=None, mode: str = "RGB"):
+        super().__init__(paths)
+        self._paths = [p for p in self._paths
+                       if p.lower().endswith(self._EXTS)]
+        self._size = size
+        self._mode = mode
+
+    def _read_file(self, path: str):
+        import pyarrow as pa
+        from PIL import Image
+
+        img = Image.open(path)
+        if self._mode:
+            img = img.convert(self._mode)
+        if self._size is not None:
+            h, w = self._size
+            img = img.resize((w, h))
+        arr = np.asarray(img)
+        if self._size is not None:
+            # Dense fixed-shape tensor column (np.stack, not arr[None]:
+            # a size-1 view axis gets stride 0, which
+            # FixedShapeTensorArray rejects).
+            tensor = pa.FixedShapeTensorArray.from_numpy_ndarray(
+                np.stack([arr]))
+        else:
+            # Without a target size images may differ per file; a
+            # fixed-shape type per block would fail to concatenate.
+            # Nested lists unify across blocks (ragged column).
+            tensor = pa.array([arr.tolist()])
+        yield pa.table({"image": tensor, "path": pa.array([path])})
+
+
 class TextDatasource(_FileDatasource):
     def _read_file(self, path: str):
         with open(path, "r", encoding="utf-8") as f:
@@ -166,3 +206,58 @@ class NumpyDatasource(Datasource):
                 return read
             tasks.append(make())
         return tasks
+
+
+# ---------------------------------------------------------------------------
+# Datasinks (reference: `data/datasource/datasink.py` — write plugin model)
+# ---------------------------------------------------------------------------
+
+class Datasink:
+    """Writes one block per invocation; `Dataset.write_datasink` fans the
+    blocks out as tasks when a cluster is up."""
+
+    def prepare(self) -> None:
+        """Called once driver-side before any write."""
+
+    def write_block(self, block, idx: int) -> Any:
+        raise NotImplementedError
+
+
+class _FileDatasink(Datasink):
+    def __init__(self, path: str):
+        self._path = os.fspath(path)
+
+    def prepare(self) -> None:
+        os.makedirs(self._path, exist_ok=True)
+
+    def _dest(self, idx: int, ext: str) -> str:
+        return os.path.join(self._path, f"block-{idx:06d}.{ext}")
+
+
+class ParquetDatasink(_FileDatasink):
+    def write_block(self, block, idx: int) -> str:
+        import pyarrow.parquet as pq
+
+        dest = self._dest(idx, "parquet")
+        pq.write_table(block, dest)
+        return dest
+
+
+class CSVDatasink(_FileDatasink):
+    def write_block(self, block, idx: int) -> str:
+        from pyarrow import csv as pacsv
+
+        dest = self._dest(idx, "csv")
+        pacsv.write_csv(block, dest)
+        return dest
+
+
+class JSONDatasink(_FileDatasink):
+    def write_block(self, block, idx: int) -> str:
+        import json
+
+        dest = self._dest(idx, "json")
+        with open(dest, "w") as f:
+            for row in BlockAccessor(block).rows():
+                f.write(json.dumps(row, default=str) + "\n")
+        return dest

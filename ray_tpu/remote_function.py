@@ -47,8 +47,18 @@ class RemoteFunction:
     def _ensure_exported(self, worker) -> str:
         if self._pickled is None:
             self._pickled = cloudpickle.dumps(self._function)
-        if self._fn_hash is None:
+        # Re-export per driver session: a module-level @remote function
+        # outlives init()/shutdown() cycles, and a cached hash from a
+        # previous cluster's GCS function table is unknown to the next
+        # one ("function ... not found in the GCS function table").
+        # Keyed on the worker's random id — job ids restart at 1 per
+        # cluster so they collide across sessions, and id(worker) could
+        # be recycled after GC.
+        token = worker.worker_id
+        if self._fn_hash is None or getattr(self, "_export_token",
+                                            None) != token:
             self._fn_hash = worker.export_function(self._pickled)
+            self._export_token = token
         return self._fn_hash
 
     def remote(self, *args, **kwargs):
@@ -72,6 +82,7 @@ class RemoteFunction:
         clone = RemoteFunction(self._function, merged)
         clone._pickled = self._pickled
         clone._fn_hash = self._fn_hash
+        clone._export_token = getattr(self, "_export_token", None)
         return clone
 
     def bind(self, *args, **kwargs):

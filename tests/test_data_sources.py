@@ -1,0 +1,139 @@
+"""New datasources/sinks: images, huggingface, torch, Datasink plugin
+(reference: `data/datasource/` + `read_api.py`)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def data_cluster():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _write_images(root, n=4, size=(12, 10)):
+    from PIL import Image
+
+    root.mkdir(parents=True, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        arr = rng.randint(0, 255, (*size, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(root / f"img_{i}.png")
+
+
+def test_read_images(tmp_path, data_cluster):
+    import ray_tpu.data as rd
+
+    _write_images(tmp_path / "imgs", n=4, size=(12, 10))
+    ds = rd.read_images(str(tmp_path / "imgs"), size=(8, 8))
+    assert ds.count() == 4
+    batch = next(iter(ds.iter_batches(batch_size=4)))
+    assert batch["image"].shape == (4, 8, 8, 3)
+    assert batch["image"].dtype == np.uint8
+    assert all(p.endswith(".png") for p in batch["path"])
+
+
+def test_from_huggingface(data_cluster):
+    import datasets
+
+    import ray_tpu.data as rd
+
+    hf = datasets.Dataset.from_dict(
+        {"text": [f"doc {i}" for i in range(10)], "label": list(range(10))})
+    ds = rd.from_huggingface(hf)
+    assert ds.count() == 10
+    rows = ds.take_all()
+    assert rows[3] == {"text": "doc 3", "label": 3}
+    # Pipelines compose on top.
+    assert ds.filter(lambda r: r["label"] % 2 == 0).count() == 5
+
+
+def test_read_images_ragged_without_size(tmp_path, data_cluster):
+    """Mixed-size dirs without size= yield a ragged (nested-list) column
+    instead of crashing on incompatible tensor types."""
+    from PIL import Image
+
+    root = tmp_path / "mixed"
+    root.mkdir()
+    rng = np.random.RandomState(0)
+    for i, hw in enumerate([(8, 8), (6, 10)]):
+        arr = rng.randint(0, 255, (*hw, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(root / f"i{i}.png")
+    import ray_tpu.data as rd
+
+    rows = rd.read_images(str(root)).take_all()
+    shapes = sorted(np.asarray(r["image"]).shape for r in rows)
+    assert shapes == [(6, 10, 3), (8, 8, 3)]
+
+
+def test_from_huggingface_respects_indices(data_cluster):
+    """select/shuffle live in the HF indices mapping — must be honored."""
+    import datasets
+
+    import ray_tpu.data as rd
+
+    hf = datasets.Dataset.from_dict({"x": list(range(10))})
+    sel = rd.from_huggingface(hf.select([2, 5]))
+    assert [r["x"] for r in sel.take_all()] == [2, 5]
+    shuffled = rd.from_huggingface(hf.shuffle(seed=0))
+    vals = [r["x"] for r in shuffled.take_all()]
+    assert sorted(vals) == list(range(10)) and vals != list(range(10))
+
+
+def test_from_torch(data_cluster):
+    import torch.utils.data as tud
+
+    import ray_tpu.data as rd
+
+    class Squares(tud.Dataset):
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return i * i
+
+    ds = rd.from_torch(Squares())
+    assert [r["item"] for r in ds.take_all()] == [0, 1, 4, 9, 16, 25]
+
+
+def test_custom_datasink_runs_as_tasks(tmp_path, data_cluster):
+    import os
+
+    import ray_tpu.data as rd
+    from ray_tpu.data import Datasink
+
+    class PidMarkerSink(Datasink):
+        def __init__(self, path):
+            self._path = str(path)
+
+        def prepare(self):
+            os.makedirs(self._path, exist_ok=True)
+
+        def write_block(self, block, idx):
+            dest = os.path.join(self._path, f"part-{idx}.txt")
+            with open(dest, "w") as f:
+                f.write(f"{os.getpid()}:{block.num_rows}\n")
+            return dest
+
+    out = rd.range(40).repartition(4).write_datasink(
+        PidMarkerSink(tmp_path / "sink"))
+    assert len(out) == 4
+    rows = sum(int(open(p).read().split(":")[1]) for p in out)
+    assert rows == 40
+    # Ran in worker processes, not the driver.
+    pids = {int(open(p).read().split(":")[0]) for p in out}
+    assert os.getpid() not in pids
+
+
+def test_write_read_parquet_via_sink(tmp_path, data_cluster):
+    import ray_tpu.data as rd
+
+    paths = rd.range(25).write_parquet(str(tmp_path / "pq"))
+    assert paths
+    back = rd.read_parquet(sorted(paths))
+    assert sorted(r["id"] for r in back.take_all()) == list(range(25))
