@@ -62,6 +62,16 @@ def init(
         from ray_tpu._private.node import Node
         from ray_tpu._private.rpc import RpcClient
 
+        if address is not None and address.startswith("ray_tpu://"):
+            # Thin-client mode (reference "ray://"): every API call
+            # proxies to a server-side driver; no local daemons.
+            from ray_tpu.client.worker import ClientWorker
+
+            host, port = address[len("ray_tpu://"):].rsplit(":", 1)
+            client_worker = ClientWorker(host, int(port))
+            set_global_worker(client_worker)
+            return {"client": True, "address": address}
+
         if address is None:
             node = Node(head=True, num_cpus=num_cpus, num_tpus=num_tpus,
                         resources=resources, labels=labels,
@@ -176,6 +186,13 @@ def shutdown() -> None:
         if _log_listener_stop is not None:
             _log_listener_stop.set()
         w = global_worker_or_none()
+        from ray_tpu.client.worker import ClientWorker
+
+        if isinstance(w, ClientWorker):
+            # Thin client: disconnect only — the cluster lives on.
+            w.shutdown()
+            set_global_worker(None)
+            return
         if w is not None:
             try:
                 w.gcs.call("mark_job_finished", job_id=w.job_id.binary(),

@@ -1,0 +1,18 @@
+"""ray_tpu.client — remote driver proxy ("ray://" equivalent).
+
+Reference: `python/ray/util/client/` — a proxy server runs a driver
+inside the cluster; thin clients connect over RPC and the whole public
+API (tasks, actors, get/put/wait) executes server-side. The TPU shape of
+this matters: a laptop client drives a TPU-pod cluster without being in
+the pod's network fabric.
+
+Usage:
+    server:  ray_tpu.client.serve(port)        # inside any driver
+             python -m ray_tpu.client.server --address <gcs> --port P
+    client:  ray_tpu.init(address="ray_tpu://host:port")
+"""
+
+from ray_tpu.client.server import ClientServer, serve
+from ray_tpu.client.worker import ClientWorker
+
+__all__ = ["ClientServer", "ClientWorker", "serve"]

@@ -1,0 +1,131 @@
+"""Thin-client proxy (reference: `python/ray/util/client/` "ray://")."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+CLIENT_SCRIPT = """
+import ray_tpu
+
+ray_tpu.init(address="ray_tpu://127.0.0.1:{port}")
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+# tasks + composition (ref as arg crosses the proxy as a marker)
+refs = [square.remote(i) for i in range(5)]
+assert ray_tpu.get(refs, timeout=60) == [0, 1, 4, 9, 16]
+chained = square.remote(refs[3])
+assert ray_tpu.get(chained, timeout=60) == 81
+
+# put / wait
+data = ray_tpu.put({{"k": [1, 2, 3]}})
+assert ray_tpu.get(data, timeout=30)["k"] == [1, 2, 3]
+ready, rest = ray_tpu.wait(refs, num_returns=2, timeout=30)
+assert len(ready) == 2 and len(rest) == 3
+
+# actors end to end, incl. passing the handle through a task
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+c = Counter.options(name="client_counter").remote()
+assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+assert ray_tpu.get(c.incr.remote(4), timeout=60) == 5
+
+@ray_tpu.remote
+def poke(counter):
+    return ray_tpu.get(counter.incr.remote(10), timeout=30)
+
+assert ray_tpu.get(poke.remote(c), timeout=60) == 15
+
+# a ref nested inside a custom object still resolves server-side
+class Holder:
+    def __init__(self, ref):
+        self.ref = ref
+
+@ray_tpu.remote
+def unwrap(holder):
+    return ray_tpu.get(holder.ref, timeout=30) + 1
+
+assert ray_tpu.get(unwrap.remote(Holder(refs[2])), timeout=60) == 5
+
+# named-actor lookup through the proxy
+again = ray_tpu.get_actor("client_counter")
+assert ray_tpu.get(again.incr.remote(), timeout=60) == 16
+
+# cluster state passthrough
+nodes = ray_tpu.nodes()
+assert len(nodes) == 1 and nodes[0]["Alive"]
+
+ray_tpu.kill(c)
+ray_tpu.shutdown()
+print("CLIENT-OK")
+"""
+
+
+def test_thin_client_end_to_end(tmp_path):
+    import ray_tpu
+    from ray_tpu import client as rt_client
+
+    ray_tpu.init(num_cpus=4, num_tpus=0,
+                 object_store_memory=128 * 1024 * 1024,
+                 ignore_reinit_error=True)
+    server = rt_client.serve(0, host="127.0.0.1")
+    try:
+        script = tmp_path / "client_driver.py"
+        script.write_text(CLIENT_SCRIPT.format(port=server.port))
+        proc = subprocess.run(
+            [sys.executable, str(script)], capture_output=True, text=True,
+            timeout=180, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                              "PYTHONPATH": _repo_root()})
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert "CLIENT-OK" in proc.stdout
+    finally:
+        server.stop()
+        ray_tpu.shutdown()
+
+
+def test_client_release_unpins_server_refs(tmp_path):
+    import gc
+
+    import ray_tpu
+    from ray_tpu import client as rt_client
+    from ray_tpu.client.worker import ClientWorker
+
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 object_store_memory=64 * 1024 * 1024,
+                 ignore_reinit_error=True)
+    server = rt_client.serve(0, host="127.0.0.1")
+    try:
+        w = ClientWorker("127.0.0.1", server.port)
+        ref = w.put([1, 2, 3])
+        oid = ref.binary()
+        assert oid in server._refs
+        # In client mode the global worker IS the ClientWorker and
+        # ObjectRef GC drives this counter; here (a second worker beside
+        # a real driver) exercise the protocol directly.
+        w.reference_counter.add_local_ref(oid)
+        w.reference_counter.remove_local_ref(oid)
+        import time
+
+        deadline = time.monotonic() + 10
+        while oid in server._refs and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert oid not in server._refs, "server pin never released"
+        w.shutdown()
+    finally:
+        server.stop()
+        ray_tpu.shutdown()
