@@ -290,13 +290,72 @@ def available_resources() -> Dict[str, float]:
     return global_worker().gcs.call("available_resources", timeout=10)
 
 
-def timeline() -> List[Dict]:
-    return global_worker().gcs.call("get_task_events", timeout=10)
+def task_events(limit: Optional[int] = None) -> List[Dict]:
+    """Raw task lifecycle events from the head's ring buffer (all of it by
+    default — the server-side default limit of 1000 would silently drop
+    older tasks from timelines)."""
+    from ray_tpu._private.config import GlobalConfig
+    return global_worker().gcs.call(
+        "get_task_events", timeout=30,
+        limit=limit or GlobalConfig.task_events_buffer_size)
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict]:
+    """Chrome-trace-format task timeline (reference: `ray timeline`,
+    `scripts/scripts.py:1875` dumping chrome://tracing JSON from GCS task
+    events). Returns the trace events; also writes JSON to `filename` if
+    given."""
+    events = task_events()
+    by_task: Dict[bytes, Dict[str, Dict]] = {}
+    spans: List[Dict] = []
+    for e in events:
+        if e["state"] == "SPAN":
+            spans.append(e)
+            continue
+        by_task.setdefault(e["task_id"], {})[e["state"]] = e
+    trace: List[Dict] = []
+    for tid, states in by_task.items():
+        run, end = states.get("RUNNING"), (
+            states.get("FINISHED") or states.get("FAILED"))
+        if not run:
+            continue
+        worker = ":".join(map(str, run.get("worker_addr", ["?"])))
+        end_ts = end["ts"] if end else time.time()
+        trace.append({
+            "name": run["name"], "cat": "task", "ph": "X",
+            "ts": run["ts"] * 1e6, "dur": max(end_ts - run["ts"], 0) * 1e6,
+            "pid": worker, "tid": worker,
+            "args": {"task_id": tid.hex(),
+                     "state": end["state"] if end else "RUNNING"},
+        })
+        sub = states.get("PENDING")
+        if sub:  # flow arrow: submission -> execution
+            trace.append({
+                "name": run["name"], "cat": "submit", "ph": "X",
+                "ts": sub["ts"] * 1e6,
+                "dur": max(run["ts"] - sub["ts"], 0) * 1e6,
+                "pid": f"driver-{sub.get('owner_pid', '?')}",
+                "tid": f"driver-{sub.get('owner_pid', '?')}",
+                "args": {"task_id": tid.hex()},
+            })
+    for e in spans:  # user spans from ray_tpu.util.tracing
+        trace.append({
+            "name": e["name"], "cat": "span", "ph": "X",
+            "ts": e["ts"] * 1e6, "dur": e.get("dur", 0) * 1e6,
+            "pid": f"spans-{e.get('owner_pid', '?')}",
+            "tid": e["task_id"].hex()[:12],
+            "args": e.get("attrs", {}),
+        })
+    if filename:
+        import json as _json
+        with open(filename, "w") as f:
+            _json.dump(trace, f)
+    return trace
 
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "put", "get", "wait",
     "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
     "available_resources", "get_runtime_context", "ObjectRef", "ActorHandle",
-    "exceptions", "__version__",
+    "exceptions", "timeline", "task_events", "__version__",
 ]

@@ -116,6 +116,9 @@ _define("event_stats", bool, True,
 _define("task_events_buffer_size", int, 100_000,
         "Ring buffer capacity of task lifecycle events kept on the head "
         "(reference: gcs task manager ring buffer).")
+_define("metrics_report_interval_s", float, 2.0,
+        "Flush cadence of user-defined ray_tpu.util.metrics to the GCS "
+        "(reference: metrics_report_interval_ms).")
 
 # --- tpu ---
 _define("tpu_chips_per_host_default", int, 4, "")

@@ -105,6 +105,13 @@ class GcsServer:
         # internal worker info registry (worker_id -> info)
         self.workers: Dict[bytes, Dict[str, Any]] = {}
 
+        # user-defined metrics: source (pid string) -> (ts, snapshots)
+        # (reference: per-node MetricsAgent registry aggregated by
+        # Prometheus). Entries expire when a source stops pushing — the
+        # same visibility a Prometheus target losing a process has;
+        # counter resets are the scrape consumer's problem (rate()).
+        self.user_metrics: Dict[str, Tuple[float, List[Dict[str, Any]]]] = {}
+
         self._register_handlers()
         self._health_task = None
         self._snapshot_path: Optional[str] = None
@@ -198,7 +205,7 @@ class GcsServer:
             "get_task_events",
             "register_worker", "list_workers", "get_system_config",
             "cluster_resources", "available_resources", "internal_stats",
-            "metrics_text", "get_cluster_load",
+            "metrics_text", "get_cluster_load", "push_metrics",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
 
@@ -260,7 +267,97 @@ class GcsServer:
         for state, n in pg_states.items():
             lines.append(
                 f'rtpu_placement_groups_total{{state="{state}"}} {n}')
+        lines.extend(self._render_user_metrics())
         return "\n".join(lines) + "\n"
+
+    async def _h_push_metrics(self, source: str, records):
+        self.user_metrics[source] = (time.time(), records)
+        return True
+
+    @staticmethod
+    def _esc_label(v: str) -> str:
+        return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+                .replace('"', '\\"'))
+
+    def _render_user_metrics(self) -> List[str]:
+        """Aggregate pushed ray_tpu.util.metrics snapshots into exposition
+        lines: counters/histograms summed across processes, gauges exported
+        per-process with a pid label. Sources that stopped pushing (dead
+        workers) expire after 10 flush intervals."""
+        ttl = GlobalConfig.metrics_report_interval_s * 10
+        now = time.time()
+        for source in [s for s, (ts, _) in self.user_metrics.items()
+                       if now - ts > ttl]:
+            del self.user_metrics[source]
+        # (name) -> merged view
+        metas: Dict[str, Dict[str, Any]] = {}
+        counters: Dict[str, Dict[str, float]] = defaultdict(
+            lambda: defaultdict(float))
+        gauges: Dict[str, Dict[str, float]] = defaultdict(dict)
+        hists: Dict[str, Dict[str, List[float]]] = defaultdict(dict)
+        for source, (_, records) in self.user_metrics.items():
+            for rec in records:
+                name, typ = rec["name"], rec["type"]
+                meta = metas.setdefault(name, rec)
+                if meta.get("type") != typ or (
+                        typ == "histogram"
+                        and tuple(meta.get("boundaries", ()))
+                        != tuple(rec.get("boundaries", ()))):
+                    # Conflicting registration from another process: skip
+                    # this record rather than corrupt/crash the scrape.
+                    continue
+                keys = rec.get("tag_keys", ())
+                for tagvals, cell in rec.get("data", {}).items():
+                    labels = ",".join(
+                        f'{k}="{self._esc_label(v)}"' for k, v in
+                        zip(keys, tagvals.split(",") if keys else ()))
+                    if typ == "counter":
+                        counters[name][labels] += cell
+                    elif typ == "gauge":
+                        lbl = (labels + "," if labels else "") + \
+                            f'pid="{self._esc_label(source)}"'
+                        gauges[name][lbl] = cell
+                    elif typ == "histogram":
+                        acc = hists[name].get(labels)
+                        if acc is None or len(acc) != len(cell):
+                            hists[name][labels] = list(cell)
+                        else:
+                            for i, v in enumerate(cell):
+                                acc[i] += v
+        out: List[str] = []
+        for name, meta in metas.items():
+            typ = meta["type"]
+            full = f"rtpu_{name}"
+            if meta.get("description"):
+                out.append(f"# HELP {full} {meta['description']}")
+            if typ in ("counter", "gauge"):
+                out.append(f"# TYPE {full} {typ}")
+                table = counters[name] if typ == "counter" else gauges[name]
+                for labels, val in sorted(table.items()):
+                    out.append(f"{full}{{{labels}}} {val}"
+                               if labels else f"{full} {val}")
+            elif typ == "histogram":
+                out.append(f"# TYPE {full} histogram")
+                bounds = meta.get("boundaries", ())
+                for labels, cell in sorted(hists[name].items()):
+                    if len(cell) != len(bounds) + 3:
+                        continue  # mismatched push; never crash the scrape
+                    prefix = labels + "," if labels else ""
+                    for i, b in enumerate(bounds):
+                        out.append(
+                            f'{full}_bucket{{{prefix}le="{b}"}} {cell[i]}')
+                    out.append(
+                        f'{full}_bucket{{{prefix}le="+Inf"}} '
+                        f'{cell[len(bounds)]}')
+                    out.append(f"{full}_sum{{{labels}}} "
+                               f"{cell[len(bounds) + 1]}"
+                               if labels else
+                               f"{full}_sum {cell[len(bounds) + 1]}")
+                    out.append(f"{full}_count{{{labels}}} "
+                               f"{cell[len(bounds) + 2]}"
+                               if labels else
+                               f"{full}_count {cell[len(bounds) + 2]}")
+        return out
 
     def start_metrics_http(self, port: int = 0) -> int:
         """Serve GET /metrics for Prometheus scrapers (stdlib HTTP)."""

@@ -690,7 +690,10 @@ class Worker:
         event = {
             "task_id": spec.task_id.binary(), "name": spec.name,
             "job_id": spec.job_id.binary(), "state": state,
-            "ts": time.time(), "owner_pid": os.getpid(), **extra,
+            "ts": time.time(), "owner_pid": os.getpid(),
+            "parent_task_id": (spec.parent_task_id.binary()
+                               if spec.parent_task_id else None),
+            **extra,
         }
         with self._task_events_lock:
             self._task_events.append(event)
@@ -1225,6 +1228,15 @@ class Worker:
         # reply and os._exit must fail as killed, not silently execute
         # (ray.kill() has already returned to the user by then).
         self._killed = True
+        try:  # last-gasp user-metric flush (bounded; best effort)
+            from ray_tpu.util.metrics import snapshot_records
+            recs = snapshot_records()
+            if recs:
+                await asyncio.wait_for(
+                    self.gcs.acall("push_metrics", source=str(os.getpid()),
+                                   records=recs, timeout=1), 1.0)
+        except Exception:
+            pass
         asyncio.get_running_loop().call_later(0.02, os._exit, 1)
         return True
 
@@ -1612,13 +1624,18 @@ class Worker:
         return asyncio.to_thread(self.get_objects, refs, None)
 
     def shutdown(self):
-        # Final task-event flush before the GCS connection closes
-        # (synchronous: the io loop dies with us).
+        # Final task-event + user-metric flush before the GCS connection
+        # closes (synchronous: the io loop dies with us).
         try:
             with self._task_events_lock:
                 batch, self._task_events = self._task_events, []
             if batch:
                 self.gcs.call("push_task_events", events=batch, timeout=5)
+        except Exception:
+            pass
+        try:
+            from ray_tpu.util import metrics as _metrics
+            _metrics.flush()
         except Exception:
             pass
         if self._mapped:

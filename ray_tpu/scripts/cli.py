@@ -171,6 +171,14 @@ def cmd_metrics(args) -> None:
     ray_tpu.shutdown()
 
 
+def cmd_timeline(args) -> None:
+    ray_tpu = _connect(args)
+    trace = ray_tpu.timeline(filename=args.output)
+    print(f"wrote {len(trace)} trace events to {args.output} "
+          f"(open in chrome://tracing or https://ui.perfetto.dev)")
+    ray_tpu.shutdown()
+
+
 # --------------------------------------------------------------------- main
 
 def main(argv: Optional[List[str]] = None) -> None:
@@ -220,6 +228,10 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     p = sub.add_parser("metrics", help="prometheus metrics text")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("timeline", help="dump chrome-trace task timeline")
+    p.add_argument("-o", "--output", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -1,5 +1,6 @@
 """ray_tpu.util — user-facing utilities (reference: `python/ray/util/`)."""
 
 from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util import metrics, tracing
 
-__all__ = ["ActorPool"]
+__all__ = ["ActorPool", "metrics", "tracing"]

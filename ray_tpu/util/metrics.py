@@ -1,0 +1,223 @@
+"""User-defined application metrics (reference: `python/ray/util/metrics.py`,
+exported through the node MetricsAgent -> Prometheus in the reference;
+here pushed to the GCS metrics registry and served from the GCS
+``/metrics`` scrape endpoint alongside the system gauges).
+
+Usage, mirroring the reference API::
+
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    requests = Counter("num_requests", description="...",
+                       tag_keys=("route",))
+    requests.inc(1.0, tags={"route": "/predict"})
+
+Metrics are process-local and flushed to the GCS every
+``GlobalConfig.metrics_report_interval_s`` seconds by a daemon thread
+(the reference's C++ registry flushes to the metrics agent on the same
+cadence). Aggregation on the scrape side: counters and histograms are
+summed across processes; gauges are exported per-process with a
+``pid`` label (summing gauges would be wrong).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}  # name -> canonical instance
+_flusher_started = False
+
+DEFAULT_BOUNDARIES = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _valid_name(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    if not out or out[0].isdigit():
+        raise ValueError(f"invalid metric name {name!r}")
+    return out
+
+
+class Metric:
+    """Base class; do not instantiate directly."""
+
+    _type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if tag_keys is not None and not all(
+                isinstance(k, str) for k in tag_keys):
+            raise TypeError("tag_keys must be strings")
+        self._name = _valid_name(name)
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        # tag-value tuple (aligned with _tag_keys) -> float / bucket list
+        self._data: Dict[Tuple[str, ...], object] = {}
+        # Re-creating a metric with the same name (e.g. inside a task body
+        # run many times on one worker) aliases the canonical instance's
+        # storage instead of growing the registry without bound.
+        with _registry_lock:
+            prior = _registry.get(self._name)
+            if prior is not None:
+                if (prior._type != self._type
+                        or prior._tag_keys != self._tag_keys
+                        or getattr(prior, "boundaries", None)
+                        != getattr(self, "boundaries", None)):
+                    raise ValueError(
+                        f"metric {self._name!r} already registered with a "
+                        f"different type/tag_keys/boundaries")
+                self._data = prior._data
+                self._lock = prior._lock
+            else:
+                _registry[self._name] = self
+        _ensure_flusher()
+
+    # Reference parity: metric.set_default_tags({...}) returns self.
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    @property
+    def info(self) -> Dict[str, object]:
+        return {"name": self._name, "type": self._type,
+                "description": self._description,
+                "tag_keys": self._tag_keys,
+                "default_tags": dict(self._default_tags)}
+
+    def _tag_tuple(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        extra = set(merged) - set(self._tag_keys)
+        if extra:
+            raise ValueError(
+                f"unknown tag(s) {sorted(extra)} for metric {self._name!r}; "
+                f"declared tag_keys={self._tag_keys}")
+        vals = tuple(str(merged.get(k, "")) for k in self._tag_keys)
+        if any("," in v for v in vals):
+            raise ValueError("tag values must not contain ','")
+        return vals
+
+    def _snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            data = {",".join(k): v if not isinstance(v, list) else list(v)
+                    for k, v in self._data.items()}
+        return {**self.info, "data": data}
+
+
+class Counter(Metric):
+    """Monotonically increasing counter (summed across processes)."""
+
+    _type = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("Counter.inc() requires value >= 0")
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._data[key] = float(self._data.get(key, 0.0)) + value
+
+
+class Gauge(Metric):
+    """Last-write-wins value (exported per-process)."""
+
+    _type = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._data[self._tag_tuple(tags)] = float(value)
+
+
+class Histogram(Metric):
+    """Cumulative-bucket histogram, Prometheus exposition semantics."""
+
+    _type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        self.boundaries = tuple(
+            sorted(boundaries if boundaries else DEFAULT_BOUNDARIES))
+        if any(b <= 0 for b in self.boundaries):
+            raise ValueError("histogram boundaries must be > 0")
+        super().__init__(name, description, tag_keys)
+
+    @property
+    def info(self) -> Dict[str, object]:
+        out = super().info
+        out["boundaries"] = self.boundaries
+        return out
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._tag_tuple(tags)
+        with self._lock:
+            cell = self._data.get(key)
+            if cell is None:
+                # [bucket_0..bucket_n-1, +inf, sum, count]
+                cell = [0.0] * (len(self.boundaries) + 3)
+                self._data[key] = cell
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    cell[i] += 1
+            cell[len(self.boundaries)] += 1          # +inf bucket
+            cell[len(self.boundaries) + 1] += value  # sum
+            cell[len(self.boundaries) + 2] += 1      # count
+
+
+# --------------------------------------------------------------------- flush
+
+def snapshot_records() -> List[Dict[str, object]]:
+    """Serializable snapshots of every registered metric (for async push
+    paths that cannot use the sync GCS client, e.g. worker kill)."""
+    with _registry_lock:
+        return [m._snapshot() for m in _registry.values()]
+
+
+def _flush_once() -> bool:
+    """Push one snapshot of every registered metric to the GCS."""
+    from ray_tpu._private.worker import global_worker_or_none
+
+    w = global_worker_or_none()
+    if w is None or getattr(w, "_dead", False):
+        return False
+    with _registry_lock:
+        snaps = [m._snapshot() for m in _registry.values()]
+    if not snaps:
+        return True
+    try:
+        w.gcs.call("push_metrics", source=f"{os.getpid()}",
+                   records=snaps, timeout=5)
+        return True
+    except Exception:
+        return False
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def _loop():
+        from ray_tpu._private.config import GlobalConfig
+        while True:
+            time.sleep(GlobalConfig.metrics_report_interval_s)
+            _flush_once()
+
+    threading.Thread(target=_loop, daemon=True,
+                     name="rtpu-metrics-flusher").start()
+
+
+def flush() -> bool:
+    """Force an immediate push (also called at worker shutdown/kill;
+    SIGKILL'd workers lose at most one flush interval of updates)."""
+    return _flush_once()

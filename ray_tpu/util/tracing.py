@@ -1,0 +1,91 @@
+"""Lightweight task tracing (reference:
+`python/ray/util/tracing/tracing_helper.py`, which wraps every remote
+call/execution in OpenTelemetry spans and propagates context in task specs).
+
+Here the runtime *already* propagates trace lineage natively: every
+``TaskSpec`` carries ``parent_task_id``/``depth``, and the worker records
+PENDING/RUNNING/FINISHED lifecycle events into the head's task-event ring
+buffer. This module adds the user-facing span API on top:
+
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def step():
+        with tracing.span("load"):
+            ...
+        with tracing.span("compute", attrs={"n": 4}):
+            ...
+
+Spans attach to the current task (or the driver) and export through the
+same GCS ring buffer; ``ray_tpu.timeline()`` renders them as nested rows
+and ``span_tree()`` reconstructs the cross-task call tree from
+``parent_task_id`` links — the role OpenTelemetry context propagation
+plays in the reference.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@contextmanager
+def span(name: str, attrs: Optional[Dict[str, Any]] = None) -> Iterator[None]:
+    """Record a named span inside the current task/driver."""
+    from ray_tpu._private.worker import global_worker_or_none
+
+    start = time.time()
+    try:
+        yield
+    finally:
+        w = global_worker_or_none()
+        # Thin-client drivers (ray_tpu://) have no local event buffer;
+        # spans there are a no-op rather than an AttributeError.
+        if (w is not None and not getattr(w, "_dead", False)
+                and hasattr(w, "_task_events_lock")):
+            tid = w.current_task_id()
+            event = {
+                "task_id": tid.binary() if tid else b"driver",
+                "name": name, "job_id": b"", "state": "SPAN",
+                "ts": start, "dur": time.time() - start,
+                "owner_pid": __import__("os").getpid(),
+                "attrs": attrs or {},
+            }
+            with w._task_events_lock:
+                w._task_events.append(event)
+
+
+def span_tree() -> List[Dict[str, Any]]:
+    """The cross-task call tree: each node is a task with its lifecycle
+    timestamps, user spans, and children (tasks it submitted)."""
+    import ray_tpu
+
+    events = ray_tpu.task_events()
+    nodes: Dict[bytes, Dict[str, Any]] = {}
+    spans: Dict[bytes, List[Dict[str, Any]]] = {}
+    for e in events:
+        if e["state"] == "SPAN":
+            spans.setdefault(e["task_id"], []).append(
+                {"name": e["name"], "ts": e["ts"], "dur": e.get("dur", 0),
+                 "attrs": e.get("attrs", {})})
+            continue
+        node = nodes.setdefault(e["task_id"], {
+            "task_id": e["task_id"].hex(), "name": e["name"],
+            "states": {}, "children": [], "spans": [],
+            "parent_task_id": None})
+        node["states"][e["state"]] = e["ts"]
+        if e.get("parent_task_id"):
+            node["parent_task_id"] = e["parent_task_id"]
+    for tid, sp in spans.items():
+        if tid in nodes:
+            nodes[tid]["spans"] = sorted(sp, key=lambda s: s["ts"])
+    roots = []
+    for node in nodes.values():
+        parent = node.pop("parent_task_id", None)
+        pnode = nodes.get(parent) if parent else None
+        if pnode is not None and pnode is not node:
+            pnode["children"].append(node)
+        else:
+            roots.append(node)
+    return roots

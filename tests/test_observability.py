@@ -172,3 +172,125 @@ ray_tpu.shutdown()
     # (without a kill the 4s first attempt completes and writes once).
     pids = [p for p in attempts.read_text().split() if p]
     assert len(pids) >= 2, (pids, proc.stderr[-2000:])
+
+
+# ---------------------------------------------------------------- metrics
+
+def test_user_metrics_exported(ray_start_regular):
+    """Counter/Gauge/Histogram recorded in tasks surface on the GCS
+    prometheus endpoint (reference: `ray.util.metrics` -> MetricsAgent ->
+    Prometheus scrape)."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util import metrics
+
+    @ray_tpu.remote
+    def work(i):
+        from ray_tpu.util import metrics as m
+        c = m.Counter("obs_requests", description="requests served",
+                      tag_keys=("route",))
+        c.inc(1.0, tags={"route": "/predict"})
+        c.inc(2.0, tags={"route": "/health"})
+        g = m.Gauge("obs_queue_depth", tag_keys=())
+        g.set(float(i))
+        h = m.Histogram("obs_latency", boundaries=[0.1, 1.0, 10.0])
+        h.observe(0.05)
+        h.observe(5.0)
+        assert m.flush()
+        return i
+
+    assert sorted(ray_tpu.get([work.remote(i) for i in range(2)],
+                              timeout=60)) == [0, 1]
+    # Driver-side metric too.
+    metrics.Counter("obs_driver_side").inc(3.0)
+    assert metrics.flush()
+    text = global_worker().gcs.call("metrics_text", timeout=30)
+    assert 'rtpu_obs_requests{route="/predict"} 2.0' in text
+    assert 'rtpu_obs_requests{route="/health"} 4.0' in text
+    assert "# TYPE rtpu_obs_requests counter" in text
+    assert "rtpu_obs_driver_side 3.0" in text
+    # Gauges per-process, never summed.
+    assert "# TYPE rtpu_obs_queue_depth gauge" in text
+    assert 'rtpu_obs_queue_depth{pid="' in text
+    # Histogram buckets are cumulative; each task saw 1 obs <= 0.1
+    # and 2 obs <= +Inf.
+    assert 'rtpu_obs_latency_bucket{le="0.1"} 2.0' in text
+    assert 'rtpu_obs_latency_bucket{le="+Inf"} 4.0' in text
+    assert "rtpu_obs_latency_count 4.0" in text
+
+
+def test_metric_tag_validation():
+    from ray_tpu.util.metrics import Counter, Histogram
+
+    c = Counter("obs_tags", tag_keys=("a",))
+    with pytest.raises(ValueError):
+        c.inc(1.0, tags={"bogus": "x"})
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+    with pytest.raises(ValueError):
+        Histogram("obs_badbounds", boundaries=[-1.0])
+
+
+# --------------------------------------------------------------- timeline
+
+def test_timeline_and_span_tree(ray_start_regular):
+    """Chrome-trace dump + cross-task span tree from parent_task_id links
+    (reference: `ray timeline` + tracing_helper context propagation)."""
+    import json
+
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def leaf():
+        with tracing.span("leaf-work", attrs={"k": 1}):
+            time.sleep(0.01)
+        return 1
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get([leaf.remote() for _ in range(2)], timeout=30)
+
+    assert ray_tpu.get(parent.options(name="obs_parent").remote(),
+                       timeout=60) == [1, 1]
+    global_worker = __import__(
+        "ray_tpu._private.worker", fromlist=["global_worker"]).global_worker
+    global_worker().flush_task_events()
+    # Worker-side events (the leaf tasks + spans) flush on a 2s cadence.
+    def _all_arrived():
+        names = {e["name"] for e in ray_tpu.timeline()}
+        return {"obs_parent", "leaf-work"} <= names
+
+    assert _wait_for(_all_arrived, timeout=15), \
+        {e["name"] for e in ray_tpu.timeline()}
+
+    out = os.path.join(os.path.dirname(__file__), "..", "_timeline_test.json")
+    try:
+        trace = ray_tpu.timeline(filename=out)
+        with open(out) as f:
+            assert json.load(f) == trace
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    names = {e["name"] for e in trace}
+    assert "obs_parent" in names
+    assert "leaf-work" in names            # user span surfaced
+    complete = [e for e in trace if e["cat"] == "task"]
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in complete)
+
+    roots = tracing.span_tree()
+    # The driver-submitted parent task has the two leaves as children.
+    def find(nodes, name):
+        for n in nodes:
+            if n["name"] == name:
+                return n
+            got = find(n["children"], name)
+            if got:
+                return got
+        return None
+
+    pnode = find(roots, "obs_parent")
+    assert pnode is not None
+    assert len([c for c in pnode["children"] if c["name"] == "leaf"]) == 2
+    leaf_node = find(pnode["children"], "leaf")
+    assert any(s["name"] == "leaf-work" for s in leaf_node["spans"])
