@@ -102,12 +102,22 @@ class Checkpoint:
 
     # -- persistence --------------------------------------------------------
     def persist(self, storage_dir: str, name: Optional[str] = None) -> "Checkpoint":
-        """Copy into experiment storage; returns the persisted checkpoint."""
+        """Copy into experiment storage; returns the persisted checkpoint.
+
+        Atomic: stage into a dot-prefixed tmp dir + rename, so a process
+        killed mid-copy never leaves a torn `checkpoint_*` directory for
+        crash recovery to pick up."""
         os.makedirs(storage_dir, exist_ok=True)
         dest = os.path.join(storage_dir,
                             name or f"checkpoint_{uuid.uuid4().hex[:8]}")
-        if os.path.abspath(dest) != self.path:
-            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        if os.path.abspath(dest) == self.path:
+            return Checkpoint(dest)
+        tmp = os.path.join(storage_dir,
+                           f".tmp_{os.path.basename(dest)}_{os.getpid()}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.copytree(self.path, tmp)
+        shutil.rmtree(dest, ignore_errors=True)  # relaunch overwrote name
+        os.rename(tmp, dest)
         return Checkpoint(dest)
 
     def to_uri(self, uri: str, filesystem=None) -> "Checkpoint":

@@ -27,8 +27,25 @@ class _TuneSession:
         self._config = config
         self.trial_dir = trial_dir
         self.latest_checkpoint = checkpoint
-        self._queue: "queue.Queue" = queue.Queue(maxsize=8)
+        # maxsize=1 + join(): report() blocks until the controller has
+        # consumed the result (reference `_TrainSession`'s bounded queue) —
+        # otherwise a fast trial sprints ahead of the driver and its last
+        # reported checkpoints are lost if it crashes.
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        # Continue numbering past any checkpoints already in the trial
+        # dir: a relaunched trial (PBT exploit, restore) must never
+        # overwrite checkpoint_000000 — recovery picks the highest index.
         self._counter = 0
+        try:
+            for d in os.listdir(trial_dir):
+                if d.startswith("checkpoint_"):
+                    try:
+                        idx = int(d.split("_")[1]) + 1
+                    except ValueError:
+                        continue  # foreign checkpoint naming
+                    self._counter = max(self._counter, idx)
+        except OSError:
+            pass
         self._stop = threading.Event()
 
     def start(self):
@@ -64,12 +81,15 @@ class _TuneSession:
             ckpt_path = persisted.path
         self._counter += 1
         self._queue.put((REPORT, metrics, ckpt_path))
+        self._queue.join()   # returns once next_result() handed it over
 
     def next_result(self, timeout: Optional[float] = None):
         try:
-            return self._queue.get(timeout=timeout)
+            item = self._queue.get(timeout=timeout)
         except queue.Empty:
             return None
+        self._queue.task_done()
+        return item
 
     def request_stop(self):
         self._stop.set()

@@ -19,7 +19,7 @@ import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import Result
 from ray_tpu.tune import _session as tsession
-from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, FIFOScheduler, STOP
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
@@ -36,6 +36,7 @@ class Trial:
     checkpoint_path: Optional[str] = None
     error: Optional[str] = None
     stopped_early: bool = False
+    exploits: int = 0  # PBT: times this trial cloned a donor checkpoint
 
     @property
     def last_result(self) -> Dict[str, Any]:
@@ -129,6 +130,23 @@ class TuneController:
             except Exception as e:  # actor died
                 trial.status = ERRORED
                 trial.error = f"trial actor died: {e}"
+                # The session persists checkpoints to the trial dir BEFORE
+                # report() returns, so a crash can leave a newer checkpoint
+                # on disk than the last result we received — recover it for
+                # restore (reference: trial dirs are the durable record).
+                # Never clobber a checkpoint_path pointing OUTSIDE the
+                # trial dir (a freshly-assigned PBT donor checkpoint) and
+                # never go backwards in index.
+                latest = self._latest_disk_checkpoint(trial.trial_id)
+                cur = trial.checkpoint_path
+                trial_dir = os.path.join(self._dir, trial.trial_id)
+                cur_in_dir = (cur is not None and
+                              os.path.dirname(os.path.abspath(cur))
+                              == os.path.abspath(trial_dir))
+                if latest is not None and (
+                        cur is None or (cur_in_dir and os.path.basename(
+                            latest) > os.path.basename(cur))):
+                    trial.checkpoint_path = latest
                 running.pop(trial_id)
                 self._save_experiment_state()
                 continue
@@ -167,10 +185,48 @@ class TuneController:
                         pass
                     running.pop(trial_id)
                     ray_tpu.kill(actor)
+                elif decision == EXPLOIT:
+                    # PBT: clone a top-quantile donor's checkpoint into
+                    # this trial with a perturbed config and relaunch
+                    # (reference: pbt.py _exploit).
+                    donor = trial_by_id.get(
+                        self._scheduler.exploit_target(trial_id))
+                    if (donor is None or donor.trial_id == trial_id
+                            or donor.checkpoint_path is None):
+                        # Nothing usable to exploit: keep training.
+                        running[trial_id] = (actor,
+                                             actor.next_result.remote())
+                    else:
+                        try:
+                            ray_tpu.get(actor.request_stop.remote(),
+                                        timeout=10)
+                        except Exception:
+                            pass
+                        running.pop(trial_id)
+                        ray_tpu.kill(actor)
+                        trial.config = self._scheduler.mutate(donor.config)
+                        trial.checkpoint_path = donor.checkpoint_path
+                        trial.status = PENDING
+                        trial.exploits += 1
+                        pending.append(trial)
                 else:
                     running[trial_id] = (actor, actor.next_result.remote())
             self._save_experiment_state()
         return self.trials
+
+    def _latest_disk_checkpoint(self, trial_id: str) -> Optional[str]:
+        trial_dir = os.path.join(self._dir, trial_id)
+        try:
+            cands = [os.path.join(trial_dir, d)
+                     for d in os.listdir(trial_dir)
+                     if d.startswith("checkpoint")]
+        except OSError:
+            return None
+        # Highest checkpoint index, not mtime: session numbering is
+        # monotonic across relaunches, while rewriting files inside an
+        # existing dir does not bump the dir's mtime.
+        cands = [c for c in cands if os.path.isdir(c)]
+        return max(cands, key=os.path.basename) if cands else None
 
     # ---------------------------------------------------------- persistence
     def _save_experiment_state(self) -> None:
@@ -183,10 +239,12 @@ class TuneController:
         with open(tmp, "w") as f:
             json.dump(state, f)
         os.replace(tmp, os.path.join(self._dir, "experiment_state.json"))
-        cfg_path = os.path.join(self._dir, "trial_configs.pkl")
-        if not os.path.exists(cfg_path):
-            with open(cfg_path, "wb") as f:
-                pickle.dump({t.trial_id: t.config for t in self.trials}, f)
+        # Rewritten every save: PBT exploits mutate trial configs
+        # mid-experiment, and restore must see the post-mutation values.
+        cfg_tmp = os.path.join(self._dir, ".trial_configs.tmp")
+        with open(cfg_tmp, "wb") as f:
+            pickle.dump({t.trial_id: t.config for t in self.trials}, f)
+        os.replace(cfg_tmp, os.path.join(self._dir, "trial_configs.pkl"))
 
     @staticmethod
     def load_experiment_state(experiment_dir: str) -> List[Trial]:
@@ -206,7 +264,13 @@ class TuneController:
                       stopped_early=s.get("stopped_early", False))
             if t.status in (RUNNING, ERRORED):
                 # Interrupted mid-flight: resume from latest checkpoint.
+                # Clear the stale error and pre-crash history — the Result
+                # of the resumed run reports only post-restore progress
+                # (the checkpoint, not the metric log, is the state that
+                # carries over).
                 t.status = PENDING
+                t.error = None
+                t.history = []
             trials.append(t)
         return trials
 

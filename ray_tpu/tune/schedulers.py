@@ -3,16 +3,24 @@
 Reference: `python/ray/tune/schedulers/async_hyperband.py` — ASHA: rungs at
 grace_period * reduction_factor^k; at each rung a trial continues only if its
 result is in the top 1/reduction_factor of results recorded at that rung.
+Also `tune/schedulers/pbt.py` (PopulationBasedTraining: bottom-quantile
+trials clone a top-quantile trial's checkpoint with perturbed hyperparams)
+and `tune/schedulers/median_stopping_rule.py`.
 """
 
 from __future__ import annotations
 
 import math
+import random
 from collections import defaultdict
-from typing import Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+# Scheduler asks the controller to clone a donor trial's checkpoint into
+# this trial with a perturbed config (PBT). The controller calls
+# `exploit_target(trial_id)` and `mutate(donor_config)` to act on it.
+EXPLOIT = "EXPLOIT"
 
 
 class FIFOScheduler:
@@ -57,3 +65,118 @@ class AsyncHyperBandScheduler:
                     return STOP
                 break
         return CONTINUE
+
+
+class MedianStoppingRule:
+    """Stop a trial whose best value so far is worse than the median of
+    other trials' running averages at a comparable step (reference:
+    `tune/schedulers/median_stopping_rule.py`; the Vizier rule)."""
+
+    def __init__(self, metric: str = None, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        self._sums: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+        self._best: Dict[str, float] = {}
+
+    def on_result(self, trial_id: str, iteration: int, value: float) -> str:
+        if self.mode == "min":
+            value = -value
+        self._sums[trial_id] += value
+        self._counts[trial_id] += 1
+        self._best[trial_id] = max(
+            self._best.get(trial_id, -math.inf), value)
+        if iteration < self._grace:
+            return CONTINUE
+        others = [self._sums[t] / self._counts[t]
+                  for t in self._sums if t != trial_id]
+        if len(others) < self._min_samples:
+            return CONTINUE
+        median = sorted(others)[len(others) // 2]
+        return STOP if self._best[trial_id] < median else CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT (reference `tune/schedulers/pbt.py`): every
+    `perturbation_interval` iterations, a trial in the bottom quantile
+    exploits — the controller clones a random top-quantile trial's latest
+    checkpoint into it and re-launches with a perturbed config.
+
+    `hyperparam_mutations` maps config key -> list of choices | callable
+    () -> value | (low, high) numeric range. On perturb: with
+    `resample_probability` draw fresh from the spec, otherwise multiply
+    numeric values by 0.8/1.2 (or step to a list neighbor).
+    """
+
+    def __init__(self, metric: str = None, mode: str = "max",
+                 perturbation_interval: int = 2,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        if not 0 < quantile_fraction <= 0.5:
+            raise ValueError("quantile_fraction must be in (0, 0.5]")
+        self.metric = metric
+        self.mode = mode
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._scores: Dict[str, float] = {}          # signed latest value
+        self._last_perturb: Dict[str, int] = defaultdict(int)
+        self._donor_for: Dict[str, str] = {}
+
+    # ------------------------------------------------------------ protocol
+    def on_result(self, trial_id: str, iteration: int, value: float) -> str:
+        if self.mode == "min":
+            value = -value
+        self._scores[trial_id] = value
+        if iteration - self._last_perturb[trial_id] < self._interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = iteration
+        ranked = sorted(self._scores, key=self._scores.get)
+        k = max(1, int(len(ranked) * self._quantile))
+        if len(ranked) < 2 * k:
+            return CONTINUE            # population too small to split
+        bottom, top = ranked[:k], ranked[-k:]
+        if trial_id in bottom and trial_id not in top:
+            self._donor_for[trial_id] = self._rng.choice(top)
+            return EXPLOIT
+        return CONTINUE
+
+    def exploit_target(self, trial_id: str) -> Optional[str]:
+        return self._donor_for.get(trial_id)
+
+    def mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        for key, spec in self._mutations.items():
+            resample = self._rng.random() < self._resample_prob
+            cur = out.get(key)
+            if callable(spec):
+                out[key] = spec()
+            elif isinstance(spec, list):
+                if resample or cur not in spec:
+                    out[key] = self._rng.choice(spec)
+                else:  # step to a neighbor (reference behavior)
+                    i = spec.index(cur)
+                    j = min(len(spec) - 1, max(0, i + self._rng.choice(
+                        (-1, 1))))
+                    out[key] = spec[j]
+            elif (isinstance(spec, tuple) and len(spec) == 2
+                  and all(isinstance(b, (int, float)) for b in spec)):
+                low, high = spec
+                if resample or not isinstance(cur, (int, float)):
+                    out[key] = self._rng.uniform(low, high)
+                else:
+                    out[key] = min(high, max(
+                        low, cur * self._rng.choice((0.8, 1.2))))
+                if isinstance(low, int) and isinstance(high, int):
+                    out[key] = int(round(out[key]))
+            else:
+                raise ValueError(
+                    f"unsupported mutation spec for {key!r}: {spec!r}")
+        return out

@@ -114,7 +114,7 @@ class Tuner:
             metric=tc.metric, mode=tc.mode, scheduler=scheduler,
             max_concurrent=tc.max_concurrent_trials,
             trial_resources=tc.trial_resources)
-        controller.run()
+        self._last_trials = controller.run()  # post-run Trial introspection
         return ResultGrid(controller.results(), tc.metric, tc.mode)
 
     # -------------------------------------------------------------- restore

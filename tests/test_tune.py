@@ -153,3 +153,100 @@ def test_trainer_fit_routes_through_tune(ray_start_regular, tmp_path):
     # Experiment state persisted by the tune engine.
     assert os.path.exists(
         str(tmp_path / "fit_via_tune" / "experiment_state.json"))
+
+
+# ----------------------------------------------------- schedulers (units)
+
+def test_median_stopping_rule_units():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, MedianStoppingRule
+
+    rule = MedianStoppingRule(grace_period=2, min_samples_required=2)
+    # Build up history for three healthy trials.
+    for it in (1, 2, 3):
+        for t in ("a", "b", "c"):
+            assert rule.on_result(t, it, 10.0) == CONTINUE
+    # A trial far below the median of running averages stops after grace.
+    assert rule.on_result("bad", 1, 0.1) == CONTINUE   # grace
+    assert rule.on_result("bad", 2, 0.1) == STOP
+    # min mode flips the comparison.
+    rule_min = MedianStoppingRule(mode="min", grace_period=1,
+                                  min_samples_required=2)
+    for t in ("a", "b"):
+        rule_min.on_result(t, 1, 1.0)
+    assert rule_min.on_result("low", 1, 0.01) == CONTINUE  # 0.01 is best
+    assert rule_min.on_result("high", 1, 50.0) == STOP
+
+
+def test_pbt_scheduler_units():
+    from ray_tpu.tune.schedulers import (
+        CONTINUE, EXPLOIT, PopulationBasedTraining)
+
+    pbt = PopulationBasedTraining(
+        perturbation_interval=2, quantile_fraction=0.25,
+        hyperparam_mutations={"lr": (0.001, 1.0),
+                              "batch": [16, 32, 64],
+                              "opt": lambda: "sgd"},
+        seed=7)
+    # 4 trials: scores 1..4. Below-interval reports never exploit.
+    for i, t in enumerate(["t0", "t1", "t2", "t3"]):
+        assert pbt.on_result(t, 1, float(i)) == CONTINUE
+    # At the interval, the worst trial exploits the best.
+    assert pbt.on_result("t0", 2, 0.0) == EXPLOIT
+    assert pbt.exploit_target("t0") == "t3"
+    # The best trial never exploits.
+    assert pbt.on_result("t3", 2, 3.0) == CONTINUE
+
+    donor_cfg = {"lr": 0.1, "batch": 32, "opt": "adam", "fixed": 9}
+    for _ in range(20):
+        m = pbt.mutate(donor_cfg)
+        assert 0.001 <= m["lr"] <= 1.0
+        assert m["batch"] in (16, 32, 64)
+        assert m["opt"] == "sgd"            # callable always resamples
+        assert m["fixed"] == 9              # unlisted keys untouched
+    with pytest.raises(ValueError, match="quantile_fraction"):
+        PopulationBasedTraining(quantile_fraction=0.9)
+
+
+def pbt_trainable(config):
+    """Score grows by `lr` each iteration from the checkpointed base —
+    exploitation jumps a bad trial onto a good trial's trajectory."""
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    ckpt = tune.get_checkpoint()
+    state = ckpt.to_dict() if ckpt else {"score": 0.0, "it": 0}
+    for _ in range(8):
+        state["it"] += 1
+        state["score"] += config["lr"]
+        tune.report({"score": state["score"], "lr": config["lr"]},
+                    checkpoint=Checkpoint.from_dict(state))
+        time.sleep(0.05)
+
+
+def test_pbt_exploits_checkpoint_e2e(ray_start_regular, tmp_path):
+    """A near-zero-lr trial clones a high-lr trial's checkpoint and
+    config (reference: pbt.py exploit/explore loop)."""
+    from ray_tpu.tune import PopulationBasedTraining
+
+    pbt = PopulationBasedTraining(
+        perturbation_interval=2, quantile_fraction=0.25,
+        resample_probability=0.0,
+        hyperparam_mutations={"lr": (0.0001, 2.0)}, seed=3)
+    tuner = Tuner(
+        pbt_trainable,
+        param_space={"lr": tune.grid_search([0.001, 0.9, 1.0, 1.1])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt,
+                               max_concurrent_trials=4),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert not grid.errors
+    # The weak trial exploited: somebody cloned a donor checkpoint.
+    exploited = [t for t in tuner._last_trials if t.exploits > 0]
+    assert exploited, "no trial ever exploited"
+    weak = next(t for t in tuner._last_trials
+                if t.trial_id == "trial_00000")
+    # Its post-exploit lr is a perturbation of a donor (0.8x/1.2x of
+    # ~1.0), not its original 0.001.
+    assert weak.config["lr"] > 0.5
+    # And its final score reflects the donor's head start, far above
+    # what lr=0.001 * 8 iters could reach alone.
+    assert weak.last_result.get("score", 0.0) > 1.0
