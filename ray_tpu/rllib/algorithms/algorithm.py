@@ -33,6 +33,9 @@ class AlgorithmConfig:
         self.jax_platform: Optional[str] = None
         self.module_hidden = (64, 64)
         self.seed = 0
+        # Episode-return smoothing window (reference:
+        # metrics_num_episodes_for_smoothing).
+        self.metrics_episode_window = 100
 
     # fluent builder sections (reference algorithm_config.py style)
     def environment(self, env) -> "AlgorithmConfig":
@@ -118,7 +121,8 @@ class Algorithm:
         metrics = self.training_step()
         metrics["training_iteration"] = self._iteration
         if self._recent_returns:
-            window = self._recent_returns[-100:]
+            window = self._recent_returns[
+                -getattr(self.config, "metrics_episode_window", 100):]
             metrics["episode_return_mean"] = float(np.mean(window))
             metrics["num_episodes"] = len(window)
         return metrics

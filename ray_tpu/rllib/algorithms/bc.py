@@ -1,0 +1,132 @@
+"""BC — offline behavior cloning from a transition dataset.
+
+Reference: `rllib/algorithms/bc/bc.py` (+ `marwil/marwil.py`, of which BC
+is the beta=0 special case: plain negative-log-likelihood on the expert's
+actions, no advantage weighting) and `rllib/offline/` for dataset-backed
+training. Here the offline input is a `ray_tpu.data.Dataset` of
+{"obs", "actions"} batches — the Data library streams/shuffles it and
+the learner does supervised NLL updates; no env runners exist at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.cartpole import make_env
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.jax_backend import JaxConfig
+
+
+class BCLearner(Learner):
+    def compute_loss(self, params, batch, rng):
+        logits = self.module.forward_train(params,
+                                           batch["obs"])["action_logits"]
+        logp = jax.nn.log_softmax(logits)
+        act = batch["actions"].astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, act[:, None], -1)[:, 0]
+        loss = nll.mean()
+        acc = (jnp.argmax(logits, -1) == act).mean()
+        return loss, {"bc_nll": loss, "bc_accuracy": acc}
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.train_batch_size = 256
+        self.num_batches_per_iteration = 32
+        self.dataset = None        # ray_tpu.data.Dataset | list of dicts
+
+    def offline_data(self, dataset) -> "BCConfig":
+        self.dataset = dataset
+        return self
+
+    algo_class = property(lambda self: BC)
+
+
+class BC:
+    """Offline algorithm: no env-runner fleet — `train()` consumes the
+    configured dataset. The env is probed only for spaces."""
+
+    learner_class = BCLearner
+
+    def __init__(self, config: BCConfig):
+        if config.dataset is None:
+            raise ValueError("BCConfig.offline_data(dataset) is required")
+        probe_env = make_env(config.env)
+        self.config = config
+        self.module_spec = RLModuleSpec(
+            observation_space=probe_env.observation_space,
+            action_space=probe_env.action_space,
+            hidden=config.module_hidden)
+        self.learner_group = LearnerGroup(
+            self.learner_class, self.module_spec,
+            learner_config={"lr": config.lr, "grad_clip": config.grad_clip,
+                            "seed": config.seed},
+            scaling_config=ScalingConfig(num_workers=config.num_learners),
+            jax_config=JaxConfig(platform=config.jax_platform))
+        self._iteration = 0
+        self._batch_iter: Optional[Iterator] = None
+
+    # ------------------------------------------------------------ ingestion
+    def _batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        ds = self.config.dataset
+        bs = self.config.train_batch_size
+        if hasattr(ds, "iter_batches"):       # ray_tpu.data.Dataset
+            while True:                        # epoch loop
+                for batch in ds.iter_batches(batch_size=bs):
+                    yield {"obs": np.asarray(batch["obs"], np.float32),
+                           "actions": np.asarray(batch["actions"])}
+        else:                                  # in-memory list of rows
+            rows = list(ds)
+            obs = np.asarray([r["obs"] for r in rows], np.float32)
+            act = np.asarray([r["actions"] for r in rows])
+            rng = np.random.RandomState(self.config.seed)
+            while True:
+                idx = rng.randint(0, len(rows), bs)
+                yield {"obs": obs[idx], "actions": act[idx]}
+
+    # ------------------------------------------------------------ training
+    def train(self) -> Dict[str, Any]:
+        self._iteration += 1
+        if self._batch_iter is None:
+            self._batch_iter = self._batches()
+        metrics: Dict[str, Any] = {}
+        for _ in range(self.config.num_batches_per_iteration):
+            metrics.update(self.learner_group.update(
+                next(self._batch_iter)))
+        metrics["training_iteration"] = self._iteration
+        return metrics
+
+    def get_policy_params(self):
+        return self.learner_group.get_weights()
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, float]:
+        """Greedy rollouts of the cloned policy in the probe env."""
+        module = self.module_spec.build()
+        params = self.get_policy_params()
+        fwd = jax.jit(module.forward_inference)
+        returns: List[float] = []
+        env = make_env(self.config.env, seed=self.config.seed + 999)
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=self.config.seed + ep)
+            total, done = 0.0, False
+            while not done:
+                out = fwd(params, obs[None].astype(np.float32))
+                obs, r, term, trunc, _ = env.step(
+                    int(np.asarray(out["actions"])[0]))
+                total += r
+                done = term or trunc
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": num_episodes}
+
+    def stop(self) -> None:
+        self.learner_group.shutdown()

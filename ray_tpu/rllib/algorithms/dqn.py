@@ -109,11 +109,12 @@ class ReplayBuffer:
     """Uniform ring buffer over flat transitions (driver-side numpy;
     reference: `utils/replay_buffers/`)."""
 
-    def __init__(self, capacity: int, obs_shape):
+    def __init__(self, capacity: int, obs_shape, action_shape=(),
+                 action_dtype=np.int32):
         self._cap = capacity
         self._obs = np.zeros((capacity, *obs_shape), np.float32)
         self._next_obs = np.zeros((capacity, *obs_shape), np.float32)
-        self._actions = np.zeros((capacity,), np.int32)
+        self._actions = np.zeros((capacity, *action_shape), action_dtype)
         self._rewards = np.zeros((capacity,), np.float32)
         self._dones = np.zeros((capacity,), np.float32)
         self._idx = 0
@@ -195,13 +196,14 @@ class DQN(Algorithm):
         for ro in rollouts:
             T, N = ro["actions"].shape
             self._env_steps += T * N
-            obs = ro["obs"]                       # [T, N, obs]
-            next_obs = np.concatenate(
-                [obs[1:], ro["last_obs"][None]], axis=0)
             flat = lambda a: a.reshape(T * N, *a.shape[2:])  # noqa: E731
-            self._buffer.add_batch(flat(obs), flat(ro["actions"]),
-                                   flat(ro["rewards"]), flat(next_obs),
-                                   flat(ro["dones"]))
+            # True successor states + env-true terminations: bootstraps
+            # through time-limit truncations and never aliases a reset
+            # obs as next_obs (see EnvRunner.sample).
+            self._buffer.add_batch(flat(ro["obs"]), flat(ro["actions"]),
+                                   flat(ro["rewards"]),
+                                   flat(ro["next_obs"]),
+                                   flat(ro["terminateds"]))
 
         metrics: Dict[str, Any] = {"env_steps": self._env_steps,
                                    "buffer_size": len(self._buffer),

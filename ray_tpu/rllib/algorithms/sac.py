@@ -1,0 +1,231 @@
+"""SAC — soft actor-critic for continuous control.
+
+Reference: `rllib/algorithms/sac/sac.py` (off-policy training_step over a
+replay buffer) and `sac/sac_learner.py` (twin-Q + squashed-Gaussian actor
++ entropy autotuning). TPU-first shape: actor, both critics, their target
+copies, and log_alpha live in ONE state pytree; the whole SAC update —
+critic + actor + alpha losses, one optimizer step, polyak target
+averaging — is a single jitted, donated call (`post_update_state` runs
+the polyak inside the same XLA program, so targets never round-trip to
+host).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env.spaces import Box
+
+_LOG_STD_MIN, _LOG_STD_MAX = -5.0, 2.0
+
+
+class SACModule(RLModule):
+    """Squashed-Gaussian actor + twin Q critics over flax.linen."""
+
+    def __init__(self, observation_space: Box, action_space: Box,
+                 hidden: Sequence[int] = (64, 64)):
+        import flax.linen as nn
+
+        obs_dim = int(np.prod(observation_space.shape))
+        act_dim = int(np.prod(action_space.shape))
+        self._act_scale = np.asarray(action_space.high,
+                                     np.float32).reshape(-1)
+
+        class _Actor(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = x
+                for width in hidden:
+                    h = nn.relu(nn.Dense(width)(h))
+                mean = nn.Dense(act_dim)(h)
+                log_std = jnp.clip(nn.Dense(act_dim)(h),
+                                   _LOG_STD_MIN, _LOG_STD_MAX)
+                return mean, log_std
+
+        class _Critic(nn.Module):
+            @nn.compact
+            def __call__(self, obs, act):
+                h = jnp.concatenate([obs, act], axis=-1)
+                for width in hidden:
+                    h = nn.relu(nn.Dense(width)(h))
+                return nn.Dense(1)(h)[..., 0]
+
+        self._actor, self._critic = _Actor(), _Critic()
+        self._obs_dim, self._act_dim = obs_dim, act_dim
+
+    def init(self, rng: jax.Array) -> Any:
+        k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+        obs = jnp.zeros((1, self._obs_dim), jnp.float32)
+        act = jnp.zeros((1, self._act_dim), jnp.float32)
+        return {
+            "actor": self._actor.init(k_pi, obs),
+            "q1": self._critic.init(k_q1, obs, act),
+            "q2": self._critic.init(k_q2, obs, act),
+            "log_alpha": jnp.asarray(0.0, jnp.float32),
+        }
+
+    # -------------------------------------------------------------- policy
+    def sample_action(self, actor_params, obs, rng):
+        """Reparameterized tanh-Gaussian sample -> (action, logp)."""
+        mean, log_std = self._actor.apply(actor_params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mean.shape)
+        pre = mean + std * eps
+        act = jnp.tanh(pre)
+        # logp under the squashed density: N(pre) - log|d tanh/d pre|
+        logp_gauss = (-0.5 * (eps ** 2 + 2 * log_std
+                              + jnp.log(2 * jnp.pi))).sum(-1)
+        logp = logp_gauss - jnp.log1p(-act ** 2 + 1e-6).sum(-1)
+        return act * self._act_scale, logp
+
+    def q_values(self, params, obs, act):
+        return (self._critic.apply(params["q1"], obs, act),
+                self._critic.apply(params["q2"], obs, act))
+
+    # ------------------------------------------------- env-runner protocol
+    def forward_exploration(self, params, obs, rng):
+        act, logp = self.sample_action(params["actor"], obs, rng)
+        return {"actions": act, "logp": logp,
+                "vf": jnp.zeros(obs.shape[0], jnp.float32)}
+
+    def forward_train(self, params, obs):
+        mean, _ = self._actor.apply(params["actor"], obs)
+        act = jnp.tanh(mean) * self._act_scale
+        return {"actions": act}
+
+
+class SACLearner(Learner):
+    def init_extra_state(self, params) -> Dict[str, Any]:
+        return {"target": {
+            "q1": jax.tree.map(jnp.copy, params["q1"]),
+            "q2": jax.tree.map(jnp.copy, params["q2"]),
+        }}
+
+    def compute_loss_from_state(self, state, batch, rng):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        target_entropy = cfg["target_entropy"]
+        params, target = state["params"], state["target"]
+        m: SACModule = self.module
+        k_next, k_pi = jax.random.split(rng)
+        alpha = jnp.exp(params["log_alpha"])
+        alpha_sg = jax.lax.stop_gradient(alpha)
+
+        # --- critic loss: y = r + gamma (min target-Q(s', a') - a logp')
+        a_next, logp_next = m.sample_action(
+            jax.lax.stop_gradient(params["actor"]), batch["next_obs"],
+            k_next)
+        tq1 = m._critic.apply(target["q1"], batch["next_obs"], a_next)
+        tq2 = m._critic.apply(target["q2"], batch["next_obs"], a_next)
+        y = batch["rewards"] + gamma * (
+            1.0 - batch["dones"].astype(jnp.float32)) * (
+            jnp.minimum(tq1, tq2) - alpha_sg * logp_next)
+        y = jax.lax.stop_gradient(y)
+        q1, q2 = m.q_values(params, batch["obs"], batch["actions"])
+        critic_loss = ((q1 - y) ** 2).mean() + ((q2 - y) ** 2).mean()
+
+        # --- actor loss: alpha logp - min Q (critic frozen)
+        a_pi, logp_pi = m.sample_action(params["actor"], batch["obs"], k_pi)
+        frozen = jax.lax.stop_gradient(
+            {"q1": params["q1"], "q2": params["q2"]})
+        fq1, fq2 = m.q_values(frozen, batch["obs"], a_pi)
+        actor_loss = (alpha_sg * logp_pi - jnp.minimum(fq1, fq2)).mean()
+
+        # --- alpha loss: autotune toward target entropy
+        alpha_loss = -(params["log_alpha"] * jax.lax.stop_gradient(
+            logp_pi + target_entropy)).mean()
+
+        loss = critic_loss + actor_loss + alpha_loss
+        return loss, {"critic_loss": critic_loss,
+                      "actor_loss": actor_loss,
+                      "alpha": alpha,
+                      "entropy": -logp_pi.mean(),
+                      "q1_mean": q1.mean()}
+
+    def post_update_state(self, state):
+        tau = self.config.get("tau", 0.005)
+        polyak = lambda t, o: (1.0 - tau) * t + tau * o  # noqa: E731
+        new_target = {
+            "q1": jax.tree.map(polyak, state["target"]["q1"],
+                               state["params"]["q1"]),
+            "q2": jax.tree.map(polyak, state["target"]["q2"],
+                               state["params"]["q2"]),
+        }
+        return {**state, "target": new_target}
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.lr = 3e-4
+        self.grad_clip = 10.0
+        self.tau = 0.005
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1000
+        self.train_batch_size = 256
+        self.rollout_fragment_length = 32
+        self.num_updates_per_iteration = 64
+        self.target_entropy = None     # default: -act_dim
+
+    algo_class = property(lambda self: SAC)
+
+
+class SAC(Algorithm):
+    learner_class = SACLearner
+    rl_module_class = SACModule
+
+    def __init__(self, config: SACConfig):
+        super().__init__(config)
+        act_space = self.module_spec.action_space
+        self._buffer = ReplayBuffer(
+            config.buffer_capacity,
+            self.module_spec.observation_space.shape,
+            action_shape=act_space.shape, action_dtype=np.float32)
+        self._rng = np.random.RandomState(config.seed)
+        self._env_steps = 0
+        self._updates = 0
+
+    def _learner_config(self) -> Dict[str, Any]:
+        out = super()._learner_config()
+        cfg = self.config
+        act_dim = int(np.prod(self.module_spec.action_space.shape))
+        out["gamma"] = cfg.gamma
+        out["tau"] = cfg.tau
+        out["target_entropy"] = (cfg.target_entropy
+                                 if cfg.target_entropy is not None
+                                 else -float(act_dim))
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollouts = self.sample_batch(cfg.rollout_fragment_length)
+        for ro in rollouts:
+            T, N = ro["actions"].shape[:2]
+            self._env_steps += T * N
+            flat = lambda a: a.reshape(T * N, *a.shape[2:])  # noqa: E731
+            # terminateds (not dones): TD targets bootstrap through
+            # time-limit truncations; next_obs is the true successor.
+            self._buffer.add_batch(flat(ro["obs"]), flat(ro["actions"]),
+                                   flat(ro["rewards"]),
+                                   flat(ro["next_obs"]),
+                                   flat(ro["terminateds"]))
+
+        metrics: Dict[str, Any] = {"env_steps": self._env_steps,
+                                   "buffer_size": len(self._buffer)}
+        if len(self._buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                batch = self._buffer.sample(cfg.train_batch_size, self._rng)
+                metrics.update(self.learner_group.update(batch))
+                self._updates += 1
+        self._sync_weights()
+        metrics["num_gradient_updates"] = self._updates
+        return metrics

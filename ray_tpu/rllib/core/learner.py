@@ -65,8 +65,9 @@ class Learner:
             metrics = dict(metrics)
             metrics["total_loss"] = loss
             metrics["grad_norm"] = optax.global_norm(grads)
-            return {**state, "params": new_params,
-                    "opt_state": new_opt}, metrics
+            new_state = self.post_update_state(
+                {**state, "params": new_params, "opt_state": new_opt})
+            return new_state, metrics
 
         self._update_fn = jax.jit(_update, donate_argnums=(0,))
 
@@ -84,6 +85,12 @@ class Learner:
         """Extra entries merged into the learner state pytree (carried
         through jitted updates untouched)."""
         return {}
+
+    def post_update_state(self, state):
+        """Traced inside the jitted update, after the optimizer step —
+        the hook for per-update state transforms (e.g. SAC's polyak
+        target averaging). Must be pure."""
+        return state
 
     # ----------------------------------------------------------------- update
     def update(self, batch: Dict[str, np.ndarray],

@@ -74,7 +74,13 @@ class CartPoleEnv:
         return self._state.copy(), 1.0, terminated, truncated, {}
 
 
-_ENV_REGISTRY = {"CartPole-v1": CartPoleEnv}
+def _pendulum(seed=None):
+    from ray_tpu.rllib.env.pendulum import PendulumEnv
+
+    return PendulumEnv(seed=seed)
+
+
+_ENV_REGISTRY = {"CartPole-v1": CartPoleEnv, "Pendulum-v1": _pendulum}
 
 
 def register_env(name: str, ctor) -> None:

@@ -51,7 +51,7 @@ class EnvRunner:
 
         n_envs = len(self._envs)
         obs_buf, act_buf, logp_buf, rew_buf = [], [], [], []
-        done_buf, vf_buf = [], []
+        done_buf, term_buf, next_obs_buf, vf_buf = [], [], [], []
 
         with jax.default_device(self._cpu):
             for _ in range(num_steps):
@@ -66,10 +66,19 @@ class EnvRunner:
 
                 rewards = np.zeros(n_envs, np.float32)
                 dones = np.zeros(n_envs, bool)
+                terms = np.zeros(n_envs, bool)
+                next_obs = np.empty_like(self._obs)
+                discrete = np.issubdtype(actions.dtype, np.integer)
                 for i, env in enumerate(self._envs):
-                    obs, r, term, trunc, _ = env.step(int(actions[i]))
+                    act = int(actions[i]) if discrete else actions[i]
+                    obs, r, term, trunc, _ = env.step(act)
                     rewards[i] = r
                     self._episode_returns[i] += r
+                    # The TRUE successor state, before any auto-reset —
+                    # TD targets must bootstrap from this, never from the
+                    # next episode's reset obs.
+                    next_obs[i] = obs
+                    terms[i] = term
                     if term or trunc:
                         dones[i] = True
                         self._completed.append(self._episode_returns[i])
@@ -78,6 +87,8 @@ class EnvRunner:
                     self._obs[i] = obs
                 rew_buf.append(rewards)
                 done_buf.append(dones)
+                term_buf.append(terms)
+                next_obs_buf.append(next_obs.copy())
 
             # Bootstrap value for the final observation of each env lane.
             self._rng, key = jax.random.split(self._rng)
@@ -91,7 +102,12 @@ class EnvRunner:
             "actions": np.stack(act_buf),
             "logp": np.stack(logp_buf),
             "rewards": np.stack(rew_buf),
+            # dones = terminated | truncated (episode accounting / GAE
+            # cuts); terminateds = env-true termination only (TD targets
+            # bootstrap through time-limit truncations).
             "dones": np.stack(done_buf),
+            "terminateds": np.stack(term_buf),
+            "next_obs": np.stack(next_obs_buf),
             "vf": np.stack(vf_buf),
             "last_vf": last_vf,
             # Final observation per env lane: lets value-based algorithms

@@ -1,0 +1,66 @@
+"""Pendulum — the classic continuous-control swing-up task (the standard
+benchmark SAC/DDPG-family algorithms are smoke-tested on; reference:
+RLlib's use of gymnasium Pendulum-v1 in `rllib/algorithms/sac/`).
+
+Physics (textbook inverted-pendulum):
+    theta'' = 3g/(2l) sin(theta) + 3/(m l^2) u
+Observation: [cos theta, sin theta, theta'], action: torque in
+[-2, 2], reward: -(theta^2 + 0.1 theta'^2 + 0.001 u^2), horizon 200.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.env.spaces import Box
+
+_G, _M, _L, _DT = 10.0, 1.0, 1.0, 0.05
+_MAX_SPEED, _MAX_TORQUE, _HORIZON = 8.0, 2.0, 200
+
+
+def _angle_normalize(x: float) -> float:
+    return ((x + np.pi) % (2 * np.pi)) - np.pi
+
+
+class PendulumEnv:
+    observation_space = Box(
+        low=np.array([-1.0, -1.0, -_MAX_SPEED], np.float32),
+        high=np.array([1.0, 1.0, _MAX_SPEED], np.float32))
+    action_space = Box(low=np.array([-_MAX_TORQUE], np.float32),
+                       high=np.array([_MAX_TORQUE], np.float32))
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.RandomState(seed)
+        self._theta = 0.0
+        self._thetadot = 0.0
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._theta), np.sin(self._theta),
+                         self._thetadot], np.float32)
+
+    def reset(self, *, seed: Optional[int] = None
+              ) -> Tuple[np.ndarray, dict]:
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._theta = self._rng.uniform(-np.pi, np.pi)
+        self._thetadot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action) -> Tuple[np.ndarray, float, bool, bool, dict]:
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -_MAX_TORQUE, _MAX_TORQUE))
+        th, thdot = self._theta, self._thetadot
+        cost = (_angle_normalize(th) ** 2 + 0.1 * thdot ** 2
+                + 0.001 * u ** 2)
+        thdot = thdot + (3 * _G / (2 * _L) * np.sin(th)
+                         + 3.0 / (_M * _L ** 2) * u) * _DT
+        thdot = float(np.clip(thdot, -_MAX_SPEED, _MAX_SPEED))
+        th = th + thdot * _DT
+        self._theta, self._thetadot = th, thdot
+        self._t += 1
+        truncated = self._t >= _HORIZON
+        return self._obs(), -cost, False, truncated, {}
