@@ -171,6 +171,36 @@ def cmd_metrics(args) -> None:
     ray_tpu.shutdown()
 
 
+def cmd_serve(args) -> None:
+    ray_tpu = _connect(args)
+    from ray_tpu import serve
+
+    if args.serve_cmd == "deploy":
+        names = serve.deploy_config_file(args.config)
+        print(f"deployed applications: {', '.join(names)}")
+    elif args.serve_cmd == "run":
+        app = serve.import_application(args.import_path)
+        serve.run(app, name=args.name,
+                  route_prefix=args.route_prefix)
+        print(f"application '{args.name}' running "
+              f"(ingress: {args.import_path})")
+    elif args.serve_cmd == "status":
+        apps = serve.list_applications()
+        if not apps:
+            print("serve is not running (no applications deployed)")
+            ray_tpu.shutdown()
+            return
+        rows = []
+        for app in apps:
+            for d in serve.status(app):
+                rows.append({"app": app, **d})
+        _print_table(rows)
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+    ray_tpu.shutdown()
+
+
 def cmd_timeline(args) -> None:
     ray_tpu = _connect(args)
     trace = ray_tpu.timeline(filename=args.output)
@@ -232,6 +262,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     p = sub.add_parser("timeline", help="dump chrome-trace task timeline")
     p.add_argument("-o", "--output", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("serve", help="model-serving control")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    pd = ssub.add_parser("deploy", help="deploy a YAML config file")
+    pd.add_argument("config")
+    pr = ssub.add_parser("run", help="run an app by import path")
+    pr.add_argument("import_path", help="module.sub:app")
+    pr.add_argument("--name", default="default")
+    pr.add_argument("--route-prefix", default=None)
+    ssub.add_parser("status")
+    ssub.add_parser("shutdown")
+    p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
     args.fn(args)

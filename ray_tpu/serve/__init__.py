@@ -11,15 +11,24 @@ control plane stays on CPU.
 """
 
 from ray_tpu.serve.api import (
-    Application, Deployment, delete, deployment, get_app_handle, run,
-    shutdown, start, status,
+    Application, Deployment, delete, deployment, get_app_handle,
+    list_applications, run, shutdown, start, status,
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.schema import (
+    deploy_config, deploy_config_file, import_application,
+)
 
 __all__ = [
     "Application", "Deployment", "DeploymentHandle", "batch", "delete",
-    "deployment", "get_app_handle", "get_multiplexed_model_id",
+    "deploy_config", "deploy_config_file", "deployment", "get_app_handle",
+    "get_multiplexed_model_id", "import_application", "list_applications",
     "multiplexed", "run", "shutdown", "start", "status",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("serve")
+del _rlu

@@ -129,8 +129,15 @@ def start(http_port: int = 0):
 
 
 def run(app: Application, *, name: str = "default",
-        route_prefix: Optional[str] = None) -> DeploymentHandle:
-    """Deploy (or redeploy) an application; returns its ingress handle."""
+        route_prefix: Optional[str] = None,
+        _overrides: Optional[Dict[str, Dict[str, Any]]] = None
+        ) -> DeploymentHandle:
+    """Deploy (or redeploy) an application; returns its ingress handle.
+
+    `_overrides` maps deployment name -> spec overrides; it is how
+    declarative config deploys (`serve/schema.py`) re-tune a code-defined
+    app without editing code (reference: config fields shadow @deployment
+    options)."""
     from ray_tpu.serve._private.controller import get_or_create_controller
 
     controller = get_or_create_controller()
@@ -140,6 +147,35 @@ def run(app: Application, *, name: str = "default",
         for spec in specs:
             if spec["is_ingress"]:
                 spec["route_prefix"] = route_prefix
+    if _overrides:
+        unknown = set(_overrides) - {s["name"] for s in specs}
+        if unknown:
+            raise ValueError(
+                f"config overrides reference deployment(s) "
+                f"{sorted(unknown)} not present in app {name!r} "
+                f"(has {sorted(s['name'] for s in specs)})")
+        for spec in specs:
+            ov = dict(_overrides.get(spec["name"], ()))
+            if not ov:
+                continue
+            wants_auto = ov.get("num_replicas") == "auto"
+            if wants_auto:
+                ov.pop("num_replicas")
+            if wants_auto or "autoscaling_config" in ov:
+                # Same defaults merge _collect applies to code-defined
+                # configs — a partial config dict must never reach the
+                # controller (reconcile KeyErrors on missing knobs).
+                auto = {
+                    "min_replicas": 1, "max_replicas": 4,
+                    "target_ongoing_requests": 2,
+                    "upscale_delay_s": 2.0, "downscale_delay_s": 10.0,
+                    **(spec.get("autoscaling_config") or {}),
+                    **(ov.get("autoscaling_config") or {}),
+                }
+                ov["autoscaling_config"] = auto
+                if wants_auto:
+                    ov["num_replicas"] = auto["min_replicas"]
+            spec.update(ov)
     ray_tpu.get(controller.deploy_application.remote(name, specs),
                 timeout=120)
     return handle
@@ -160,6 +196,18 @@ def status(name: str = "default") -> List[Dict[str, Any]]:
 
     return ray_tpu.get(
         get_or_create_controller().list_deployments.remote(name), timeout=30)
+
+
+def list_applications() -> List[str]:
+    """Names of deployed applications; [] when serve was never started
+    (read-only: does NOT spawn a controller)."""
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return []
+    return ray_tpu.get(controller.list_applications.remote(), timeout=30)
 
 
 def delete(name: str) -> None:

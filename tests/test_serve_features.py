@@ -197,3 +197,103 @@ def test_serve_autoscaling_e2e(serve_cluster):
         time.sleep(1.0)
     assert replica_count() == 1, "never scaled down"
     serve.delete("auto_app")
+
+
+# ------------------------------------------------------- config deploys
+
+APP_BUILDER_MODULE = """
+from ray_tpu import serve
+
+@serve.deployment(num_cpus=0.1)
+class Doubler:
+    def __call__(self, x):
+        return x * 2
+
+@serve.deployment(num_cpus=0.1)
+class Ingress:
+    def __init__(self, doubler, bias=0):
+        self.doubler = doubler
+        self.bias = bias
+    def __call__(self, x):
+        return self.doubler.remote(x).result(timeout=30) + self.bias
+
+prebuilt = Ingress.bind(Doubler.bind())
+
+def build(bias=0):
+    return Ingress.bind(Doubler.bind(), bias=bias)
+"""
+
+
+def test_schema_validation_units():
+    from ray_tpu.serve.schema import DeploySchema, SchemaError
+
+    ok = {"applications": [
+        {"name": "a", "import_path": "m:app", "route_prefix": "/a",
+         "deployments": [{"name": "D", "num_replicas": 2}]},
+        {"name": "b", "import_path": "m:other"},
+    ]}
+    schema = DeploySchema.parse(ok)
+    assert [a.name for a in schema.applications] == ["a", "b"]
+    assert schema.applications[0].deployments[0].overrides == {
+        "num_replicas": 2}
+
+    with pytest.raises(SchemaError, match="applications"):
+        DeploySchema.parse({})
+    with pytest.raises(SchemaError, match="import_path"):
+        DeploySchema.parse({"applications": [{"name": "x"}]})
+    with pytest.raises(SchemaError, match="duplicate application"):
+        DeploySchema.parse({"applications": [
+            {"name": "a", "import_path": "m:x"},
+            {"name": "a", "import_path": "m:y"}]})
+    with pytest.raises(SchemaError, match="unknown field"):
+        DeploySchema.parse({"applications": [
+            {"name": "a", "import_path": "m:x", "replicas": 3}]})
+    with pytest.raises(SchemaError, match="num_replicas"):
+        DeploySchema.parse({"applications": [
+            {"name": "a", "import_path": "m:x",
+             "deployments": [{"name": "D", "num_replicas": -1}]}]})
+
+
+def test_config_file_deploy(serve_cluster, tmp_path):
+    """YAML config -> import_path app build -> per-deployment overrides
+    land in the controller (reference: `serve deploy` + schema.py)."""
+    import sys
+
+    import yaml
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    (tmp_path / "cfg_app_mod.py").write_text(APP_BUILDER_MODULE)
+    sys.path.insert(0, str(tmp_path))
+    try:
+        cfg = {"applications": [
+            {"name": "cfg_app", "import_path": "cfg_app_mod:build",
+             "route_prefix": "/cfg", "args": {"bias": 5},
+             "deployments": [
+                 {"name": "Doubler", "num_replicas": 2},
+                 {"name": "Ingress", "max_ongoing_requests": 4},
+             ]},
+        ]}
+        path = tmp_path / "serve.yaml"
+        path.write_text(yaml.safe_dump(cfg))
+        assert serve.deploy_config_file(str(path)) == ["cfg_app"]
+
+        handle = serve.get_app_handle("cfg_app")
+        assert handle.remote(10).result(timeout=60) == 25  # 10*2+5
+
+        stat = {d["name"]: d for d in serve.status("cfg_app")}
+        assert stat["Doubler"]["num_replicas"] == 2
+        # Bound-Application import path works too; overrides must name
+        # real deployments.
+        serve.run(serve.import_application("cfg_app_mod:prebuilt"),
+                  name="cfg_pre")
+        assert serve.get_app_handle(
+            "cfg_pre").remote(3).result(timeout=60) == 6
+        with pytest.raises(ValueError, match="not present in app"):
+            serve.run(serve.import_application("cfg_app_mod:prebuilt"),
+                      name="cfg_bad", _overrides={"Nope": {}})
+        serve.delete("cfg_app")
+        serve.delete("cfg_pre")
+    finally:
+        sys.path.remove(str(tmp_path))
