@@ -199,6 +199,25 @@ def shutdown() -> None:
                            timeout=5)
             except Exception:
                 pass
+            if _local_node is not None:
+                try:  # local usage report (reference: usage_stats ping)
+                    from ray_tpu._private import usage_stats
+
+                    # Opt-out guards the RPCs too, not just the write.
+                    if usage_stats.usage_stats_enabled():
+                        caps = w.gcs.call("cluster_resources", timeout=5)
+                        n_nodes = len(
+                            w.gcs.call("get_all_nodes", timeout=5) or [])
+                        usage_stats.write_report(
+                            _local_node.session_dir, {
+                                "session_id": os.path.basename(
+                                    _local_node.session_dir),
+                                "num_nodes": n_nodes,
+                                "num_cpus": caps.get("CPU"),
+                                "num_tpus": caps.get("TPU"),
+                            })
+                except Exception:
+                    pass
             w.shutdown()
         if _local_node is not None:
             _local_node.shutdown()

@@ -869,7 +869,9 @@ class Worker:
             demand = await self._pg_demand(strategy, demand)
             if demand is None:
                 return None, None
-        while time.monotonic() < deadline:
+        while True:
+            if spec.task_id.binary() in self._cancelled_tasks:
+                return None, None
             try:
                 reply = await client.acall(
                     "request_worker_lease",
@@ -893,11 +895,16 @@ class Worker:
             if reply.get("infeasible"):
                 # Infeasible *now* may become feasible (node still joining,
                 # PG bundle resources propagating); back off and retry until
-                # the lease deadline, as the reference's infeasible queue does.
+                # the lease deadline, as the reference's infeasible queue
+                # does. Only truly-infeasible demand hits this deadline —
+                # a feasible-but-busy cluster queues indefinitely below,
+                # matching the reference's pending-task queue (a saturated
+                # cluster must never fail tasks with a timeout).
+                if time.monotonic() >= deadline:
+                    return None, None
                 await asyncio.sleep(0.2)
                 continue
             await asyncio.sleep(0.05)
-        return None, None
 
     async def _pg_demand(self, strategy: SchedulingStrategySpec,
                          demand: ResourceSet) -> Optional[ResourceSet]:
@@ -1083,8 +1090,22 @@ class Worker:
                 reply = await push
             except (ConnectionLost, OSError):
                 self._actor_addr_cache.pop(actor_id, None)
-                info = await self.gcs.acall("get_actor_info",
-                                            actor_id=actor_id, timeout=30)
+                # The GCS learns of the death via the raylet's worker-exit
+                # report, which races this query: an immediate read can
+                # return stale ALIVE with an unchanged incarnation and
+                # misclassify a plain death as "restarted". Poll until the
+                # state moves off the pre-failure snapshot (or ~5s).
+                prev_inc = self._actor_incarnation.get(actor_id, 0)
+                info = None
+                for _ in range(25):
+                    info = await self.gcs.acall("get_actor_info",
+                                                actor_id=actor_id,
+                                                timeout=30)
+                    state = (info or {}).get("state")
+                    if state != "ALIVE" or (info or {}).get(
+                            "restarts_used", 0) != prev_inc:
+                        break
+                    await asyncio.sleep(0.2)
                 state = (info or {}).get("state")
                 # Sequence numbers reset only when the actor PROCESS was
                 # replaced (incarnation bump), not on a transient network
@@ -1229,11 +1250,12 @@ class Worker:
         # (ray.kill() has already returned to the user by then).
         self._killed = True
         try:  # last-gasp user-metric flush (bounded; best effort)
-            from ray_tpu.util.metrics import snapshot_records
+            from ray_tpu.util.metrics import metric_source, snapshot_records
             recs = snapshot_records()
             if recs:
                 await asyncio.wait_for(
-                    self.gcs.acall("push_metrics", source=str(os.getpid()),
+                    self.gcs.acall("push_metrics",
+                                   source=metric_source(self),
                                    records=recs, timeout=1), 1.0)
         except Exception:
             pass

@@ -118,3 +118,8 @@ __all__ = [
     "from_huggingface", "from_torch", "Datasink", "ParquetDatasink",
     "CSVDatasink", "JSONDatasink",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("data")
+del _rlu

@@ -19,3 +19,8 @@ __all__ = [
     "FailureConfig", "CheckpointConfig", "Checkpoint", "Result",
     "report", "get_context", "get_dataset_shard", "TrainingFailedError",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("train")
+del _rlu

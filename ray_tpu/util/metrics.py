@@ -193,11 +193,18 @@ def _flush_once() -> bool:
     if not snaps:
         return True
     try:
-        w.gcs.call("push_metrics", source=f"{os.getpid()}",
+        w.gcs.call("push_metrics", source=metric_source(w),
                    records=snaps, timeout=5)
         return True
     except Exception:
         return False
+
+
+def metric_source(worker) -> str:
+    """Cluster-unique push key: bare pid collides across nodes."""
+    wid = getattr(worker, "worker_id", None)
+    suffix = wid.binary().hex()[:8] if wid is not None else "local"
+    return f"{os.getpid()}@{suffix}"
 
 
 def _ensure_flusher() -> None:

@@ -1,0 +1,203 @@
+"""multiprocessing.Pool API over ray_tpu tasks (reference:
+`python/ray/util/multiprocessing/pool.py` — drop-in Pool whose workers
+are actors; here map-style calls fan out as tasks and `imap` streams
+results in completion order or submission order).
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=8) as p:
+        print(p.map(f, range(100)))
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from typing import Any, Callable, Iterable, List, Optional, Set
+
+import ray_tpu
+
+# Worker-process-side record of which pools already ran their initializer
+# there — stdlib Pool contract: initializer runs once per worker process,
+# not once per task.
+_WORKER_INITED: Set[str] = set()
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        try:
+            ray_tpu.get(self._refs, timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Task-backed process pool. `processes` caps in-flight tasks (the
+    cluster scheduler does the real placement)."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        if processes is not None and processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._processes = processes or 8
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+        self._pool_id = uuid.uuid4().hex
+        # One exported remote function per (func, kind) — re-exporting a
+        # fresh closure per call would grow cluster function state without
+        # bound on long-lived drivers.
+        self._task_cache: dict = {}
+
+    # ------------------------------------------------------------ internal
+    def _task(self, func: Callable, kind: str = "item"):
+        key = (func, kind)
+        cached = self._task_cache.get(key)
+        if cached is not None:
+            return cached
+        init, initargs, pool_id = (self._initializer, self._initargs,
+                                   self._pool_id)
+
+        def _ensure_init():
+            if init is None:
+                return
+            from ray_tpu.util import multiprocessing as _mp
+
+            if pool_id not in _mp._WORKER_INITED:
+                _mp._WORKER_INITED.add(pool_id)
+                init(*initargs)
+
+        if kind == "item":
+            @ray_tpu.remote
+            def _call(*args, **kwargs):
+                _ensure_init()
+                return func(*args, **kwargs)
+        elif kind == "chunk":
+            @ray_tpu.remote
+            def _call(xs):
+                _ensure_init()
+                return [func(x) for x in xs]
+        else:  # starchunk
+            @ray_tpu.remote
+            def _call(xs):
+                _ensure_init()
+                return [func(*x) for x in xs]
+
+        self._task_cache[key] = _call
+        return _call
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    @staticmethod
+    def _star(args: Any) -> tuple:
+        return tuple(args) if isinstance(args, (tuple, list)) else (args,)
+
+    # ----------------------------------------------------------------- api
+    def apply(self, func, args=(), kwds=None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args=(), kwds=None) -> AsyncResult:
+        self._check_open()
+        task = self._task(func, "item")
+        return AsyncResult([task.remote(*args, **(kwds or {}))],
+                           single=True)
+
+    def map(self, func, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        self._check_open()
+        items = list(iterable)
+        chunk = chunksize or max(1, len(items) // (self._processes * 4) or 1)
+        task = self._task(func, "chunk")
+        refs = [task.remote(items[i:i + chunk])
+                for i in range(0, len(items), chunk)]
+        flat = _FlatteningResult(refs)
+        return flat
+
+    def starmap(self, func, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check_open()
+        items = [self._star(a) for a in iterable]
+        chunk = chunksize or max(1, len(items) // (self._processes * 4) or 1)
+        task = self._task(func, "starchunk")
+        refs = [task.remote(items[i:i + chunk])
+                for i in range(0, len(items), chunk)]
+        return _FlatteningResult(refs).get()
+
+    def imap(self, func, iterable: Iterable,
+             chunksize: int = 1) -> Iterable[Any]:
+        """Submission-order streaming, bounded in-flight window. Like
+        stdlib Pool.imap, blocks without timeout on each item."""
+        self._check_open()
+        task = self._task(func, "item")
+        window = self._processes * 2
+        it = iter(iterable)
+        pending: List[Any] = [task.remote(x)
+                              for x in itertools.islice(it, window)]
+        while pending:
+            yield ray_tpu.get(pending.pop(0))
+            for x in itertools.islice(it, 1):
+                pending.append(task.remote(x))
+
+    def imap_unordered(self, func, iterable: Iterable,
+                       chunksize: int = 1) -> Iterable[Any]:
+        """Completion-order streaming."""
+        self._check_open()
+        task = self._task(func, "item")
+        window = self._processes * 2
+        it = iter(iterable)
+        pending = [task.remote(x) for x in itertools.islice(it, window)]
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            yield ray_tpu.get(done[0])
+            for x in itertools.islice(it, 1):
+                pending.append(task.remote(x))
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()
+
+
+class _FlatteningResult(AsyncResult):
+    def get(self, timeout: Optional[float] = None):
+        chunks = ray_tpu.get(self._refs, timeout=timeout)
+        return [x for c in chunks for x in c]

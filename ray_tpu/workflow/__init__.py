@@ -202,3 +202,8 @@ def list_all() -> List[Dict[str, Any]]:
         if status is not None:
             out.append({"workflow_id": wf_id, "status": status})
     return out
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("workflow")
+del _rlu

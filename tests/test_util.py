@@ -74,3 +74,66 @@ def test_queue_blocking_timeout(ray_start_regular):
     with pytest.raises(Empty):
         q.get(timeout=0.2)
     q.shutdown()
+
+
+# -------------------------------------------------- multiprocessing.Pool
+
+def test_pool_map_starmap_apply(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=4) as p:
+        assert p.map(lambda x: x * x, range(20)) == [
+            x * x for x in range(20)]
+        assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert p.apply(lambda a, b: a * b, (3, 4)) == 12
+        res = p.apply_async(lambda: "ok")
+        assert res.get(timeout=30) == "ok"
+        assert res.ready() and res.successful()
+    with pytest.raises(ValueError):
+        p.map(lambda x: x, [1])         # closed
+
+
+def test_pool_imap_ordering(ray_start_regular):
+    import time as _t
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    def slow_first(x):
+        if x == 0:
+            _t.sleep(1.0)
+        return x
+
+    with Pool(processes=4) as p:
+        # imap preserves submission order even when item 0 is slowest.
+        assert list(p.imap(slow_first, range(6))) == list(range(6))
+        # imap_unordered yields everything, order-free.
+        assert sorted(p.imap_unordered(slow_first, range(6))) == list(
+            range(6))
+        # initializer runs in the worker before the function.
+        p2 = Pool(processes=2, initializer=lambda v: None, initargs=(1,))
+        assert p2.map(lambda x: x + 1, [1, 2]) == [2, 3]
+
+
+# -------------------------------------------------------- usage stats
+
+def test_usage_stats_report(tmp_path, monkeypatch):
+    from ray_tpu._private import usage_stats
+
+    usage_stats.record_library_usage("train")
+    usage_stats.record_extra_usage_tag("test_tag", "42")
+    path = usage_stats.write_report(
+        str(tmp_path), {"session_id": "s1", "num_nodes": 1,
+                        "num_cpus": 8.0, "num_tpus": 0.0})
+    assert path is not None
+    import json
+
+    report = json.load(open(path))
+    assert report["source"] == "ray_tpu"
+    assert "train" in report["libraries_used"]
+    assert report["extra_usage_tags"]["test_tag"] == "42"
+    assert report["total_num_cpus"] == 8.0
+
+    # Opt-out honored.
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    assert not usage_stats.usage_stats_enabled()
+    assert usage_stats.write_report(str(tmp_path), {}) is None
