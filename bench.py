@@ -4,8 +4,8 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Metric: training tokens/sec/chip for a ~1B-param Llama-family decoder
-(bf16 params+compute, AdamW, flash-attention pallas kernel, per-layer
-remat, donated train state, 2 steps per dispatch via lax.scan).
+(bf16 params+compute, AdamW, flash-attention pallas kernel, dots-policy
+remat, donated train state, 4 steps per dispatch via lax.scan).
 
 Baseline normalization: the reference stack publishes no absolute
 samples/sec (BASELINE.md) — its northstar is "matching NCCL-GPU
@@ -48,10 +48,14 @@ def _bench_config(on_tpu: bool):
         # ~1B-param Llama (llama2 width, 4 layers): large matmuls saturate
         # the MXU; remat + donation keep HBM under the 16 GiB budget at
         # batch 16.
+        # remat="dots" (keep matmul outputs, recompute elementwise) beats
+        # full per-layer remat by ~2.5 MFU points at the same batch 16
+        # (full remat at batch 20/24 is slower than dots at 16 — see
+        # PERF.md round-2 sweep).
         return LlamaConfig(
             vocab_size=32000, dim=4096, n_layers=4, n_heads=32,
             n_kv_heads=8, hidden_dim=11008, max_seq_len=1024,
-            attn_impl="flash", remat=True,
+            attn_impl="flash", remat="dots",
             param_dtype=jnp.bfloat16), 16, 1024, 4
     return LlamaConfig.tiny(), 4, 64, 2
 
@@ -71,7 +75,9 @@ def main() -> None:
     device_kind = jax.devices()[0].device_kind
     on_tpu = "TPU" in device_kind or "tpu" in device_kind.lower()
     config, batch, seq, timed_rounds = _bench_config(on_tpu)
-    steps_per_call = 2
+    # 4 steps per jit call: the tunneled host's ~100ms dispatch+readback
+    # amortizes to ~2% of step time (K=2 left ~4% on the table).
+    steps_per_call = 4
 
     mesh = make_mesh({"data": -1})
     optimizer = optax.adamw(1e-4)

@@ -44,7 +44,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16   # activation/matmul dtype
     param_dtype: Any = jnp.float32
     attn_impl: str = "xla"      # "xla" | "flash" | "ring"
-    remat: bool = False          # jax.checkpoint each layer (HBM for FLOPs)
+    # False | True (full per-layer jax.checkpoint) | "dots" (checkpoint
+    # with dots-saveable policy: keep matmul outputs, recompute the rest)
+    remat: Any = False
     tie_embeddings: bool = False
     # Mixture-of-Experts FFN (0 = dense). Experts shard over the mesh
     # "expert" axis (SURVEY §2.7 EP; see models/moe.py).
@@ -304,7 +306,17 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
     x = embed_lookup(params["embed"].astype(c.dtype), tokens)
 
     layer_fn = partial(_layer, c, cos, sin, attn_fn)
-    if c.remat:
+    if isinstance(c.remat, str) and c.remat != "dots":
+        raise ValueError(
+            f"remat={c.remat!r}: expected False, True, or 'dots'")
+    if c.remat == "dots":
+        # Keep matmul outputs, recompute only cheap elementwise ops on
+        # the backward — ~5x less recompute than full remat at a modest
+        # HBM premium (policy: dots_with_no_batch_dims_saveable).
+        layer_fn = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif c.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
     def scan_body(x, layer_params):

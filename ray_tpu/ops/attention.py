@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -355,9 +356,26 @@ def _xla_attention(q, k, v, causal):
     return xla_attention(q, k, v, causal=causal)
 
 
-def _blocks(S: int, Sk: int) -> Tuple[int, int]:
-    bq = 512 if S % 512 == 0 else 128
-    bk = 512 if Sk % 512 == 0 else 128
+def _blocks(S: int, Sk: int, causal: bool = True) -> Tuple[int, int]:
+    """Tile sizes for the pallas grid (RAY_TPU_FLASH_BLOCK_Q/K override
+    for tuning sweeps). In causal mode divisibility is NOT required:
+    `_prep` pads the sequence up to the tile multiple, padded keys are
+    excluded by the kernel's absolute-index masks, and padded query rows
+    are sliced off the output. Non-causal has no mask to hide padded
+    keys behind, so its key tile must divide Sk exactly."""
+    def _env(name: str) -> int:
+        raw = os.environ.get(name, "").strip()
+        return int(raw) if raw.isdigit() else 0
+
+    pad_s = -(-S // _LANE) * _LANE
+    pad_sk = -(-Sk // _LANE) * _LANE
+    # v5e sweep at seq 1024 / head dim 128 (PERF.md): bigger tiles win
+    # monotonically up to 1024 (68.9% MFU vs 53.5% at 128-tiles); 1024
+    # caps VMEM use for long sequences.
+    bq = min(_env("RAY_TPU_FLASH_BLOCK_Q") or 1024, pad_s)
+    bk = min(_env("RAY_TPU_FLASH_BLOCK_K") or 1024, pad_sk)
+    if not causal and Sk % bk:
+        bk = _LANE  # caller enforces Sk % 128 == 0 for non-causal
     return bq, bk
 
 
@@ -388,7 +406,7 @@ def _flash_fwd(q, k, v, causal):
     if not causal and (S % 128 or Sk % 128):
         raise NotImplementedError(
             "non-causal flash requires seq_len % 128 == 0")
-    block_q, block_k = _blocks(S, Sk)
+    block_q, block_k = _blocks(S, Sk, causal)
     qt, kt, vt = _prep(q, block_q), _prep(k, block_k), _prep(v, block_k)
     out, lse = _flash_fwd_bhsd(qt, kt, vt, causal, block_q, block_k,
                                scale=1.0 / math.sqrt(D))
@@ -404,7 +422,7 @@ def _flash_bwd(causal, residuals, g):
             lambda q, k, v: _xla_attention(q, k, v, causal), q, k, v)
         return vjp(g)
     Sk = k.shape[1]
-    block_q, block_k = _blocks(S, Sk)
+    block_q, block_k = _blocks(S, Sk, causal)
     qt, kt, vt = _prep(q, block_q), _prep(k, block_k), _prep(v, block_k)
     do = _prep(g.astype(q.dtype), block_q)
     dq, dk, dv = _bhsd_bwd(qt, kt, vt, do, o_pad, lse, causal,
