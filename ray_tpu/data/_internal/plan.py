@@ -128,3 +128,18 @@ def split_stages(ops: List[Op]) -> List[Any]:
     if run:
         stages.append(list(run))
     return stages
+
+
+@dataclasses.dataclass
+class Union(Op):
+    """Concatenate other datasets' streams after this one (reference:
+    `Dataset.union`)."""
+    branches: List[List[Op]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Zip(Op):
+    """Column-wise zip with another dataset, row-aligned (reference:
+    `Dataset.zip`; right-hand duplicate column names get an `_1`
+    suffix)."""
+    other: List[Op] = dataclasses.field(default_factory=list)

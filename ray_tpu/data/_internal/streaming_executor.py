@@ -122,8 +122,62 @@ class StreamingExecutor:
         elif isinstance(head, plan_mod.RandomShuffle):
             yield from self._apply_rest(
                 self._shuffle(list(source), head.seed), rest)
+        elif isinstance(head, plan_mod.Union):
+            def unioned():
+                yield from source
+                for branch in head.branches:
+                    yield from StreamingExecutor(
+                        branch, self._in_flight).stream_blocks()
+            yield from self._apply_rest(unioned(), rest)
+        elif isinstance(head, plan_mod.Zip):
+            yield from self._apply_rest(self._zip(source, head.other), rest)
         else:
             raise TypeError(f"unsupported stage {head}")
+
+    def _zip(self, source: Iterator[Any], other_ops: List[Any]
+             ) -> Iterator[Any]:
+        """Column-wise arrow merge with block realignment — no per-row
+        Python dict churn; only block slicing happens driver-side."""
+        import pyarrow as pa
+
+        right_iter = StreamingExecutor(
+            other_ops, self._in_flight).stream_blocks()
+        rbuf: list = []      # right arrow tables not yet consumed
+        rrows = 0
+
+        def take(n: int) -> "pa.Table":
+            nonlocal rrows
+            while rrows < n:
+                nxt = next(right_iter, None)
+                if nxt is None:
+                    raise ValueError(
+                        "zip(): right dataset has fewer rows than left")
+                t = BlockAccessor(nxt).table
+                rbuf.append(t)
+                rrows += t.num_rows
+            parts, need = [], n
+            while need:
+                t = rbuf[0]
+                if t.num_rows <= need:
+                    parts.append(rbuf.pop(0))
+                    need -= t.num_rows
+                else:
+                    parts.append(t.slice(0, need))
+                    rbuf[0] = t.slice(need)
+                    need = 0
+            rrows -= n
+            return parts[0] if len(parts) == 1 else pa.concat_tables(parts)
+
+        for block in source:
+            lt = BlockAccessor(block).table
+            rt = take(lt.num_rows)
+            merged = lt
+            for name, col in zip(rt.column_names, rt.columns):
+                out = f"{name}_1" if name in lt.column_names else name
+                merged = merged.append_column(out, col)
+            yield merged
+        if rbuf or next(right_iter, None) is not None:
+            raise ValueError("zip(): right dataset has more rows than left")
 
     # -------------------------------------------------------------- waves
     def _stream_tasks(self, read_tasks: List[Any], fused) -> Iterator[Any]:

@@ -57,6 +57,12 @@ class Dataset:
                        ) -> "Dataset":
         return self._with(plan_mod.RandomShuffle(seed))
 
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(plan_mod.Union([o._ops for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with(plan_mod.Zip(other._ops))
+
     # ------------------------------------------------------------ all-to-all
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         """Distributed range sort (sample boundaries -> partition ->

@@ -6,6 +6,7 @@ TPU-first: the learner update is a single pjit'd SPMD step over the learner
 gang's global mesh (gradients psum over ICI), not DDP-wrapped modules.
 """
 
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
@@ -14,8 +15,8 @@ from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
 
-__all__ = ["BC", "BCConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
-           "PPO", "PPOConfig", "SAC", "SACConfig",
+__all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "DQN", "DQNConfig",
+           "IMPALA", "IMPALAConfig", "PPO", "PPOConfig", "SAC", "SACConfig",
            "LearnerGroup", "MLPModule", "RLModuleSpec"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

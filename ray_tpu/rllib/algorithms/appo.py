@@ -1,0 +1,78 @@
+"""APPO — asynchronous PPO: IMPALA's actor-learner architecture with the
+PPO clipped-surrogate objective on V-trace advantages.
+
+Reference: `rllib/algorithms/appo/appo.py` (+ `appo_learner.py` for the
+clip-on-vtrace loss). Everything about sampling, batching, and weight
+sync is inherited from the IMPALA implementation; only the policy loss
+changes — ratio clipping bounds the update where V-trace's rho clipping
+alone would still allow large steps on near-on-policy data.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.impala import (
+    IMPALA, IMPALAConfig, IMPALALearner, vtrace,
+)
+
+
+class APPOLearner(IMPALALearner):
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+        ent_coeff = cfg.get("entropy_coeff", 0.01)
+        clip = cfg.get("clip_param", 0.2)
+
+        obs = batch["obs"]
+        actions = batch["actions"].astype(jnp.int32)
+        B, T = actions.shape
+        out = self.module.forward_train(params, obs.reshape(B * T, -1))
+        logits = out["action_logits"].reshape(B, T, -1)
+        values_bt = out["vf"].reshape(B, T)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp_bt = jnp.take_along_axis(
+            logp_all, actions[..., None], axis=-1)[..., 0]
+
+        behavior_logp = batch["logp"].T
+        target_logp = target_logp_bt.T
+        rewards, dones = batch["rewards"].T, batch["dones"].T
+        values = values_bt.T
+        bootstrap = batch["bootstrap_value"]
+
+        vs, pg_adv = vtrace(behavior_logp, target_logp, rewards, dones,
+                            values, bootstrap, gamma,
+                            cfg.get("rho_bar", 1.0), cfg.get("c_bar", 1.0))
+        adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+
+        ratio = jnp.exp(target_logp - behavior_logp)
+        surrogate = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+        policy_loss = -surrogate.mean()
+        vf_loss = 0.5 * ((values - vs) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return total, {
+            "policy_loss": policy_loss, "vf_loss": vf_loss,
+            "entropy": entropy, "mean_ratio": ratio.mean(),
+            "clip_frac": (jnp.abs(ratio - 1.0) > clip).mean(),
+        }
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+
+    algo_class = property(lambda self: APPO)
+
+
+class APPO(IMPALA):
+    learner_class = APPOLearner
+
+    def _learner_config(self):
+        out = super()._learner_config()
+        out["clip_param"] = self.config.clip_param
+        return out

@@ -50,14 +50,31 @@ def _storage() -> str:
 
 
 class Step:
-    """One DAG node: a function + args (args may be other Steps)."""
+    """One DAG node: a function + args (args may be other Steps).
 
-    def __init__(self, fn, args: tuple, kwargs: dict, name: str):
+    Per-step options (reference: `workflow.options(max_retries=...,
+    catch_exceptions=...)`): `max_retries` re-executes a crashed/raising
+    step before failing the workflow; `catch_exceptions=True` makes the
+    step's checkpointed output `(result, None)` or `(None, exception)`
+    so downstream steps decide how to proceed."""
+
+    def __init__(self, fn, args: tuple, kwargs: dict, name: str,
+                 max_retries: int = 0, catch_exceptions: bool = False):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
         self.name = name
+        self.max_retries = max_retries
+        self.catch_exceptions = catch_exceptions
         self.step_id: Optional[str] = None  # assigned at run (deterministic)
+
+    def options(self, *, max_retries: Optional[int] = None,
+                catch_exceptions: Optional[bool] = None) -> "Step":
+        if max_retries is not None:
+            self.max_retries = max_retries
+        if catch_exceptions is not None:
+            self.catch_exceptions = catch_exceptions
+        return self
 
     def run(self, workflow_id: str) -> Any:
         return run(self, workflow_id)
@@ -67,19 +84,34 @@ class Step:
 
 
 class _StepBuilder:
-    def __init__(self, fn):
+    def __init__(self, fn, max_retries: int = 0,
+                 catch_exceptions: bool = False):
         self._fn = fn
+        self._max_retries = max_retries
+        self._catch_exceptions = catch_exceptions
         self.__name__ = getattr(fn, "__name__", "step")
 
     def step(self, *args, **kwargs) -> Step:
-        return Step(self._fn, args, kwargs, self.__name__)
+        return Step(self._fn, args, kwargs, self.__name__,
+                    max_retries=self._max_retries,
+                    catch_exceptions=self._catch_exceptions)
+
+    def options(self, *, max_retries: Optional[int] = None,
+                catch_exceptions: Optional[bool] = None) -> "_StepBuilder":
+        return _StepBuilder(
+            self._fn,
+            self._max_retries if max_retries is None else max_retries,
+            self._catch_exceptions if catch_exceptions is None
+            else catch_exceptions)
 
     def __call__(self, *args, **kwargs):
         return self._fn(*args, **kwargs)
 
 
-def step(fn) -> _StepBuilder:
-    return _StepBuilder(fn)
+def step(fn=None, *, max_retries: int = 0, catch_exceptions: bool = False):
+    if fn is None:
+        return lambda f: _StepBuilder(f, max_retries, catch_exceptions)
+    return _StepBuilder(fn, max_retries, catch_exceptions)
 
 
 # ---------------------------------------------------------------- executor
@@ -149,10 +181,29 @@ def _execute(dag: Step, workflow_id: str) -> Any:
             args = tuple(resolve(a) for a in node.args)
             kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
             remote_fn = ray_tpu.remote(node.fn)
-            value = ray_tpu.get(remote_fn.remote(*args, **kwargs),
-                                timeout=3600)
+            if node.max_retries:
+                # Explicit per-step retries also retry on application
+                # exceptions; steps WITHOUT explicit retries keep the
+                # global crash-retry default (never override it with 0).
+                remote_fn = remote_fn.options(
+                    max_retries=node.max_retries, retry_exceptions=True)
+            if node.catch_exceptions:
+                try:
+                    value = ray_tpu.get(remote_fn.remote(*args, **kwargs),
+                                        timeout=3600)
+                    value = (value, None)
+                except Exception as e:  # noqa: BLE001
+                    # Hand the user the application exception, not the
+                    # RayTaskError transport wrapper.
+                    value = (None, getattr(e, "cause", e))
+            else:
+                value = ray_tpu.get(remote_fn.remote(*args, **kwargs),
+                                    timeout=3600)
             with open(out_path + ".tmp", "wb") as f:
-                pickle.dump(value, f)
+                # cloudpickle: catch_exceptions outputs can hold
+                # dynamically-created RayTaskError subclasses that plain
+                # pickle cannot serialize by reference.
+                cloudpickle.dump(value, f)
             os.replace(out_path + ".tmp", out_path)  # atomic checkpoint
             results[node.step_id] = value
     except BaseException:

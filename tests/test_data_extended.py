@@ -80,3 +80,30 @@ def test_iter_jax_batches(ray_start_regular):
         assert batch["x"].sharding == sharding
         seen += int(batch["x"].shape[0])
     assert seen == 64
+
+
+def test_union_and_zip(ray_start_regular):
+    """Multi-input plans: union concatenates streams; zip merges columns
+    row-aligned with _1 suffix on collisions (reference: Dataset.union,
+    Dataset.zip)."""
+    left = rdata.range(4).map(lambda r: {"id": r["id"], "x": r["id"] * 10})
+    right = rdata.range(4).map(lambda r: {"id": r["id"] + 100,
+                                          "y": r["id"]})
+
+    u = left.union(right)
+    assert u.count() == 8
+    ids = [r["id"] for r in u.take_all()]
+    assert ids[:4] == [0, 1, 2, 3] and set(ids[4:]) == {100, 101, 102, 103}
+
+    z = left.zip(right)
+    rows = z.take_all()
+    assert len(rows) == 4
+    assert rows[1] == {"id": 1, "x": 10, "id_1": 101, "y": 1}
+
+    # Downstream ops compose after the multi-input stage.
+    assert left.union(right).filter(
+        lambda r: r["id"] >= 100).count() == 4
+
+    # Length mismatch is an error, not silent truncation.
+    with pytest.raises(Exception, match="zip"):
+        rdata.range(3).zip(rdata.range(5)).take_all()

@@ -90,3 +90,39 @@ def test_list_all(ray_start_regular):
     double.step(2).run("wf_b")
     listed = {w["workflow_id"]: w["status"] for w in workflow.list_all()}
     assert listed == {"wf_a": "SUCCEEDED", "wf_b": "SUCCEEDED"}
+
+
+def test_step_options_retries_and_catch(ray_start_regular, tmp_path):
+    """max_retries re-executes a flaky step; catch_exceptions checkpoints
+    (result, err) pairs (reference: workflow.options)."""
+    marker = tmp_path / "flaky_tries"
+
+    @workflow.step(max_retries=3)
+    def flaky():
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        if n < 2:
+            raise RuntimeError("transient")
+        return "recovered"
+
+    assert flaky.step().run("wf_retry") == "recovered"
+    assert int(marker.read_text()) == 3
+
+    @workflow.step
+    def always_fails():
+        raise ValueError("boom")
+
+    @workflow.step
+    def handle(pair):
+        result, err = pair
+        return f"handled:{type(err).__name__}" if err else result
+
+    out = handle.step(
+        always_fails.step().options(catch_exceptions=True)).run("wf_catch")
+    assert out == "handled:ValueError"
+    assert workflow.get_status("wf_catch") == "SUCCEEDED"
+
+    # Without catch_exceptions the workflow fails.
+    with pytest.raises(Exception):
+        handle.step(always_fails.step()).run("wf_nocatch")
+    assert workflow.get_status("wf_nocatch") == "FAILED"
