@@ -147,6 +147,8 @@ class StreamingExecutor:
 
         def take(n: int) -> "pa.Table":
             nonlocal rrows
+            if n == 0:
+                return pa.table({})
             while rrows < n:
                 nxt = next(right_iter, None)
                 if nxt is None:
@@ -170,14 +172,21 @@ class StreamingExecutor:
 
         for block in source:
             lt = BlockAccessor(block).table
+            if lt.num_rows == 0:
+                continue  # nothing to pair; avoids schema-less output
             rt = take(lt.num_rows)
             merged = lt
             for name, col in zip(rt.column_names, rt.columns):
                 out = f"{name}_1" if name in lt.column_names else name
                 merged = merged.append_column(out, col)
             yield merged
-        if rbuf or next(right_iter, None) is not None:
-            raise ValueError("zip(): right dataset has more rows than left")
+        # Compare remaining ROWS, not block presence: trailing zero-row
+        # blocks (e.g. from a filter) are not a length mismatch.
+        leftover = rrows + sum(
+            BlockAccessor(t).num_rows() for t in right_iter)
+        if leftover:
+            raise ValueError(
+                f"zip(): right dataset has {leftover} more rows than left")
 
     # -------------------------------------------------------------- waves
     def _stream_tasks(self, read_tasks: List[Any], fused) -> Iterator[Any]:

@@ -104,16 +104,24 @@ def replicated(mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def context_parallel_attention(mesh, seq_axis: str = "seq"):
+def context_parallel_attention(mesh, seq_axis: str = "seq",
+                               impl: str = "ring"):
     """Attention callable for context-parallel training (SURVEY §7 M11):
     plug into ``LlamaConfig(attn_impl=...)`` / ``forward(attn_impl=...)``
-    and the model's attention runs as ring attention over ``mesh[seq_axis]``
-    (KV blocks rotate via ppermute while everything else stays jit/GSPMD).
+    and the model's attention runs sequence-parallel over
+    ``mesh[seq_axis]``. ``impl="ring"`` rotates KV blocks via ppermute;
+    ``impl="ulysses"`` all-to-alls into head-sharded full-sequence
+    attention (exact, head-count-capped parallelism).
     """
-    from ray_tpu.ops.ring_attention import ring_attention_global
+    if impl == "ulysses":
+        from ray_tpu.ops.ulysses import ulysses_attention_global as _global
+    elif impl == "ring":
+        from ray_tpu.ops.ring_attention import (
+            ring_attention_global as _global)
+    else:
+        raise ValueError(f"impl={impl!r}: expected 'ring' or 'ulysses'")
 
     def attn(q, k, v, causal=True, positions=None):
-        return ring_attention_global(q, k, v, mesh, causal=causal,
-                                     seq_axis=seq_axis)
+        return _global(q, k, v, mesh, causal=causal, seq_axis=seq_axis)
 
     return attn

@@ -10,41 +10,22 @@ alone would still allow large steps on near-on-policy data.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from ray_tpu.rllib.algorithms.impala import (
-    IMPALA, IMPALAConfig, IMPALALearner, vtrace,
+    IMPALA, IMPALAConfig, IMPALALearner,
 )
 
 
 class APPOLearner(IMPALALearner):
     def compute_loss(self, params, batch, rng):
         cfg = self.config
-        gamma = cfg.get("gamma", 0.99)
         vf_coeff = cfg.get("vf_loss_coeff", 0.5)
         ent_coeff = cfg.get("entropy_coeff", 0.01)
         clip = cfg.get("clip_param", 0.2)
 
-        obs = batch["obs"]
-        actions = batch["actions"].astype(jnp.int32)
-        B, T = actions.shape
-        out = self.module.forward_train(params, obs.reshape(B * T, -1))
-        logits = out["action_logits"].reshape(B, T, -1)
-        values_bt = out["vf"].reshape(B, T)
-        logp_all = jax.nn.log_softmax(logits)
-        target_logp_bt = jnp.take_along_axis(
-            logp_all, actions[..., None], axis=-1)[..., 0]
-
-        behavior_logp = batch["logp"].T
-        target_logp = target_logp_bt.T
-        rewards, dones = batch["rewards"].T, batch["dones"].T
-        values = values_bt.T
-        bootstrap = batch["bootstrap_value"]
-
-        vs, pg_adv = vtrace(behavior_logp, target_logp, rewards, dones,
-                            values, bootstrap, gamma,
-                            cfg.get("rho_bar", 1.0), cfg.get("c_bar", 1.0))
+        (behavior_logp, target_logp, values, vs, pg_adv,
+         logp_all) = self._vtrace_prep(params, batch)
         adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
 
         ratio = jnp.exp(target_logp - behavior_logp)

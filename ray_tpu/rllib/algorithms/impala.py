@@ -52,11 +52,12 @@ def vtrace(behavior_logp, target_logp, rewards, dones, values,
 
 
 class IMPALALearner(Learner):
-    def compute_loss(self, params, batch, rng):
+    def _vtrace_prep(self, params, batch):
+        """Shared forward + V-trace plumbing (also the base of APPO's
+        clipped loss): returns time-major (behavior_logp, target_logp,
+        values, vs, pg_adv) plus logp_all for the entropy term."""
         cfg = self.config
         gamma = cfg.get("gamma", 0.99)
-        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
-        ent_coeff = cfg.get("entropy_coeff", 0.01)
 
         # Batch arrives batch-major [B, T, ...]: dim 0 is sharded over the
         # mesh, so the network flattens (B*T) keeping the sharded dim
@@ -75,14 +76,19 @@ class IMPALALearner(Learner):
 
         behavior_logp = batch["logp"].T                      # [T, B]
         target_logp = target_logp_bt.T
-        rewards = batch["rewards"].T
-        dones = batch["dones"].T
         values = values_bt.T
-        bootstrap = batch["bootstrap_value"]                 # [B]
+        vs, pg_adv = vtrace(
+            behavior_logp, target_logp, batch["rewards"].T,
+            batch["dones"].T, values, batch["bootstrap_value"], gamma,
+            cfg.get("rho_bar", 1.0), cfg.get("c_bar", 1.0))
+        return behavior_logp, target_logp, values, vs, pg_adv, logp_all
 
-        vs, pg_adv = vtrace(behavior_logp, target_logp, rewards, dones,
-                            values, bootstrap, gamma,
-                            cfg.get("rho_bar", 1.0), cfg.get("c_bar", 1.0))
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+        ent_coeff = cfg.get("entropy_coeff", 0.01)
+        (behavior_logp, target_logp, values, vs, pg_adv,
+         logp_all) = self._vtrace_prep(params, batch)
 
         policy_loss = -(target_logp * pg_adv).mean()
         vf_loss = 0.5 * ((values - vs) ** 2).mean()

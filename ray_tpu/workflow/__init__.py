@@ -70,11 +70,16 @@ class Step:
 
     def options(self, *, max_retries: Optional[int] = None,
                 catch_exceptions: Optional[bool] = None) -> "Step":
-        if max_retries is not None:
-            self.max_retries = max_retries
-        if catch_exceptions is not None:
-            self.catch_exceptions = catch_exceptions
-        return self
+        # Copy semantics, matching _StepBuilder.options: a Step node
+        # reused in two DAG positions must not inherit options applied
+        # to one of them.
+        return Step(
+            self.fn, self.args, self.kwargs, self.name,
+            max_retries=(self.max_retries if max_retries is None
+                         else max_retries),
+            catch_exceptions=(self.catch_exceptions
+                              if catch_exceptions is None
+                              else catch_exceptions))
 
     def run(self, workflow_id: str) -> Any:
         return run(self, workflow_id)
