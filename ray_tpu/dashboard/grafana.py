@@ -1,0 +1,88 @@
+"""Grafana dashboard generator (reference:
+`dashboard/modules/metrics/grafana_dashboard_factory.py` +
+`default_dashboard_panels.py` — auto-generated dashboards over the
+Prometheus metrics the cluster exports).
+
+`generate_default_dashboard()` returns a Grafana dashboard JSON whose
+panels query the `rtpu_*` series served by the GCS `/metrics` endpoint;
+`write_dashboard(path)` drops it where Grafana provisioning can pick it
+up.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+_PANELS: List[Dict[str, str]] = [
+    {"title": "Alive nodes", "expr": 'rtpu_nodes_total{state="ALIVE"}',
+     "unit": "short"},
+    {"title": "Actors by state", "expr": "rtpu_actors_total",
+     "legend": "{{state}}", "unit": "short"},
+    {"title": "Task events by state", "expr": "rtpu_tasks_events_total",
+     "legend": "{{state}}", "unit": "short"},
+    {"title": "CPU available vs total",
+     "expr": 'rtpu_resource_available{resource="CPU"}',
+     "expr_b": 'rtpu_resource_total{resource="CPU"}', "unit": "short"},
+    {"title": "TPU available vs total",
+     "expr": 'rtpu_resource_available{resource="TPU"}',
+     "expr_b": 'rtpu_resource_total{resource="TPU"}', "unit": "short"},
+    {"title": "Object store used",
+     "expr": 'rtpu_resource_total{resource="object_store_memory"} - '
+             'rtpu_resource_available{resource="object_store_memory"}',
+     "unit": "bytes"},
+    {"title": "Placement groups",
+     "expr": "rtpu_placement_groups_total", "legend": "{{state}}",
+     "unit": "short"},
+]
+
+
+def _panel(spec: Dict[str, str], panel_id: int, x: int, y: int
+           ) -> Dict[str, Any]:
+    targets = [{"expr": spec["expr"], "refId": "A",
+                "legendFormat": spec.get("legend", "")}]
+    if "expr_b" in spec:
+        targets.append({"expr": spec["expr_b"], "refId": "B",
+                        "legendFormat": "total"})
+    return {
+        "id": panel_id, "title": spec["title"], "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": spec.get("unit", "short")},
+                        "overrides": []},
+        "targets": targets,
+    }
+
+
+def generate_default_dashboard(
+        extra_metric_names: Optional[List[str]] = None) -> Dict[str, Any]:
+    """The default cluster dashboard; `extra_metric_names` appends one
+    panel per user-defined metric (ray_tpu.util.metrics name, without
+    the rtpu_ prefix)."""
+    specs = list(_PANELS)
+    for name in extra_metric_names or []:
+        specs.append({"title": name, "expr": f"rtpu_{name}"})
+    panels = [_panel(s, i + 1, (i % 2) * 12, (i // 2) * 8)
+              for i, s in enumerate(specs)]
+    return {
+        "title": "ray_tpu cluster",
+        "uid": "ray-tpu-default",
+        "tags": ["ray_tpu", "generated"],
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource",
+            "query": "prometheus",
+        }]},
+        "panels": panels,
+    }
+
+
+def write_dashboard(path: str,
+                    extra_metric_names: Optional[List[str]] = None) -> str:
+    with open(path, "w") as f:
+        json.dump(generate_default_dashboard(extra_metric_names), f,
+                  indent=2)
+    return path

@@ -181,12 +181,18 @@ class StreamingExecutor:
                 merged = merged.append_column(out, col)
             yield merged
         # Compare remaining ROWS, not block presence: trailing zero-row
-        # blocks (e.g. from a filter) are not a length mismatch.
-        leftover = rrows + sum(
-            BlockAccessor(t).num_rows() for t in right_iter)
+        # blocks (e.g. from a filter) are not a length mismatch. Bounded
+        # drain — stop at the first nonzero block rather than executing
+        # the whole remaining right pipeline for an exact count.
+        leftover = rrows
+        while leftover == 0:
+            nxt = next(right_iter, None)
+            if nxt is None:
+                break
+            leftover += BlockAccessor(nxt).num_rows()
         if leftover:
             raise ValueError(
-                f"zip(): right dataset has {leftover} more rows than left")
+                "zip(): right dataset has more rows than left")
 
     # -------------------------------------------------------------- waves
     def _stream_tasks(self, read_tasks: List[Any], fused) -> Iterator[Any]:

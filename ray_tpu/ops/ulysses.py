@@ -31,6 +31,7 @@ single-device attention.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from ray_tpu.ops.attention import flash_attention
@@ -44,9 +45,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     fewer (grouped-query) heads. Requires the head counts to be divisible
     by the sequence-axis size."""
     n = _axis_size(axis_name)
-    if n is None or n == 1:  # axis unbound: plain exact attention
-        return flash_attention(q, k, v, causal)
     H, Hkv = q.shape[2], k.shape[2]
+    if n is None or n == 1:  # axis unbound: plain exact attention
+        if Hkv != H:
+            k = jnp.repeat(k, H // Hkv, axis=2)
+            v = jnp.repeat(v, H // Hkv, axis=2)
+        return flash_attention(q, k, v, causal)
     if H % n or Hkv % n:
         raise ValueError(
             f"ulysses: sequence-axis size {n} must divide n_heads={H} "
@@ -55,7 +59,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # head shard (one fused all-to-all per tensor over ICI).
     reshard = lambda x: lax.all_to_all(          # noqa: E731
         x, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    out = flash_attention(reshard(q), reshard(k), reshard(v), causal)
+    qg, kg, vg = reshard(q), reshard(k), reshard(v)
+    if Hkv != H:
+        # Grouped-query: expand the local KV head shard to the query
+        # head count AFTER the reshard (ships Hkv/n heads over ICI,
+        # repeats locally — cheaper than repeating before).
+        kg = jnp.repeat(kg, H // Hkv, axis=2)
+        vg = jnp.repeat(vg, H // Hkv, axis=2)
+    out = flash_attention(qg, kg, vg, causal)
     # [B, S, H/n, D] -> [B, S/n, H, D]
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)

@@ -1,0 +1,79 @@
+"""joblib backend over ray_tpu tasks (reference:
+`python/ray/util/joblib/` — `register_ray()` + a backend that fans
+scikit-learn/joblib work out as tasks).
+
+    import joblib
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        joblib.Parallel()(joblib.delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def register_ray_tpu() -> None:
+    import joblib
+
+    joblib.register_parallel_backend("ray_tpu", RayTpuBackend)
+
+
+def _make_backend_class():
+    from joblib._parallel_backends import ParallelBackendBase
+
+    class _RayTpuBackend(ParallelBackendBase):
+        supports_timeout = True
+
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._pool = None
+
+        def effective_n_jobs(self, n_jobs: Optional[int]) -> int:
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            if n_jobs is None:
+                return 1
+            if n_jobs < 0:
+                import ray_tpu
+
+                try:
+                    return max(1, int(
+                        ray_tpu.cluster_resources().get("CPU", 1)))
+                except Exception:
+                    return 1
+            return n_jobs
+
+        def configure(self, n_jobs: int = 1, parallel=None, **kwargs):
+            from ray_tpu.util.multiprocessing import Pool
+
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def apply_async(self, func, callback=None):
+            return self._pool.apply_async(func, callback=callback)
+
+        def terminate(self):
+            if self._pool is not None:
+                self._pool.terminate()
+                self._pool = None
+
+        def abort_everything(self, ensure_ready: bool = True):
+            self.terminate()
+            if ensure_ready:
+                self.configure(n_jobs=self.parallel.n_jobs,
+                               parallel=self.parallel)
+
+    return _RayTpuBackend
+
+
+class RayTpuBackend:
+    """Lazy proxy: joblib internals import only when the backend is
+    instantiated (keeps `ray_tpu.util` importable without joblib)."""
+
+    def __new__(cls, *args, **kwargs):
+        return _make_backend_class()(*args, **kwargs)

@@ -24,9 +24,25 @@ _WORKER_INITED: Set[str] = set()
 
 
 class AsyncResult:
-    def __init__(self, refs: List[Any], single: bool = False):
+    def __init__(self, refs: List[Any], single: bool = False,
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
         self._refs = refs
         self._single = single
+        if callback is not None or error_callback is not None:
+            import threading
+
+            def _notify():
+                try:
+                    result = self.get()
+                except Exception as e:  # noqa: BLE001
+                    if error_callback is not None:
+                        error_callback(e)
+                    return
+                if callback is not None:
+                    callback(result)
+
+            threading.Thread(target=_notify, daemon=True).start()
 
     def get(self, timeout: Optional[float] = None):
         out = ray_tpu.get(self._refs, timeout=timeout)
@@ -119,36 +135,45 @@ class Pool:
     def apply(self, func, args=(), kwds=None):
         return self.apply_async(func, args, kwds).get()
 
-    def apply_async(self, func, args=(), kwds=None) -> AsyncResult:
+    def apply_async(self, func, args=(), kwds=None, callback=None,
+                    error_callback=None) -> AsyncResult:
         self._check_open()
         task = self._task(func, "item")
         return AsyncResult([task.remote(*args, **(kwds or {}))],
-                           single=True)
+                           single=True, callback=callback,
+                           error_callback=error_callback)
 
     def map(self, func, iterable: Iterable,
             chunksize: Optional[int] = None) -> List[Any]:
         return self.map_async(func, iterable, chunksize).get()
 
     def map_async(self, func, iterable: Iterable,
-                  chunksize: Optional[int] = None) -> AsyncResult:
+                  chunksize: Optional[int] = None, callback=None,
+                  error_callback=None) -> AsyncResult:
         self._check_open()
         items = list(iterable)
         chunk = chunksize or max(1, len(items) // (self._processes * 4) or 1)
         task = self._task(func, "chunk")
         refs = [task.remote(items[i:i + chunk])
                 for i in range(0, len(items), chunk)]
-        flat = _FlatteningResult(refs)
-        return flat
+        return _FlatteningResult(refs, callback=callback,
+                                 error_callback=error_callback)
 
     def starmap(self, func, iterable: Iterable[tuple],
                 chunksize: Optional[int] = None) -> List[Any]:
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(self, func, iterable: Iterable[tuple],
+                      chunksize: Optional[int] = None, callback=None,
+                      error_callback=None) -> AsyncResult:
         self._check_open()
         items = [self._star(a) for a in iterable]
         chunk = chunksize or max(1, len(items) // (self._processes * 4) or 1)
         task = self._task(func, "starchunk")
         refs = [task.remote(items[i:i + chunk])
                 for i in range(0, len(items), chunk)]
-        return _FlatteningResult(refs).get()
+        return _FlatteningResult(refs, callback=callback,
+                                 error_callback=error_callback)
 
     def imap(self, func, iterable: Iterable,
              chunksize: int = 1) -> Iterable[Any]:

@@ -83,3 +83,24 @@ def test_dashboard_url_registered_in_kv(dash_cluster):
     assert url is not None
     assert url.decode().startswith("http://")
     assert url.decode() == _dashboard_url()
+
+
+def test_grafana_dashboard_factory(tmp_path):
+    """Generated dashboard JSON is well-formed and its exprs reference
+    series the GCS actually exports (reference:
+    grafana_dashboard_factory.py)."""
+    import json
+
+    from ray_tpu.dashboard.grafana import (
+        generate_default_dashboard, write_dashboard)
+
+    dash = generate_default_dashboard(extra_metric_names=["my_metric"])
+    assert dash["uid"] == "ray-tpu-default"
+    titles = [p["title"] for p in dash["panels"]]
+    assert "Alive nodes" in titles and "my_metric" in titles
+    for p in dash["panels"]:
+        assert p["targets"][0]["expr"].lstrip().startswith("rtpu_")
+        assert {"h", "w", "x", "y"} <= set(p["gridPos"])
+
+    path = write_dashboard(str(tmp_path / "dash.json"))
+    assert json.load(open(path))["panels"]

@@ -40,9 +40,10 @@ def test_ulysses_matches_reference(causal):
 
 
 def test_ulysses_gqa_and_grads():
-    """Grouped-query heads reshard too; grads flow through both
-    all-to-alls."""
-    B, S, H, Hkv, D = 1, 128, 8, 8, 16
+    """TRUE grouped-query attention (Hkv < H): the KV head shard expands
+    to the query head count after the reshard; grads flow through both
+    all-to-alls and the repeat."""
+    B, S, H, Hkv, D = 1, 128, 16, 8, 16
     ks = jax.random.split(jax.random.key(1), 3)
     q = _rand(ks[0], (B, S, H, D))
     k = _rand(ks[1], (B, S, Hkv, D))
@@ -56,10 +57,19 @@ def test_ulysses_gqa_and_grads():
             return jnp.sum(o * w)
         return loss
 
+    def ref_attn(q, k, v):
+        rep = H // Hkv
+        return xla_attention(q, jnp.repeat(k, rep, axis=2),
+                             jnp.repeat(v, rep, axis=2), causal=True)
+
+    out = ulysses_attention_global(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ref_attn(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
     g_uly = jax.grad(mk(lambda q, k, v: ulysses_attention_global(
         q, k, v, mesh, causal=True)), argnums=(0, 1, 2))(q, k, v)
-    g_ref = jax.grad(mk(lambda q, k, v: xla_attention(
-        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(mk(ref_attn), argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_uly, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)

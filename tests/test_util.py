@@ -137,3 +137,18 @@ def test_usage_stats_report(tmp_path, monkeypatch):
     monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
     assert not usage_stats.usage_stats_enabled()
     assert usage_stats.write_report(str(tmp_path), {}) is None
+
+
+# ------------------------------------------------------------- joblib
+
+def test_joblib_backend(ray_start_regular):
+    """joblib.Parallel fans out as tasks (reference: ray.util.joblib)."""
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = joblib.Parallel()(
+            joblib.delayed(lambda x: x * x)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
