@@ -1,0 +1,152 @@
+"""Vision Transformer — the attention-based vision family.
+
+TPU-first notes: patchify is one strided conv (NHWC, maps to the MXU as
+an unrolled matmul), the encoder is pre-LN transformer blocks in bf16
+with fp32 layernorm statistics, and the sequence is short enough
+(e.g. 197 for ViT-B/16 at 224^2) that plain XLA attention is optimal —
+no flash kernel needed below the [S, S] memory wall. `flax.linen`
+modules like the ResNet family (per-layer conv shapes preclude the
+Llama stacked-scan trick only for the patch stem; encoder blocks share
+shapes and could scan, but at ViT depths XLA's unrolled fusion wins).
+
+Reference analog: the reference trains torchvision/timm ViTs through its
+generic worker group; the model itself is net-new here (same stance as
+`models/resnet.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    dim: int = 768
+    depth: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def vit_b16(**kw) -> "ViTConfig":
+        return ViTConfig(**kw)
+
+    @staticmethod
+    def vit_s16(**kw) -> "ViTConfig":
+        return ViTConfig(**{**dict(dim=384, depth=12, n_heads=6,
+                                   mlp_dim=1536), **kw})
+
+    @staticmethod
+    def tiny(**kw) -> "ViTConfig":
+        """CPU-test size: 16x16 inputs train in milliseconds."""
+        return ViTConfig(**{**dict(image_size=16, patch_size=4,
+                                   num_classes=10, dim=32, depth=2,
+                                   n_heads=4, mlp_dim=64), **kw})
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size={self.image_size} must be divisible by "
+                f"patch_size={self.patch_size}")
+
+    @property
+    def seq_len(self) -> int:
+        return (self.image_size // self.patch_size) ** 2 + 1  # + [CLS]
+
+
+class _Encoder(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = self.config
+        drop = not train or c.dropout == 0.0
+        for _ in range(c.depth):
+            # Pre-LN attention block, fp32 norm stats, bf16 matmuls.
+            h = nn.LayerNorm(dtype=jnp.float32)(x).astype(c.dtype)
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=c.n_heads, dtype=c.dtype,
+                deterministic=drop, dropout_rate=c.dropout)(h, h)
+            h = nn.Dropout(c.dropout, deterministic=drop)(h)
+            x = x + h
+            h = nn.LayerNorm(dtype=jnp.float32)(x).astype(c.dtype)
+            h = nn.Dense(c.mlp_dim, dtype=c.dtype)(h)
+            h = nn.gelu(h)
+            h = nn.Dropout(c.dropout, deterministic=drop)(h)
+            h = nn.Dense(c.dim, dtype=c.dtype)(h)
+            h = nn.Dropout(c.dropout, deterministic=drop)(h)
+            x = x + h
+        return nn.LayerNorm(dtype=jnp.float32)(x)
+
+
+class ViT(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        """images [B, H, W, C] (NHWC) -> logits [B, num_classes]."""
+        c = self.config
+        B = images.shape[0]
+        x = nn.Conv(c.dim, (c.patch_size, c.patch_size),
+                    strides=(c.patch_size, c.patch_size),
+                    padding="VALID", dtype=c.dtype, name="patch_embed")(
+            images.astype(c.dtype))
+        x = x.reshape(B, -1, c.dim)                       # [B, S-1, dim]
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, c.dim))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, c.dim)).astype(c.dtype), x], 1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, c.seq_len, c.dim))
+        x = x + pos.astype(c.dtype)
+        x = nn.Dropout(c.dropout,
+                       deterministic=not train or c.dropout == 0.0)(x)
+        x = _Encoder(c)(x, train)
+        return nn.Dense(c.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0].astype(jnp.float32))
+
+
+def init_params(config: ViTConfig, key: jax.Array):
+    model = ViT(config)
+    dummy = jnp.zeros(
+        (1, config.image_size, config.image_size, 3), jnp.float32)
+    return model.init({"params": key}, dummy, train=False)
+
+
+def forward(params, images, config: ViTConfig, train: bool = False,
+            rngs: Optional[Dict] = None):
+    if train and config.dropout > 0.0 and (
+            rngs is None or "dropout" not in rngs):
+        raise ValueError(
+            "training with dropout > 0 requires "
+            "rngs={'dropout': jax.random.key(...)}")
+    return ViT(config).apply(params, images, train=train,
+                             rngs=rngs or {})
+
+
+def loss_fn(params, batch: Dict[str, jax.Array], config: ViTConfig,
+            rngs: Optional[Dict] = None) -> Tuple[jax.Array, jax.Array]:
+    """Cross-entropy; batch: {"images" [B,H,W,C], "labels" [B]}.
+    Returns (loss, accuracy)."""
+    logits = forward(params, batch["images"], config, train=True,
+                     rngs=rngs)
+    labels = batch["labels"].astype(jnp.int32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], -1)[:, 0]
+    loss = (lse - tgt).mean()
+    acc = (jnp.argmax(logits, -1) == labels).mean()
+    return loss, acc
+
+
+def num_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))

@@ -1,0 +1,90 @@
+"""ViT family: shapes, learning, parameter count (reference stance:
+net-new model layer, like models/resnet.py — the reference has no
+in-repo vision models)."""
+
+import numpy as np
+import pytest
+
+
+def test_vit_forward_shapes():
+    import jax
+
+    from ray_tpu.models.vit import ViTConfig, forward, init_params
+
+    cfg = ViTConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    imgs = np.zeros((2, 16, 16, 3), np.float32)
+    logits = forward(params, imgs, cfg)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == np.float32      # head/loss stay fp32
+    assert cfg.seq_len == 17               # 4x4 patches + CLS
+
+
+def test_tiny_vit_learns():
+    import jax
+    import optax
+
+    from ray_tpu.models.vit import ViTConfig, init_params, loss_fn
+
+    cfg = ViTConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    optimizer = optax.adam(3e-3)
+    opt_state = optimizer.init(params)
+
+    rng = np.random.RandomState(0)
+    images = rng.randn(32, 16, 16, 3).astype(np.float32)
+    # Learnable signal: label = sign of the mean of the red channel.
+    labels = (images[..., 0].mean(axis=(1, 2)) > 0).astype(np.int32)
+    batch = {"images": images, "labels": labels}
+
+    @jax.jit
+    def step(params, opt_state):
+        (loss, acc), grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg), has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    accs = []
+    for _ in range(40):
+        params, opt_state, loss, acc = step(params, opt_state)
+        accs.append(float(acc))
+    assert accs[-1] > 0.9, accs[-5:]
+
+
+def test_vit_b16_param_count():
+    import jax
+
+    from ray_tpu.models.vit import ViTConfig, init_params, num_params
+
+    cfg = ViTConfig.vit_b16(num_classes=1000)
+    params = jax.eval_shape(
+        lambda k: init_params(cfg, k), jax.random.key(0))
+    n = sum(np.prod(x.shape) for x in jax.tree.leaves(params))
+    # ViT-B/16 is ~86M params; allow wiggle for impl choices.
+    assert 80e6 < n < 92e6, n
+
+
+def test_vit_config_validation_and_dropout():
+    import jax
+    import pytest as _pytest
+
+    from ray_tpu.models.vit import ViTConfig, forward, init_params, loss_fn
+
+    with _pytest.raises(ValueError, match="divisible"):
+        ViTConfig(image_size=17, patch_size=4)
+
+    cfg = ViTConfig.tiny(dropout=0.1)
+    params = init_params(cfg, jax.random.key(0))
+    imgs = np.zeros((2, 16, 16, 3), np.float32)
+    # Clear error without a dropout rng; works with one.
+    with _pytest.raises(ValueError, match="dropout"):
+        forward(params, imgs, cfg, train=True)
+    out = forward(params, imgs, cfg, train=True,
+                  rngs={"dropout": jax.random.key(1)})
+    assert out.shape == (2, 10)
+    # Inference needs no rng even with dropout configured.
+    forward(params, imgs, cfg, train=False)
+    loss, _ = loss_fn(params, {"images": imgs,
+                               "labels": np.zeros(2, np.int32)}, cfg,
+                      rngs={"dropout": jax.random.key(2)})
+    assert np.isfinite(float(loss))
