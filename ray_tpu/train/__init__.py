@@ -10,11 +10,15 @@ from ray_tpu.train.config import (
     CheckpointConfig, FailureConfig, Result, RunConfig, ScalingConfig,
 )
 from ray_tpu.train.jax_backend import JaxBackend, JaxConfig
-from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+from ray_tpu.train.torch_backend import TorchBackend, TorchConfig
+from ray_tpu.train.trainer import (
+    DataParallelTrainer, JaxTrainer, TorchTrainer,
+)
 from ray_tpu.train._internal.backend_executor import TrainingFailedError
 
 __all__ = [
     "JaxTrainer", "DataParallelTrainer", "JaxBackend", "JaxConfig",
+    "TorchTrainer", "TorchBackend", "TorchConfig",
     "Backend", "BackendConfig", "ScalingConfig", "RunConfig",
     "FailureConfig", "CheckpointConfig", "Checkpoint", "Result",
     "report", "get_context", "get_dataset_shard", "TrainingFailedError",

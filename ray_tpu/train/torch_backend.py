@@ -1,0 +1,91 @@
+"""TorchBackend — torch.distributed process groups for CPU-side torch
+training (reference: `train/torch/config.py:146` — pick nccl vs gloo,
+broadcast rank-0 address, `dist.init_process_group` at `:108`).
+
+On this stack the accelerator path is jax/XLA (`JaxBackend`); the torch
+backend exists for CPU-tensor workloads and to keep the reference's
+pluggable-Backend story intact: the SAME BackendExecutor/WorkerGroup
+machinery boots either framework — only the rendezvous hook differs.
+Only gloo is supported (no NCCL on TPU hosts; the tensor plane between
+chips is XLA over ICI, SURVEY §5 two-plane design).
+
+    trainer = TorchTrainer(
+        train_loop, scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+
+Inside `train_loop`, `torch.distributed` is initialized (gloo) and
+`ray_tpu.train.report()` works as with JaxTrainer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.jax_backend import _free_port_on_worker
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"          # the only supported process-group kind
+    init_timeout_s: float = 120.0
+
+    @property
+    def backend_cls(self):
+        return TorchBackend
+
+
+def _setup_torch_process_group(master_addr: str, master_port: int,
+                               world_size: int, rank: int,
+                               backend: str, timeout_s: float) -> bool:
+    import datetime
+    import os
+
+    import torch.distributed as dist
+
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    dist.init_process_group(
+        backend=backend, world_size=world_size, rank=rank,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    return dist.is_initialized()
+
+
+def _shutdown_torch_process_group() -> None:
+    import torch.distributed as dist
+
+    try:
+        if dist.is_initialized():
+            dist.destroy_process_group()
+    except Exception:
+        pass
+
+
+class TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: TorchConfig) -> None:
+        import ray_tpu
+
+        if backend_config.backend != "gloo":
+            raise ValueError(
+                f"backend={backend_config.backend!r}: only 'gloo' is "
+                "supported (inter-chip tensors ride XLA/ICI, not NCCL)")
+        # The group forms even at world_size 1 (the reference does too):
+        # DDP and dist.* calls in the user loop must work at any scale.
+        world_size = worker_group.num_workers
+        meta0 = worker_group.metadata()[0]
+        port = worker_group.execute_single(0, _free_port_on_worker)
+        ok = ray_tpu.get([
+            w.execute.remote(_setup_torch_process_group, meta0["ip"], port,
+                             world_size, rank, backend_config.backend,
+                             backend_config.init_timeout_s)
+            for rank, w in enumerate(worker_group.workers)
+        ], timeout=600)
+        if not all(ok):
+            raise RuntimeError(f"torch process group failed to form: {ok}")
+
+    def on_shutdown(self, worker_group,
+                    backend_config: TorchConfig) -> None:
+        try:
+            worker_group.execute(_shutdown_torch_process_group)
+        except Exception:
+            pass

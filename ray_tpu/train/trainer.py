@@ -294,3 +294,29 @@ class JaxTrainer(DataParallelTrainer):
         backend_config = jax_config or JaxConfig()
         super().__init__(train_loop_per_worker,
                          backend_config=backend_config, **kwargs)
+
+
+class TorchTrainer(DataParallelTrainer):
+    """CPU-torch data-parallel trainer over the same worker-group
+    machinery as JaxTrainer (reference: `train/torch/torch_trainer.py`;
+    gloo process groups — see `train/torch_backend.py` for why NCCL has
+    no role on a TPU stack).
+
+    Usage::
+
+        def train_loop(config):
+            import torch.distributed as dist
+            model = torch.nn.parallel.DistributedDataParallel(model)
+            ...ray_tpu.train.report(...) per epoch...
+
+        TorchTrainer(train_loop,
+                     scaling_config=ScalingConfig(num_workers=2)).fit()
+    """
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 torch_config: Optional["Any"] = None, **kwargs):
+        from ray_tpu.train.torch_backend import TorchConfig
+
+        backend_config = torch_config or TorchConfig()
+        super().__init__(train_loop_per_worker,
+                         backend_config=backend_config, **kwargs)
