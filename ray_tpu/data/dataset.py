@@ -100,6 +100,18 @@ class Dataset:
                 break
         return out[:n]
 
+    def to_pandas(self):
+        """Materialize into one pandas DataFrame (reference:
+        `Dataset.to_pandas` — driver-memory bound by design)."""
+        from ray_tpu.data.block import BlockAccessor
+
+        blocks = list(self._stream())
+        if not blocks:
+            import pandas as pd
+
+            return pd.DataFrame()
+        return BlockAccessor.concat(blocks).to_pandas()
+
     def take_all(self) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []
         for block in self._stream():

@@ -201,6 +201,16 @@ def cmd_serve(args) -> None:
     ray_tpu.shutdown()
 
 
+def cmd_summary(args) -> None:
+    ray_tpu = _connect(args)
+    from ray_tpu.util import state
+
+    rows = (state.summary_tasks() if args.kind == "tasks"
+            else state.summary_actors())
+    _print_table(rows)
+    ray_tpu.shutdown()
+
+
 def cmd_timeline(args) -> None:
     ray_tpu = _connect(args)
     trace = ray_tpu.timeline(filename=args.output)
@@ -262,6 +272,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     p = sub.add_parser("timeline", help="dump chrome-trace task timeline")
     p.add_argument("-o", "--output", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("summary", help="state rollups")
+    p.add_argument("kind", choices=["tasks", "actors"])
+    p.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("serve", help="model-serving control")
     ssub = p.add_subparsers(dest="serve_cmd", required=True)

@@ -140,3 +140,35 @@ def summary() -> Dict[str, Any]:
         "cluster_resources": cluster_resources(),
         "available_resources": available_resources(),
     }
+
+
+def summary_tasks() -> List[Dict[str, Any]]:
+    """Per-function-name rollup of task lifecycle states (reference:
+    `ray summary tasks` / `util/state/summary.py`)."""
+    from collections import defaultdict
+
+    agg: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    for e in ray_tpu.task_events():
+        if e.get("state") == "SPAN":
+            continue
+        agg[e["name"]][e["state"]] += 1
+    out = []
+    for name, states in sorted(agg.items()):
+        # PENDING/RUNNING counts are event totals; net in-flight =
+        # submitted minus finished/failed.
+        out.append({"name": name, **dict(states),
+                    "total": states.get("PENDING", 0)})
+    return out
+
+
+def summary_actors() -> List[Dict[str, Any]]:
+    """Per-class rollup of actor states (reference: `ray summary
+    actors`)."""
+    from collections import defaultdict
+
+    agg: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    for a in list_actors():
+        cls = a.get("class_name") or a.get("name") or "<anonymous>"
+        agg[cls][a.get("state", "UNKNOWN")] += 1
+    return [{"class": cls, **dict(states)}
+            for cls, states in sorted(agg.items())]

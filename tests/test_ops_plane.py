@@ -135,3 +135,57 @@ def test_cli_start_status_stop(tmp_path):
         # would nuke the other test modules' clusters).
         subprocess.run(["pkill", "-f", session_dir],
                        capture_output=True, timeout=60)
+
+
+def test_summary_rollups(ray_start_regular):
+    """ray summary tasks/actors equivalents (reference:
+    `util/state/summary.py`)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def summed(x):
+        return x
+
+    assert ray_tpu.get([summed.remote(i) for i in range(3)],
+                       timeout=60) == [0, 1, 2]
+
+    @ray_tpu.remote
+    class Summarized:
+        def ping(self):
+            return "ok"
+
+    a = Summarized.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
+    from ray_tpu._private.worker import global_worker
+
+    global_worker().flush_task_events()
+    deadline = time.monotonic() + 15
+    rows = []
+    while time.monotonic() < deadline:
+        rows = state.summary_tasks()
+        if any(r["name"] == "summed" and r.get("FINISHED", 0) >= 3
+               for r in rows):
+            break
+        time.sleep(0.5)
+    srow = next(r for r in rows if r["name"] == "summed")
+    assert srow["FINISHED"] >= 3 and srow["total"] >= 3
+
+    arows = state.summary_actors()
+    assert any(r["class"] == "Summarized" and r.get("ALIVE", 0) >= 1
+               for r in arows)
+    ray_tpu.kill(a)
+
+
+def test_dataset_to_pandas(ray_start_regular):
+    import pandas as pd
+
+    from ray_tpu import data as rdata
+
+    df = rdata.range(5).map(
+        lambda r: {"id": r["id"], "sq": r["id"] ** 2}).to_pandas()
+    assert isinstance(df, pd.DataFrame)
+    assert df["sq"].tolist() == [0, 1, 4, 9, 16]
+    assert rdata.from_items([]).to_pandas().empty
