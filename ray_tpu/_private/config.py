@@ -62,6 +62,12 @@ _define("object_spilling_threshold", float, 0.8,
         "Fraction of store capacity above which primary copies spill to disk.")
 _define("object_store_fallback_directory", str, "",
         "Directory for disk spillover; defaults under the session dir.")
+_define("rpc_put_max_bytes", int, 512 * 1024,
+        "Owner puts <= this many bytes travel inside a single pipelined "
+        "put_object RPC; larger ones are written into the shared arena "
+        "mapping directly (create + client memcpy + seal).")
+_define("async_put_max_inflight", int, 32,
+        "Max owner puts pipelined on the io loop before put() blocks.")
 
 # --- scheduling ---
 _define("scheduler_top_k_fraction", float, 0.2,

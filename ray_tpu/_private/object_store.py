@@ -159,6 +159,8 @@ class NodeObjectStore:
             return
         path, offset = self.create(object_id, len(payload))
         if self._arena is not None:
+            _populate(self._arena_map, offset, len(payload),
+                      _MADV_POPULATE_WRITE)
             self._arena_map[offset:offset + len(payload)] = payload
         else:
             with open(path, "r+b") as f:
@@ -378,6 +380,24 @@ class NodeObjectStore:
 _client_arenas: Dict[str, mmap.mmap] = {}
 _client_arena_files: Dict[str, Any] = {}
 
+_MADV_POPULATE_READ = 22   # Linux; absent from the mmap module's constants
+_MADV_POPULATE_WRITE = 23
+_POPULATE_MIN = 64 * 1024
+
+
+def _populate(arena: mmap.mmap, offset: int, size: int, advice: int) -> None:
+    """Fault an extent's pages into THIS process in one syscall: the store
+    pre-commits tmpfs pages server-side, but each client mapping still pays
+    a minor fault per page on first touch — ~5ms per 10 MiB if taken one by
+    one inside memcpy, ~0.2ms batched here."""
+    if size < _POPULATE_MIN:
+        return
+    start = offset & ~4095
+    try:
+        arena.madvise(advice, start, offset + size - start)
+    except (OSError, ValueError):
+        pass
+
 
 def _client_arena_map(path: str) -> mmap.mmap:
     m = _client_arenas.get(path)
@@ -400,6 +420,7 @@ class MappedObject:
             self._file = None
             self._mmap = None
             arena = _client_arena_map(path)
+            _populate(arena, offset, size, _MADV_POPULATE_READ)
             self.view = memoryview(arena)[offset:offset + size]
             return
         self._shared = False
@@ -434,6 +455,7 @@ class WritableObject:
             self._file = None
             self._mmap = None
             arena = _client_arena_map(path)
+            _populate(arena, offset, size, _MADV_POPULATE_WRITE)
             self.view = memoryview(arena)[offset:offset + size]
             return
         self._shared = False

@@ -150,7 +150,8 @@ class Raylet:
         for name in [
             "request_worker_lease", "return_worker", "lease_worker_for_actor",
             "register_worker", "worker_exiting",
-            "create_object", "seal_object", "get_object", "contains_object",
+            "create_object", "seal_object", "put_object", "get_object",
+            "contains_object",
             "delete_objects", "pin_object", "unpin_object", "read_chunk",
             "release_object", "release_objects",
             "object_info", "store_stats",
@@ -758,8 +759,22 @@ class Raylet:
         path, offset = self.store.create(object_id, size)
         return {"path": path, "offset": offset}
 
-    async def _h_seal_object(self, object_id):
+    async def _h_seal_object(self, object_id, pin=False):
         self.store.seal(object_id)
+        if pin:
+            self.store.pin(object_id)
+        return True
+
+    async def _h_put_object(self, object_id, payload, pin=False):
+        """One-RPC put for small/medium objects: create+write+seal(+pin).
+
+        The payload rides the RPC frame (one extra copy) in exchange for a
+        single round trip — the client-side 3-RPC create/seal/pin dance
+        dominated small-put latency (reference bar: ray_perf.py put suites).
+        """
+        self.store.put_bytes(object_id, payload)
+        if pin:
+            self.store.pin(object_id)
         return True
 
     def _track_client_ref(self, object_id, client_id) -> None:

