@@ -32,11 +32,6 @@ namespace {
 
 constexpr uint64_t kAlign = 64;
 constexpr uint64_t kInvalid = ~0ull;
-constexpr uint64_t kPage = 4096;
-// Keep this many bytes of fresh arena pre-faulted beyond the allocation
-// frontier: first-touch faults on tmpfs pages otherwise cap client write
-// bandwidth well below memcpy speed.
-constexpr uint64_t kTouchAhead = 128ull << 20;
 
 struct Entry {
   uint64_t offset = 0;
@@ -59,57 +54,24 @@ struct Store {
   // free extents keyed by offset -> size (coalescing on release)
   std::map<uint64_t, uint64_t> free_list;
 
-  // Background pre-faulting of fresh pages ahead of the high-water mark.
+  // One background sweep that commits every arena page at open.
   std::thread toucher;
-  std::mutex touch_mu;
-  std::condition_variable touch_cv;
-  std::atomic<uint64_t> touch_goal{0};    // fault pages up to this offset
-  std::atomic<uint64_t> touched{0};       // faulted so far
   std::atomic<bool> closing{false};
 
-  void want_touched(uint64_t upto) {
-    upto = std::min(upto, capacity);
-    if (upto <= touch_goal.load(std::memory_order_relaxed)) return;
-    {
-      std::lock_guard<std::mutex> g(touch_mu);
-      if (upto > touch_goal.load(std::memory_order_relaxed))
-        touch_goal.store(upto, std::memory_order_relaxed);
-    }
-    touch_cv.notify_one();
-  }
-
   void toucher_main() {
-    for (;;) {
-      uint64_t goal;
-      {
-        std::unique_lock<std::mutex> lk(touch_mu);
-        touch_cv.wait(lk, [&] {
-          return closing.load() ||
-                 touch_goal.load(std::memory_order_relaxed) >
-                     touched.load(std::memory_order_relaxed);
-        });
-        if (closing.load()) return;
-        goal = touch_goal.load(std::memory_order_relaxed);
-      }
-      uint64_t pos = touched.load(std::memory_order_relaxed);
-      while (pos < goal && !closing.load()) {
-        // MADV_POPULATE_WRITE faults pages in WITHOUT modifying content,
-        // so racing a client's concurrent write into a just-allocated
-        // extent is safe by construction (a plain zero-write would not
-        // be). On kernels without it, clients simply pay the faults.
-        uint64_t chunk = std::min<uint64_t>(8ull << 20, goal - pos);
+    uint64_t pos = 0;
+    while (pos < capacity && !closing.load(std::memory_order_relaxed)) {
+      // MADV_POPULATE_WRITE faults pages in WITHOUT modifying content,
+      // so racing a client's concurrent write into a just-allocated
+      // extent is safe by construction (a plain zero-write would not
+      // be). On kernels without it, clients simply pay the faults.
+      uint64_t chunk = std::min<uint64_t>(8ull << 20, capacity - pos);
 #ifdef MADV_POPULATE_WRITE
-        if (::madvise(base + pos, chunk, MADV_POPULATE_WRITE) != 0) {
-          pos = goal;
-          break;
-        }
+      if (::madvise(base + pos, chunk, MADV_POPULATE_WRITE) != 0) break;
 #else
-        pos = goal;
-        break;
+      break;
 #endif
-        pos += chunk;
-      }
-      touched.store(std::min(pos, capacity), std::memory_order_relaxed);
+      pos += chunk;
     }
   }
 
@@ -189,7 +151,6 @@ void* rtpu_store_open(const char* path, uint64_t capacity) {
   // region), so committing it once up front is the honest behavior and
   // makes every later client write run at memcpy speed.
   s->toucher = std::thread([s] { s->toucher_main(); });
-  s->want_touched(capacity);
   return s;
 }
 
@@ -197,7 +158,6 @@ void rtpu_store_close(void* h) {
   auto* s = static_cast<Store*>(h);
   if (!s) return;
   s->closing.store(true);
-  s->touch_cv.notify_one();
   if (s->toucher.joinable()) s->toucher.join();
   ::munmap(s->base, s->capacity);
   ::close(s->fd);

@@ -601,6 +601,17 @@ class GcsServer:
                 await asyncio.sleep(delay)
                 continue
             if not reply.get("ok"):
+                if reply.get("env_setup_error"):
+                    # Creation can never succeed on this env; retrying just
+                    # re-runs a failing pip install every cycle.
+                    a["state"] = DEAD
+                    a["death_cause"] = (
+                        f"runtime_env setup failed: "
+                        f"{reply['env_setup_error']}")
+                    self.pubsub.publish("actor", {
+                        "actor_id": actor_id, "state": DEAD,
+                        "cause": a["death_cause"]})
+                    return
                 await asyncio.sleep(delay)
                 continue
             # Worker is up and dedicated; tell it to become the actor.

@@ -50,6 +50,7 @@ class _WorkerHandle:
         # env's worker.
         self.pool_key = pool_key if pool_key is not None else job_id
         self.runtime_env = runtime_env
+        self.env_uris: list = []      # runtime_env cache entries in use
         self.lease: Optional[Dict[str, Any]] = None  # demand + tpu ids
         self.is_actor = False
         self.actor_id: Optional[bytes] = None
@@ -126,6 +127,10 @@ class Raylet:
         # via get_worker_exit_info to turn the crash into OutOfMemoryError.
         self._oom_killed: Set[bytes] = set()
         self._worker_info_cache: Dict[bytes, Any] = {}
+        # pool_key -> (message, ts) of the last runtime_env setup failure:
+        # turned into a fast lease error so owners fail tasks with
+        # RuntimeEnvSetupError instead of hot-looping spawn attempts.
+        self._env_failures: Dict[bytes, Tuple[str, float]] = {}
 
     # ------------------------------------------------------------------- boot
     def start(self) -> int:
@@ -157,7 +162,7 @@ class Raylet:
             "object_info", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "kill_worker", "node_stats", "shutdown_node", "get_tasks_info",
-            "get_worker_exit_info",
+            "get_worker_exit_info", "runtime_env_stats",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
 
@@ -221,6 +226,25 @@ class Raylet:
         env["RAY_TPU_NODE_IP"] = self.host
         return env
 
+    def _runtime_env_manager(self):
+        if getattr(self, "_renv_manager", None) is None:
+            from ray_tpu.runtime_env.manager import RuntimeEnvManager
+
+            self._renv_manager = RuntimeEnvManager(
+                os.path.join(self.session_dir, "runtime_envs"), self.gcs)
+        return self._renv_manager
+
+    def _release_worker_env(self, handle) -> None:
+        if handle is not None and handle.env_uris:
+            uris, handle.env_uris = handle.env_uris, []
+            try:
+                self._runtime_env_manager().release(uris)
+            except Exception:
+                pass
+
+    async def _h_runtime_env_stats(self):
+        return self._runtime_env_manager().stats()
+
     @staticmethod
     def _pool_key(job_id: bytes, runtime_env: Optional[Dict[str, Any]]
                   ) -> bytes:
@@ -250,14 +274,65 @@ class Raylet:
         out = open(os.path.join(
             log_dir, f"worker-{worker_id.hex()[:12]}.out"), "wb")
         env = self._worker_env()
+        env_uris = []
+        python_exe = sys.executable
+        command_prefix = []
         if runtime_env:
             # Applied at worker spawn (reference: RuntimeEnvContext.exec_worker
-            # runs the worker inside the env) — not mutated per-task.
-            for key, val in (runtime_env.get("env_vars") or {}).items():
+            # runs the worker inside the env) — not mutated per-task. The
+            # manager materializes pip venvs / code packages on pool miss.
+            try:
+                ctx = await self._runtime_env_manager().setup(runtime_env)
+            except Exception as e:
+                out.close()
+                self._starting[pool_key] = max(
+                    0, self._starting[pool_key] - 1)
+                sys.stderr.write(f"[raylet] runtime_env setup failed: {e}\n")
+                self._env_failures[pool_key] = (
+                    f"{type(e).__name__}: {e}", time.monotonic())
+                waiters = self._pending_pop[pool_key]
+                while waiters:
+                    fut = waiters.popleft()
+                    if not fut.done():
+                        fut.set_result(None)
+                        break
+                return
+            for key, val in ctx.env_vars.items():
                 env[str(key)] = str(val)
-            if runtime_env.get("working_dir"):
-                env["RAY_TPU_WORKING_DIR"] = str(runtime_env["working_dir"])
-        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
+            if ctx.working_dir:
+                env["RAY_TPU_WORKING_DIR"] = ctx.working_dir
+            if ctx.pythonpath:
+                env["PYTHONPATH"] = os.pathsep.join(
+                    ctx.pythonpath
+                    + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                       if p])
+            if ctx.py_executable:
+                python_exe = ctx.py_executable
+                # The venv interpreter must still import ray_tpu itself.
+                repo_root = os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))
+                env["PYTHONPATH"] = os.pathsep.join(
+                    [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                     if p] + [repo_root])
+            command_prefix = list(ctx.command_prefix)
+            if command_prefix:
+                # Popen env applies to the container CLI, not inside the
+                # container: graft the worker env through -e flags and use
+                # the image's own interpreter.
+                passthrough = dict(ctx.env_vars)
+                for k in ("PYTHONPATH", "RAY_TPU_NODE_ID", "RAY_TPU_NODE_IP",
+                          "RAY_TPU_WORKING_DIR"):
+                    if env.get(k):
+                        passthrough[k] = env[k]
+                env_flags = []
+                for k, v in passthrough.items():
+                    env_flags += ["-e", f"{k}={v}"]
+                command_prefix = (command_prefix[:-1] + env_flags
+                                  + command_prefix[-1:])
+                python_exe = "python3"
+            env_uris = list(ctx.uris)
+        cmd = command_prefix + [
+               python_exe, "-m", "ray_tpu._private.worker_main",
                "--raylet-host", self.host,
                "--raylet-port", str(self.server.port),
                "--gcs-host", self.gcs_addr[0],
@@ -276,6 +351,13 @@ class Raylet:
             out.close()
             self._starting[pool_key] = max(0, self._starting[pool_key] - 1)
             sys.stderr.write(f"[raylet] worker spawn failed: {e}\n")
+            if env_uris:
+                # setup() took cache refs for this worker; give them back
+                # or the venv/package can never be garbage-collected.
+                try:
+                    self._runtime_env_manager().release(env_uris)
+                except Exception:
+                    pass
             # Fail one parked lease waiter fast instead of letting it ride
             # out the full pop timeout (pre-async-spawn, Popen errors
             # propagated synchronously into the lease handler).
@@ -289,6 +371,7 @@ class Raylet:
         # Handle is completed when the worker registers back.
         handle = _WorkerHandle(worker_id.binary(), proc, ("", 0), job_id,
                                pool_key=pool_key, runtime_env=runtime_env)
+        handle.env_uris = env_uris
         self.workers[worker_id.binary()] = handle
 
     async def _h_register_worker(self, worker_id, port, pid, job_id):
@@ -297,6 +380,7 @@ class Raylet:
             return {"ok": False}
         handle.addr = (self.host, port)
         key = handle.pool_key
+        self._env_failures.pop(key, None)
         self._starting[key] = max(0, self._starting[key] - 1)
         self._offer_worker(handle)
         return {"ok": True, "system_config": GlobalConfig.dump_system_config()}
@@ -342,6 +426,7 @@ class Raylet:
                 self._maybe_replenish(job_id, runtime_env)
                 return handle
             self.workers.pop(handle.worker_id, None)
+            self._release_worker_env(handle)
         # Count async-starting workers too: they only land in self.workers
         # after the off-loop Popen, so without _starting a request burst in
         # that window would overshoot the cap.
@@ -375,6 +460,7 @@ class Raylet:
                 if code is None:
                     continue
                 self.workers.pop(worker_id, None)
+                self._release_worker_env(handle)
                 if handle.addr == ("", 0):
                     # Died before registering: undo its _starting slot or the
                     # warm-pool floor is suppressed forever.
@@ -546,6 +632,11 @@ class Raylet:
 
     async def _grant_local(self, demand: ResourceSet, job_id: bytes,
                            timeout: float, strategy=None, runtime_env=None):
+        if runtime_env:
+            failure = self._env_failures.get(
+                self._pool_key(job_id, runtime_env))
+            if failure is not None and time.monotonic() - failure[1] < 60:
+                return {"env_setup_error": failure[0]}
         if not self.local.try_allocate(demand):
             fut = asyncio.get_running_loop().create_future()
             self._lease_queue.append((demand, job_id, strategy, fut,
@@ -710,6 +801,7 @@ class Raylet:
         self._release_lease(handle)
         if kill or handle.proc.poll() is not None:
             self.workers.pop(worker_id, None)
+            self._release_worker_env(handle)
             if handle.proc.poll() is None:
                 handle.proc.kill()
         else:
@@ -718,6 +810,13 @@ class Raylet:
 
     async def _h_lease_worker_for_actor(self, spec, demand):
         demand_rs = ResourceSet(demand)
+        renv = getattr(spec, "runtime_env", None)
+        if renv:
+            failure = self._env_failures.get(
+                self._pool_key(spec.job_id.binary(), renv))
+            if failure is not None and time.monotonic() - failure[1] < 60:
+                return {"ok": False, "env_setup_error": failure[0],
+                        "reason": f"runtime_env setup failed: {failure[0]}"}
         if not self.local.try_allocate(demand_rs):
             return {"ok": False, "reason": "resources busy"}
         tpu_ids = self._take_tpu_chips(demand_rs)
@@ -738,8 +837,9 @@ class Raylet:
         handle = self.workers.pop(worker_id, None)
         if handle is not None:
             self._release_lease(handle)
+            self._release_worker_env(handle)
             try:
-                self._idle[handle.job_id].remove(handle)
+                self._idle[handle.pool_key].remove(handle)
             except ValueError:
                 pass
         return True

@@ -244,13 +244,15 @@ class _SyncConn:
     interleave and no framing state is needed beyond the length prefix.
     """
 
-    __slots__ = ("host", "port", "_connect_timeout", "sock", "dead")
+    __slots__ = ("host", "port", "_connect_timeout", "sock", "dead",
+                 "owner_thread")
 
     def __init__(self, host: str, port: int, connect_timeout: float):
         self.host, self.port = host, port
         self._connect_timeout = connect_timeout
         self.sock = None
         self.dead = False
+        self.owner_thread = threading.get_ident()
         self._connect()
 
     def _connect(self):
@@ -430,7 +432,20 @@ class RpcClient:
             conn = _SyncConn(self.host, self.port, self._connect_timeout)
             self._sync_local.conn = conn
             with self._sync_conns_lock:
-                self._sync_conns.append(conn)
+                # Prune sockets owned by exited threads (their thread-local
+                # ref is gone but this registry would otherwise pin the fd
+                # open for the life of the client).
+                live = {t.ident for t in threading.enumerate()}
+                keep = []
+                for c in self._sync_conns:
+                    if c.dead:
+                        continue
+                    if c.owner_thread not in live:
+                        c.close()
+                        continue
+                    keep.append(c)
+                keep.append(conn)
+                self._sync_conns = keep
         return conn.call(method, payload, timeout)
 
     def close(self):

@@ -192,7 +192,7 @@ class _LeaseState:
     """Per-scheduling-shape lease bookkeeping on the owner."""
 
     __slots__ = ("idle", "waiters", "inflight", "event",
-                 "dispatcher_started")
+                 "dispatcher_started", "pushing")
 
     def __init__(self):
         self.idle: deque = deque()      # parked reusable leases
@@ -200,6 +200,7 @@ class _LeaseState:
         self.inflight = 0               # raylet lease requests in flight
         self.event = asyncio.Event()    # wakes the dispatcher
         self.dispatcher_started = False
+        self.pushing = 0                # batch pushes currently in flight
 
 
 class _WorkerCrashed:
@@ -295,6 +296,7 @@ class Worker:
         self._actor_submit_locks: Dict[bytes, asyncio.Lock] = {}
         self._actor_batchers: Dict[bytes, "_ActorSendQueue"] = {}
         self._exported_functions: set = set()
+        self._prepared_env_cache: Dict[str, Dict[str, Any]] = {}
         self._cancelled_tasks: set = set()
         # task_id -> executing worker addr, while a push RPC is in flight
         # (real cancel needs the executing worker, not a broadcast).
@@ -424,6 +426,13 @@ class Worker:
                 await self.raylet.acall(
                     "put_object", object_id=oid, payload=payload, pin=True,
                     timeout=60)
+                if self.reference_counter.is_freed(oid):
+                    # Every ref was dropped while the put was in flight:
+                    # nobody will ever decref again, so delete the pinned
+                    # copy now or it leaks in the arena forever.
+                    await self.raylet.acall("delete_objects",
+                                            object_ids=[oid], timeout=10)
+                    return
                 self.reference_counter.add_location(oid, self.node_id)
                 self._complete_object(oid, in_plasma=True)
             except Exception as e:  # noqa: BLE001 — surfaces at get()
@@ -744,6 +753,25 @@ class Worker:
                                      owner_addr=ref.owner_addr))
         return specs, list(kwargs.keys())
 
+    def _prepare_runtime_env(self, env):
+        """Driver-side runtime_env normalization + code packaging
+        (reference: upload_working_dir_if_needed): validates the spec,
+        zips local working_dir / py_modules into content-addressed GCS
+        packages, and caches the rewritten env so repeated submissions
+        don't re-hash directories."""
+        if not env:
+            return None
+        import json as _json
+
+        key = _json.dumps(env, sort_keys=True, default=str)
+        prepared = self._prepared_env_cache.get(key)
+        if prepared is None:
+            from ray_tpu.runtime_env.manager import prepare_runtime_env
+
+            prepared = prepare_runtime_env(env, self.gcs) or {}
+            self._prepared_env_cache[key] = prepared
+        return prepared or None
+
     def submit_task(self, fn_hash: str, fn_name: str, args, kwargs,
                     options: Dict[str, Any]) -> List[ObjectRef]:
         task_id = TaskID.for_normal_task(self.job_id)
@@ -765,7 +793,8 @@ class Worker:
             max_retries=options.get("max_retries",
                                     GlobalConfig.task_max_retries_default),
             retry_exceptions=options.get("retry_exceptions", False),
-            runtime_env=options.get("runtime_env"),
+            runtime_env=self._prepare_runtime_env(
+                options.get("runtime_env")),
             parent_task_id=self._ctx.task_id,
             labels=options.get("_labels") or {},
         )
@@ -984,7 +1013,8 @@ class Worker:
                             kill=False, timeout=10)
                     except Exception:
                         pass
-                if not st.idle and not st.waiters and not st.inflight:
+                if (not st.idle and not st.waiters and not st.inflight
+                        and not st.pushing):
                     self._lease_pool.pop(key, None)
                     st.event.set()  # wake the dispatcher so it can exit
 
@@ -1016,11 +1046,17 @@ class Worker:
             asyncio.ensure_future(self._lease_dispatcher(key, st))
         self._spawn_lease_requesters(key, st, demand, strategy,
                                      spec.runtime_env)
-        try:
-            return await asyncio.wait_for(
-                fut, GlobalConfig.worker_lease_timeout_ms / 1000 + 5)
-        except asyncio.TimeoutError:
-            return None
+        # No deadline here: a saturated-but-feasible cluster queues tasks
+        # indefinitely (reference pending-task-queue semantics); only the
+        # requester resolves a waiter with None when demand stays
+        # infeasible past the lease deadline. The periodic wakeup just
+        # re-ensures requesters exist (they exit when waiters drain).
+        while True:
+            done, _ = await asyncio.wait([fut], timeout=30)
+            if done:
+                return fut.result()
+            self._spawn_lease_requesters(key, st, demand, strategy,
+                                         spec.runtime_env)
 
     async def _lease_dispatcher(self, key: str, st: "_LeaseState"):
         """Single consumer per scheduling shape: pairs idle leases with
@@ -1041,6 +1077,7 @@ class Worker:
                 if not batch:
                     st.idle.appendleft(lease)
                     break
+                st.pushing += 1
                 asyncio.ensure_future(
                     self._push_batch(key, st, lease, batch))
 
@@ -1076,39 +1113,57 @@ class Worker:
             self._record_task_event(spec, "RUNNING",
                                     worker_addr=list(worker_addr))
         try:
-            if len(batch) == 1:
-                replies = [await client.acall(
-                    "push_task", spec=batch[0][0],
-                    tpu_ids=lease.get("tpu_ids", []))]
-            else:
-                replies = await client.acall(
-                    "push_tasks", specs=[s for s, _ in batch],
-                    tpu_ids=lease.get("tpu_ids", []))
-        except (ConnectionLost, OSError):
-            for spec, fut in batch:
-                self._inflight_push.pop(spec.task_id.binary(), None)
-                if not fut.done():
-                    fut.set_result(_WorkerCrashed(lease["worker_id"],
-                                                  lease["_lessor"]))
             try:
-                await lease["_lessor"].acall(
-                    "return_worker", worker_id=lease["worker_id"],
-                    kill=True, timeout=10)
-            except Exception:
-                pass
-            st.event.set()
-            return
-        for (spec, fut), reply in zip(batch, replies):
-            self._inflight_push.pop(spec.task_id.binary(), None)
-            dur = reply.pop("dur", None) if isinstance(reply, dict) else None
-            if dur is not None:
-                h = spec.function.function_hash
-                prev = self._fn_dur_ema.get(h)
-                self._fn_dur_ema[h] = (dur if prev is None
-                                       else 0.7 * prev + 0.3 * dur)
-            if not fut.done():
-                fut.set_result(reply)
-        self._hand_lease(key, st, lease)
+                if len(batch) == 1:
+                    replies = [await client.acall(
+                        "push_task", spec=batch[0][0],
+                        tpu_ids=lease.get("tpu_ids", []))]
+                else:
+                    replies = await client.acall(
+                        "push_tasks", specs=[s for s, _ in batch],
+                        tpu_ids=lease.get("tpu_ids", []))
+            except (ConnectionLost, OSError):
+                for spec, fut in batch:
+                    self._inflight_push.pop(spec.task_id.binary(), None)
+                    if not fut.done():
+                        fut.set_result(_WorkerCrashed(lease["worker_id"],
+                                                      lease["_lessor"]))
+                await self._discard_lease(lease)
+                st.event.set()
+                return
+            except Exception as e:  # noqa: BLE001 — e.g. RpcError
+                # Unknown failure mode: fail the tasks with the real error
+                # (not a bogus lease timeout) and return the worker killed
+                # — its state is unknowable.
+                for spec, fut in batch:
+                    self._inflight_push.pop(spec.task_id.binary(), None)
+                    if not fut.done():
+                        fut.set_exception(e)
+                await self._discard_lease(lease)
+                st.event.set()
+                return
+            for (spec, fut), reply in zip(batch, replies):
+                self._inflight_push.pop(spec.task_id.binary(), None)
+                dur = (reply.pop("dur", None)
+                       if isinstance(reply, dict) else None)
+                if dur is not None:
+                    h = spec.function.function_hash
+                    prev = self._fn_dur_ema.get(h)
+                    self._fn_dur_ema[h] = (dur if prev is None
+                                           else 0.7 * prev + 0.3 * dur)
+                if not fut.done():
+                    fut.set_result(reply)
+            self._hand_lease(key, st, lease)
+        finally:
+            st.pushing -= 1
+
+    async def _discard_lease(self, lease) -> None:
+        try:
+            await lease["_lessor"].acall(
+                "return_worker", worker_id=lease["worker_id"],
+                kill=True, timeout=10)
+        except Exception:
+            pass
 
     def _spawn_lease_requesters(self, key, st: "_LeaseState", demand,
                                 strategy, runtime_env) -> None:
@@ -1125,8 +1180,10 @@ class Worker:
                                strategy, runtime_env):
         client = self.raylet
         deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_ms / 1000
+        fast_timeouts = 0
         try:
             while st.waiters and not self._dead:
+                req_start = time.monotonic()
                 try:
                     reply = await client.acall(
                         "request_worker_lease",
@@ -1142,6 +1199,25 @@ class Worker:
                     await asyncio.sleep(0.2)
                     client = self.raylet
                     continue
+                if reply.get("timeout") and (
+                        time.monotonic() - req_start < 5.0):
+                    # The raylet gave up on a pop almost immediately: the
+                    # node can't spawn workers at all (fork failure). A
+                    # saturated-but-healthy cluster instead parks us the
+                    # full lease window, so rapid timeouts are a real
+                    # failure signal — bound them rather than hot-loop.
+                    fast_timeouts += 1
+                    if fast_timeouts >= 20:
+                        while st.waiters:
+                            _spec, fut = st.waiters.popleft()
+                            if not fut.done():
+                                fut.set_result(None)
+                                break
+                        fast_timeouts = 0
+                    await asyncio.sleep(0.2)
+                    continue
+                if not reply.get("timeout"):
+                    fast_timeouts = 0
                 if reply.get("granted"):
                     reply["_lessor"] = client
                     self._hand_lease(key, st, reply)
@@ -1149,6 +1225,19 @@ class Worker:
                     continue
                 if reply.get("spillback_to"):
                     client = self._raylet_client(tuple(reply["spillback_to"]))
+                    continue
+                if reply.get("env_setup_error"):
+                    from ray_tpu.runtime_env.manager import (
+                        RuntimeEnvSetupError,
+                    )
+
+                    while st.waiters:
+                        _spec, fut = st.waiters.popleft()
+                        if not fut.done():
+                            fut.set_exception(RuntimeEnvSetupError(
+                                reply["env_setup_error"]))
+                            break
+                    await asyncio.sleep(0.05)
                     continue
                 if reply.get("infeasible"):
                     # Infeasible *now* may become feasible (node still
@@ -1265,7 +1354,8 @@ class Worker:
             is_detached=options.get("lifetime") == "detached",
             actor_name=options.get("name") or "",
             namespace=options.get("namespace") or "default",
-            runtime_env=options.get("runtime_env"),
+            runtime_env=self._prepare_runtime_env(
+                options.get("runtime_env")),
         )
         reply = self.gcs.call("register_actor", spec=spec)
         if reply.get("error"):
@@ -1378,6 +1468,13 @@ class Worker:
             for _, fut in batch:
                 if not fut.done():
                     fut.set_exception(ConnectionLost(str(e)))
+            return
+        except Exception as e:  # noqa: BLE001 — RpcError etc.: a
+            # fire-and-forget task swallowing this would leave every
+            # caller future pending forever; fail the calls instead.
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
             return
         replies = reply if batched else [reply]
         for (spec, fut), r in zip(batch, replies):

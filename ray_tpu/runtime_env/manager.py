@@ -66,6 +66,9 @@ class RuntimeEnvManager:
     async def setup(self, runtime_env: Dict[str, Any]) -> RuntimeEnvContext:
         """Materialize every resource of a validated runtime_env. Safe to
         call concurrently; each URI is created once (per-URI lock)."""
+        from ray_tpu.runtime_env import validate_runtime_env
+
+        runtime_env = validate_runtime_env(runtime_env)
         ctx = RuntimeEnvContext()
         timeout = (runtime_env.get("config") or {}).get(
             "setup_timeout_seconds", 600)
@@ -219,13 +222,26 @@ class RuntimeEnvManager:
             return venv_dir
 
     def _create_venv(self, venv_dir: str, packages: List[str]) -> None:
-        """venv with --system-site-packages: the host's preinstalled stack
-        (jax, numpy, ray_tpu's own deps) stays importable, and only the
-        delta installs (reference: pip.py uses virtualenv the same way)."""
+        """venv inheriting the creating interpreter's site-packages: the
+        host's preinstalled stack (jax, numpy, cloudpickle) stays
+        importable and only the delta installs (reference: pip.py uses
+        virtualenv the same way). --system-site-packages alone is not
+        enough when the host python is itself a venv (/opt/venv): the new
+        venv would inherit the BASE interpreter's site-packages, so the
+        current environment's paths are grafted in with a .pth file."""
+        import glob as _glob
+
         subprocess.run(
             [sys.executable, "-m", "venv", "--system-site-packages",
              venv_dir],
             check=True, capture_output=True, timeout=300)
+        parent_sites = [p for p in sys.path
+                        if p.endswith("site-packages") and os.path.isdir(p)]
+        for venv_site in _glob.glob(
+                os.path.join(venv_dir, "lib", "python*", "site-packages")):
+            with open(os.path.join(venv_site, "_rtpu_inherit.pth"),
+                      "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
         pip_exe = os.path.join(venv_dir, "bin", "pip")
         cmd = [pip_exe, "install", "--no-input"]
         if all(os.path.exists(p.split("[")[0]) for p in packages):
