@@ -45,8 +45,11 @@ class Pubsub:
         self._channels: Dict[str, List[Tuple[int, Any]]] = defaultdict(list)
         self._events: Dict[str, asyncio.Event] = defaultdict(asyncio.Event)
         self._seq = 0
+        self.on_publish = None   # hook: snapshot dirty-marking
 
     def publish(self, channel: str, message: Any) -> None:
+        if self.on_publish is not None:
+            self.on_publish(channel)
         self._seq += 1
         log = self._channels[channel]
         log.append((self._seq, message))
@@ -112,7 +115,11 @@ class GcsServer:
         # counter resets are the scrape consumer's problem (rate()).
         self.user_metrics: Dict[str, Tuple[float, List[Dict[str, Any]]]] = {}
 
+        self._reschedule_on_start: List[bytes] = []
         self._register_handlers()
+        # Actor/PG lifecycle transitions all publish; piggyback snapshot
+        # dirty-marking there so bounce recovery stays fresh.
+        self.pubsub.on_publish = self._on_publish
         self._health_task = None
         self._snapshot_path: Optional[str] = None
         self._snapshot_task = None
@@ -123,9 +130,16 @@ class GcsServer:
     def start(self) -> int:
         port = self.server.start()
         self._health_task = get_io_loop().submit(self._health_loop())
+        for actor_id in self._reschedule_on_start:
+            get_io_loop().submit(self._schedule_actor(actor_id))
+        self._reschedule_on_start = []
         if self._snapshot_path:
             self._snapshot_task = get_io_loop().submit(self._snapshot_loop())
         return port
+
+    def _on_publish(self, channel: str) -> None:
+        if channel in ("actor", "pg"):
+            self._snapshot_dirty = True
 
     # ------------------------------------------------------- persistence
     def enable_snapshots(self, path: str) -> None:
@@ -144,6 +158,21 @@ class GcsServer:
                 self.jobs.update(snap.get("jobs", {}))
                 self._next_job_int = max(self._next_job_int,
                                          snap.get("next_job_int", 0))
+                # Live-actor and PG tables survive a control-plane bounce
+                # (reference: redis-backed gcs_actor_table): addresses may
+                # be stale; death reports and failed pushes correct them.
+                for actor_id, rec in snap.get("actors", {}).items():
+                    rec = dict(rec)
+                    self.actors[actor_id] = rec
+                    self._actor_events[actor_id] = asyncio.Event()
+                    if rec.get("state") == ALIVE:
+                        self._actor_events[actor_id].set()
+                    elif rec.get("state") in (PENDING_CREATION, RESTARTING):
+                        # Their scheduling coroutine died with the old
+                        # process; restart it once the loop is up.
+                        self._reschedule_on_start.append(actor_id)
+                self.named_actors.update(snap.get("named_actors", {}))
+                self.placement_groups.update(snap.get("pgs", {}))
             except Exception as e:  # corrupt snapshot: recover empty, SAY SO
                 import sys
 
@@ -156,11 +185,18 @@ class GcsServer:
 
         tmp = self._snapshot_path + ".tmp"
         with open(tmp, "wb") as f:
+            actors = {}
+            for aid, rec in self.actors.items():
+                slim = {k: v for k, v in rec.items() if k != "handle"}
+                actors[aid] = slim
             pickle.dump({
                 "kv": {ns: dict(entries)
                        for ns, entries in self.kv.items()},
                 "jobs": dict(self.jobs),
                 "next_job_int": self._next_job_int,
+                "actors": actors,
+                "named_actors": dict(self.named_actors),
+                "pgs": dict(self.placement_groups),
             }, f)
         os.replace(tmp, self._snapshot_path)
 
@@ -534,6 +570,10 @@ class GcsServer:
     async def _h_register_actor(self, spec):
         """spec: pickled TaskSpec for the actor-creation task."""
         actor_id = spec.actor_id.binary()
+        if actor_id in self.actors:
+            # Duplicate delivery (client retried after a lost reply):
+            # the first registration stands.
+            return {"ok": True}
         name_key = (spec.actor_name, spec.namespace)
         if spec.actor_name:
             existing = self.named_actors.get(name_key)
@@ -556,6 +596,7 @@ class GcsServer:
         if spec.actor_name:
             self.named_actors[name_key] = actor_id
         self._actor_events[actor_id] = asyncio.Event()
+        self._snapshot_dirty = True
         asyncio.ensure_future(self._schedule_actor(actor_id))
         return {"ok": True}
 
@@ -608,6 +649,7 @@ class GcsServer:
                     a["death_cause"] = (
                         f"runtime_env setup failed: "
                         f"{reply['env_setup_error']}")
+                    self._actor_events[actor_id].set()
                     self.pubsub.publish("actor", {
                         "actor_id": actor_id, "state": DEAD,
                         "cause": a["death_cause"]})
@@ -775,6 +817,8 @@ class GcsServer:
         """2-phase commit against raylets (reference:
         `gcs_placement_group_scheduler.h`, raylet PrepareBundles/CommitBundles
         at `placement_group_resource_manager.h:54-61`)."""
+        if pg_id in self.placement_groups:
+            return True    # duplicate delivery: first creation stands
         self.placement_groups[pg_id] = {
             "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
             "name": name, "state": "PENDING", "bundle_nodes": [None] * len(bundles),

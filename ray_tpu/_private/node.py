@@ -138,7 +138,7 @@ class Node:
         return env
 
     def _start_gcs(self) -> Tuple[str, int]:
-        log = open(os.path.join(self.session_dir, "logs", "gcs.err"), "wb")
+        log = open(os.path.join(self.session_dir, "logs", "gcs.err"), "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.gcs_server",
              "--host", self.host, "--port", str(self._gcs_port),
@@ -150,7 +150,36 @@ class Node:
             start_new_session=True)
         port = _read_port(proc, "GCS_PORT=")
         self._procs.append(proc)
+        self._gcs_proc = proc
         return (self.host, port)
+
+    def kill_gcs(self) -> None:
+        """Hard-kill the GCS process (fault-injection surface for
+        control-plane bounce tests)."""
+        self._gcs_proc.kill()
+        self._gcs_proc.wait(timeout=10)
+
+    def restart_gcs(self) -> Tuple[str, int]:
+        """Restart the GCS on the SAME port, recovering its durable tables
+        from the session snapshot (reference: GCS FT via external Redis +
+        NotifyGCSRestart; here: file snapshot + raylet re-registration)."""
+        if self._gcs_proc.poll() is None:
+            self.kill_gcs()
+        try:
+            self._procs.remove(self._gcs_proc)
+        except ValueError:
+            pass
+        self._gcs_port = self.gcs_addr[1]
+        deadline = time.time() + 15
+        last = None
+        while time.time() < deadline:
+            try:
+                self.gcs_addr = self._start_gcs()
+                return self.gcs_addr
+            except RuntimeError as e:   # port briefly in TIME_WAIT
+                last = e
+                time.sleep(0.5)
+        raise last
 
     def _start_raylet(self, object_store_memory) -> Tuple[str, int]:
         log = open(os.path.join(

@@ -191,7 +191,23 @@ class Raylet:
                     num_workers=len(self.workers),
                     timeout=10)
                 _dbg("reply ok")
-                if "nodes" in reply:
+                if reply.get("unknown"):
+                    # The GCS doesn't know us: it restarted (bounce) —
+                    # re-register with our existing identity and keep all
+                    # local state; leases/workers/objects are untouched
+                    # (reference: NotifyGCSRestart -> re-register,
+                    # node_manager.proto:366).
+                    _dbg("gcs bounce detected; re-registering")
+                    rereg = await self.gcs.acall(
+                        "register_node", node_id=self.node_id,
+                        addr=(self.host, self.server.port),
+                        resources=self.local.total.to_dict(),
+                        labels=self.labels,
+                        object_store_capacity=self.store.capacity,
+                        timeout=10)
+                    if "nodes" in rereg:
+                        self._apply_nodes_snapshot(rereg["nodes"])
+                elif "nodes" in reply:
                     self._apply_nodes_snapshot(reply["nodes"])
             except Exception as e:
                 _dbg("EXC", repr(e))

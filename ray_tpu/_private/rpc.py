@@ -285,23 +285,21 @@ class _SyncConn:
     def call(self, method: str, payload: dict, timeout: Optional[float]):
         frame = _encode_frame((0, _KIND_REQUEST, method, payload))
         try:
-            self.sock.settimeout(self._connect_timeout)
             try:
-                self.sock.sendall(frame)
-            except (BrokenPipeError, ConnectionResetError, OSError):
-                # Server bounced between calls on this pooled connection —
-                # reconnect once and resend (nothing was executed yet).
-                self.sock.close()
+                kind, reply = self._roundtrip(frame, timeout)
+            except (ConnectionLost, BrokenPipeError, ConnectionResetError,
+                    OSError) as first:
+                if isinstance(first, socket.timeout):
+                    raise
+                # Server bounced while this pooled connection sat idle (or
+                # died before replying). Reconnect once and retry — the
+                # sync surface (puts/gets/kv/registry reads) is idempotent,
+                # and a restarted control plane is exactly the case this
+                # retry exists for.
+                self.close()
+                self.dead = False
                 self._connect()
-                self.sock.settimeout(self._connect_timeout)
-                self.sock.sendall(frame)
-            self.sock.settimeout(timeout)
-            header = self._recv_exact(_HEADER.size)
-            (length,) = _HEADER.unpack(header)
-            if length > _MAX_FRAME:
-                raise ConnectionLost(f"oversized frame: {length}")
-            _req_id, kind, _method, reply = pickle.loads(
-                self._recv_exact(length))
+                kind, reply = self._roundtrip(frame, timeout)
         except socket.timeout:
             # The reply may still arrive later; this connection's framing
             # is now out of step — discard it.
@@ -322,6 +320,18 @@ class _SyncConn:
         if exc is not None and isinstance(exc, Exception):
             raise exc
         raise RpcError(f"{name}: {msg}\n{tb}")
+
+    def _roundtrip(self, frame: bytes, timeout: Optional[float]):
+        self.sock.settimeout(self._connect_timeout)
+        self.sock.sendall(frame)
+        self.sock.settimeout(timeout)
+        header = self._recv_exact(_HEADER.size)
+        (length,) = _HEADER.unpack(header)
+        if length > _MAX_FRAME:
+            raise ConnectionLost(f"oversized frame: {length}")
+        _req_id, kind, _method, reply = pickle.loads(
+            self._recv_exact(length))
+        return kind, reply
 
     def close(self):
         self.dead = True

@@ -297,6 +297,7 @@ class Worker:
         self._actor_batchers: Dict[bytes, "_ActorSendQueue"] = {}
         self._exported_functions: set = set()
         self._prepared_env_cache: Dict[str, Dict[str, Any]] = {}
+        self._exported_payloads: Dict[str, bytes] = {}
         self._cancelled_tasks: set = set()
         # task_id -> executing worker addr, while a push RPC is in flight
         # (real cancel needs the executing worker, not a broadcast).
@@ -730,7 +731,22 @@ class Worker:
             self.gcs.call("kv_put", namespace="fn", key=fn_hash,
                           value=payload, overwrite=False)
             self._exported_functions.add(fn_hash)
+            # Keep the payload: a bounced GCS may have snapshotted before
+            # this export landed, in which case the owner re-exports on
+            # the first function-not-found task failure.
+            self._exported_payloads[fn_hash] = payload
         return fn_hash
+
+    async def _maybe_reexport(self, fn_hash: str) -> bool:
+        payload = self._exported_payloads.get(fn_hash)
+        if payload is None:
+            return False
+        try:
+            await self.gcs.acall("kv_put", namespace="fn", key=fn_hash,
+                                 value=payload, overwrite=True, timeout=10)
+            return True
+        except Exception:
+            return False
 
     def _serialize_args(self, args: Sequence[Any], kwargs: Dict[str, Any]
                         ) -> Tuple[List[ArgSpec], List[str]]:
@@ -900,6 +916,7 @@ class Worker:
             self._release_deps(spec)
             return
 
+        reexported = False
         while True:
             if spec.task_id.binary() in self._cancelled_tasks:
                 self._fail_task(spec, serialize_error(
@@ -950,6 +967,15 @@ class Worker:
                 return
             reply = outcome
             if reply.get("app_error") is not None:
+                if (not reexported
+                        and b"not found in the GCS function table"
+                        in reply["app_error"]
+                        and await self._maybe_reexport(
+                            spec.function.function_hash)):
+                    reexported = True
+                    # A bounced GCS lost the export; it's restored — retry
+                    # without burning a user-visible attempt.
+                    continue
                 if (spec.task_id.binary() not in self._cancelled_tasks
                         and self._should_retry_app_error(
                             spec, reply["app_error"], attempt)):
@@ -1385,6 +1411,9 @@ class Worker:
         task_id = TaskID.for_actor_task(ActorID(actor_id))
         arg_specs, kw_keys = self._serialize_args(args, kwargs)
         num_returns = options.get("num_returns", 1)
+        streaming = num_returns == "streaming"
+        if isinstance(num_returns, str):
+            num_returns = {"dynamic": -1, "streaming": -2}[num_returns]
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
             function=FunctionDescriptor("", method_name, ""),
@@ -1401,7 +1430,17 @@ class Worker:
             self._entry(rid.binary())
             refs.append(ObjectRef(rid.binary(), self.addr,
                                   self.worker_id.binary()))
+        if num_returns < 0:
+            # Streaming item pushes may arrive before this coroutine runs.
+            self._generators[task_id.binary()] = _GeneratorState()
         self.io.submit(self._run_actor_task(spec))
+        if streaming:
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+
+            gen = ObjectRefGenerator(task_id.binary(), self.addr,
+                                     self.worker_id.binary())
+            gen._ref0 = refs[0]
+            return [gen]
         return refs
 
     def _actor_lock(self, actor_id: bytes) -> asyncio.Lock:
@@ -2059,6 +2098,14 @@ class Worker:
                 result = await loop.run_in_executor(
                     actor.executor_for(spec.concurrency_group),
                     lambda: method(*args, **kwargs))
+            if spec.num_returns < 0:
+                # Actor generator methods stream like normal-task ones:
+                # each yielded item becomes an object, pushed to the owner
+                # as produced (num_returns="streaming").
+                results, count = await loop.run_in_executor(
+                    self._task_executor, self._store_generator_returns,
+                    spec, result)
+                return {"results": results, "generator_count": count}
             results = await loop.run_in_executor(
                 self._task_executor, self._store_returns, spec, result)
             return {"results": results}

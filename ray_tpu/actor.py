@@ -44,7 +44,8 @@ class ActorMethod:
         nr = self._options.get("num_returns", 1)
         if nr == 0:
             return None
-        if nr == 1:
+        if nr == 1 or isinstance(nr, str):
+            # "dynamic"/"streaming" return the single generator ref.
             return refs[0]
         return refs
 

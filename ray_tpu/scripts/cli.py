@@ -100,6 +100,102 @@ def cmd_stop(args) -> None:
     print("stopped" if out.returncode == 0 else "no daemons found")
 
 
+# --------------------------------------------------------- cluster launcher
+
+def _launcher_state_path(cluster_name: str) -> str:
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(),
+                        f"ray_tpu-cluster-{cluster_name}.json")
+
+
+def cmd_up(args) -> None:
+    """Launch a cluster from a YAML config and run the autoscaler monitor
+    (reference: `ray up` — `autoscaler/_private/commands.py:create_or_update_cluster`)."""
+    from ray_tpu._private.node import Node
+    from ray_tpu.autoscaler.config import load_cluster_config
+    from ray_tpu.autoscaler.pod_autoscaler import run_monitor_loop
+
+    cfg = load_cluster_config(args.config)
+    head_type = cfg.get("head_node_type")
+    head_res = {}
+    if head_type:
+        head_res = dict(
+            cfg["available_node_types"][head_type].get("resources", {}))
+    node = Node(head=True, num_cpus=int(head_res.pop("CPU", args.num_cpus)),
+                num_tpus=int(head_res.pop("TPU", 0)), resources=head_res,
+                fate_share=False)
+    addr = "%s:%d" % node.gcs_addr
+    state = {"cluster_name": cfg["cluster_name"], "address": addr,
+             "session_dir": node.session_dir, "config": args.config,
+             "head_pid": os.getpid()}
+    with open(_launcher_state_path(cfg["cluster_name"]), "w") as f:
+        json.dump(state, f)
+    print(f"cluster '{cfg['cluster_name']}' is up; address: {addr}")
+    print(f"  attach with: python -m ray_tpu attach {args.config}")
+    print(f"  export RAY_TPU_ADDRESS={addr}")
+    stop = {"flag": False}
+    signal.signal(signal.SIGTERM, lambda *a: stop.update(flag=True))
+    try:
+        run_monitor_loop(node.gcs_addr, cfg, node.session_dir,
+                         stop_check=lambda: stop["flag"])
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.shutdown()
+        try:
+            os.unlink(_launcher_state_path(cfg["cluster_name"]))
+        except OSError:
+            pass
+
+
+def cmd_down(args) -> None:
+    """Tear down a launched cluster (reference: `ray down`)."""
+    from ray_tpu.autoscaler.config import load_cluster_config
+
+    cfg = load_cluster_config(args.config)
+    path = _launcher_state_path(cfg["cluster_name"])
+    if not os.path.exists(path):
+        raise SystemExit(f"no running cluster '{cfg['cluster_name']}' found")
+    with open(path) as f:
+        state = json.load(f)
+    try:
+        os.kill(state["head_pid"], signal.SIGTERM)
+        print(f"cluster '{cfg['cluster_name']}' shutting down "
+              f"(head pid {state['head_pid']})")
+    except ProcessLookupError:
+        print("head process already gone; cleaning up state")
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def cmd_attach(args) -> None:
+    """Open a Python REPL connected to the launched cluster
+    (reference: `ray attach` opens a shell on the head)."""
+    from ray_tpu.autoscaler.config import load_cluster_config
+
+    cfg = load_cluster_config(args.config)
+    path = _launcher_state_path(cfg["cluster_name"])
+    if not os.path.exists(path):
+        raise SystemExit(f"no running cluster '{cfg['cluster_name']}' found")
+    with open(path) as f:
+        state = json.load(f)
+    if args.print_address:
+        print(state["address"])
+        return
+    import code
+
+    import ray_tpu
+
+    ray_tpu.init(address=state["address"])
+    banner = (f"Attached to cluster '{cfg['cluster_name']}' at "
+              f"{state['address']}.\nray_tpu is initialized — e.g. "
+              "ray_tpu.cluster_resources()")
+    code.interact(banner=banner, local={"ray_tpu": ray_tpu})
+
+
 def cmd_status(args) -> None:
     ray_tpu = _connect(args)
     from ray_tpu.util import state
@@ -241,6 +337,22 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     p = sub.add_parser("stop", help="stop all local daemons")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("up", help="launch a cluster from a YAML config "
+                                  "and run its autoscaler")
+    p.add_argument("config")
+    p.add_argument("--num-cpus", type=int, default=os.cpu_count() or 1)
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down a launched cluster")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("attach", help="REPL attached to a launched cluster")
+    p.add_argument("config")
+    p.add_argument("--print-address", action="store_true",
+                   help="print the cluster address and exit")
+    p.set_defaults(fn=cmd_attach)
 
     p = sub.add_parser("status", help="cluster summary")
     p.set_defaults(fn=cmd_status)

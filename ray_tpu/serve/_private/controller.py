@@ -18,7 +18,7 @@ import ray_tpu
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
-@ray_tpu.remote(num_cpus=0.5)
+@ray_tpu.remote(num_cpus=0.5, max_concurrency=32)
 class ServeController:
     def __init__(self):
         from ray_tpu.serve._private.replica import Replica
@@ -34,8 +34,14 @@ class ServeController:
         # (app, deployment) -> {"desired", "since"}: scale-decision
         # hysteresis state.
         self._scale_state: Dict[tuple, Dict[str, Any]] = {}
+        # (app, deployment) -> hash of the spec its replicas were built
+        # from; a mismatch triggers a rolling replacement.
+        self._replica_hash: Dict[tuple, str] = {}
         self._version = 0
         self._lock = threading.Lock()
+        # Long-pollers park on this until the routing version bumps
+        # (reference: serve LongPollHost — push-invalidated routers).
+        self._version_cond = threading.Condition(self._lock)
         self._stop = threading.Event()
         threading.Thread(target=self._reconcile_loop, daemon=True,
                          name="serve-reconcile").start()
@@ -60,6 +66,7 @@ class ServeController:
                 self._handle_metrics.pop((app_name, name), None)
                 self._scale_state.pop((app_name, name), None)
             self._version += 1
+            self._version_cond.notify_all()
         return True
 
     # ---------------------------------------------------------- reconcile
@@ -80,6 +87,20 @@ class ServeController:
         for app, spec in goal:
             key = (app, spec["name"])
             replicas = self._replicas.setdefault(key, [])
+            # Rolling code update (reference: deployment_state version
+            # rollout): a redeploy with different code/config retires
+            # every replica built from the old spec — matching replica
+            # count alone would keep serving stale code.
+            spec_hash = self._spec_hash(spec)
+            retiring = []
+            if replicas and self._replica_hash.get(key) != spec_hash:
+                # Old-spec replicas keep serving until the new ones exist;
+                # they drain only after the spawn loop below has filled
+                # the replica set (no empty-routing window on redeploy).
+                retiring = list(replicas)
+                replicas.clear()
+                changed = True
+            self._replica_hash[key] = spec_hash
             # Drop dead replicas (health probe).
             live = []
             for r in replicas:
@@ -101,6 +122,12 @@ class ServeController:
                     tuple(spec.get("init_args", ())),
                     dict(spec.get("init_kwargs", {}))))
                 changed = True
+            if retiring:
+                with self._lock:
+                    self._version += 1
+                    self._version_cond.notify_all()
+                for doomed in retiring:
+                    self._drain_and_kill(doomed)
             if len(replicas) > want:
                 doomed_list = replicas[want:]
                 del replicas[want:]
@@ -110,11 +137,31 @@ class ServeController:
                 # must finish (reference: graceful replica shutdown).
                 with self._lock:
                     self._version += 1
+                    self._version_cond.notify_all()
                 for doomed in doomed_list:
                     self._drain_and_kill(doomed)
         if changed:
             with self._lock:
                 self._version += 1
+                self._version_cond.notify_all()
+
+    @staticmethod
+    def _spec_hash(spec: Dict[str, Any]) -> str:
+        import hashlib
+
+        import cloudpickle
+
+        h = hashlib.md5()
+        h.update(spec.get("serialized_callable", b""))
+        # cloudpickle (not repr): init args may hold DeploymentHandles,
+        # whose default repr embeds a memory address — the hash must be
+        # stable across identical redeploys.
+        h.update(cloudpickle.dumps((spec.get("init_args"),
+                                    spec.get("init_kwargs"))))
+        for field in ("num_cpus", "num_tpus", "max_ongoing_requests",
+                      "stream"):
+            h.update(repr(spec.get(field)).encode())
+        return h.hexdigest()
 
     def _drain_and_kill(self, replica, timeout_s: float = 10.0) -> None:
         deadline = time.monotonic() + timeout_s
@@ -198,6 +245,43 @@ class ServeController:
     def routing_version(self) -> int:
         with self._lock:
             return self._version
+
+    def poll_replicas(self, app_name: str, deployment_name: str,
+                      known_version: int = -1, timeout_s: float = 25.0):
+        """Long-poll get_replicas: replies immediately when the routing
+        version moved past `known_version`, else parks until a bump or the
+        window closes (reference: `long_poll.py` LongPollHost.listen)."""
+        deadline = time.time() + timeout_s
+        with self._version_cond:
+            while self._version == known_version:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._version_cond.wait(min(1.0, remaining))
+            return self._version, list(
+                self._replicas.get((app_name, deployment_name), []))
+
+    def poll_routes(self, known_version: int = -1,
+                    timeout_s: float = 25.0):
+        """Long-poll the route table: app name -> ingress deployment."""
+        deadline = time.time() + timeout_s
+        with self._version_cond:
+            while self._version == known_version:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._version_cond.wait(min(1.0, remaining))
+            routes = {}
+            for app, deployments in self._apps.items():
+                for name, spec in deployments.items():
+                    if spec.get("is_ingress"):
+                        routes[app] = {
+                            "deployment": name,
+                            "route_prefix": spec.get("route_prefix")
+                            or f"/{app}",
+                            "stream": bool(spec.get("stream")),
+                        }
+            return self._version, routes
 
     def list_deployments(self, app_name: str) -> List[Dict[str, Any]]:
         with self._lock:

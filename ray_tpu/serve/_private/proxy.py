@@ -1,93 +1,188 @@
 """HTTP proxy — exposes deployed applications over REST.
 
-Reference: `serve/_private/proxy.py` (per-node ProxyActor). Stdlib
-ThreadingHTTPServer (the image ships no ASGI stack): each request resolves
-the app by route prefix, forwards the JSON body (or raw bytes) to the
-app's ingress deployment through the same pow-2 router as Python handles,
-and returns the JSON-encoded response.
+Reference: `serve/_private/proxy.py` (per-node ProxyActor on uvicorn).
+This one runs aiohttp on a dedicated event-loop thread inside the proxy
+actor: async request handling, streaming (chunked) responses for
+deployments declared with ``stream=True``, and a push-invalidated route
+table (long-polled from the controller) so the request hot path never
+does a controller round trip.
+
+Routing: the first path segment picks the application (``/`` -> app
+"default"). JSON bodies decode to Python values; others pass through as
+text.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict
 
 import ray_tpu
 
 
-@ray_tpu.remote(num_cpus=0.5)
+@ray_tpu.remote(num_cpus=0.5, max_concurrency=16)
 class ProxyActor:
     def __init__(self, port: int = 0):
         from ray_tpu.serve._private.controller import get_or_create_controller
-        from ray_tpu.serve.handle import DeploymentHandle
 
         self._controller = get_or_create_controller()
-        self._handles: Dict[str, DeploymentHandle] = {}
-        proxy = self
+        self._handles: Dict[str, Any] = {}
+        self._routes: Dict[str, Dict[str, Any]] = {}
+        self._routes_version = -1
+        self._routes_ready = threading.Event()
+        self._requests_served = 0
 
-        class _Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):
-                pass
+        self.port = None
+        started = threading.Event()
+        self._loop_thread = threading.Thread(
+            target=self._serve_forever, args=(port, started),
+            daemon=True, name="serve-proxy")
+        self._loop_thread.start()
+        started.wait(timeout=30)
+        threading.Thread(target=self._route_poll_loop, daemon=True,
+                         name="serve-proxy-routes").start()
+        # First snapshot so early requests route.
+        try:
+            version, routes = ray_tpu.get(
+                self._controller.poll_routes.remote(-1, 0.1), timeout=30)
+            self._routes_version, self._routes = version, routes
+        except Exception:
+            pass
+        self._routes_ready.set()
 
-            def _dispatch(self):
-                try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                    raw = self.rfile.read(length) if length else b""
-                    if raw:
-                        try:
-                            payload = json.loads(raw)
-                        except ValueError:
-                            payload = raw.decode("utf-8", "replace")
-                    else:
-                        payload = None
-                    result = proxy._route(self.path, payload)
-                    body = json.dumps({"result": result}).encode()
-                    self.send_response(200)
-                except KeyError as e:
-                    body = json.dumps({"error": str(e)}).encode()
-                    self.send_response(404)
-                except Exception as e:  # noqa: BLE001
-                    body = json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+    # ---- route table (push-invalidated) -----------------------------------
+    def _route_poll_loop(self):
+        import time
 
-            do_GET = do_POST = _dispatch
+        while True:
+            try:
+                version, routes = ray_tpu.get(
+                    self._controller.poll_routes.remote(
+                        self._routes_version, 25.0), timeout=60)
+                self._routes_version = version
+                self._routes = routes
+                stale = set(self._handles) - set(routes)
+                for app in stale:
+                    self._handles.pop(app, None)
+            except Exception:
+                time.sleep(1.0)
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
-        self.port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever, daemon=True,
-                         name="serve-proxy").start()
-
-    def _route(self, path: str, payload: Any) -> Any:
+    def _handle_for(self, app: str):
         from ray_tpu.serve.handle import DeploymentHandle
 
-        app_name = path.strip("/").split("/")[0] or "default"
-        apps = ray_tpu.get(self._controller.list_applications.remote(),
-                           timeout=30)
-        if app_name not in apps:
-            raise KeyError(f"no application '{app_name}'")
-        ingress = ray_tpu.get(
-            self._controller.get_ingress.remote(app_name), timeout=30)
-        if ingress is None:
-            raise KeyError(f"application '{app_name}' has no ingress")
-        handle = self._handles.get(app_name)
-        if handle is None:
-            handle = self._handles[app_name] = DeploymentHandle(
-                app_name, ingress)
-        if payload is None:
-            response = handle.remote()
-        else:
-            response = handle.remote(payload)
-        return response.result(timeout=120)
+        route = self._routes.get(app)
+        if route is None:
+            raise KeyError(f"no application '{app}'")
+        cached = self._handles.get(app)
+        if cached is not None and cached[0] == route["deployment"]:
+            return cached[1]
+        # First request, or the ingress deployment was renamed by a
+        # redeploy — a stale handle would route to the retired name.
+        handle = DeploymentHandle(app, route["deployment"])
+        self._handles[app] = (route["deployment"], handle)
+        return handle
 
+    # ---- http -------------------------------------------------------------
+    def _serve_forever(self, port: int, started: threading.Event):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def handler(request: "web.Request"):
+            self._requests_served += 1
+            parts = request.path.strip("/").split("/", 1)
+            app = parts[0] or "default"
+            if request.path == "/-/healthz":
+                return web.json_response({"ok": True})
+            if request.path == "/-/routes":
+                return web.json_response(
+                    {a: r.get("route_prefix") for a, r in
+                     self._routes.items()})
+            self._routes_ready.wait(timeout=10)
+            raw = await request.read()
+            if raw:
+                try:
+                    payload = json.loads(raw)
+                except ValueError:
+                    payload = raw.decode("utf-8", "replace")
+            else:
+                payload = None
+            route = self._routes.get(app)
+            if route is None:
+                return web.json_response({"error": f"no application '{app}'"},
+                                         status=404)
+            try:
+                handle = self._handle_for(app)
+            except KeyError as e:
+                return web.json_response({"error": str(e)}, status=404)
+            args = (payload,) if payload is not None else ()
+            if route.get("stream"):
+                return await self._stream_response(request, handle, args)
+            try:
+                response = await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    lambda: handle.remote(*args).result(timeout=120))
+            except Exception as e:  # noqa: BLE001 — surfaced as HTTP 500
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=500)
+            return web.json_response({"result": response})
+
+        async def _stream(request, handle, args):
+            from aiohttp import web
+
+            resp = web.StreamResponse()
+            resp.headers["Content-Type"] = "text/plain; charset=utf-8"
+            await resp.prepare(request)
+            loop = asyncio.get_running_loop()
+            # The router blocks (replica waits, sync submission) — keep it
+            # off the event loop, same as the non-streaming path.
+            gen = await loop.run_in_executor(
+                None, lambda: handle.options(stream=True).remote(*args))
+            it = iter(gen)
+
+            def _next():
+                try:
+                    return next(it)
+                except StopIteration:
+                    return _STOP
+
+            while True:
+                item = await loop.run_in_executor(None, _next)
+                if item is _STOP:
+                    break
+                if isinstance(item, bytes):
+                    chunk = item
+                elif isinstance(item, str):
+                    chunk = item.encode()
+                else:
+                    chunk = (json.dumps(item) + "\n").encode()
+                await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+
+        _STOP = object()
+        self._stream_response = _stream
+
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_route("*", "/{tail:.*}", handler)
+        runner = web.AppRunner(app, access_log=None)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "0.0.0.0", port)
+        loop.run_until_complete(site.start())
+        self.port = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+
+    # ---- actor api --------------------------------------------------------
     def get_port(self) -> int:
         return self.port
 
     def healthz(self) -> bool:
         return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {"requests_served": self._requests_served,
+                "routes": dict(self._routes)}

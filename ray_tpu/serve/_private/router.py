@@ -3,7 +3,10 @@
 Reference: `serve/_private/router.py:254` + power-of-two-choices scheduler
 (`replica_scheduler/pow_2_scheduler.py:44`): sample two random replicas,
 send to the one with fewer locally-tracked in-flight requests. The replica
-set refreshes from the controller when its routing version bumps.
+set is push-invalidated: a background thread long-polls the controller
+(`poll_replicas`, the LongPollHost analogue) and replies arrive the moment
+the routing version bumps — the request hot path never talks to the
+controller.
 """
 
 from __future__ import annotations
@@ -11,7 +14,7 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import ray_tpu
 
@@ -27,10 +30,41 @@ class Router:
         self._version = -1
         self._inflight: Dict[Any, int] = {}
         self._lock = threading.Lock()
-        self._last_refresh = 0.0
+        self._have_replicas = threading.Event()
         self._router_id = uuid.uuid4().hex[:12]
         self._push_thread_started = False
-        self._refresh(force=True)
+        self._closed = False
+        # Synchronous first snapshot, then the long-poll keeps it fresh.
+        self._apply(*ray_tpu.get(
+            self._controller.get_replicas.remote(app_name, deployment_name),
+            timeout=60))
+        threading.Thread(target=self._poll_loop, daemon=True,
+                         name="serve-router-poll").start()
+
+    def _apply(self, version: int, replicas: List[Any]) -> None:
+        with self._lock:
+            if version != self._version:
+                self._version = version
+                self._replicas = replicas
+                self._inflight = {r: self._inflight.get(r, 0)
+                                  for r in replicas}
+            if self._replicas:
+                self._have_replicas.set()
+            else:
+                self._have_replicas.clear()
+
+    def _poll_loop(self) -> None:
+        while not self._closed:
+            try:
+                version, replicas = ray_tpu.get(
+                    self._controller.poll_replicas.remote(
+                        self._app, self._deployment, self._version, 25.0),
+                    timeout=60)
+                self._apply(version, replicas)
+            except Exception:
+                if self._closed:
+                    return
+                time.sleep(1.0)
 
     def _maybe_push_metrics(self) -> None:
         """Start the periodic load reporter on first traffic. A background
@@ -55,38 +89,17 @@ class Router:
         threading.Thread(target=run, daemon=True,
                          name="serve-metrics-push").start()
 
-    def _refresh(self, force: bool = False) -> None:
-        now = time.monotonic()
-        if not force and now - self._last_refresh < 1.0:
-            return
-        self._last_refresh = now
-        version, replicas = ray_tpu.get(
-            self._controller.get_replicas.remote(self._app, self._deployment),
-            timeout=60)
-        with self._lock:
-            if version != self._version:
-                self._version = version
-                self._replicas = replicas
-                self._inflight = {r: self._inflight.get(r, 0)
-                                  for r in replicas}
-
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       model_id: str = ""):
-        """Returns an ObjectRef for the response."""
-        deadline = time.monotonic() + 30.0
-        while True:
-            self._refresh()
-            with self._lock:
-                replicas = list(self._replicas)
-            if replicas:
-                break
-            if time.monotonic() > deadline:
+                       model_id: str = "", stream: bool = False):
+        """Returns an ObjectRef (or ObjectRefGenerator when streaming)."""
+        if not self._have_replicas.wait(timeout=30.0):
+            raise RuntimeError(
+                f"no live replicas for {self._app}/{self._deployment}")
+        with self._lock:
+            replicas = list(self._replicas)
+            if not replicas:
                 raise RuntimeError(
                     f"no live replicas for {self._app}/{self._deployment}")
-            self._refresh(force=True)
-            time.sleep(0.1)
-
-        with self._lock:
             if len(replicas) == 1:
                 chosen = replicas[0]
             elif model_id:
@@ -105,13 +118,20 @@ class Router:
             self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
         self._maybe_push_metrics()
 
-        ref = chosen.handle_request.remote(method_name, args, kwargs,
-                                           model_id)
+        method = chosen.handle_request
+        if stream:
+            method = method.options(num_returns="streaming")
+        ref = method.remote(method_name, args, kwargs, model_id)
 
         def _done(_fut):
             with self._lock:
                 if chosen in self._inflight:
                     self._inflight[chosen] -= 1
 
-        ref.future().add_done_callback(_done)
+        if stream:
+            # Generator: decrement when the final item lands (the
+            # generator ref resolves at completion).
+            ref._ref0.future().add_done_callback(_done)
+        else:
+            ref.future().add_done_callback(_done)
         return ref

@@ -33,6 +33,10 @@ class Deployment:
     # {"min_replicas", "max_replicas", "target_ongoing_requests",
     #  "upscale_delay_s", "downscale_delay_s"}
     autoscaling_config: Optional[Dict[str, Any]] = None
+    # Generator deployments: HTTP responses stream chunk-by-chunk and
+    # handles default to DeploymentResponseGenerator (reference:
+    # StreamingResponse over uvicorn).
+    stream: bool = False
 
     def options(self, **overrides) -> "Deployment":
         return dataclasses.replace(self, **overrides)
@@ -91,6 +95,7 @@ class Application:
                 "is_ingress": is_ingress,
                 "max_ongoing_requests": d.max_ongoing_requests,
                 "autoscaling_config": autoscaling,
+                "stream": d.stream,
             })
         return DeploymentHandle(app_name, d.name)
 
@@ -99,7 +104,8 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                num_replicas: Any = 1, num_cpus: float = 1,
                num_tpus: float = 0, route_prefix: Optional[str] = None,
                max_ongoing_requests: int = 8,
-               autoscaling_config: Optional[Dict[str, Any]] = None):
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               stream: bool = False):
     def wrap(target):
         return Deployment(
             func_or_class=target,
@@ -107,23 +113,50 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
             num_replicas=num_replicas, num_cpus=num_cpus,
             num_tpus=num_tpus, route_prefix=route_prefix,
             max_ongoing_requests=max_ongoing_requests,
-            autoscaling_config=autoscaling_config)
+            autoscaling_config=autoscaling_config, stream=stream)
 
     return wrap(func_or_class) if func_or_class is not None else wrap
 
 
 # ---------------------------------------------------------------- lifecycle
 
-def start(http_port: int = 0):
-    """Start the proxy (controller starts lazily on first run())."""
+def start(http_port: int = 0, proxy_location: str = "HeadOnly"):
+    """Start the HTTP ingress (controller starts lazily on first run()).
+
+    ``proxy_location="EveryNode"`` pins one proxy actor per alive node
+    (reference: ProxyLocation.EveryNode — each node accepts traffic and
+    routes to replicas anywhere), returning the head-node proxy.
+    """
     from ray_tpu.serve._private.controller import get_or_create_controller
 
     get_or_create_controller()
+    from ray_tpu.serve._private.proxy import ProxyActor
+
+    if proxy_location == "EveryNode":
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        head = None
+        for node in ray_tpu.nodes():
+            if not node.get("Alive"):
+                continue
+            node_id = node["NodeID"]
+            name = f"{_PROXY_NAME}:{node_id[:12]}"
+            try:
+                proxy = ray_tpu.get_actor(name)
+            except Exception:
+                proxy = ProxyActor.options(
+                    name=name, lifetime="detached",
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=node_id, soft=False),
+                ).remote(http_port)
+            if head is None:
+                head = proxy
+        return head
     try:
         return ray_tpu.get_actor(_PROXY_NAME)
     except Exception:
-        from ray_tpu.serve._private.proxy import ProxyActor
-
         return ProxyActor.options(name=_PROXY_NAME,
                                   lifetime="detached").remote(http_port)
 

@@ -7,7 +7,7 @@ deployments rehydrate in the replica process).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import ray_tpu
 
@@ -24,6 +24,23 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming response (reference: handle.options(stream=True) ->
+    DeploymentResponseGenerator): iterate to receive items as the replica
+    yields them."""
+
+    def __init__(self, ref_gen):
+        self._gen = ref_gen
+
+    def __iter__(self):
+        for ref in self._gen:
+            yield ray_tpu.get(ref, timeout=120)
+
+    @property
+    def ref_generator(self):
+        return self._gen
+
+
 class _MethodCaller:
     def __init__(self, handle: "DeploymentHandle", method: str):
         self._handle = handle
@@ -35,17 +52,25 @@ class _MethodCaller:
 
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str,
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self._app = app_name
         self._deployment = deployment_name
         self._model_id = multiplexed_model_id
+        self._stream = stream
         self._router = None
 
-    def options(self, *, multiplexed_model_id: str = "") -> "DeploymentHandle":
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         """Request options (reference: handle.options(multiplexed_model_id=…)
-        routes to a replica already holding that model)."""
-        clone = DeploymentHandle(self._app, self._deployment,
-                                 multiplexed_model_id)
+        routes to a replica already holding that model;
+        handle.options(stream=True) returns a DeploymentResponseGenerator
+        over the replica's yielded items). Unspecified options keep the
+        current handle's values — chained .options() calls compose."""
+        clone = DeploymentHandle(
+            self._app, self._deployment,
+            self._model_id if multiplexed_model_id is None
+            else multiplexed_model_id,
+            self._stream if stream is None else stream)
         clone._router = self._router    # share the router + inflight view
         return clone
 
@@ -60,10 +85,12 @@ class DeploymentHandle:
                                   self._deployment)
         return self._router
 
-    def _call(self, method: str, args: tuple,
-              kwargs: dict) -> DeploymentResponse:
+    def _call(self, method: str, args: tuple, kwargs: dict):
         ref = self._get_router().assign_request(method, args, kwargs,
-                                                model_id=self._model_id)
+                                                model_id=self._model_id,
+                                                stream=self._stream)
+        if self._stream:
+            return DeploymentResponseGenerator(ref)
         return DeploymentResponse(ref)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:

@@ -6,6 +6,9 @@ from ray_tpu.train._internal.session import (
 )
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.sharded_checkpoint import (  # noqa: F401
+    load_sharded, save_sharded,
+)
 from ray_tpu.train.config import (
     CheckpointConfig, FailureConfig, Result, RunConfig, ScalingConfig,
 )

@@ -297,3 +297,74 @@ def test_config_file_deploy(serve_cluster, tmp_path):
         serve.delete("cfg_pre")
     finally:
         sys.path.remove(str(tmp_path))
+
+
+def test_streaming_deployment_http_and_handle(serve_cluster):
+    """Serve v2: chunked streaming over the aiohttp ingress and
+    DeploymentResponseGenerator over Python handles (reference:
+    `serve/_private/proxy.py` StreamingResponse over uvicorn)."""
+    import json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    @serve.deployment(stream=True, name="ChunkSource")
+    class ChunkSource:
+        def __call__(self, payload=None):
+            for i in range(int(payload or 3)):
+                yield f"c{i}\n"
+
+    serve.run(ChunkSource.bind(), name="streamapp")
+    proxy = serve.start()
+    port = ray_tpu.get(proxy.get_port.remote(), timeout=60)
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/streamapp", data=b"4")
+    resp = urllib.request.urlopen(req, timeout=60)
+    lines = [ln.decode().strip() for ln in resp if ln.strip()]
+    assert lines == ["c0", "c1", "c2", "c3"]
+
+    handle = DeploymentHandle("streamapp", "ChunkSource")
+    out = list(handle.options(stream=True).remote(2))
+    assert out == ["c0\n", "c1\n"]
+    serve.delete("streamapp")
+
+
+def test_router_push_invalidation_latency(serve_cluster):
+    """Replica-set changes reach existing routers by long-poll push, not
+    a polling interval: after a redeploy bumps the routing version, the
+    router converges well under a second (reference: LongPollHost)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    @serve.deployment(name="Bumpy")
+    class Bumpy:
+        def __call__(self, payload=None):
+            return "v1"
+
+    serve.run(Bumpy.bind(), name="bumpapp")
+    handle = DeploymentHandle("bumpapp", "Bumpy")
+    assert handle.remote().result(timeout=60) == "v1"
+    router = handle._get_router()
+    v0 = router._version
+
+    @serve.deployment(name="Bumpy", num_replicas=2)
+    class Bumpy2:
+        def __call__(self, payload=None):
+            return "v2"
+
+    serve.run(Bumpy2.bind(), name="bumpapp")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and router._version == v0:
+        time.sleep(0.05)
+    waited = 5.0 - (deadline - time.monotonic())
+    assert router._version != v0, "router never saw the new version"
+    # Long-poll delivery is push-shaped: the update lands promptly.
+    assert waited < 3.0, f"update took {waited:.1f}s — looks like polling"
+    assert handle.remote().result(timeout=60) == "v2"
+    serve.delete("bumpapp")
