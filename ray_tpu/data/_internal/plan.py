@@ -38,6 +38,16 @@ class MapBatches(Op):
     batch_size: Optional[int] = None
     batch_format: str = "numpy"
     fn_kwargs: dict = dataclasses.field(default_factory=dict)
+    # Stateful-UDF execution (reference: actor_pool_map_operator.py):
+    # a class UDF + concurrency>0 runs on a pool of actors, one instance
+    # per actor (model loaded once), instead of stateless tasks.
+    concurrency: Optional[int] = None
+    num_cpus: float = 1
+    num_tpus: float = 0
+
+    @property
+    def uses_actors(self) -> bool:
+        return self.concurrency is not None or isinstance(self.fn, type)
 
 
 @dataclasses.dataclass
@@ -82,6 +92,7 @@ def compile_block_fn(ops: List[Op]) -> Callable[[Any], Any]:
         for op in ops:
             acc = BlockAccessor(block)
             if isinstance(op, MapBatches):
+                fn = op.fn() if isinstance(op.fn, type) else op.fn
                 outs = []
                 n = acc.num_rows()
                 bs = op.batch_size or n or 1
@@ -89,8 +100,8 @@ def compile_block_fn(ops: List[Op]) -> Callable[[Any], Any]:
                     if n == 0:
                         break
                     sub = BlockAccessor(acc.slice(lo, min(lo + bs, n)))
-                    out = op.fn(sub.to_batch(op.batch_format),
-                                **op.fn_kwargs)
+                    out = fn(sub.to_batch(op.batch_format),
+                             **op.fn_kwargs)
                     outs.append(BlockAccessor.from_batch(out))
                 block = (BlockAccessor.concat([o for o in outs])
                          if outs else pa.table({}))
@@ -114,11 +125,17 @@ def compile_block_fn(ops: List[Op]) -> Callable[[Any], Any]:
 
 def split_stages(ops: List[Op]) -> List[Any]:
     """Group the op list into stages: each stage is either a source op, a
-    barrier op, or a fused list of map-like ops."""
+    barrier op, an actor-pool MapBatches, or a fused list of map-like
+    ops."""
     stages: List[Any] = []
     run: List[Op] = []
     for op in ops:
-        if isinstance(op, MAP_LIKE):
+        if isinstance(op, MapBatches) and op.uses_actors:
+            if run:
+                stages.append(list(run))
+                run = []
+            stages.append(op)
+        elif isinstance(op, MAP_LIKE):
             run.append(op)
         else:
             if run:

@@ -172,3 +172,101 @@ def local_groupby(blocks: List[Any], key: str,
     if table is None:
         return []
     return [table.group_by(key).aggregate(list(aggs))]
+
+
+# ---------------------------------------------------------------------------
+# All-to-all random shuffle / repartition over object refs (reference:
+# `execution/operators/all_to_all_operator.py` + shuffle task scheduler):
+# map tasks split each input into N chunks, reduce tasks combine chunk p of
+# every input. Block data moves store-to-store; the driver only holds refs.
+# ---------------------------------------------------------------------------
+
+@ray_tpu.remote
+def _shuffle_map(blocks: List[Any], n: int, seed: int):
+    """One read-task output (list of tables) -> n random-assigned chunks."""
+    import pyarrow as pa
+
+    if not isinstance(blocks, list):
+        blocks = [blocks]
+    tables = [t for t in blocks if t.num_rows]
+    if not tables:
+        empty = pa.table({})
+        return [empty] * n if n > 1 else [empty]
+    table = pa.concat_tables(tables, promote_options="default")
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, n, table.num_rows)
+    return [table.filter(pa.array(assign == p)) for p in range(n)]
+
+
+@ray_tpu.remote
+def _shuffle_reduce(seed: int, *chunks):
+    import pyarrow as pa
+
+    non_empty = [c for c in chunks if c.num_rows]
+    if not non_empty:
+        return pa.table({})
+    table = pa.concat_tables(non_empty, promote_options="default")
+    perm = np.random.default_rng(seed).permutation(table.num_rows)
+    return table.take(perm)
+
+
+def distributed_random_shuffle(list_refs: List[Any], n_out: int,
+                               seed) -> List[Any]:
+    """list_refs: refs of block-lists. Returns n_out refs of output blocks."""
+    base = 0 if seed is None else int(seed)
+    n_out = max(1, n_out)
+    parts = []
+    for i, ref in enumerate(list_refs):
+        out = _shuffle_map.options(num_returns=n_out).remote(
+            ref, n_out, base + 7919 * (i + 1))
+        parts.append(out if isinstance(out, list) else [out])
+    return [
+        _shuffle_reduce.remote(base + 104729 * (p + 1),
+                               *[parts[i][p] for i in range(len(parts))])
+        for p in range(n_out)
+    ]
+
+
+@ray_tpu.remote
+def _split_chunks(blocks: List[Any], n: int):
+    """Split one input's rows into n contiguous, evenly-sized chunks."""
+    import pyarrow as pa
+
+    if not isinstance(blocks, list):
+        blocks = [blocks]
+    tables = [t for t in blocks if t.num_rows]
+    if not tables:
+        empty = pa.table({})
+        return [empty] * n if n > 1 else [empty]
+    table = pa.concat_tables(tables, promote_options="default")
+    total = table.num_rows
+    per, extra = divmod(total, n)
+    out, lo = [], 0
+    for p in range(n):
+        size = per + (1 if p < extra else 0)
+        out.append(table.slice(lo, size))
+        lo += size
+    return out
+
+
+@ray_tpu.remote
+def _concat_chunks(*chunks):
+    import pyarrow as pa
+
+    non_empty = [c for c in chunks if c.num_rows]
+    if not non_empty:
+        return pa.table({})
+    return pa.concat_tables(non_empty, promote_options="default")
+
+
+def distributed_repartition(list_refs: List[Any], n: int) -> List[Any]:
+    """Approximately even n-way repartition over refs (each input
+    contributes one slice to every output)."""
+    n = max(1, n)
+    parts = []
+    for ref in list_refs:
+        out = _split_chunks.options(num_returns=n).remote(ref, n)
+        parts.append(out if isinstance(out, list) else [out])
+    return [_concat_chunks.remote(*[parts[i][p]
+                                    for i in range(len(parts))])
+            for p in range(n)]

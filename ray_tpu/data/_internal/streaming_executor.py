@@ -45,6 +45,14 @@ def _run_transform(blocks: List[Any], fused_fn) -> List[Any]:
 
 
 @ray_tpu.remote
+def _as_block_list(item, fused) -> List[Any]:
+    blocks = item if isinstance(item, list) else [item]
+    if fused is not None:
+        blocks = [fused(b) for b in blocks]
+    return blocks
+
+
+@ray_tpu.remote
 def _gather_slices(parts: List[Any]) -> List[Any]:
     """parts: list of (blocks_list, lo, hi) row-ranges to concat."""
     out = []
@@ -84,6 +92,28 @@ class StreamingExecutor:
             fused = plan_mod.compile_block_fn(rest[0])
             rest = rest[1:]
 
+        # All-to-all barrier directly after the (fused) source: run it as
+        # a distributed exchange over refs — block bytes move store to
+        # store, never through this process (so a shuffle larger than
+        # driver memory works).
+        if (rest and _cluster_available()
+                and isinstance(rest[0], (plan_mod.RandomShuffle,
+                                         plan_mod.Repartition))):
+            refs = self._source_refs(first, fused)
+            if refs is not None:
+                from ray_tpu.data._internal import shuffle as shuffle_mod
+
+                barrier = rest[0]
+                if isinstance(barrier, plan_mod.RandomShuffle):
+                    out_refs = shuffle_mod.distributed_random_shuffle(
+                        refs, n_out=max(len(refs), 1), seed=barrier.seed)
+                else:
+                    out_refs = shuffle_mod.distributed_repartition(
+                        refs, barrier.n)
+                yield from self._apply_rest(
+                    self._stream_input(out_refs, None), rest[1:])
+                return
+
         if isinstance(first, plan_mod.Read):
             tasks = first.datasource.get_read_tasks(
                 first.parallelism if first.parallelism > 0 else 8)
@@ -94,6 +124,30 @@ class StreamingExecutor:
             raise TypeError(f"bad source op {first}")
 
         yield from self._apply_rest(source, rest)
+
+    def _source_refs(self, first, fused) -> Optional[List[Any]]:
+        """Materialize the source stage as refs of block-lists (no driver
+        fetch). None when the source kind doesn't support it."""
+        from ray_tpu import ObjectRef
+
+        if isinstance(first, plan_mod.Read):
+            tasks = first.datasource.get_read_tasks(
+                first.parallelism if first.parallelism > 0 else 8)
+            return [_run_read.remote(t, fused) for t in tasks]
+        if isinstance(first, plan_mod.InputBlocks):
+            refs = []
+            for r in first.refs:
+                if isinstance(r, ObjectRef) and fused is None:
+                    refs.append(r)
+                elif isinstance(r, ObjectRef):
+                    refs.append(_as_block_list.remote(r, fused))
+                else:
+                    blocks = r if isinstance(r, list) else [r]
+                    if fused is not None:
+                        blocks = [fused(b) for b in blocks]
+                    refs.append(ray_tpu.put(blocks))
+            return refs
+        return None
 
     def _apply_rest(self, source: Iterator[Any], stages: List[Any]
                     ) -> Iterator[Any]:
@@ -116,6 +170,9 @@ class StreamingExecutor:
                     if seen >= head.n:
                         return  # early exit stops upstream submission
             yield from self._apply_rest(limited(), rest)
+        elif isinstance(head, plan_mod.MapBatches) and head.uses_actors:
+            yield from self._apply_rest(
+                self._actor_pool_map(source, head), rest)
         elif isinstance(head, plan_mod.Repartition):
             yield from self._apply_rest(
                 self._repartition(list(source), head.n), rest)
@@ -194,6 +251,58 @@ class StreamingExecutor:
             raise ValueError(
                 "zip(): right dataset has more rows than left")
 
+    # -------------------------------------------------------- actor pool
+    def _actor_pool_map(self, source: Iterator[Any],
+                        op) -> Iterator[Any]:
+        """Stateful-UDF stage on a pool of actors (reference:
+        `execution/operators/actor_pool_map_operator.py`): the class
+        constructs once per actor; blocks pipeline through the pool with
+        a bounded in-flight window per actor."""
+        from ray_tpu.data._internal.plan import MapBatches, compile_block_fn
+
+        inline_op = MapBatches(op.fn, batch_size=op.batch_size,
+                               batch_format=op.batch_format,
+                               fn_kwargs=op.fn_kwargs)
+        if not _cluster_available():
+            fn = compile_block_fn([inline_op])
+            for b in source:
+                yield fn(b)
+            return
+
+        @ray_tpu.remote
+        class _MapWorker:
+            def __init__(self, op_):
+                from ray_tpu.data._internal.plan import compile_block_fn
+
+                self._fn = compile_block_fn([op_])
+
+            def apply(self, block):
+                return self._fn(block)
+
+        size = op.concurrency or 2
+        opts = {"num_cpus": op.num_cpus}
+        if op.num_tpus:
+            opts["num_tpus"] = op.num_tpus
+        pool = [_MapWorker.options(**opts).remote(inline_op)
+                for _ in range(size)]
+        try:
+            pending: deque = deque()   # (ref) in submission order
+            rr = 0
+            per_actor_window = 2
+            for block in source:
+                while len(pending) >= size * per_actor_window:
+                    yield ray_tpu.get(pending.popleft(), timeout=600)
+                pending.append(pool[rr % size].apply.remote(block))
+                rr += 1
+            while pending:
+                yield ray_tpu.get(pending.popleft(), timeout=600)
+        finally:
+            for a in pool:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
     # -------------------------------------------------------------- waves
     def _stream_tasks(self, read_tasks: List[Any], fused) -> Iterator[Any]:
         if not _cluster_available():
@@ -201,11 +310,23 @@ class StreamingExecutor:
                 for block in t():
                     yield fused(block) if fused is not None else block
             return
+        # Byte-budget backpressure (reference:
+        # `execution/backpressure_policy/streaming_output_backpressure_policy`):
+        # the in-flight window adapts to observed task-output size so a
+        # wide dataset doesn't buffer gigabytes while a narrow one still
+        # pipelines deeply.
+        target_bytes = 256 * 1024 * 1024
+        ema_task_bytes: Optional[float] = None
         pending: deque = deque()
         it = iter(read_tasks)
         exhausted = False
         while pending or not exhausted:
-            while not exhausted and len(pending) < self._in_flight:
+            if ema_task_bytes:
+                budget = max(2, int(target_bytes / max(ema_task_bytes, 1)))
+            else:
+                budget = self._in_flight
+            window = min(max(2, budget), 4 * self._in_flight)
+            while not exhausted and len(pending) < window:
                 try:
                     t = next(it)
                 except StopIteration:
@@ -214,6 +335,9 @@ class StreamingExecutor:
                 pending.append(_run_read.remote(t, fused))
             if pending:
                 blocks = ray_tpu.get(pending.popleft(), timeout=600)
+                size = sum(BlockAccessor(b).size_bytes() for b in blocks)
+                ema_task_bytes = (size if ema_task_bytes is None
+                                  else 0.7 * ema_task_bytes + 0.3 * size)
                 yield from blocks
 
     def _stream_input(self, refs: List[Any], fused) -> Iterator[Any]:

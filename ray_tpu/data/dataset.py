@@ -33,10 +33,19 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy",
-                    fn_kwargs: Optional[Dict] = None, **_ignored) -> "Dataset":
+                    fn_kwargs: Optional[Dict] = None,
+                    concurrency: Optional[int] = None,
+                    num_cpus: float = 1, num_tpus: float = 0,
+                    **_ignored) -> "Dataset":
+        """Per-batch transform. A CLASS `fn` (or explicit `concurrency`)
+        runs on a pool of stateful actors — the constructor runs once per
+        actor, so model weights load once per worker, and `num_tpus`
+        reserves accelerator chips per actor (reference:
+        `actor_pool_map_operator.py` / `ActorPoolStrategy`)."""
         return self._with(plan_mod.MapBatches(
             fn, batch_size=batch_size, batch_format=batch_format,
-            fn_kwargs=fn_kwargs or {}))
+            fn_kwargs=fn_kwargs or {}, concurrency=concurrency,
+            num_cpus=num_cpus, num_tpus=num_tpus))
 
     def map(self, fn: Callable, **_ignored) -> "Dataset":
         return self._with(plan_mod.MapRows(fn))

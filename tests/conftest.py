@@ -11,7 +11,14 @@ import os
 import sys
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+# The image's sitecustomize registers the TPU backend in EVERY python
+# subprocess when this env var is present (~2.2s per process). Tests run
+# on the CPU backend, but cluster tests spawn dozens of daemon/worker
+# subprocesses that would each pay that preload — it roughly triples the
+# suite wall-clock and makes first-task latency ~14s. Drop the trigger so
+# test-spawned processes boot clean.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,17 @@
+import os
+import subprocess
+import sys
+import time
+
+
+def test_spawn_speed(capsys):
+    msgs = []
+    for label, env in [
+        ("inherit", dict(os.environ)),
+        ("clean", {"PATH": os.environ["PATH"]}),
+    ]:
+        t = time.monotonic()
+        subprocess.run([sys.executable, "-c", "pass"], env=env, check=True)
+        msgs.append(f"{label}={time.monotonic()-t:.2f}s")
+    with capsys.disabled():
+        print("\nspawn: " + " ".join(msgs), flush=True)
