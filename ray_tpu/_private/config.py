@@ -83,6 +83,10 @@ _define("worker_pool_idle_ttl_s", float, 600.0,
 
 # --- fault tolerance ---
 _define("health_check_period_ms", int, 1000, "")
+_define("raylet_report_resources_period_ms", int, 100,
+        "How often a raylet pushes its resource view to the GCS. Drives how "
+        "fast spillback decisions see remote availability (reference: "
+        "raylet_report_resources_period_milliseconds).")
 _define("health_check_failure_threshold", int, 5,
         "Consecutive missed health checks before a node is marked dead.")
 _define("task_max_retries_default", int, 3, "")

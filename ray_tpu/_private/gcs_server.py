@@ -79,6 +79,12 @@ class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.server = RpcServer(host, port)
         self.view = ClusterView()
+        # Bumped whenever the nodes snapshot would change (membership or a
+        # node's resource availability). Raylets echo the last seq they
+        # applied; heartbeat replies carry a fresh snapshot only when it
+        # advanced — at a 100ms report period an idle cluster would
+        # otherwise serialize O(nodes) snapshots to every raylet 10x/s.
+        self._view_seq = 1
         self.pubsub = Pubsub()
 
         # node_id(bytes) -> node info dict
@@ -442,6 +448,7 @@ class GcsServer:
         }
         nr = NodeResources(ResourceSet(resources), labels)
         self.view.update_node(node_id, nr)
+        self._view_seq += 1
         self._last_heartbeat[node_id] = time.monotonic()
         self.pubsub.publish("node", {"event": "ALIVE", "node_id": node_id,
                                      "addr": addr})
@@ -449,19 +456,26 @@ class GcsServer:
                 "nodes": self._nodes_snapshot()}
 
     async def _h_heartbeat(self, node_id, available, total, idle=True,
-                           pending_demands=None, num_workers=0):
+                           pending_demands=None, num_workers=0,
+                           have_seq=0):
         if node_id not in self.nodes:
             return {"unknown": True}
         if os.environ.get("RAY_TPU_DEBUG_SCHED"):
             print(f"[gcs-hb {time.monotonic():.3f}] handled",
                   file=sys.stderr, flush=True)
         self._last_heartbeat[node_id] = time.monotonic()
+        old = self.view.get(node_id)
         nr = NodeResources(ResourceSet(total), self.nodes[node_id]["labels"])
         nr.available = ResourceSet(available)
+        if (old is None or old.available.to_dict() != nr.available.to_dict()
+                or old.total.to_dict() != nr.total.to_dict()):
+            self._view_seq += 1
         self.view.update_node(node_id, nr)
         self.nodes[node_id]["pending_demands"] = pending_demands or []
         self.nodes[node_id]["num_workers"] = num_workers
-        return {"nodes": self._nodes_snapshot()}
+        if have_seq == self._view_seq:
+            return {"seq": self._view_seq}
+        return {"seq": self._view_seq, "nodes": self._nodes_snapshot()}
 
     async def _h_get_cluster_load(self):
         """Autoscaler state (reference: gcs_autoscaler_state_manager.h):
@@ -512,6 +526,7 @@ class GcsServer:
               f"(last heartbeat {age} ago)", file=sys.stderr, flush=True)
         info["state"] = DEAD
         self.view.remove_node(node_id)
+        self._view_seq += 1
         self.pubsub.publish("node", {"event": "DEAD", "node_id": node_id,
                                      "reason": reason})
         # Fail/restart actors that lived on this node.

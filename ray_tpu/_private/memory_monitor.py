@@ -47,13 +47,17 @@ def usage_fraction(test_path: str = "") -> Optional[float]:
         return None
 
 
-def pick_victim(workers) -> Optional[object]:
+def pick_victim(workers, busy_ids=frozenset()) -> Optional[object]:
     """Worker-killing policy over _WorkerHandle values: leased task
-    workers before actors (tasks retry for free; actors lose state),
-    newest lease first (its work loses the least progress)."""
+    workers before actors (tasks retry for free; actors lose state);
+    within a class, workers actually executing before idle-leased ones
+    (killing a pool-idle worker frees no task memory); newest lease
+    first (its work loses the least progress). ``busy_ids`` is the set
+    of worker_ids observed executing (raylet probes `busy_info`)."""
     leased = [h for h in workers if h.lease is not None]
     if not leased:
         return None
     tasks = [h for h in leased if not h.is_actor]
     pool = tasks or leased
-    return max(pool, key=lambda h: getattr(h, "lease_ts", 0.0))
+    return max(pool, key=lambda h: (getattr(h, "worker_id", None) in busy_ids,
+                                    getattr(h, "lease_ts", 0.0)))

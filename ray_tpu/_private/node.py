@@ -212,6 +212,7 @@ class Node:
              "--host", self.host, "--port", "0",
              "--gcs-host", self.gcs_addr[0],
              "--gcs-port", str(self.gcs_addr[1]),
+             "--session-dir", self.session_dir,
              "--fate-share-pid",
              str(os.getpid() if self._fate_share else 0)],
             stdout=subprocess.PIPE, stderr=log, env=self._daemon_env(),

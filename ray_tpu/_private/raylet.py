@@ -131,6 +131,8 @@ class Raylet:
         # turned into a fast lease error so owners fail tasks with
         # RuntimeEnvSetupError instead of hot-looping spawn attempts.
         self._env_failures: Dict[bytes, Tuple[str, float]] = {}
+        # worker_id -> RpcClient used by the memory monitor's busy probe.
+        self._worker_probe_clients: Dict[bytes, Any] = {}
 
     # ------------------------------------------------------------------- boot
     def start(self) -> int:
@@ -171,7 +173,11 @@ class Raylet:
         from ray_tpu._private.rpc import debug_log
 
         _dbg = debug_log("hb")
-        period = GlobalConfig.health_check_period_ms / 1000
+        # Resource reports drive spillback freshness, so they run much
+        # faster than liveness needs (reference splits these the same way:
+        # report_resources_period vs health check period).
+        period = GlobalConfig.raylet_report_resources_period_ms / 1000
+        have_seq = 0
         while not self._dead:
             try:
                 now = time.monotonic()
@@ -189,6 +195,7 @@ class Raylet:
                     total=self.local.total.to_dict(),
                     pending_demands=pending,
                     num_workers=len(self.workers),
+                    have_seq=have_seq,
                     timeout=10)
                 _dbg("reply ok")
                 if reply.get("unknown"):
@@ -207,8 +214,10 @@ class Raylet:
                         timeout=10)
                     if "nodes" in rereg:
                         self._apply_nodes_snapshot(rereg["nodes"])
+                        have_seq = 0  # fresh GCS numbers from 1 again
                 elif "nodes" in reply:
                     self._apply_nodes_snapshot(reply["nodes"])
+                    have_seq = reply.get("seq", 0)
             except Exception as e:
                 _dbg("EXC", repr(e))
             await asyncio.sleep(period)
@@ -251,6 +260,15 @@ class Raylet:
         return self._renv_manager
 
     def _release_worker_env(self, handle) -> None:
+        """Per-worker teardown at every removal site: runtime_env cache
+        refs plus the memory monitor's probe client."""
+        if handle is not None:
+            client = self._worker_probe_clients.pop(handle.worker_id, None)
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:
+                    pass
         if handle is not None and handle.env_uris:
             uris, handle.env_uris = handle.env_uris, []
             try:
@@ -558,7 +576,7 @@ class Raylet:
             usage = memory_monitor.usage_fraction(test_path)
             if usage is None or usage <= threshold:
                 continue
-            victim = memory_monitor.pick_victim(self.workers.values())
+            victim = await self._pick_oom_victim()
             if victim is None:
                 continue
             self._oom_kills += 1
@@ -576,6 +594,41 @@ class Raylet:
             # Let the reaper pick up the death before re-sampling, so one
             # spike doesn't massacre the whole pool.
             await asyncio.sleep(max(period, 1.0))
+
+    async def _pick_oom_victim(self):
+        """Worker-killing policy (reference `worker_killing_policy.h:34`):
+        among leased workers, prefer one actually executing (killing an
+        idle pool worker frees no task memory), prefer retriable tasks
+        over actors (tasks retry for free; actors lose state), newest
+        lease first (loses the least progress). Busy state comes from a
+        short `busy_info` probe; an unresponsive worker counts as busy —
+        a thrashing process can't answer and is the likeliest hog."""
+        from ray_tpu._private import memory_monitor
+        from ray_tpu._private.rpc import RpcClient
+
+        leased = [h for h in self.workers.values() if h.lease is not None]
+        if not leased:
+            return None
+
+        async def probe(h):
+            # Bound the WHOLE probe (connect included — acall's timeout
+            # starts after connect, and connect retries up to 10s): the
+            # monitor must pick a victim before the kernel OOM killer does.
+            try:
+                client = self._worker_probe_clients.get(h.worker_id)
+                if client is None:
+                    client = RpcClient(*h.addr)
+                    self._worker_probe_clients[h.worker_id] = client
+                info = await asyncio.wait_for(
+                    client.acall("busy_info", timeout=1.0), 1.0)
+                return h.worker_id if info.get("executing") else None
+            except Exception:
+                # Unresponsive = likeliest hog (a thrashing process can't
+                # answer): count as busy.
+                return h.worker_id
+        hits = await asyncio.gather(*(probe(h) for h in leased))
+        busy = {wid for wid in hits if wid is not None}
+        return memory_monitor.pick_victim(leased, busy)
 
     # ---------------------------------------------------------- lease protocol
     def _strategy_allows_local(self, strategy) -> bool:

@@ -192,7 +192,7 @@ class _LeaseState:
     """Per-scheduling-shape lease bookkeeping on the owner."""
 
     __slots__ = ("idle", "waiters", "inflight", "event",
-                 "dispatcher_started", "pushing")
+                 "dispatcher_started", "pushing", "remote_pending")
 
     def __init__(self):
         self.idle: deque = deque()      # parked reusable leases
@@ -201,6 +201,13 @@ class _LeaseState:
         self.event = asyncio.Event()    # wakes the dispatcher
         self.dispatcher_started = False
         self.pushing = 0                # batch pushes currently in flight
+        # Lease requests currently parked at a *remote* raylet (after a
+        # spillback). Each one is an expected grant on an other-node worker;
+        # the dispatcher must not starve those nodes by reusing a local
+        # finished lease for the waiter the remote grant is coming for
+        # (reference contract: the leased-worker cache never starves an
+        # idle node — `direct_task_transport.cc:600`).
+        self.remote_pending = 0
 
 
 class _WorkerCrashed:
@@ -258,6 +265,7 @@ class Worker:
         for name in ["push_task", "push_tasks", "create_actor",
                      "push_actor_task", "push_actor_tasks",
                      "get_object_status", "kill_self", "cancel_task", "ping",
+                     "busy_info",
                      "delete_object_notification", "report_generator_item",
                      "recover_object", "wait_object_status"]:
             self.server.register(name, getattr(self, f"_h_{name}"))
@@ -1015,9 +1023,17 @@ class Worker:
             st = self._lease_pool[key] = _LeaseState()
         return st
 
-    def _hand_lease(self, key: str, st: "_LeaseState", lease) -> None:
+    def _hand_lease(self, key: str, st: "_LeaseState", lease,
+                    reused: bool = False) -> None:
         lease["_idle_since"] = time.monotonic()
-        st.idle.append(lease)
+        lease["_reused"] = reused
+        if reused:
+            st.idle.append(lease)
+        else:
+            # Fresh grants pair before recycled leases: a grant was issued
+            # *for* a specific waiter by the cluster scheduler; honoring it
+            # first keeps placement decisions with the raylet.
+            st.idle.appendleft(lease)
         st.event.set()
         if not self._lease_pool_sweeper_started:
             self._lease_pool_sweeper_started = True
@@ -1099,6 +1115,16 @@ class Worker:
                 return
             while st.idle and st.waiters:
                 lease = st.idle.popleft()
+                if (lease.get("_reused") and st.remote_pending
+                        and not self._live_waiters_at_least(
+                            st, st.remote_pending + 1)):
+                    # Every remaining waiter has a grant pending on another
+                    # node (spilled request parked at a remote raylet).
+                    # Reusing this finished lease would serialize work on
+                    # this node while that node idles; park it instead —
+                    # the sweeper returns it if the grants land first.
+                    st.idle.appendleft(lease)
+                    break
                 batch = self._take_batch(st)
                 if not batch:
                     st.idle.appendleft(lease)
@@ -1106,6 +1132,21 @@ class Worker:
                 st.pushing += 1
                 asyncio.ensure_future(
                     self._push_batch(key, st, lease, batch))
+
+    @staticmethod
+    def _live_waiters_at_least(st: "_LeaseState", k: int) -> bool:
+        """True if >= k waiters are still live (future not done). Bounded
+        scan: stops at k, so callers comparing against small thresholds
+        (inflight caps, remote_pending) stay O(k) on deep queues."""
+        if k <= 0:
+            return True
+        n = 0
+        for _spec, fut in st.waiters:
+            if not fut.done():
+                n += 1
+                if n >= k:
+                    return True
+        return False
 
     def _take_batch(self, st: "_LeaseState"):
         """Pop the next push batch: one task normally; up to 8 of the same
@@ -1179,7 +1220,7 @@ class Worker:
                                            else 0.7 * prev + 0.3 * dur)
                 if not fut.done():
                     fut.set_result(reply)
-            self._hand_lease(key, st, lease)
+            self._hand_lease(key, st, lease, reused=True)
         finally:
             st.pushing -= 1
 
@@ -1209,6 +1250,18 @@ class Worker:
         fast_timeouts = 0
         try:
             while st.waiters and not self._dead:
+                if not self._live_waiters_at_least(
+                        st, len(st.idle) + st.inflight):
+                    # Remaining waiters are already covered by idle leases
+                    # (e.g. the grant this requester just handed over, not
+                    # yet consumed by the dispatcher) or by the other
+                    # in-flight requests (e.g. one parked at a spilled-to
+                    # raylet). A surplus request here would lease a worker
+                    # nobody will use — or steal the waiter back from an
+                    # idle remote node.
+                    break
+                remote = client is not self.raylet
+                st.remote_pending += remote
                 req_start = time.monotonic()
                 try:
                     reply = await client.acall(
@@ -1225,6 +1278,10 @@ class Worker:
                     await asyncio.sleep(0.2)
                     client = self.raylet
                     continue
+                finally:
+                    if remote:
+                        st.remote_pending -= 1
+                        st.event.set()  # a parked reused lease may now pair
                 if reply.get("timeout") and (
                         time.monotonic() - req_start < 5.0):
                     # The raylet gave up on a pop almost immediately: the
@@ -1244,6 +1301,11 @@ class Worker:
                     continue
                 if not reply.get("timeout"):
                     fast_timeouts = 0
+                elif remote:
+                    # Full-window park timeout on a spilled-to node: go back
+                    # to the local raylet to re-evaluate placement instead of
+                    # re-parking on a node that may no longer be the pick.
+                    client = self.raylet
                 if reply.get("granted"):
                     reply["_lessor"] = client
                     self._hand_lease(key, st, reply)
@@ -1641,6 +1703,14 @@ class Worker:
     # ======================================================================
     # Execution side (RPC handlers)
     # ======================================================================
+    async def _h_busy_info(self):
+        """Liveness+load probe for the raylet's worker-killing policy: a
+        leased worker that is actually executing is a better OOM victim
+        than one idling in the lease pool (reference:
+        `worker_killing_policy.h:34` picks among workers with assigned
+        tasks)."""
+        return {"executing": len(self._executing_tids)}
+
     async def _h_ping(self):
         return "pong"
 

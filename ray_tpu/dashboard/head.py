@@ -209,6 +209,10 @@ def main() -> None:
     parser.add_argument("--gcs-host", required=True)
     parser.add_argument("--gcs-port", type=int, required=True)
     parser.add_argument("--fate-share-pid", type=int, default=0)
+    # Identification only: puts the session dir on the command line so
+    # `pkill -f <session_dir>` cleanup and humans can find the daemon
+    # that belongs to a session.
+    parser.add_argument("--session-dir", default="")
     args = parser.parse_args()
 
     if args.fate_share_pid:
@@ -222,6 +226,26 @@ def main() -> None:
     port = loop.run_until_complete(_serve(head, args.host, args.port))
     print(f"DASHBOARD_PORT={port}", flush=True)
     sys.stdout.flush()
+
+    async def _gcs_watchdog():
+        # The dashboard must never outlive its cluster: without this, a
+        # no-fate-share start (`ray_tpu start --head`) leaks the process
+        # forever once the GCS goes away (observed as a cross-test daemon
+        # leak). Tolerate brief GCS bounces; exit after sustained loss.
+        misses = 0
+        while True:
+            await asyncio.sleep(5.0)
+            try:
+                await head._gcs.acall("get_all_nodes", timeout=5)
+                misses = 0
+            except Exception:
+                misses += 1
+                if misses >= 6:
+                    sys.stderr.write(
+                        "[dashboard] GCS unreachable for ~30s; exiting\n")
+                    os._exit(0)
+
+    loop.create_task(_gcs_watchdog())
     loop.run_forever()
 
 

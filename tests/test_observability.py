@@ -79,10 +79,14 @@ def test_memory_monitor_units(tmp_path):
     assert memory_monitor.usage_fraction(str(usage)) == pytest.approx(0.42)
 
     class H:
+        _n = 0
+
         def __init__(self, actor, ts):
             self.lease = {}
             self.is_actor = actor
             self.lease_ts = ts
+            H._n += 1
+            self.worker_id = b"w%d" % H._n
 
     task_old, task_new, actor = H(False, 1.0), H(False, 2.0), H(True, 3.0)
     # Task workers beat actors even when the actor lease is newer.
@@ -91,6 +95,14 @@ def test_memory_monitor_units(tmp_path):
     idle = H(False, 0.0)
     idle.lease = None
     assert memory_monitor.pick_victim([idle]) is None
+    # A busy (executing) task worker beats an idle-leased newer one:
+    # killing a pool-idle worker frees no task memory.
+    busy = {task_old.worker_id}
+    assert memory_monitor.pick_victim(
+        [task_old, task_new], busy_ids=busy) is task_old
+    # ...but actors stay last-resort even when busy.
+    assert memory_monitor.pick_victim(
+        [task_old, actor], busy_ids={actor.worker_id}) is task_old
 
 
 def test_actor_churn_does_not_wedge_cluster(tmp_path):
