@@ -57,15 +57,38 @@ struct Store {
   // One background sweep that commits every arena page at open.
   std::thread toucher;
   std::atomic<bool> closing{false};
+  // Highest byte ever allocated; the toucher pre-commits a bounded
+  // window ahead of it instead of the whole declared capacity, so a
+  // mostly-empty store does not become RAM-resident up front (full
+  // residency can OOM memory-tight hosts that lazy faulting spared).
+  std::atomic<uint64_t> watermark{0};
 
   void toucher_main() {
+    // RTPU_ARENA_PRECOMMIT: "ahead" (default) commits up to 256MB past
+    // the allocation watermark; "full" commits the whole capacity up
+    // front (dedicated hosts where the budget is truly reserved);
+    // "off" leaves every fault to first touch.
+    const char* mode_env = ::getenv("RTPU_ARENA_PRECOMMIT");
+    std::string mode = mode_env ? mode_env : "ahead";
+    if (mode == "off") return;
+    const uint64_t headroom = 256ull << 20;
     uint64_t pos = 0;
     while (pos < capacity && !closing.load(std::memory_order_relaxed)) {
+      uint64_t target =
+          mode == "full"
+              ? capacity
+              : std::min<uint64_t>(
+                    capacity,
+                    watermark.load(std::memory_order_relaxed) + headroom);
+      if (pos >= target) {
+        ::usleep(10000);
+        continue;
+      }
       // MADV_POPULATE_WRITE faults pages in WITHOUT modifying content,
       // so racing a client's concurrent write into a just-allocated
       // extent is safe by construction (a plain zero-write would not
       // be). On kernels without it, clients simply pay the faults.
-      uint64_t chunk = std::min<uint64_t>(8ull << 20, capacity - pos);
+      uint64_t chunk = std::min<uint64_t>(8ull << 20, target - pos);
 #ifdef MADV_POPULATE_WRITE
       if (::madvise(base + pos, chunk, MADV_POPULATE_WRITE) != 0) break;
 #else
@@ -93,6 +116,11 @@ struct Store {
         free_list.erase(it);
         if (extent > want) free_list.emplace(off + want, extent - want);
         used += want;
+        uint64_t end = off + want;
+        uint64_t seen = watermark.load(std::memory_order_relaxed);
+        while (end > seen &&
+               !watermark.compare_exchange_weak(seen, end)) {
+        }
         return off;
       }
     }

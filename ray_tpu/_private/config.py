@@ -90,6 +90,10 @@ _define("raylet_report_resources_period_ms", int, 100,
 _define("health_check_failure_threshold", int, 5,
         "Consecutive missed health checks before a node is marked dead.")
 _define("task_max_retries_default", int, 3, "")
+_define("borrow_pending_ttl_s", float, 600.0,
+        "How long a serialized-out ref stays pinned waiting for its "
+        "recipient to register as a borrower. The backstop that turns "
+        "lost-message races into a bounded delay instead of a leak.")
 _define("actor_max_restarts_default", int, 0, "")
 
 # --- rpc / transport ---

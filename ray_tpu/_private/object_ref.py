@@ -3,12 +3,14 @@
 Reference-counted by the owning worker: creating and destroying Python
 ObjectRef instances adjusts the owner's local refcount (reference:
 `src/ray/core_worker/reference_count.h:61`). Serializing a ref into a task
-argument or another object marks it *shared*, which pins it until job end in
-this round (the full borrower protocol is future work; leak-safe by design).
+argument or another object enters the borrower protocol (see
+`reference_count.py`): the recipient registers with the owner and the
+object is freed once every borrower drains.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Tuple
 
 from ray_tpu._private.ids import ObjectID
@@ -103,18 +105,64 @@ class ObjectRef:
         return fut
 
 
+# Thread-local capture of refs crossing a serialize/deserialize boundary,
+# feeding the borrower protocol (reference: borrowed-ref bookkeeping in
+# `reference_count.cc`). The serializer/worker installs a list before the
+# (de)pickling pass and collects it after.
+_capture = threading.local()
+
+
+def begin_serialize_capture() -> None:
+    _capture.out = []
+
+
+def end_serialize_capture():
+    out = getattr(_capture, "out", None)
+    _capture.out = None
+    return out or []
+
+
+def begin_deserialize_capture() -> None:
+    _capture.inb = []
+
+
+def end_deserialize_capture():
+    inb = getattr(_capture, "inb", None)
+    _capture.inb = None
+    return inb or []
+
+
 def reduce_object_ref(ref: ObjectRef):
-    """Pickle reducer: mark shared with the owner, rehydrate on load."""
+    """Pickle reducer: pin with a pending share (a recipient will claim
+    it by registering as a borrower, or the TTL sweep expires it), and
+    rehydrate on load."""
     from ray_tpu._private import worker as worker_mod
 
     w = worker_mod.global_worker_or_none()
     if w is not None:
-        w.reference_counter.mark_shared(ref.binary())
+        w.reference_counter.add_pending_share(ref.binary())
+    out = getattr(_capture, "out", None)
+    if out is not None:
+        out.append((ref.binary(), ref.owner_addr))
     return _rehydrate_ref, (ref.binary(), ref.owner_addr, ref.owner_worker_id)
 
 
 def _rehydrate_ref(object_id, owner_addr, owner_worker_id):
-    return ObjectRef(object_id, owner_addr, owner_worker_id)
+    ref = ObjectRef(object_id, owner_addr, owner_worker_id)
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker_or_none()
+    if w is not None and owner_addr is not None:
+        if tuple(owner_addr) != w.addr:
+            w.reference_counter.add_borrowed(object_id, tuple(owner_addr))
+            inb = getattr(_capture, "inb", None)
+            if inb is not None:
+                inb.append((object_id, tuple(owner_addr)))
+        # else: the bytes came home to the owner. Do NOT consume a pending
+        # share here — shares are fungible per object, and the one we'd
+        # pop could be the only pin covering a different still-in-flight
+        # copy; the TTL sweep retires it instead.
+    return ref
 
 
 class ObjectRefGenerator:

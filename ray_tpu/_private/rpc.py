@@ -50,6 +50,32 @@ class RpcError(Exception):
     """Remote handler raised; carries the remote traceback string."""
 
 
+# Methods the sync transport may safely RE-SEND after a connection drop
+# mid-call: the server might have executed the first attempt with only the
+# reply lost, so everything here must be a read, a keyed upsert, or a call
+# the server dedups by id. Anything else (e.g. return_worker, refcount
+# releases, id allocators) surfaces ConnectionLost to the caller instead.
+_RETRY_SAFE_PREFIXES = (
+    "get_", "list_", "kv_", "wait_", "cluster_", "available_", "node_",
+    "store_", "metrics_", "contains_", "object_", "runtime_env_",
+)
+_RETRY_SAFE_METHODS = frozenset({
+    "heartbeat", "ping", "client_ping", "poll", "pubsub_seq",
+    "register_node", "register_worker", "register_actor", "register_job",
+    "create_placement_group", "remove_placement_group",
+    "create_object", "seal_object", "pin_object", "unpin_object",
+    "kill_actor", "client_kill_actor", "client_cancel",
+    "client_disconnect", "client_export_function", "client_get_actor",
+    "mark_job_finished", "push_task_events",
+    "add_borrower", "release_borrower",  # server-side key dedup
+})
+
+
+def _retry_safe(method: str) -> bool:
+    return (method in _RETRY_SAFE_METHODS
+            or method.startswith(_RETRY_SAFE_PREFIXES))
+
+
 class ConnectionLost(Exception):
     pass
 
@@ -291,11 +317,18 @@ class _SyncConn:
                     OSError) as first:
                 if isinstance(first, socket.timeout):
                     raise
+                if not _retry_safe(method):
+                    # The server may have executed the request and only the
+                    # reply was lost; re-sending a non-idempotent method
+                    # would double-execute it (e.g. a duplicated
+                    # return_worker offers the same worker handle twice).
+                    # Surface the loss instead and let the caller decide.
+                    raise
                 # Server bounced while this pooled connection sat idle (or
-                # died before replying). Reconnect once and retry — the
-                # sync surface (puts/gets/kv/registry reads) is idempotent,
-                # and a restarted control plane is exactly the case this
-                # retry exists for.
+                # died before replying). Reconnect once and retry — only
+                # for methods on the idempotent allowlist (reads, keyed
+                # upserts with server-side dedup); a restarted control
+                # plane is exactly the case this retry exists for.
                 self.close()
                 self.dead = False
                 self._connect()

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import pickle
 import struct
+import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import cloudpickle
@@ -70,7 +71,18 @@ class SerializationContext:
     def __init__(self):
         # type -> reducer(obj) -> (reconstructor, args)
         self._custom_reducers: dict = {}
+        # Called after each deserialize with [(oid, owner_addr)] of refs
+        # rehydrated from the payload whose owner is another process —
+        # the worker registers these as borrows.
         self._on_deserialize: List[Callable[[Any], None]] = []
+        # Per-thread hand-off of refs embedded in the latest serialize():
+        # serialize runs concurrently on executor/actor/driver threads, so
+        # this must never be shared mutable state.
+        self._tls = threading.local()
+
+    @property
+    def last_contained_refs(self) -> List:
+        return getattr(self._tls, "contained", [])
 
     def register_reducer(self, type_: type, reducer: Callable) -> None:
         self._custom_reducers[type_] = reducer
@@ -86,9 +98,18 @@ class SerializationContext:
 
         import io
 
+        from ray_tpu._private import object_ref as _oref
+
         sink = io.BytesIO()
         pickler = _Pickler(sink, protocol=5, buffer_callback=buffers.append)
-        pickler.dump(value)
+        _oref.begin_serialize_capture()
+        try:
+            pickler.dump(value)
+        finally:
+            # Refs embedded in the value, for the borrower protocol: the
+            # caller decides whether they become object-keyed holders
+            # (stored values) or stay covered by task-dep pins (args).
+            self._tls.contained = _oref.end_serialize_capture()
         views = [b.raw() for b in buffers]
         return SerializedObject(sink.getvalue(), views)
 
@@ -111,7 +132,19 @@ class SerializationContext:
                 view = _KeepaliveView(view, keepalive)
             bufs.append(view)
             off = _aligned(off + size)
-        return pickle.loads(meta, buffers=bufs)
+        from ray_tpu._private import object_ref as _oref
+
+        _oref.begin_deserialize_capture()
+        try:
+            value = pickle.loads(meta, buffers=bufs)
+        finally:
+            borrowed = _oref.end_deserialize_capture()
+        # Register in-bound borrows with their owners BEFORE the value is
+        # usable: for task args the caller still holds the task-dep pin,
+        # so the registration can never race the owner's free.
+        for hook in self._on_deserialize:
+            hook(borrowed)
+        return value
 
 
 class _KeepaliveView:

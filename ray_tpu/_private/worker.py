@@ -265,7 +265,7 @@ class Worker:
         for name in ["push_task", "push_tasks", "create_actor",
                      "push_actor_task", "push_actor_tasks",
                      "get_object_status", "kill_self", "cancel_task", "ping",
-                     "busy_info",
+                     "busy_info", "add_borrower", "release_borrower",
                      "delete_object_notification", "report_generator_item",
                      "recover_object", "wait_object_status"]:
             self.server.register(name, getattr(self, f"_h_{name}"))
@@ -280,7 +280,19 @@ class Worker:
         self.serialization.register_reducer(ActorHandle, reduce_actor_handle)
 
         # object state
-        self.reference_counter = ReferenceCounter(on_free=self._free_object)
+        self.reference_counter = ReferenceCounter(
+            on_free=self._free_object,
+            on_borrow_release=self._send_borrow_release,
+            on_contained_free=self._release_contained)
+        # oids this process has announced itself as borrowing (dedupes the
+        # per-deserialize registration RPC; cleared on release).
+        self._borrow_registered: Set[bytes] = set()
+        self.serialization._on_deserialize.append(self._register_borrows)
+        # _dead must exist before the sweeper's first loop check — the io
+        # loop thread is already running and can win the race against the
+        # rest of __init__.
+        self._dead = False
+        self.io.submit(self._borrow_sweeper())
         self.actor_handles = ActorHandleTracker(self)
         self._objects: Dict[bytes, _PendingObject] = {}
         self._objects_lock = threading.Lock()
@@ -414,6 +426,10 @@ class Worker:
 
     def _store_value(self, oid: bytes, value: Any) -> None:
         sobj = self.serialization.serialize(value)
+        # Refs nested in the stored value stay alive while this object
+        # does (object-keyed borrow; reference: nested refs in
+        # reference_count.cc).
+        self._adopt_contained(oid, self.serialization.last_contained_refs)
         if sobj.total_size <= GlobalConfig.max_direct_call_object_size:
             self._complete_object(oid, inline=sobj.to_bytes())
         elif sobj.total_size <= GlobalConfig.rpc_put_max_bytes:
@@ -682,6 +698,156 @@ class Worker:
             except Exception:
                 pass
 
+    # ---- borrower protocol (reference: reference_count.cc borrowed refs,
+    # WaitForRefRemoved; here: explicit register/release RPCs + TTL'd
+    # pending-share pins + owner-side borrower liveness sweep) ------------
+
+    def _register_borrows(self, borrowed) -> None:
+        """Deserialize hook: we just rehydrated refs owned elsewhere —
+        announce the borrow to each owner before the value is usable."""
+        if not borrowed or self._dead:
+            return
+        for oid, owner_addr in borrowed:
+            if oid in self._borrow_registered:
+                continue
+            # Optimistic dedupe entry (prevents duplicate RPCs from rapid
+            # repeated deserializes); rolled back on failure so the next
+            # deserialize retries the registration.
+            self._borrow_registered.add(oid)
+            try:
+                if threading.current_thread() is getattr(
+                        self.io, "_thread", None):
+                    # On the io loop itself a sync RPC would deadlock;
+                    # fire async — the serializer's pending-share pin (or
+                    # the caller's task-dep pin) covers the gap.
+                    self.io.submit(self._register_borrow_async(
+                        oid, owner_addr))
+                else:
+                    self._client_for(owner_addr).call(
+                        "add_borrower", object_id=oid,
+                        key=self.worker_id.binary(),
+                        addr=list(self.addr), timeout=30)
+            except Exception:
+                # Owner unreachable NOW: drop the dedupe entry so a later
+                # deserialize retries; until then the ref may dangle and
+                # get() surfaces ObjectLostError.
+                self._borrow_registered.discard(oid)
+
+    async def _register_borrow_async(self, oid: bytes, owner_addr) -> None:
+        try:
+            await self._client_for(owner_addr).acall(
+                "add_borrower", object_id=oid,
+                key=self.worker_id.binary(),
+                addr=list(self.addr), timeout=30)
+        except Exception:
+            self._borrow_registered.discard(oid)
+
+    def _send_borrow_release(self, oid: bytes, addr) -> None:
+        """ReferenceCounter callback (borrower side): our last hold on a
+        borrowed ref drained."""
+        self._borrow_registered.discard(oid)
+        if self._dead:
+            return
+
+        async def _go():
+            try:
+                await self._client_for(tuple(addr)).acall(
+                    "release_borrower", object_id=oid,
+                    key=self.worker_id.binary(), timeout=30)
+            except Exception:
+                pass
+
+        try:
+            self.io.submit(_go())
+        except Exception:
+            pass
+
+    def _release_contained(self, outer: bytes, inners) -> None:
+        """ReferenceCounter callback (owner side): a freed object's value
+        embedded other refs — drop the object-keyed holds."""
+        key = b"obj:" + outer
+        for inner, iaddr in inners:
+            if iaddr is None or tuple(iaddr) == self.addr:
+                self.reference_counter.release_borrower(inner, key)
+            elif not self._dead:
+                async def _go(a=tuple(iaddr), i=inner):
+                    try:
+                        await self._client_for(a).acall(
+                            "release_borrower", object_id=i, key=key,
+                            timeout=30)
+                    except Exception:
+                        pass
+
+                try:
+                    self.io.submit(_go())
+                except Exception:
+                    pass
+
+    def _adopt_contained(self, outer: bytes, inners) -> None:
+        """We own `outer`, whose sealed value embeds `inners`: hold an
+        object-keyed borrow on each until `outer` is freed."""
+        if not inners:
+            return
+        key = b"obj:" + outer
+        recorded = []
+        for inner, iaddr in inners:
+            iaddr = tuple(iaddr) if iaddr else None
+            if iaddr is None or iaddr == self.addr:
+                self.reference_counter.register_borrower(inner, key, None)
+                recorded.append((inner, None))
+            else:
+                client = self._client_for(iaddr)
+                try:
+                    # Carry OUR address so the inner owner's liveness
+                    # sweep can reap the object-keyed hold if this
+                    # process dies before freeing `outer`.
+                    self.io.submit(client.acall(
+                        "add_borrower", object_id=inner, key=key,
+                        addr=list(self.addr), timeout=30))
+                except Exception:
+                    pass
+                recorded.append((inner, iaddr))
+        self.reference_counter.set_contained(outer, recorded)
+
+    async def _h_add_borrower(self, object_id, key, addr=None):
+        return {"ok": self.reference_counter.register_borrower(
+            object_id, key, tuple(addr) if addr else None)}
+
+    async def _h_release_borrower(self, object_id, key):
+        self.reference_counter.release_borrower(object_id, key)
+        return True
+
+    async def _borrow_sweeper(self):
+        """Owner-side hygiene: expire unclaimed pending-share pins and
+        reap borrowers whose process died without releasing."""
+        fails: Dict[Tuple[str, int], int] = {}
+        while not self._dead:
+            ttl = GlobalConfig.borrow_pending_ttl_s
+            await asyncio.sleep(min(30.0, max(0.5, ttl / 4)))
+            if self._dead:
+                return
+            try:
+                self.reference_counter.expire_pending(ttl)
+                for addr, entries in list(
+                        self.reference_counter.borrower_addrs().items()):
+                    if addr == self.addr:
+                        continue
+                    try:
+                        await asyncio.wait_for(
+                            self._client_for(addr).acall("ping", timeout=5),
+                            5)
+                        fails.pop(addr, None)
+                    except Exception:
+                        n = fails.get(addr, 0) + 1
+                        fails[addr] = n
+                        if n >= 3:
+                            fails.pop(addr, None)
+                            for oid, bkey in entries:
+                                self.reference_counter.release_borrower(
+                                    oid, bkey)
+            except Exception:
+                pass
+
     async def _delete_flusher(self):
         while not self._dead:
             await asyncio.sleep(0.05)
@@ -916,6 +1082,10 @@ class Worker:
             await self._run_normal_task_inner(spec, attempt)
         except Exception as e:  # noqa: BLE001 — submission machinery crashed
             self._fail_task(spec, serialize_error(e))
+            # Every failure path must drop the task's pinned dependency
+            # refs or repeated failures (e.g. runtime_env setup errors)
+            # pin objects in the store forever.
+            self._release_deps(spec)
 
     async def _run_normal_task_inner(self, spec: TaskSpec, attempt: int) -> None:
         dep_error = await self._resolve_deps(spec)
@@ -1366,6 +1536,11 @@ class Worker:
         if spec.num_returns < 0:
             self._accept_generator_results(spec, reply)
             return
+        for outer, inners in (reply.get("contained") or {}).items():
+            # Return values embedding refs: we own the return object, so
+            # we hold the object-keyed borrow on each inner ref until the
+            # return object is freed.
+            self._adopt_contained(outer, inners)
         for oid, kind, payload in reply["results"]:
             if kind == "inline":
                 self._complete_object(oid, inline=payload)
@@ -1536,7 +1711,20 @@ class Worker:
             while b.queue:
                 batch = [b.queue.popleft()
                          for _ in range(min(len(b.queue), max_batch))]
-                addr = await self._actor_addr(actor_id)
+                try:
+                    addr = await self._actor_addr(actor_id)
+                except Exception as e:  # noqa: BLE001 — GCS outage etc.
+                    # The address lookup can raise (ConnectionLost during a
+                    # GCS bounce). The batch is already popped: resolve its
+                    # futures with the error — callers retry through the
+                    # actor-restart machinery — and keep the loop alive so
+                    # later calls don't enqueue onto a dead sender forever.
+                    err = e if isinstance(e, (ConnectionLost, OSError)) \
+                        else ConnectionLost(repr(e))
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(type(err)(str(err)))
+                    continue
                 if addr is None:
                     for _, fut in batch:
                         if not fut.done():
@@ -1879,7 +2067,8 @@ class Worker:
                 results, count = self._store_generator_returns(spec, result)
                 return {"results": results, "generator_count": count,
                         "dur": time.monotonic() - t_start}
-            return {"results": self._store_returns(spec, result),
+            results, contained = self._store_returns(spec, result)
+            return {"results": results, "contained": contained,
                     "dur": time.monotonic() - t_start}
         except Exception as e:  # noqa: BLE001 — application error
             return {"results": [], "app_error": serialize_error(e),
@@ -1903,15 +2092,24 @@ class Worker:
                     f"task {spec.name} declared num_returns={num_returns} but "
                     f"returned {len(values)} values")
         out = []
+        contained = {}
         for rid, value in zip(spec.return_ids(), values):
             oid = rid.binary()
             sobj = self.serialization.serialize(value)
+            if self.serialization.last_contained_refs:
+                # Refs nested in a return value: the return object's owner
+                # is the CALLER, so report them in the reply — the caller
+                # registers the object-keyed borrows with the inner owners
+                # while our serialize-side pending pin still covers them.
+                contained[oid] = [
+                    (i, list(a) if a else None)
+                    for i, a in self.serialization.last_contained_refs]
             if sobj.total_size <= GlobalConfig.max_direct_call_object_size:
                 out.append((oid, "inline", sobj.to_bytes()))
             else:
                 self._plasma_put(oid, sobj)
                 out.append((oid, "plasma", self.node_id))
-        return out
+        return out, contained
 
     def _store_generator_returns(self, spec: TaskSpec, result: Any):
         """Execution side of num_returns="dynamic"/"streaming": store each
@@ -1927,11 +2125,15 @@ class Worker:
         for value in result:
             oid = spec.generator_item_id(count).binary()
             sobj = self.serialization.serialize(value)
+            # Refs nested in a yielded item ride along so the owner can
+            # adopt object-keyed borrows (same contract as _store_returns).
+            contained = [(i, list(a) if a else None)
+                         for i, a in self.serialization.last_contained_refs]
             if sobj.total_size <= GlobalConfig.max_direct_call_object_size:
-                entry = (oid, "inline", sobj.to_bytes())
+                entry = (oid, "inline", sobj.to_bytes(), contained)
             else:
                 self._plasma_put(oid, sobj)
-                entry = (oid, "plasma", self.node_id)
+                entry = (oid, "plasma", self.node_id, contained)
             items.append(entry)
             if streaming:
                 if owner is not None:
@@ -1951,7 +2153,8 @@ class Worker:
 
     # ---- generator plane (owner side) -------------------------------------
     def _on_generator_item(self, task_id: bytes, index: int, item) -> None:
-        oid, kind, payload = item
+        oid, kind, payload = item[0], item[1], item[2]
+        contained = item[3] if len(item) > 3 else None
         entry = self._entry(oid)
         if not entry.event.is_set():
             if (not self.reference_counter.has_ref(oid)
@@ -1962,6 +2165,11 @@ class Worker:
                 self.reference_counter.add_owned(oid)
                 if task_id in self._lineage_live:
                     self._lineage_live[task_id] += 1
+            if contained:
+                # We own the item object: hold its nested refs until it
+                # is freed (first arrival only — re-deliveries would
+                # only duplicate the already-held borrows).
+                self._adopt_contained(oid, contained)
             if kind == "inline":
                 self._complete_object(oid, inline=payload)
             else:
@@ -2176,9 +2384,9 @@ class Worker:
                     self._task_executor, self._store_generator_returns,
                     spec, result)
                 return {"results": results, "generator_count": count}
-            results = await loop.run_in_executor(
+            results, contained = await loop.run_in_executor(
                 self._task_executor, self._store_returns, spec, result)
-            return {"results": results}
+            return {"results": results, "contained": contained}
         except Exception as e:  # noqa: BLE001
             return {"results": [], "app_error": serialize_error(e)}
 
@@ -2202,6 +2410,15 @@ class Worker:
         return asyncio.to_thread(self.get_objects, refs, None)
 
     def shutdown(self):
+        # Tell owners we no longer hold any borrowed refs (best effort —
+        # their liveness sweep reaps us anyway if this is lost).
+        for oid, addr in self.reference_counter.drain_borrows():
+            try:
+                self._client_for(tuple(addr)).call(
+                    "release_borrower", object_id=oid,
+                    key=self.worker_id.binary(), timeout=2)
+            except Exception:
+                pass
         # Final task-event + user-metric flush before the GCS connection
         # closes (synchronous: the io loop dies with us).
         try:

@@ -24,7 +24,7 @@ import ray_tpu
 
 @ray_tpu.remote(num_cpus=0.5, max_concurrency=16)
 class ProxyActor:
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         from ray_tpu.serve._private.controller import get_or_create_controller
 
         self._controller = get_or_create_controller()
@@ -36,6 +36,7 @@ class ProxyActor:
 
         self.port = None
         started = threading.Event()
+        self._host = host
         self._loop_thread = threading.Thread(
             target=self._serve_forever, args=(port, started),
             daemon=True, name="serve-proxy")
@@ -170,7 +171,11 @@ class ProxyActor:
         app.router.add_route("*", "/{tail:.*}", handler)
         runner = web.AppRunner(app, access_log=None)
         loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, "0.0.0.0", port)
+        # Loopback by default: the ingress has no authentication, so it is
+        # only exposed on all interfaces when the operator explicitly asks
+        # (serve.start(http_host="0.0.0.0") or proxy_location="EveryNode",
+        # where cross-node traffic is the point).
+        site = web.TCPSite(runner, self._host, port)
         loop.run_until_complete(site.start())
         self.port = site._server.sockets[0].getsockname()[1]
         started.set()

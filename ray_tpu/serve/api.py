@@ -120,12 +120,17 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
 
 # ---------------------------------------------------------------- lifecycle
 
-def start(http_port: int = 0, proxy_location: str = "HeadOnly"):
+def start(http_port: int = 0, proxy_location: str = "HeadOnly",
+          http_host: Optional[str] = None):
     """Start the HTTP ingress (controller starts lazily on first run()).
 
     ``proxy_location="EveryNode"`` pins one proxy actor per alive node
     (reference: ProxyLocation.EveryNode — each node accepts traffic and
     routes to replicas anywhere), returning the head-node proxy.
+
+    The proxy binds loopback by default (it has no authentication);
+    EveryNode implies 0.0.0.0 because cross-node ingress is the point,
+    and ``http_host`` overrides either way.
     """
     from ray_tpu.serve._private.controller import get_or_create_controller
 
@@ -150,15 +155,16 @@ def start(http_port: int = 0, proxy_location: str = "HeadOnly"):
                     name=name, lifetime="detached",
                     scheduling_strategy=NodeAffinitySchedulingStrategy(
                         node_id=node_id, soft=False),
-                ).remote(http_port)
+                ).remote(http_port, http_host or "0.0.0.0")
             if head is None:
                 head = proxy
         return head
     try:
         return ray_tpu.get_actor(_PROXY_NAME)
     except Exception:
-        return ProxyActor.options(name=_PROXY_NAME,
-                                  lifetime="detached").remote(http_port)
+        return ProxyActor.options(
+            name=_PROXY_NAME, lifetime="detached",
+        ).remote(http_port, http_host or "127.0.0.1")
 
 
 def run(app: Application, *, name: str = "default",

@@ -1,0 +1,171 @@
+"""Control-plane scalability envelope (reference harness:
+`release/benchmarks/README.md:5-31`, `python/ray/_private/ray_perf.py`).
+
+Runs an in-process multi-raylet cluster through the envelope BASELINE.md
+targets — many submitted tasks, hundreds of actors, placement groups,
+a large broadcast — and prints a JSON summary + a markdown table for
+SCALE.md. Sized by flags so the same harness runs as a quick smoke or a
+full soak.
+
+Usage:
+    python scripts/scale_bench.py [--raylets 8] [--tasks 10000]
+        [--actors 500] [--pgs 100] [--broadcast-mb 100] [--queued 100000]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # clean worker spawns
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--raylets", type=int, default=8)
+    ap.add_argument("--cpus-per-raylet", type=int, default=2)
+    ap.add_argument("--tasks", type=int, default=10000)
+    ap.add_argument("--actors", type=int, default=500)
+    ap.add_argument("--actor-calls", type=int, default=5000)
+    ap.add_argument("--pgs", type=int, default=100)
+    ap.add_argument("--broadcast-mb", type=int, default=100)
+    ap.add_argument("--queued", type=int, default=100000)
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group,
+    )
+
+    results = {}
+    t_boot = time.monotonic()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": args.cpus_per_raylet,
+                                      "num_tpus": 0})
+    for _ in range(args.raylets - 1):
+        cluster.add_node(num_cpus=args.cpus_per_raylet, num_tpus=0)
+    ray_tpu.init(address=cluster.address)
+    results["boot_s"] = round(time.monotonic() - t_boot, 2)
+    print(f"[scale] {args.raylets} raylets up in {results['boot_s']}s",
+          flush=True)
+
+    # ---- phase 1: task throughput (tiny same-shape tasks) ----------------
+    @ray_tpu.remote
+    def nop(i):
+        return i
+
+    # Warm the worker pools so the phase measures dispatch, not spawns.
+    ray_tpu.get([nop.remote(i) for i in range(args.raylets * 4)],
+                timeout=300)
+    t0 = time.monotonic()
+    refs = [nop.remote(i) for i in range(args.tasks)]
+    out = ray_tpu.get(refs, timeout=1200)
+    dt = time.monotonic() - t0
+    assert len(out) == args.tasks
+    results["tasks"] = args.tasks
+    results["tasks_per_s"] = round(args.tasks / dt, 1)
+    print(f"[scale] {args.tasks} tasks in {dt:.1f}s "
+          f"({results['tasks_per_s']}/s)", flush=True)
+
+    # ---- phase 2: queued depth (submit >> capacity, then drain) ----------
+    if args.queued:
+        t0 = time.monotonic()
+        refs = [nop.remote(i) for i in range(args.queued)]
+        t_submit = time.monotonic() - t0
+        out = ray_tpu.get(refs, timeout=3600)
+        dt = time.monotonic() - t0
+        assert len(out) == args.queued
+        results["queued"] = args.queued
+        results["queued_submit_per_s"] = round(args.queued / t_submit, 1)
+        results["queued_drain_per_s"] = round(args.queued / dt, 1)
+        print(f"[scale] {args.queued} queued: submit "
+              f"{results['queued_submit_per_s']}/s, drain "
+              f"{results['queued_drain_per_s']}/s", flush=True)
+
+    # ---- phase 3: actors ------------------------------------------------
+    @ray_tpu.remote
+    class Echo:
+        def ping(self, x=0):
+            return x
+
+    t0 = time.monotonic()
+    actors = [Echo.remote() for _ in range(args.actors)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=3600)
+    dt = time.monotonic() - t0
+    results["actors"] = args.actors
+    results["actors_ready_s"] = round(dt, 1)
+    results["actors_per_s"] = round(args.actors / dt, 1)
+    print(f"[scale] {args.actors} actors ready in {dt:.1f}s "
+          f"({results['actors_per_s']}/s)", flush=True)
+
+    t0 = time.monotonic()
+    calls = [actors[i % len(actors)].ping.remote(i)
+             for i in range(args.actor_calls)]
+    out = ray_tpu.get(calls, timeout=1200)
+    dt = time.monotonic() - t0
+    assert len(out) == args.actor_calls
+    results["actor_calls"] = args.actor_calls
+    results["actor_calls_per_s"] = round(args.actor_calls / dt, 1)
+    print(f"[scale] {args.actor_calls} actor calls "
+          f"({results['actor_calls_per_s']}/s)", flush=True)
+    for a in actors:
+        ray_tpu.kill(a)
+    del actors
+
+    # ---- phase 4: placement groups --------------------------------------
+    t0 = time.monotonic()
+    pgs = [placement_group([{"CPU": 1}], strategy="PACK")
+           for _ in range(args.pgs)]
+    for pg in pgs:
+        pg.wait(timeout_seconds=600)
+    dt = time.monotonic() - t0
+    results["pgs"] = args.pgs
+    results["pgs_per_s"] = round(args.pgs / dt, 1)
+    print(f"[scale] {args.pgs} PGs ready in {dt:.1f}s "
+          f"({results['pgs_per_s']}/s)", flush=True)
+    for pg in pgs:
+        remove_placement_group(pg)
+
+    # ---- phase 5: broadcast ---------------------------------------------
+    import numpy as np
+
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    mb = args.broadcast_mb
+    blob = ray_tpu.put(np.ones((mb, 1024, 128), dtype=np.float64))  # mb MiB
+
+    @ray_tpu.remote
+    def digest(arr):
+        return float(arr[0, 0, 0]) + arr.shape[0]
+
+    t0 = time.monotonic()
+    node_ids = [n["NodeID"] for n in ray_tpu.nodes() if n.get("Alive")]
+    refs = [digest.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=bytes.fromhex(nid), soft=False)).remote(blob)
+        for nid in node_ids]
+    out = ray_tpu.get(refs, timeout=1200)
+    dt = time.monotonic() - t0
+    assert all(v == 1.0 + mb for v in out)
+    results["broadcast_mb"] = mb
+    results["broadcast_nodes"] = len(node_ids)
+    results["broadcast_s"] = round(dt, 2)
+    results["broadcast_mb_per_s"] = round(mb * len(node_ids) / dt, 1)
+    print(f"[scale] {mb}MiB broadcast to {len(node_ids)} nodes in "
+          f"{dt:.2f}s ({results['broadcast_mb_per_s']} MiB/s aggregate)",
+          flush=True)
+
+    ray_tpu.shutdown()
+    cluster.shutdown()
+    print("SCALE-JSON: " + json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,14 +109,21 @@ class TestReferenceCounter:
         rc.remove_task_dependency(b"x")
         assert freed == [b"x"]
 
-    def test_shared_pins_forever(self):
+    def test_pending_share_pins_until_claimed(self):
+        """Serialize-out pins; a borrower registration claims the pin and
+        holds; releasing the borrower frees (borrower protocol,
+        reference: reference_count.cc)."""
         freed = []
         rc = ReferenceCounter(on_free=lambda oid, locs: freed.append(oid))
         rc.add_owned(b"x")
         rc.add_local_ref(b"x")
-        rc.mark_shared(b"x")
+        rc.add_pending_share(b"x")
         rc.remove_local_ref(b"x")
-        assert not freed
+        assert not freed  # in-flight share pins
+        assert rc.register_borrower(b"x", b"worker-1", ("h", 1))
+        assert not freed  # borrower holds
+        rc.release_borrower(b"x", b"worker-1")
+        assert freed == [b"x"]
 
     def test_locations_passed_to_free(self):
         captured = {}

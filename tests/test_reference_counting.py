@@ -1,0 +1,254 @@
+"""Borrower-protocol tests: unit state machine (style of
+`reference_count_test.cc`) and e2e free-after-borrow behavior that was
+impossible before round 4 (serialized-out refs were pinned forever)."""
+
+import time
+
+import pytest
+
+from ray_tpu._private.reference_count import ReferenceCounter
+
+
+class TestBorrowerStateMachine:
+    def _rc(self):
+        freed = []
+        released = []
+        contained = []
+        rc = ReferenceCounter(
+            on_free=lambda oid, locs: freed.append(oid),
+            on_borrow_release=lambda oid, addr: released.append((oid, addr)),
+            on_contained_free=lambda outer, inners: contained.append(
+                (outer, inners)))
+        return rc, freed, released, contained
+
+    def test_pending_share_expires(self):
+        rc, freed, _, _ = self._rc()
+        rc.add_owned(b"x")
+        rc.add_pending_share(b"x")
+        rc.expire_pending(ttl_s=3600)
+        assert not freed  # young share survives the sweep
+        time.sleep(0.02)
+        rc.expire_pending(ttl_s=0.01)
+        assert freed == [b"x"]  # unclaimed share expired -> freed
+
+    def test_registration_consumes_one_share(self):
+        rc, freed, _, _ = self._rc()
+        rc.add_owned(b"x")
+        rc.add_pending_share(b"x")
+        rc.add_pending_share(b"x")  # two copies in flight
+        assert rc.register_borrower(b"x", b"w1", ("h", 1))
+        assert rc.snapshot(b"x")["pending_shares"] == 1
+        assert rc.register_borrower(b"x", b"w2", ("h", 2))
+        assert rc.snapshot(b"x")["pending_shares"] == 0
+        rc.release_borrower(b"x", b"w1")
+        assert not freed
+        rc.release_borrower(b"x", b"w2")
+        assert freed == [b"x"]
+
+    def test_duplicate_registration_is_noop(self):
+        """RPC retries must not double-consume pending shares."""
+        rc, _, _, _ = self._rc()
+        rc.add_owned(b"x")
+        rc.add_pending_share(b"x")
+        rc.add_pending_share(b"x")
+        assert rc.register_borrower(b"x", b"w1", ("h", 1))
+        assert rc.register_borrower(b"x", b"w1", ("h", 1))
+        assert rc.snapshot(b"x")["pending_shares"] == 1
+
+    def test_late_registration_after_free(self):
+        rc, freed, _, _ = self._rc()
+        rc.add_owned(b"x")
+        rc.add_pending_share(b"x")
+        time.sleep(0.02)
+        rc.expire_pending(ttl_s=0.01)
+        assert freed == [b"x"]
+        assert rc.register_borrower(b"x", b"w1", ("h", 1)) is False
+
+    def test_borrower_side_release_fires_once(self):
+        rc, freed, released, _ = self._rc()
+        rc.add_borrowed(b"x", ("owner", 5))
+        rc.add_local_ref(b"x")
+        rc.add_local_ref(b"x")
+        rc.remove_local_ref(b"x")
+        assert not released
+        rc.remove_local_ref(b"x")
+        assert released == [(b"x", ("owner", 5))]
+        assert not freed  # borrowers never free the object themselves
+        # Entry dropped: a re-borrow recreates it cleanly.
+        rc.add_borrowed(b"x", ("owner", 5))
+        rc.add_local_ref(b"x")
+        rc.remove_local_ref(b"x")
+        assert len(released) == 2
+
+    def test_borrower_pending_share_defers_release(self):
+        """A borrower that serialized the ref onward must not release
+        until its own in-flight share is claimed or expires."""
+        rc, _, released, _ = self._rc()
+        rc.add_borrowed(b"x", ("owner", 5))
+        rc.add_local_ref(b"x")
+        rc.add_pending_share(b"x")  # forwarded to a third worker
+        rc.remove_local_ref(b"x")
+        assert not released
+        time.sleep(0.02)
+        rc.expire_pending(ttl_s=0.01)
+        assert released == [(b"x", ("owner", 5))]
+
+    def test_nested_refs_released_with_outer(self):
+        rc, freed, _, contained_freed = self._rc()
+        rc.add_owned(b"inner")
+        rc.add_owned(b"outer")
+        rc.add_local_ref(b"outer")
+        # inner serialized into outer's value: object-keyed borrow.
+        rc.add_pending_share(b"inner")
+        rc.register_borrower(b"inner", b"obj:outer", None)
+        rc.set_contained(b"outer", [(b"inner", None)])
+        assert not freed
+        rc.remove_local_ref(b"outer")
+        # outer freed -> callback reports its contained refs.
+        assert b"outer" in freed
+        assert contained_freed == [(b"outer", [(b"inner", None)])]
+        # The worker callback releases the object-keyed borrow:
+        rc.release_borrower(b"inner", b"obj:outer")
+        assert b"inner" in freed
+
+    def test_task_dep_and_borrower_combine(self):
+        rc, freed, _, _ = self._rc()
+        rc.add_owned(b"x")
+        rc.add_task_dependency(b"x")
+        rc.add_pending_share(b"x")
+        rc.register_borrower(b"x", b"w1", ("h", 1))
+        rc.remove_task_dependency(b"x")
+        assert not freed
+        rc.release_borrower(b"x", b"w1")
+        assert freed == [b"x"]
+
+
+# --------------------------------------------------------------------- e2e
+
+@pytest.fixture(scope="module")
+def borrow_cluster():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024,
+                        _system_config={"borrow_pending_ttl_s": 3.0},
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=30.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.2)
+    raise AssertionError(f"condition not met within {timeout}s: {msg}")
+
+
+def test_ref_freed_after_actor_borrow_drains(borrow_cluster):
+    """Pass a ref into an actor, drop it everywhere, and the owner frees
+    the store entry (the round-3 design pinned it forever)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, ref):
+            self.ref = ref  # keeps a borrowed ref alive in actor state
+            return "held"
+
+        def peek(self):
+            return float(ray_tpu.get(self.ref[0])[0, 0])
+
+        def drop(self):
+            self.ref = None
+            import gc
+
+            gc.collect()
+            return "dropped"
+
+    w = global_worker()
+    ref = ray_tpu.put(np.full((512, 1024), 3.0))  # 4 MiB -> plasma
+    oid = ref.binary()
+    holder = Holder.remote()
+    # Pass the ref wrapped in a list so it is NOT unwrapped into the raw
+    # value by arg resolution — the actor holds the ObjectRef itself.
+    assert ray_tpu.get(holder.hold.remote([ref]), timeout=60) == "held"
+
+    # The actor registered as a borrower with the owner (us).
+    def borrower_known():
+        snap = w.reference_counter.snapshot(oid)
+        return snap is not None and any(
+            not k.startswith(b"obj:") for k in snap["borrowers"])
+    _wait_for(borrower_known, msg="actor never registered as borrower")
+
+    # Drop the owner's local ref: object must stay alive for the actor.
+    del ref
+    import gc
+
+    gc.collect()
+    time.sleep(4.0)  # > borrow_pending_ttl_s: pending pins expired too
+    assert ray_tpu.get(holder.peek.remote(), timeout=60) == 3.0
+    assert not w.reference_counter.is_freed(oid)
+
+    # Actor drops its copy -> borrow released -> owner frees the entry.
+    assert ray_tpu.get(holder.drop.remote(), timeout=60) == "dropped"
+    _wait_for(lambda: w.reference_counter.is_freed(oid),
+              msg="owner never freed after borrower drained")
+
+
+def test_ref_nested_in_put_freed_with_outer(borrow_cluster):
+    import gc
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    inner = ray_tpu.put(np.ones((256, 1024)))  # 2 MiB
+    inner_oid = inner.binary()
+    outer = ray_tpu.put({"payload": inner})
+    del inner
+    gc.collect()
+    time.sleep(3.5)  # let the TTL sweep expire the serialize-out pin
+    # The outer object's object-keyed borrow keeps inner alive.
+    assert not w.reference_counter.is_freed(inner_oid)
+    got = ray_tpu.get(outer, timeout=60)
+    assert float(ray_tpu.get(got["payload"])[0, 0]) == 1.0
+    del got
+    del outer
+    gc.collect()
+    _wait_for(lambda: w.reference_counter.is_freed(inner_oid),
+              msg="inner never freed after outer dropped")
+
+
+def test_ref_returned_from_task_freed_after_drop(borrow_cluster):
+    """A task that puts an object and returns the ref: ownership stays
+    with the executing worker; the caller's borrow keeps it alive until
+    the caller drops it (nested return refs)."""
+    import gc
+
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def make():
+        return [ray_tpu.put(np.full((256, 1024), 7.0))]
+
+    (inner,) = ray_tpu.get(make.remote(), timeout=60)
+    # The inner object lives on the executing worker; we borrowed it.
+    assert float(ray_tpu.get(inner, timeout=60)[0, 0]) == 7.0
+    del inner
+    gc.collect()
+    # Nothing to assert owner-side (other process); the release RPC path
+    # is covered by not leaking: a second round-trip still works.
+    (inner2,) = ray_tpu.get(make.remote(), timeout=60)
+    assert float(ray_tpu.get(inner2, timeout=60)[0, 0]) == 7.0
