@@ -121,7 +121,9 @@ def init(
             _start_log_listener(gcs_addr, job_id.hex())
         return {"gcs_address": f"{gcs_addr[0]}:{gcs_addr[1]}",
                 "node_id": node_id.hex(), "job_id": job_id.hex(),
-                "session_dir": session_dir}
+                "session_dir": session_dir,
+                "dashboard_url": getattr(_local_node, "dashboard_url", None)
+                if _local_node is not None else None}
 
 
 _log_listener_stop = None

@@ -150,6 +150,7 @@ class Raylet:
         io.submit(self._lease_dispatch_loop())
         io.submit(self._log_monitor_loop())
         io.submit(self._memory_monitor_loop())
+        io.submit(self._reporter_loop())
         return port
 
     def _register_handlers(self):
@@ -164,6 +165,7 @@ class Raylet:
             "object_info", "store_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "kill_worker", "node_stats", "shutdown_node", "get_tasks_info",
+            "profile_worker",
             "get_worker_exit_info", "runtime_env_stats",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
@@ -594,6 +596,102 @@ class Raylet:
             # Let the reaper pick up the death before re-sampling, so one
             # spike doesn't massacre the whole pool.
             await asyncio.sleep(max(period, 1.0))
+
+    async def _reporter_loop(self):
+        """Per-node resource reporter (reference: `dashboard/modules/
+        reporter/reporter_agent.py:277`): node cpu/mem/disk, per-worker
+        RSS, and TPU chip allocation, pushed as gauges through the
+        existing metrics pipeline so they surface on the Prometheus
+        endpoint and the dashboard."""
+        try:
+            import psutil
+        except Exception:
+            return
+        node = self.node_id.hex()[:12]
+        psutil.cpu_percent(interval=None)  # prime the sampler
+
+        def g(name, desc, tag_keys, data):
+            return {"name": name, "type": "gauge", "description": desc,
+                    "tag_keys": tuple(tag_keys), "default_tags": {},
+                    "data": data}
+
+        while not self._dead:
+            await asyncio.sleep(GlobalConfig.metrics_report_interval_s)
+            try:
+                vm = psutil.virtual_memory()
+                try:
+                    disk = psutil.disk_usage(self.session_dir or "/")
+                    disk_data = {f"{node},used": float(disk.used),
+                                 f"{node},total": float(disk.total)}
+                except Exception:
+                    disk_data = {}
+                rss = {}
+                for h in list(self.workers.values()):
+                    try:
+                        rss[f"{node},{h.proc.pid}"] = float(
+                            psutil.Process(h.proc.pid)
+                            .memory_info().rss)
+                    except Exception:
+                        pass
+                records = [
+                    g("node_cpu_percent", "Node CPU utilization.",
+                      ("node",), {node: psutil.cpu_percent(interval=None)}),
+                    g("node_mem_used_bytes", "Node memory in use.",
+                      ("node",), {node: float(vm.used)}),
+                    g("node_mem_total_bytes", "Node memory capacity.",
+                      ("node",), {node: float(vm.total)}),
+                    g("node_disk_bytes",
+                      "Session-dir filesystem usage by kind (used/total).",
+                      ("node", "kind"), disk_data),
+                    g("node_workers", "Live worker processes.",
+                      ("node",), {node: float(len(self.workers))}),
+                    g("node_tpu_chips_free", "Unassigned TPU chips.",
+                      ("node",), {node: float(len(self._free_tpu_chips))}),
+                    # NOT tag key "pid": the gauge renderer appends its
+                    # own pid=<source> label to every gauge and duplicate
+                    # label names break the whole Prometheus scrape.
+                    g("worker_rss_bytes", "Per-worker resident memory.",
+                      ("node", "worker_pid"), rss),
+                ]
+                await self.gcs.acall("push_metrics",
+                                     source=f"reporter:{node}",
+                                     records=records, timeout=10)
+            except Exception:
+                pass
+
+    async def _h_profile_worker(self, worker_id=None, duration_s=5.0,
+                                kind="profile"):
+        """On-demand worker profiling (reference: `profile_manager.py`):
+        forwards to the worker's sampling profiler / stack dumper. With
+        no worker_id, covers every live worker on this node."""
+        from ray_tpu._private.rpc import RpcClient
+
+        targets = ([self.workers[worker_id]] if worker_id in self.workers
+                   else list(self.workers.values()) if worker_id is None
+                   else [])
+
+        async def one(h):
+            try:
+                client = self._worker_probe_clients.get(h.worker_id)
+                if client is None:
+                    client = RpcClient(*h.addr)
+                    self._worker_probe_clients[h.worker_id] = client
+                if kind == "stacks":
+                    reply = await client.acall("stack_dump", timeout=10)
+                else:
+                    reply = await asyncio.wait_for(
+                        client.acall("profile", duration_s=duration_s,
+                                     timeout=duration_s + 30),
+                        duration_s + 30)
+                return h.worker_id.hex(), reply
+            except Exception as e:  # noqa: BLE001
+                return h.worker_id.hex(), {"error": repr(e)}
+
+        # Concurrent: whole-node profiling takes ~duration_s, not
+        # duration_s * n_workers (the dashboard RPC has a fixed budget).
+        pairs = await asyncio.gather(
+            *(one(h) for h in targets if h.addr != ("", 0)))
+        return dict(pairs)
 
     async def _pick_oom_victim(self):
         """Worker-killing policy (reference `worker_killing_policy.h:34`):

@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import os
+import sys
 import threading
 import time
 from collections import defaultdict, deque
@@ -266,6 +267,7 @@ class Worker:
                      "push_actor_task", "push_actor_tasks",
                      "get_object_status", "kill_self", "cancel_task", "ping",
                      "busy_info", "add_borrower", "release_borrower",
+                     "stack_dump", "profile",
                      "delete_object_notification", "report_generator_item",
                      "recover_object", "wait_object_status"]:
             self.server.register(name, getattr(self, f"_h_{name}"))
@@ -1891,6 +1893,52 @@ class Worker:
     # ======================================================================
     # Execution side (RPC handlers)
     # ======================================================================
+    async def _h_stack_dump(self):
+        """All-thread stack traces (reference: the dashboard's py-spy
+        dump route, `profile_manager.py:188` — here via sys._current
+        _frames, no external tool)."""
+        import traceback
+
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in frames.items():
+            stack = "".join(traceback.format_stack(frame))
+            out.append(f"--- thread {names.get(ident, ident)} ---\n{stack}")
+        return {"pid": os.getpid(), "stacks": "\n".join(out)}
+
+    async def _h_profile(self, duration_s=5.0, interval_ms=10.0):
+        """Sampling CPU profile in folded-stack format (flamegraph.pl /
+        speedscope compatible): `frame;frame;frame count` lines.
+        Sampling runs in a helper thread so the event loop stays live."""
+        duration_s = min(float(duration_s), 60.0)
+        interval = max(float(interval_ms), 1.0) / 1000.0
+        counts: Dict[str, int] = {}
+
+        def _sample():
+            deadline = time.monotonic() + duration_s
+            while time.monotonic() < deadline:
+                for ident, frame in sys._current_frames().items():
+                    if ident == threading.get_ident():
+                        continue  # never sample the sampler itself
+                    stack = []
+                    f = frame
+                    while f is not None:
+                        code = f.f_code
+                        stack.append(
+                            f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                            f"{code.co_name}:{f.f_lineno}")
+                        f = f.f_back
+                    key = ";".join(reversed(stack))
+                    counts[key] = counts.get(key, 0) + 1
+                time.sleep(interval)
+
+        await asyncio.get_running_loop().run_in_executor(None, _sample)
+        folded = "\n".join(f"{k} {v}" for k, v in
+                           sorted(counts.items(), key=lambda kv: -kv[1]))
+        return {"pid": os.getpid(), "duration_s": duration_s,
+                "samples": sum(counts.values()), "folded": folded}
+
     async def _h_busy_info(self):
         """Liveness+load probe for the raylet's worker-killing policy: a
         leased worker that is actually executing is a better OOM victim

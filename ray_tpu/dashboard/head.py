@@ -91,6 +91,24 @@ refresh(); setInterval(refresh, 3000);
 class DashboardHead:
     def __init__(self, gcs_host: str, gcs_port: int):
         self._gcs = RpcClient(gcs_host, gcs_port)
+        self._gcs_addr = (gcs_host, gcs_port)
+        self._job_client = None
+        self._job_client_lock = __import__("threading").Lock()
+
+    def _jobs_client(self):
+        """Lazy embedded driver connection: job submission needs actor
+        creation, so the dashboard becomes a (CPU-less) driver on first
+        use (reference: job_head.py forwards to the JobManager's own
+        core worker)."""
+        with self._job_client_lock:
+            if self._job_client is None:
+                import ray_tpu
+                from ray_tpu.job_submission import JobSubmissionClient
+
+                ray_tpu.init(address="%s:%d" % self._gcs_addr,
+                             ignore_reinit_error=True)
+                self._job_client = JobSubmissionClient()
+            return self._job_client
 
     # ------------------------------------------------------------ handlers
     async def index(self, _req) -> web.Response:
@@ -169,6 +187,98 @@ class DashboardHead:
         text = await self._gcs.acall("metrics_text", timeout=10)
         return web.Response(text=text, content_type="text/plain")
 
+    # ---- profiling (reference: dashboard/modules/reporter/
+    # profile_manager.py — on-demand stack dump + sampling CPU profile
+    # per worker, flamegraph-able folded-stack payloads) ----------------
+
+    async def _node_raylet(self, node_prefix):
+        nodes = await self._gcs.acall("get_all_nodes", timeout=10)
+        for n in nodes or []:
+            if n["state"] != "ALIVE":
+                continue
+            if (node_prefix is None
+                    or n["node_id"].hex().startswith(node_prefix)):
+                return RpcClient(*n["addr"])
+        return None
+
+    async def profile(self, req) -> web.Response:
+        client = await self._node_raylet(req.query.get("node"))
+        if client is None:
+            return web.json_response({"error": "no such node"}, status=404)
+        kind = ("stacks" if req.path.endswith("/stacks") else "profile")
+        wid = req.query.get("worker")
+        try:
+            out = await client.acall(
+                "profile_worker",
+                worker_id=bytes.fromhex(wid) if wid else None,
+                duration_s=float(req.query.get("duration", 5.0)),
+                kind=kind, timeout=120)
+        finally:
+            client.close()
+        return web.json_response(out)
+
+    # ---- job submission REST (reference: dashboard/modules/job/job_head
+    # .py — POST/GET/logs endpoints so off-cluster clients submit over
+    # HTTP; SDK/CLI counterpart in job_submission.JobSubmissionClient
+    # with an http:// address) ------------------------------------------
+
+    async def submit_job(self, req) -> web.Response:
+        body = await req.json()
+        entrypoint = body.get("entrypoint")
+        if not entrypoint:
+            return web.json_response(
+                {"error": "entrypoint is required"}, status=400)
+        loop = asyncio.get_running_loop()
+
+        def _go():
+            return self._jobs_client().submit_job(
+                entrypoint=entrypoint,
+                submission_id=body.get("submission_id"),
+                env=body.get("env"),
+                working_dir=body.get("working_dir"))
+
+        try:
+            sid = await loop.run_in_executor(None, _go)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"submission_id": sid})
+
+    async def list_job_submissions(self, _req) -> web.Response:
+        loop = asyncio.get_running_loop()
+        jobs = await loop.run_in_executor(
+            None, lambda: self._jobs_client().list_jobs())
+        return web.json_response(jobs)
+
+    async def job_submission(self, req) -> web.Response:
+        sid = req.match_info["sid"]
+        loop = asyncio.get_running_loop()
+        try:
+            info = await loop.run_in_executor(
+                None, lambda: self._jobs_client().get_job_info(sid))
+        except KeyError:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response(info)
+
+    async def job_submission_logs(self, req) -> web.Response:
+        sid = req.match_info["sid"]
+        loop = asyncio.get_running_loop()
+        try:
+            logs = await loop.run_in_executor(
+                None, lambda: self._jobs_client().get_job_logs(sid))
+        except KeyError:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response({"logs": logs})
+
+    async def stop_job_submission(self, req) -> web.Response:
+        sid = req.match_info["sid"]
+        loop = asyncio.get_running_loop()
+        try:
+            stopped = await loop.run_in_executor(
+                None, lambda: self._jobs_client().stop_job(sid))
+        except KeyError:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response({"stopped": bool(stopped)})
+
     # --------------------------------------------------------------- serve
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -179,6 +289,15 @@ class DashboardHead:
         app.router.add_get("/api/jobs", self.jobs)
         app.router.add_get("/api/tasks", self.tasks)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/api/profile", self.profile)
+        app.router.add_get("/api/profile/stacks", self.profile)
+        app.router.add_post("/api/job_submissions", self.submit_job)
+        app.router.add_get("/api/job_submissions", self.list_job_submissions)
+        app.router.add_get("/api/job_submissions/{sid}", self.job_submission)
+        app.router.add_get("/api/job_submissions/{sid}/logs",
+                           self.job_submission_logs)
+        app.router.add_post("/api/job_submissions/{sid}/stop",
+                            self.stop_job_submission)
         return app
 
 

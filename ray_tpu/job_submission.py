@@ -62,24 +62,56 @@ class JobSupervisor:
                 entrypoint, shell=True, stdout=log,
                 stderr=subprocess.STDOUT, env=child_env,
                 cwd=working_dir or None)
+            put_status(child_pid=proc.pid)  # stop_job kills this
             rc = proc.wait()
+        record = json.loads(
+            w.gcs.call("kv_get", namespace=_KV_NS,
+                       key=submission_id) or b"{}")
+        if record.get("status") == "STOPPED":
+            return rc  # stop_job already wrote the terminal state
         put_status(status="SUCCEEDED" if rc == 0 else "FAILED",
                    returncode=rc, end_time=time.time())
         return rc
 
 
 class JobSubmissionClient:
-    """Submit/inspect jobs against an initialized cluster connection."""
+    """Submit/inspect jobs.
 
-    def __init__(self):
+    Two transports (reference: `job/sdk.py`): with no address, talks to
+    the initialized in-process cluster connection; with an ``http://``
+    address, talks to the dashboard head's job REST API — the off-cluster
+    path (`dashboard/modules/job/job_head.py`).
+    """
+
+    def __init__(self, address: Optional[str] = None):
+        self._http = None
+        if address and address.startswith("http"):
+            self._http = address.rstrip("/")
+            return
         from ray_tpu._private.worker import global_worker
 
         self._worker = global_worker()
+
+    # ---- HTTP transport ---------------------------------------------------
+    def _http_json(self, method: str, path: str, body=None):
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self._http + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())
 
     def submit_job(self, *, entrypoint: str,
                    submission_id: Optional[str] = None,
                    env: Optional[Dict[str, str]] = None,
                    working_dir: Optional[str] = None) -> str:
+        if self._http:
+            return self._http_json("POST", "/api/job_submissions", {
+                "entrypoint": entrypoint, "submission_id": submission_id,
+                "env": env, "working_dir": working_dir,
+            })["submission_id"]
         submission_id = submission_id or f"job_{uuid.uuid4().hex[:10]}"
         gcs_addr = "%s:%d" % self._worker.gcs_addr
         self._worker.gcs.call(
@@ -100,19 +132,56 @@ class JobSubmissionClient:
         return submission_id
 
     def get_job_status(self, submission_id: str) -> str:
-        return self._record(submission_id).get("status", "UNKNOWN")
+        return self.get_job_info(submission_id).get("status", "UNKNOWN")
 
     def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        if self._http:
+            return self._http_json(
+                "GET", f"/api/job_submissions/{submission_id}")
         return self._record(submission_id)
 
     def get_job_logs(self, submission_id: str) -> str:
+        if self._http:
+            return self._http_json(
+                "GET", f"/api/job_submissions/{submission_id}/logs")["logs"]
         path = self._record(submission_id).get("log_path")
         if not path or not os.path.exists(path):
             return ""
         with open(path, "r", errors="replace") as f:
             return f.read()
 
+    def stop_job(self, submission_id: str) -> bool:
+        """Kill the job's entrypoint process and mark it STOPPED."""
+        if self._http:
+            return self._http_json(
+                "POST", f"/api/job_submissions/{submission_id}/stop"
+            ).get("stopped", False)
+        record = self._record(submission_id)
+        if record.get("status") in ("SUCCEEDED", "FAILED", "STOPPED"):
+            return False
+        # Terminal state FIRST: the supervisor checks for STOPPED before
+        # writing its own terminal status, so writing before the kill
+        # closes the race where its FAILED overwrites our STOPPED.
+        record.update(status="STOPPED", end_time=time.time())
+        self._worker.gcs.call(
+            "kv_put", namespace=_KV_NS, key=submission_id,
+            value=json.dumps(record).encode())
+        pid = record.get("child_pid")
+        if pid:
+            try:
+                os.kill(pid, 15)
+            except OSError:
+                pass
+        try:
+            sup = ray_tpu.get_actor(f"_job_supervisor:{submission_id}")
+            ray_tpu.kill(sup)
+        except Exception:
+            pass
+        return True
+
     def list_jobs(self) -> List[Dict[str, Any]]:
+        if self._http:
+            return self._http_json("GET", "/api/job_submissions")
         keys = self._worker.gcs.call("kv_keys", namespace=_KV_NS)
         return [self._record(k if isinstance(k, str) else k.decode())
                 for k in keys]

@@ -232,10 +232,18 @@ def cmd_list(args) -> None:
 
 
 def cmd_job(args) -> None:
-    ray_tpu = _connect(args)
     from ray_tpu.job_submission import JobSubmissionClient
 
-    client = JobSubmissionClient()
+    # http:// address = dashboard job REST API (off-cluster submission,
+    # no driver connection needed); otherwise connect as a driver.
+    addr = getattr(args, "address", None) or os.environ.get(
+        "RAY_TPU_ADDRESS", "")
+    if addr.startswith("http"):
+        ray_tpu = None
+        client = JobSubmissionClient(address=addr)
+    else:
+        ray_tpu = _connect(args)
+        client = JobSubmissionClient()
     if args.job_cmd == "submit":
         parts = list(args.entrypoint)
         if parts and parts[0] == "--":
@@ -254,9 +262,13 @@ def cmd_job(args) -> None:
         print(client.get_job_status(args.submission_id))
     elif args.job_cmd == "logs":
         print(client.get_job_logs(args.submission_id))
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.submission_id)
+              else "not running")
     elif args.job_cmd == "list":
         _print_table(client.list_jobs())
-    ray_tpu.shutdown()
+    if ray_tpu is not None:
+        ray_tpu.shutdown()
 
 
 def cmd_metrics(args) -> None:
@@ -372,7 +384,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     ps.add_argument("--timeout", type=float, default=600.0)
     ps.add_argument("entrypoint", nargs=argparse.REMAINDER,
                     help="shell entrypoint (after --)")
-    for name in ("status", "logs"):
+    for name in ("status", "logs", "stop"):
         pj = jsub.add_parser(name)
         pj.add_argument("submission_id")
     jsub.add_parser("list")

@@ -10,10 +10,12 @@ claim TPU chips for accelerated inference (jitted model calls), while the
 control plane stays on CPU.
 """
 
+from ray_tpu.serve import asgi
 from ray_tpu.serve.api import (
     Application, Deployment, delete, deployment, get_app_handle,
-    list_applications, run, shutdown, start, status,
+    list_applications, run, shutdown, start, start_grpc, status,
 )
+from ray_tpu.serve.asgi import ingress
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
@@ -22,10 +24,11 @@ from ray_tpu.serve.schema import (
 )
 
 __all__ = [
-    "Application", "Deployment", "DeploymentHandle", "batch", "delete",
-    "deploy_config", "deploy_config_file", "deployment", "get_app_handle",
-    "get_multiplexed_model_id", "import_application", "list_applications",
-    "multiplexed", "run", "shutdown", "start", "status",
+    "Application", "Deployment", "DeploymentHandle", "asgi", "batch",
+    "delete", "deploy_config", "deploy_config_file", "deployment",
+    "get_app_handle", "get_multiplexed_model_id", "import_application",
+    "ingress", "list_applications", "multiplexed", "run", "shutdown",
+    "start", "start_grpc", "status",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

@@ -280,6 +280,7 @@ class ServeController:
                             "route_prefix": spec.get("route_prefix")
                             or f"/{app}",
                             "stream": bool(spec.get("stream")),
+                            "asgi": bool(spec.get("asgi")),
                         }
             return self._version, routes
 

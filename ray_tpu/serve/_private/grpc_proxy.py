@@ -1,0 +1,178 @@
+"""gRPC ingress — deployed applications over gRPC, same routing plane as
+the HTTP proxy.
+
+Reference: the gRPC proxy in `serve/_private/proxy.py` +
+`serve/_private/grpc_util.py` (gRPCGenericServer). Re-designed without
+compiled protos: a generic bytes-in/bytes-out service
+
+    /ray_tpu.serve.ServeAPI/Predict        (unary-unary)
+    /ray_tpu.serve.ServeAPI/PredictStream  (unary-stream)
+
+where the target application, method and multiplexed model id travel in
+invocation metadata (``application``, ``method``,
+``multiplexed_model_id``) — exactly how the reference's gRPC ingress
+selects apps. Payload bytes that parse as JSON become Python values;
+replies that are bytes pass through raw, strings utf-8, anything else
+JSON. Routing state (long-polled route table, per-app handles) mirrors
+the HTTP proxy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict
+
+import ray_tpu
+from ray_tpu.serve._private.route_plane import RoutePlane
+
+SERVICE = "ray_tpu.serve.ServeAPI"
+PREDICT = f"/{SERVICE}/Predict"
+PREDICT_STREAM = f"/{SERVICE}/PredictStream"
+
+
+def _encode(value: Any) -> bytes:
+    if isinstance(value, bytes):
+        return value
+    if isinstance(value, str):
+        return value.encode()
+    return json.dumps(value).encode()
+
+
+def _decode(raw: bytes) -> Any:
+    if not raw:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return raw
+
+
+@ray_tpu.remote(num_cpus=0.5, max_concurrency=16)
+class GrpcProxyActor(RoutePlane):
+    """Per-cluster gRPC ingress actor (HeadOnly placement by default).
+    Routing state comes from the shared RoutePlane mixin — one route
+    table implementation for both ingress flavors."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        from ray_tpu.serve._private.controller import get_or_create_controller
+
+        self.port = None
+        self._pre_init_route_plane()
+        started = threading.Event()
+        self._loop_thread = threading.Thread(
+            target=self._serve_forever, args=(host, port, started),
+            daemon=True, name="serve-grpc-proxy")
+        self._loop_thread.start()
+        started.wait(timeout=30)
+        self._init_route_plane(get_or_create_controller())
+
+    # ---- grpc server ------------------------------------------------------
+    def _serve_forever(self, host: str, port: int,
+                       started: threading.Event):
+        import grpc
+        import grpc.aio
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        outer = self
+
+        def _meta(context) -> Dict[str, str]:
+            return {k: v for k, v in (context.invocation_metadata() or ())}
+
+        async def _handle_or_abort(app: str, context):
+            # The route table is push-invalidated; tolerate only the
+            # short deploy-to-first-poll race (bounded), then NOT_FOUND.
+            for _ in range(15):
+                try:
+                    return outer._handle_for(app)
+                except KeyError:
+                    await asyncio.sleep(0.1)
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"no application '{app}'")
+
+        async def predict(request: bytes, context) -> bytes:
+            md = _meta(context)
+            app = md.get("application", "default")
+            method = md.get("method", "__call__")
+            handle = await _handle_or_abort(app, context)
+            if md.get("multiplexed_model_id"):
+                handle = handle.options(
+                    multiplexed_model_id=md["multiplexed_model_id"])
+            payload = _decode(request)
+            args = (payload,) if payload is not None else ()
+            caller = getattr(handle, method) if method != "__call__" \
+                else handle
+            try:
+                reply = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: caller.remote(*args).result(timeout=120))
+            except Exception as e:  # noqa: BLE001 — surfaced as grpc error
+                await context.abort(grpc.StatusCode.INTERNAL,
+                                    f"{type(e).__name__}: {e}")
+            return _encode(reply)
+
+        async def predict_stream(request: bytes, context):
+            md = _meta(context)
+            app = md.get("application", "default")
+            method = md.get("method", "__call__")
+            handle = await _handle_or_abort(app, context)
+            if md.get("multiplexed_model_id"):
+                handle = handle.options(
+                    multiplexed_model_id=md["multiplexed_model_id"])
+            payload = _decode(request)
+            args = (payload,) if payload is not None else ()
+            shandle = handle.options(stream=True)
+            caller = getattr(shandle, method) if method != "__call__" \
+                else shandle
+            loop = asyncio.get_running_loop()
+            gen = await loop.run_in_executor(
+                None, lambda: caller.remote(*args))
+            it = iter(gen)
+            _stop = object()
+
+            def _next():
+                try:
+                    return next(it)
+                except StopIteration:
+                    return _stop
+
+            while True:
+                item = await loop.run_in_executor(None, _next)
+                if item is _stop:
+                    break
+                yield _encode(item)
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                if call_details.method == PREDICT:
+                    return grpc.unary_unary_rpc_method_handler(predict)
+                if call_details.method == PREDICT_STREAM:
+                    return grpc.unary_stream_rpc_method_handler(
+                        predict_stream)
+                return None
+
+        async def _main():
+            server = grpc.aio.server()
+            server.add_generic_rpc_handlers((Handler(),))
+            bound = server.add_insecure_port(f"{host}:{port}")
+            await server.start()
+            self.port = bound
+            started.set()
+            await server.wait_for_termination()
+
+        loop.run_until_complete(_main())
+
+    # ---- actor api --------------------------------------------------------
+    def get_port(self) -> int:
+        # The server thread publishes the port asynchronously; never hand
+        # out None to a client that called right after creation.
+        import time as _time
+
+        deadline = _time.monotonic() + 20
+        while self.port is None and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        return self.port
+
+    def healthz(self) -> bool:
+        return True

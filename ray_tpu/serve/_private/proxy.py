@@ -20,20 +20,16 @@ import threading
 from typing import Any, Dict
 
 import ray_tpu
+from ray_tpu.serve._private.route_plane import RoutePlane
 
 
 @ray_tpu.remote(num_cpus=0.5, max_concurrency=16)
-class ProxyActor:
+class ProxyActor(RoutePlane):
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         from ray_tpu.serve._private.controller import get_or_create_controller
 
-        self._controller = get_or_create_controller()
-        self._handles: Dict[str, Any] = {}
-        self._routes: Dict[str, Dict[str, Any]] = {}
-        self._routes_version = -1
-        self._routes_ready = threading.Event()
         self._requests_served = 0
-
+        self._pre_init_route_plane()
         self.port = None
         started = threading.Event()
         self._host = host
@@ -42,48 +38,8 @@ class ProxyActor:
             daemon=True, name="serve-proxy")
         self._loop_thread.start()
         started.wait(timeout=30)
-        threading.Thread(target=self._route_poll_loop, daemon=True,
-                         name="serve-proxy-routes").start()
-        # First snapshot so early requests route.
-        try:
-            version, routes = ray_tpu.get(
-                self._controller.poll_routes.remote(-1, 0.1), timeout=30)
-            self._routes_version, self._routes = version, routes
-        except Exception:
-            pass
-        self._routes_ready.set()
-
-    # ---- route table (push-invalidated) -----------------------------------
-    def _route_poll_loop(self):
-        import time
-
-        while True:
-            try:
-                version, routes = ray_tpu.get(
-                    self._controller.poll_routes.remote(
-                        self._routes_version, 25.0), timeout=60)
-                self._routes_version = version
-                self._routes = routes
-                stale = set(self._handles) - set(routes)
-                for app in stale:
-                    self._handles.pop(app, None)
-            except Exception:
-                time.sleep(1.0)
-
-    def _handle_for(self, app: str):
-        from ray_tpu.serve.handle import DeploymentHandle
-
-        route = self._routes.get(app)
-        if route is None:
-            raise KeyError(f"no application '{app}'")
-        cached = self._handles.get(app)
-        if cached is not None and cached[0] == route["deployment"]:
-            return cached[1]
-        # First request, or the ingress deployment was renamed by a
-        # redeploy — a stale handle would route to the retired name.
-        handle = DeploymentHandle(app, route["deployment"])
-        self._handles[app] = (route["deployment"], handle)
-        return handle
+        # Shared push-invalidated route table (route_plane.py).
+        self._init_route_plane(get_or_create_controller())
 
     # ---- http -------------------------------------------------------------
     def _serve_forever(self, port: int, started: threading.Event):
@@ -119,6 +75,35 @@ class ProxyActor:
                 handle = self._handle_for(app)
             except KeyError as e:
                 return web.json_response({"error": str(e)}, status=404)
+            if route.get("asgi"):
+                # ASGI ingress: forward the raw request; the replica
+                # drives the app and returns status/headers/body
+                # (reference: proxy -> ASGIAppReplicaWrapper).
+                prefix = (route.get("route_prefix") or f"/{app}").rstrip("/")
+                sub = request.path
+                if sub.startswith(prefix):
+                    sub = sub[len(prefix):] or "/"
+                asgi_req = {
+                    "method": request.method,
+                    "path": sub,
+                    "query_string": request.query_string,
+                    "headers": dict(request.headers),
+                    "body": raw,
+                }
+                try:
+                    rep = await asyncio.get_running_loop().run_in_executor(
+                        None, lambda: handle.remote(asgi_req)
+                        .result(timeout=120))
+                except Exception as e:  # noqa: BLE001
+                    return web.json_response(
+                        {"error": f"{type(e).__name__}: {e}"}, status=500)
+                return web.Response(
+                    body=rep.get("body", b""),
+                    status=rep.get("status", 200),
+                    headers={k: v for k, v in
+                             (rep.get("headers") or {}).items()
+                             if k.lower() not in ("content-length",
+                                                  "transfer-encoding")})
             args = (payload,) if payload is not None else ()
             if route.get("stream"):
                 return await self._stream_response(request, handle, args)
@@ -183,6 +168,13 @@ class ProxyActor:
 
     # ---- actor api --------------------------------------------------------
     def get_port(self) -> int:
+        # The aiohttp thread publishes the port asynchronously; never
+        # hand out None to a client that called right after creation.
+        import time as _time
+
+        deadline = _time.monotonic() + 20
+        while self.port is None and _time.monotonic() < deadline:
+            _time.sleep(0.05)
         return self.port
 
     def healthz(self) -> bool:

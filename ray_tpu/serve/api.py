@@ -96,6 +96,10 @@ class Application:
                 "max_ongoing_requests": d.max_ongoing_requests,
                 "autoscaling_config": autoscaling,
                 "stream": d.stream,
+                # @serve.ingress(app)-wrapped classes: the proxy forwards
+                # the raw HTTP request and writes back status/headers/body.
+                "asgi": bool(getattr(d.func_or_class, "_serve_is_asgi",
+                                     False)),
             })
         return DeploymentHandle(app_name, d.name)
 
@@ -165,6 +169,26 @@ def start(http_port: int = 0, proxy_location: str = "HeadOnly",
         return ProxyActor.options(
             name=_PROXY_NAME, lifetime="detached",
         ).remote(http_port, http_host or "127.0.0.1")
+
+
+_GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
+
+
+def start_grpc(grpc_port: int = 0, host: str = "127.0.0.1"):
+    """Start the gRPC ingress (reference: grpc_options on serve.start →
+    the gRPC proxy in `_private/proxy.py`). Shares the HTTP proxy's
+    routing plane; see `serve/_private/grpc_proxy.py` for the wire
+    contract."""
+    from ray_tpu.serve._private.controller import get_or_create_controller
+    from ray_tpu.serve._private.grpc_proxy import GrpcProxyActor
+
+    get_or_create_controller()
+    try:
+        return ray_tpu.get_actor(_GRPC_PROXY_NAME)
+    except Exception:
+        return GrpcProxyActor.options(
+            name=_GRPC_PROXY_NAME, lifetime="detached",
+        ).remote(grpc_port, host)
 
 
 def run(app: Application, *, name: str = "default",

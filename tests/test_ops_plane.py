@@ -9,6 +9,10 @@ import sys
 import time
 import urllib.request
 
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 import pytest
 
 import ray_tpu
@@ -189,3 +193,49 @@ def test_dataset_to_pandas(ray_start_regular):
     assert isinstance(df, pd.DataFrame)
     assert df["sq"].tolist() == [0, 1, 4, 9, 16]
     assert rdata.from_items([]).to_pandas().empty
+
+
+def test_job_rest_api_over_http(tmp_path):
+    """Off-cluster job submission through the dashboard head's REST API
+    (reference: dashboard/modules/job/job_head.py): submit over HTTP,
+    poll status, fetch logs, list, stop. Runs in a subprocess driver so
+    it owns its cluster regardless of module fixtures."""
+    import subprocess
+
+    script = tmp_path / "restjob_driver.py"
+    job = tmp_path / "restjob.py"
+    job.write_text("print('REST-JOB-RAN')\n")
+    slow = tmp_path / "slowjob.py"
+    slow.write_text("import time; time.sleep(600)\n")
+    script.write_text(f"""
+import sys, time
+import ray_tpu
+from ray_tpu.job_submission import JobSubmissionClient
+
+info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                    object_store_memory=128 * 1024 * 1024,
+                    include_dashboard=True)
+url = info["dashboard_url"]
+assert url, "no dashboard"
+client = JobSubmissionClient(address=url)
+sid = client.submit_job(entrypoint=sys.executable + " {job}")
+status = client.wait_until_finished(sid, timeout=180)
+assert status == "SUCCEEDED", client.get_job_logs(sid)
+assert "REST-JOB-RAN" in client.get_job_logs(sid)
+assert any(j.get("submission_id") == sid for j in client.list_jobs())
+sid2 = client.submit_job(entrypoint=sys.executable + " {slow}")
+deadline = time.monotonic() + 120
+while (client.get_job_status(sid2) == "PENDING"
+       and time.monotonic() < deadline):
+    time.sleep(0.3)
+assert client.stop_job(sid2)
+assert client.wait_until_finished(sid2, timeout=60) == "STOPPED"
+ray_tpu.shutdown()
+print("REST-API-OK")
+""")
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                          "PYTHONPATH": _repo_root()})
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "REST-API-OK" in proc.stdout

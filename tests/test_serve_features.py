@@ -368,3 +368,102 @@ def test_router_push_invalidation_latency(serve_cluster):
     assert waited < 3.0, f"update took {waited:.1f}s — looks like polling"
     assert handle.remote().result(timeout=60) == "v2"
     serve.delete("bumpapp")
+
+
+def test_grpc_ingress_unary_and_stream(serve_cluster):
+    """gRPC ingress on the shared routing plane: unary predict with
+    method + model selection via metadata, and a streamed response
+    (reference: serve gRPC proxy + grpc_util)."""
+    import json
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.grpc_util import ServeGrpcClient
+
+    @serve.deployment(name="GrpcEcho")
+    class GrpcEcho:
+        def __call__(self, payload=None):
+            return {"echo": payload}
+
+        def double(self, payload=0):
+            return 2 * payload
+
+    serve.run(GrpcEcho.bind(), name="grpcapp")
+    proxy = serve.start_grpc()
+    port = ray_tpu.get(proxy.get_port.remote(), timeout=60)
+    client = ServeGrpcClient(f"127.0.0.1:{port}")
+    try:
+        out = json.loads(client.predict({"x": 1}, application="grpcapp"))
+        assert out == {"echo": {"x": 1}}
+        out = json.loads(client.predict(21, application="grpcapp",
+                                        method="double"))
+        assert out == 42
+
+        @serve.deployment(stream=True, name="GrpcChunks")
+        class GrpcChunks:
+            def __call__(self, payload=None):
+                for i in range(int(payload or 3)):
+                    yield f"g{i}"
+
+        serve.run(GrpcChunks.bind(), name="grpcstream")
+        chunks = [c.decode() for c in client.predict_stream(
+            3, application="grpcstream")]
+        assert chunks == ["g0", "g1", "g2"]
+    finally:
+        client.close()
+        serve.delete("grpcapp")
+        serve.delete("grpcstream")
+
+
+def test_asgi_ingress_fastapi_style(serve_cluster):
+    """@serve.ingress(app) routes HTTP through an ASGI app with path
+    params, querystrings and JSON bodies (reference: FastAPI ingress via
+    http_util.ASGIAppReplicaWrapper)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    app = serve.asgi.App()
+
+    @app.get("/items/{item_id}")
+    def get_item(request):
+        return {"item_id": request.path_params["item_id"],
+                "q": request.query_params.get("q", ""),
+                "scale": request.scope["deployment"].scale}
+
+    @app.post("/items")
+    async def add_item(request):
+        body = request.json()
+        return serve.asgi.Response({"added": body["name"]}, status=201)
+
+    @serve.deployment(name="AsgiApp")
+    @serve.ingress(app)
+    class AsgiApp:
+        def __init__(self, scale=10):
+            self.scale = scale
+
+    serve.run(AsgiApp.bind(3), name="shop")
+    proxy = serve.start()
+    port = ray_tpu.get(proxy.get_port.remote(), timeout=60)
+    base = f"http://127.0.0.1:{port}/shop"
+    try:
+        with urllib.request.urlopen(f"{base}/items/7?q=red",
+                                    timeout=60) as resp:
+            out = json.loads(resp.read())
+        assert out == {"item_id": "7", "q": "red", "scale": 3}
+        req = urllib.request.Request(
+            f"{base}/items", data=json.dumps({"name": "hat"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 201
+            assert json.loads(resp.read()) == {"added": "hat"}
+        try:
+            urllib.request.urlopen(f"{base}/nope", timeout=60)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        serve.delete("shop")
