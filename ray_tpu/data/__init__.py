@@ -72,6 +72,26 @@ def read_text(paths, **_ignored) -> Dataset:
     return _read(TextDatasource(paths))
 
 
+def read_datasource(datasource: Datasource, *, parallelism: int = -1,
+                    **_ignored) -> Dataset:
+    """Custom Datasource ingest (reference: `ray.data.read_datasource`)."""
+    return _read(datasource, parallelism)
+
+
+def read_tfrecords(paths, **_ignored) -> Dataset:
+    """TFRecord/tf.train.Example ingest (no tensorflow dependency)."""
+    from ray_tpu.data.datasource import TFRecordDatasource
+
+    return _read(TFRecordDatasource(paths))
+
+
+def read_webdataset(paths, **_ignored) -> Dataset:
+    """WebDataset tar shards: one row per sample key."""
+    from ray_tpu.data.datasource import WebDatasetDatasource
+
+    return _read(WebDatasetDatasource(paths))
+
+
 def read_images(paths, *, size=None, mode="RGB", **_ignored) -> Dataset:
     """Image directory/files -> rows with a dense "image" tensor column
     (reference: `read_api.py` read_images). `size=(H, W)` resizes for the
@@ -116,7 +136,8 @@ __all__ = [
     "from_numpy", "from_pandas", "from_arrow", "read_parquet", "read_csv",
     "read_json", "read_text", "read_binary_files", "read_images",
     "from_huggingface", "from_torch", "Datasink", "ParquetDatasink",
-    "CSVDatasink", "JSONDatasink",
+    "CSVDatasink", "JSONDatasink", "read_datasource", "read_tfrecords",
+    "read_webdataset",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

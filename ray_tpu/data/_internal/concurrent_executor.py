@@ -1,0 +1,322 @@
+"""Concurrent operator scheduler — every runnable operator in flight at
+once, under per-operator resource budgets and pluggable backpressure.
+
+Reference model: `python/ray/data/_internal/execution/streaming_executor.py
+:55` (the scheduling loop over operator states), `resource_manager.py`
+(per-op budgets carved from the cluster total) and
+`backpressure_policy/` (ConcurrencyCapBackpressurePolicy,
+StreamingOutputBackpressurePolicy). This is the push-mode core the
+pull-based StreamingExecutor delegates to when the plan has more than
+one remote stage: while a source read task is still producing, map tasks
+for already-produced blocks are simultaneously in flight and actor-pool
+stages are transforming earlier blocks — no stage barrier anywhere.
+
+Blocks travel BETWEEN operators as ObjectRefs (task output straight into
+the next task's argument), so intermediate data never materializes in
+the driver; only final outputs are fetched, in order.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+import ray_tpu
+
+
+# --------------------------------------------------------------- policies
+
+class BackpressurePolicy:
+    """Decides whether an operator may launch one more task now."""
+
+    def can_launch(self, op: "_OpState", execr: "ConcurrentExecutor"
+                   ) -> bool:
+        raise NotImplementedError
+
+
+class ConcurrencyCapPolicy(BackpressurePolicy):
+    """Cap each op's in-flight tasks at its resource budget (reference:
+    ConcurrencyCapBackpressurePolicy)."""
+
+    def can_launch(self, op, execr):
+        return len(op.pending) < op.budget_slots
+
+
+class OutputBufferPolicy(BackpressurePolicy):
+    """Bound how far an op may run ahead of its consumer (reference:
+    StreamingOutputBackpressurePolicy): stop launching when the
+    downstream input queue is already deep — a slow consumer throttles
+    the whole chain instead of buffering unboundedly.
+
+    The FINAL op is exempt: its output buffer holds refs awaiting
+    in-order emission, and one straggling low sequence number can park
+    many later refs there — counting them would block launching exactly
+    the straggler's task, a permanent deadlock. The consumer's generator
+    suspension + the concurrency cap already bound the final stage."""
+
+    def __init__(self, max_queued_outputs: int = 16):
+        self.max_queued = max_queued_outputs
+
+    def can_launch(self, op, execr):
+        nxt = execr.op_after(op)
+        if nxt is None:
+            return True
+        return len(nxt.inputs) + len(op.pending) < self.max_queued
+
+
+DEFAULT_POLICIES = (ConcurrencyCapPolicy(), OutputBufferPolicy())
+
+
+# --------------------------------------------------------------- op states
+
+from ray_tpu.data._internal.remote_ops import (  # noqa: E402
+    MapWorker, run_map, run_read,
+)
+
+
+class _OpState:
+    """Scheduler-side state for one physical operator."""
+
+    def __init__(self, name: str, budget_slots: int):
+        self.name = name
+        self.budget_slots = budget_slots
+        self.inputs: deque = deque()          # (seq, payload)
+        self.pending: Dict[Any, int] = {}     # ref -> seq
+        self.exhausted = False                # no more inputs will arrive
+
+    def done(self) -> bool:
+        return self.exhausted and not self.inputs and not self.pending
+
+    # launch one task from the input queue; returns the new ref or None
+    def launch(self, execr: "ConcurrentExecutor"):
+        raise NotImplementedError
+
+
+class _SourceState(_OpState):
+    def __init__(self, read_tasks: List[Any], fused, budget_slots: int):
+        super().__init__("source", budget_slots)
+        for i, t in enumerate(read_tasks):
+            self.inputs.append((i, t))
+        self._fused = fused
+        self.exhausted = True  # the input list is fully known up front
+
+    def launch(self, execr):
+        seq, task = self.inputs.popleft()
+        ref = run_read.remote(task, self._fused)
+        self.pending[ref] = seq
+        return ref
+
+
+class _InputRefsState(_OpState):
+    """Source stage over pre-existing block refs — nothing to launch; the
+    refs ARE the outputs (they flow straight to the next op)."""
+
+    def __init__(self, refs: List[Any]):
+        super().__init__("input", 0)
+        self.refs = refs
+
+
+class _TaskMapState(_OpState):
+    def __init__(self, fused_fn, budget_slots: int, index: int):
+        super().__init__(f"map:{index}", budget_slots)
+        self._fn = fused_fn
+
+    def launch(self, execr):
+        seq, payload = self.inputs.popleft()
+        # payload may be an ObjectRef (upstream task output) — passed as
+        # an arg so the block list moves store-to-store, never via the
+        # driver.
+        ref = run_map.remote(payload, self._fn)
+        self.pending[ref] = seq
+        return ref
+
+
+class _ActorMapState(_OpState):
+    """Stateful-UDF stage on a pool of actors (reference:
+    actor_pool_map_operator)."""
+
+    def __init__(self, op, budget_slots: int, index: int):
+        from ray_tpu.data._internal.plan import MapBatches
+
+        super().__init__(f"actor_map:{index}",
+                         min(budget_slots, (op.concurrency or 2) * 2))
+        self._op = MapBatches(op.fn, batch_size=op.batch_size,
+                              batch_format=op.batch_format,
+                              fn_kwargs=op.fn_kwargs)
+        self._size = op.concurrency or 2
+        self._opts = {"num_cpus": op.num_cpus}
+        if op.num_tpus:
+            self._opts["num_tpus"] = op.num_tpus
+        self._pool: Optional[List[Any]] = None
+        self._rr = 0
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            self._pool = [
+                MapWorker.options(**self._opts).remote(self._op)
+                for _ in range(self._size)]
+        return self._pool
+
+    def launch(self, execr):
+        pool = self._ensure_pool()
+        seq, payload = self.inputs.popleft()
+        actor = pool[self._rr % len(pool)]
+        self._rr += 1
+        ref = actor.apply_list.remote(payload)
+        self.pending[ref] = seq
+        return ref
+
+    def close(self):
+        for a in self._pool or []:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------- executor
+
+class ConcurrentExecutor:
+    """Run Source -> Map* chains with every op concurrently in flight.
+
+    Outputs are yielded strictly in source order (ordering is part of the
+    Dataset contract — limit/zip depend on it); completion may happen in
+    any order, the reorder buffer lives only at the very end.
+    """
+
+    def __init__(self, source: _OpState, map_states: List[_OpState],
+                 policies=DEFAULT_POLICIES):
+        self.ops: List[_OpState] = [source] + list(map_states)
+        self.policies = list(policies)
+        self.outputs: Dict[int, Any] = {}  # seq -> final ref
+        self._next_emit = 0
+        self._total: Optional[int] = None
+
+    def op_after(self, op: _OpState) -> Optional[_OpState]:
+        i = self.ops.index(op)
+        return self.ops[i + 1] if i + 1 < len(self.ops) else None
+
+    @staticmethod
+    def budgets(n_ops: int) -> int:
+        """Per-op concurrency budget: an equal share of cluster CPUs,
+        floor 2 so every op always makes progress (reference:
+        resource_manager.py's per-op resource split)."""
+        try:
+            total = int(ray_tpu.cluster_resources().get("CPU", 8))
+        except Exception:
+            total = 8
+        return max(2, total // max(n_ops, 1))
+
+    # ------------------------------------------------------------ running
+    def stream(self) -> Iterator[Any]:
+        src = self.ops[0]
+        if isinstance(src, _InputRefsState):
+            nxt = self.ops[1] if len(self.ops) > 1 else None
+            if nxt is None:
+                for i, r in enumerate(src.refs):
+                    self.outputs[i] = r
+            else:
+                for i, r in enumerate(src.refs):
+                    nxt.inputs.append((i, r))
+                nxt.exhausted = True
+            self._total = len(src.refs)
+            self.ops = self.ops[1:]
+        else:
+            self._total = len(src.inputs)
+
+        try:
+            while True:
+                self._launch_all()
+                yield from self._drain_ready_outputs()
+                if self._next_emit >= (self._total or 0) and not any(
+                        op.pending or op.inputs for op in self.ops):
+                    break
+                self._wait_any()
+            yield from self._drain_ready_outputs(final=True)
+        finally:
+            for op in self.ops:
+                if isinstance(op, _ActorMapState):
+                    op.close()
+
+    def _launch_all(self) -> None:
+        for op in self.ops:
+            while op.inputs and all(p.can_launch(op, self)
+                                    for p in self.policies):
+                op.launch(self)
+
+    def _wait_any(self) -> None:
+        refs = [r for op in self.ops for r in op.pending]
+        if not refs:
+            # Nothing in flight but also nothing launchable (policies
+            # blocking, or inputs waiting on the consumer): don't spin.
+            import time as _time
+
+            _time.sleep(0.02)
+            return
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=5.0,
+                                fetch_local=False)
+        for ref in ready:
+            self._complete(ref)
+
+    def _complete(self, ref) -> None:
+        for i, op in enumerate(self.ops):
+            if ref in op.pending:
+                seq = op.pending.pop(ref)
+                nxt = self.ops[i + 1] if i + 1 < len(self.ops) else None
+                if nxt is None:
+                    self.outputs[seq] = ref
+                else:
+                    nxt.inputs.append((seq, ref))
+                    if op.done():
+                        nxt.exhausted = True
+                return
+
+    def _drain_ready_outputs(self, final: bool = False) -> Iterator[Any]:
+        while self._next_emit in self.outputs:
+            ref = self.outputs.pop(self._next_emit)
+            self._next_emit += 1
+            blocks = (ray_tpu.get(ref, timeout=600)
+                      if not isinstance(ref, list) else ref)
+            blocks = blocks if isinstance(blocks, list) else [blocks]
+            yield from blocks
+
+
+def build_pipeline(first, fused, map_stages: List[Any],
+                   policies=DEFAULT_POLICIES) -> Optional[ConcurrentExecutor]:
+    """Build a ConcurrentExecutor for a Source + map-stage prefix, or
+    None when the source kind can't feed it. ``map_stages`` entries are
+    either fused-op lists or actor MapBatches ops (split_stages output)."""
+    from ray_tpu.data._internal import plan as plan_mod
+
+    n_ops = 1 + len(map_stages)
+    slots = ConcurrentExecutor.budgets(n_ops)
+    if isinstance(first, plan_mod.Read):
+        tasks = first.datasource.get_read_tasks(
+            first.parallelism if first.parallelism > 0 else 8)
+        source: _OpState = _SourceState(tasks, fused, slots)
+    elif isinstance(first, plan_mod.InputBlocks):
+        from ray_tpu import ObjectRef
+
+        refs = []
+        for r in first.refs:
+            if isinstance(r, ObjectRef):
+                refs.append(r)
+            else:
+                refs.append(ray_tpu.put(r if isinstance(r, list) else [r]))
+        if fused is not None:
+            # Run the fused stage as the first map over the refs.
+            map_stages = [None] + list(map_stages)
+        source = _InputRefsState(refs)
+    else:
+        return None
+
+    states: List[_OpState] = []
+    for idx, stage in enumerate(map_stages):
+        if stage is None:  # the fused fn carried over from the source
+            states.append(_TaskMapState(fused, slots, idx))
+        elif isinstance(stage, list):
+            states.append(_TaskMapState(
+                plan_mod.compile_block_fn(stage), slots, idx))
+        else:  # actor MapBatches
+            states.append(_ActorMapState(stage, slots, idx))
+    return ConcurrentExecutor(source, states, policies)

@@ -1,0 +1,45 @@
+"""Remote execution primitives shared by the pull-based
+StreamingExecutor and the push-based ConcurrentExecutor — one definition
+of how a read task / fused map / actor map runs remotely, so fixes land
+in both schedulers."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def run_read(read_task, fused_fn) -> List[Any]:
+    blocks = []
+    for block in read_task():
+        if fused_fn is not None:
+            block = fused_fn(block)
+        blocks.append(block)
+    return blocks
+
+
+@ray_tpu.remote
+def run_map(blocks, fused_fn) -> List[Any]:
+    # Inputs may be a single block (e.g. refs from
+    # MaterializedDataset.from_blocks) or a block list (task outputs).
+    blocks = blocks if isinstance(blocks, list) else [blocks]
+    return [fused_fn(b) for b in blocks]
+
+
+@ray_tpu.remote
+class MapWorker:
+    """Stateful-UDF pool actor (reference: actor_pool_map_operator)."""
+
+    def __init__(self, op_):
+        from ray_tpu.data._internal.plan import compile_block_fn
+
+        self._fn = compile_block_fn([op_])
+
+    def apply(self, block):
+        return self._fn(block)
+
+    def apply_list(self, blocks):
+        blocks = blocks if isinstance(blocks, list) else [blocks]
+        return [self._fn(b) for b in blocks]

@@ -29,19 +29,13 @@ def _cluster_available() -> bool:
     return global_worker_or_none() is not None
 
 
-@ray_tpu.remote
-def _run_read(read_task, fused_fn) -> List[Any]:
-    blocks = []
-    for block in read_task():
-        if fused_fn is not None:
-            block = fused_fn(block)
-        blocks.append(block)
-    return blocks
+from ray_tpu.data._internal.remote_ops import (  # noqa: E402
+    MapWorker, run_read,
+)
 
-
-@ray_tpu.remote
-def _run_transform(blocks: List[Any], fused_fn) -> List[Any]:
-    return [fused_fn(b) for b in blocks]
+# Back-compat alias: the scheduler primitives live in remote_ops so the
+# pull- and push-mode executors share one definition.
+_run_read = run_read
 
 
 @ray_tpu.remote
@@ -112,6 +106,30 @@ class StreamingExecutor:
                         refs, barrier.n)
                 yield from self._apply_rest(
                     self._stream_input(out_refs, None), rest[1:])
+                return
+
+        # Concurrent pipelined prefix: when MORE remote stages follow the
+        # (fused) source — an actor-pool map, further fused maps — run
+        # the whole prefix under the concurrent operator scheduler so
+        # stage N+1 transforms earlier blocks while stage N is still
+        # producing (reference: streaming_executor.py:55's operator
+        # scheduling loop). The tail (limits, barriers, zip/union) stays
+        # on the pull path.
+        prefix: List[Any] = []
+        tail = list(rest)
+        while tail and (isinstance(tail[0], list)
+                        or (isinstance(tail[0], plan_mod.MapBatches)
+                            and tail[0].uses_actors)):
+            prefix.append(tail.pop(0))
+        if prefix and _cluster_available() and isinstance(
+                first, (plan_mod.Read, plan_mod.InputBlocks)):
+            from ray_tpu.data._internal.concurrent_executor import (
+                build_pipeline,
+            )
+
+            pipe = build_pipeline(first, fused, prefix)
+            if pipe is not None:
+                yield from self._apply_rest(pipe.stream(), tail)
                 return
 
         if isinstance(first, plan_mod.Read):
@@ -269,21 +287,11 @@ class StreamingExecutor:
                 yield fn(b)
             return
 
-        @ray_tpu.remote
-        class _MapWorker:
-            def __init__(self, op_):
-                from ray_tpu.data._internal.plan import compile_block_fn
-
-                self._fn = compile_block_fn([op_])
-
-            def apply(self, block):
-                return self._fn(block)
-
         size = op.concurrency or 2
         opts = {"num_cpus": op.num_cpus}
         if op.num_tpus:
             opts["num_tpus"] = op.num_tpus
-        pool = [_MapWorker.options(**opts).remote(inline_op)
+        pool = [MapWorker.options(**opts).remote(inline_op)
                 for _ in range(size)]
         try:
             pending: deque = deque()   # (ref) in submission order

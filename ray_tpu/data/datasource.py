@@ -9,6 +9,7 @@ downstream transforms.
 from __future__ import annotations
 
 import os
+import struct as _struct
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import numpy as np
@@ -183,6 +184,158 @@ class BinaryDatasource(_FileDatasource):
 
         yield pa.table({"bytes": pa.array([data], pa.binary()),
                         "path": pa.array([path])})
+
+
+class TFRecordDatasource(_FileDatasource):
+    """TFRecord files of tf.train.Example protos, parsed WITHOUT a
+    tensorflow dependency (reference: `datasource/tfrecords_datasource
+    .py`, which shells out to TF) — the record framing (length + masked
+    crc) and the three-feature-list Example wire format are small enough
+    to decode directly. The main TPU-training ingest format."""
+
+    def _read_file(self, path: str):
+        rows = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if len(header) < 8:
+                    break
+                (length,) = _struct.unpack("<Q", header)
+                f.read(4)  # masked crc of length (not verified)
+                payload = f.read(length)
+                if len(payload) < length:
+                    raise ValueError(
+                        f"truncated TFRecord in {path}: record declared "
+                        f"{length} bytes, got {len(payload)} (interrupted "
+                        "writer or partial download)")
+                f.read(4)  # masked crc of payload
+                rows.append(_parse_tf_example(payload))
+        yield BlockAccessor.from_rows(rows)
+
+
+def _read_varint(buf: bytes, pos: int):
+    shift = 0
+    out = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _iter_fields(buf: bytes):
+    """(field_number, wire_type, value) over a protobuf message body."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:            # varint
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:          # 64-bit
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:          # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:          # 32-bit
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _parse_tf_example(payload: bytes) -> dict:
+    """tf.train.Example: field 1 = Features{field 1 = map<string,
+    Feature>}; Feature = oneof bytes_list(1)/float_list(2)/int64_list(3),
+    each a repeated field 1 (floats packed LE, ints packed varint)."""
+    row: dict = {}
+    for field, _w, features in _iter_fields(payload):
+        if field != 1:
+            continue
+        for f2, _w2, entry in _iter_fields(features):
+            if f2 != 1:
+                continue
+            key, feature = None, b""
+            for f3, _w3, v in _iter_fields(entry):
+                if f3 == 1:
+                    key = v.decode()
+                elif f3 == 2:
+                    feature = v
+            if key is None:
+                continue
+            values: list = []
+            for f4, _w4, flist in _iter_fields(feature):
+                if f4 == 1:      # bytes_list
+                    for f5, _w5, b in _iter_fields(flist):
+                        if f5 == 1:
+                            values.append(b)
+                elif f4 == 2:    # float_list (packed floats)
+                    for f5, w5, v in _iter_fields(flist):
+                        if f5 != 1:
+                            continue
+                        if w5 == 2:
+                            values.extend(
+                                _struct.unpack(f"<{len(v) // 4}f", v))
+                        else:
+                            values.append(_struct.unpack("<f", v)[0])
+                elif f4 == 3:    # int64_list (packed varints)
+                    for f5, w5, v in _iter_fields(flist):
+                        if f5 != 1:
+                            continue
+                        if w5 == 2:
+                            pos = 0
+                            while pos < len(v):
+                                iv, pos = _read_varint(v, pos)
+                                values.append(iv)
+                        else:
+                            values.append(v)
+            row[key] = values[0] if len(values) == 1 else values
+    return row
+
+
+class WebDatasetDatasource(_FileDatasource):
+    """WebDataset tar shards (reference: `datasource/webdataset_
+    datasource.py`): each sample is the group of tar members sharing a
+    basename up to the first dot; the remainder is the field name.
+    `.txt`/`.cls`/`.json` members decode; everything else stays bytes."""
+
+    def _read_file(self, path: str):
+        import json as _json
+        import tarfile
+
+        rows = []
+        current_key = None
+        row: dict = {}
+        with tarfile.open(path, "r") as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                if "." in base:
+                    key, ext = base.split(".", 1)
+                else:
+                    key, ext = base, "bin"
+                if key != current_key:
+                    if row:
+                        rows.append(row)
+                    current_key, row = key, {"__key__": key}
+                data = tar.extractfile(member).read()
+                if ext in ("txt", "text"):
+                    row[ext] = data.decode()
+                elif ext == "cls":
+                    row[ext] = int(data.decode().strip())
+                elif ext == "json":
+                    row[ext] = _json.loads(data)
+                else:
+                    row[ext] = data
+        if row:
+            rows.append(row)
+        yield BlockAccessor.from_rows(rows)
 
 
 class NumpyDatasource(Datasource):
