@@ -166,6 +166,10 @@ def make_provider(cfg: Dict[str, Any], gcs_addr, session_dir: str):
         from ray_tpu.autoscaler.tpu_pod_provider import SubprocessPodProvider
 
         return SubprocessPodProvider(gcs_addr, session_dir)
+    if ptype in ("gcp", "gcp_tpu"):
+        from ray_tpu.autoscaler.gcp_tpu_provider import GceTpuPodProvider
+
+        return GceTpuPodProvider(cfg["provider"], gcs_addr)
     if "." in ptype:  # external: "my.module.MyProvider"
         import importlib
 

@@ -28,7 +28,8 @@ from typing import Any, List, Optional
 
 
 def _address(args) -> Optional[str]:
-    return args.address or os.environ.get("RAY_TPU_ADDRESS")
+    return (getattr(args, "address", None)
+            or os.environ.get("RAY_TPU_ADDRESS"))
 
 
 def _connect(args):
@@ -78,8 +79,13 @@ def cmd_start(args) -> None:
         if not addr:
             raise SystemExit("start requires --head or --address")
         host, port = addr.rsplit(":", 1)
+        resources = (json.loads(args.resources)
+                     if getattr(args, "resources", None) else None)
+        labels = (json.loads(args.labels)
+                  if getattr(args, "labels", None) else None)
         node = Node(head=False, gcs_addr=(host, int(port)),
                     num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                    resources=resources, labels=labels,
                     fate_share=False)
         print(f"joined cluster at {addr} as node {node.node_id.hex()[:12]}")
     if args.block:
@@ -338,11 +344,22 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     p = sub.add_parser("start", help="start a head or worker node")
     p.add_argument("--head", action="store_true")
+    # SUPPRESS: absent here must not clobber a globally-passed
+    # `ray_tpu --address X start` (subparser defaults overwrite the
+    # shared namespace).
+    p.add_argument("--address", default=argparse.SUPPRESS,
+                   help="cluster GCS address to join (worker mode)")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--num-cpus", type=int, default=None)
     p.add_argument("--num-tpus", type=int, default=None)
     p.add_argument("--no-dashboard", action="store_true",
                    help="skip starting the dashboard head")
+    p.add_argument("--resources", default=None,
+                   help="JSON custom resources for this node, e.g. "
+                        "'{\"CPU\": 8, \"TPU\": 4}'")
+    p.add_argument("--labels", default=None,
+                   help="JSON node labels (the cloud provider tags joined "
+                        "nodes with their provider group this way)")
     p.add_argument("--block", action="store_true",
                    help="stay attached; Ctrl-C stops the node")
     p.set_defaults(fn=cmd_start)

@@ -1,0 +1,307 @@
+"""GCE TPU-VM pod-slice provider against a mocked TPU API + capturing
+command runner (reference: the gcp node provider tests run hardware-free
+the same way). Covers: atomic slice create, bootstrap on every host,
+rollback on bootstrap/API failure, terminate, autoscaler gang launch."""
+
+import re
+
+import pytest
+
+from ray_tpu.autoscaler.gcp_tpu_provider import (
+    CommandRunner, GceTpuPodProvider,
+)
+
+PROVIDER_CFG = {"project": "proj", "zone": "us-central2-b",
+                "cluster_name": "test", "type": "gcp_tpu"}
+GCS_ADDR = ("10.0.0.1", 6379)
+
+
+class FakeTpuApi:
+    """TPU API state machine: nodes become READY after `delay_polls`
+    GETs, with one networkEndpoint per host."""
+
+    def __init__(self, hosts=4, delay_polls=2, fail_state=None):
+        self.hosts = hosts
+        self.delay_polls = delay_polls
+        self.fail_state = fail_state
+        self.calls = []
+        self.nodes = {}
+
+    def __call__(self, method, url, body=None):
+        self.calls.append((method, url, body))
+        name = url.rsplit("/", 1)[-1].split("?")[0]
+        if method == "POST":
+            name = url.split("nodeId=")[1]
+            self.nodes[name] = {"polls": 0, "deleted": False}
+            return {"name": f"operations/{name}"}
+        if method == "GET":
+            st = self.nodes[name]
+            st["polls"] += 1
+            if self.fail_state and st["polls"] >= self.delay_polls:
+                return {"state": self.fail_state}
+            if st["polls"] < self.delay_polls:
+                return {"state": "CREATING"}
+            return {"state": "READY", "networkEndpoints": [
+                {"ipAddress": f"10.1.0.{i}"} for i in range(self.hosts)]}
+        if method == "DELETE":
+            self.nodes[name]["deleted"] = True
+            return {}
+        raise AssertionError(method)
+
+
+class CapturingRunner(CommandRunner):
+    def __init__(self, fail_on=None):
+        self.commands = []
+        self.fail_on = fail_on
+
+    def run(self, host_ip, command):
+        self.commands.append((host_ip, command))
+        if self.fail_on == host_ip:
+            raise RuntimeError(f"ssh to {host_ip} failed")
+
+
+def _provider(api, runner):
+    return GceTpuPodProvider(PROVIDER_CFG, GCS_ADDR, transport=api,
+                             command_runner=runner, ready_timeout_s=10,
+                             poll_interval_s=0.01)
+
+
+def test_create_slice_bootstraps_every_host():
+    api = FakeTpuApi(hosts=4)
+    runner = CapturingRunner()
+    p = _provider(api, runner)
+    gid = p.create_node_group(
+        "tpu_v5e_16", {"accelerator_type": "v5litepod-16",
+                       "resources": {"CPU": 8, "TPU": 4}}, 4)
+    assert p.node_groups() == [gid]
+    assert p.group_type_of(gid) == "tpu_v5e_16"
+    assert len(p.group_nodes(gid)) == 4
+    assert len(runner.commands) == 4
+    # Every host gets the join command with the GCS address + its
+    # provider-group identity labels.
+    for i, (ip, cmd) in enumerate(runner.commands):
+        assert ip == f"10.1.0.{i}"
+        assert "ray_tpu start --address 10.0.0.1:6379" in cmd
+        assert f'"provider_group": "{gid}"' in cmd
+        assert f'"worker_index": "{i}"' in cmd
+    # The create call asked for the right slice.
+    post = [c for c in api.calls if c[0] == "POST"][0]
+    assert post[2]["acceleratorType"] == "v5litepod-16"
+
+
+def test_bootstrap_failure_rolls_back_whole_slice():
+    api = FakeTpuApi(hosts=4)
+    runner = CapturingRunner(fail_on="10.1.0.2")  # third host fails
+    p = _provider(api, runner)
+    with pytest.raises(RuntimeError, match="ssh to 10.1.0.2"):
+        p.create_node_group(
+            "tpu_v5e_16", {"accelerator_type": "v5litepod-16"}, 4)
+    assert p.node_groups() == []
+    # Rollback: the slice was deleted, not leaked half-bootstrapped.
+    assert any(c[0] == "DELETE" for c in api.calls)
+    assert all(st["deleted"] for st in api.nodes.values())
+
+
+def test_api_failure_state_rolls_back():
+    api = FakeTpuApi(hosts=4, fail_state="PREEMPTED")
+    p = _provider(api, CapturingRunner())
+    with pytest.raises(RuntimeError, match="PREEMPTED"):
+        p.create_node_group(
+            "tpu_v5e_16", {"accelerator_type": "v5litepod-16"}, 4)
+    assert any(c[0] == "DELETE" for c in api.calls)
+
+
+def test_short_slice_detected():
+    """READY slice with fewer hosts than the gang needs = config error,
+    rolled back."""
+    api = FakeTpuApi(hosts=2)
+    p = _provider(api, CapturingRunner())
+    with pytest.raises(RuntimeError, match="expected 4"):
+        p.create_node_group(
+            "tpu_v5e_16", {"accelerator_type": "v5litepod-16"}, 4)
+    assert all(st["deleted"] for st in api.nodes.values())
+
+
+def test_terminate_group_deletes_slice():
+    api = FakeTpuApi(hosts=4)
+    p = _provider(api, CapturingRunner())
+    gid = p.create_node_group(
+        "tpu_v5e_16", {"accelerator_type": "v5litepod-16"}, 4)
+    p.terminate_node_group(gid)
+    assert p.node_groups() == []
+    assert api.nodes[gid]["deleted"]
+
+
+def test_single_node_facade():
+    api = FakeTpuApi(hosts=1)
+    p = _provider(api, CapturingRunner())
+    nid = p.create_node("cpu_worker", {"accelerator_type": "v5litepod-1"})
+    assert nid.endswith("#0")
+    assert p.node_type_of(nid) == "cpu_worker"
+    assert p.non_terminated_nodes() == [nid]
+    p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []
+
+
+def test_yaml_wiring(tmp_path):
+    """`provider.type: gcp_tpu` resolves to the GCE provider through the
+    cluster-config loader."""
+    from ray_tpu.autoscaler.config import make_provider, validate_cluster_config
+
+    cfg = validate_cluster_config({
+        "cluster_name": "demo",
+        "provider": PROVIDER_CFG,
+        "available_node_types": {
+            "tpu_v5e_16": {
+                "node_config": {"tpu": "v5e-16",
+                                "accelerator_type": "v5litepod-16"},
+            },
+        },
+    })
+    assert cfg["available_node_types"]["tpu_v5e_16"]["gang_size"] == 4
+    provider = make_provider(cfg, GCS_ADDR, "/tmp/nowhere")
+    assert isinstance(provider, GceTpuPodProvider)
+
+
+def test_pod_autoscaler_gang_launch_through_provider():
+    """A TPU-v5e-16-head demand makes the PodAutoscaler launch one
+    4-host slice atomically via the provider (gang semantics end to
+    end, GCS faked)."""
+    from ray_tpu.autoscaler.config import validate_cluster_config
+    from ray_tpu.autoscaler.pod_autoscaler import PodAutoscaler
+
+    cfg = validate_cluster_config({
+        "cluster_name": "demo",
+        "max_workers": 8,
+        "provider": PROVIDER_CFG,
+        "available_node_types": {
+            "tpu_v5e_16": {
+                "node_config": {"tpu": "v5e-16",
+                                "accelerator_type": "v5litepod-16"},
+            },
+        },
+    })
+    assert (cfg["available_node_types"]["tpu_v5e_16"]["head_resources"]
+            == {"TPU-v5e-16-head": 1})
+    api = FakeTpuApi(hosts=4)
+    runner = CapturingRunner()
+    provider = _provider(api, runner)
+
+    class FakeGcs:
+        def call(self, method, **kw):
+            assert method == "get_cluster_load"
+            return [{"node_id": b"head", "total": {"CPU": 2},
+                     "available": {"CPU": 2},
+                     "pending_demands": [{"TPU-v5e-16-head": 1}]}]
+
+    autoscaler = PodAutoscaler.__new__(PodAutoscaler)
+    autoscaler._gcs = FakeGcs()
+    autoscaler.provider = provider
+    autoscaler.config = cfg
+    autoscaler.node_types = cfg["available_node_types"]
+    autoscaler.max_hosts = cfg.get("max_workers", 8)
+    autoscaler.idle_timeout_s = 300.0
+    autoscaler._group_idle_since = {}
+
+    out = autoscaler.update()
+    assert out["launched"] == 1
+    assert len(provider.node_groups()) == 1
+    gid = provider.node_groups()[0]
+    assert len(provider.group_nodes(gid)) == 4
+    assert len(runner.commands) == 4
+    # Second pass: capacity now pending-join covers the demand; no
+    # duplicate slice.
+    out2 = autoscaler.update()
+    assert out2["launched"] == 0
+    assert len(provider.node_groups()) == 1
+
+
+def test_bootstrap_command_shape():
+    api = FakeTpuApi(hosts=1)
+    p = _provider(api, CapturingRunner())
+    cmd = p._bootstrap_command("grp1", 2, {"resources": {"TPU": 4}})
+    assert re.search(r"--address 10\.0\.0\.1:6379", cmd)
+    assert '"worker_index": "2"' in cmd
+
+
+def test_bootstrap_head_resource_on_worker0():
+    """Host 0's join command carries the promoted pod-head resource;
+    other hosts don't (gang-claim contract)."""
+    api = FakeTpuApi(hosts=4)
+    runner = CapturingRunner()
+    p = _provider(api, runner)
+    p.create_node_group(
+        "tpu-v5e-16",
+        {"accelerator_type": "v5litepod-16",
+         "resources": {"CPU": 8, "TPU": 4},
+         "head_resources": {"TPU-v5e-16-head": 1}}, 4)
+    head_cmds = [c for _, c in runner.commands if "TPU-v5e-16-head" in c]
+    assert len(head_cmds) == 1
+    assert runner.commands[0][1] == head_cmds[0]
+    assert "python -m ray_tpu start" in head_cmds[0]
+
+
+def test_node_name_sanitized():
+    """Config-legal names (dots/underscores/caps) become RFC1035 node
+    ids the TPU API accepts."""
+    api = FakeTpuApi(hosts=1)
+    p = GceTpuPodProvider({**PROVIDER_CFG, "cluster_name": "My_Cluster"},
+                          GCS_ADDR, transport=api,
+                          command_runner=CapturingRunner(),
+                          ready_timeout_s=5, poll_interval_s=0.01)
+    gid = p.create_node_group("tpu.v5e_16", {"accelerator_type": "x-1"}, 1)
+    assert re.fullmatch(r"[a-z]([-a-z0-9]*[a-z0-9])?", gid), gid
+
+
+def test_transient_poll_error_retries():
+    """One flaky GET during readiness polling must not tear the slice
+    down."""
+    api = FakeTpuApi(hosts=2, delay_polls=3)
+    orig = api.__call__
+
+    calls = {"n": 0}
+
+    def flaky(method, url, body=None):
+        if method == "GET":
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("503 backend blip")
+        return orig(method, url, body)
+
+    p = GceTpuPodProvider(PROVIDER_CFG, GCS_ADDR, transport=flaky,
+                          command_runner=CapturingRunner(),
+                          ready_timeout_s=10, poll_interval_s=0.01)
+    gid = p.create_node_group("t", {"accelerator_type": "v5litepod-8"}, 2)
+    assert gid in p.node_groups()
+
+
+def test_refresh_groups_adopts_running_slices():
+    """A restarted monitor rediscovers slices tagged with its cluster
+    (no orphaned billing, no duplicate min_workers launches)."""
+    api = FakeTpuApi(hosts=4)
+    runner = CapturingRunner()
+    p1 = _provider(api, runner)
+    gid = p1.create_node_group("tpuv5e", {"accelerator_type": "v"}, 4)
+
+    def listing(method, url, body=None):
+        if method == "GET" and url.endswith("/nodes"):
+            return {"nodes": [{
+                "name": f"projects/proj/locations/z/nodes/{gid}",
+                "state": "READY",
+                "metadata": {"ray-cluster": "test"},
+                "networkEndpoints": [{"ipAddress": f"10.1.0.{i}"}
+                                     for i in range(4)],
+            }, {
+                "name": "projects/proj/locations/z/nodes/other-cluster",
+                "metadata": {"ray-cluster": "someone-else"},
+            }]}
+        return api(method, url, body)
+
+    p2 = GceTpuPodProvider(PROVIDER_CFG, GCS_ADDR, transport=listing,
+                           command_runner=CapturingRunner(),
+                           ready_timeout_s=5, poll_interval_s=0.01)
+    assert p2.node_groups() == []
+    assert p2.refresh_groups() == 1
+    assert p2.node_groups() == [gid]
+    assert p2.group_type_of(gid) == "tpuv5e"
+    assert len(p2.group_nodes(gid)) == 4
