@@ -220,10 +220,21 @@ def shutdown() -> None:
                             })
                 except Exception:
                     pass
-            w.shutdown()
+            try:
+                w.shutdown()
+            finally:
+                # Even a failed teardown must drop the global worker, or
+                # the next init(ignore_reinit_error=True) silently reuses
+                # a half-dead cluster (observed as cross-module test
+                # leakage: later suites inherited a stale session).
+                from ray_tpu._private.worker import set_global_worker
+
+                set_global_worker(None)
         if _local_node is not None:
-            _local_node.shutdown()
-            _local_node = None
+            try:
+                _local_node.shutdown()
+            finally:
+                _local_node = None
 
 
 def is_initialized() -> bool:

@@ -292,12 +292,13 @@ def _layer(config: LlamaConfig, cos, sin, attn_fn, x, layer_params):
     return x, jnp.zeros((), jnp.float32)
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array,
-            config: LlamaConfig,
-            attn_impl: Optional[str] = None,
-            return_aux: bool = False):
-    """tokens [B, S] int32 -> logits [B, S, V] (or (logits, aux_loss)
-    with return_aux — the MoE router load-balance term)."""
+def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
+                   config: LlamaConfig,
+                   attn_impl: Optional[str] = None):
+    """Trunk only: tokens [B, S] -> (hidden [B, S, D], aux). The fused
+    training loss consumes hidden states directly so the [B, S, V]
+    logits tensor never materializes (ops/fused_loss.py); `forward`
+    adds the lm_head matmul on top."""
     c = config
     impl = attn_impl or c.attn_impl
     attn_fn = _get_attention_fn(impl)
@@ -324,6 +325,17 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
 
     x, aux = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["norm_f"], c.norm_eps)
+    return x, jnp.sum(aux)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            config: LlamaConfig,
+            attn_impl: Optional[str] = None,
+            return_aux: bool = False):
+    """tokens [B, S] int32 -> logits [B, S, V] (or (logits, aux_loss)
+    with return_aux — the MoE router load-balance term)."""
+    c = config
+    x, aux = forward_hidden(params, tokens, config, attn_impl)
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
     # bf16 matmul on the MXU (fp32 here costs ~4x), fp32 accumulation for
     # the softmax/loss that follows.
@@ -331,23 +343,46 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
         x, head.astype(c.dtype), (((2,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     if return_aux:
-        return logits, jnp.sum(aux)
+        return logits, aux
     return logits
 
 
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
             config: LlamaConfig,
-            attn_impl: Optional[str] = None) -> jax.Array:
-    """Next-token cross-entropy. batch: tokens [B, S] (+ optional mask)."""
+            attn_impl: Optional[str] = None,
+            fused: Optional[bool] = None) -> jax.Array:
+    """Next-token cross-entropy. batch: tokens [B, S] (+ optional mask).
+
+    ``fused`` (default: env RAY_TPU_FUSED_LOSS, on unless =0) streams
+    the lm_head matmul + logsumexp over vocab blocks so the [B, S, V]
+    logits tensor never round-trips to HBM (ops/fused_loss.py) —
+    identical numerics, fraction of the loss-stage memory traffic."""
+    import os
+
     tokens = batch["tokens"]
-    logits, aux = forward(params, tokens[:, :-1], config, attn_impl,
-                          return_aux=True)
     targets = tokens[:, 1:]
-    # NLL via logsumexp - target_logit: one [B,S,V] reduction instead of a
-    # materialized log_softmax plus gather (halves loss-stage HBM traffic).
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = lse - tgt
+    if fused is None:
+        fused = os.environ.get("RAY_TPU_FUSED_LOSS", "1") != "0"
+    if fused:
+        from ray_tpu.ops.fused_loss import blockwise_xent
+
+        hidden, aux = forward_hidden(params, tokens[:, :-1], config,
+                                     attn_impl)
+        c = config
+        head = (params["embed"].T if c.tie_embeddings
+                else params["lm_head"]).astype(c.dtype)
+        b, s, d = hidden.shape
+        nll = blockwise_xent(hidden.reshape(b * s, d), head,
+                             targets.reshape(-1)).reshape(b, s)
+    else:
+        logits, aux = forward(params, tokens[:, :-1], config, attn_impl,
+                              return_aux=True)
+        # NLL via logsumexp - target_logit: one [B,S,V] reduction instead
+        # of a materialized log_softmax plus gather.
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, targets[..., None],
+                                  axis=-1)[..., 0]
+        nll = lse - tgt
     mask = batch.get("mask")
     if mask is not None:
         m = mask[:, 1:].astype(jnp.float32)

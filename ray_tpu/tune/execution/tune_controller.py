@@ -68,7 +68,8 @@ class TuneController:
                  experiment_dir: str, metric: Optional[str] = None,
                  mode: str = "max", scheduler=None,
                  max_concurrent: int = 4,
-                 trial_resources: Optional[Dict[str, float]] = None):
+                 trial_resources: Optional[Dict[str, float]] = None,
+                 searcher=None, num_samples: int = 0):
         self._trainable = trainable
         self.trials = trials
         self._dir = experiment_dir
@@ -77,7 +78,41 @@ class TuneController:
         self._scheduler = scheduler or FIFOScheduler()
         self._max_concurrent = max(1, max_concurrent)
         self._resources = trial_resources or {"CPU": 1}
+        # Adaptive search (reference: SearchGenerator over a Searcher):
+        # trials are requested one at a time as slots free, so completed
+        # results steer later suggestions.
+        self._searcher = searcher
+        self._num_samples = num_samples
         os.makedirs(experiment_dir, exist_ok=True)
+
+    def _maybe_suggest(self, pending: List["Trial"], n_running: int) -> None:
+        if self._searcher is None:
+            return
+        while (len(self.trials) < self._num_samples
+               and len(pending) + n_running < self._max_concurrent):
+            trial_id = f"trial_{len(self.trials):05d}"
+            config = self._searcher.suggest(trial_id)
+            if config is None:  # limiter saturated / space exhausted
+                return
+            trial = Trial(trial_id=trial_id, config=config)
+            self.trials.append(trial)
+            pending.append(trial)
+
+    def _notify_searcher(self, trial: "Trial") -> None:
+        if self._searcher is None:
+            return
+        try:
+            self._searcher.on_trial_complete(
+                trial.trial_id, result=trial.last_result or None,
+                error=trial.status == ERRORED)
+        except Exception as e:  # noqa: BLE001
+            # Surfaced, not swallowed: a searcher that drops every
+            # observation silently degrades to random search with no
+            # sign anything is wrong.
+            import sys
+
+            print(f"[tune] searcher.on_trial_complete failed for "
+                  f"{trial.trial_id}: {e!r}", file=sys.stderr)
 
     # ----------------------------------------------------------------- run
     def run(self) -> List[Trial]:
@@ -86,7 +121,16 @@ class TuneController:
         trial_by_id = {t.trial_id: t for t in self.trials}
         self._save_experiment_state()
 
-        while pending or running:
+        while True:
+            # Suggest BEFORE the emptiness check: when the last running
+            # trial completes, the searcher must get a chance to refill
+            # or fit() exits after one trial at max_concurrent=1. A
+            # suggest() of None with nothing pending/running means the
+            # space (or limiter) is exhausted — stop rather than spin.
+            self._maybe_suggest(pending, len(running))
+            trial_by_id.update({t.trial_id: t for t in self.trials})
+            if not pending and not running:
+                break
             while pending and len(running) < self._max_concurrent:
                 trial = pending.pop(0)
                 trial_dir = os.path.join(self._dir, trial.trial_id)
@@ -109,6 +153,7 @@ class TuneController:
                 if not launched:
                     trial.status = ERRORED
                     trial.error = f"trial launch failed: {launch_error}"
+                    self._notify_searcher(trial)
                     self._save_experiment_state()
                     continue
                 trial.status = RUNNING
@@ -148,6 +193,7 @@ class TuneController:
                             latest) > os.path.basename(cur))):
                     trial.checkpoint_path = latest
                 running.pop(trial_id)
+                self._notify_searcher(trial)
                 self._save_experiment_state()
                 continue
 
@@ -159,11 +205,13 @@ class TuneController:
                 trial.status = TERMINATED
                 running.pop(trial_id)
                 ray_tpu.kill(actor)
+                self._notify_searcher(trial)
             elif kind == tsession.ERRORED:
                 trial.status = ERRORED
                 trial.error = payload
                 running.pop(trial_id)
                 ray_tpu.kill(actor)
+                self._notify_searcher(trial)
             else:
                 metrics = dict(payload or {})
                 metrics.setdefault("training_iteration",
@@ -185,6 +233,7 @@ class TuneController:
                         pass
                     running.pop(trial_id)
                     ray_tpu.kill(actor)
+                    self._notify_searcher(trial)
                 elif decision == EXPLOIT:
                     # PBT: clone a top-quantile donor's checkpoint into
                     # this trial with a perturbed config and relaunch

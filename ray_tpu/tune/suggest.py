@@ -1,0 +1,248 @@
+"""Adaptive search algorithms — suggest/observe searchers for Tune.
+
+Reference: `python/ray/tune/search/` (Searcher ABC at `searcher.py:40`,
+ConcurrencyLimiter, and the Optuna/HyperOpt adapters). The controller
+asks a Searcher for the next config as slots free up and reports
+completed trials back, so the search posterior actually steers later
+trials — unlike BasicVariantGenerator's up-front expansion.
+
+`TPESearcher` is a from-scratch Tree-structured Parzen Estimator over
+the same Domain objects grid/random search use (numpy only — no
+external HPO library in the image); `OptunaSearch` adapts an installed
+optuna, and raises a clear error when the library is absent.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.tune.search import (
+    Categorical, Domain, LogUniform, Randint, SampleFrom, Uniform,
+    _GridSearch,
+)
+
+
+class Searcher:
+    """suggest()/on_trial_complete() protocol (reference:
+    `search/searcher.py:40`)."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+
+    def set_search_properties(self, metric: Optional[str], mode: str,
+                              param_space: Dict[str, Any]) -> None:
+        self.metric = metric
+        self.mode = mode
+        self.param_space = self._normalize_space(param_space)
+
+    @staticmethod
+    def _normalize_space(param_space: Dict[str, Any]) -> Dict[str, Any]:
+        """Adaptive searchers model distributions: grid_search entries
+        become Categorical; sample_from (arbitrary code over the partial
+        config) cannot be modeled — reject it clearly instead of
+        crashing mid-experiment."""
+        out = {}
+        for key, dom in param_space.items():
+            if isinstance(dom, _GridSearch):
+                out[key] = Categorical(list(dom.values))
+            elif isinstance(dom, SampleFrom):
+                raise ValueError(
+                    f"param {key!r}: sample_from is not supported by "
+                    "adaptive searchers (use a Domain, or "
+                    "BasicVariantGenerator via search_alg=None)")
+            else:
+                out[key] = dom
+        return out
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap outstanding (suggested but unfinished) trials (reference:
+    `search/concurrency_limiter.py`)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int = 4):
+        self.searcher = searcher
+        self.max_concurrent = max(1, max_concurrent)
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, param_space):
+        super().set_search_properties(metric, mode, param_space)
+        self.searcher.set_search_properties(metric, mode, param_space)
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return None
+        out = self.searcher.suggest(trial_id)
+        if out is not None:
+            self._live.add(trial_id)
+        return out
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator over Domain spaces.
+
+    After ``n_startup`` random trials, observations split at the γ
+    quantile into good/bad sets; numeric dims model both with Gaussian
+    KDEs (in log space for LogUniform) and categorical dims with
+    smoothed counts. Candidates sample from the good model and the one
+    maximizing l(x)/g(x) wins — the standard TPE acquisition.
+    """
+
+    def __init__(self, n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 32, seed: Optional[int] = None):
+        self._n_startup = n_startup
+        self._gamma = gamma
+        self._n_cand = n_candidates
+        self._rng = np.random.default_rng(seed)
+        self._pyrng = random.Random(seed)
+        self._suggested: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[tuple] = []  # (config, score) score higher=better
+
+    # ------------------------------------------------------------ helpers
+    def _dims(self):
+        return {k: v for k, v in self.param_space.items()
+                if isinstance(v, Domain)}
+
+    def _random_config(self) -> Dict[str, Any]:
+        out = {}
+        for key, dom in self.param_space.items():
+            out[key] = dom.sample(self._pyrng) if isinstance(dom, Domain) \
+                else dom
+        return out
+
+    @staticmethod
+    def _to_num(dom, v):
+        return math.log(v) if isinstance(dom, LogUniform) else float(v)
+
+    @staticmethod
+    def _from_num(dom, x):
+        if isinstance(dom, LogUniform):
+            return float(np.clip(math.exp(x), dom.lower, dom.upper))
+        if isinstance(dom, Randint):
+            return int(np.clip(round(x), dom.lower, dom.upper - 1))
+        return float(np.clip(x, dom.lower, dom.upper))
+
+    def _kde_logpdf(self, xs: np.ndarray, pts: np.ndarray, lo, hi) -> np.ndarray:
+        if len(pts) == 0:
+            return np.zeros_like(xs)
+        bw = max((hi - lo) / max(len(pts), 1) * 1.06, (hi - lo) * 0.02, 1e-12)
+        diff = (xs[:, None] - pts[None, :]) / bw
+        return np.log(np.exp(-0.5 * diff * diff).mean(axis=1) / bw + 1e-12)
+
+    # ------------------------------------------------------------- protocol
+    def suggest(self, trial_id):
+        if len(self._obs) < self._n_startup:
+            cfg = self._random_config()
+            self._suggested[trial_id] = cfg
+            return dict(cfg)
+        ranked = sorted(self._obs, key=lambda cs: -cs[1])
+        n_good = max(1, int(len(ranked) * self._gamma))
+        good = [c for c, _ in ranked[:n_good]]
+        bad = [c for c, _ in ranked[n_good:]] or good
+        cfg = {}
+        for key, dom in self.param_space.items():
+            if not isinstance(dom, Domain):
+                cfg[key] = dom
+                continue
+            if isinstance(dom, Categorical):
+                cats = dom.categories
+                gc = np.array([sum(1.0 for c in good if c[key] == v) + 1.0
+                               for v in cats])
+                bc = np.array([sum(1.0 for c in bad if c[key] == v) + 1.0
+                               for v in cats])
+                score = (gc / gc.sum()) / (bc / bc.sum())
+                cfg[key] = cats[int(np.argmax(
+                    score * self._rng.dirichlet(np.ones(len(cats))) ** 0.1))]
+                continue
+            lo = self._to_num(dom, dom.lower)
+            hi = self._to_num(dom, getattr(dom, "upper"))
+            gpts = np.array([self._to_num(dom, c[key]) for c in good])
+            bpts = np.array([self._to_num(dom, c[key]) for c in bad])
+            # Candidates from the good KDE (plus uniform exploration).
+            idx = self._rng.integers(0, len(gpts), self._n_cand)
+            bw = max((hi - lo) * 0.1, 1e-12)
+            cand = gpts[idx] + self._rng.normal(0, bw, self._n_cand)
+            cand = np.clip(cand, lo, hi)
+            cand[0] = self._rng.uniform(lo, hi)  # never fully greedy
+            ei = (self._kde_logpdf(cand, gpts, lo, hi)
+                  - self._kde_logpdf(cand, bpts, lo, hi))
+            cfg[key] = self._from_num(dom, float(cand[int(np.argmax(ei))]))
+        self._suggested[trial_id] = cfg
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._suggested.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._obs.append((cfg, score))
+
+
+class OptunaSearch(Searcher):
+    """Adapter over an installed optuna (reference:
+    `search/optuna/optuna_search.py`); raises ImportError with guidance
+    when the library isn't present."""
+
+    def __init__(self, seed: Optional[int] = None):
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the 'optuna' package; it is not "
+                "bundled — use TPESearcher for a built-in adaptive "
+                "searcher") from e
+        self._optuna = optuna
+        self._seed = seed
+        self._study = None
+        self._live: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, param_space):
+        super().set_search_properties(metric, mode, param_space)
+        sampler = self._optuna.samplers.TPESampler(seed=self._seed)
+        self._study = self._optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize",
+            sampler=sampler)
+
+    def suggest(self, trial_id):
+        t = self._study.ask()
+        cfg = {}
+        for key, dom in self.param_space.items():
+            if isinstance(dom, Categorical):
+                cfg[key] = t.suggest_categorical(key, dom.categories)
+            elif isinstance(dom, LogUniform):
+                cfg[key] = t.suggest_float(key, dom.lower, dom.upper,
+                                           log=True)
+            elif isinstance(dom, Uniform):
+                cfg[key] = t.suggest_float(key, dom.lower, dom.upper)
+            elif isinstance(dom, Randint):
+                cfg[key] = t.suggest_int(key, dom.lower, dom.upper - 1)
+            else:
+                cfg[key] = dom
+        self._live[trial_id] = t
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        t = self._live.pop(trial_id, None)
+        if t is None:
+            return
+        if error or not result or self.metric not in result:
+            self._study.tell(t, state=self._optuna.trial.TrialState.FAIL)
+        else:
+            self._study.tell(t, float(result[self.metric]))

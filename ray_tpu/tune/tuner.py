@@ -30,6 +30,11 @@ class TuneConfig:
     scheduler: Any = None
     search_seed: Optional[int] = None
     trial_resources: Optional[Dict[str, float]] = None
+    # Adaptive searcher (ray_tpu.tune.suggest.Searcher): when set, trials
+    # are suggested incrementally instead of expanded up front, and
+    # completed results feed back into the search (reference:
+    # TuneConfig.search_alg).
+    search_alg: Any = None
 
 
 class ResultGrid:
@@ -86,6 +91,11 @@ class Tuner:
     # ------------------------------------------------------------------ fit
     def fit(self) -> ResultGrid:
         tc = self._tune_config
+        if tc.search_alg is not None:
+            # Both branches need a configured searcher: a restored
+            # experiment keeps suggesting its remaining trials.
+            tc.search_alg.set_search_properties(
+                tc.metric, tc.mode, self._param_space)
         if self._restore_path:
             experiment_dir = self._restore_path
             trials = TuneController.load_experiment_state(experiment_dir)
@@ -93,10 +103,13 @@ class Tuner:
             name = self._run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
             experiment_dir = os.path.join(
                 self._run_config.resolved_storage_path(), name)
-            configs = BasicVariantGenerator(tc.search_seed).generate(
-                self._param_space, tc.num_samples)
-            trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
-                      for i, cfg in enumerate(configs)]
+            if tc.search_alg is not None:
+                trials = []  # the controller pulls suggestions as slots free
+            else:
+                configs = BasicVariantGenerator(tc.search_seed).generate(
+                    self._param_space, tc.num_samples)
+                trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
+                          for i, cfg in enumerate(configs)]
 
         scheduler = tc.scheduler
         if scheduler is not None and getattr(scheduler, "metric",
@@ -113,7 +126,8 @@ class Tuner:
             self._trainable, trials, experiment_dir,
             metric=tc.metric, mode=tc.mode, scheduler=scheduler,
             max_concurrent=tc.max_concurrent_trials,
-            trial_resources=tc.trial_resources)
+            trial_resources=tc.trial_resources,
+            searcher=tc.search_alg, num_samples=tc.num_samples)
         self._last_trials = controller.run()  # post-run Trial introspection
         return ResultGrid(controller.results(), tc.metric, tc.mode)
 

@@ -55,12 +55,24 @@ def _install_alarm(phase, item):
     limit = int(mark.args[0]) if (mark and mark.args) else TEST_TIMEOUT_S
 
     def _on_alarm(signum, frame):
-        faulthandler.dump_traceback(file=sys.stderr)
+        # To a real file: pytest's capture plugin swallows stderr, and a
+        # post-mortem needs the stack of the thing that hung.
+        try:
+            with open("/tmp/ray_tpu_test_timeouts.log", "a") as f:
+                f.write(f"\n=== {item.nodeid} {phase} "
+                        f"exceeded {limit}s ===\n")
+                faulthandler.dump_traceback(file=f)
+        except Exception:
+            pass
         raise TestTimeoutError(
             f"{item.nodeid} {phase} exceeded {limit}s")
 
     old = signal.signal(signal.SIGALRM, _on_alarm)
-    signal.alarm(limit)
+    # Repeating timer, not a one-shot alarm: a single SIGALRM delivery
+    # can be lost while the main thread sits in a non-interruptible
+    # C call; the 5s re-fire keeps poking until the handler lands
+    # (pytest-timeout's signal method has the same failure mode).
+    signal.setitimer(signal.ITIMER_REAL, limit, 5.0)
     return old
 
 
@@ -73,7 +85,7 @@ def pytest_configure(config):
 def _clear_alarm(old):
     import signal
 
-    signal.alarm(0)
+    signal.setitimer(signal.ITIMER_REAL, 0)
     signal.signal(signal.SIGALRM, old)
 
 
@@ -102,6 +114,23 @@ def pytest_runtest_teardown(item, nextitem):
         yield
     finally:
         _clear_alarm(old)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_cluster_per_module():
+    """Module isolation guarantee: if a previous module leaked its
+    cluster connection (a test that init()'d without tearing down, or a
+    teardown that died mid-way), the next module must NOT silently reuse
+    it through init(ignore_reinit_error=True) — that was the root of the
+    round-3 'suite hangs at serve streaming' cross-module leakage."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+    yield
 
 
 @pytest.fixture(scope="module")
