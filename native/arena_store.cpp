@@ -44,6 +44,10 @@ struct Entry {
 };
 
 struct Store {
+  // Guards entries/free_list/counters. Callers are nominally the
+  // raylet's single event loop, but ctypes releases the GIL around C
+  // calls, so any second Python thread would otherwise race.
+  std::mutex mu;
   int fd = -1;
   uint8_t* base = nullptr;
   uint64_t capacity = 0;
@@ -127,6 +131,16 @@ struct Store {
     return kInvalid;
   }
 
+  // Caller holds mu (evict calls this mid-scan; the public delete
+  // wraps it with the lock).
+  bool delete_unlocked(const std::string& id) {
+    auto it = entries.find(id);
+    if (it == entries.end()) return false;
+    release(it->second.offset, it->second.size);
+    entries.erase(it);
+    return true;
+  }
+
   void release(uint64_t offset, uint64_t size) {
     uint64_t want = (size + kAlign - 1) & ~(kAlign - 1);
     if (want == 0) want = kAlign;
@@ -197,6 +211,7 @@ void rtpu_store_close(void* h) {
 // Idempotent for an existing id of the same size.
 uint64_t rtpu_store_create(void* h, const char* id, uint64_t size) {
   auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
   auto it = s->entries.find(id);
   if (it != s->entries.end()) {
     if (it->second.size == size) return it->second.offset;
@@ -214,6 +229,7 @@ uint64_t rtpu_store_create(void* h, const char* id, uint64_t size) {
 
 int rtpu_store_seal(void* h, const char* id) {
   auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
   auto it = s->entries.find(id);
   if (it == s->entries.end()) return -1;
   it->second.sealed = true;
@@ -225,6 +241,7 @@ int rtpu_store_seal(void* h, const char* id) {
 int rtpu_store_get(void* h, const char* id, uint64_t* offset,
                    uint64_t* size) {
   auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
   auto it = s->entries.find(id);
   if (it == s->entries.end()) return -1;
   if (!it->second.sealed) return 1;
@@ -236,17 +253,15 @@ int rtpu_store_get(void* h, const char* id, uint64_t* offset,
 
 int rtpu_store_contains(void* h, const char* id) {
   auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
   auto it = s->entries.find(id);
   return it != s->entries.end() && it->second.sealed ? 1 : 0;
 }
 
 int rtpu_store_delete(void* h, const char* id) {
   auto* s = static_cast<Store*>(h);
-  auto it = s->entries.find(id);
-  if (it == s->entries.end()) return -1;
-  s->release(it->second.offset, it->second.size);
-  s->entries.erase(it);
-  return 0;
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->delete_unlocked(id) ? 0 : -1;
 }
 
 // Client mapping refcount: objects with refs > 0 are excluded from both
@@ -254,6 +269,7 @@ int rtpu_store_delete(void* h, const char* id) {
 // process's address space).
 int rtpu_store_addref(void* h, const char* id, int delta) {
   auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
   auto it = s->entries.find(id);
   if (it == s->entries.end()) return -1;
   int64_t next = (int64_t)it->second.refs + delta;
@@ -263,6 +279,7 @@ int rtpu_store_addref(void* h, const char* id, int delta) {
 
 int rtpu_store_pin(void* h, const char* id, int pinned) {
   auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
   auto it = s->entries.find(id);
   if (it == s->entries.end()) return -1;
   it->second.pinned = pinned != 0;
@@ -276,6 +293,7 @@ int rtpu_store_pin(void* h, const char* id, int pinned) {
 int rtpu_store_evict(void* h, uint64_t needed, char* evicted,
                      uint64_t evicted_cap) {
   auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
   int count = 0;
   uint64_t written = 0;
   while (!s->can_allocate(needed)) {
@@ -295,7 +313,7 @@ int rtpu_store_evict(void* h, uint64_t needed, char* evicted,
       std::memcpy(evicted + written, vid.c_str(), len);
       written += len;
     }
-    rtpu_store_delete(h, vid.c_str());
+    s->delete_unlocked(vid);  // NOT the public fn: mu is already held
     ++s->num_evictions;
     ++count;
   }
@@ -308,6 +326,7 @@ int rtpu_store_evict(void* h, uint64_t needed, char* evicted,
 int rtpu_store_lru_pinned(void* h, char* id_out, uint64_t id_cap,
                           uint64_t* offset, uint64_t* size) {
   auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
   const std::string* victim = nullptr;
   uint64_t best = ~0ull;
   for (auto& kv : s->entries) {
@@ -326,8 +345,25 @@ int rtpu_store_lru_pinned(void* h, char* id_out, uint64_t id_cap,
   return 0;
 }
 
+// Debug introspection for tests/diagnostics: out = {found, sealed,
+// pinned, refs}.
+void rtpu_store_entry_flags(void* h, const char* id, uint64_t out[4]) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto it = s->entries.find(id);
+  if (it == s->entries.end()) {
+    out[0] = out[1] = out[2] = out[3] = 0;
+    return;
+  }
+  out[0] = 1;
+  out[1] = it->second.sealed ? 1 : 0;
+  out[2] = it->second.pinned ? 1 : 0;
+  out[3] = it->second.refs;
+}
+
 void rtpu_store_stats(void* h, uint64_t out[4]) {
   auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
   out[0] = s->capacity;
   out[1] = s->used;
   out[2] = s->entries.size();

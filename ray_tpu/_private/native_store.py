@@ -43,6 +43,9 @@ def _configure(lib) -> None:
     lib.rtpu_store_lru_pinned.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, u64,
         ctypes.POINTER(u64), ctypes.POINTER(u64)]
+    lib.rtpu_store_entry_flags.restype = None
+    lib.rtpu_store_entry_flags.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.POINTER(ctypes.c_uint64)]
     lib.rtpu_store_stats.argtypes = [ctypes.c_void_p, u64 * 4]
 
 
@@ -137,6 +140,12 @@ class ArenaStore:
         if rc != 0:
             return None
         return bytes.fromhex(buf.value.decode()), off.value, size.value
+
+    def entry_flags(self, oid: bytes) -> Tuple[int, int, int, int]:
+        """(found, sealed, pinned, refs) — debug/diagnostic surface."""
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.rtpu_store_entry_flags(self._h, oid.hex().encode(), out)
+        return tuple(out)
 
     def stats(self) -> Tuple[int, int, int, int]:
         out = (ctypes.c_uint64 * 4)()

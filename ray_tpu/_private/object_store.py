@@ -42,6 +42,11 @@ class ObjectStoreFullError(Exception):
     pass
 
 
+# Diagnostic: trace every client addref with a stack (flag read once —
+# the env doesn't change mid-process and addref is on the get hot path).
+_DEBUG_ADDREF = bool(os.environ.get("RTPU_DEBUG_ADDREF"))
+
+
 @dataclass
 class _Entry:
     object_id: bytes
@@ -94,6 +99,30 @@ class NodeObjectStore:
         return os.path.join(self._shm_dir, self._prefix + object_id.hex())
 
     # -- create / seal ------------------------------------------------------
+    async def _with_full_retry(self, fn, attempts: int = 8,
+                               delay_s: float = 0.15):
+        """Client buffer releases land asynchronously: a store-full
+        condition where every extent is reader-pinned usually clears
+        within milliseconds once in-flight release RPCs arrive. One
+        shared policy for every async entry point."""
+        for i in range(attempts):
+            try:
+                return fn()
+            except ObjectStoreFullError:
+                if i == attempts - 1:
+                    raise
+                await asyncio.sleep(delay_s)
+
+    async def create_async(self, object_id: bytes,
+                           size: int) -> Tuple[str, int]:
+        return await self._with_full_retry(
+            lambda: self.create(object_id, size))
+
+    async def put_bytes_async(self, object_id: bytes,
+                              payload: bytes) -> None:
+        return await self._with_full_retry(
+            lambda: self.put_bytes(object_id, payload))
+
     def create(self, object_id: bytes, size: int) -> Tuple[str, int]:
         """Allocate space; returns (mmap path, offset-within-path)."""
         if object_id in self._entries:
@@ -135,9 +164,15 @@ class NodeObjectStore:
             # 2) Spill pinned primaries (LRU first) to disk.
             victim = self._arena.lru_pinned()
             if victim is None:
+                detail = ", ".join(
+                    f"{oid.hex()[:6]}(py sealed={e.sealed} "
+                    f"pinned={e.pinned} "
+                    f"spilled={e.spilled_path is not None} "
+                    f"C={self._arena.entry_flags(oid)})"
+                    for oid, e in list(self._entries.items())[:16])
                 raise ObjectStoreFullError(
                     f"need {size} bytes; arena exhausted and nothing "
-                    "spillable")
+                    f"spillable [{detail}]")
             self._spill_arena(victim)
             offset = self._arena.create(object_id, size)
         self.used = self._arena.stats()[1]
@@ -194,7 +229,12 @@ class NodeObjectStore:
             except asyncio.TimeoutError:
                 return None
         if entry.spilled_path is not None:
-            self._restore(entry)
+            # Re-check per attempt: a concurrent getter may restore this
+            # entry while we sleep (spilled_path goes None and the spill
+            # file is gone — calling _restore again would crash).
+            await self._with_full_retry(
+                lambda: (self._restore(entry)
+                         if entry.spilled_path is not None else None))
         entry.last_access = time.monotonic()
         if self._arena is not None:
             # refresh C-side LRU stamp
@@ -231,6 +271,11 @@ class NodeObjectStore:
     #    under an existing mmap, so the files backend needs none) ----------
     def addref_client(self, object_id: bytes) -> None:
         if self._arena is not None and object_id in self._entries:
+            if _DEBUG_ADDREF:
+                import sys
+                import traceback
+                sys.stderr.write(f"ADDREF {object_id.hex()[:6]}\n"
+                                 + "".join(traceback.format_stack()[-4:]))
             self._arena.addref(object_id, 1)
 
     def release_client(self, object_id: bytes) -> None:
@@ -410,11 +455,23 @@ def _client_arena_map(path: str) -> mmap.mmap:
 
 
 class MappedObject:
-    """A client-side zero-copy view of a sealed store object."""
+    """A client-side zero-copy view of a sealed store object.
 
-    __slots__ = ("_file", "_mmap", "_shared", "view")
+    Plasma client-buffer semantics: the mapping holds a store-side
+    client ref (the raylet will not spill/evict the extent under a live
+    reader); when the last deserialized value sharing the buffer dies,
+    ``close`` runs once and fires ``on_release`` so the worker tells the
+    raylet to drop that ref. Without this, every restored object stayed
+    reader-pinned forever and a small arena wedged with 'nothing
+    spillable'."""
 
-    def __init__(self, path: str, size: int, offset: int = 0):
+    __slots__ = ("_file", "_mmap", "_shared", "view", "on_release",
+                 "_released", "__weakref__")
+
+    def __init__(self, path: str, size: int, offset: int = 0,
+                 on_release=None):
+        self.on_release = on_release
+        self._released = False
         if offset or os.path.basename(path).endswith("arena"):
             self._shared = True
             self._file = None
@@ -442,6 +499,22 @@ class MappedObject:
                 self._file.close()
         except (BufferError, ValueError, OSError):
             pass
+        cb, self.on_release = self.on_release, None
+        if cb is not None and not self._released:
+            self._released = True
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def mark_released(self) -> None:
+        """The client ref is already being dropped elsewhere (bulk
+        release at shutdown): suppress the per-object callback."""
+        self._released = True
+        self.on_release = None
+
+    def __del__(self):
+        self.close()
 
 
 class WritableObject:

@@ -1023,7 +1023,7 @@ class Raylet:
 
     # ------------------------------------------------------------ object store
     async def _h_create_object(self, object_id, size):
-        path, offset = self.store.create(object_id, size)
+        path, offset = await self.store.create_async(object_id, size)
         return {"path": path, "offset": offset}
 
     async def _h_seal_object(self, object_id, pin=False):
@@ -1039,7 +1039,7 @@ class Raylet:
         single round trip — the client-side 3-RPC create/seal/pin dance
         dominated small-put latency (reference bar: ray_perf.py put suites).
         """
-        self.store.put_bytes(object_id, payload)
+        await self.store.put_bytes_async(object_id, payload)
         if pin:
             self.store.pin(object_id)
         return True
@@ -1096,7 +1096,7 @@ class Raylet:
             raise KeyError("remote object gone")
         size = info["size"]
         chunk = GlobalConfig.object_manager_chunk_size
-        self.store.create(object_id, size)
+        await self.store.create_async(object_id, size)
         for offset in range(0, size, chunk):
             data = await client.acall(
                 "read_chunk", object_id=object_id, offset=offset,

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -73,6 +74,13 @@ class ReferenceCounter:
                      None]] = None,
     ):
         self._refs: Dict[bytes, _Ref] = {}
+        # Freed-object tombstones: get() distinguishes "freed by owner"
+        # from "unknown" via is_freed, but keeping whole _Ref objects for
+        # every dead ref grows the heap without bound (a long suite run
+        # spent its time in multi-second GC pauses over millions of dead
+        # entries). Bounded id set instead.
+        self._freed_ids: "OrderedDict[bytes, None]" = OrderedDict()
+        self._freed_cap = 200_000
         # outer object id -> [(inner oid, inner owner addr or None=ours)]
         self._contained: Dict[bytes, List[Tuple[bytes, Optional[Tuple]]]] = {}
         self._lock = threading.RLock()
@@ -85,22 +93,33 @@ class ReferenceCounter:
         self._on_contained_free = on_contained_free
 
     # -- ref lifecycle ------------------------------------------------------
+    def _live(self, object_id: bytes) -> Optional[_Ref]:
+        """Entry for a NOT-freed object, creating if new. None when the
+        id is tombstoned — a late-arriving ref copy must never resurrect
+        a freed object (that would re-fire on_free and double-release)."""
+        if object_id in self._freed_ids:
+            return None
+        return self._refs.setdefault(object_id, _Ref())
+
     def add_owned(self, object_id: bytes) -> None:
         with self._lock:
-            self._refs.setdefault(object_id, _Ref())
+            self._live(object_id)
 
     def add_borrowed(self, object_id: bytes,
                      owner_addr: Optional[Tuple[str, int]] = None) -> None:
         with self._lock:
-            ref = self._refs.setdefault(object_id, _Ref())
+            ref = self._live(object_id)
+            if ref is None:
+                return
             ref.is_owned_by_us = False
             if owner_addr is not None:
                 ref.owner_addr = tuple(owner_addr)
 
     def add_local_ref(self, object_id: bytes) -> None:
         with self._lock:
-            ref = self._refs.setdefault(object_id, _Ref())
-            ref.local += 1
+            ref = self._live(object_id)
+            if ref is not None:
+                ref.local += 1
 
     def remove_local_ref(self, object_id: bytes) -> None:
         with self._lock:
@@ -112,8 +131,9 @@ class ReferenceCounter:
 
     def add_task_dependency(self, object_id: bytes) -> None:
         with self._lock:
-            ref = self._refs.setdefault(object_id, _Ref())
-            ref.task_deps += 1
+            ref = self._live(object_id)
+            if ref is not None:
+                ref.task_deps += 1
 
     def remove_task_dependency(self, object_id: bytes) -> None:
         with self._lock:
@@ -128,8 +148,9 @@ class ReferenceCounter:
         """The ref was serialized out: pin until a recipient registers as
         a borrower or the TTL sweep expires the share."""
         with self._lock:
-            ref = self._refs.setdefault(object_id, _Ref())
-            ref.pending_shares.append(time.monotonic())
+            ref = self._live(object_id)
+            if ref is not None:
+                ref.pending_shares.append(time.monotonic())
 
     # Compatibility alias (round-3 name, thin-client path).
     mark_shared = add_pending_share
@@ -206,8 +227,9 @@ class ReferenceCounter:
     # -- directory ----------------------------------------------------------
     def add_location(self, object_id: bytes, node_id: bytes) -> None:
         with self._lock:
-            ref = self._refs.setdefault(object_id, _Ref())
-            ref.locations.add(node_id)
+            ref = self._live(object_id)
+            if ref is not None:
+                ref.locations.add(node_id)
 
     def remove_location(self, object_id: bytes, node_id: bytes) -> None:
         with self._lock:
@@ -228,6 +250,8 @@ class ReferenceCounter:
 
     def is_freed(self, object_id: bytes) -> bool:
         with self._lock:
+            if object_id in self._freed_ids:
+                return True
             ref = self._refs.get(object_id)
             return ref is not None and ref.freed
 
@@ -259,10 +283,9 @@ class ReferenceCounter:
                 or ref.borrowers or ref.freed):
             return
         if ref.is_owned_by_us:
-            ref.freed = True
             locations = set(ref.locations)
-            ref.locations.clear()
             contained = self._contained.pop(object_id, None)
+            self._tombstone(object_id)
             if self._on_free is not None:
                 self._on_free(object_id, locations)
             if contained and self._on_contained_free is not None:
@@ -290,16 +313,30 @@ class ReferenceCounter:
                     out.append((oid, ref.owner_addr))
         return out
 
+    def _tombstone(self, object_id: bytes) -> None:
+        """Caller holds the lock: drop the _Ref, remember just the id."""
+        self._refs.pop(object_id, None)
+        self._freed_ids[object_id] = None
+        while len(self._freed_ids) > self._freed_cap:
+            self._freed_ids.popitem(last=False)
+
+    def clear(self) -> None:
+        """Worker shutdown: release the whole graph promptly (GC over a
+        dead worker's millions of entries otherwise dominates teardown)."""
+        with self._lock:
+            self._refs.clear()
+            self._contained.clear()
+            self._freed_ids.clear()
+
     def force_free(self, object_id: bytes) -> None:
         """Explicit free (`ray_tpu.internal.free`) regardless of counts."""
         with self._lock:
             ref = self._refs.get(object_id)
             if ref is None or ref.freed:
                 return
-            ref.freed = True
             locations = set(ref.locations)
-            ref.locations.clear()
             contained = self._contained.pop(object_id, None)
+            self._tombstone(object_id)
             if self._on_free is not None:
                 self._on_free(object_id, locations)
             if contained and self._on_contained_free is not None:

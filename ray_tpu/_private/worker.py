@@ -19,6 +19,7 @@ import sys
 import threading
 import time
 from collections import defaultdict, deque
+from functools import partial
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -298,7 +299,15 @@ class Worker:
         self.actor_handles = ActorHandleTracker(self)
         self._objects: Dict[bytes, _PendingObject] = {}
         self._objects_lock = threading.Lock()
-        self._mapped: Dict[bytes, MappedObject] = {}
+        # Weak cache of client mappings: entries vanish when the last
+        # deserialized value sharing the buffer dies, firing the
+        # mapping's release callback so the raylet drops its client ref
+        # (plasma buffer-release semantics — a strong cache kept every
+        # read object reader-pinned forever and wedged small arenas).
+        import weakref
+
+        self._mapped: "weakref.WeakValueDictionary[bytes, MappedObject]" = \
+            weakref.WeakValueDictionary()
 
         # counters
         self._put_counter = _IndexCounter()
@@ -484,11 +493,21 @@ class Worker:
             wobj.close()
         self.raylet.call("seal_object", object_id=oid, pin=True)
 
+    def _release_mapping(self, oid: bytes) -> None:
+        """MappedObject release callback: the last value view died."""
+        if self._dead:
+            return
+        try:
+            self.io.submit(self.raylet.acall(
+                "release_object", object_id=oid,
+                client_id=self.worker_id.binary(), timeout=5))
+        except Exception:
+            pass
+
     def _plasma_get(self, oid: bytes, timeout: Optional[float],
                     locations: Sequence[bytes]) -> Any:
-        if oid in self._mapped:
-            mobj = self._mapped[oid]
-        else:
+        mobj = self._mapped.get(oid)
+        if mobj is None:
             reply = self.raylet.call("get_object", object_id=oid,
                                      wait_timeout=timeout,
                                      locations=list(locations),
@@ -497,7 +516,9 @@ class Worker:
                 raise exc.ObjectLostError(
                     f"object {oid.hex()} not found in the cluster")
             mobj = MappedObject(reply["path"], reply["size"],
-                                reply.get("offset", 0))
+                                reply.get("offset", 0),
+                                on_release=partial(
+                                    self._release_mapping, oid))
             self._mapped[oid] = mobj
         return self.serialization.deserialize(mobj.view, keepalive=mobj)
 
@@ -662,13 +683,7 @@ class Worker:
         """ReferenceCounter callback — remove the value everywhere."""
         with self._objects_lock:
             self._objects.pop(oid, None)
-        if oid in self._mapped and not self._dead:
-            try:
-                self.io.submit(self.raylet.acall(
-                    "release_object", object_id=oid,
-                    client_id=self.worker_id.binary(), timeout=5))
-            except Exception:
-                pass
+
         tid = bytes(oid[:TaskID.SIZE])
         live = self._lineage_live.get(tid)
         if live is not None:
@@ -679,7 +694,7 @@ class Worker:
                 self._lineage_live[tid] = live
         mobj = self._mapped.pop(oid, None)
         if mobj is not None:
-            mobj.close()
+            mobj.close()  # fires the release callback exactly once
         if self._dead:
             return
         if not locations and mobj is None:
@@ -1999,6 +2014,7 @@ class Worker:
     async def _h_delete_object_notification(self, object_id):
         mobj = self._mapped.pop(object_id, None)
         if mobj is not None:
+            mobj.mark_released()  # the explicit release below covers it
             mobj.close()
             try:
                 await self.raylet.acall(
@@ -2481,10 +2497,12 @@ class Worker:
             _metrics.flush()
         except Exception:
             pass
-        if self._mapped:
+        if len(self._mapped):
             try:
+                for mobj in list(self._mapped.values()):
+                    mobj.mark_released()  # bulk release below covers them
                 self.raylet.call("release_objects",
-                                 object_ids=list(self._mapped),
+                                 object_ids=list(self._mapped.keys()),
                                  client_id=self.worker_id.binary(),
                                  timeout=5)
             except Exception:
@@ -2502,6 +2520,13 @@ class Worker:
                     pass
         self._lease_pool.clear()
         self._dead = True
+        # Drop the whole ref graph now: a long-lived driver accumulates
+        # millions of counter entries and GC over them after the worker
+        # object dies dominates interpreter time.
+        try:
+            self.reference_counter.clear()
+        except Exception:
+            pass
         for b in self._actor_batchers.values():
             if b.task is not None:
                 try:

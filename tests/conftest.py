@@ -131,6 +131,12 @@ def _fresh_cluster_per_module():
         except Exception:
             pass
     yield
+    # Compact the heap at module boundaries: without this, gen2 grows
+    # across ~40 modules and late modules spend their per-test budget in
+    # multi-second GC pauses (observed at the serve module, test ~270).
+    import gc
+
+    gc.collect()
 
 
 @pytest.fixture(scope="module")

@@ -113,3 +113,22 @@ def test_node_store_full_when_everything_referenced(tmp_path):
     with pytest.raises(ObjectStoreFullError):
         store.create(oid(1000), 60 * 1024)
     store.cleanup()
+
+
+def test_sanitizer_harness_builds_and_passes():
+    """ASan+UBSan over the full store ABI from 4 threads (reference
+    analogue: the sanitizer CI jobs over plasma). Compiles the harness
+    fresh so the sanitized build is exercised, not the cached .so."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        import pytest as _pytest
+
+        _pytest.skip("no g++ in this environment")
+    native = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    out = subprocess.run(["make", "sanitize"], cwd=native,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    assert "SANITIZE-OK" in out.stdout

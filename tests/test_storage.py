@@ -110,3 +110,34 @@ def test_trainer_syncs_checkpoints_to_storage(tmp_path):
         assert len({d.name for d in trial_dirs}) == 2, trial_dirs
     finally:
         ray_tpu.shutdown()
+
+
+def test_put_pressure_spill_restore_roundtrip(tmp_path):
+    """12x8MB puts into a 32MB arena force spills; every object must
+    still be readable (restore spills newer primaries to make room).
+    Runs in a subprocess driver so the tiny store doesn't affect other
+    tests. Regression guard for a flaky 'arena exhausted and nothing
+    spillable' seen on this exact pattern."""
+    import os
+    import subprocess
+    import sys
+
+    script = tmp_path / "spill_driver.py"
+    script.write_text(
+        "import numpy as np\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=2, object_store_memory=32*1024*1024)\n"
+        "refs = [ray_tpu.put(np.full((1024, 1024), float(i)))\n"
+        "        for i in range(12)]\n"
+        "for i, r in enumerate(refs):\n"
+        "    v = ray_tpu.get(r, timeout=120)\n"
+        "    assert float(v[0, 0]) == float(i), (i, v[0, 0])\n"
+        "print('SPILL-ROUNDTRIP-OK')\n"
+        "ray_tpu.shutdown()\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                          "PYTHONPATH": repo})
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "SPILL-ROUNDTRIP-OK" in proc.stdout
