@@ -623,7 +623,33 @@ class GcsServer:
         spec = a["spec"]
         delay = 0.05
         deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_ms / 1000
-        while time.monotonic() < deadline:
+        while True:
+            if a["state"] == DEAD:
+                # kill() (or a node-death handler) resolved this actor
+                # while it was pending — stop scheduling; never lease a
+                # worker for a dead actor.
+                return
+            if time.monotonic() >= deadline:
+                # Reference semantics: a FEASIBLE actor queues until
+                # resources/worker slots free up (a 500-actor burst takes
+                # minutes of worker spawns on a small host — that is
+                # backlog, not failure). Only die when no node could ever
+                # fit the demand.
+                from ray_tpu._private.scheduling_policy import (
+                    is_feasible_anywhere,
+                )
+
+                if spec.scheduling.kind == "PLACEMENT_GROUP":
+                    pg = self.placement_groups.get(
+                        spec.scheduling.placement_group_id)
+                    if pg is None or pg.get("state") == "REMOVED":
+                        break  # the PG is gone: this can never schedule
+                if is_feasible_anywhere(self.view, spec.resources):
+                    deadline = (time.monotonic()
+                                + GlobalConfig.worker_lease_timeout_ms
+                                / 1000)
+                else:
+                    break
             pg_res = None
             if spec.scheduling.kind == "PLACEMENT_GROUP":
                 pg_res = self._pg_demand(spec.scheduling, spec.resources)

@@ -55,6 +55,9 @@ class _WorkerHandle:
         self.is_actor = False
         self.actor_id: Optional[bytes] = None
         self.last_idle = time.monotonic()
+        # Set when the worker registers (or dies before registering) —
+        # the spawn throttle waits on this instead of polling.
+        self.registered = asyncio.Event()
 
 
 class Raylet:
@@ -303,7 +306,26 @@ class Raylet:
         """Fork/exec OFF the event loop: Popen of this jax-preloaded
         process takes ~100ms+, and a replenish burst of spawns on the
         loop thread stalls heartbeats long enough for the GCS to declare
-        this node dead (observed: actor churn → 5s+ gap → node DEAD)."""
+        this node dead (observed: actor churn → 5s+ gap → node DEAD).
+
+        Startup concurrency is throttled per node (reference:
+        maximum_startup_concurrency = num_cpus): an unthrottled 500-actor
+        burst boots hundreds of Python processes at once, starving every
+        daemon's heartbeat on a small host — nodes get declared dead at
+        exactly the moment they're busiest."""
+        await self._spawn_worker_throttled(job_id, runtime_env, pool_key)
+
+    def _startup_sema(self) -> asyncio.Semaphore:
+        if not hasattr(self, "_spawn_sema"):
+            from ray_tpu._private.resources import CPU as _CPU
+
+            self._spawn_sema = asyncio.Semaphore(
+                max(2, int(self.local.total.get(_CPU) or 2)))
+        return self._spawn_sema
+
+    async def _spawn_worker_throttled(self, job_id: bytes,
+                                      runtime_env: Optional[Dict[str, Any]],
+                                      pool_key: bytes) -> None:
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         worker_id = WorkerID.from_random()
@@ -378,43 +400,58 @@ class Raylet:
                "--job-id", job_id.hex(),
                "--session-dir", self.session_dir]
         loop = asyncio.get_running_loop()
-        try:
-            proc = await loop.run_in_executor(
-                None, lambda: subprocess.Popen(
-                    cmd, stdout=out, stderr=subprocess.STDOUT, env=env,
-                    start_new_session=True))
-        except Exception as e:
-            out.close()
-            self._starting[pool_key] = max(0, self._starting[pool_key] - 1)
-            sys.stderr.write(f"[raylet] worker spawn failed: {e}\n")
-            if env_uris:
-                # setup() took cache refs for this worker; give them back
-                # or the venv/package can never be garbage-collected.
-                try:
-                    self._runtime_env_manager().release(env_uris)
-                except Exception:
-                    pass
-            # Fail one parked lease waiter fast instead of letting it ride
-            # out the full pop timeout (pre-async-spawn, Popen errors
-            # propagated synchronously into the lease handler).
-            waiters = self._pending_pop[pool_key]
-            while waiters:
-                fut = waiters.popleft()
-                if not fut.done():
-                    fut.set_result(None)
-                    break
-            return
-        # Handle is completed when the worker registers back.
-        handle = _WorkerHandle(worker_id.binary(), proc, ("", 0), job_id,
-                               pool_key=pool_key, runtime_env=runtime_env)
-        handle.env_uris = env_uris
-        self.workers[worker_id.binary()] = handle
+        # The concurrency slot covers ONLY fork + interpreter boot — not
+        # runtime_env setup above (a cold pip install holding a slot
+        # would head-of-line block every plain spawn on the node).
+        async with self._startup_sema():
+            try:
+                proc = await loop.run_in_executor(
+                    None, lambda: subprocess.Popen(
+                        cmd, stdout=out, stderr=subprocess.STDOUT, env=env,
+                        start_new_session=True))
+            except Exception as e:
+                return self._spawn_failed(e, out, pool_key, env_uris)
+            # Handle is completed when the worker registers back.
+            handle = _WorkerHandle(worker_id.binary(), proc, ("", 0),
+                                   job_id, pool_key=pool_key,
+                                   runtime_env=runtime_env)
+            handle.env_uris = env_uris
+            self.workers[worker_id.binary()] = handle
+            # Hold the startup-concurrency slot until the worker
+            # REGISTERS: the expensive part of a spawn is the Python
+            # boot, not the fork. Bounded so a crashed boot frees the
+            # slot (the reaper also sets the event on death).
+            try:
+                await asyncio.wait_for(handle.registered.wait(), 30)
+            except asyncio.TimeoutError:
+                pass
+        return None
+
+    def _spawn_failed(self, e, out, pool_key, env_uris) -> None:
+        """Popen failure cleanup: undo the _starting slot, return env
+        cache refs, and fail one parked lease waiter fast instead of
+        letting it ride out the full pop timeout."""
+        out.close()
+        self._starting[pool_key] = max(0, self._starting[pool_key] - 1)
+        sys.stderr.write(f"[raylet] worker spawn failed: {e}\n")
+        if env_uris:
+            try:
+                self._runtime_env_manager().release(env_uris)
+            except Exception:
+                pass
+        waiters = self._pending_pop[pool_key]
+        while waiters:
+            fut = waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                break
 
     async def _h_register_worker(self, worker_id, port, pid, job_id):
         handle = self.workers.get(worker_id)
         if handle is None:
             return {"ok": False}
         handle.addr = (self.host, port)
+        handle.registered.set()
         key = handle.pool_key
         self._env_failures.pop(key, None)
         self._starting[key] = max(0, self._starting[key] - 1)
@@ -452,7 +489,8 @@ class Raylet:
 
     async def _pop_worker(self, job_id: bytes,
                           runtime_env: Optional[Dict[str, Any]] = None,
-                          timeout: float = 60.0
+                          timeout: float = 60.0,
+                          dedicated: bool = False
                           ) -> Optional[_WorkerHandle]:
         pool_key = self._pool_key(job_id, runtime_env)
         idle = self._idle[pool_key]
@@ -470,7 +508,12 @@ class Raylet:
                      if w.job_id == job_id)
         n_live += sum(v for k, v in self._starting.items()
                       if k[:len(job_id)] == job_id)
-        if n_live < self._max_workers:
+        if dedicated or n_live < self._max_workers:
+            # Dedicated (actor) workers are admission-controlled by the
+            # resource allocation that already succeeded, not by the
+            # pooled-task-worker cap: 500 fractional-CPU actors on a
+            # 2-CPU node are legal, and capping them at CPU*4 workers
+            # wedges every actor past the cap in PENDING_CREATION.
             # Python worker cold-start is expensive; prestart a batch on first
             # demand so bursts don't serialize on process spawn (reference:
             # worker pool prestart, `worker_pool.cc`).
@@ -495,6 +538,7 @@ class Raylet:
                 code = handle.proc.poll()
                 if code is None:
                     continue
+                handle.registered.set()  # frees the spawn-throttle slot
                 self.workers.pop(worker_id, None)
                 self._release_worker_env(handle)
                 if handle.addr == ("", 0):
@@ -988,7 +1032,8 @@ class Raylet:
             return {"ok": False, "reason": "resources busy"}
         tpu_ids = self._take_tpu_chips(demand_rs)
         handle = await self._pop_worker(spec.job_id.binary(),
-                                        getattr(spec, "runtime_env", None))
+                                        getattr(spec, "runtime_env", None),
+                                        dedicated=True)
         if handle is None:
             self.local.release(demand_rs)
             self._release_tpu_chips(demand_rs, tpu_ids)

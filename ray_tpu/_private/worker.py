@@ -1747,45 +1747,82 @@ class Worker:
                         if not fut.done():
                             fut.set_exception(_ActorAddrUnavailable())
                     continue
-                client = self._client_for(addr)
                 seqs = []
                 for _ in batch:
                     seqs.append(self._actor_seq[actor_id])
                     self._actor_seq[actor_id] += 1
-                if len(batch) == 1:
-                    coro = client.acall(
-                        "push_actor_task", spec=batch[0][0], seq=seqs[0],
-                        caller_id=self.worker_id.binary())
-                else:
-                    coro = client.acall(
-                        "push_actor_tasks", specs=[s for s, _ in batch],
-                        seqs=seqs, caller_id=self.worker_id.binary())
                 # Pipelined: the next batch is framed while this one's reply
                 # is in flight; the worker starts tasks in frame order and
                 # the seq machinery keeps per-caller FIFO.
                 asyncio.ensure_future(self._deliver_actor_batch(
-                    actor_id, batch, coro, batched=len(batch) > 1))
+                    actor_id, batch, seqs, addr))
 
-    async def _deliver_actor_batch(self, actor_id, batch, coro, batched):
-        try:
-            reply = await coro
-        except (ConnectionLost, OSError) as e:
-            self._actor_addr_cache.pop(actor_id, None)
-            for _, fut in batch:
+    async def _deliver_actor_batch(self, actor_id, batch, seqs, addr):
+        """Send one framed batch, resending the SAME sequence numbers on
+        transient connection failures while the actor process is alive
+        with an unchanged incarnation. Two reasons this retry must live
+        HERE: (a) a connect blip to a live actor is a network event, not
+        an actor death — callers with max_task_retries=0 must not see
+        ActorDiedError for it; (b) seqs are burned at assignment, and a
+        dropped frame would leave a permanent gap that wedges the
+        worker's in-order start queue for every later call from this
+        caller."""
+        batched = len(batch) > 1
+        prev_inc = self._actor_incarnation.get(actor_id, 0)
+        last_exc: Optional[BaseException] = None
+        for attempt in range(6):
+            if addr is None:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(_ActorAddrUnavailable())
+                return
+            client = self._client_for(addr)
+            try:
+                if batched:
+                    reply = await client.acall(
+                        "push_actor_tasks", specs=[s for s, _ in batch],
+                        seqs=seqs, caller_id=self.worker_id.binary())
+                else:
+                    reply = await client.acall(
+                        "push_actor_task", spec=batch[0][0], seq=seqs[0],
+                        caller_id=self.worker_id.binary())
+            except (ConnectionLost, OSError) as e:
+                last_exc = ConnectionLost(str(e))
+                self._actor_addr_cache.pop(actor_id, None)
+                try:
+                    info = await self.gcs.acall(
+                        "get_actor_info", actor_id=actor_id, timeout=30)
+                except Exception:
+                    info = None
+                if (info and info.get("state") == "ALIVE"
+                        and info.get("restarts_used", 0) == prev_inc
+                        and attempt < 5):
+                    # Same process, still alive: resend the same frame
+                    # (the worker dedups seqs it already started).
+                    await asyncio.sleep(0.2 * (attempt + 1))
+                    addr = tuple(info["addr"]) if info.get("addr") else \
+                        await self._actor_addr(actor_id)
+                    continue
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(ConnectionLost(str(e)))
+                return
+            except Exception as e:  # noqa: BLE001 — RpcError etc.: a
+                # fire-and-forget task swallowing this would leave every
+                # caller future pending forever; fail the calls instead.
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                return
+            replies = reply if batched else [reply]
+            for (spec, fut), r in zip(batch, replies):
                 if not fut.done():
-                    fut.set_exception(ConnectionLost(str(e)))
+                    fut.set_result(r)
             return
-        except Exception as e:  # noqa: BLE001 — RpcError etc.: a
-            # fire-and-forget task swallowing this would leave every
-            # caller future pending forever; fail the calls instead.
-            for _, fut in batch:
-                if not fut.done():
-                    fut.set_exception(e)
-            return
-        replies = reply if batched else [reply]
-        for (spec, fut), r in zip(batch, replies):
+        for _, fut in batch:
             if not fut.done():
-                fut.set_result(r)
+                fut.set_exception(last_exc
+                                  or ConnectionLost("actor send failed"))
 
     async def _run_actor_task(self, spec: TaskSpec) -> None:
         self.actor_handles.task_submitted(spec.actor_id.binary())
@@ -1869,13 +1906,21 @@ class Worker:
         addr = self._actor_addr_cache.get(actor_id)
         if addr is not None:
             return addr
-        reply = await self.gcs.acall("wait_actor_ready", actor_id=actor_id,
-                                     wait_timeout=115.0, timeout=120.0)
-        if reply.get("state") == "ALIVE":
-            addr = tuple(reply["addr"])
-            self._actor_addr_cache[actor_id] = addr
-            return addr
-        return None
+        while True:
+            reply = await self.gcs.acall("wait_actor_ready",
+                                         actor_id=actor_id,
+                                         wait_timeout=55.0, timeout=60.0)
+            state = reply.get("state")
+            if state == "ALIVE":
+                addr = tuple(reply["addr"])
+                self._actor_addr_cache[actor_id] = addr
+                return addr
+            if state == "DEAD" or reply.get("error") == "unknown actor":
+                return None
+            # PENDING_CREATION / RESTARTING / long-poll window expired:
+            # creation backlog (e.g. a 500-actor burst waiting on worker
+            # spawns) is not death — calls to a pending actor block until
+            # it comes up, as the reference's direct actor transport does.
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
         self.gcs.call("kill_actor", actor_id=actor_id, no_restart=no_restart)

@@ -88,7 +88,10 @@ def main():
               f"{results['queued_drain_per_s']}/s", flush=True)
 
     # ---- phase 3: actors ------------------------------------------------
-    @ray_tpu.remote
+    # Fractional CPUs: the envelope measures actor COUNT and call
+    # throughput, not CPU capacity — 500 one-CPU actors can't fit a
+    # 16-CPU test host (they'd queue forever).
+    @ray_tpu.remote(num_cpus=0.02)
     class Echo:
         def ping(self, x=0):
             return x
