@@ -52,11 +52,22 @@ def _bench_config(on_tpu: bool):
         # full per-layer remat by ~2.5 MFU points at the same batch 16
         # (full remat at batch 20/24 is slower than dots at 16 — see
         # PERF.md round-2 sweep).
+        import os
+
+        # At this geometry (V=32k, D=4096) the fused blockwise loss is a
+        # measured net LOSS (64.3% vs 69.2% MFU): its backward recompute
+        # of block logits costs ~4.5% extra FLOPs to save only ~3GB of
+        # loss-stage HBM traffic, and batch 16 fits without it. It exists
+        # for geometries where logits don't fit (128k vocab, long seq) —
+        # see PERF.md round-4 notes.
+        os.environ.setdefault("RAY_TPU_FUSED_LOSS", "0")
+        batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", "16"))
+        steps = int(os.environ.get("RAY_TPU_BENCH_STEPS", "4"))
         return LlamaConfig(
             vocab_size=32000, dim=4096, n_layers=4, n_heads=32,
             n_kv_heads=8, hidden_dim=11008, max_seq_len=1024,
             attn_impl="flash", remat="dots",
-            param_dtype=jnp.bfloat16), 16, 1024, 4
+            param_dtype=jnp.bfloat16), batch, 1024, steps
     return LlamaConfig.tiny(), 4, 64, 2
 
 
