@@ -131,12 +131,24 @@ def _fresh_cluster_per_module():
         except Exception:
             pass
     yield
-    # Compact the heap at module boundaries: without this, gen2 grows
-    # across ~40 modules and late modules spend their per-test budget in
-    # multi-second GC pauses (observed at the serve module, test ~270).
+    # Heap discipline at module boundaries. Without this, gen2 grows
+    # across ~40 modules (pytest report caches, jax compilation caches —
+    # ~2GB RSS by test ~280) and full collections take seconds EACH,
+    # firing every ~70k allocations: late modules (observed: the serve
+    # retry loops) burn their entire 180s budgets inside GC pauses.
+    # collect() drains what's actually dead, then freeze() moves every
+    # survivor out of the collector's working set so later collections
+    # only scan objects created since — survivors were effectively
+    # immortal anyway.
     import gc
 
+    # unfreeze-collect-freeze: previously frozen entries that a later
+    # module turned into cyclic garbage (evicted cache entries) get one
+    # reclaim pass per module; survivors go back to the permanent
+    # generation where per-test collections never rescan them.
+    gc.unfreeze()
     gc.collect()
+    gc.freeze()
 
 
 @pytest.fixture(scope="module")
