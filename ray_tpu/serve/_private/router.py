@@ -34,6 +34,15 @@ class Router:
         self._router_id = uuid.uuid4().hex[:12]
         self._push_thread_started = False
         self._closed = False
+        # The worker this router was born under: background threads must
+        # die with it. Without this, every serve handle ever created
+        # leaked a forever-polling daemon thread that kept hammering a
+        # dead controller (and the ref-counter lock) for the rest of the
+        # PROCESS — dozens of zombie pollers ground long test sessions
+        # to a halt two modules later.
+        from ray_tpu._private.worker import global_worker_or_none
+
+        self._worker = global_worker_or_none()
         # Synchronous first snapshot, then the long-poll keeps it fresh.
         self._apply(*ray_tpu.get(
             self._controller.get_replicas.remote(app_name, deployment_name),
@@ -53,8 +62,15 @@ class Router:
             else:
                 self._have_replicas.clear()
 
+    def _alive(self) -> bool:
+        from ray_tpu._private.worker import global_worker_or_none
+
+        w = global_worker_or_none()
+        return (not self._closed and w is not None and w is self._worker
+                and not getattr(w, "_dead", False))
+
     def _poll_loop(self) -> None:
-        while not self._closed:
+        while self._alive():
             try:
                 version, replicas = ray_tpu.get(
                     self._controller.poll_replicas.remote(
@@ -62,7 +78,7 @@ class Router:
                     timeout=60)
                 self._apply(version, replicas)
             except Exception:
-                if self._closed:
+                if not self._alive():
                     return
                 time.sleep(1.0)
 
@@ -76,7 +92,7 @@ class Router:
         self._push_thread_started = True
 
         def run():
-            while True:
+            while self._alive():
                 time.sleep(2.0)
                 with self._lock:
                     total = sum(self._inflight.values())
