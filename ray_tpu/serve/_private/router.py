@@ -87,9 +87,10 @@ class Router:
         thread (not push-on-assign) keeps reports fresh while long
         requests run with no new arrivals — otherwise the controller sees
         stale-then-zero load and downscales mid-traffic."""
-        if self._push_thread_started:
-            return
-        self._push_thread_started = True
+        with self._lock:
+            if self._push_thread_started:
+                return
+            self._push_thread_started = True
 
         def run():
             while self._alive():
@@ -105,9 +106,21 @@ class Router:
         threading.Thread(target=run, daemon=True,
                          name="serve-metrics-push").start()
 
+    def close(self) -> None:
+        """Stop the background threads; the router routes no further
+        requests. Safe to call more than once."""
+        self._closed = True
+
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
                        model_id: str = "", stream: bool = False):
         """Returns an ObjectRef (or ObjectRefGenerator when streaming)."""
+        if not self._alive():
+            # A handle that outlived its worker would otherwise route on
+            # a frozen replica snapshot from the dead cluster.
+            raise RuntimeError(
+                f"router for {self._app}/{self._deployment} is detached "
+                "(its cluster connection was shut down); recreate the "
+                "handle after ray_tpu.init()")
         if not self._have_replicas.wait(timeout=30.0):
             raise RuntimeError(
                 f"no live replicas for {self._app}/{self._deployment}")
