@@ -227,8 +227,10 @@ def shutdown() -> None:
                 # the next init(ignore_reinit_error=True) silently reuses
                 # a half-dead cluster (observed as cross-module test
                 # leakage: later suites inherited a stale session).
-                from ray_tpu._private.worker import set_global_worker
-
+                # NOTE: uses the module-level import — a local import here
+                # would shadow `set_global_worker` for the WHOLE function
+                # and break the thin-client branch above with
+                # UnboundLocalError.
                 set_global_worker(None)
         if _local_node is not None:
             try:
