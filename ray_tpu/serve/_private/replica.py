@@ -66,5 +66,16 @@ class Replica:
         return {"ongoing": self._num_ongoing, "served": self._num_served}
 
     def prepare_shutdown(self) -> bool:
-        hook = getattr(self._callable, "__del__", None)
+        """User teardown hook before the controller kills this replica.
+        Draining happens CALLER-side (controller._drain_and_kill polls
+        stats until ongoing==0) — a replica-side wait would share the
+        max_concurrency pool with handle_request and so could never run
+        exactly when the replica is saturated."""
+        if not self._is_function:
+            hook = getattr(self._callable, "__del__", None)
+            if hook is not None:
+                try:
+                    hook()
+                except Exception:
+                    pass
         return True
