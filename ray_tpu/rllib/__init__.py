@@ -10,14 +10,23 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.multi_rl_module import (MultiRLModule,
+                                                MultiRLModuleSpec)
 from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
+from ray_tpu.rllib.env.multi_agent_env import (MultiAgentCartPole,
+                                               MultiAgentEnv,
+                                               RockPaperScissors)
 
 __all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "DQN", "DQNConfig",
-           "IMPALA", "IMPALAConfig", "PPO", "PPOConfig", "SAC", "SACConfig",
-           "LearnerGroup", "MLPModule", "RLModuleSpec"]
+           "IMPALA", "IMPALAConfig", "MARWIL", "MARWILConfig",
+           "PPO", "PPOConfig", "SAC", "SACConfig",
+           "LearnerGroup", "MLPModule", "RLModuleSpec",
+           "MultiRLModule", "MultiRLModuleSpec", "MultiAgentEnv",
+           "MultiAgentCartPole", "RockPaperScissors"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 

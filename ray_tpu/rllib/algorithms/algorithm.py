@@ -32,10 +32,18 @@ class AlgorithmConfig:
         self.num_learners = 1
         self.jax_platform: Optional[str] = None
         self.module_hidden = (64, 64)
+        # Extra catalog model_config (conv_filters etc.,
+        # `models/catalog.py`); None -> defaults.
+        self.model_config = None
         self.seed = 0
         # Episode-return smoothing window (reference:
         # metrics_num_episodes_for_smoothing).
         self.metrics_episode_window = 100
+        # Multi-agent (reference: algorithm_config.multi_agent()).
+        # policies: {module_id: RLModuleSpec | None} — None means "probe
+        # spaces from an agent mapped to this module".
+        self.policies = None
+        self.policy_mapping_fn = None
 
     # fluent builder sections (reference algorithm_config.py style)
     def environment(self, env) -> "AlgorithmConfig":
@@ -65,9 +73,28 @@ class AlgorithmConfig:
             self.jax_platform = jax_platform
         return self
 
-    def rl_module(self, hidden=None) -> "AlgorithmConfig":
+    def multi_agent(self, policies=None,
+                    policy_mapping_fn=None) -> "AlgorithmConfig":
+        """Reference: `algorithm_config.py` AlgorithmConfig.multi_agent().
+        `policies` may be a dict {module_id: RLModuleSpec|None} or an
+        iterable of module ids; `policy_mapping_fn(agent_id) -> module_id`
+        must be picklable (top-level function / functools.partial)."""
+        if policies is not None:
+            if isinstance(policies, str):
+                policies = [policies]
+            if not isinstance(policies, dict):
+                policies = {mid: None for mid in policies}
+            self.policies = policies
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def rl_module(self, hidden=None,
+                  model_config=None) -> "AlgorithmConfig":
         if hidden is not None:
             self.module_hidden = tuple(hidden)
+        if model_config is not None:
+            self.model_config = dict(model_config)
         return self
 
     def copy(self) -> "AlgorithmConfig":
@@ -84,32 +111,92 @@ class Algorithm:
     """
 
     learner_class = None
+    ma_learner_class = None   # multi-agent learner (None -> unsupported)
     rl_module_class = None    # None -> default actor-critic MLP
 
     def __init__(self, config: AlgorithmConfig):
         from ray_tpu.rllib.core.learner_group import LearnerGroup
 
         self.config = config
+        self.multi_agent = config.policies is not None
         probe_env = make_env(config.env)
-        self.module_spec = RLModuleSpec(
-            observation_space=probe_env.observation_space,
-            action_space=probe_env.action_space,
-            hidden=config.module_hidden,
-            module_class=self.rl_module_class)
-        self.env_runners = [
-            EnvRunner.remote(config.env, self.module_spec,
-                             num_envs=config.num_envs_per_runner,
-                             seed=config.seed + i)
-            for i in range(config.num_env_runners)
-        ]
+        learner_class = self.learner_class
+        if self.multi_agent:
+            from ray_tpu.rllib.core.multi_rl_module import (
+                MultiRLModuleSpec, default_policy_mapping_fn)
+            from ray_tpu.rllib.env.multi_agent_env_runner import (
+                MultiAgentEnvRunner)
+
+            if self.ma_learner_class is None:
+                raise ValueError(
+                    f"{type(self).__name__} has no multi-agent learner")
+            mapping = config.policy_mapping_fn or default_policy_mapping_fn
+            specs = {}
+            for mid, spec in config.policies.items():
+                if spec is None:
+                    # Probe spaces from any agent routed to this module.
+                    agent = next(
+                        (a for a in probe_env.possible_agents
+                         if mapping(a) == mid), None)
+                    if agent is None:
+                        raise ValueError(
+                            f"policy '{mid}' has no RLModuleSpec and "
+                            f"policy_mapping_fn maps no agent of "
+                            f"{probe_env.possible_agents} to it")
+                    spec = self._default_module_spec(
+                        probe_env.get_observation_space(agent),
+                        probe_env.get_action_space(agent))
+                specs[mid] = spec
+            for a in probe_env.possible_agents:
+                if mapping(a) not in specs:
+                    raise ValueError(
+                        f"policy_mapping_fn routes agent '{a}' to "
+                        f"'{mapping(a)}', which is not in "
+                        f"policies={sorted(specs)}")
+            self.module_spec = MultiRLModuleSpec(specs)
+            self.env_runners = [
+                MultiAgentEnvRunner.remote(
+                    config.env, self.module_spec,
+                    policy_mapping_fn=config.policy_mapping_fn,
+                    num_envs=config.num_envs_per_runner,
+                    seed=config.seed + i)
+                for i in range(config.num_env_runners)
+            ]
+            learner_class = self.ma_learner_class
+        else:
+            self.module_spec = self._default_module_spec(
+                probe_env.observation_space, probe_env.action_space)
+            self.env_runners = [
+                EnvRunner.remote(config.env, self.module_spec,
+                                 num_envs=config.num_envs_per_runner,
+                                 seed=config.seed + i)
+                for i in range(config.num_env_runners)
+            ]
         self.learner_group = LearnerGroup(
-            self.learner_class, self.module_spec,
+            learner_class, self.module_spec,
             learner_config=self._learner_config(),
             scaling_config=ScalingConfig(num_workers=config.num_learners),
             jax_config=JaxConfig(platform=config.jax_platform))
         self._iteration = 0
         self._recent_returns: List[float] = []
+        self._agent_returns: Dict[str, List[float]] = {}
         self._sync_weights()
+
+    def _default_module_spec(self, obs_space, act_space) -> RLModuleSpec:
+        """Algorithms with a fixed module keep it (DQN's QModule, SAC's
+        SACModule); otherwise the catalog picks by spaces (MLP / CNN /
+        Gaussian — `models/catalog.py`, reference `rllib/models/
+        catalog.py`)."""
+        if self.rl_module_class is not None:
+            return RLModuleSpec(observation_space=obs_space,
+                                action_space=act_space,
+                                hidden=self.config.module_hidden,
+                                module_class=self.rl_module_class)
+        from ray_tpu.rllib.models.catalog import Catalog
+
+        model_config = {"fcnet_hiddens": self.config.module_hidden,
+                        **(self.config.model_config or {})}
+        return Catalog.get_module_spec(obs_space, act_space, model_config)
 
     def _learner_config(self) -> Dict[str, Any]:
         return {"lr": self.config.lr, "grad_clip": self.config.grad_clip,
@@ -125,6 +212,11 @@ class Algorithm:
                 -getattr(self.config, "metrics_episode_window", 100):]
             metrics["episode_return_mean"] = float(np.mean(window))
             metrics["num_episodes"] = len(window)
+        win = getattr(self.config, "metrics_episode_window", 100)
+        for agent, rets in self._agent_returns.items():
+            if rets:
+                metrics[f"episode_return_mean/{agent}"] = float(
+                    np.mean(rets[-win:]))
         return metrics
 
     def training_step(self) -> Dict[str, Any]:
@@ -139,6 +231,8 @@ class Algorithm:
         rollouts = ray_tpu.get(refs, timeout=600)
         for ro in rollouts:
             self._recent_returns.extend(ro.pop("episode_returns"))
+            for agent, rets in ro.pop("agent_episode_returns", {}).items():
+                self._agent_returns.setdefault(agent, []).extend(rets)
         return rollouts
 
     def _sync_weights(self, weights=None) -> None:

@@ -9,7 +9,7 @@ update is the Learner's single pjit'd SPMD step.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,40 +19,99 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.core.learner import Learner
 
 
+def _ppo_loss(module, params, batch, cfg):
+    """Clipped-surrogate loss on one module's flat batch (shared by the
+    single-agent and multi-agent learners).  Distribution-agnostic:
+    discrete modules emit `action_logits`, continuous ones emit
+    `action_mean`/`action_log_std` (`models/distributions.py`)."""
+    from ray_tpu.rllib.models.distributions import dist_from_outputs
+
+    clip = cfg.get("clip_param", 0.2)
+    vf_clip = cfg.get("vf_clip_param", 10.0)
+    vf_coeff = cfg.get("vf_loss_coeff", 0.5)
+    ent_coeff = cfg.get("entropy_coeff", 0.0)
+
+    out = module.forward_train(params, batch["obs"])
+    dist = dist_from_outputs(out)
+    logp = dist.logp(batch["actions"])
+
+    # Multi-agent batches keep inactive-lane rows (static shapes -> the
+    # update jits once); `mask` turns means into masked means.
+    if "mask" in batch:
+        w = batch["mask"]
+        denom = jnp.maximum(w.sum(), 1.0)
+        wmean = lambda x: (x * w).sum() / denom          # noqa: E731
+    else:
+        wmean = jnp.mean
+
+    ratio = jnp.exp(logp - batch["logp_old"])
+    adv = batch["advantages"]
+    surrogate = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+    policy_loss = -wmean(surrogate)
+
+    vf_err = jnp.clip((out["vf"] - batch["value_targets"]) ** 2,
+                      0.0, vf_clip ** 2)
+    vf_loss = wmean(vf_err)
+
+    entropy = wmean(dist.entropy())
+    total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "mean_kl": wmean(batch["logp_old"] - logp),
+    }
+
+
 class PPOLearner(Learner):
     def compute_loss(self, params, batch, rng):
-        cfg = self.config
-        clip = cfg.get("clip_param", 0.2)
-        vf_clip = cfg.get("vf_clip_param", 10.0)
-        vf_coeff = cfg.get("vf_loss_coeff", 0.5)
-        ent_coeff = cfg.get("entropy_coeff", 0.0)
+        return _ppo_loss(self.module, params, batch, self.config)
 
-        out = self.module.forward_train(params, batch["obs"])
-        logits = out["action_logits"]
-        logp_all = jax.nn.log_softmax(logits)
-        actions = batch["actions"].astype(jnp.int32)
-        logp = jnp.take_along_axis(
-            logp_all, actions[:, None], axis=-1)[:, 0]
 
-        ratio = jnp.exp(logp - batch["logp_old"])
-        adv = batch["advantages"]
-        surrogate = jnp.minimum(
-            ratio * adv,
-            jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
-        policy_loss = -surrogate.mean()
+class MultiAgentPPOLearner(Learner):
+    """Multi-agent PPO: params = {module_id: subparams}, batch =
+    {module_id: flat batch}.  The per-module losses sum into ONE scalar,
+    so a single jitted value_and_grad covers every policy — disjoint
+    param subtrees give each module its own gradients with no masking.
+    Reference analogue: `rllib/core/learner/learner.py` looping
+    update_for_module per module_id (a dispatch per policy per step);
+    here XLA fuses all policies into one program."""
 
-        vf_err = jnp.clip((out["vf"] - batch["value_targets"]) ** 2,
-                          0.0, vf_clip ** 2)
-        vf_loss = vf_err.mean()
+    def _make_optimizer(self):
+        """Clip each module's gradients by ITS OWN global norm (reference
+        RLlib clips per module) — a shared clip_by_global_norm over the
+        combined tree would let one policy's gradient spike rescale every
+        other policy's healthy gradients, and would shrink the effective
+        per-module threshold as ~sqrt(num_policies)."""
+        import optax
 
-        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-        total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
-        return total, {
-            "policy_loss": policy_loss,
-            "vf_loss": vf_loss,
-            "entropy": entropy,
-            "mean_kl": (batch["logp_old"] - logp).mean(),
-        }
+        clip = self.config.get("grad_clip", 0.5)
+
+        def _clip_update(updates, state, params=None):
+            def one(u):
+                g = optax.global_norm(u)
+                scale = jnp.minimum(1.0, clip / (g + 1e-9))
+                return jax.tree.map(lambda x: x * scale, u)
+
+            return {mid: one(u) for mid, u in updates.items()}, state
+
+        per_module_clip = optax.GradientTransformation(
+            lambda params: optax.EmptyState(), _clip_update)
+        return optax.chain(per_module_clip,
+                           optax.adam(self.config.get("lr", 3e-4)))
+
+    def compute_loss(self, params, batch, rng):
+        total = 0.0
+        metrics = {}
+        for mid in sorted(batch):
+            loss, m = _ppo_loss(self.module[mid], params[mid],
+                                batch[mid], self.config)
+            total = total + loss
+            for k, v in m.items():
+                metrics[f"{mid}/{k}"] = v
+        return total, metrics
 
 
 class PPOConfig(AlgorithmConfig):
@@ -75,6 +134,7 @@ class PPOConfig(AlgorithmConfig):
 
 class PPO(Algorithm):
     learner_class = PPOLearner
+    ma_learner_class = MultiAgentPPOLearner
 
     def _learner_config(self) -> Dict[str, Any]:
         cfg = super()._learner_config()
@@ -86,6 +146,8 @@ class PPO(Algorithm):
 
     # -------------------------------------------------------------- step
     def training_step(self) -> Dict[str, Any]:
+        if self.multi_agent:
+            return self._multi_agent_step()
         c = self.config
         lanes = c.num_env_runners * c.num_envs_per_runner
         steps_per_runner = max(1, c.train_batch_size // lanes)
@@ -111,6 +173,73 @@ class PPO(Algorithm):
         metrics["num_env_steps_sampled"] = n
         return metrics
 
+    def _multi_agent_step(self) -> Dict[str, Any]:
+        c = self.config
+        lanes = c.num_env_runners * c.num_envs_per_runner
+        steps_per_runner = max(1, c.train_batch_size // lanes)
+
+        rollouts = self.sample_batch(steps_per_runner)
+        batches = _build_multi_agent_ppo_batch(rollouts, c.gamma,
+                                               c.gae_lambda)
+
+        n_learners = max(1, self.learner_group.num_learners)
+        counts = {mid: len(b["obs"]) for mid, b in batches.items()}
+        # One shared number of minibatches, sized off the smallest module
+        # (every module must appear in every update — the jitted loss
+        # traces over all module ids).
+        n_min = min(counts.values())
+        n_mb = max(1, n_min // min(c.minibatch_size, n_min))
+        rng = np.random.RandomState(self._iteration)
+        metrics: Dict[str, float] = {}
+        for _ in range(c.num_epochs):
+            perms = {mid: rng.permutation(n) for mid, n in counts.items()}
+            for j in range(n_mb):
+                mb = {}
+                for mid, b in batches.items():
+                    size = counts[mid] // n_mb
+                    size = max(n_learners, size - size % n_learners)
+                    idx = perms[mid][j * size:(j + 1) * size]
+                    mb[mid] = {k: v[idx] for k, v in b.items()}
+                metrics = self.learner_group.update(mb)
+        self._sync_weights()
+        # Honest accounting: env steps = what the runners stepped;
+        # agent steps = active (mask=1) rows actually trained on.
+        metrics["num_env_steps_sampled"] = (
+            steps_per_runner * c.num_env_runners * c.num_envs_per_runner)
+        metrics["num_agent_steps_sampled"] = int(sum(
+            b["mask"].sum() for b in batches.values()))
+        return metrics
+
+
+def _gae(rew: np.ndarray, vf: np.ndarray, dones: np.ndarray,
+         last_vf: np.ndarray, gamma: float, lam: float,
+         mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """Backward GAE over time-major [T, N] lanes.
+
+    With `mask`, rows where mask==0 (agent not acting that step — allowed
+    by the MultiAgentEnv contract for turn-based envs) are transparent:
+    the (next_v, next_adv) carry passes through unchanged, so an agent's
+    advantage bootstraps from its own NEXT acted step, never from the
+    stale vf recorded during the gap."""
+    T, N = rew.shape
+    adv = np.zeros((T, N), np.float32)
+    next_adv = np.zeros(N, np.float32)
+    next_v = np.asarray(last_vf, np.float32)
+    for t in reversed(range(T)):
+        nonterm = 1.0 - dones[t].astype(np.float32)
+        delta = rew[t] + gamma * next_v * nonterm - vf[t]
+        new_adv = delta + gamma * lam * nonterm * next_adv
+        if mask is None:
+            next_adv = new_adv
+            next_v = vf[t]
+            adv[t] = new_adv
+        else:
+            m = mask[t]
+            next_adv = m * new_adv + (1.0 - m) * next_adv
+            next_v = m * vf[t] + (1.0 - m) * next_v
+            adv[t] = new_adv * m
+    return adv
+
 
 def _build_ppo_batch(rollouts: List[Dict[str, np.ndarray]], gamma: float,
                      lam: float) -> Dict[str, np.ndarray]:
@@ -119,18 +248,11 @@ def _build_ppo_batch(rollouts: List[Dict[str, np.ndarray]], gamma: float,
     for ro in rollouts:
         rew, vf, dones = ro["rewards"], ro["vf"], ro["dones"]
         T, N = rew.shape
-        adv = np.zeros((T, N), np.float32)
-        next_adv = np.zeros(N, np.float32)
-        next_v = ro["last_vf"]
-        for t in reversed(range(T)):
-            nonterm = 1.0 - dones[t].astype(np.float32)
-            delta = rew[t] + gamma * next_v * nonterm - vf[t]
-            next_adv = delta + gamma * lam * nonterm * next_adv
-            adv[t] = next_adv
-            next_v = vf[t]
+        adv = _gae(rew, vf, dones, ro["last_vf"], gamma, lam)
         targets = adv + vf
         obs.append(ro["obs"].reshape(T * N, -1))
-        actions.append(ro["actions"].reshape(T * N))
+        act = ro["actions"]
+        actions.append(act.reshape((T * N,) + act.shape[2:]))
         logp.append(ro["logp"].reshape(T * N))
         adv_all.append(adv.reshape(T * N))
         targets_all.append(targets.reshape(T * N))
@@ -139,8 +261,71 @@ def _build_ppo_batch(rollouts: List[Dict[str, np.ndarray]], gamma: float,
     advantages = (advantages - advantages.mean()) / (advantages.std() + 1e-8)
     return {
         "obs": np.concatenate(obs).astype(np.float32),
-        "actions": np.concatenate(actions).astype(np.int32),
+        "actions": _cast_actions(np.concatenate(actions)),
         "logp_old": np.concatenate(logp).astype(np.float32),
         "advantages": advantages.astype(np.float32),
         "value_targets": np.concatenate(targets_all).astype(np.float32),
     }
+
+
+def _cast_actions(a: np.ndarray) -> np.ndarray:
+    """int32 for discrete, float32 for continuous (Box) actions."""
+    return a.astype(np.int32 if np.issubdtype(a.dtype, np.integer)
+                    else np.float32)
+
+
+def _build_multi_agent_ppo_batch(rollouts, gamma: float, lam: float
+                                 ) -> Dict[str, Dict[str, np.ndarray]]:
+    """Per-module GAE over masked rectangular lanes.
+
+    Masked (inactive-lane) rows stay in the batch with mask=0 so every
+    minibatch has a static shape; `_gae` carries the bootstrap through
+    masked gaps so turn-based agents bootstrap from their own next acted
+    step."""
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    per_module: Dict[str, List[Dict[str, np.ndarray]]] = {}
+    for ro in rollouts:
+        for mid, frag in ro["modules"].items():
+            per_module.setdefault(mid, []).append(frag)
+
+    for mid, frags in per_module.items():
+        obs, actions, logp, adv_all, targets_all, masks = [], [], [], [], [], []
+        for fr in frags:
+            rew, vf, dones, mask = (fr["rewards"], fr["vf"], fr["dones"],
+                                    fr["mask"])
+            T, L = rew.shape
+            adv = _gae(rew, vf, dones, fr["last_vf"], gamma, lam, mask=mask)
+            targets = adv + vf
+            # A lane inactive at the fragment end (turn-based gap) has no
+            # successor value for its last acted row — last_vf is V(that
+            # same obs), a biased bootstrap.  Drop that one row from
+            # training rather than train on it (mask copy: the GAE above
+            # already used the true mask for carry transparency).
+            mask = mask.copy()
+            for lane in range(L):
+                col = mask[:, lane]
+                if col[-1] == 0 and col.any():
+                    t_star = int(np.nonzero(col)[0][-1])
+                    if not dones[t_star, lane]:
+                        mask[t_star, lane] = 0.0
+            obs.append(fr["obs"].reshape(T * L, -1))
+            act = fr["actions"]
+            actions.append(act.reshape((T * L,) + act.shape[2:]))
+            logp.append(fr["logp"].reshape(T * L))
+            adv_all.append((adv * mask).reshape(T * L))
+            targets_all.append(targets.reshape(T * L))
+            masks.append(mask.reshape(T * L))
+        m = np.concatenate(masks).astype(np.float32)
+        advantages = np.concatenate(adv_all)
+        denom = max(m.sum(), 1.0)
+        mean = (advantages * m).sum() / denom
+        std = np.sqrt(((advantages - mean) ** 2 * m).sum() / denom) + 1e-8
+        out[mid] = {
+            "obs": np.concatenate(obs).astype(np.float32),
+            "actions": _cast_actions(np.concatenate(actions)),
+            "logp_old": np.concatenate(logp).astype(np.float32),
+            "advantages": ((advantages - mean) / std * m).astype(np.float32),
+            "value_targets": np.concatenate(targets_all).astype(np.float32),
+            "mask": m,
+        }
+    return out

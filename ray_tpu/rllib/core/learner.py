@@ -44,10 +44,7 @@ class Learner:
         params = self.module.init(
             jax.random.key(int(self.config.get("seed", 0))))
         params = jax.device_put(params, self._repl)
-        self._optimizer = optax.chain(
-            optax.clip_by_global_norm(self.config.get("grad_clip", 0.5)),
-            optax.adam(self.config.get("lr", 3e-4)),
-        )
+        self._optimizer = self._make_optimizer()
         opt_state = jax.device_put(self._optimizer.init(params), self._repl)
         self._state = {"params": params, "opt_state": opt_state,
                        **self.init_extra_state(params)}
@@ -70,6 +67,16 @@ class Learner:
             return new_state, metrics
 
         self._update_fn = jax.jit(_update, donate_argnums=(0,))
+
+    def _make_optimizer(self):
+        """Hook: subclasses may change clipping/optimizer structure (the
+        multi-agent learner clips per module so policies stay decoupled)."""
+        import optax
+
+        return optax.chain(
+            optax.clip_by_global_norm(self.config.get("grad_clip", 0.5)),
+            optax.adam(self.config.get("lr", 3e-4)),
+        )
 
     # ------------------------------------------------------------------- loss
     def compute_loss(self, params, batch: Dict[str, jax.Array],
@@ -101,11 +108,11 @@ class Learner:
         the same global step; `make_array_from_process_local_data` assembles
         the global sharded array and the psum rides the mesh.
         """
-        global_batch = {
-            k: jax.make_array_from_process_local_data(
-                self._data_sh, np.asarray(v))
-            for k, v in batch.items()
-        }
+        # tree.map so nested multi-agent batches ({module_id: {k: v}})
+        # shard leaf-wise exactly like flat single-agent ones.
+        global_batch = jax.tree.map(
+            lambda v: jax.make_array_from_process_local_data(
+                self._data_sh, np.asarray(v)), batch)
         self._state, metrics = self._update_fn(
             self._state, global_batch, jax.random.key(rng_seed))
         return {k: float(v) for k, v in metrics.items()}

@@ -109,14 +109,18 @@ class LearnerGroup:
         self._executor.shutdown()
 
 
-def _split_batch(batch: Dict[str, np.ndarray], n: int
-                 ) -> List[Dict[str, np.ndarray]]:
+def _split_batch(batch: Dict[str, Any], n: int) -> List[Dict[str, Any]]:
+    """Even split along axis 0 of every leaf (handles nested multi-agent
+    batches {module_id: {k: array}} the same as flat ones)."""
     if n == 1:
         return [batch]
-    out: List[Dict[str, np.ndarray]] = [{} for _ in range(n)]
-    for k, v in batch.items():
+
+    def _shard(v, i):
         v = np.asarray(v)
         per = len(v) // n
-        for i in range(n):
-            out[i][k] = v[i * per:(i + 1) * per]
-    return out
+        return v[i * per:(i + 1) * per]
+
+    import jax
+
+    return [jax.tree.map(lambda v, i=i: _shard(v, i), batch)
+            for i in range(n)]

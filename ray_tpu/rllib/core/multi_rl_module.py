@@ -1,0 +1,68 @@
+"""MultiRLModule — a dict of RLModules, one per policy.
+
+Reference: `rllib/core/rl_module/multi_rl_module.py` (MultiRLModuleSpec
+builds {module_id: RLModule}; forward passes are dispatched per module).
+TPU-first shape: the multi-module's params are a single pytree
+{module_id: params}, so a learner jits ONE update over all policies —
+disjoint subtrees mean XLA computes each policy's gradients in the same
+program with no cross-talk, and adding a policy never adds a dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+ModuleID = str
+
+
+@dataclasses.dataclass
+class MultiRLModuleSpec:
+    """module_specs: {module_id: RLModuleSpec}."""
+
+    module_specs: Dict[ModuleID, RLModuleSpec]
+
+    def build(self) -> "MultiRLModule":
+        return MultiRLModule({mid: spec.build()
+                              for mid, spec in self.module_specs.items()})
+
+    @property
+    def module_ids(self) -> List[ModuleID]:
+        return sorted(self.module_specs)
+
+
+class MultiRLModule:
+    """Holds per-policy submodules; params = {module_id: subparams}."""
+
+    def __init__(self, modules: Dict[ModuleID, RLModule]):
+        self._modules = dict(modules)
+
+    def __getitem__(self, module_id: ModuleID) -> RLModule:
+        return self._modules[module_id]
+
+    def keys(self) -> List[ModuleID]:
+        return sorted(self._modules)
+
+    def init(self, rng: jax.Array) -> Dict[ModuleID, Any]:
+        keys = jax.random.split(rng, len(self._modules))
+        return {mid: self._modules[mid].init(k)
+                for mid, k in zip(self.keys(), keys)}
+
+    def forward_train(self, params, obs_by_module):
+        return {mid: self._modules[mid].forward_train(params[mid], obs)
+                for mid, obs in obs_by_module.items()}
+
+    def forward_exploration(self, params, obs_by_module, rng):
+        keys = jax.random.split(rng, len(obs_by_module))
+        return {mid: self._modules[mid].forward_exploration(
+                    params[mid], obs, k)
+                for (mid, obs), k in zip(sorted(obs_by_module.items()), keys)}
+
+
+def default_policy_mapping_fn(agent_id: str) -> ModuleID:
+    """Reference default: every agent maps to one shared policy."""
+    return "default_policy"

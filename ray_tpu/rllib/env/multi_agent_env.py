@@ -1,0 +1,159 @@
+"""MultiAgentEnv — dict-keyed multi-agent environment API.
+
+Reference: `rllib/env/multi_agent_env.py` (obs/reward/termination dicts
+keyed by agent id; `possible_agents`, per-agent spaces) and the tuned
+test envs `rllib/examples/envs/classes/multi_agent/` (MultiAgentCartPole,
+RockPaperScissors). The contract here is the same; the implementation is
+numpy-only so env runners stay importable on hosts without gymnasium.
+
+An episode ends when every agent has terminated or truncated (the runner
+resets the env then). Agents that terminate early simply stop appearing
+in the obs dict; the runner masks their lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.env.cartpole import CartPoleEnv, register_env
+from ray_tpu.rllib.env.spaces import Box, Discrete
+
+AgentID = str
+
+
+class MultiAgentEnv:
+    """Base class. Subclasses define `possible_agents` and per-agent
+    spaces, and implement reset()/step() over agent-keyed dicts."""
+
+    possible_agents: List[AgentID] = []
+
+    def __init__(self):
+        self.observation_spaces: Dict[AgentID, Box] = {}
+        self.action_spaces: Dict[AgentID, Any] = {}
+
+    # Per-agent space accessors (reference: get_observation_space(agent_id))
+    def get_observation_space(self, agent_id: AgentID):
+        return self.observation_spaces[agent_id]
+
+    def get_action_space(self, agent_id: AgentID):
+        return self.action_spaces[agent_id]
+
+    def reset(self, *, seed: Optional[int] = None
+              ) -> Tuple[Dict[AgentID, np.ndarray], Dict[AgentID, Any]]:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[AgentID, Any]) -> Tuple[
+            Dict[AgentID, np.ndarray], Dict[AgentID, float],
+            Dict[AgentID, bool], Dict[AgentID, bool], Dict[AgentID, Any]]:
+        """Returns (obs, rewards, terminateds, truncateds, infos), each
+        keyed by the agents that acted.  The special key "__all__" in
+        terminateds/truncateds signals episode end for the whole env."""
+        raise NotImplementedError
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """N independent CartPole lanes, one per agent (the reference's
+    standard multi-agent smoke env).  Agents terminate independently; the
+    episode ends when all have."""
+
+    def __init__(self, num_agents: int = 2, seed: Optional[int] = None):
+        super().__init__()
+        self.possible_agents = [f"agent_{i}" for i in range(num_agents)]
+        self._envs = {a: CartPoleEnv(seed=None if seed is None else seed + i)
+                      for i, a in enumerate(self.possible_agents)}
+        for a, e in self._envs.items():
+            self.observation_spaces[a] = e.observation_space
+            self.action_spaces[a] = e.action_space
+        self._done: Dict[AgentID, bool] = {}
+
+    def reset(self, *, seed=None):
+        obs = {}
+        for i, (a, e) in enumerate(self._envs.items()):
+            obs[a], _ = e.reset(seed=None if seed is None else seed + i)
+        self._done = {a: False for a in self.possible_agents}
+        return obs, {}
+
+    def step(self, action_dict):
+        obs, rew, term, trunc, info = {}, {}, {}, {}, {}
+        for a, act in action_dict.items():
+            if self._done[a]:
+                continue
+            o, r, tm, tr, _ = self._envs[a].step(act)
+            rew[a] = r
+            term[a] = tm
+            trunc[a] = tr
+            if tm or tr:
+                self._done[a] = True
+            else:
+                obs[a] = o
+        done_all = all(self._done.values())
+        term["__all__"] = done_all
+        trunc["__all__"] = False
+        return obs, rew, term, trunc, info
+
+
+class RockPaperScissors(MultiAgentEnv):
+    """Two-player repeated rock-paper-scissors, zero-sum (reference:
+    `rllib/examples/envs/classes/multi_agent/rock_paper_scissors.py`).
+
+    Observation: one-hot of the opponent's previous move plus a
+    first-move flag -> Box(4,).  Episodes last `episode_len` steps.
+    `scripted_opponent="rock"` freezes player_1 to a fixed move so tests
+    can assert player_0 learns the best response (paper)."""
+
+    WIN = {(0, 2), (1, 0), (2, 1)}   # rock>scissors, paper>rock, scissors>paper
+
+    def __init__(self, episode_len: int = 10,
+                 scripted_opponent: Optional[str] = None,
+                 seed: Optional[int] = None):
+        super().__init__()
+        self.possible_agents = ["player_0", "player_1"]
+        obs_space = Box(np.zeros(4, np.float32), np.ones(4, np.float32))
+        for a in self.possible_agents:
+            self.observation_spaces[a] = obs_space
+            self.action_spaces[a] = Discrete(3)
+        self._len = episode_len
+        self._scripted = {"rock": 0, "paper": 1,
+                          "scissors": 2}.get(scripted_opponent)
+        self._t = 0
+        self._last: Dict[AgentID, int] = {}
+
+    def _obs(self) -> Dict[AgentID, np.ndarray]:
+        out = {}
+        for me, other in (("player_0", "player_1"), ("player_1", "player_0")):
+            v = np.zeros(4, np.float32)
+            if other in self._last:
+                v[self._last[other]] = 1.0
+            else:
+                v[3] = 1.0
+            out[me] = v
+        return out
+
+    def reset(self, *, seed=None):
+        self._t = 0
+        self._last = {}
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        a0 = int(action_dict["player_0"])
+        a1 = (self._scripted if self._scripted is not None
+              else int(action_dict["player_1"]))
+        self._last = {"player_0": a0, "player_1": a1}
+        if (a0, a1) in self.WIN:
+            r0 = 1.0
+        elif (a1, a0) in self.WIN:
+            r0 = -1.0
+        else:
+            r0 = 0.0
+        self._t += 1
+        done = self._t >= self._len
+        obs = self._obs() if not done else {}
+        term = {"player_0": done, "player_1": done, "__all__": done}
+        trunc = {"player_0": False, "player_1": False, "__all__": False}
+        return obs, {"player_0": r0, "player_1": -r0}, term, trunc, {}
+
+
+register_env("MultiAgentCartPole", MultiAgentCartPole)
+register_env("RockPaperScissors", RockPaperScissors)

@@ -1,0 +1,279 @@
+"""Multi-agent RLlib: MultiAgentEnv API, MultiRLModule, masked-lane
+rollouts, and multi-agent PPO (shared and per-agent policies).
+
+Reference: `rllib/env/multi_agent_env.py`,
+`rllib/core/rl_module/multi_rl_module.py`,
+`rllib/examples/multi_agent/rock_paper_scissors_*.py` (learned best
+response vs a scripted opponent is the reference's own smoke target).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_tpu.rllib import PPOConfig
+from ray_tpu.rllib.core.multi_rl_module import MultiRLModuleSpec
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.multi_agent_env import (MultiAgentCartPole,
+                                               RockPaperScissors)
+
+
+@pytest.fixture(scope="module")
+def ma_cluster():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=8, num_tpus=0,
+                        object_store_memory=256 * 1024 * 1024,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------- env units
+def test_multi_agent_cartpole_api():
+    env = MultiAgentCartPole(num_agents=3, seed=0)
+    assert env.possible_agents == ["agent_0", "agent_1", "agent_2"]
+    obs, _ = env.reset(seed=0)
+    assert set(obs) == set(env.possible_agents)
+    for a in env.possible_agents:
+        assert obs[a].shape == (4,)
+
+    # Step until some agent terminates; its key must vanish from obs.
+    done_agents = set()
+    for _ in range(600):
+        acts = {a: 1 for a in env.possible_agents if a not in done_agents}
+        obs, rew, term, trunc, _ = env.step(acts)
+        for a in acts:
+            assert rew[a] == 1.0
+            if term[a] or trunc[a]:
+                done_agents.add(a)
+                assert a not in obs
+        if term["__all__"]:
+            break
+    assert term["__all__"] and done_agents == set(env.possible_agents)
+
+
+def test_rock_paper_scissors_zero_sum():
+    env = RockPaperScissors(episode_len=5, seed=0)
+    obs, _ = env.reset()
+    assert obs["player_0"][3] == 1.0  # first-move flag
+    # paper (1) beats rock (0)
+    obs, rew, term, trunc, _ = env.step({"player_0": 1, "player_1": 0})
+    assert rew["player_0"] == 1.0 and rew["player_1"] == -1.0
+    # next obs one-hot encodes the opponent's previous move
+    assert obs["player_0"][0] == 1.0  # opponent played rock
+    assert obs["player_1"][1] == 1.0  # opponent played paper
+    # draws are 0/0; episode terminates at episode_len
+    for _ in range(4):
+        obs, rew, term, trunc, _ = env.step({"player_0": 2, "player_1": 2})
+        assert rew["player_0"] == 0.0 == rew["player_1"]
+    assert term["__all__"]
+
+
+def test_rps_scripted_opponent_ignores_player1_action():
+    env = RockPaperScissors(episode_len=3, scripted_opponent="rock")
+    env.reset()
+    _, rew, _, _, _ = env.step({"player_0": 1, "player_1": 2})
+    assert rew["player_0"] == 1.0  # paper beats the scripted rock
+
+
+# ----------------------------------------------------------- module units
+def test_multi_rl_module_disjoint_params_and_forward():
+    env = RockPaperScissors()
+    spec = MultiRLModuleSpec({
+        mid: RLModuleSpec(observation_space=env.get_observation_space(a),
+                          action_space=env.get_action_space(a),
+                          hidden=(16,))
+        for mid, a in (("p0", "player_0"), ("p1", "player_1"))})
+    module = spec.build()
+    params = module.init(jax.random.key(0))
+    assert set(params) == {"p0", "p1"}
+    # Disjoint init: per-module param trees differ (independent RNG keys).
+    w0 = next(l for l in jax.tree.leaves(params["p0"]) if l.ndim == 2)
+    w1 = next(l for l in jax.tree.leaves(params["p1"]) if l.ndim == 2)
+    assert not np.allclose(np.asarray(w0), np.asarray(w1))
+
+    obs = {"p0": np.zeros((7, 4), np.float32),
+           "p1": np.ones((5, 4), np.float32)}
+    out = module.forward_exploration(params, obs, jax.random.key(1))
+    assert out["p0"]["actions"].shape == (7,)
+    assert out["p1"]["logp"].shape == (5,)
+
+
+# ---------------------------------------------------- turn-based mechanics
+def test_masked_gae_bootstraps_through_gaps():
+    """An agent's advantage must bootstrap from its own next acted step,
+    never from the stale vf recorded while it wasn't acting."""
+    from ray_tpu.rllib.algorithms.ppo import _gae
+
+    mask = np.array([[1.0], [0.0], [1.0]], np.float32)
+    rew = np.array([[0.0], [0.0], [1.0]], np.float32)
+    vf = np.array([[0.5], [99.0], [0.7]], np.float32)  # gap row is garbage
+    dones = np.zeros((3, 1), bool)
+    adv = _gae(rew, vf, dones, np.array([0.2], np.float32),
+               gamma=1.0, lam=1.0, mask=mask)
+    np.testing.assert_allclose(adv[:, 0], [0.7, 0.0, 0.5], atol=1e-6)
+
+
+class _AlternatingTurnEnv:
+    """Two agents alternate turns; each step's reward is delivered to the
+    agent that is NOT acting (as in board games: your move pays off on
+    the opponent's turn).  Exercises the runner's retro-credit path."""
+
+    possible_agents = ["a", "b"]
+
+    def __init__(self, episode_len=6, seed=None):
+        from ray_tpu.rllib.env.spaces import Box, Discrete
+
+        self._len = episode_len
+        space = Box(np.zeros(2, np.float32), np.ones(2, np.float32))
+        self.observation_spaces = {x: space for x in self.possible_agents}
+        self.action_spaces = {x: Discrete(2) for x in self.possible_agents}
+        self._t = 0
+
+    def get_observation_space(self, a):
+        return self.observation_spaces[a]
+
+    def get_action_space(self, a):
+        return self.action_spaces[a]
+
+    def _obs(self):
+        actor = self.possible_agents[self._t % 2]
+        return {actor: np.array([self._t % 2, self._t / 10.0], np.float32)}
+
+    def reset(self, *, seed=None):
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        waiting = self.possible_agents[1 - self._t % 2]
+        self._t += 1
+        done = self._t >= self._len
+        term = {x: done for x in self.possible_agents}
+        term["__all__"] = done
+        trunc = {x: False for x in self.possible_agents}
+        trunc["__all__"] = False
+        obs = self._obs() if not done else {}
+        return obs, {waiting: 1.0}, term, trunc, {}
+
+
+def test_turn_based_rewards_retro_credit():
+    from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+
+    env = _AlternatingTurnEnv()
+    spec = MultiRLModuleSpec({"default_policy": RLModuleSpec(
+        observation_space=env.get_observation_space("a"),
+        action_space=env.get_action_space("a"), hidden=(8,))})
+    runner = MultiAgentEnvRunner._cls(
+        _AlternatingTurnEnv, spec, None, num_envs=1, seed=0)
+    out = runner.sample(12)  # two full 6-step episodes
+    frag = out["modules"]["default_policy"]
+
+    # Lane order is env's possible_agents order: a=lane0, b=lane1.
+    mask = frag["mask"]
+    np.testing.assert_allclose(mask[:, 0], [1, 0] * 6)  # a acts even steps
+    np.testing.assert_allclose(mask[:, 1], [0, 1] * 6)
+
+    # a's rewards arrive on b's turns and retro-credit a's acted rows: all
+    # 3 per episode land in training.  b's t=0 reward arrives before b has
+    # any acted row (dropped from training, kept in metrics): 2 per episode.
+    assert frag["rewards"][:, 0].sum() == pytest.approx(6.0)
+    assert frag["rewards"][:, 1].sum() == pytest.approx(4.0)
+    # a terminates while inactive (episode ends on b's turn): retro-done
+    # on a's last acted row, so GAE never bootstraps across episodes.
+    assert bool(frag["dones"][4, 0]) and bool(frag["dones"][10, 0])
+    assert bool(frag["terminateds"][4, 0])
+    # Episode-return metrics see the full delivered rewards for both.
+    assert out["agent_episode_returns"]["a"] == [3.0, 3.0]
+    assert out["agent_episode_returns"]["b"] == [3.0, 3.0]
+    assert out["episode_returns"] == [6.0, 6.0]
+
+
+def test_env_without_all_key_still_resets():
+    """Envs that mark every agent done per-key but never set '__all__'
+    must still end the episode (otherwise every lane goes inactive and
+    the env never resets — a silent livelock)."""
+    from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+
+    class NoAllEnv(_AlternatingTurnEnv):
+        def step(self, action_dict):
+            obs, rew, term, trunc, info = super().step(action_dict)
+            term.pop("__all__", None)
+            trunc.pop("__all__", None)
+            return obs, rew, term, trunc, info
+
+    spec = MultiRLModuleSpec({"default_policy": RLModuleSpec(
+        observation_space=NoAllEnv().get_observation_space("a"),
+        action_space=NoAllEnv().get_action_space("a"), hidden=(8,))})
+    runner = MultiAgentEnvRunner._cls(NoAllEnv, spec, None,
+                                      num_envs=1, seed=0)
+    out = runner.sample(12)
+    assert out["episode_returns"] == [6.0, 6.0]  # two episodes completed
+    frag = out["modules"]["default_policy"]
+    assert frag["mask"].sum() == 12  # lanes kept acting after episode 1
+
+
+# ------------------------------------------------------------------- e2e
+def test_mappo_shared_policy_cartpole_improves(ma_cluster):
+    """All agents share one policy; mean per-agent return improves well
+    beyond the random-policy plateau (~20-30 per agent)."""
+    config = (
+        PPOConfig()
+        .environment(lambda: MultiAgentCartPole(num_agents=2))
+        .multi_agent(policies=["default_policy"])
+        .training(lr=1e-3, train_batch_size=1024, num_epochs=6,
+                  minibatch_size=256, entropy_coeff=0.01)
+        .env_runners(num_env_runners=2, num_envs_per_runner=4)
+        .learners(num_learners=1, jax_platform="cpu")
+    )
+    algo = config.build()
+    try:
+        best = 0.0
+        for _ in range(16):
+            result = algo.train()
+            # Env-level return sums both agents; /2 -> per-agent.
+            best = max(best, result.get("episode_return_mean", 0.0) / 2)
+            if best >= 80:
+                break
+        # Random policy plateaus ~22 per agent; 80 is unambiguous learning
+        # (RPS e2e below covers convergence-to-optimal).
+        assert best >= 80, f"shared-policy MAPPO best {best} < 80"
+    finally:
+        algo.stop()
+
+
+def _rps_mapping(agent_id):
+    return {"player_0": "p0", "player_1": "p1"}[agent_id]
+
+
+def test_mappo_two_policies_exploit_scripted_opponent(ma_cluster):
+    """Separate policies per player; player_0 learns the best response
+    (paper) to a frozen rock-playing opponent -> near-max exploitation."""
+    config = (
+        PPOConfig()
+        .environment(lambda: RockPaperScissors(episode_len=10,
+                                               scripted_opponent="rock"))
+        .multi_agent(policies=["p0", "p1"],
+                     policy_mapping_fn=_rps_mapping)
+        .training(lr=3e-3, train_batch_size=640, num_epochs=6,
+                  minibatch_size=128, entropy_coeff=0.0)
+        .env_runners(num_env_runners=1, num_envs_per_runner=8)
+        .learners(num_learners=1, jax_platform="cpu")
+    )
+    algo = config.build()
+    try:
+        best = -10.0
+        for _ in range(15):
+            result = algo.train()
+            p0 = result.get("episode_return_mean/player_0")
+            if p0 is not None:
+                best = max(best, p0)
+            if best >= 8.0:
+                break
+        # 10 steps/episode, +1 per win: >= 8 means near-always paper.
+        assert best >= 8.0, f"player_0 best return {best} < 8"
+        # Per-module metrics flow through with module-id prefixes.
+        assert any(k.startswith("p0/") for k in result)
+    finally:
+        algo.stop()
