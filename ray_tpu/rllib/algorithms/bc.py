@@ -68,30 +68,45 @@ class BC:
             hidden=config.module_hidden)
         self.learner_group = LearnerGroup(
             self.learner_class, self.module_spec,
-            learner_config={"lr": config.lr, "grad_clip": config.grad_clip,
-                            "seed": config.seed},
+            learner_config=self._learner_config(),
             scaling_config=ScalingConfig(num_workers=config.num_learners),
             jax_config=JaxConfig(platform=config.jax_platform))
         self._iteration = 0
         self._batch_iter: Optional[Iterator] = None
 
+    def _learner_config(self) -> Dict[str, Any]:
+        return {"lr": self.config.lr, "grad_clip": self.config.grad_clip,
+                "seed": self.config.seed}
+
     # ------------------------------------------------------------ ingestion
+    # Columns each batch carries: (key, dtype); dtype None = keep as-is.
+    # Subclasses extend (MARWIL adds "returns") instead of re-implementing
+    # the two ingestion paths.
+    _batch_columns = (("obs", np.float32), ("actions", None))
+
     def _batches(self) -> Iterator[Dict[str, np.ndarray]]:
         ds = self.config.dataset
         bs = self.config.train_batch_size
+        cols = self._batch_columns
         if hasattr(ds, "iter_batches"):       # ray_tpu.data.Dataset
             while True:                        # epoch loop
                 for batch in ds.iter_batches(batch_size=bs):
-                    yield {"obs": np.asarray(batch["obs"], np.float32),
-                           "actions": np.asarray(batch["actions"])}
+                    for k, _ in cols:
+                        if k not in batch:
+                            raise ValueError(
+                                f"{type(self).__name__} over a Dataset "
+                                f"needs a '{k}' column")
+                    yield {k: np.asarray(batch[k], dt) if dt else
+                           np.asarray(batch[k]) for k, dt in cols}
         else:                                  # in-memory list of rows
             rows = list(ds)
-            obs = np.asarray([r["obs"] for r in rows], np.float32)
-            act = np.asarray([r["actions"] for r in rows])
+            arrays = {k: (np.asarray([r[k] for r in rows], dt) if dt else
+                          np.asarray([r[k] for r in rows]))
+                      for k, dt in cols}
             rng = np.random.RandomState(self.config.seed)
             while True:
                 idx = rng.randint(0, len(rows), bs)
-                yield {"obs": obs[idx], "actions": act[idx]}
+                yield {k: v[idx] for k, v in arrays.items()}
 
     # ------------------------------------------------------------ training
     def train(self) -> Dict[str, Any]:

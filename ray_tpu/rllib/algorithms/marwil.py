@@ -1,0 +1,93 @@
+"""MARWIL — Monotonic Advantage Re-Weighted Imitation Learning.
+
+Reference: `rllib/algorithms/marwil/marwil.py` + `marwil_learner` (an
+offline algorithm: exponentially advantage-weighted behavior cloning with
+a value head regressed on monte-carlo returns; beta=0 reduces it to plain
+BC).  Deviation from the reference: the advantage normalizer is the
+per-batch RMS instead of a persistent moving average — one line simpler
+and equivalent in steady state for the shuffled offline batches the
+trainer feeds.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.core.learner import Learner
+
+
+class MARWILLearner(Learner):
+    def compute_loss(self, params, batch, rng):
+        beta = self.config.get("beta", 1.0)
+        vf_coeff = self.config.get("vf_coeff", 1.0)
+
+        out = self.module.forward_train(params, batch["obs"])
+        logits = out["action_logits"]
+        logp = jax.nn.log_softmax(logits)
+        act = batch["actions"].astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, act[:, None], -1)[:, 0]
+
+        returns = batch["returns"]
+        adv = returns - out["vf"]
+        adv_sg = jax.lax.stop_gradient(adv)
+        c = jnp.sqrt(jnp.mean(adv_sg ** 2)) + 1e-8
+        # Exp-clip keeps one lucky episode from dominating the batch
+        # (reference clips the weight at e^{~3}).
+        weights = jnp.clip(jnp.exp(beta * adv_sg / c), 0.0, 20.0)
+
+        policy_loss = jnp.mean(weights * nll)
+        vf_loss = jnp.mean(adv ** 2)
+        total = policy_loss + vf_coeff * vf_loss
+        acc = (jnp.argmax(logits, -1) == act).mean()
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "mean_weight": weights.mean(), "bc_accuracy": acc}
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        self.gamma = 0.99
+
+    algo_class = property(lambda self: MARWIL)
+
+
+class MARWIL(BC):
+    """Offline advantage-weighted cloning.  Accepts the same inputs as BC
+    plus reward signals: rows may carry a precomputed "returns", or
+    "rewards" + "eps_id" (return-to-go computed here with config.gamma,
+    matching `JsonReader.with_returns`)."""
+
+    learner_class = MARWILLearner
+
+    def __init__(self, config: MARWILConfig):
+        ds = config.dataset
+        if isinstance(ds, (list, tuple)) and ds and "returns" not in ds[0]:
+            rows = [dict(r) for r in ds]
+            by_ep: Dict[Any, list] = {}
+            for i, r in enumerate(rows):
+                by_ep.setdefault(r.get("eps_id", 0), []).append(i)
+            for idxs in by_ep.values():
+                ret = 0.0
+                for i in reversed(idxs):
+                    ret = float(rows[i].get("rewards", 0.0)) + \
+                        config.gamma * ret
+                    rows[i]["returns"] = ret
+            config.dataset = rows
+        super().__init__(config)
+
+    def _learner_config(self) -> Dict[str, Any]:
+        return {"lr": self.config.lr, "grad_clip": self.config.grad_clip,
+                "seed": self.config.seed, "beta": self.config.beta,
+                "vf_coeff": self.config.vf_coeff}
+
+    # ------------------------------------------------------------ ingestion
+    # BC's two ingestion paths, plus the return-to-go column
+    # (precompute via JsonReader.with_returns for Dataset inputs).
+    _batch_columns = BC._batch_columns + (("returns", np.float32),)

@@ -88,14 +88,69 @@ def register_env(name: str, ctor) -> None:
 
 
 def make_env(spec, seed: Optional[int] = None):
-    """spec: an env id string, a constructor, or an instance factory."""
+    """spec: an env id string, a constructor, or an instance factory.
+    Unregistered string ids fall through to gymnasium when it is
+    installed (reference: RLlib resolves env strings via gym.make —
+    `rllib/env/utils.py`)."""
     if callable(spec):
         return spec()
     ctor = _ENV_REGISTRY.get(spec)
     if ctor is None:
+        gym_env, gym_err = _try_gymnasium(spec, seed)
+        if gym_env is not None:
+            return gym_env
         raise KeyError(f"unknown env '{spec}' "
-                       f"(registered: {sorted(_ENV_REGISTRY)})")
+                       f"(registered: {sorted(_ENV_REGISTRY)}; "
+                       f"gymnasium lookup failed: {gym_err})")
     try:
         return ctor(seed=seed)
     except TypeError:
         return ctor()
+
+
+def _try_gymnasium(env_id: str, seed: Optional[int]):
+    try:
+        import gymnasium
+    except ImportError as e:
+        return None, e
+    try:
+        env = gymnasium.make(env_id)
+    except Exception as e:
+        # Keep the real reason (missing extra deps, bad version suffix…)
+        # for make_env's error message.
+        return None, e
+    return GymnasiumEnv(env, seed=seed), None
+
+
+class GymnasiumEnv:
+    """Adapter: gymnasium env -> this package's env/space contract (the
+    reset/step 5-tuple API is already identical; only spaces translate)."""
+
+    def __init__(self, env, seed: Optional[int] = None):
+        self._env = env
+        self._seed = seed
+        self.observation_space = _convert_space(env.observation_space)
+        self.action_space = _convert_space(env.action_space)
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is None:
+            seed, self._seed = self._seed, None
+        return self._env.reset(seed=seed)
+
+    def step(self, action):
+        return self._env.step(action)
+
+    def close(self):
+        self._env.close()
+
+
+def _convert_space(space):
+    from ray_tpu.rllib.env.spaces import Box, Discrete
+
+    name = type(space).__name__
+    if name == "Discrete":
+        return Discrete(int(space.n))
+    if name == "Box":
+        return Box(np.asarray(space.low, np.float32),
+                   np.asarray(space.high, np.float32))
+    raise ValueError(f"unsupported gymnasium space: {space}")

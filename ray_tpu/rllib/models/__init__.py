@@ -1,0 +1,12 @@
+"""ray_tpu.rllib.models — model catalog + action distributions.
+
+Reference: `rllib/models/` (catalog.py, distributions).
+"""
+
+from ray_tpu.rllib.models.catalog import (Catalog, CNNModule,
+                                          GaussianMLPModule)
+from ray_tpu.rllib.models.distributions import (Categorical, DiagGaussian,
+                                                dist_from_outputs)
+
+__all__ = ["Catalog", "CNNModule", "GaussianMLPModule",
+           "Categorical", "DiagGaussian", "dist_from_outputs"]

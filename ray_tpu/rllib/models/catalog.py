@@ -1,0 +1,170 @@
+"""Model catalog — default network selection from spaces + model_config.
+
+Reference: `rllib/models/catalog.py` (`ModelCatalog.get_model_v2` /
+the new-stack `rllib/core/models/catalog.py`: obs space + action space +
+model_config -> encoder + heads).  Selection rules mirrored here:
+
+- 3-D Box obs (H, W, C)  -> CNN encoder (`conv_filters`)
+- 1-D Box obs            -> MLP encoder (`fcnet_hiddens`)
+- Discrete action        -> categorical logits head
+- Box action             -> diagonal-Gaussian head (mean + log_std)
+
+All modules are actor-critic (policy head + vf head) so every algorithm
+in the repo can consume them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.env.spaces import Box, Discrete
+from ray_tpu.rllib.models.distributions import DiagGaussian
+
+DEFAULT_MODEL_CONFIG: Dict[str, Any] = {
+    "fcnet_hiddens": (64, 64),
+    # (out_channels, kernel, stride) triples; default = the classic
+    # Atari-ish stack scaled for small inputs.
+    "conv_filters": ((16, 4, 2), (32, 3, 2)),
+    "conv_fc_hidden": 128,
+}
+
+
+class Catalog:
+    @staticmethod
+    def get_module_spec(observation_space, action_space,
+                        model_config: Optional[Dict[str, Any]] = None
+                        ) -> RLModuleSpec:
+        cfg = {**DEFAULT_MODEL_CONFIG, **(model_config or {})}
+        obs_ndim = len(observation_space.shape)
+        if obs_ndim == 3:
+            cls = (CNNModule if isinstance(action_space, Discrete)
+                   else _unsupported(observation_space, action_space))
+            builder = lambda o, a, h: cls(o, a, cfg)          # noqa: E731
+        elif isinstance(action_space, Discrete):
+            from ray_tpu.rllib.core.rl_module import MLPModule
+
+            builder = lambda o, a, h: MLPModule(              # noqa: E731
+                o, a, cfg["fcnet_hiddens"])
+        elif isinstance(action_space, Box):
+            builder = lambda o, a, h: GaussianMLPModule(      # noqa: E731
+                o, a, cfg["fcnet_hiddens"])
+        else:
+            _unsupported(observation_space, action_space)
+        return RLModuleSpec(observation_space=observation_space,
+                            action_space=action_space,
+                            hidden=cfg["fcnet_hiddens"],
+                            module_class=_BuilderClass(builder))
+
+
+def _unsupported(obs_space, act_space):
+    raise ValueError(f"no default model for obs={obs_space} "
+                     f"act={act_space}")
+
+
+class _BuilderClass:
+    """Adapter: RLModuleSpec.build calls module_class(obs, act, hidden);
+    this lets the catalog capture model_config in a closure while staying
+    spec-pickleable (cloudpickle serializes the closure)."""
+
+    def __init__(self, builder):
+        self._builder = builder
+
+    def __call__(self, obs_space, act_space, hidden):
+        return self._builder(obs_space, act_space, hidden)
+
+
+class CNNModule(RLModule):
+    """Conv encoder + categorical policy/vf heads for image observations
+    (reference: the catalog's default vision network).  Channels-last
+    NHWC — the layout XLA prefers on TPU."""
+
+    def __init__(self, observation_space: Box, action_space: Discrete,
+                 cfg: Dict[str, Any]):
+        import flax.linen as nn
+
+        h, w, c = observation_space.shape
+        n_actions = action_space.n
+        filters = tuple(cfg["conv_filters"])
+        fc = int(cfg["conv_fc_hidden"])
+
+        class _Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                for (ch, k, s) in filters:
+                    x = nn.relu(nn.Conv(ch, (k, k), strides=(s, s))(x))
+                x = x.reshape((x.shape[0], -1))
+                x = nn.relu(nn.Dense(fc)(x))
+                logits = nn.Dense(
+                    n_actions,
+                    kernel_init=nn.initializers.normal(0.01))(x)
+                vf = nn.Dense(1)(x)
+                return logits, vf[..., 0]
+
+        self._net = _Net()
+        self._shape = (h, w, c)
+
+    def init(self, rng):
+        dummy = jnp.zeros((1,) + self._shape, jnp.float32)
+        return self._net.init(rng, dummy)
+
+    def forward_train(self, params, obs):
+        # Runners flatten obs rows; restore the image layout.
+        obs = obs.reshape((obs.shape[0],) + self._shape)
+        logits, vf = self._net.apply(params, obs)
+        return {"action_logits": logits, "vf": vf}
+
+
+class GaussianMLPModule(RLModule):
+    """MLP actor-critic with a diagonal-Gaussian head for Box actions
+    (state-independent log_std parameter, the reference default)."""
+
+    def __init__(self, observation_space: Box, action_space: Box,
+                 hidden: Sequence[int] = (64, 64)):
+        import flax.linen as nn
+
+        obs_dim = int(np.prod(observation_space.shape))
+        act_dim = int(np.prod(action_space.shape))
+
+        class _Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = x
+                for width in hidden:
+                    h = nn.tanh(nn.Dense(width)(h))
+                mean = nn.Dense(
+                    act_dim,
+                    kernel_init=nn.initializers.normal(0.01))(h)
+                log_std = self.param(
+                    "log_std", nn.initializers.zeros, (act_dim,))
+                hv = x
+                for width in hidden:
+                    hv = nn.tanh(nn.Dense(width)(hv))
+                vf = nn.Dense(1)(hv)
+                return mean, log_std, vf[..., 0]
+
+        self._net = _Net()
+        self._obs_dim = obs_dim
+
+    def init(self, rng):
+        dummy = jnp.zeros((1, self._obs_dim), jnp.float32)
+        return self._net.init(rng, dummy)
+
+    def forward_train(self, params, obs):
+        mean, log_std, vf = self._net.apply(params, obs)
+        return {"action_mean": mean, "action_log_std": log_std, "vf": vf}
+
+    def forward_inference(self, params, obs):
+        out = self.forward_train(params, obs)
+        return {"actions": out["action_mean"]}
+
+    def forward_exploration(self, params, obs, rng):
+        out = self.forward_train(params, obs)
+        dist = DiagGaussian(out["action_mean"], out["action_log_std"])
+        actions = dist.sample(rng)
+        return {"actions": actions, "logp": dist.logp(actions),
+                "vf": out["vf"]}

@@ -1,0 +1,67 @@
+"""Action distributions (reference: `rllib/models/distributions.py` +
+`torch/torch_distributions.py` — Categorical / DiagGaussian behind one
+logp/entropy/sample interface so losses are action-space agnostic).
+
+Pure jnp functions over batch-leading arrays — usable inside jit on
+either execution tier (TPU learner, CPU env runner).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class Categorical:
+    def __init__(self, logits: jax.Array):
+        self.logits = logits
+
+    def sample(self, rng: jax.Array) -> jax.Array:
+        return jax.random.categorical(rng, self.logits)
+
+    def logp(self, actions: jax.Array) -> jax.Array:
+        logp_all = jax.nn.log_softmax(self.logits)
+        return jnp.take_along_axis(
+            logp_all, actions.astype(jnp.int32)[..., None], -1)[..., 0]
+
+    def entropy(self) -> jax.Array:
+        logp_all = jax.nn.log_softmax(self.logits)
+        return -(jnp.exp(logp_all) * logp_all).sum(-1)
+
+    def deterministic_sample(self) -> jax.Array:
+        return jnp.argmax(self.logits, -1)
+
+
+class DiagGaussian:
+    """Independent normal per action dim; logp sums over dims."""
+
+    def __init__(self, mean: jax.Array, log_std: jax.Array):
+        self.mean = mean
+        self.log_std = jnp.broadcast_to(log_std, mean.shape)
+
+    def sample(self, rng: jax.Array) -> jax.Array:
+        return self.mean + jnp.exp(self.log_std) * \
+            jax.random.normal(rng, self.mean.shape)
+
+    def logp(self, actions: jax.Array) -> jax.Array:
+        var = jnp.exp(2 * self.log_std)
+        ll = -0.5 * ((actions - self.mean) ** 2 / var
+                     + 2 * self.log_std + jnp.log(2 * jnp.pi))
+        return ll.sum(-1)
+
+    def entropy(self) -> jax.Array:
+        return (self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e)).sum(-1)
+
+    def deterministic_sample(self) -> jax.Array:
+        return self.mean
+
+
+def dist_from_outputs(out: Dict[str, jax.Array]):
+    """Build the right distribution from a module's forward_train output:
+    discrete modules emit `action_logits`, continuous ones emit
+    `action_mean` + `action_log_std`."""
+    if "action_logits" in out:
+        return Categorical(out["action_logits"])
+    return DiagGaussian(out["action_mean"], out["action_log_std"])

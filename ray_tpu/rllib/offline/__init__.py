@@ -1,0 +1,10 @@
+"""ray_tpu.rllib.offline — offline-RL experience I/O.
+
+Reference: `rllib/offline/` (JsonReader/JsonWriter, offline input/output
+configs, dataset-backed training used by BC/MARWIL/CQL).
+"""
+
+from ray_tpu.rllib.offline.io import (JsonReader, JsonWriter,
+                                      record_rollouts)
+
+__all__ = ["JsonReader", "JsonWriter", "record_rollouts"]

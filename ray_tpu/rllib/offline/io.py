@@ -1,0 +1,132 @@
+"""Offline experience I/O — JSONL episode logs.
+
+Reference: `rllib/offline/json_writer.py` / `json_reader.py` (newline-
+delimited JSON sample batches, sharded files, env-rollout recording via
+`config.offline_data(output=...)`).  Rows here are per-transition with an
+`eps_id`, so readers can reassemble episodes and compute return-to-go for
+advantage-weighted algorithms (MARWIL) without the writer knowing gamma.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class JsonWriter:
+    """Append transitions as JSONL; rolls to a new shard every
+    `max_rows_per_file` rows (reference: JsonWriter sharding)."""
+
+    def __init__(self, path: str, max_rows_per_file: int = 100_000):
+        self._dir = path
+        os.makedirs(path, exist_ok=True)
+        self._max = max_rows_per_file
+        self._rows_in_file = 0
+        self._shard = 0
+        self._fh = None
+
+    def _roll(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        fname = os.path.join(self._dir, f"rollouts-{self._shard:05d}.jsonl")
+        self._fh = open(fname, "a")
+        self._shard += 1
+        self._rows_in_file = 0
+
+    def write(self, row: Dict[str, Any]) -> None:
+        if self._fh is None or self._rows_in_file >= self._max:
+            self._roll()
+        self._fh.write(json.dumps(
+            {k: (v.tolist() if isinstance(v, np.ndarray) else
+                 v.item() if isinstance(v, np.generic) else v)
+             for k, v in row.items()}) + "\n")
+        self._rows_in_file += 1
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class JsonReader:
+    """Reads a JSONL rollout directory (or single file) back into rows;
+    `with_returns(gamma)` appends discounted return-to-go per transition
+    (grouped by eps_id, episode order = row order within a shard)."""
+
+    def __init__(self, path: str):
+        if os.path.isdir(path):
+            self._files = sorted(_glob.glob(os.path.join(path, "*.jsonl")))
+        else:
+            self._files = [path]
+        if not self._files:
+            raise FileNotFoundError(f"no .jsonl files under {path}")
+
+    def rows(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for f in self._files:
+            with open(f) as fh:
+                for ln in fh:
+                    ln = ln.strip()
+                    if ln:
+                        out.append(json.loads(ln))
+        return out
+
+    def with_returns(self, gamma: float = 0.99) -> List[Dict[str, Any]]:
+        rows = self.rows()
+        # Group row indices per episode, preserving in-episode order.
+        by_ep: Dict[Any, List[int]] = {}
+        for i, r in enumerate(rows):
+            by_ep.setdefault(r.get("eps_id", 0), []).append(i)
+        for idxs in by_ep.values():
+            ret = 0.0
+            for i in reversed(idxs):
+                ret = float(rows[i].get("rewards", 0.0)) + gamma * ret
+                rows[i]["returns"] = ret
+        return rows
+
+    def to_dataset(self):
+        """Rows as a ray_tpu.data Dataset (requires a live cluster)."""
+        from ray_tpu import data as rdata
+
+        return rdata.from_items(self.rows())
+
+
+def record_rollouts(env_spec, path: str, num_episodes: int,
+                    policy: Optional[Callable[[np.ndarray], int]] = None,
+                    seed: int = 0) -> Dict[str, Any]:
+    """Roll `num_episodes` episodes of `env_spec` and persist them as
+    JSONL (reference: `rllib/offline/` output API + `rllib train ...
+    --out`).  `policy(obs) -> action`; None = uniform random."""
+    from ray_tpu.rllib.env.cartpole import make_env
+
+    env = make_env(env_spec, seed=seed)
+    rng = np.random.RandomState(seed)
+    returns: List[float] = []
+    with JsonWriter(path) as w:
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=seed * 100003 + ep)
+            done, total, t = False, 0.0, 0
+            while not done:
+                if policy is None:
+                    act = env.action_space.sample(rng)
+                else:
+                    act = policy(obs)
+                nxt, r, term, trunc, _ = env.step(act)
+                w.write({"eps_id": ep, "t": t, "obs": obs, "actions": act,
+                         "rewards": r, "terminateds": term,
+                         "truncateds": trunc})
+                obs, total, t = nxt, total + r, t + 1
+                done = term or trunc
+            returns.append(total)
+    return {"num_episodes": num_episodes,
+            "episode_return_mean": float(np.mean(returns))}
