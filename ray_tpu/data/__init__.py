@@ -92,6 +92,15 @@ def read_webdataset(paths, **_ignored) -> Dataset:
     return _read(WebDatasetDatasource(paths))
 
 
+def read_sql(sql: str, connection_factory, *, shards=None,
+             **_ignored) -> Dataset:
+    """DB-API query ingest (reference: `ray.data.read_sql`); optional
+    `shards` = list of SQL predicates appended per read task."""
+    from ray_tpu.data.datasource import SQLDatasource
+
+    return _read(SQLDatasource(sql, connection_factory, shards=shards))
+
+
 def read_images(paths, *, size=None, mode="RGB", **_ignored) -> Dataset:
     """Image directory/files -> rows with a dense "image" tensor column
     (reference: `read_api.py` read_images). `size=(H, W)` resizes for the
@@ -137,7 +146,7 @@ __all__ = [
     "read_json", "read_text", "read_binary_files", "read_images",
     "from_huggingface", "from_torch", "Datasink", "ParquetDatasink",
     "CSVDatasink", "JSONDatasink", "read_datasource", "read_tfrecords",
-    "read_webdataset",
+    "read_webdataset", "read_sql",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

@@ -221,6 +221,28 @@ class Dataset:
 
         return self.write_datasink(JSONDatasink(path))
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import TFRecordDatasink
+
+        return self.write_datasink(TFRecordDatasink(path))
+
+    def write_numpy(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import NumpyDatasink
+
+        return self.write_datasink(NumpyDatasink(path))
+
+    def write_webdataset(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import WebDatasetDatasink
+
+        return self.write_datasink(WebDatasetDatasink(path))
+
+    def write_sql(self, table: str, connection_factory) -> List[Any]:
+        """connection_factory must be picklable (top-level function):
+        blocks insert from parallel tasks when a cluster is up."""
+        from ray_tpu.data.datasource import SQLDatasink
+
+        return self.write_datasink(SQLDatasink(table, connection_factory))
+
     # ---------------------------------------------------------------- misc
     def __repr__(self) -> str:  # pragma: no cover
         return self.stats()

@@ -69,17 +69,12 @@ class MARWIL(BC):
     def __init__(self, config: MARWILConfig):
         ds = config.dataset
         if isinstance(ds, (list, tuple)) and ds and "returns" not in ds[0]:
-            rows = [dict(r) for r in ds]
-            by_ep: Dict[Any, list] = {}
-            for i, r in enumerate(rows):
-                by_ep.setdefault(r.get("eps_id", 0), []).append(i)
-            for idxs in by_ep.values():
-                ret = 0.0
-                for i in reversed(idxs):
-                    ret = float(rows[i].get("rewards", 0.0)) + \
-                        config.gamma * ret
-                    rows[i]["returns"] = ret
-            config.dataset = rows
+            from ray_tpu.rllib.offline.io import compute_returns
+
+            # Raises if rows carry neither rewards nor returns — silent
+            # all-zero returns would degenerate the advantage weights.
+            config.dataset = compute_returns(
+                [dict(r) for r in ds], config.gamma)
         super().__init__(config)
 
     def _learner_config(self) -> Dict[str, Any]:

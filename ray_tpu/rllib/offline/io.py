@@ -22,18 +22,25 @@ class JsonWriter:
     `max_rows_per_file` rows (reference: JsonWriter sharding)."""
 
     def __init__(self, path: str, max_rows_per_file: int = 100_000):
+        import uuid
+
         self._dir = path
         os.makedirs(path, exist_ok=True)
         self._max = max_rows_per_file
         self._rows_in_file = 0
         self._shard = 0
+        # Unique per-writer token (reference JsonWriter does the same):
+        # two recordings into one directory must neither append to each
+        # other's shards nor collide eps_ids at read time.
+        self._token = uuid.uuid4().hex[:8]
         self._fh = None
 
     def _roll(self) -> None:
         if self._fh is not None:
             self._fh.close()
-        fname = os.path.join(self._dir, f"rollouts-{self._shard:05d}.jsonl")
-        self._fh = open(fname, "a")
+        fname = os.path.join(
+            self._dir, f"rollouts-{self._token}-{self._shard:05d}.jsonl")
+        self._fh = open(fname, "w")
         self._shard += 1
         self._rows_in_file = 0
 
@@ -82,17 +89,7 @@ class JsonReader:
         return out
 
     def with_returns(self, gamma: float = 0.99) -> List[Dict[str, Any]]:
-        rows = self.rows()
-        # Group row indices per episode, preserving in-episode order.
-        by_ep: Dict[Any, List[int]] = {}
-        for i, r in enumerate(rows):
-            by_ep.setdefault(r.get("eps_id", 0), []).append(i)
-        for idxs in by_ep.values():
-            ret = 0.0
-            for i in reversed(idxs):
-                ret = float(rows[i].get("rewards", 0.0)) + gamma * ret
-                rows[i]["returns"] = ret
-        return rows
+        return compute_returns(self.rows(), gamma)
 
     def to_dataset(self):
         """Rows as a ray_tpu.data Dataset (requires a live cluster)."""
@@ -101,17 +98,46 @@ class JsonReader:
         return rdata.from_items(self.rows())
 
 
+def compute_returns(rows: List[Dict[str, Any]],
+                    gamma: float = 0.99) -> List[Dict[str, Any]]:
+    """Append discounted return-to-go per transition, grouping by eps_id
+    in row order (shared by JsonReader.with_returns and MARWIL's
+    in-memory ingestion).  Rows must carry 'rewards' (or a precomputed
+    'returns', which is left untouched)."""
+    if rows and "returns" not in rows[0] and "rewards" not in rows[0]:
+        raise ValueError(
+            "offline rows need 'rewards' (+optional eps_id) or a "
+            "precomputed 'returns' column")
+    by_ep: Dict[Any, List[int]] = {}
+    for i, r in enumerate(rows):
+        by_ep.setdefault(r.get("eps_id", 0), []).append(i)
+    for idxs in by_ep.values():
+        ret = 0.0
+        for i in reversed(idxs):
+            if "returns" in rows[i]:
+                ret = float(rows[i]["returns"])
+                continue
+            ret = float(rows[i]["rewards"]) + gamma * ret
+            rows[i]["returns"] = ret
+    return rows
+
+
 def record_rollouts(env_spec, path: str, num_episodes: int,
                     policy: Optional[Callable[[np.ndarray], int]] = None,
                     seed: int = 0) -> Dict[str, Any]:
     """Roll `num_episodes` episodes of `env_spec` and persist them as
     JSONL (reference: `rllib/offline/` output API + `rllib train ...
     --out`).  `policy(obs) -> action`; None = uniform random."""
+    import uuid
+
     from ray_tpu.rllib.env.cartpole import make_env
 
     env = make_env(env_spec, seed=seed)
     rng = np.random.RandomState(seed)
     returns: List[float] = []
+    # Globally-unique episode ids: a second recording into the same
+    # directory must not merge its episodes with this run's at read time.
+    run = uuid.uuid4().hex[:8]
     with JsonWriter(path) as w:
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=seed * 100003 + ep)
@@ -122,9 +148,9 @@ def record_rollouts(env_spec, path: str, num_episodes: int,
                 else:
                     act = policy(obs)
                 nxt, r, term, trunc, _ = env.step(act)
-                w.write({"eps_id": ep, "t": t, "obs": obs, "actions": act,
-                         "rewards": r, "terminateds": term,
-                         "truncateds": trunc})
+                w.write({"eps_id": f"{run}-{ep}", "t": t, "obs": obs,
+                         "actions": act, "rewards": r,
+                         "terminateds": term, "truncateds": trunc})
                 obs, total, t = nxt, total + r, t + 1
                 done = term or trunc
             returns.append(total)

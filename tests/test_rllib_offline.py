@@ -143,3 +143,35 @@ def test_marwil_config_requires_rewards_or_returns(off_cluster):
         assert "policy_loss" in m
     finally:
         algo.stop()
+
+
+def test_double_recording_keeps_episodes_distinct(tmp_path):
+    """Two recordings into one directory must not merge episodes (unique
+    shard names + run-scoped eps_ids)."""
+    path = str(tmp_path / "twice")
+    record_rollouts("CartPole-v1", path, num_episodes=2, seed=0)
+    record_rollouts("CartPole-v1", path, num_episodes=2, seed=0)
+    rows = JsonReader(path).with_returns(gamma=1.0)
+    eps = {r["eps_id"] for r in rows}
+    assert len(eps) == 4  # identical seeds, still four distinct episodes
+    # Per-episode t=0 return equals that episode's length — would break
+    # if two recordings' transitions merged under one eps_id.
+    by_ep = {}
+    for r in rows:
+        by_ep.setdefault(r["eps_id"], []).append(r)
+    for ep_rows in by_ep.values():
+        first = next(r for r in ep_rows if r["t"] == 0)
+        assert first["returns"] == float(len(ep_rows))
+
+
+def test_marwil_rejects_rows_without_reward_signal(off_cluster):
+    from ray_tpu.rllib import MARWILConfig
+
+    rows = [{"obs": np.zeros(4, np.float32), "actions": 0}
+            for _ in range(8)]
+    config = (MARWILConfig().environment("CartPole-v1")
+              .training(train_batch_size=8)
+              .learners(num_learners=1, jax_platform="cpu")
+              .offline_data(rows))
+    with pytest.raises(ValueError, match="rewards"):
+        config.build()
