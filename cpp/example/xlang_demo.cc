@@ -1,0 +1,95 @@
+// End-to-end C++ frontend exercise, driven by tests/test_cpp_client.py.
+// Connects to a client server (port = argv[1]), runs tasks, checks
+// results, prints one PASS/FAIL line per check.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "ray_tpu/client.hpp"
+
+using ray_tpu::Client;
+using ray_tpu::NDArray;
+using ray_tpu::ObjectRef;
+using ray_tpu::Value;
+
+static int g_failures = 0;
+
+static void check(bool ok, const char* name) {
+  std::printf("%s %s\n", ok ? "PASS" : "FAIL", name);
+  if (!ok) ++g_failures;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: xlang_demo <port>\n");
+    return 2;
+  }
+  Client c("127.0.0.1", std::atoi(argv[1]));
+  check(c.Ping(), "ping");
+
+  // Scalar task by import path (stdlib: no fixture needed).
+  ObjectRef r1 = c.Call("math:hypot", {Value::Float(3.0), Value::Float(4.0)});
+  check(c.Get(r1).AsFloat() == 5.0, "call_import_path");
+
+  // Registered fixture doing a jax matmul cluster-side; C++ ships/receives
+  // dense arrays.
+  NDArray m;
+  m.dtype = "float32";
+  m.shape = {2, 3};
+  float vals[6] = {1, 2, 3, 4, 5, 6};
+  m.data.resize(sizeof(vals));
+  std::memcpy(m.data.data(), vals, sizeof(vals));
+  ObjectRef r2 = c.Call("xlang_matmul_t", {m.ToValue()});
+  NDArray out = NDArray::FromValue(c.Get(r2, 120.0));
+  // (2x3) @ (2x3)^T = 2x2: [[14, 32], [32, 77]]
+  float expect[4] = {14, 32, 32, 77};
+  bool mm_ok = out.dtype == "float32" && out.shape.size() == 2 &&
+               out.shape[0] == 2 && out.shape[1] == 2 &&
+               out.data.size() == sizeof(expect);
+  if (mm_ok) {
+    float got[4];
+    std::memcpy(got, out.data.data(), sizeof(got));
+    for (int k = 0; k < 4; ++k) mm_ok = mm_ok && got[k] == expect[k];
+  }
+  check(mm_ok, "ndarray_matmul_roundtrip");
+
+  // Put / Get round trip of a structured value.
+  Value v = Value::Map();
+  v.Set("xs", Value::Array({Value::Int(1), Value::Int(-2), Value::Int(3)}));
+  v.Set("tag", Value::Str("cpp"));
+  ObjectRef r3 = c.Put(v);
+  Value back = c.Get(r3);
+  check(back.Find("tag") != nullptr && back.Find("tag")->AsStr() == "cpp" &&
+            back.Find("xs")->arr[1].AsInt() == -2,
+        "put_get_structured");
+
+  // Wait over several tasks.
+  std::vector<ObjectRef> refs;
+  for (int k = 0; k < 4; ++k)
+    refs.push_back(c.Call("xlang_square", {Value::Int(k)}));
+  std::vector<ObjectRef> ready, pending;
+  c.Wait(refs, 4, 60.0, &ready, &pending);
+  check(ready.size() == 4 && pending.empty(), "wait_all");
+  long total = 0;
+  for (const auto& r : ready) total += c.Get(r).AsInt();
+  check(total == 0 + 1 + 4 + 9, "parallel_results");
+
+  // Remote errors surface as typed failures, not hangs.
+  bool threw = false;
+  try {
+    ObjectRef bad = c.Call("xlang_boom", {});
+    c.Get(bad);
+  } catch (const ray_tpu::RpcError& e) {
+    threw = std::strstr(e.what(), "boom") != nullptr ||
+            std::strstr(e.what(), "Error") != nullptr;
+  }
+  check(threw, "remote_error_propagates");
+
+  // Release + disconnect must not throw.
+  c.Release(refs);
+  c.Disconnect();
+  check(true, "release_disconnect");
+
+  return g_failures == 0 ? 0 : 1;
+}

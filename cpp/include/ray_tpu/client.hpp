@@ -1,0 +1,89 @@
+// ray_tpu C++ client — the non-Python user frontend.
+//
+// Reference analogue: `cpp/` in the reference repo (C++ user API) and
+// `python/ray/util/client` (the thin-client protocol it rides).  The C++
+// client is a DRIVER: it connects to the cluster's client server
+// (`ray_tpu.client.server`, started by `serve()` or
+// `python -m ray_tpu.client.server`) and drives tasks/objects through
+// the msgpack-typed cross-language surface (`ray_tpu/cross_language.py`).
+// Tensors cross as tagged dense arrays; compute runs cluster-side where
+// jax/TPU live — the C++ side stays a control-plane citizen, which is
+// exactly the TPU-first split (XLA owns device code; frontends schedule).
+//
+// Usage:
+//   ray_tpu::Client c("127.0.0.1", port);
+//   auto ref = c.Call("mypkg.mymod:train_step", {ray_tpu::Value::Int(3)});
+//   ray_tpu::Value out = c.Get(ref, 60.0);
+
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "ray_tpu/msgpack_lite.hpp"
+
+namespace ray_tpu {
+
+// An object in the cluster, pinned server-side until Release/disconnect.
+struct ObjectRef {
+  std::string id;  // binary object id
+};
+
+// Dense ndarray helper: the {"__nd__":1,...} tagged map of
+// cross_language.py.
+struct NDArray {
+  std::string dtype;            // numpy dtype string, e.g. "float32"
+  std::vector<int64_t> shape;
+  std::vector<uint8_t> data;    // C-contiguous
+
+  Value ToValue() const;
+  static NDArray FromValue(const Value& v);
+};
+
+class RpcError : public std::runtime_error {
+ public:
+  explicit RpcError(const std::string& what) : std::runtime_error(what) {}
+};
+
+class Client {
+ public:
+  Client(const std::string& host, int port);
+  ~Client();
+
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  bool Ping();
+
+  // Submit a cross-language task: `func` is a name registered via
+  // ray_tpu.cross_language.register or an importable "module:attr".
+  ObjectRef Call(const std::string& func, const std::vector<Value>& args);
+
+  // Fetch + decode a result (blocks up to timeout_s).
+  Value Get(const ObjectRef& ref, double timeout_s = 60.0);
+
+  // Store a msgpack-typed value in the cluster object store.
+  ObjectRef Put(const Value& value);
+
+  // ray.wait equivalent over pinned refs.
+  void Wait(const std::vector<ObjectRef>& refs, int num_returns,
+            double timeout_s, std::vector<ObjectRef>* ready,
+            std::vector<ObjectRef>* pending);
+
+  // Drop server-side pins (cluster GC can reclaim).
+  void Release(const std::vector<ObjectRef>& refs);
+
+  void Disconnect();
+
+ private:
+  Value Request(const std::string& method, Value kwargs);
+  void SendAll(const char* data, size_t n);
+  void RecvAll(char* data, size_t n);
+
+  int fd_ = -1;
+  uint64_t next_req_id_ = 1;
+};
+
+}  // namespace ray_tpu

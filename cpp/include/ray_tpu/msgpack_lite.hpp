@@ -1,0 +1,253 @@
+// msgpack_lite — the msgpack subset the ray_tpu cross-language RPC uses.
+//
+// Reference analogue: the msgpack serialization boundary of
+// python/ray/cross_language.py (non-Python workers exchange
+// msgpack-typed values).  Self-contained header: nil/bool/int/float/
+// str/bin/array/map, both directions, no external dependencies.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ray_tpu {
+
+struct Value {
+  enum class Type { Nil, Bool, Int, Float, Str, Bin, Array, Map };
+  Type type = Type::Nil;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;                  // Str
+  std::vector<uint8_t> bin;       // Bin
+  std::vector<Value> arr;         // Array
+  std::vector<std::pair<Value, Value>> map;  // Map (ordered)
+
+  Value() = default;
+  static Value Nil() { return Value(); }
+  static Value Bool(bool v) { Value x; x.type = Type::Bool; x.b = v; return x; }
+  static Value Int(int64_t v) { Value x; x.type = Type::Int; x.i = v; return x; }
+  static Value Float(double v) { Value x; x.type = Type::Float; x.f = v; return x; }
+  static Value Str(std::string v) {
+    Value x; x.type = Type::Str; x.s = std::move(v); return x;
+  }
+  static Value Bin(std::vector<uint8_t> v) {
+    Value x; x.type = Type::Bin; x.bin = std::move(v); return x;
+  }
+  static Value Bin(const void* data, size_t n) {
+    Value x; x.type = Type::Bin;
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    x.bin.assign(p, p + n);
+    return x;
+  }
+  static Value Array(std::vector<Value> v) {
+    Value x; x.type = Type::Array; x.arr = std::move(v); return x;
+  }
+  static Value Map() { Value x; x.type = Type::Map; return x; }
+
+  Value& Set(const std::string& key, Value v) {
+    map.emplace_back(Str(key), std::move(v));
+    return *this;
+  }
+  const Value* Find(const std::string& key) const {
+    for (const auto& kv : map)
+      if (kv.first.type == Type::Str && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+  int64_t AsInt() const {
+    if (type == Type::Int) return i;
+    if (type == Type::Float) return static_cast<int64_t>(f);
+    throw std::runtime_error("Value: not an int");
+  }
+  double AsFloat() const {
+    if (type == Type::Float) return f;
+    if (type == Type::Int) return static_cast<double>(i);
+    throw std::runtime_error("Value: not a float");
+  }
+  const std::string& AsStr() const {
+    if (type != Type::Str) throw std::runtime_error("Value: not a str");
+    return s;
+  }
+  const std::vector<uint8_t>& AsBin() const {
+    if (type != Type::Bin) throw std::runtime_error("Value: not bin");
+    return bin;
+  }
+};
+
+namespace msgpack_lite {
+
+inline void put_u8(std::string& out, uint8_t v) { out.push_back(char(v)); }
+inline void put_be(std::string& out, uint64_t v, int bytes) {
+  for (int k = bytes - 1; k >= 0; --k) out.push_back(char((v >> (8 * k)) & 0xFF));
+}
+
+inline void encode(const Value& v, std::string& out) {
+  switch (v.type) {
+    case Value::Type::Nil: put_u8(out, 0xC0); break;
+    case Value::Type::Bool: put_u8(out, v.b ? 0xC3 : 0xC2); break;
+    case Value::Type::Int:
+      if (v.i >= 0 && v.i < 128) {
+        put_u8(out, uint8_t(v.i));
+      } else if (v.i < 0 && v.i >= -32) {
+        put_u8(out, uint8_t(0xE0 | (v.i + 32)));
+      } else {
+        put_u8(out, 0xD3);  // int64
+        put_be(out, uint64_t(v.i), 8);
+      }
+      break;
+    case Value::Type::Float: {
+      put_u8(out, 0xCB);
+      uint64_t bits;
+      std::memcpy(&bits, &v.f, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case Value::Type::Str:
+      if (v.s.size() < 32) {
+        put_u8(out, uint8_t(0xA0 | v.s.size()));
+      } else {
+        put_u8(out, 0xDB);  // str32
+        put_be(out, v.s.size(), 4);
+      }
+      out.append(v.s);
+      break;
+    case Value::Type::Bin:
+      put_u8(out, 0xC6);  // bin32
+      put_be(out, v.bin.size(), 4);
+      out.append(reinterpret_cast<const char*>(v.bin.data()), v.bin.size());
+      break;
+    case Value::Type::Array:
+      if (v.arr.size() < 16) {
+        put_u8(out, uint8_t(0x90 | v.arr.size()));
+      } else {
+        put_u8(out, 0xDD);  // array32
+        put_be(out, v.arr.size(), 4);
+      }
+      for (const auto& e : v.arr) encode(e, out);
+      break;
+    case Value::Type::Map:
+      if (v.map.size() < 16) {
+        put_u8(out, uint8_t(0x80 | v.map.size()));
+      } else {
+        put_u8(out, 0xDF);  // map32
+        put_be(out, v.map.size(), 4);
+      }
+      for (const auto& kv : v.map) {
+        encode(kv.first, out);
+        encode(kv.second, out);
+      }
+      break;
+  }
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  uint64_t be(int bytes) {
+    if (end - p < bytes) throw std::runtime_error("msgpack: truncated");
+    uint64_t v = 0;
+    for (int k = 0; k < bytes; ++k) v = (v << 8) | *p++;
+    return v;
+  }
+  std::string str(size_t n) {
+    if (size_t(end - p) < n) throw std::runtime_error("msgpack: truncated");
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+
+  Value next() {
+    if (p >= end) throw std::runtime_error("msgpack: truncated");
+    uint8_t t = *p++;
+    if (t < 0x80) return Value::Int(t);                 // pos fixint
+    if (t >= 0xE0) return Value::Int(int8_t(t));        // neg fixint
+    if ((t & 0xF0) == 0x80) return map_body(t & 0x0F);  // fixmap
+    if ((t & 0xF0) == 0x90) return arr_body(t & 0x0F);  // fixarray
+    if ((t & 0xE0) == 0xA0) return Value::Str(str(t & 0x1F));  // fixstr
+    switch (t) {
+      case 0xC0: return Value::Nil();
+      case 0xC2: return Value::Bool(false);
+      case 0xC3: return Value::Bool(true);
+      case 0xC4: return bin_body(be(1));
+      case 0xC5: return bin_body(be(2));
+      case 0xC6: return bin_body(be(4));
+      case 0xCA: {  // float32
+        uint32_t bits = uint32_t(be(4));
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Value::Float(f);
+      }
+      case 0xCB: {  // float64
+        uint64_t bits = be(8);
+        double f;
+        std::memcpy(&f, &bits, 8);
+        return Value::Float(f);
+      }
+      case 0xCC: return Value::Int(int64_t(be(1)));
+      case 0xCD: return Value::Int(int64_t(be(2)));
+      case 0xCE: return Value::Int(int64_t(be(4)));
+      case 0xCF: return Value::Int(int64_t(be(8)));  // uint64 (may wrap)
+      case 0xD0: return Value::Int(int8_t(be(1)));
+      case 0xD1: return Value::Int(int16_t(be(2)));
+      case 0xD2: return Value::Int(int32_t(be(4)));
+      case 0xD3: return Value::Int(int64_t(be(8)));
+      case 0xD9: return Value::Str(str(be(1)));
+      case 0xDA: return Value::Str(str(be(2)));
+      case 0xDB: return Value::Str(str(be(4)));
+      case 0xDC: return arr_body(be(2));
+      case 0xDD: return arr_body(be(4));
+      case 0xDE: return map_body(be(2));
+      case 0xDF: return map_body(be(4));
+      default:
+        throw std::runtime_error("msgpack: unsupported type byte " +
+                                 std::to_string(int(t)));
+    }
+  }
+
+  Value bin_body(uint64_t n) {
+    if (uint64_t(end - p) < n) throw std::runtime_error("msgpack: truncated");
+    Value v;
+    v.type = Value::Type::Bin;
+    v.bin.assign(p, p + n);
+    p += n;
+    return v;
+  }
+  Value arr_body(uint64_t n) {
+    Value v;
+    v.type = Value::Type::Array;
+    v.arr.reserve(n);
+    for (uint64_t k = 0; k < n; ++k) v.arr.push_back(next());
+    return v;
+  }
+  Value map_body(uint64_t n) {
+    Value v;
+    v.type = Value::Type::Map;
+    v.map.reserve(n);
+    for (uint64_t k = 0; k < n; ++k) {
+      Value key = next();
+      v.map.emplace_back(std::move(key), next());
+    }
+    return v;
+  }
+};
+
+inline std::string encode(const Value& v) {
+  std::string out;
+  encode(v, out);
+  return out;
+}
+
+inline Value decode(const std::string& buf) {
+  Reader r{reinterpret_cast<const uint8_t*>(buf.data()),
+           reinterpret_cast<const uint8_t*>(buf.data()) + buf.size()};
+  return r.next();
+}
+
+}  // namespace msgpack_lite
+}  // namespace ray_tpu

@@ -1,0 +1,196 @@
+// ray_tpu C++ client implementation — see client.hpp.
+
+#include "ray_tpu/client.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+namespace ray_tpu {
+
+namespace {
+constexpr int kKindRequest = 0;
+constexpr int kKindResponse = 1;
+constexpr int kKindError = 2;
+
+std::string PackFrame(const std::string& body) {
+  std::string out;
+  out.reserve(8 + body.size());
+  uint64_t n = body.size();
+  for (int k = 7; k >= 0; --k) out.push_back(char((n >> (8 * k)) & 0xFF));
+  out.append(body);
+  return out;
+}
+}  // namespace
+
+Value NDArray::ToValue() const {
+  Value v = Value::Map();
+  v.Set("__nd__", Value::Int(1));
+  v.Set("dtype", Value::Str(dtype));
+  std::vector<Value> sh;
+  sh.reserve(shape.size());
+  for (int64_t d : shape) sh.push_back(Value::Int(d));
+  v.Set("shape", Value::Array(std::move(sh)));
+  v.Set("data", Value::Bin(data));
+  return v;
+}
+
+NDArray NDArray::FromValue(const Value& v) {
+  const Value* tag = v.Find("__nd__");
+  if (v.type != Value::Type::Map || tag == nullptr)
+    throw RpcError("value is not a tagged ndarray");
+  const Value* dtype = v.Find("dtype");
+  const Value* shape = v.Find("shape");
+  const Value* data = v.Find("data");
+  if (dtype == nullptr || shape == nullptr || data == nullptr)
+    throw RpcError("tagged ndarray missing dtype/shape/data");
+  NDArray a;
+  a.dtype = dtype->AsStr();
+  for (const auto& d : shape->arr) a.shape.push_back(d.AsInt());
+  a.data = data->AsBin();
+  return a;
+}
+
+Client::Client(const std::string& host, int port) {
+  struct addrinfo hints {};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 ||
+      res == nullptr)
+    throw RpcError("cannot resolve " + host);
+  fd_ = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd_ < 0 || connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd_ >= 0) close(fd_);
+    fd_ = -1;
+    throw RpcError("cannot connect to " + host + ":" + port_s);
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+Client::~Client() {
+  if (fd_ >= 0) {
+    close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Client::SendAll(const char* data, size_t n) {
+  while (n) {
+    ssize_t sent = send(fd_, data, n, 0);
+    if (sent <= 0) throw RpcError("connection lost (send)");
+    data += sent;
+    n -= size_t(sent);
+  }
+}
+
+void Client::RecvAll(char* data, size_t n) {
+  while (n) {
+    ssize_t got = recv(fd_, data, n, 0);
+    if (got <= 0) throw RpcError("connection lost (recv)");
+    data += got;
+    n -= size_t(got);
+  }
+}
+
+Value Client::Request(const std::string& method, Value kwargs) {
+  uint64_t req_id = next_req_id_++;
+  Value frame = Value::Array({Value::Int(int64_t(req_id)),
+                              Value::Int(kKindRequest), Value::Str(method),
+                              std::move(kwargs)});
+  std::string wire = PackFrame(msgpack_lite::encode(frame));
+  SendAll(wire.data(), wire.size());
+
+  char hdr[8];
+  RecvAll(hdr, 8);
+  uint64_t n = 0;
+  for (int k = 0; k < 8; ++k) n = (n << 8) | uint8_t(hdr[k]);
+  std::string body(n, '\0');
+  RecvAll(body.data(), n);
+  Value reply = msgpack_lite::decode(body);
+  if (reply.type != Value::Type::Array || reply.arr.size() != 4)
+    throw RpcError("malformed reply frame");
+  int64_t kind = reply.arr[1].AsInt();
+  if (kind == kKindError) {
+    const Value& err = reply.arr[3];
+    std::string what = "remote error";
+    if (err.type == Value::Type::Array && err.arr.size() >= 2)
+      what = err.arr[0].AsStr() + ": " + err.arr[1].AsStr();
+    throw RpcError(what);
+  }
+  if (kind != kKindResponse) throw RpcError("unexpected frame kind");
+  return std::move(reply.arr[3]);
+}
+
+bool Client::Ping() {
+  return Request("client_ping", Value::Map()).b;
+}
+
+ObjectRef Client::Call(const std::string& func,
+                       const std::vector<Value>& args) {
+  Value kw = Value::Map();
+  kw.Set("func", Value::Str(func));
+  kw.Set("args", Value::Array(args));
+  Value id = Request("client_xlang_call", std::move(kw));
+  return ObjectRef{std::string(id.AsBin().begin(), id.AsBin().end())};
+}
+
+Value Client::Get(const ObjectRef& ref, double timeout_s) {
+  Value kw = Value::Map();
+  kw.Set("object_id", Value::Bin(ref.id.data(), ref.id.size()));
+  kw.Set("wait_timeout", Value::Float(timeout_s));
+  return Request("client_xlang_get", std::move(kw));
+}
+
+ObjectRef Client::Put(const Value& value) {
+  Value kw = Value::Map();
+  kw.Set("value", value);
+  Value id = Request("client_xlang_put", std::move(kw));
+  return ObjectRef{std::string(id.AsBin().begin(), id.AsBin().end())};
+}
+
+void Client::Wait(const std::vector<ObjectRef>& refs, int num_returns,
+                  double timeout_s, std::vector<ObjectRef>* ready,
+                  std::vector<ObjectRef>* pending) {
+  Value kw = Value::Map();
+  std::vector<Value> ids;
+  ids.reserve(refs.size());
+  for (const auto& r : refs) ids.push_back(Value::Bin(r.id.data(),
+                                                      r.id.size()));
+  kw.Set("object_ids", Value::Array(std::move(ids)));
+  kw.Set("num_returns", Value::Int(num_returns));
+  kw.Set("wait_timeout", Value::Float(timeout_s));
+  Value out = Request("client_xlang_wait", std::move(kw));
+  for (int half = 0; half < 2; ++half) {
+    std::vector<ObjectRef>* dst = half == 0 ? ready : pending;
+    if (dst == nullptr) continue;
+    dst->clear();
+    for (const auto& id : out.arr[half].arr)
+      dst->push_back(ObjectRef{std::string(id.AsBin().begin(),
+                                           id.AsBin().end())});
+  }
+}
+
+void Client::Release(const std::vector<ObjectRef>& refs) {
+  Value kw = Value::Map();
+  std::vector<Value> ids;
+  ids.reserve(refs.size());
+  for (const auto& r : refs) ids.push_back(Value::Bin(r.id.data(),
+                                                      r.id.size()));
+  kw.Set("object_ids", Value::Array(std::move(ids)));
+  Request("client_release", std::move(kw));
+}
+
+void Client::Disconnect() {
+  Request("client_disconnect", Value::Map());
+}
+
+}  // namespace ray_tpu

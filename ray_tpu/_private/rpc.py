@@ -101,16 +101,35 @@ class EventStats:
 
 
 async def _read_frame(reader: asyncio.StreamReader):
+    """Returns ((req_id, kind, method, payload), is_msgpack).
+
+    Frames from Python peers are pickled (protocol >= 2, body starts
+    0x80).  Cross-language clients (the C++ frontend, `cpp/`) send the
+    same 4-tuple msgpack-encoded instead — a fixarray first byte, which
+    can never collide with pickle's PROTO opcode.  Reference analogue:
+    the msgpack boundary of `python/ray/cross_language.py`."""
     header = await reader.readexactly(_HEADER.size)
     (length,) = _HEADER.unpack(header)
     if length > _MAX_FRAME:
         raise ConnectionLost(f"oversized frame: {length}")
     body = await reader.readexactly(length)
-    return pickle.loads(body)
+    if body[:1] == b"\x80":
+        return pickle.loads(body), False
+    import msgpack
+
+    req_id, kind, method, payload = msgpack.unpackb(body, raw=False)
+    return (req_id, kind, method, payload), True
 
 
 def _encode_frame(msg) -> bytes:
     body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    return _HEADER.pack(len(body)) + body
+
+
+def _encode_msgpack_frame(msg) -> bytes:
+    import msgpack
+
+    body = msgpack.packb(list(msg), use_bin_type=True)
     return _HEADER.pack(len(body)) + body
 
 
@@ -213,35 +232,63 @@ class RpcServer:
         try:
             while True:
                 try:
-                    req_id, kind, method, payload = await _read_frame(reader)
+                    (req_id, kind, method,
+                     payload), is_mp = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError,
                         ConnectionLost):
+                    break
+                except Exception as exc:
+                    # Undecodable frame (bad cross-language client or a
+                    # pickle the server can't load): framing is
+                    # unrecoverable on this connection. Log before
+                    # killing it — every in-flight call on the shared
+                    # connection is about to see ConnectionLost.
+                    import sys
+
+                    print(f"[rpc] closing connection on undecodable "
+                          f"frame: {exc!r}", file=sys.stderr, flush=True)
                     break
                 if kind != _KIND_REQUEST:
                     continue
                 asyncio.ensure_future(
-                    self._dispatch(req_id, method, payload, writer, write_lock)
+                    self._dispatch(req_id, method, payload, writer,
+                                   write_lock, is_mp)
                 )
         finally:
             writer.close()
 
-    async def _dispatch(self, req_id, method, payload, writer, write_lock):
+    async def _dispatch(self, req_id, method, payload, writer, write_lock,
+                        is_mp=False):
         start = time.monotonic()
         try:
             handler = self._handlers.get(method)
             if handler is None:
                 raise RpcError(f"no handler registered for {method!r}")
             reply = await handler(**payload)
-            frame = _encode_frame((req_id, _KIND_RESPONSE, method, reply))
+            if is_mp:
+                # Cross-language caller: reply must stay in msgpack types
+                # (the xlang handlers guarantee this).
+                frame = _encode_msgpack_frame(
+                    (req_id, _KIND_RESPONSE, method, reply))
+            else:
+                frame = _encode_frame(
+                    (req_id, _KIND_RESPONSE, method, reply))
         except Exception as exc:  # noqa: BLE001 — forwarded to caller
-            err = (type(exc).__name__, str(exc), traceback.format_exc(), exc)
-            try:
-                frame = _encode_frame((req_id, _KIND_ERROR, method, err))
-            except Exception:
-                # Exception object itself unpicklable — send string form only.
-                frame = _encode_frame((req_id, _KIND_ERROR, method,
-                                       (type(exc).__name__, str(exc),
-                                        traceback.format_exc(), None)))
+            if is_mp:
+                frame = _encode_msgpack_frame(
+                    (req_id, _KIND_ERROR, method,
+                     [type(exc).__name__, str(exc),
+                      traceback.format_exc()]))
+            else:
+                err = (type(exc).__name__, str(exc),
+                       traceback.format_exc(), exc)
+                try:
+                    frame = _encode_frame((req_id, _KIND_ERROR, method, err))
+                except Exception:
+                    # Exception object itself unpicklable — string form only.
+                    frame = _encode_frame((req_id, _KIND_ERROR, method,
+                                           (type(exc).__name__, str(exc),
+                                            traceback.format_exc(), None)))
         finally:
             self.stats.record(method, time.monotonic() - start)
         try:
@@ -423,7 +470,8 @@ class RpcClient:
     async def _read_loop(self, reader):
         try:
             while True:
-                req_id, kind, method, payload = await _read_frame(reader)
+                (req_id, kind, method,
+                 payload), _is_mp = await _read_frame(reader)
                 fut = self._pending.pop(req_id, None)
                 if fut is None or fut.done():
                     continue

@@ -28,9 +28,14 @@ class ClientServer:
                      "wait", "release", "create_actor",
                      "submit_actor_task", "get_actor", "kill_actor",
                      "release_actor", "cancel", "gcs_call", "ping",
-                     "disconnect"]:
+                     "disconnect",
+                     # msgpack-typed surface for non-Python frontends
+                     # (the C++ client in cpp/): see cross_language.py.
+                     "xlang_call", "xlang_get", "xlang_put",
+                     "xlang_wait"]:
             self._server.register(f"client_{name}",
                                   getattr(self, f"_h_{name}"))
+        self._xlang_fns: Dict[str, Any] = {}
 
     def start(self) -> int:
         return self._server.start()
@@ -122,6 +127,62 @@ class ClientServer:
         value = pickle.loads(payload)
         ref = await self._blocking(global_worker().put, value)
         return self._pin(ref)
+
+    # --------------------------------------------------- xlang (msgpack)
+    def _xlang_remote(self, func: str):
+        """Cache one RemoteFunction per cross-language symbol so repeated
+        calls reuse the exported function hash."""
+        rf = self._xlang_fns.get(func)
+        if rf is None:
+            import ray_tpu
+            from ray_tpu.cross_language import resolve
+
+            rf = ray_tpu.remote(resolve(func))
+            self._xlang_fns[func] = rf
+        return rf
+
+    async def _h_xlang_call(self, func, args, options=None):
+        """Submit `func` (registered name or "module:attr") with
+        msgpack-typed args; returns the result ref id (bytes)."""
+        from ray_tpu.cross_language import decode
+
+        rf = self._xlang_remote(func)
+        if options:
+            rf = rf.options(**options)
+        call_args = [decode(a) for a in (args or [])]
+        ref = await self._blocking(lambda: rf.remote(*call_args))
+        return self._pin(ref)
+
+    async def _h_xlang_get(self, object_id, wait_timeout=None):
+        import asyncio
+
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.cross_language import encode
+
+        ref = self._ref(object_id)
+        w = global_worker()
+        (value,) = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: w.get_objects([ref], wait_timeout))
+        return encode(value)
+
+    async def _h_xlang_put(self, value):
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.cross_language import decode
+
+        ref = await self._blocking(global_worker().put, decode(value))
+        return self._pin(ref)
+
+    async def _h_xlang_wait(self, object_ids, num_returns, wait_timeout):
+        import asyncio
+
+        import ray_tpu
+
+        refs = [self._ref(oid) for oid in object_ids]
+        ready, pending = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: ray_tpu.wait(refs, num_returns=num_returns,
+                                       timeout=wait_timeout))
+        return [[r.binary() for r in ready],
+                [r.binary() for r in pending]]
 
     async def _h_wait(self, object_ids, num_returns, wait_timeout,
                       fetch_local):

@@ -1,0 +1,81 @@
+"""Cross-language boundary — msgpack-typed task calls.
+
+Reference: `python/ray/cross_language.py` + the msgpack serialization
+boundary the reference uses between language workers (`java_function`,
+`cpp_function`: tasks named by symbol, arguments restricted to
+msgpack-representable types).  Here the non-Python frontend is the C++
+client (`cpp/`), which drives the cluster through the thin-client server
+(`client/server.py`) over the same socket RPC the Python client uses —
+frames msgpack instead of pickle, sniffed per-frame in `_private/rpc.py`.
+
+Functions callable from other languages are named either by an explicit
+`register("name", fn)` or by import path `"pkg.module:attr"`.  Values
+cross the boundary as msgpack types; numpy arrays ride as a tagged map
+{"__nd__": 1, dtype, shape, data} for zero-copy-ish dense transfer.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str, fn: Callable) -> None:
+    """Expose `fn` to cross-language callers under `name`."""
+    _REGISTRY[name] = fn
+
+
+def resolve(func: str) -> Callable:
+    """Registered name first, then `"pkg.module:attr"` import path."""
+    fn = _REGISTRY.get(func)
+    if fn is not None:
+        return fn
+    if ":" not in func:
+        raise KeyError(
+            f"cross-language function '{func}' is not registered and is "
+            f"not a 'module:attr' import path")
+    mod_name, attr = func.split(":", 1)
+    mod = importlib.import_module(mod_name)
+    fn = mod
+    for part in attr.split("."):
+        fn = getattr(fn, part)
+    if not callable(fn):
+        raise TypeError(f"'{func}' resolved to non-callable {fn!r}")
+    return fn
+
+
+# ------------------------------------------------------------ value codec
+def encode(value: Any) -> Any:
+    """Python value -> msgpack-representable tree."""
+    if isinstance(value, np.ndarray):
+        c = np.ascontiguousarray(value)
+        return {"__nd__": 1, "dtype": str(c.dtype),
+                "shape": list(c.shape), "data": c.tobytes()}
+    if isinstance(value, np.generic):
+        return value.item()
+    if isinstance(value, (list, tuple)):
+        return [encode(v) for v in value]
+    if isinstance(value, dict):
+        return {k: encode(v) for k, v in value.items()}
+    if value is None or isinstance(value, (bool, int, float, str, bytes)):
+        return value
+    raise TypeError(
+        f"value of type {type(value).__name__} cannot cross the "
+        f"language boundary (msgpack types + numpy arrays only)")
+
+
+def decode(value: Any) -> Any:
+    """msgpack tree -> Python value (reconstructing tagged ndarrays)."""
+    if isinstance(value, dict):
+        if value.get("__nd__") == 1:
+            return np.frombuffer(
+                value["data"], dtype=np.dtype(value["dtype"])
+            ).reshape(value["shape"]).copy()
+        return {k: decode(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [decode(v) for v in value]
+    return value

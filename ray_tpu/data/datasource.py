@@ -478,20 +478,28 @@ class SQLDatasink(Datasink):
         rows = list(BlockAccessor(block).rows())
         if not rows:
             return 0
-        cols = list(rows[0])
+        # Column union over the whole block: heterogeneous rows insert
+        # NULL for keys they lack instead of crashing mid-INSERT.
+        cols: List[str] = []
+        for r in rows:
+            for c in r:
+                if c not in cols:
+                    cols.append(c)
         conn = self._factory()
         try:
             cur = conn.cursor()
             if self._create:
+                sample = {c: next(r[c] for r in rows if c in r)
+                          for c in cols}
                 decls = ", ".join(
-                    f"{c} {self._sql_type(rows[0][c])}" for c in cols)
+                    f"{c} {self._sql_type(sample[c])}" for c in cols)
                 cur.execute(
                     f"CREATE TABLE IF NOT EXISTS {self._table} ({decls})")
             ph = ", ".join("?" for _ in cols)
             cur.executemany(
                 f"INSERT INTO {self._table} ({', '.join(cols)}) "
                 f"VALUES ({ph})",
-                [tuple(_sql_value(r[c]) for c in cols) for r in rows])
+                [tuple(_sql_value(r.get(c)) for c in cols) for r in rows])
             conn.commit()
         finally:
             conn.close()
@@ -523,8 +531,16 @@ def _crc32c_table():
 
 _CRC32C_TABLE = _crc32c_table()
 
+try:  # native implementation when present (large image-bytes records
+    # would pay ~1 us/byte in the Python loop)
+    from google_crc32c import value as _crc32c_native
+except ImportError:  # pragma: no cover - environment-dependent
+    _crc32c_native = None
+
 
 def _crc32c(data: bytes) -> int:
+    if _crc32c_native is not None:
+        return _crc32c_native(data)
     crc = 0xFFFFFFFF
     for b in data:
         crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
@@ -610,7 +626,18 @@ class NumpyDatasink(_FileDatasink):
         for r in rows:
             for k, v in r.items():
                 cols.setdefault(k, []).append(v)
-        np.savez(dest, **{k: np.asarray(v) for k, v in cols.items()})
+        arrays = {}
+        for k, v in cols.items():
+            try:
+                arrays[k] = np.asarray(v)
+            except ValueError as e:
+                # Ragged columns have no dense .npz representation
+                # (object arrays need allow_pickle and defeat the point).
+                raise ValueError(
+                    f"column '{k}' is ragged (rows have differing "
+                    f"shapes) and cannot be written as .npz — pad it or "
+                    f"use write_parquet/write_json") from e
+        np.savez(dest, **arrays)
         return dest
 
 

@@ -104,12 +104,12 @@ def compute_returns(rows: List[Dict[str, Any]],
     in row order (shared by JsonReader.with_returns and MARWIL's
     in-memory ingestion).  Rows must carry 'rewards' (or a precomputed
     'returns', which is left untouched)."""
-    if rows and "returns" not in rows[0] and "rewards" not in rows[0]:
-        raise ValueError(
-            "offline rows need 'rewards' (+optional eps_id) or a "
-            "precomputed 'returns' column")
     by_ep: Dict[Any, List[int]] = {}
     for i, r in enumerate(rows):
+        if "returns" not in r and "rewards" not in r:
+            raise ValueError(
+                f"offline row {i} has neither 'rewards' nor a precomputed "
+                f"'returns' column (keys: {sorted(r)})")
         by_ep.setdefault(r.get("eps_id", 0), []).append(i)
     for idxs in by_ep.values():
         ret = 0.0
