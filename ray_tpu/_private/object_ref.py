@@ -64,7 +64,12 @@ class ObjectRef:
 
             w = worker_mod.global_worker_or_none()
             if w is not None:
-                w.reference_counter.remove_local_ref(self._id)
+                # NEVER decref inline: __del__ can fire inside any
+                # allocation on a thread already holding worker locks
+                # (self-deadlock via _free_object). deque.append is the
+                # only GC-safe operation; the worker drains it at entry
+                # points and from its release-drainer task.
+                w.defer_release(self._id)
         except BaseException:
             # Interpreter teardown: module globals may already be gone.
             pass

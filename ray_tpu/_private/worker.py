@@ -152,6 +152,17 @@ class ActorHandleTracker:
             actor_id, self._counts[actor_id] + 1))
 
     def remove_ref(self, actor_id: bytes) -> None:
+        """GC-context entry (ActorHandle.__del__): append-only.
+
+        `_post`/call_soon_threadsafe takes the event loop's internal
+        mutex — if cyclic GC fires this __del__ on the io-loop thread
+        while it is INSIDE call_soon_threadsafe, re-taking that mutex
+        self-deadlocks (same class as the ObjectRef.__del__ hang). The
+        worker's release drainer applies the decrefs."""
+        self._worker._pending_actor_releases.append(actor_id)
+
+    def apply_deferred_release(self, actor_id: bytes) -> None:
+        """Drain-point counterpart of remove_ref (non-GC context)."""
         def _dec():
             self._counts[actor_id] -= 1
             self._maybe_gc(actor_id)
@@ -299,6 +310,28 @@ class Worker:
         self.actor_handles = ActorHandleTracker(self)
         self._objects: Dict[bytes, _PendingObject] = {}
         self._objects_lock = threading.Lock()
+        # Deferred ref releases from ObjectRef.__del__. A __del__ can run
+        # inside ANY allocation on ANY thread — including one already
+        # holding _objects_lock (e.g. _entry building a _PendingObject) —
+        # so it must never call into the refcounter/free path directly:
+        # remove_local_ref -> _free_object re-takes _objects_lock and
+        # self-deadlocks while holding the refcount lock, wedging every
+        # other thread (observed as the serve-suite hang). __del__ only
+        # appends here (GIL-atomic); drains run at public entry points
+        # and from the release-drainer io task. Reference analogue:
+        # core_worker defers Python refcount ops onto the io_service.
+        import collections as _collections
+
+        self._pending_releases: "_collections.deque[bytes]" = \
+            _collections.deque()
+        # Same contract for MappedObject view releases (raylet client-ref
+        # drops) and ActorHandle.__del__ decrefs: GC-time callbacks
+        # append; the drainer applies them.
+        self._pending_map_releases: "_collections.deque[bytes]" = \
+            _collections.deque()
+        self._pending_actor_releases: "_collections.deque[bytes]" = \
+            _collections.deque()
+        self.io.submit(self._release_drainer())
         # Weak cache of client mappings: entries vanish when the last
         # deserialized value sharing the buffer dies, firing the
         # mapping's release callback so the raylet drops its client ref
@@ -428,6 +461,7 @@ class Worker:
             return False
 
     def put(self, value: Any) -> ObjectRef:
+        self.drain_releases()
         task_id = self._ctx.task_id or TaskID.for_normal_task(self.job_id)
         oid_obj = ObjectID.for_put(task_id, self._put_counter.next())
         oid = oid_obj.binary()
@@ -494,15 +528,16 @@ class Worker:
         self.raylet.call("seal_object", object_id=oid, pin=True)
 
     def _release_mapping(self, oid: bytes) -> None:
-        """MappedObject release callback: the last value view died."""
+        """MappedObject release callback: the last value view died.
+
+        Usually fires from GC (the WeakValueDictionary entry dying), so
+        it must stay lock-free like ObjectRef.__del__ — io.submit takes
+        the asyncio loop's internal mutex and can self-deadlock if the
+        collection happens inside call_soon_threadsafe on the loop
+        thread. Defer; the drainer sends the raylet release."""
         if self._dead:
             return
-        try:
-            self.io.submit(self.raylet.acall(
-                "release_object", object_id=oid,
-                client_id=self.worker_id.binary(), timeout=5))
-        except Exception:
-            pass
+        self._pending_map_releases.append(oid)
 
     def _plasma_get(self, oid: bytes, timeout: Optional[float],
                     locations: Sequence[bytes]) -> Any:
@@ -524,6 +559,7 @@ class Worker:
 
     def get_objects(self, refs: Sequence[ObjectRef],
                     timeout: Optional[float]) -> List[Any]:
+        self.drain_releases()
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
         for ref in refs:
@@ -834,6 +870,53 @@ class Worker:
         self.reference_counter.release_borrower(object_id, key)
         return True
 
+    def defer_release(self, oid: bytes) -> None:
+        """GC-safe local-ref release (ObjectRef.__del__ only): a single
+        lock-free append; the actual decref runs at the next drain."""
+        self._pending_releases.append(oid)
+
+    def drain_releases(self) -> None:
+        """Apply deferred __del__ releases. Called from public entry
+        points (never while holding _objects_lock) and periodically."""
+        q = self._pending_releases
+        while q:
+            try:
+                oid = q.popleft()
+            except IndexError:
+                break
+            try:
+                self.reference_counter.remove_local_ref(oid)
+            except Exception:
+                pass
+        aq = self._pending_actor_releases
+        while aq:
+            try:
+                actor_id = aq.popleft()
+            except IndexError:
+                break
+            try:
+                self.actor_handles.apply_deferred_release(actor_id)
+            except Exception:
+                pass
+        mq = self._pending_map_releases
+        while mq and not self._dead:
+            try:
+                oid = mq.popleft()
+            except IndexError:
+                break
+            try:
+                self.io.submit(self.raylet.acall(
+                    "release_object", object_id=oid,
+                    client_id=self.worker_id.binary(), timeout=5))
+            except Exception:
+                pass
+
+    async def _release_drainer(self):
+        while not self._dead:
+            await asyncio.sleep(0.2)
+            if self._pending_releases or self._pending_map_releases:
+                self.drain_releases()
+
     async def _borrow_sweeper(self):
         """Owner-side hygiene: expire unclaimed pending-share pins and
         reap borrowers whose process died without releasing."""
@@ -981,6 +1064,7 @@ class Worker:
 
     def submit_task(self, fn_hash: str, fn_name: str, args, kwargs,
                     options: Dict[str, Any]) -> List[ObjectRef]:
+        self.drain_releases()
         task_id = TaskID.for_normal_task(self.job_id)
         arg_specs, kw_keys = self._serialize_args(args, kwargs)
         num_returns = options.get("num_returns", 1)
@@ -1662,6 +1746,7 @@ class Worker:
     def submit_actor_task(self, actor_id: bytes, method_name: str, args,
                           kwargs, options: Dict[str, Any],
                           max_task_retries: int = 0) -> List[ObjectRef]:
+        self.drain_releases()
         task_id = TaskID.for_actor_task(ActorID(actor_id))
         arg_specs, kw_keys = self._serialize_args(args, kwargs)
         num_returns = options.get("num_returns", 1)
@@ -2519,6 +2604,14 @@ class Worker:
         return asyncio.to_thread(self.get_objects, refs, None)
 
     def shutdown(self):
+        # Deferred GC releases first, while the raylet connection is
+        # still alive — pending view releases queued in the last drainer
+        # interval would otherwise leave client read-pins until the
+        # raylet's client-death sweep.
+        try:
+            self.drain_releases()
+        except Exception:
+            pass
         # Tell owners we no longer hold any borrowed refs (best effort —
         # their liveness sweep reaps us anyway if this is lost).
         for oid, addr in self.reference_counter.drain_borrows():

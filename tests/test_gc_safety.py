@@ -1,0 +1,84 @@
+"""GC-safety of release paths.
+
+Regression for a real deadlock (round-4 serve-suite hang):
+ObjectRef.__del__ ran remove_local_ref inline; when GC fired inside an
+allocation on a thread already holding worker._objects_lock (e.g.
+_entry building a _PendingObject during submit_actor_task), the free
+path re-took _objects_lock and self-deadlocked while holding the
+refcount lock — wedging every other thread at add_owned.  The contract
+under test: __del__-context release paths perform ONLY a lock-free
+deque append; decrefs/RPCs happen at drain points.
+
+Reference analogue: core_worker defers Python del-callbacks onto the
+io_service instead of running them on the GC thread.
+"""
+
+import gc
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def gc_cluster():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_objectref_del_defers_the_decref(gc_cluster):
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    ref = ray_tpu.put("payload")
+    oid = ref.binary()
+    rc = w.reference_counter
+    assert rc._refs[oid].local >= 1
+    before = rc._refs[oid].local
+
+    w.drain_releases()              # start from an empty queue
+    del ref
+    gc.collect()
+    # The decref is QUEUED, not applied: local count unchanged until a
+    # drain point runs.
+    assert oid in list(w._pending_releases)
+    assert rc._refs[oid].local == before
+
+    w.drain_releases()
+    assert oid not in list(w._pending_releases)
+    assert rc._refs.get(oid) is None or rc._refs[oid].local == before - 1
+
+
+def test_del_inside_refcount_critical_section_cannot_deadlock(gc_cluster):
+    """Simulate the exact hazard: trigger an ObjectRef.__del__ while the
+    current thread holds _objects_lock (as _entry does during alloc).
+    With the deferred contract this returns instantly; the old inline
+    decref deadlocked here."""
+    from ray_tpu._private.object_ref import ObjectRef
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    ref = ray_tpu.put(123)
+    with w._objects_lock:
+        # __del__ fires here, as if GC interrupted an allocation in
+        # _entry. Must not block or call into the free path.
+        del ref
+        gc.collect()
+    w.drain_releases()  # applies cleanly afterwards
+
+
+def test_release_churn_under_submission_load(gc_cluster):
+    """Thousands of refs dying while tasks submit concurrently — the
+    pattern the serve router produced. Bounded time = no wedge."""
+    @ray_tpu.remote
+    def echo(x):
+        return x
+
+    for _ in range(20):
+        refs = [echo.remote(i) for i in range(25)]
+        assert sorted(ray_tpu.get(refs, timeout=60)) == list(range(25))
+        del refs
+        gc.collect()
