@@ -1,0 +1,113 @@
+// ray_tpu C++ task library — user C++ functions callable as cluster
+// tasks.
+//
+// Reference analogue: the `cpp/` worker's RAY_REMOTE registration.
+// Architecture difference (deliberate, documented): instead of a
+// standalone C++ worker speaking the full worker protocol, a task
+// library is a shared object the Python worker process dlopens; calls
+// cross one C-ABI function with msgpack-encoded args/results (the same
+// value codec as the C++ driver client — numpy arrays ride the tagged
+// dense-map form).  That keeps C++ user code in-process with the
+// worker's lease/retry/ownership machinery instead of duplicating it.
+//
+// Usage:
+//   #include "ray_tpu/task_lib.hpp"
+//   static ray_tpu::Value Fib(const std::vector<ray_tpu::Value>& args) {
+//     int64_t n = args[0].AsInt(); ...
+//     return ray_tpu::Value::Int(result);
+//   }
+//   RAY_TPU_REGISTER_TASK("fib", Fib);
+//
+// Build as a -shared -fPIC library; Python side:
+//   fib = ray_tpu.cross_language.cpp_function("libtasks.so", "fib")
+//   ray_tpu.get(ray_tpu.remote(fib).remote(20))
+
+#pragma once
+
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "ray_tpu/msgpack_lite.hpp"
+
+namespace ray_tpu {
+
+using TaskFn = std::function<Value(const std::vector<Value>&)>;
+
+inline std::map<std::string, TaskFn>& task_registry() {
+  static std::map<std::string, TaskFn> registry;
+  return registry;
+}
+
+struct TaskRegistrar {
+  TaskRegistrar(const char* name, TaskFn fn) {
+    task_registry()[name] = std::move(fn);
+  }
+};
+
+}  // namespace ray_tpu
+
+#define RAY_TPU_REGISTER_TASK(name, fn) \
+  static ::ray_tpu::TaskRegistrar _ray_tpu_reg_##fn(name, fn)
+
+// ------------------------------------------------------------- C ABI
+// One library exports exactly these three symbols (defined by including
+// this header in ONE translation unit with RAY_TPU_TASK_LIB_MAIN).
+#ifdef RAY_TPU_TASK_LIB_MAIN
+extern "C" {
+
+// Returns 0 on success; *out/*out_len = malloc'd msgpack result.
+// On failure returns 1 and *out carries a msgpack string (the error).
+int ray_tpu_call(const char* func_name, const uint8_t* args_buf,
+                 size_t args_len, uint8_t** out, size_t* out_len) {
+  using ray_tpu::Value;
+  std::string result;
+  int rc = 0;
+  try {
+    auto& reg = ray_tpu::task_registry();
+    auto it = reg.find(func_name);
+    if (it == reg.end())
+      throw std::runtime_error(std::string("no registered C++ task '") +
+                               func_name + "'");
+    std::string packed(reinterpret_cast<const char*>(args_buf), args_len);
+    Value args = ray_tpu::msgpack_lite::decode(packed);
+    Value ret = it->second(args.arr);
+    result = ray_tpu::msgpack_lite::encode(ret);
+  } catch (const std::exception& e) {
+    result = ray_tpu::msgpack_lite::encode(Value::Str(e.what()));
+    rc = 1;
+  } catch (...) {
+    // A non-std exception escaping the extern-C boundary would
+    // std::terminate() the whole hosting worker process.
+    result = ray_tpu::msgpack_lite::encode(
+        Value::Str("non-standard C++ exception"));
+    rc = 1;
+  }
+  *out = static_cast<uint8_t*>(std::malloc(result.size()));
+  std::memcpy(*out, result.data(), result.size());
+  *out_len = result.size();
+  return rc;
+}
+
+void ray_tpu_free(uint8_t* p) { std::free(p); }
+
+// Registered task names as a NUL-joined, double-NUL-terminated list the
+// caller must ray_tpu_free (introspection for error messages/tooling).
+int ray_tpu_list_tasks(uint8_t** out, size_t* out_len) {
+  std::string names;
+  for (const auto& kv : ray_tpu::task_registry()) {
+    names += kv.first;
+    names.push_back('\0');
+  }
+  names.push_back('\0');
+  *out = static_cast<uint8_t*>(std::malloc(names.size()));
+  std::memcpy(*out, names.data(), names.size());
+  *out_len = names.size();
+  return 0;
+}
+
+}  // extern "C"
+#endif  // RAY_TPU_TASK_LIB_MAIN

@@ -186,12 +186,12 @@ void* rtpu_store_open(const char* path, uint64_t capacity) {
   s->base = static_cast<uint8_t*>(base);
   s->capacity = capacity;
   s->free_list.emplace(0, capacity);
-  // Commit the whole arena in the background: fresh tmpfs pages cost
-  // ~0.4ms/MB to allocate+zero at first touch, capping first-write
-  // bandwidth near 2 GB/s however the fault is taken. The capacity is the
-  // operator's declared store budget (plasma's model — a fixed shm
-  // region), so committing it once up front is the honest behavior and
-  // makes every later client write run at memcpy speed.
+  // Background page pre-commit: fresh tmpfs pages cost ~0.4ms/MB to
+  // allocate+zero at first touch, capping first-write bandwidth near
+  // 2 GB/s however the fault is taken. The toucher stays a bounded
+  // window ahead of the allocation watermark by default (see
+  // toucher_main / RTPU_ARENA_PRECOMMIT) so a mostly-empty store does
+  // not become RAM-resident up front.
   s->toucher = std::thread([s] { s->toucher_main(); });
   return s;
 }

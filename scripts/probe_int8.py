@@ -15,7 +15,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 M, K, N = 4096, 4096, 11008
@@ -86,6 +85,44 @@ def main():
     t_wo = timeit("B int8 weight-only PTQ", int8_weightonly, x, w8, ws)
     t_i8 = timeit("C int8 pure (upper bound)", int8_pure, x8, w8)
     print(f"\nspeedup B vs A: x{t_bf / t_wo:.3f}   C vs A: x{t_bf / t_i8:.3f}")
+    single_dot()
+
+
+def single_dot():
+    """Cases D/E of the PERF.md table: one 8192^3 dot repeated with a
+    varying operand (defeats CSE), minimal non-matmul work — the cleanest
+    look at the raw MXU rate per dtype."""
+    global M, K, N
+    M = K = N = 8192
+    key = jax.random.key(0)
+    a16 = jax.random.normal(key, (M, K), jnp.bfloat16)
+    b16 = jax.random.normal(key, (K, N), jnp.bfloat16)
+    a8 = (a16 * 10).astype(jnp.int8)
+    b8 = (b16 * 10).astype(jnp.int8)
+
+    @jax.jit
+    def d_bf16(a, b):
+        def inner(c, i):
+            y = (a * (1.0 + i * 1e-6).astype(jnp.bfloat16)) @ b
+            return c + y[0, :8].astype(jnp.float32).sum(), None
+        c, _ = lax.scan(inner, jnp.float32(0),
+                        jnp.arange(ITERS, dtype=jnp.float32))
+        return c
+
+    @jax.jit
+    def e_int8(a, b):
+        def inner(c, i):
+            aa = a + (i % 2).astype(jnp.int8)
+            y = lax.dot_general(aa, b, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+            return c + y[0, :8].sum(), None
+        c, _ = lax.scan(inner, jnp.int32(0),
+                        jnp.arange(ITERS, dtype=jnp.int32))
+        return c
+
+    t_d = timeit("D bf16 single dot 8192^3", d_bf16, a16, b16)
+    t_e = timeit("E int8 single dot 8192^3", e_int8, a8, b8)
+    print(f"speedup E vs D: x{t_d / t_e:.3f}")
 
 
 if __name__ == "__main__":

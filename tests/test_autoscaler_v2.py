@@ -1,0 +1,169 @@
+"""Autoscaler v2: declarative instance manager + reconciler.
+
+Reference: `python/ray/autoscaler/v2/` (instance_manager, reconciler,
+instance_storage) and its tests (`autoscaler/v2/tests/test_instance_
+manager.py`, `test_reconciler.py`): lifecycle legality, persistence, and
+crash-resume are the properties under test.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import FakeMultiNodeProvider
+from ray_tpu.autoscaler.v2 import (Instance, InstanceManager,
+                                   InstanceStatus, Reconciler)
+from ray_tpu.autoscaler.v2.instance_manager import InvalidTransition
+
+
+class _DictKV:
+    def __init__(self):
+        self.d = {}
+
+    def get(self, k):
+        return self.d.get(k)
+
+    def put(self, k, v):
+        self.d[k] = v
+
+
+# ----------------------------------------------------------- state machine
+def test_lifecycle_transitions_and_illegal_ones():
+    kv = _DictKV()
+    im = InstanceManager(kv.get, kv.put)
+    inst = im.add("worker.small")
+    assert inst.status == InstanceStatus.QUEUED
+
+    im.transition(inst.instance_id, InstanceStatus.REQUESTED)
+    im.transition(inst.instance_id, InstanceStatus.ALLOCATED,
+                  cloud_instance_id="c-1")
+    im.transition(inst.instance_id, InstanceStatus.RAY_RUNNING,
+                  node_id="ab" * 14)
+    with pytest.raises(InvalidTransition):
+        im.transition(inst.instance_id, InstanceStatus.QUEUED)
+    im.transition(inst.instance_id, InstanceStatus.TERMINATING)
+    im.transition(inst.instance_id, InstanceStatus.TERMINATED)
+    with pytest.raises(InvalidTransition):
+        im.transition(inst.instance_id, InstanceStatus.RAY_RUNNING)
+    # Full history retained for debugging (reference keeps the same).
+    assert len(im.instances[inst.instance_id].history) == 6
+
+
+def test_table_persists_and_reloads():
+    kv = _DictKV()
+    im = InstanceManager(kv.get, kv.put)
+    a = im.add("t1")
+    im.add("t2")
+    im.transition(a.instance_id, InstanceStatus.REQUESTED)
+    v = im.version
+
+    # "Crash": a brand-new manager over the same storage sees everything.
+    im2 = InstanceManager(kv.get, kv.put)
+    assert im2.version == v
+    assert set(im2.instances) == set(im.instances)
+    assert im2.instances[a.instance_id].status == InstanceStatus.REQUESTED
+    # and continues versioning from there
+    im2.add("t3")
+    assert im2.version == v + 1
+
+
+# -------------------------------------------------------------- reconciler
+NODE_TYPES = {
+    "bigmem.node": {"resources": {"CPU": 2, "bigmem2": 1},
+                    "min_workers": 0, "max_workers": 3},
+}
+
+
+def test_reconciler_scales_up_joins_and_down(ray_start_isolated):
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    provider = FakeMultiNodeProvider(w.gcs_addr, w.session_dir)
+    rec = Reconciler(w.gcs_addr, provider, NODE_TYPES,
+                     max_workers=3, idle_timeout_s=3.0)
+    try:
+        @ray_tpu.remote(resources={"bigmem2": 0.5})
+        def needs():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        ref = needs.remote()
+
+        launched = 0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and launched == 0:
+            time.sleep(1.0)
+            launched = rec.reconcile()["launched"]
+        assert launched == 1
+
+        node_id = ray_tpu.get(ref, timeout=120)
+
+        # Reconcile until the join is observed as RAY_RUNNING.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rec.reconcile()
+            running = rec.im.with_status(InstanceStatus.RAY_RUNNING)
+            if running:
+                break
+            time.sleep(0.5)
+        assert running and running[0].node_id == node_id
+
+        # Idle past the timeout -> full STOPPING/TERMINATING/TERMINATED
+        # walk, recorded in the history.
+        terminated = 0
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and terminated == 0:
+            time.sleep(1.0)
+            terminated = rec.reconcile()["terminated"]
+        assert terminated == 1
+        assert provider.non_terminated_nodes() == []
+        hist = rec.im.instances[running[0].instance_id].history
+        assert any("RAY_STOPPING" in h for h in hist)
+        assert any("TERMINATED" in h for h in hist)
+    finally:
+        provider.shutdown()
+
+
+def test_reconciler_crash_resume_adopts_and_requeues(ray_start_isolated):
+    """A new Reconciler over the same GCS KV (autoscaler restart) resumes
+    the table: live cloud nodes are re-recognized, and a REQUESTED row
+    whose create never completed is retired for re-evaluation."""
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    provider = FakeMultiNodeProvider(w.gcs_addr, w.session_dir)
+    rec1 = Reconciler(w.gcs_addr, provider, NODE_TYPES, max_workers=3)
+    try:
+        # A live cloud node tracked by rec1.
+        inst = rec1.im.add("bigmem.node")
+        rec1.reconcile()  # launches it
+        assert rec1.im.instances[inst.instance_id].status in (
+            InstanceStatus.ALLOCATED, InstanceStatus.RAY_RUNNING)
+
+        # Simulate a crash mid-launch: a REQUESTED row with no cloud id.
+        orphan = rec1.im.add("bigmem.node")
+        rec1.im.transition(orphan.instance_id, InstanceStatus.REQUESTED)
+
+        # Restarted autoscaler process.
+        rec2 = Reconciler(w.gcs_addr, provider, NODE_TYPES, max_workers=3)
+        assert set(rec2.im.instances) == set(rec1.im.instances)
+        stats = rec2.reconcile()
+        assert stats["requeued"] == 1
+        assert (rec2.im.instances[orphan.instance_id].status
+                == InstanceStatus.TERMINATED)
+        # The real node survived the restart and is still tracked.
+        live = rec2.im.instances[inst.instance_id]
+        assert live.status in (InstanceStatus.ALLOCATED,
+                               InstanceStatus.RAY_RUNNING)
+        assert live.cloud_instance_id in provider.non_terminated_nodes()
+
+        # An untracked (manually-launched) cloud node is adopted.
+        extra = provider.create_node("bigmem.node",
+                                     NODE_TYPES["bigmem.node"])
+        stats = rec2.reconcile()
+        assert stats["adopted"] == 1
+        adopted = rec2.im.by_cloud_id(extra)
+        assert adopted is not None and adopted.status in (
+            InstanceStatus.ALLOCATED, InstanceStatus.RAY_RUNNING)
+    finally:
+        provider.shutdown()
