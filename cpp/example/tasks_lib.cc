@@ -1,0 +1,60 @@
+// Example C++ task library (built as libtasks.so, used by
+// tests/test_cpp_client.py to prove C++ task execution).
+
+#define RAY_TPU_TASK_LIB_MAIN
+#include "ray_tpu/task_lib.hpp"
+
+#include <cstring>
+
+using ray_tpu::Value;
+
+static void RequireArity(const std::vector<Value>& args, size_t n,
+                         const char* name) {
+  if (args.size() < n)
+    throw std::runtime_error(std::string(name) + " expects " +
+                             std::to_string(n) + " args, got " +
+                             std::to_string(args.size()));
+}
+
+static Value Fib(const std::vector<Value>& args) {
+  RequireArity(args, 1, "fib");
+  int64_t n = args[0].AsInt();
+  int64_t a = 0, b = 1;
+  for (int64_t k = 0; k < n; ++k) {
+    int64_t t = a + b;
+    a = b;
+    b = t;
+  }
+  return Value::Int(a);
+}
+RAY_TPU_REGISTER_TASK("fib", Fib);
+
+// Dense float32 vector scale: demonstrates the tagged-ndarray codec in
+// C++ task position (args: ndarray map, scalar).
+static Value Scale(const std::vector<Value>& args) {
+  RequireArity(args, 2, "scale");
+  const Value& nd = args[0];
+  double factor = args[1].AsFloat();
+  const Value* dtype = nd.Find("dtype");
+  const Value* data = nd.Find("data");
+  const Value* shape = nd.Find("shape");
+  if (dtype == nullptr || data == nullptr || shape == nullptr ||
+      dtype->AsStr() != "float32")
+    throw std::runtime_error("scale expects a float32 ndarray");
+  std::vector<uint8_t> out_bytes = data->AsBin();
+  float* f = reinterpret_cast<float*>(out_bytes.data());
+  for (size_t k = 0; k < out_bytes.size() / 4; ++k)
+    f[k] = float(f[k] * factor);
+  Value out = Value::Map();
+  out.Set("__nd__", Value::Int(1));
+  out.Set("dtype", Value::Str("float32"));
+  out.Set("shape", *shape);
+  out.Set("data", Value::Bin(std::move(out_bytes)));
+  return out;
+}
+RAY_TPU_REGISTER_TASK("scale", Scale);
+
+static Value Fail(const std::vector<Value>&) {
+  throw std::runtime_error("cpp task exploded");
+}
+RAY_TPU_REGISTER_TASK("fail", Fail);

@@ -86,6 +86,14 @@ int main(int argc, char** argv) {
   }
   check(threw, "remote_error_propagates");
 
+  // Full circle when the harness registered a C++ task library
+  // cluster-side (argv[2] == "with_cpp_tasks"): C++ driver -> cluster ->
+  // C++ task function.
+  if (argc >= 3 && std::strcmp(argv[2], "with_cpp_tasks") == 0) {
+    ObjectRef rf = c.Call("cpp_fib", {Value::Int(20)});
+    check(c.Get(rf).AsInt() == 6765, "cpp_to_cpp_task");
+  }
+
   // Release + disconnect must not throw.
   c.Release(refs);
   c.Disconnect();

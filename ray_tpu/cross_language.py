@@ -48,6 +48,106 @@ def resolve(func: str) -> Callable:
     return fn
 
 
+# ---------------------------------------------------------- C++ task libs
+class _CppFunction:
+    """A remote-able callable that executes a C++ task-library function
+    (reference: `cross_language.cpp_function`; architecture note in
+    `cpp/include/ray_tpu/task_lib.hpp` — the library is dlopen'd inside
+    the Python worker and called over a msgpack C ABI)."""
+
+    def __init__(self, lib_path: str, func_name: str):
+        self._lib_path = lib_path
+        self._func = func_name
+        self.__name__ = f"cpp:{func_name}"
+        self.__qualname__ = self.__name__
+
+    def __call__(self, *args, **kwargs):
+        import ctypes
+        import os
+
+        import msgpack
+
+        if kwargs:
+            raise TypeError(
+                f"C++ task '{self._func}' is positional-only (msgpack "
+                f"C ABI); got keyword args {sorted(kwargs)}")
+
+        # Resolve relative paths in the *worker's* cwd: with runtime_env
+        # working_dir the .so lands in the unpacked working dir, which is
+        # the worker's cwd — an absolute driver-side path would not exist
+        # on remote nodes.
+        path = self._lib_path
+        if not os.path.isabs(path):
+            path = os.path.join(os.getcwd(), path)
+        lib = _load_task_lib(path)
+        packed = msgpack.packb([encode(a) for a in args],
+                               use_bin_type=True)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        rc = lib.ray_tpu_call(
+            self._func.encode(), packed, len(packed),
+            ctypes.byref(out), ctypes.byref(out_len))
+        result = msgpack.unpackb(_read_and_free(lib, out, out_len),
+                                 raw=False)
+        if rc != 0:
+            names = _list_task_lib(lib)
+            raise RuntimeError(
+                f"C++ task '{self._func}' failed: {result} "
+                f"(library exports: {names})")
+        return decode(result)
+
+
+_TASK_LIBS: Dict[str, Any] = {}
+
+
+def _read_and_free(lib, out, out_len) -> bytes:
+    import ctypes
+
+    try:
+        return ctypes.string_at(out, out_len.value)
+    finally:
+        lib.ray_tpu_free(out)
+
+
+def _load_task_lib(path: str):
+    lib = _TASK_LIBS.get(path)
+    if lib is None:
+        import ctypes
+
+        lib = ctypes.CDLL(path)
+        lib.ray_tpu_call.restype = ctypes.c_int
+        lib.ray_tpu_call.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.ray_tpu_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.ray_tpu_list_tasks.restype = ctypes.c_int
+        lib.ray_tpu_list_tasks.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t)]
+        _TASK_LIBS[path] = lib
+    return lib
+
+
+def _list_task_lib(lib) -> list:
+    import ctypes
+
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    lib.ray_tpu_list_tasks(ctypes.byref(out), ctypes.byref(out_len))
+    raw = _read_and_free(lib, out, out_len)
+    return [n.decode() for n in raw.split(b"\0") if n]
+
+
+def cpp_function(lib_path: str, func_name: str) -> _CppFunction:
+    """A callable running `func_name` from a C++ task library; wrap with
+    ray_tpu.remote(...) to run it as a cluster task.  `lib_path` must be
+    reachable on the worker's filesystem; a *relative* path is resolved
+    in the worker's cwd, so ship the .so via runtime_env working_dir on
+    multi-node clusters and pass its in-package relative path."""
+    return _CppFunction(lib_path, func_name)
+
+
 # ------------------------------------------------------------ value codec
 def encode(value: Any) -> Any:
     """Python value -> msgpack-representable tree."""

@@ -38,7 +38,14 @@ def cpp_binary():
     return path
 
 
-def test_cpp_client_end_to_end(cpp_binary):
+@pytest.fixture(scope="module")
+def cpp_tasks_lib(cpp_binary):
+    path = os.path.join(CPP, "build", "libtasks.so")
+    assert os.path.exists(path), path
+    return path
+
+
+def test_cpp_client_end_to_end(cpp_binary, cpp_tasks_lib):
     import ray_tpu
     from ray_tpu import cross_language
     from ray_tpu.client.server import serve
@@ -49,17 +56,48 @@ def test_cpp_client_end_to_end(cpp_binary):
     cross_language.register("xlang_matmul_t", _xlang_matmul_t)
     cross_language.register("xlang_square", _xlang_square)
     cross_language.register("xlang_boom", _xlang_boom)
+    # C++-to-C++ circle: the C++ driver calls a C++ task-library fn.
+    cross_language.register(
+        "cpp_fib", cross_language.cpp_function(cpp_tasks_lib, "fib"))
     srv = serve(port=0, host="127.0.0.1")
     try:
-        proc = subprocess.run([cpp_binary, str(srv.port)],
+        proc = subprocess.run([cpp_binary, str(srv.port), "with_cpp_tasks"],
                               capture_output=True, text=True, timeout=180)
         print(proc.stdout)
         assert proc.returncode == 0, (proc.stdout, proc.stderr)
         lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
-        assert len(lines) >= 7
+        assert len(lines) >= 8
         assert all(ln.startswith("PASS") for ln in lines), proc.stdout
+        assert any("cpp_to_cpp_task" in ln for ln in lines)
     finally:
         srv.stop()
+        ray_tpu.shutdown()
+
+
+def test_cpp_function_as_cluster_task(cpp_tasks_lib):
+    """C++ task-library functions run as ordinary cluster tasks
+    (reference: cpp worker RAY_REMOTE; architecture note in
+    task_lib.hpp)."""
+    import ray_tpu
+    from ray_tpu.cross_language import cpp_function
+
+    ray_tpu.init(num_cpus=4, num_tpus=0,
+                 object_store_memory=128 * 1024 * 1024,
+                 ignore_reinit_error=True)
+    try:
+        fib = ray_tpu.remote(cpp_function(cpp_tasks_lib, "fib"))
+        assert ray_tpu.get(fib.remote(20), timeout=60) == 6765
+
+        scale = ray_tpu.remote(cpp_function(cpp_tasks_lib, "scale"))
+        out = ray_tpu.get(
+            scale.remote(np.array([1.0, 2.0], np.float32), 3.0),
+            timeout=60)
+        np.testing.assert_allclose(out, [3.0, 6.0])
+
+        boom = ray_tpu.remote(cpp_function(cpp_tasks_lib, "fail"))
+        with pytest.raises(Exception, match="exploded"):
+            ray_tpu.get(boom.remote(), timeout=60)
+    finally:
         ray_tpu.shutdown()
 
 
