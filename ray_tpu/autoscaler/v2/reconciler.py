@@ -35,9 +35,11 @@ class Reconciler:
         self.idle_timeout_s = idle_timeout_s
         self.adopt_untracked = adopt_untracked
         self.im = InstanceManager(
-            kv_get=lambda k: self._gcs.call("kv_get", key=k, timeout=30),
-            kv_put=lambda k, v: self._gcs.call("kv_put", key=k, value=v,
-                                               timeout=30))
+            kv_get=lambda k: self._gcs.call(
+                "kv_get", namespace="autoscaler", key=k, timeout=30),
+            kv_put=lambda k, v: self._gcs.call(
+                "kv_put", namespace="autoscaler", key=k, value=v,
+                timeout=30))
         self._idle_since: Dict[str, float] = {}
         self._missing_since: Dict[str, float] = {}
 
