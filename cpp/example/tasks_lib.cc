@@ -58,3 +58,43 @@ static Value Fail(const std::vector<Value>&) {
   throw std::runtime_error("cpp task exploded");
 }
 RAY_TPU_REGISTER_TASK("fail", Fail);
+
+// Stateful C++ actor: a counter with an ndarray-accumulating method,
+// driven from Python (cross_language.cpp_actor_class) or from the C++
+// driver client (full C++->cluster->C++ actor circle).
+class Counter : public ray_tpu::Actor {
+ public:
+  explicit Counter(const std::vector<Value>& args)
+      : n_(args.empty() ? 0 : args[0].AsInt()) {}
+
+  Value Call(const std::string& method,
+             const std::vector<Value>& args) override {
+    if (method == "inc") {
+      n_ += args.empty() ? 1 : args[0].AsInt();
+      return Value::Int(n_);
+    }
+    if (method == "get") return Value::Int(n_);
+    if (method == "accumulate") {
+      // Sum a float32 ndarray into the running total (rounded) —
+      // exercises the tagged-ndarray codec in actor position.
+      RequireArity(args, 1, "accumulate");
+      const Value* dtype = args[0].Find("dtype");
+      const Value* data = args[0].Find("data");
+      if (dtype == nullptr || data == nullptr ||
+          dtype->AsStr() != "float32")
+        throw std::runtime_error("accumulate expects a float32 ndarray");
+      const std::vector<uint8_t>& raw = data->AsBin();
+      const float* f = reinterpret_cast<const float*>(raw.data());
+      double total = 0.0;
+      for (size_t k = 0; k < raw.size() / 4; ++k) total += f[k];
+      n_ += static_cast<int64_t>(total);
+      return Value::Int(n_);
+    }
+    if (method == "fail") throw std::runtime_error("cpp actor exploded");
+    throw std::runtime_error("Counter has no method '" + method + "'");
+  }
+
+ private:
+  int64_t n_;
+};
+RAY_TPU_REGISTER_ACTOR("Counter", Counter);

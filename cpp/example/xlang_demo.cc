@@ -8,9 +8,11 @@
 
 #include "ray_tpu/client.hpp"
 
+using ray_tpu::ActorRef;
 using ray_tpu::Client;
 using ray_tpu::NDArray;
 using ray_tpu::ObjectRef;
+using ray_tpu::RpcError;
 using ray_tpu::Value;
 
 static int g_failures = 0;
@@ -88,10 +90,51 @@ int main(int argc, char** argv) {
 
   // Full circle when the harness registered a C++ task library
   // cluster-side (argv[2] == "with_cpp_tasks"): C++ driver -> cluster ->
-  // C++ task function.
+  // C++ task function, and a stateful C++ actor driven from C++.
   if (argc >= 3 && std::strcmp(argv[2], "with_cpp_tasks") == 0) {
     ObjectRef rf = c.Call("cpp_fib", {Value::Int(20)});
     check(c.Get(rf).AsInt() == 6765, "cpp_to_cpp_task");
+
+    ActorRef counter = c.CreateActor("CppCounter", {Value::Int(100)});
+    c.Get(c.ActorCall(counter, "inc", {Value::Int(5)}));
+    ObjectRef rn = c.ActorCall(counter, "inc", {Value::Int(5)});
+    check(c.Get(rn).AsInt() == 110, "cpp_to_cpp_actor");
+
+    // ndarray method + ordered delivery: accumulate [1,2,3] -> +6.
+    NDArray arr;
+    arr.dtype = "float32";
+    arr.shape = {3};
+    const float vals[3] = {1.0f, 2.0f, 3.0f};
+    arr.data.assign(reinterpret_cast<const uint8_t*>(vals),
+                    reinterpret_cast<const uint8_t*>(vals) + 12);
+    ObjectRef ra = c.ActorCall(counter, "accumulate", {arr.ToValue()});
+    check(c.Get(ra).AsInt() == 116, "cpp_actor_ndarray");
+
+    // Actor error propagates without killing the actor.
+    bool athrew = false;
+    try {
+      c.Get(c.ActorCall(counter, "fail", {}));
+    } catch (const RpcError&) {
+      athrew = true;
+    }
+    check(athrew, "cpp_actor_error");
+    ObjectRef rg = c.ActorCall(counter, "get", {});
+    check(c.Get(rg).AsInt() == 116, "cpp_actor_survives_error");
+
+    c.KillActor(counter);
+    c.ReleaseActor(counter);
+
+    // Named actor: create under a name, re-resolve via GetActor, and
+    // observe the SAME instance's state.
+    ActorRef named = c.CreateActor("CppCounter", {Value::Int(7)},
+                                   "cpp-named-counter");
+    c.Get(c.ActorCall(named, "inc", {Value::Int(1)}));
+    ActorRef again = c.GetActor("cpp-named-counter");
+    ObjectRef rv = c.ActorCall(again, "get", {});
+    check(c.Get(rv).AsInt() == 8, "cpp_named_actor_lookup");
+    c.KillActor(named);
+    c.ReleaseActor(named);
+    c.ReleaseActor(again);
   }
 
   // Release + disconnect must not throw.

@@ -31,6 +31,11 @@ struct ObjectRef {
   std::string id;  // binary object id
 };
 
+// A cluster actor, pinned server-side until ReleaseActor/disconnect.
+struct ActorRef {
+  std::string id;  // binary actor id
+};
+
 // Dense ndarray helper: the {"__nd__":1,...} tagged map of
 // cross_language.py.
 struct NDArray {
@@ -74,6 +79,28 @@ class Client {
 
   // Drop server-side pins (cluster GC can reclaim).
   void Release(const std::vector<ObjectRef>& refs);
+
+  // ----------------------------------------------------------- actors
+  // Create a cluster actor from a cross-language symbol: a name
+  // registered via ray_tpu.cross_language.register (e.g. a
+  // cpp_actor_class, closing the C++->cluster->C++ actor circle) or an
+  // importable "module:Class". Non-empty `name` makes it a named actor
+  // retrievable via GetActor.
+  ActorRef CreateActor(const std::string& cls,
+                       const std::vector<Value>& args,
+                       const std::string& name = "");
+
+  // Invoke a method; fetch the result with Get().
+  ObjectRef ActorCall(const ActorRef& actor, const std::string& method,
+                      const std::vector<Value>& args);
+
+  // Look up a named actor (ray_tpu options(name=...)).
+  ActorRef GetActor(const std::string& name);
+
+  void KillActor(const ActorRef& actor, bool no_restart = true);
+
+  // Drop the server-side pin (does not kill the actor).
+  void ReleaseActor(const ActorRef& actor);
 
   void Disconnect();
 

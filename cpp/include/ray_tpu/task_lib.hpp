@@ -48,16 +48,60 @@ struct TaskRegistrar {
   }
 };
 
+// ------------------------------------------------------------- actors
+// A C++ actor class: constructed with the actor's __init__ args, then
+// dispatched by method name. One instance lives for the actor's
+// lifetime inside the hosting Python actor worker; our actors are
+// single-threaded by default (ordered per-caller queues), so Call never
+// races with itself unless max_concurrency>1 is requested — guard your
+// state if you opt into that.
+class Actor {
+ public:
+  virtual ~Actor() = default;
+  virtual Value Call(const std::string& method,
+                     const std::vector<Value>& args) = 0;
+};
+
+using ActorFactory = std::function<Actor*(const std::vector<Value>&)>;
+
+inline std::map<std::string, ActorFactory>& actor_registry() {
+  static std::map<std::string, ActorFactory> registry;
+  return registry;
+}
+
+struct ActorRegistrar {
+  ActorRegistrar(const char* name, ActorFactory fn) {
+    actor_registry()[name] = std::move(fn);
+  }
+};
+
 }  // namespace ray_tpu
 
 #define RAY_TPU_REGISTER_TASK(name, fn) \
   static ::ray_tpu::TaskRegistrar _ray_tpu_reg_##fn(name, fn)
 
+// Class must be constructible from `const std::vector<Value>&`.
+#define RAY_TPU_REGISTER_ACTOR(name, Class)                            \
+  static ::ray_tpu::ActorRegistrar _ray_tpu_areg_##Class(              \
+      name, [](const std::vector<::ray_tpu::Value>& a)                 \
+                -> ::ray_tpu::Actor* { return new Class(a); })
+
 // ------------------------------------------------------------- C ABI
-// One library exports exactly these three symbols (defined by including
-// this header in ONE translation unit with RAY_TPU_TASK_LIB_MAIN).
+// A library exports this fixed symbol set — tasks: ray_tpu_call,
+// ray_tpu_free, ray_tpu_list_tasks; actors (optional; the Python loader
+// degrades to task-only when absent): ray_tpu_actor_new,
+// ray_tpu_actor_call, ray_tpu_actor_free, ray_tpu_list_actors.
+// All are defined by including this header in ONE translation unit with
+// RAY_TPU_TASK_LIB_MAIN.
 #ifdef RAY_TPU_TASK_LIB_MAIN
 extern "C" {
+
+static void _ray_tpu_pack_out(const std::string& s, uint8_t** out,
+                              size_t* out_len) {
+  *out = static_cast<uint8_t*>(std::malloc(s.size()));
+  std::memcpy(*out, s.data(), s.size());
+  *out_len = s.size();
+}
 
 // Returns 0 on success; *out/*out_len = malloc'd msgpack result.
 // On failure returns 1 and *out carries a msgpack string (the error).
@@ -86,13 +130,87 @@ int ray_tpu_call(const char* func_name, const uint8_t* args_buf,
         Value::Str("non-standard C++ exception"));
     rc = 1;
   }
-  *out = static_cast<uint8_t*>(std::malloc(result.size()));
-  std::memcpy(*out, result.data(), result.size());
-  *out_len = result.size();
+  _ray_tpu_pack_out(result, out, out_len);
   return rc;
 }
 
 void ray_tpu_free(uint8_t* p) { std::free(p); }
+
+// --------------------------------------------------------- actor ABI
+// Handles are heap Actor*; the hosting worker owns exactly one per
+// Python-side actor instance and frees it on actor teardown.
+
+// 0 = ok (*out_handle set); 1 = error (*out carries msgpack err string).
+int ray_tpu_actor_new(const char* cls_name, const uint8_t* args_buf,
+                      size_t args_len, void** out_handle, uint8_t** out,
+                      size_t* out_len) {
+  using ray_tpu::Value;
+  *out_handle = nullptr;
+  std::string result;
+  int rc = 0;
+  try {
+    auto& reg = ray_tpu::actor_registry();
+    auto it = reg.find(cls_name);
+    if (it == reg.end())
+      throw std::runtime_error(std::string("no registered C++ actor '") +
+                               cls_name + "'");
+    std::string packed(reinterpret_cast<const char*>(args_buf), args_len);
+    Value args = ray_tpu::msgpack_lite::decode(packed);
+    *out_handle = it->second(args.arr);
+    result = ray_tpu::msgpack_lite::encode(Value::Nil());
+  } catch (const std::exception& e) {
+    result = ray_tpu::msgpack_lite::encode(Value::Str(e.what()));
+    rc = 1;
+  } catch (...) {
+    result = ray_tpu::msgpack_lite::encode(
+        Value::Str("non-standard C++ exception"));
+    rc = 1;
+  }
+  _ray_tpu_pack_out(result, out, out_len);
+  return rc;
+}
+
+int ray_tpu_actor_call(void* handle, const char* method,
+                       const uint8_t* args_buf, size_t args_len,
+                       uint8_t** out, size_t* out_len) {
+  using ray_tpu::Value;
+  std::string result;
+  int rc = 0;
+  try {
+    if (handle == nullptr) throw std::runtime_error("null actor handle");
+    std::string packed(reinterpret_cast<const char*>(args_buf), args_len);
+    Value args = ray_tpu::msgpack_lite::decode(packed);
+    Value ret = static_cast<ray_tpu::Actor*>(handle)->Call(method,
+                                                           args.arr);
+    result = ray_tpu::msgpack_lite::encode(ret);
+  } catch (const std::exception& e) {
+    result = ray_tpu::msgpack_lite::encode(Value::Str(e.what()));
+    rc = 1;
+  } catch (...) {
+    result = ray_tpu::msgpack_lite::encode(
+        Value::Str("non-standard C++ exception"));
+    rc = 1;
+  }
+  _ray_tpu_pack_out(result, out, out_len);
+  return rc;
+}
+
+void ray_tpu_actor_free(void* handle) {
+  delete static_cast<ray_tpu::Actor*>(handle);
+}
+
+// Registered actor class names, same NUL-joined form as
+// ray_tpu_list_tasks.
+int ray_tpu_list_actors(uint8_t** out, size_t* out_len) {
+  std::string names;
+  for (const auto& kv : ray_tpu::actor_registry()) {
+    names += kv.first;
+    names.push_back('\0');
+  }
+  names.push_back('\0');
+  _ray_tpu_pack_out(names, out, out_len);
+  return 0;
+}
 
 // Registered task names as a NUL-joined, double-NUL-terminated list the
 // caller must ray_tpu_free (introspection for error messages/tooling).
@@ -103,9 +221,7 @@ int ray_tpu_list_tasks(uint8_t** out, size_t* out_len) {
     names.push_back('\0');
   }
   names.push_back('\0');
-  *out = static_cast<uint8_t*>(std::malloc(names.size()));
-  std::memcpy(*out, names.data(), names.size());
-  *out_len = names.size();
+  _ray_tpu_pack_out(names, out, out_len);
   return 0;
 }
 

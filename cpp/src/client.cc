@@ -189,6 +189,52 @@ void Client::Release(const std::vector<ObjectRef>& refs) {
   Request("client_release", std::move(kw));
 }
 
+ActorRef Client::CreateActor(const std::string& cls,
+                             const std::vector<Value>& args,
+                             const std::string& name) {
+  Value kw = Value::Map();
+  kw.Set("cls", Value::Str(cls));
+  kw.Set("args", Value::Array(args));
+  if (!name.empty()) {
+    Value opt = Value::Map();
+    opt.Set("name", Value::Str(name));
+    kw.Set("options", std::move(opt));
+  }
+  Value id = Request("client_xlang_create_actor", std::move(kw));
+  return ActorRef{std::string(id.AsBin().begin(), id.AsBin().end())};
+}
+
+ObjectRef Client::ActorCall(const ActorRef& actor,
+                            const std::string& method,
+                            const std::vector<Value>& args) {
+  Value kw = Value::Map();
+  kw.Set("actor_id", Value::Bin(actor.id.data(), actor.id.size()));
+  kw.Set("method", Value::Str(method));
+  kw.Set("args", Value::Array(args));
+  Value id = Request("client_xlang_actor_call", std::move(kw));
+  return ObjectRef{std::string(id.AsBin().begin(), id.AsBin().end())};
+}
+
+ActorRef Client::GetActor(const std::string& name) {
+  Value kw = Value::Map();
+  kw.Set("name", Value::Str(name));
+  Value id = Request("client_xlang_get_actor", std::move(kw));
+  return ActorRef{std::string(id.AsBin().begin(), id.AsBin().end())};
+}
+
+void Client::KillActor(const ActorRef& actor, bool no_restart) {
+  Value kw = Value::Map();
+  kw.Set("actor_id", Value::Bin(actor.id.data(), actor.id.size()));
+  kw.Set("no_restart", Value::Bool(no_restart));
+  Request("client_kill_actor", std::move(kw));
+}
+
+void Client::ReleaseActor(const ActorRef& actor) {
+  Value kw = Value::Map();
+  kw.Set("actor_id", Value::Bin(actor.id.data(), actor.id.size()));
+  Request("client_release_actor", std::move(kw));
+}
+
 void Client::Disconnect() {
   Request("client_disconnect", Value::Map());
 }

@@ -32,10 +32,12 @@ class ClientServer:
                      # msgpack-typed surface for non-Python frontends
                      # (the C++ client in cpp/): see cross_language.py.
                      "xlang_call", "xlang_get", "xlang_put",
-                     "xlang_wait"]:
+                     "xlang_wait", "xlang_create_actor",
+                     "xlang_actor_call", "xlang_get_actor"]:
             self._server.register(f"client_{name}",
                                   getattr(self, f"_h_{name}"))
         self._xlang_fns: Dict[str, Any] = {}
+        self._xlang_actor_cls: Dict[str, Any] = {}
 
     def start(self) -> int:
         return self._server.start()
@@ -183,6 +185,53 @@ class ClientServer:
                                        timeout=wait_timeout))
         return [[r.binary() for r in ready],
                 [r.binary() for r in pending]]
+
+    async def _h_xlang_create_actor(self, cls, args, options=None):
+        """Create an actor from a cross-language symbol (a name
+        registered via cross_language.register — e.g. a cpp_actor_class
+        — or an importable "module:Class"); msgpack-typed args. Returns
+        the actor id (bytes); kill/release ride the existing
+        client_kill_actor / client_release_actor methods, whose
+        payloads are already msgpack-representable."""
+        import ray_tpu
+        from ray_tpu.cross_language import decode, resolve
+
+        acls = self._xlang_actor_cls.get(cls)
+        if acls is None:
+            acls = ray_tpu.remote(resolve(cls))
+            self._xlang_actor_cls[cls] = acls
+        if options:
+            acls = acls.options(**options)
+        call_args = [decode(a) for a in (args or [])]
+        handle = await self._blocking(lambda: acls.remote(*call_args))
+        self._actors[handle._actor_id] = handle
+        return handle._actor_id
+
+    async def _h_xlang_actor_call(self, actor_id, method, args):
+        """Invoke a method on a pinned actor with msgpack-typed args;
+        returns the result ref id (fetch via client_xlang_get)."""
+        from ray_tpu.cross_language import decode
+
+        handle = self._actors.get(actor_id)
+        if handle is None:
+            raise KeyError(
+                f"unknown or released actor {actor_id!r}; create it via "
+                f"xlang_create_actor or look it up via xlang_get_actor")
+        call_args = [decode(a) for a in (args or [])]
+        refs = await self._blocking(
+            lambda: getattr(handle, method).remote(*call_args))
+        ref = refs if not isinstance(refs, (list, tuple)) else refs[0]
+        return self._pin(ref)
+
+    async def _h_xlang_get_actor(self, name, namespace=None):
+        from ray_tpu._private.worker import global_worker
+
+        # Named actors register under "default" when no namespace is
+        # given; passing None through would miss every one of them.
+        handle = await self._blocking(global_worker().get_actor, name,
+                                      namespace or "default")
+        self._actors[handle._actor_id] = handle
+        return handle._actor_id
 
     async def _h_wait(self, object_ids, num_returns, wait_timeout,
                       fetch_local):

@@ -125,6 +125,22 @@ def _load_task_lib(path: str):
         lib.ray_tpu_list_tasks.argtypes = [
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.c_size_t)]
+        try:  # actor ABI is optional (task-only libraries lack it)
+            lib.ray_tpu_actor_new.restype = ctypes.c_int
+            lib.ray_tpu_actor_new.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_size_t)]
+            lib.ray_tpu_actor_call.restype = ctypes.c_int
+            lib.ray_tpu_actor_call.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_size_t)]
+            lib.ray_tpu_actor_free.argtypes = [ctypes.c_void_p]
+        except AttributeError:
+            pass
         _TASK_LIBS[path] = lib
     return lib
 
@@ -137,6 +153,88 @@ def _list_task_lib(lib) -> list:
     lib.ray_tpu_list_tasks(ctypes.byref(out), ctypes.byref(out_len))
     raw = _read_and_free(lib, out, out_len)
     return [n.decode() for n in raw.split(b"\0") if n]
+
+
+class _CppActorBase:
+    """Instance side of a C++ actor class: the constructor runs INSIDE
+    the actor worker process, dlopens the task library, and instantiates
+    the registered C++ actor; method calls dispatch by name over the
+    msgpack C ABI (reference: the cpp worker's RAY_REMOTE actor classes;
+    architecture note in `cpp/include/ray_tpu/task_lib.hpp`)."""
+
+    _LIB: str = ""
+    _CLS: str = ""
+
+    def __init__(self, *args):
+        import ctypes
+        import os
+
+        import msgpack
+
+        path = self._LIB
+        if not os.path.isabs(path):
+            path = os.path.join(os.getcwd(), path)
+        lib = _load_task_lib(path)
+        packed = msgpack.packb([encode(a) for a in args],
+                               use_bin_type=True)
+        handle = ctypes.c_void_p()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        rc = lib.ray_tpu_actor_new(
+            self._CLS.encode(), packed, len(packed),
+            ctypes.byref(handle), ctypes.byref(out), ctypes.byref(out_len))
+        err = msgpack.unpackb(_read_and_free(lib, out, out_len), raw=False)
+        if rc != 0:
+            raise RuntimeError(
+                f"C++ actor '{self._CLS}' construction failed: {err}")
+        self._lib = lib
+        self._handle = handle
+
+    def __getattr__(self, method):
+        # Worker-side dispatch: the runtime getattrs the instance by
+        # method name, so C++ methods need no Python declarations.
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def _call(*args):
+            import ctypes
+
+            import msgpack
+
+            packed = msgpack.packb([encode(a) for a in args],
+                                   use_bin_type=True)
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            out_len = ctypes.c_size_t()
+            rc = self._lib.ray_tpu_actor_call(
+                self._handle, method.encode(), packed, len(packed),
+                ctypes.byref(out), ctypes.byref(out_len))
+            result = msgpack.unpackb(
+                _read_and_free(self._lib, out, out_len), raw=False)
+            if rc != 0:
+                raise RuntimeError(
+                    f"C++ actor method '{self._CLS}.{method}' failed: "
+                    f"{result}")
+            return decode(result)
+
+        return _call
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            try:
+                self._lib.ray_tpu_actor_free(handle)
+            except Exception:
+                pass
+            self._handle = None
+
+
+def cpp_actor_class(lib_path: str, cls_name: str) -> type:
+    """A Python actor class backed by a C++ actor from a task library;
+    wrap with ray_tpu.remote(...) and use like any actor.  Path rules
+    match cpp_function (relative paths resolve in the worker's cwd)."""
+    cls = type(f"Cpp{cls_name}", (_CppActorBase,),
+               {"_LIB": lib_path, "_CLS": cls_name})
+    return cls
 
 
 def cpp_function(lib_path: str, func_name: str) -> _CppFunction:

@@ -56,9 +56,13 @@ def test_cpp_client_end_to_end(cpp_binary, cpp_tasks_lib):
     cross_language.register("xlang_matmul_t", _xlang_matmul_t)
     cross_language.register("xlang_square", _xlang_square)
     cross_language.register("xlang_boom", _xlang_boom)
-    # C++-to-C++ circle: the C++ driver calls a C++ task-library fn.
+    # C++-to-C++ circle: the C++ driver calls a C++ task-library fn and
+    # drives a C++ actor class.
     cross_language.register(
         "cpp_fib", cross_language.cpp_function(cpp_tasks_lib, "fib"))
+    cross_language.register(
+        "CppCounter",
+        cross_language.cpp_actor_class(cpp_tasks_lib, "Counter"))
     srv = serve(port=0, host="127.0.0.1")
     try:
         proc = subprocess.run([cpp_binary, str(srv.port), "with_cpp_tasks"],
@@ -66,9 +70,12 @@ def test_cpp_client_end_to_end(cpp_binary, cpp_tasks_lib):
         print(proc.stdout)
         assert proc.returncode == 0, (proc.stdout, proc.stderr)
         lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
-        assert len(lines) >= 8
+        assert len(lines) >= 14
         assert all(ln.startswith("PASS") for ln in lines), proc.stdout
-        assert any("cpp_to_cpp_task" in ln for ln in lines)
+        for probe in ("cpp_to_cpp_task", "cpp_to_cpp_actor",
+                      "cpp_actor_ndarray", "cpp_actor_survives_error",
+                      "cpp_named_actor_lookup"):
+            assert any(probe in ln for ln in lines), (probe, proc.stdout)
     finally:
         srv.stop()
         ray_tpu.shutdown()
@@ -97,6 +104,40 @@ def test_cpp_function_as_cluster_task(cpp_tasks_lib):
         boom = ray_tpu.remote(cpp_function(cpp_tasks_lib, "fail"))
         with pytest.raises(Exception, match="exploded"):
             ray_tpu.get(boom.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_cpp_actor_class_as_cluster_actor(cpp_tasks_lib):
+    """C++ actor classes run as ordinary cluster actors from Python
+    (reference: cpp worker RAY_REMOTE actor classes; architecture note
+    in task_lib.hpp)."""
+    import ray_tpu
+    from ray_tpu.cross_language import cpp_actor_class
+
+    ray_tpu.init(num_cpus=4, num_tpus=0,
+                 object_store_memory=128 * 1024 * 1024,
+                 ignore_reinit_error=True)
+    try:
+        Counter = ray_tpu.remote(cpp_actor_class(cpp_tasks_lib, "Counter"))
+        c = Counter.remote(10)
+        assert ray_tpu.get(c.inc.remote(), timeout=60) == 11
+        assert ray_tpu.get(c.inc.remote(5), timeout=60) == 16
+        out = ray_tpu.get(
+            c.accumulate.remote(np.array([1.0, 2.0], np.float32)),
+            timeout=60)
+        assert out == 19
+
+        # C++ exceptions surface as task errors; state survives.
+        with pytest.raises(Exception, match="exploded"):
+            ray_tpu.get(c.fail.remote(), timeout=60)
+        assert ray_tpu.get(c.get.remote(), timeout=60) == 19
+
+        # Two instances do not share state.
+        c2 = Counter.remote()
+        assert ray_tpu.get(c2.get.remote(), timeout=60) == 0
+        ray_tpu.kill(c)
+        ray_tpu.kill(c2)
     finally:
         ray_tpu.shutdown()
 
