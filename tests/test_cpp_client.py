@@ -142,6 +142,28 @@ def test_cpp_actor_class_as_cluster_actor(cpp_tasks_lib):
         ray_tpu.shutdown()
 
 
+def test_cpp_function_shipped_via_working_dir(cpp_tasks_lib, tmp_path):
+    """The documented multi-node mechanism: ship the .so via
+    runtime_env working_dir and reference it by RELATIVE path — the
+    worker resolves it in its unpacked working dir (cross_language
+    docstrings; reference: runtime_env code shipping)."""
+    import shutil
+
+    import ray_tpu
+    from ray_tpu.cross_language import cpp_function
+
+    shutil.copy(cpp_tasks_lib, tmp_path / "shipped_tasks.so")
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 object_store_memory=128 * 1024 * 1024,
+                 ignore_reinit_error=True)
+    try:
+        fib = ray_tpu.remote(cpp_function("shipped_tasks.so", "fib"))
+        fib = fib.options(runtime_env={"working_dir": str(tmp_path)})
+        assert ray_tpu.get(fib.remote(10), timeout=120) == 55
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_msgpack_value_codec_roundtrip():
     """The C++ msgpack_lite subset against the Python msgpack encoder:
     cross-decode both directions through the cross_language value codec."""
