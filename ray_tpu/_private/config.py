@@ -76,6 +76,12 @@ _define("scheduler_spread_threshold", float, 0.5,
         "Critical resource utilization below which the hybrid policy packs "
         "onto the local/first node instead of spreading.")
 _define("worker_lease_timeout_ms", int, 30000, "")
+_define("actor_unreachable_timeout_s", float, 120.0,
+        "How long the actor delivery layer keeps resending the same "
+        "frames (same seqs — dedup'd by the worker) to an actor that is "
+        "ALIVE with an unchanged incarnation but unreachable, before "
+        "surfacing ActorUnavailableError. Oversubscribed hosts can "
+        "CPU-starve healthy workers past many connect timeouts.")
 _define("max_workers_per_node", int, 0,
         "Cap on pooled workers per node; 0 means #CPUs.")
 _define("worker_pool_idle_ttl_s", float, 600.0,

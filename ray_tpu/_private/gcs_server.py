@@ -186,24 +186,32 @@ class GcsServer:
                       f"({type(e).__name__}: {e}); starting without "
                       "recovered state", file=sys.stderr, flush=True)
 
-    def _write_snapshot(self) -> None:
+    def _build_snapshot(self) -> dict:
+        """Consistent one-level-deep copies, taken ON the event loop so
+        handler mutations can't race the pickle (observed under a
+        500-actor storm: 'dictionary changed size during iteration'
+        from the executor thread)."""
+        actors = {}
+        for aid, rec in list(self.actors.items()):
+            actors[aid] = {k: v for k, v in rec.items() if k != "handle"}
+        return {
+            "kv": {ns: dict(entries)
+                   for ns, entries in self.kv.items()},
+            "jobs": {k: dict(v) if isinstance(v, dict) else v
+                     for k, v in self.jobs.items()},
+            "next_job_int": self._next_job_int,
+            "actors": actors,
+            "named_actors": dict(self.named_actors),
+            "pgs": {k: dict(v) if isinstance(v, dict) else v
+                    for k, v in self.placement_groups.items()},
+        }
+
+    def _write_snapshot(self, snap: dict) -> None:
         import pickle
 
         tmp = self._snapshot_path + ".tmp"
         with open(tmp, "wb") as f:
-            actors = {}
-            for aid, rec in self.actors.items():
-                slim = {k: v for k, v in rec.items() if k != "handle"}
-                actors[aid] = slim
-            pickle.dump({
-                "kv": {ns: dict(entries)
-                       for ns, entries in self.kv.items()},
-                "jobs": dict(self.jobs),
-                "next_job_int": self._next_job_int,
-                "actors": actors,
-                "named_actors": dict(self.named_actors),
-                "pgs": dict(self.placement_groups),
-            }, f)
+            pickle.dump(snap, f)
         os.replace(tmp, self._snapshot_path)
 
     async def _snapshot_loop(self):
@@ -215,10 +223,12 @@ class GcsServer:
                 continue
             self._snapshot_dirty = False
             try:
-                # The pickle+write runs off-loop: a large KV (exported
-                # functions) must not stall heartbeat handling.
+                # Copies on-loop (consistent), pickle+write off-loop: a
+                # large KV (exported functions) must not stall
+                # heartbeat handling.
+                snap = self._build_snapshot()
                 await asyncio.get_running_loop().run_in_executor(
-                    None, self._write_snapshot)
+                    None, self._write_snapshot, snap)
                 self._snapshot_errors = 0
             except Exception as e:
                 self._snapshot_dirty = True
@@ -534,18 +544,83 @@ class GcsServer:
             if a.get("node_id") == node_id and a["state"] == ALIVE:
                 await self._on_actor_failure(actor_id, f"node died: {reason}")
 
+    @staticmethod
+    def _tcp_alive(addr, timeout=2.0) -> bool:
+        import socket as _socket
+
+        try:
+            _socket.create_connection(tuple(addr), timeout=timeout).close()
+            return True
+        except ConnectionRefusedError:
+            return False  # nothing listening: the process is gone
+        except OSError:
+            # Timeout / transient network error: INDETERMINATE — a
+            # stalled raylet with a full accept backlog drops SYNs, and
+            # calling that dead would re-create the mass-kill this probe
+            # exists to prevent. Defer; the hard cap still bounds a
+            # truly wedged node.
+            return True
+
     async def _health_loop(self):
+        """Passive heartbeat age + ACTIVE liveness probe (reference:
+        gcs_health_check_manager.cc does an active per-node check, not
+        just heartbeat bookkeeping). A stale heartbeat alone conflates
+        BUSY with DEAD: on an oversubscribed host a raylet booting
+        hundreds of workers can stall its loop past the passive
+        threshold while its process is perfectly alive — observed as
+        'node DEAD after 6.2s' mass-killing 86 healthy actors. The TCP
+        probe discriminates: the kernel completes the handshake from
+        the listen backlog even when the event loop is stalled, so
+        connect-success means alive-but-busy (defer death, up to a
+        hard cap) and connect-refused means the process is gone (die
+        at the fast passive threshold, keeping node-failure detection
+        prompt for real crashes)."""
         period = GlobalConfig.health_check_period_ms / 1000
         threshold = GlobalConfig.health_check_failure_threshold
+        hard_cap = period * threshold * 12  # truly wedged: still dies
+        deferred = set()
+        loop = asyncio.get_running_loop()
         while True:
             await asyncio.sleep(period)
             now = time.monotonic()
+            suspects = []
             for node_id, last in list(self._last_heartbeat.items()):
                 info = self.nodes.get(node_id)
                 if info is None or info["state"] == DEAD:
                     continue
-                if now - last > period * threshold:
-                    await self._mark_node_dead(node_id, "health check failed")
+                age = now - last
+                if age <= period * threshold:
+                    deferred.discard(node_id)
+                    continue
+                suspects.append((node_id, age, info["addr"]))
+            if not suspects:
+                continue
+            # Probe CONCURRENTLY: N simultaneously-stale nodes (the
+            # motivating storm) must not serialize into 2s x N sweeps
+            # that delay detecting a genuinely crashed node.
+            alive = await asyncio.gather(*[
+                loop.run_in_executor(None, self._tcp_alive, addr)
+                for _, _, addr in suspects])
+            for (node_id, age, _addr), is_alive in zip(suspects, alive):
+                # Re-check JUST before the kill decision: a heartbeat
+                # can arrive during the probe window, and killing on
+                # the stale snapshot shot a node whose last heartbeat
+                # was 0.66s old (observed).
+                last = self._last_heartbeat.get(node_id)
+                if (last is not None
+                        and time.monotonic() - last <= period * threshold):
+                    deferred.discard(node_id)
+                    continue
+                if age < hard_cap and is_alive:
+                    if node_id not in deferred:
+                        deferred.add(node_id)
+                        print(f"[gcs] node {node_id.hex()[:8]} heartbeat "
+                              f"stale ({age:.1f}s) but TCP-alive; "
+                              f"deferring death (busy host)",
+                              file=sys.stderr, flush=True)
+                    continue
+                deferred.discard(node_id)
+                await self._mark_node_dead(node_id, "health check failed")
 
     def _client_for_node(self, node_id) -> Optional[RpcClient]:
         info = self.nodes.get(node_id)
@@ -758,6 +833,9 @@ class GcsServer:
         if a is None or a["state"] == DEAD:
             return
         spec = a["spec"]
+        print(f"[gcs] actor {actor_id.hex()[:12]} failed "
+              f"(restarts_used={a['restarts_used']}/{spec.max_restarts}): "
+              f"{cause}", file=sys.stderr, flush=True)
         if a["restarts_used"] < spec.max_restarts or spec.max_restarts == -1:
             a["restarts_used"] += 1
             a["state"] = RESTARTING
@@ -1123,7 +1201,7 @@ def main():
 
         def _final_snapshot(*_):
             try:
-                gcs._write_snapshot()
+                gcs._write_snapshot(gcs._build_snapshot())
             except Exception:
                 pass
             os._exit(0)

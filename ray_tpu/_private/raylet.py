@@ -54,6 +54,14 @@ class _WorkerHandle:
         self.lease: Optional[Dict[str, Any]] = None  # demand + tpu ids
         self.is_actor = False
         self.actor_id: Optional[bytes] = None
+        # Bumped on every grant (task lease OR dedicated-actor lease).
+        # return_worker must echo it back: a return processed late — a
+        # slow raylet can apply a frame a minute after it was sent —
+        # must not be able to strip a lease the worker acquired SINCE
+        # (observed: a stale task-lease return re-offered a worker that
+        # had become a dedicated ACTOR worker, and the next task-lease
+        # failure path SIGKILLed the actor).
+        self.lease_epoch = 0
         self.last_idle = time.monotonic()
         # Set when the worker registers (or dies before registering) —
         # the spawn throttle waits on this instead of polling.
@@ -154,7 +162,25 @@ class Raylet:
         io.submit(self._log_monitor_loop())
         io.submit(self._memory_monitor_loop())
         io.submit(self._reporter_loop())
+        io.submit(self._stall_watchdog())
         return port
+
+    async def _stall_watchdog(self):
+        """Log when this raylet's event loop stops turning (reference:
+        instrumented_io_context's lag stats). A stalled loop silently
+        breaks heartbeats, worker pings, and lease handling — the log
+        line turns 'mystery mass worker death' into a diagnosis."""
+        last = time.monotonic()
+        while not self._dead:
+            await asyncio.sleep(1.0)
+            now = time.monotonic()
+            gap = now - last - 1.0
+            if gap > 5.0:
+                sys.stderr.write(
+                    f"[raylet {self.node_id.hex()[:8]}] event loop "
+                    f"stalled {gap:.1f}s (workers={len(self.workers)})\n")
+                sys.stderr.flush()
+            last = now
 
     def _register_handlers(self):
         s = self.server
@@ -398,6 +424,7 @@ class Raylet:
                "--node-id", self.node_id.hex(),
                "--worker-id", worker_id.hex(),
                "--job-id", job_id.hex(),
+               "--raylet-pid", str(os.getpid()),
                "--session-dir", self.session_dir]
         loop = asyncio.get_running_loop()
         # The concurrency slot covers ONLY fork + interpreter boot — not
@@ -465,6 +492,18 @@ class Raylet:
             if not fut.done():
                 fut.set_result(handle)
                 return
+        # Pool hard cap: beyond max_workers idle processes per pool,
+        # retire instead of hoarding — an idle worker is ~150 MB RSS
+        # plus a heartbeat loop, and churn-heavy workloads otherwise
+        # accumulate them without bound.
+        if len(self._idle[handle.pool_key]) >= self._max_workers:
+            self.workers.pop(handle.worker_id, None)
+            self._release_worker_env(handle)
+            try:
+                handle.proc.kill()
+            except Exception:
+                pass
+            return
         handle.last_idle = time.monotonic()
         self._idle[handle.pool_key].append(handle)
 
@@ -530,10 +569,35 @@ class Raylet:
         except asyncio.TimeoutError:
             return None
 
+    def _sweep_idle_ttl(self) -> None:
+        """Enforce worker_pool_idle_ttl_s: pooled workers idle past the
+        TTL are killed down to the warm floor. Without this, phase
+        churn accumulates workers without bound (observed: 893 live
+        worker processes after an actor storm — each one's idle
+        heartbeat loop then taxes the whole host)."""
+        ttl = GlobalConfig.worker_pool_idle_ttl_s
+        if ttl <= 0:
+            return
+        now = time.monotonic()
+        floor = GlobalConfig.worker_pool_min_idle
+        for pool_key, idle in list(self._idle.items()):
+            while len(idle) > floor and now - idle[0].last_idle > ttl:
+                handle = idle.popleft()
+                self.workers.pop(handle.worker_id, None)
+                self._release_worker_env(handle)
+                try:
+                    handle.proc.kill()
+                except Exception:
+                    pass
+
     async def _reaper_loop(self):
         """Detect dead worker processes; report actor deaths to GCS."""
+        last_ttl_sweep = time.monotonic()
         while not self._dead:
             await asyncio.sleep(0.2)
+            if time.monotonic() - last_ttl_sweep > 5.0:
+                last_ttl_sweep = time.monotonic()
+                self._sweep_idle_ttl()
             for worker_id, handle in list(self.workers.items()):
                 code = handle.proc.poll()
                 if code is None:
@@ -865,8 +929,10 @@ class Raylet:
             return {"timeout": True}
         handle.lease = {"demand": demand, "tpu_ids": tpu_ids}
         handle.lease_ts = time.monotonic()
+        handle.lease_epoch += 1
         return {"granted": True, "worker_addr": handle.addr,
-                "worker_id": handle.worker_id, "tpu_ids": tpu_ids}
+                "worker_id": handle.worker_id, "tpu_ids": tpu_ids,
+                "lease_token": handle.lease_epoch}
 
     @staticmethod
     def _pg_tpu_demand(demand: ResourceSet):
@@ -1005,9 +1071,25 @@ class Raylet:
                                           runtime_env))
             await asyncio.sleep(0.005)
 
-    async def _h_return_worker(self, worker_id, kill=False):
+    async def _h_return_worker(self, worker_id, kill=False,
+                               lease_token=None):
         handle = self.workers.get(worker_id)
         if handle is None:
+            return False
+        # Reject stale returns: a return frame can be processed long
+        # after it was sent (busy raylet), by which time the worker may
+        # hold a NEWER lease — possibly as a dedicated actor. Applying
+        # the stale return would strip that lease, re-offer the worker
+        # to the idle pool, and let a later task-lease failure SIGKILL
+        # a live actor.
+        if lease_token is not None and lease_token != handle.lease_epoch:
+            return False
+        if handle.is_actor:
+            # Task-lease returns never apply to dedicated actor workers
+            # (defense in depth for token-less callers).
+            sys.stderr.write(
+                f"[raylet] ignoring return_worker for actor worker "
+                f"{worker_id.hex()[:12]}\n")
             return False
         self._release_lease(handle)
         if kill or handle.proc.poll() is not None:
@@ -1040,6 +1122,7 @@ class Raylet:
             return {"ok": False, "reason": "no worker"}
         handle.lease = {"demand": demand_rs, "tpu_ids": tpu_ids}
         handle.lease_ts = time.monotonic()
+        handle.lease_epoch += 1
         handle.is_actor = True
         handle.actor_id = spec.actor_id.binary()
         return {"ok": True, "worker_addr": handle.addr,

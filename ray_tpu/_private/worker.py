@@ -879,6 +879,10 @@ class Worker:
         """Apply deferred __del__ releases. Called from public entry
         points (never while holding _objects_lock) and periodically."""
         q = self._pending_releases
+        big = len(q) > 100_000
+        if big:
+            t0 = time.monotonic()
+            n0 = len(q)
         while q:
             try:
                 oid = q.popleft()
@@ -888,6 +892,10 @@ class Worker:
                 self.reference_counter.remove_local_ref(oid)
             except Exception:
                 pass
+        if big:
+            print(f"[worker] drained {n0} deferred releases in "
+                  f"{time.monotonic() - t0:.2f}s", file=sys.stderr,
+                  flush=True)
         aq = self._pending_actor_releases
         while aq:
             try:
@@ -1323,7 +1331,9 @@ class Worker:
                     try:
                         await lease["_lessor"].acall(
                             "return_worker", worker_id=lease["worker_id"],
-                            kill=False, timeout=10)
+                            kill=False,
+                            lease_token=lease.get("lease_token"),
+                            timeout=10)
                     except Exception:
                         pass
                 if (not st.idle and not st.waiters and not st.inflight
@@ -1499,7 +1509,8 @@ class Worker:
         try:
             await lease["_lessor"].acall(
                 "return_worker", worker_id=lease["worker_id"],
-                kill=True, timeout=10)
+                kill=True, lease_token=lease.get("lease_token"),
+                timeout=10)
         except Exception:
             pass
 
@@ -1813,16 +1824,34 @@ class Worker:
             while b.queue:
                 batch = [b.queue.popleft()
                          for _ in range(min(len(b.queue), max_batch))]
-                try:
-                    addr = await self._actor_addr(actor_id)
-                except Exception as e:  # noqa: BLE001 — GCS outage etc.
-                    # The address lookup can raise (ConnectionLost during a
-                    # GCS bounce). The batch is already popped: resolve its
-                    # futures with the error — callers retry through the
-                    # actor-restart machinery — and keep the loop alive so
-                    # later calls don't enqueue onto a dead sender forever.
-                    err = e if isinstance(e, (ConnectionLost, OSError)) \
-                        else ConnectionLost(repr(e))
+                addr = None
+                addr_err: Optional[BaseException] = None
+                # NOTHING has been sent yet for this batch (no seqs
+                # burned), so retrying the address lookup is always
+                # safe — a single GCS blip must not fail calls from
+                # max_task_retries=0 callers who cannot retry.
+                lookup_deadline = (time.monotonic()
+                                   + GlobalConfig.actor_unreachable_timeout_s)
+                attempt = 0
+                while True:
+                    try:
+                        addr = await self._actor_addr(actor_id)
+                        addr_err = None
+                        break
+                    except Exception as e:  # noqa: BLE001 — GCS outage
+                        addr_err = e
+                        if (self._dead
+                                or time.monotonic() >= lookup_deadline):
+                            break
+                        attempt += 1
+                        await asyncio.sleep(min(1.0, 0.2 * attempt))
+                if addr_err is not None:
+                    # Lookup deadline exhausted: resolve the batch with
+                    # the error and keep the loop alive so later calls
+                    # don't enqueue onto a dead sender forever.
+                    err = addr_err if isinstance(
+                        addr_err, (ConnectionLost, OSError)) \
+                        else ConnectionLost(repr(addr_err))
                     for _, fut in batch:
                         if not fut.done():
                             fut.set_exception(type(err)(str(err)))
@@ -1854,8 +1883,17 @@ class Worker:
         caller."""
         batched = len(batch) > 1
         prev_inc = self._actor_incarnation.get(actor_id, 0)
-        last_exc: Optional[BaseException] = None
-        for attempt in range(6):
+        # Deadline, not a small attempt count: on an oversubscribed host
+        # a healthy actor worker can be CPU-starved past the 10 s
+        # connect timeout many times in a row (observed: a 500-actor
+        # readiness sweep after a 1M-task drain). Resending the SAME
+        # seqs is safe for any duration — the worker dedups — so
+        # persistence costs nothing semantically, while giving up early
+        # surfaces a bogus failure for a live actor.
+        deadline = (time.monotonic()
+                    + GlobalConfig.actor_unreachable_timeout_s)
+        attempt = 0
+        while True:
             if addr is None:
                 for _, fut in batch:
                     if not fut.done():
@@ -1872,22 +1910,45 @@ class Worker:
                         "push_actor_task", spec=batch[0][0], seq=seqs[0],
                         caller_id=self.worker_id.binary())
             except (ConnectionLost, OSError) as e:
-                last_exc = ConnectionLost(str(e))
                 self._actor_addr_cache.pop(actor_id, None)
+                gcs_down = False
                 try:
                     info = await self.gcs.acall(
                         "get_actor_info", actor_id=actor_id, timeout=30)
                 except Exception:
+                    # GCS unreachable: the actor's fate is UNKNOWN, not
+                    # bad — resending the same seqs is safe regardless,
+                    # so keep retrying under the deadline instead of
+                    # converting a GCS blip into a hard task failure
+                    # for max_task_retries=0 callers.
                     info = None
-                if (info and info.get("state") == "ALIVE"
-                        and info.get("restarts_used", 0) == prev_inc
-                        and attempt < 5):
-                    # Same process, still alive: resend the same frame
-                    # (the worker dedups seqs it already started).
-                    await asyncio.sleep(0.2 * (attempt + 1))
-                    addr = tuple(info["addr"]) if info.get("addr") else \
-                        await self._actor_addr(actor_id)
+                    gcs_down = True
+                if ((gcs_down or (info and info.get("state") == "ALIVE"
+                                  and info.get("restarts_used",
+                                               0) == prev_inc))
+                        and time.monotonic() < deadline):
+                    # Same process, still alive (or fate unknowable):
+                    # resend the same frame (the worker dedups seqs it
+                    # already started).
+                    attempt += 1
+                    await asyncio.sleep(min(1.0, 0.2 * attempt))
+                    if info and info.get("addr"):
+                        addr = tuple(info["addr"])
+                    elif not gcs_down:
+                        try:
+                            addr = await self._actor_addr(actor_id)
+                        except Exception:
+                            pass  # keep the old addr; retry covers it
+                    # gcs_down: keep the old addr — a lookup would just
+                    # raise again, and an escaped exception here would
+                    # orphan every future in the batch.
                     continue
+                print(f"[worker] actor delivery giving up after "
+                      f"{attempt} resends: state="
+                      f"{(info or {}).get('state')} inc="
+                      f"{(info or {}).get('restarts_used')} "
+                      f"err={type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
                 for _, fut in batch:
                     if not fut.done():
                         fut.set_exception(ConnectionLost(str(e)))
@@ -1904,10 +1965,6 @@ class Worker:
                 if not fut.done():
                     fut.set_result(r)
             return
-        for _, fut in batch:
-            if not fut.done():
-                fut.set_exception(last_exc
-                                  or ConnectionLost("actor send failed"))
 
     async def _run_actor_task(self, spec: TaskSpec) -> None:
         self.actor_handles.task_submitted(spec.actor_id.binary())
@@ -1969,11 +2026,29 @@ class Worker:
                     attempt += 1
                     continue
                 if state == "ALIVE":
-                    # Actor restarted but this call isn't retryable.
-                    self._fail_task(spec, serialize_error(
-                        exc.ActorUnavailableError(
-                            f"actor restarted while executing {spec.name}; "
-                            "set max_task_retries to retry automatically")))
+                    if new_inc == prev_inc:
+                        # Never restarted: the delivery layer exhausted
+                        # its (long) same-seq resend deadline against a
+                        # live but unreachable actor. Say so — calling
+                        # this a restart sent earlier debugging down the
+                        # wrong path entirely.
+                        self._fail_task(spec, serialize_error(
+                            exc.ActorUnavailableError(
+                                f"actor alive but unreachable while "
+                                f"executing {spec.name}: same-seq "
+                                f"delivery resends exhausted their "
+                                f"deadline (actor_unreachable_timeout_s="
+                                f"{GlobalConfig.actor_unreachable_timeout_s}"
+                                f" per stage — address lookup and frame "
+                                f"delivery each); set max_task_retries "
+                                f"to retry automatically")))
+                    else:
+                        # Actor restarted but this call isn't retryable.
+                        self._fail_task(spec, serialize_error(
+                            exc.ActorUnavailableError(
+                                f"actor restarted while executing "
+                                f"{spec.name}; set max_task_retries to "
+                                f"retry automatically")))
                 else:
                     self._fail_task(spec, serialize_error(exc.ActorDiedError(
                         f"actor died while executing {spec.name}: "
@@ -2651,9 +2726,10 @@ class Worker:
             while st.idle:
                 lease = st.idle.popleft()
                 try:
-                    lease["_lessor"].call("return_worker",
-                                          worker_id=lease["worker_id"],
-                                          kill=False, timeout=5)
+                    lease["_lessor"].call(
+                        "return_worker", worker_id=lease["worker_id"],
+                        kill=False, lease_token=lease.get("lease_token"),
+                        timeout=5)
                 except Exception:
                     pass
         self._lease_pool.clear()

@@ -29,6 +29,7 @@ def main():
     parser.add_argument("--worker-id", required=True)
     parser.add_argument("--job-id", required=True)
     parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--raylet-pid", type=int, default=0)
     args = parser.parse_args()
 
     # runtime_env working_dir: the raylet exports it when this worker's
@@ -76,13 +77,44 @@ def main():
     except Exception:
         pass
 
-    # Fate-share with the raylet: if pings start failing, exit.
+    # Fate-share with the raylet. The PRIMARY signal is process
+    # liveness (os.kill(pid, 0)) — it cannot false-positive when the
+    # raylet is merely busy. RPC pings are only a backstop for a raylet
+    # whose process is alive but whose server is permanently wedged,
+    # and require a long consecutive-failure streak: a single missed
+    # ping used to os._exit(1) here, and under a 500-actor spawn storm
+    # after a 1M-task drain the raylet's loop stalls >10s, which
+    # mass-suicided whole batches of healthy actor workers (actors
+    # DEAD in bursts of ~36 while every node stayed ALIVE).
+    def raylet_process_alive(pid: int) -> bool:
+        # os.kill(pid, 0) alone treats a ZOMBIE raylet (crashed, not yet
+        # reaped by its parent) as alive — read the state field instead.
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().rsplit(") ", 1)[1].split()[0]
+            return state != "Z"
+        except OSError:
+            return False
+
+    ping_fails = 0
     while True:
         time.sleep(2.0)
+        if args.raylet_pid and not raylet_process_alive(args.raylet_pid):
+            os._exit(1)  # raylet process is gone (or a zombie)
         try:
             worker.raylet.call("node_stats", timeout=10)
-        except Exception:
-            os._exit(1)
+            ping_fails = 0
+        except Exception as e:
+            # Instant refusal means nothing is listening — the raylet's
+            # server is gone even if a pid lingers — so weigh it far
+            # heavier than a timeout (a BUSY raylet times out, it does
+            # not refuse). The RPC layer wraps ECONNREFUSED in
+            # ConnectionLost, so match on the message.
+            ping_fails += 5 if "refused" in str(e).lower() else 1
+            if ping_fails >= (30 if args.raylet_pid else 5):
+                print(f"raylet unreachable (score {ping_fails}, last: "
+                      f"{e}); exiting", file=sys.stderr, flush=True)
+                os._exit(1)
 
 
 if __name__ == "__main__":

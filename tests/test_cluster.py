@@ -214,3 +214,42 @@ class TestPlacementGroups:
         for _, _, tpu_ids in out:
             assert sorted(tpu_ids) == [0, 1, 2, 3]
         remove_placement_group(pg)
+
+
+def test_stale_return_worker_cannot_strip_actor(ray_start_regular):
+    """A return_worker processed late (stale lease token, or targeting a
+    worker that has since become a dedicated actor worker) must be
+    rejected — observed under the 1M-task + 500-actor envelope: a stale
+    task-lease return re-offered an actor's worker into the idle pool
+    and a later task-lease failure path SIGKILLed the live actor
+    (reference analogue: lease ids scoping worker returns)."""
+    from ray_tpu._private.worker import global_worker
+
+    @ray_tpu.remote
+    class Keeper:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    k = Keeper.remote()
+    assert ray_tpu.get(k.bump.remote(), timeout=60) == 1
+
+    w = global_worker()
+    infos = w.raylet.call("get_tasks_info", timeout=10)
+    actor_workers = [i for i in infos if i["is_actor"]]
+    assert actor_workers, infos
+    wid = actor_workers[0]["worker_id"]
+
+    # Stale-token return: must be rejected outright.
+    assert w.raylet.call("return_worker", worker_id=wid, kill=True,
+                         lease_token=999_999, timeout=10) is False
+    # Token-less return against an actor worker: the is_actor guard.
+    assert w.raylet.call("return_worker", worker_id=wid, kill=True,
+                         timeout=10) is False
+
+    # The actor is untouched: same process, state intact, still serving.
+    assert ray_tpu.get(k.bump.remote(), timeout=60) == 2
+    ray_tpu.kill(k)

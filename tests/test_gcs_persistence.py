@@ -23,7 +23,7 @@ def test_kv_and_jobs_survive_restart(tmp_path):
     _call(gcs1, "kv_put", namespace="ns", key="k", value=b"v1")
     _call(gcs1, "register_job", job_id=b"\x01" * 4,
           driver_addr=("127.0.0.1", 1), metadata={"who": "test"})
-    gcs1._write_snapshot()
+    gcs1._write_snapshot(gcs1._build_snapshot())
 
     # A fresh GCS (simulated restart) loads the durable tables.
     gcs2 = GcsServer("127.0.0.1", 0)
@@ -40,6 +40,6 @@ def test_snapshot_is_atomic(tmp_path):
     gcs.enable_snapshots(snap)
     for i in range(5):
         _call(gcs, "kv_put", namespace="ns", key=f"k{i}", value=b"x" * 100)
-        gcs._write_snapshot()
+        gcs._write_snapshot(gcs._build_snapshot())
     assert os.path.exists(snap)
     assert not os.path.exists(snap + ".tmp")
