@@ -10,6 +10,11 @@ full soak.
 Usage:
     python scripts/scale_bench.py [--raylets 8] [--tasks 10000]
         [--actors 500] [--pgs 100] [--broadcast-mb 100] [--queued 100000]
+        [--object-args 10000] [--store-object-kb 128] [--returns 3000]
+
+--object-args / --returns / --queued take 0 to disable their phases;
+--store-object-kb sizes the phase-6 payloads (default 128 KiB, above
+the 100 KiB inline threshold so objects are store-backed).
 """
 
 import argparse
@@ -34,6 +39,9 @@ def main():
     ap.add_argument("--pgs", type=int, default=100)
     ap.add_argument("--broadcast-mb", type=int, default=100)
     ap.add_argument("--queued", type=int, default=100000)
+    ap.add_argument("--object-args", type=int, default=10000)
+    ap.add_argument("--store-object-kb", type=int, default=128)
+    ap.add_argument("--returns", type=int, default=3000)
     args = ap.parse_args()
 
     import ray_tpu
@@ -41,6 +49,13 @@ def main():
     from ray_tpu.util.placement_group import (
         placement_group, remove_placement_group,
     )
+
+    def rss_mb():
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS"):
+                    return round(int(ln.split()[1]) / 1024, 1)
+        return -1.0
 
     results = {}
     t_boot = time.monotonic()
@@ -71,21 +86,6 @@ def main():
     results["tasks_per_s"] = round(args.tasks / dt, 1)
     print(f"[scale] {args.tasks} tasks in {dt:.1f}s "
           f"({results['tasks_per_s']}/s)", flush=True)
-
-    # ---- phase 2: queued depth (submit >> capacity, then drain) ----------
-    if args.queued:
-        t0 = time.monotonic()
-        refs = [nop.remote(i) for i in range(args.queued)]
-        t_submit = time.monotonic() - t0
-        out = ray_tpu.get(refs, timeout=3600)
-        dt = time.monotonic() - t0
-        assert len(out) == args.queued
-        results["queued"] = args.queued
-        results["queued_submit_per_s"] = round(args.queued / t_submit, 1)
-        results["queued_drain_per_s"] = round(args.queued / dt, 1)
-        print(f"[scale] {args.queued} queued: submit "
-              f"{results['queued_submit_per_s']}/s, drain "
-              f"{results['queued_drain_per_s']}/s", flush=True)
 
     # ---- phase 3: actors ------------------------------------------------
     # Fractional CPUs: the envelope measures actor COUNT and call
@@ -164,6 +164,96 @@ def main():
     print(f"[scale] {mb}MiB broadcast to {len(node_ids)} nodes in "
           f"{dt:.2f}s ({results['broadcast_mb_per_s']} MiB/s aggregate)",
           flush=True)
+
+    # ---- phase 6: per-node object envelope -------------------------------
+    # Reference rows (release/benchmarks/README.md:22-31): 10k+ object
+    # args to ONE task, 3k+ returns from ONE task, 10k+ store objects in
+    # one get.
+    if args.object_args:
+        # STORE-backed payloads (above max_direct_call_object_size =
+        # 100 KiB), so this exercises 10k shared-memory objects, 10k
+        # store dependency resolutions into one lease, and one get over
+        # 10k store entries — the strict version of the reference rows.
+        # The consumer is pinned to the owner's node: the envelope is
+        # per-node, not a cross-node transfer benchmark.
+        kb = args.store_object_kb
+        payload = b"x" * (kb * 1024)
+        t0 = time.monotonic()
+        arg_refs = [ray_tpu.put(payload) for _ in range(args.object_args)]
+        t_put = time.monotonic() - t0
+
+        @ray_tpu.remote
+        def count_args(*parts):
+            return sum(len(p) for p in parts)
+
+        # Pin to the DRIVER's node (where the puts landed): hard
+        # affinity, or the phase silently becomes a 1.25 GiB cross-node
+        # transfer instead of the per-node envelope it claims to be.
+        from ray_tpu._private.worker import global_worker
+
+        my_node = global_worker().node_id
+        t0 = time.monotonic()
+        total = ray_tpu.get(
+            count_args.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=my_node, soft=False))
+            .remote(*arg_refs), timeout=1800)
+        dt = time.monotonic() - t0
+        assert total == args.object_args * kb * 1024
+        results["object_args"] = args.object_args
+        results["object_args_kb"] = kb
+        results["object_args_put_per_s"] = round(args.object_args / t_put, 1)
+        results["object_args_call_s"] = round(dt, 2)
+        print(f"[scale] {args.object_args} x {kb}KiB store args to one "
+              f"task: puts {results['object_args_put_per_s']}/s, call "
+              f"{dt:.2f}s", flush=True)
+
+        t0 = time.monotonic()
+        vals = ray_tpu.get(arg_refs, timeout=1800)
+        dt = time.monotonic() - t0
+        assert len(vals) == args.object_args
+        results["get_many"] = args.object_args
+        results["get_many_per_s"] = round(args.object_args / dt, 1)
+        print(f"[scale] one get over {args.object_args} store objects in "
+              f"{dt:.2f}s ({results['get_many_per_s']}/s)", flush=True)
+        del arg_refs, vals
+
+    if args.returns:
+        @ray_tpu.remote(num_returns=args.returns)
+        def fan_out():
+            return tuple(range(args.returns))
+
+        t0 = time.monotonic()
+        refs = fan_out.remote()
+        out = ray_tpu.get(refs, timeout=1800)
+        dt = time.monotonic() - t0
+        assert list(out) == list(range(args.returns))
+        results["returns"] = args.returns
+        results["returns_s"] = round(dt, 2)
+        print(f"[scale] {args.returns} returns from one task in "
+              f"{dt:.2f}s", flush=True)
+
+    # ---- final phase: queued depth (the long soak runs LAST: it is the
+    # reference's separate many-tasks release test, and running it before
+    # the actor storm leaves a 600-process host mid-collapse for the
+    # phases that follow) (submit >> capacity, then drain) ----------
+    if args.queued:
+        t0 = time.monotonic()
+        refs = [nop.remote(i) for i in range(args.queued)]
+        t_submit = time.monotonic() - t0
+        out = ray_tpu.get(refs, timeout=3600)
+        dt = time.monotonic() - t0
+        assert len(out) == args.queued
+        results["queued"] = args.queued
+        results["queued_submit_per_s"] = round(args.queued / t_submit, 1)
+        results["queued_drain_per_s"] = round(args.queued / dt, 1)
+        results["rss_mb_after_queued"] = rss_mb()
+        print(f"[scale] {args.queued} queued: submit "
+              f"{results['queued_submit_per_s']}/s, drain "
+              f"{results['queued_drain_per_s']}/s "
+              f"(driver RSS {results['rss_mb_after_queued']} MB)",
+              flush=True)
+
 
     ray_tpu.shutdown()
     cluster.shutdown()
