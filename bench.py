@@ -71,7 +71,58 @@ def _bench_config(on_tpu: bool):
     return LlamaConfig.tiny(), 4, 64, 2
 
 
+def _wait_for_backend(max_wait_s: float = 240.0, probe_timeout_s: float = 120.0):
+    """Bounded wait for the (possibly tunneled, possibly flaky) accelerator
+    backend to come up before the bench process touches jax itself.
+
+    Round 4's driver bench died rc=1 on a transient `UNAVAILABLE: TPU
+    backend setup/compile error` from the tunnel (VERDICT r4).  Probing in
+    short-lived subprocesses means a failed or *hung* init never poisons or
+    wedges this process; once a probe succeeds, the in-process init takes
+    the same (now-healthy) path.  Returns the probe's device kind, or None
+    if the backend never came up (caller decides how to degrade).
+    """
+    import os
+    import subprocess
+    import sys
+
+    deadline = time.monotonic() + max_wait_s
+    attempt = 0
+    last_err = ""
+    while True:
+        attempt += 1
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].device_kind)"],
+                capture_output=True, text=True, timeout=probe_timeout_s,
+                env=dict(os.environ))
+            if proc.returncode == 0 and proc.stdout.strip():
+                return proc.stdout.strip().splitlines()[-1]
+            last_err = (proc.stderr or "")[-800:]
+        except subprocess.TimeoutExpired:
+            last_err = f"probe hung >{probe_timeout_s}s (killed)"
+        if time.monotonic() >= deadline:
+            print(f"bench: backend unavailable after {attempt} probes: "
+                  f"{last_err}", file=sys.stderr)
+            return None
+        time.sleep(min(20.0, 3.0 * attempt))
+
+
 def main() -> None:
+    import sys
+
+    kind = _wait_for_backend()
+    if kind is None:
+        # Emit a parseable failure record (so the round's bench artifact
+        # carries the diagnosis, not just an rc), then fail.
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": None, "unit": "tokens/s", "vs_baseline": None,
+            "error": "accelerator backend unavailable after bounded retry",
+        }))
+        raise SystemExit(1)
+
     import jax
     import numpy as np
     import optax

@@ -279,6 +279,7 @@ class Worker:
                      "push_actor_task", "push_actor_tasks",
                      "get_object_status", "kill_self", "cancel_task", "ping",
                      "busy_info", "add_borrower", "release_borrower",
+                     "consume_pending_share",
                      "stack_dump", "profile",
                      "delete_object_notification", "report_generator_item",
                      "recover_object", "wait_object_status"]:
@@ -762,6 +763,12 @@ class Worker:
             return
         for oid, owner_addr in borrowed:
             if oid in self._borrow_registered:
+                # Already a registered borrower: this extra copy's
+                # serialize-out still appended a pending share owner-side
+                # that nothing would ever consume (it would pin the object
+                # for the full TTL — ADVICE r4 low). Retire it now; the
+                # registered borrow itself keeps the object alive.
+                self._consume_share_async(oid, owner_addr)
                 continue
             # Optimistic dedupe entry (prevents duplicate RPCs from rapid
             # repeated deserializes); rolled back on failure so the next
@@ -785,6 +792,31 @@ class Worker:
                 # deserialize retries; until then the ref may dangle and
                 # get() surfaces ObjectLostError.
                 self._borrow_registered.discard(oid)
+
+    def _consume_share_async(self, oid: bytes, owner_addr) -> None:
+        """Best-effort, fire-and-forget: tell the owner one in-flight
+        pending share was delivered to an already-registered borrower.
+        Never retried (shares are fungible; an over-consume could drop
+        the pin covering a different in-flight copy), so a lost message
+        just falls back to the TTL sweep."""
+        if self._dead or owner_addr is None:
+            return
+
+        async def _go():
+            try:
+                await self._client_for(tuple(owner_addr)).acall(
+                    "consume_pending_share", object_id=oid, timeout=30)
+            except Exception:
+                pass
+
+        try:
+            self.io.submit(_go())
+        except Exception:
+            pass
+
+    async def _h_consume_pending_share(self, object_id):
+        self.reference_counter.consume_pending_share(object_id)
+        return True
 
     async def _register_borrow_async(self, oid: bytes, owner_addr) -> None:
         try:

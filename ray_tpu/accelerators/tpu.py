@@ -71,12 +71,24 @@ class TPUAcceleratorManager(AcceleratorManager):
         vfio = glob.glob("/dev/vfio/[0-9]*")
         if vfio:
             return len(vfio)
-        # If jax is already imported and running on TPU, trust it.
+        # If jax has already INITIALIZED a backend in this process and it
+        # is a TPU, trust it. Merely-imported jax is not enough: calling
+        # jax.devices() would trigger backend init here, and when the
+        # accelerator transport is down that call hangs — wedging
+        # ray_tpu.init() itself (the round-4 dryrun lost its signal to
+        # exactly this; jax is pre-imported in some environments).
         try:
             import sys
 
             jax = sys.modules.get("jax")
             if jax is not None:
+                from jax._src import xla_bridge
+
+                if not getattr(
+                        xla_bridge, "backends_are_initialized",
+                        lambda: bool(getattr(xla_bridge, "_backends",
+                                             None)))():
+                    return 0
                 devs = jax.devices()
                 if devs and "tpu" in devs[0].platform.lower() or (
                         devs and "TPU" in getattr(devs[0], "device_kind", "")):

@@ -9,6 +9,7 @@ work unchanged from outside the cluster.
 
 from __future__ import annotations
 
+import collections
 import pickle
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -32,13 +33,19 @@ class _ClientRefCounter:
             self._counts[object_id] = self._counts.get(object_id, 0) + 1
 
     def remove_local_ref(self, object_id: bytes) -> None:
+        if self._decref(object_id):
+            self._owner._release_objects([object_id])
+
+    def _decref(self, object_id: bytes) -> bool:
+        """Drop one local ref; True iff the count reached zero (the
+        caller then releases the server-side pin)."""
         with self._lock:
             n = self._counts.get(object_id, 0) - 1
             if n > 0:
                 self._counts[object_id] = n
-                return
+                return False
             self._counts.pop(object_id, None)
-        self._owner._release_objects([object_id])
+            return True
 
     def mark_shared(self, object_id: bytes) -> None:
         # Shared into a task argument: keep the server pin for the
@@ -58,19 +65,24 @@ class _ClientActorGC:
             self._counts[actor_id] = self._counts.get(actor_id, 0) + 1
 
     def remove_ref(self, actor_id: bytes) -> None:
-        with self._lock:
-            n = self._counts.get(actor_id, 0) - 1
-            if n > 0:
-                self._counts[actor_id] = n
-                return
-            self._counts.pop(actor_id, None)
-        self._owner._release_actor(actor_id)
+        # GC-context entry (ActorHandle.__del__): append-only, like the
+        # in-process worker — never RPC under a finalizer.
+        self._owner.defer_actor_release(actor_id)
 
     def mark_created(self, actor_id: bytes) -> None:
         pass
 
     def mark_shared(self, actor_id: bytes) -> None:
         self.add_ref(actor_id)
+
+    def _decref(self, actor_id: bytes) -> bool:
+        with self._lock:
+            n = self._counts.get(actor_id, 0) - 1
+            if n > 0:
+                self._counts[actor_id] = n
+                return False
+            self._counts.pop(actor_id, None)
+            return True
 
 
 class ClientWorker:
@@ -85,6 +97,18 @@ class ClientWorker:
         self.actor_handles = _ClientActorGC(self)
         self.gcs = _GcsProxy(self._client)
         self._closed = False
+        # Deferred finalizer releases (ObjectRef/ActorHandle.__del__): a
+        # __del__ must never RPC — append here, drain from the background
+        # thread and at shutdown. Without this, client-mode __del__ used to
+        # hit the missing-method except and leak every server-side pin for
+        # the whole session (ADVICE r4 high).
+        self._pending_releases: collections.deque = collections.deque()
+        self._pending_actor_releases: collections.deque = collections.deque()
+        self._release_wake = threading.Event()
+        self._release_thread = threading.Thread(
+            target=self._release_loop, name="client-release-drainer",
+            daemon=True)
+        self._release_thread.start()
 
     # ------------------------------------------------------------ marshall
     @staticmethod
@@ -177,6 +201,56 @@ class ClientWorker:
                           force=force, timeout=60)
 
     # ------------------------------------------------------------- lifecycle
+    def defer_release(self, oid: bytes) -> None:
+        """GC-safe local-ref release (ObjectRef.__del__ only): lock-free
+        append; the decref + server release run at the next drain."""
+        self._pending_releases.append(oid)
+        self._release_wake.set()
+
+    def defer_actor_release(self, actor_id: bytes) -> None:
+        self._pending_actor_releases.append(actor_id)
+        self._release_wake.set()
+
+    def drain_releases(self) -> None:
+        """Apply deferred __del__ releases; batch zero-count objects into
+        one server round-trip."""
+        q = self._pending_releases
+        dead: List[bytes] = []
+        while q:
+            try:
+                oid = q.popleft()
+            except IndexError:
+                break
+            try:
+                if self.reference_counter._decref(oid):
+                    dead.append(oid)
+            except Exception:
+                pass
+        if dead:
+            self._release_objects(dead)
+        aq = self._pending_actor_releases
+        while aq:
+            try:
+                actor_id = aq.popleft()
+            except IndexError:
+                break
+            try:
+                if self.actor_handles._decref(actor_id):
+                    self._release_actor(actor_id)
+            except Exception:
+                pass
+
+    def _release_loop(self) -> None:
+        while not self._closed:
+            self._release_wake.wait(timeout=1.0)
+            self._release_wake.clear()
+            if self._closed:
+                return
+            try:
+                self.drain_releases()
+            except Exception:
+                pass
+
     def _release_objects(self, object_ids: List[bytes]) -> None:
         if self._closed:
             return
@@ -202,10 +276,15 @@ class ClientWorker:
 
     def shutdown(self) -> None:
         try:
+            self.drain_releases()
+        except Exception:
+            pass
+        try:
             self._client.call("client_disconnect", timeout=10)
         except Exception:
             pass
         self._closed = True
+        self._release_wake.set()
         try:
             self._client.close()
         except Exception:

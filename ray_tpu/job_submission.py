@@ -20,9 +20,60 @@ import ray_tpu
 _KV_NS = "job_submissions"
 
 
-@ray_tpu.remote(num_cpus=0.5)
+@ray_tpu.remote(num_cpus=0.5, max_concurrency=2)
 class JobSupervisor:
-    """Runs one job's entrypoint as a child process and reports status."""
+    """Runs one job's entrypoint as a child process and reports status.
+
+    ``max_concurrency=2`` so ``stop()`` can be delivered while ``run()``
+    is blocked in ``proc.wait()`` — the stop must execute on the node
+    that owns the child process (a client-side ``os.kill`` only works
+    when client and supervisor share a machine; ADVICE r4 medium).
+    """
+
+    def __init__(self):
+        import threading
+
+        self._proc: Optional[subprocess.Popen] = None
+        # Closes the stop-before-spawn race: stop() sets _stopped under
+        # the lock; run() checks it under the same lock around Popen, so
+        # an early stop() can never let the child spawn afterwards.
+        self._stopped = False
+        self._lock = threading.Lock()
+
+    def stop(self, grace_s: float = 3.0) -> bool:
+        """Terminate this job's entrypoint process group: SIGTERM, a
+        grace window, then SIGKILL. Runs where the child lives, so it is
+        correct on multi-node clusters and for off-cluster HTTP clients.
+        Returns True iff the job can no longer run (process killed, or
+        spawn permanently suppressed)."""
+        import signal
+
+        with self._lock:
+            self._stopped = True
+            proc = self._proc
+        if proc is None:
+            return True  # run() will see _stopped and never spawn
+        if proc.poll() is not None:
+            return True  # already exited
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (OSError, ProcessLookupError):
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline and proc.poll() is None:
+            time.sleep(0.1)
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        return True
 
     def run(self, submission_id: str, entrypoint: str, gcs_addr: str,
             env: Dict[str, str], working_dir: Optional[str]) -> int:
@@ -55,19 +106,28 @@ class JobSupervisor:
             [pkg_root] + [p for p in
                           child_env.get("PYTHONPATH", "").split(os.pathsep)
                           if p])
-        put_status(status="RUNNING", log_path=log_path,
-                   start_time=time.time(), pid=os.getpid())
         with open(log_path, "wb") as log:
-            proc = subprocess.Popen(
-                entrypoint, shell=True, stdout=log,
-                stderr=subprocess.STDOUT, env=child_env,
-                cwd=working_dir or None)
-            put_status(child_pid=proc.pid)  # stop_job kills this
+            with self._lock:
+                if self._stopped:
+                    # stop_job() beat us here: never spawn.
+                    return -1
+                # Own session/process group: stop() kills the whole tree.
+                proc = subprocess.Popen(
+                    entrypoint, shell=True, stdout=log,
+                    stderr=subprocess.STDOUT, env=child_env,
+                    cwd=working_dir or None, start_new_session=True)
+                self._proc = proc
+            with self._lock:
+                stopped_now = self._stopped
+            if not stopped_now:
+                put_status(status="RUNNING", log_path=log_path,
+                           start_time=time.time(), pid=os.getpid(),
+                           child_pid=proc.pid)  # same-node stop fallback
             rc = proc.wait()
         record = json.loads(
             w.gcs.call("kv_get", namespace=_KV_NS,
                        key=submission_id) or b"{}")
-        if record.get("status") == "STOPPED":
+        if record.get("status") == "STOPPED" or self._stopped:
             return rc  # stop_job already wrote the terminal state
         put_status(status="SUCCEEDED" if rc == 0 else "FAILED",
                    returncode=rc, end_time=time.time())
@@ -166,17 +226,30 @@ class JobSubmissionClient:
         self._worker.gcs.call(
             "kv_put", namespace=_KV_NS, key=submission_id,
             value=json.dumps(record).encode())
-        pid = record.get("child_pid")
-        if pid:
-            try:
-                os.kill(pid, 15)
-            except OSError:
-                pass
+        # Route the kill through the supervisor: it owns the child and
+        # runs on the child's node, so this is correct on multi-node
+        # clusters (a client-side os.kill only ever worked same-node).
+        stopped_via_supervisor = False
+        sup = None
         try:
             sup = ray_tpu.get_actor(f"_job_supervisor:{submission_id}")
-            ray_tpu.kill(sup)
+            stopped_via_supervisor = bool(
+                ray_tpu.get(sup.stop.remote(), timeout=30))
         except Exception:
             pass
+        if not stopped_via_supervisor:
+            # Same-node fallback when the supervisor is unreachable.
+            pid = record.get("child_pid")
+            if pid:
+                try:
+                    os.kill(pid, 15)
+                except OSError:
+                    pass
+        if sup is not None:
+            try:
+                ray_tpu.kill(sup)
+            except Exception:
+                pass
         return True
 
     def list_jobs(self) -> List[Dict[str, Any]]:

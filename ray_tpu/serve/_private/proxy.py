@@ -87,7 +87,10 @@ class ProxyActor(RoutePlane):
                     "method": request.method,
                     "path": sub,
                     "query_string": request.query_string,
-                    "headers": dict(request.headers),
+                    # List of pairs, not a dict: duplicate headers
+                    # (multiple Cookie/Set-Cookie) must survive the
+                    # proxy->replica hop.
+                    "headers": list(request.headers.items()),
                     "body": raw,
                 }
                 try:
@@ -97,13 +100,18 @@ class ProxyActor(RoutePlane):
                 except Exception as e:  # noqa: BLE001
                     return web.json_response(
                         {"error": f"{type(e).__name__}: {e}"}, status=500)
+                from multidict import CIMultiDict
+
+                hdrs = CIMultiDict()
+                for k, v in (rep.get("header_list")
+                             or list((rep.get("headers") or {}).items())):
+                    if k.lower() not in ("content-length",
+                                         "transfer-encoding"):
+                        hdrs.add(k, v)
                 return web.Response(
                     body=rep.get("body", b""),
                     status=rep.get("status", 200),
-                    headers={k: v for k, v in
-                             (rep.get("headers") or {}).items()
-                             if k.lower() not in ("content-length",
-                                                  "transfer-encoding")})
+                    headers=hdrs)
             args = (payload,) if payload is not None else ()
             if route.get("stream"):
                 return await self._stream_response(request, handle, args)

@@ -33,20 +33,26 @@ class Request:
         self.method: str = scope.get("method", "GET")
         self.path: str = scope.get("path", "/")
         self.path_params: Dict[str, str] = scope.get("path_params", {})
-        self.headers: Dict[str, str] = {
-            k.decode() if isinstance(k, bytes) else k:
-            v.decode() if isinstance(v, bytes) else v
-            for k, v in scope.get("headers", [])}
+        # Full fidelity list (duplicates preserved) + a convenience dict
+        # that joins duplicates per RFC 9110 ("," separated).
+        self.header_list: List[Tuple[str, str]] = [
+            (k.decode() if isinstance(k, bytes) else k,
+             v.decode() if isinstance(v, bytes) else v)
+            for k, v in scope.get("headers", [])]
+        self.headers = {}
+        for k, v in self.header_list:
+            self.headers[k] = f"{self.headers[k]}, {v}" \
+                if k in self.headers else v
         qs = scope.get("query_string", b"")
         if isinstance(qs, str):
             qs = qs.encode()
-        self.query_params: Dict[str, str] = {}
-        for part in qs.decode().split("&"):
-            if "=" in part:
-                k, v = part.split("=", 1)
-                self.query_params[k] = v
-            elif part:
-                self.query_params[part] = ""
+        # parse_qsl percent-decodes and handles '+' (ADVICE r4 low — the
+        # old hand-split passed values through still encoded).
+        from urllib.parse import parse_qsl
+
+        self.query_params_list: List[Tuple[str, str]] = parse_qsl(
+            qs.decode(), keep_blank_values=True)
+        self.query_params: Dict[str, str] = dict(self.query_params_list)
         self._body = body
 
     def body(self) -> bytes:
@@ -58,8 +64,7 @@ class Request:
 
 class Response:
     def __init__(self, content: Any = b"", status: int = 200,
-                 headers: Optional[Dict[str, str]] = None,
-                 media_type: Optional[str] = None):
+                 headers=None, media_type: Optional[str] = None):
         if isinstance(content, bytes):
             body = content
             media_type = media_type or "application/octet-stream"
@@ -71,8 +76,14 @@ class Response:
             media_type = media_type or "application/json"
         self.body = body
         self.status = status
-        self.headers = dict(headers or {})
-        self.headers.setdefault("content-type", media_type)
+        # ``headers`` may be any mapping or a list of pairs (the latter
+        # emits duplicates, e.g. multiple Set-Cookie).
+        pairs = (list(headers.items()) if hasattr(headers, "items")
+                 else list(headers or []))
+        if not any(k.lower() == "content-type" for k, _ in pairs):
+            pairs.append(("content-type", media_type))
+        self.header_list: List[Tuple[str, str]] = pairs
+        self.headers: Dict[str, str] = dict(pairs)
 
 
 _PARAM = re.compile(r"{([a-zA-Z_][a-zA-Z0-9_]*)}")
@@ -153,9 +164,9 @@ class App:
 
 
 async def _send_response(send, resp: Response) -> None:
+    pairs = getattr(resp, "header_list", None) or list(resp.headers.items())
     await send({"type": "http.response.start", "status": resp.status,
-                "headers": [(k.encode(), v.encode())
-                            for k, v in resp.headers.items()]})
+                "headers": [(k.encode(), v.encode()) for k, v in pairs]})
     await send({"type": "http.response.body", "body": resp.body})
 
 
@@ -178,7 +189,10 @@ def run_asgi_request(asgi_app, request: Dict[str, Any],
         "raw_path": (request.get("path", "/") or "/").encode(),
         "query_string": (request.get("query_string") or "").encode(),
         "headers": [(k.lower().encode(), v.encode())
-                    for k, v in (request.get("headers") or {}).items()],
+                    for k, v in (
+                        request["headers"].items()
+                        if isinstance(request.get("headers"), dict)
+                        else (request.get("headers") or []))],
     }
     body = request.get("body") or b""
     if isinstance(body, str):
@@ -197,10 +211,13 @@ def run_asgi_request(asgi_app, request: Dict[str, Any],
     async def send(message):
         if message["type"] == "http.response.start":
             out["status"] = message["status"]
-            out["headers"] = {
-                (k.decode() if isinstance(k, bytes) else k):
-                (v.decode() if isinstance(v, bytes) else v)
-                for k, v in message.get("headers", [])}
+            pairs = [((k.decode() if isinstance(k, bytes) else k),
+                      (v.decode() if isinstance(v, bytes) else v))
+                     for k, v in message.get("headers", [])]
+            # header_list keeps duplicates (Set-Cookie); the dict is the
+            # backward-compatible view (last value wins).
+            out["header_list"] = pairs
+            out["headers"] = dict(pairs)
         elif message["type"] == "http.response.body":
             chunks.append(message.get("body", b""))
 

@@ -99,6 +99,51 @@ def test_job_submission_lifecycle(ray_start_regular, tmp_path):
     assert any(j["submission_id"] == sid for j in client.list_jobs())
 
 
+def test_stop_job_kills_entrypoint_tree(ray_start_regular, tmp_path):
+    """stop_job must terminate the entrypoint via the SUPERVISOR (which
+    owns the child and its process group) — not a client-side os.kill,
+    which only ever worked when client and supervisor shared a node
+    (ADVICE r4 medium)."""
+    import time
+
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    pid_path = tmp_path / "child.pid"
+    script = tmp_path / "spin.py"
+    script.write_text(
+        "import os, subprocess, sys, time\n"
+        # A grandchild too: the process-group kill must reap the tree.
+        "sub = subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(600)'])\n"
+        f"open({str(pid_path)!r}, 'w').write("
+        "f'{os.getpid()} {sub.pid}')\n"
+        "time.sleep(600)\n")
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    deadline = time.monotonic() + 60
+    while not pid_path.exists() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert pid_path.exists(), client.get_job_logs(sid)
+    child_pid, grandchild_pid = map(int, pid_path.read_text().split())
+
+    assert client.stop_job(sid) is True
+    assert client.get_job_status(sid) == "STOPPED"
+
+    def _dead(pid):
+        end = time.monotonic() + 15
+        while time.monotonic() < end:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return True
+            time.sleep(0.1)
+        return False
+
+    assert _dead(child_pid), "entrypoint survived stop_job"
+    assert _dead(grandchild_pid), "entrypoint's subprocess survived stop_job"
+
+
 def test_failed_job_reports_failure(ray_start_regular):
     from ray_tpu.job_submission import JobSubmissionClient
 

@@ -203,6 +203,37 @@ def test_ref_freed_after_actor_borrow_drains(borrow_cluster):
               msg="owner never freed after borrower drained")
 
 
+def test_repeated_shares_to_same_borrower_drain(borrow_cluster):
+    """N sends of the same ref to an already-registered borrower must not
+    leave N-1 pending shares pinning the object until the TTL sweep
+    (ADVICE r4 low): the duplicate-deserialize path sends
+    consume_pending_share instead."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    @ray_tpu.remote
+    class Sink:
+        def take(self, wrapped):
+            return float(ray_tpu.get(wrapped[0]))
+
+    w = global_worker()
+    ref = ray_tpu.put(7.0)
+    oid = ref.binary()
+    sink = Sink.remote()
+    for _ in range(6):
+        assert ray_tpu.get(sink.take.remote([ref]), timeout=60) == 7.0
+
+    # Every serialize-out appended a share; only the first registration
+    # consumed one. The duplicates must drain via the consume RPC well
+    # before the 3 s TTL sweep would get to them.
+    def shares_drained():
+        snap = w.reference_counter.snapshot(oid)
+        return snap is not None and snap["pending_shares"] <= 1
+
+    _wait_for(shares_drained, timeout=2.5,
+              msg="unconsumed pending shares lingered")
+
+
 def test_ref_nested_in_put_freed_with_outer(borrow_cluster):
     import gc
 

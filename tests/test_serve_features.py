@@ -415,6 +415,47 @@ def test_grpc_ingress_unary_and_stream(serve_cluster):
         serve.delete("grpcstream")
 
 
+def test_asgi_query_decoding_and_duplicate_headers():
+    """Query values reach handlers percent-decoded ('+' included) and
+    duplicate headers survive both directions (ADVICE r4 low)."""
+    import json
+
+    from ray_tpu.serve.asgi import App, Response, run_asgi_request
+
+    app = App()
+
+    @app.get("/echo")
+    def echo(request):
+        return Response(
+            {"q": request.query_params.get("q"),
+             "tags": [v for k, v in request.query_params_list
+                      if k == "tag"],
+             "cookies": [v for k, v in request.header_list
+                         if k == "cookie"]},
+            headers=[("set-cookie", "a=1"), ("set-cookie", "b=2")])
+
+    rep = run_asgi_request(app, {
+        "method": "GET", "path": "/echo",
+        "query_string": "q=red+hat%2F7&tag=x&tag=y",
+        "headers": [("cookie", "s=1"), ("cookie", "t=2")],
+    })
+    assert rep["status"] == 200
+    out = json.loads(rep["body"])
+    assert out["q"] == "red hat/7"
+    assert out["tags"] == ["x", "y"]
+    assert out["cookies"] == ["s=1", "t=2"]
+    assert [v for k, v in rep["header_list"]
+            if k == "set-cookie"] == ["a=1", "b=2"]
+
+    # A dict headers payload (older proxy wire format) still works.
+    rep = run_asgi_request(app, {
+        "method": "GET", "path": "/echo", "query_string": "q=%2B1",
+        "headers": {"cookie": "only=1"},
+    })
+    assert json.loads(rep["body"])["q"] == "+1"
+    assert json.loads(rep["body"])["cookies"] == ["only=1"]
+
+
 def test_asgi_ingress_fastapi_style(serve_cluster):
     """@serve.ingress(app) routes HTTP through an ASGI app with path
     params, querystrings and JSON bodies (reference: FastAPI ingress via
