@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
 from ray_tpu._private.resources import NodeResources, ResourceSet
-from ray_tpu._private.rpc import RpcClient, RpcServer, get_io_loop
+from ray_tpu._private.rpc import RpcClient, RpcServer, get_io_loop, spawn_task
 from ray_tpu._private.scheduling_policy import ClusterView, pick_node
 from ray_tpu._private.task_spec import SchedulingStrategySpec
 
@@ -687,7 +687,7 @@ class GcsServer:
             self.named_actors[name_key] = actor_id
         self._actor_events[actor_id] = asyncio.Event()
         self._snapshot_dirty = True
-        asyncio.ensure_future(self._schedule_actor(actor_id))
+        spawn_task(self._schedule_actor(actor_id))
         return {"ok": True}
 
     async def _schedule_actor(self, actor_id):
@@ -842,7 +842,7 @@ class GcsServer:
             a["addr"] = None
             self.pubsub.publish("actor", {"actor_id": actor_id,
                                           "state": RESTARTING})
-            asyncio.ensure_future(self._schedule_actor(actor_id))
+            spawn_task(self._schedule_actor(actor_id))
         else:
             a["state"] = DEAD
             a["death_cause"] = cause
@@ -942,7 +942,7 @@ class GcsServer:
             "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
             "name": name, "state": "PENDING", "bundle_nodes": [None] * len(bundles),
         }
-        asyncio.ensure_future(self._schedule_pg(pg_id))
+        spawn_task(self._schedule_pg(pg_id))
         return True
 
     async def _schedule_pg(self, pg_id):

@@ -30,7 +30,7 @@ from ray_tpu._private.object_store import NodeObjectStore
 from ray_tpu._private.resources import (
     CPU, MEM, OBJECT_STORE_MEM, TPU, NodeResources, ResourceSet,
 )
-from ray_tpu._private.rpc import RpcClient, RpcServer, get_io_loop
+from ray_tpu._private.rpc import RpcClient, RpcServer, get_io_loop, spawn_task
 from ray_tpu._private.scheduling_policy import (
     ClusterView, is_feasible_anywhere, pick_node,
 )
@@ -323,7 +323,7 @@ class Raylet:
                       runtime_env: Optional[Dict[str, Any]] = None) -> None:
         pool_key = self._pool_key(job_id, runtime_env)
         self._starting[pool_key] += 1
-        asyncio.ensure_future(
+        spawn_task(
             self._spawn_worker_async(job_id, runtime_env, pool_key))
 
     async def _spawn_worker_async(self, job_id: bytes,

@@ -43,7 +43,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 
-@dataclass
+@dataclass(slots=True)
 class _Ref:
     local: int = 0
     task_deps: int = 0
@@ -74,6 +74,12 @@ class ReferenceCounter:
                      None]] = None,
     ):
         self._refs: Dict[bytes, _Ref] = {}
+        # Side index: oids that currently have >=1 pending share. The TTL
+        # sweep walks ONLY this set — walking the full _refs table under
+        # the lock stalls every add_owned/add_local_ref caller for the
+        # whole scan once the table reaches millions of entries (observed
+        # as 180 s suite wedges inside add_owned).
+        self._with_pending: Set[bytes] = set()
         # Freed-object tombstones: get() distinguishes "freed by owner"
         # from "unknown" via is_freed, but keeping whole _Ref objects for
         # every dead ref grows the heap without bound (a long suite run
@@ -151,6 +157,7 @@ class ReferenceCounter:
             ref = self._live(object_id)
             if ref is not None:
                 ref.pending_shares.append(time.monotonic())
+                self._with_pending.add(object_id)
 
     # Compatibility alias (round-3 name, thin-client path).
     mark_shared = add_pending_share
@@ -163,6 +170,8 @@ class ReferenceCounter:
             if ref is None or not ref.pending_shares:
                 return
             ref.pending_shares.pop(0)
+            if not ref.pending_shares:
+                self._with_pending.discard(object_id)
             self._maybe_free(object_id, ref)
 
     def register_borrower(self, object_id: bytes, key: bytes,
@@ -179,6 +188,8 @@ class ReferenceCounter:
             ref.borrowers[key] = tuple(addr) if addr else None
             if ref.pending_shares:
                 ref.pending_shares.pop(0)
+                if not ref.pending_shares:
+                    self._with_pending.discard(object_id)
             return True
 
     def release_borrower(self, object_id: bytes, key: bytes) -> None:
@@ -204,12 +215,21 @@ class ReferenceCounter:
         recipients); frees objects whose last pin this was."""
         cutoff = time.monotonic() - ttl_s
         with self._lock:
-            for oid, ref in list(self._refs.items()):
-                if not ref.pending_shares:
-                    continue
-                ref.pending_shares = [t for t in ref.pending_shares
-                                      if t >= cutoff]
-                self._maybe_free(oid, ref)
+            candidates = list(self._with_pending)
+        # Chunked re-acquire: the sweep must never hold the lock long
+        # enough to stall foreground add_owned/add_local_ref callers.
+        for i in range(0, len(candidates), 512):
+            with self._lock:
+                for oid in candidates[i:i + 512]:
+                    ref = self._refs.get(oid)
+                    if ref is None or not ref.pending_shares:
+                        self._with_pending.discard(oid)
+                        continue
+                    ref.pending_shares = [t for t in ref.pending_shares
+                                          if t >= cutoff]
+                    if not ref.pending_shares:
+                        self._with_pending.discard(oid)
+                    self._maybe_free(oid, ref)
 
     def borrower_addrs(self) -> Dict[Tuple[str, int], List[Tuple[bytes, bytes]]]:
         """addr -> [(object_id, borrower_key)] for every worker-keyed
@@ -298,6 +318,7 @@ class ReferenceCounter:
             ref.released = True
             addr = ref.owner_addr
             del self._refs[object_id]
+            self._with_pending.discard(object_id)
             if addr is not None and self._on_borrow_release is not None:
                 self._on_borrow_release(object_id, addr)
 
@@ -316,6 +337,7 @@ class ReferenceCounter:
     def _tombstone(self, object_id: bytes) -> None:
         """Caller holds the lock: drop the _Ref, remember just the id."""
         self._refs.pop(object_id, None)
+        self._with_pending.discard(object_id)
         self._freed_ids[object_id] = None
         while len(self._freed_ids) > self._freed_cap:
             self._freed_ids.popitem(last=False)
@@ -325,6 +347,7 @@ class ReferenceCounter:
         dead worker's millions of entries otherwise dominates teardown)."""
         with self._lock:
             self._refs.clear()
+            self._with_pending.clear()
             self._contained.clear()
             self._freed_ids.clear()
 

@@ -37,6 +37,26 @@ _KIND_ERROR = 2
 _MAX_FRAME = 1 << 33
 
 
+# Strong references for fire-and-forget tasks. asyncio's loop holds only
+# WEAK references to tasks (see the create_task docs warning): a pending
+# task whose await-chain isn't externally reachable can be garbage-
+# collected mid-execution and silently vanish — under suite-level GC
+# pressure this kills daemons (lease dispatchers, read loops, GCS
+# schedulers) and everything downstream wedges. Every fire-and-forget
+# spawn in the runtime goes through spawn_task() so the task is pinned
+# until done.
+_BACKGROUND_TASKS: set = set()
+
+
+def spawn_task(coro: Awaitable, loop=None) -> "asyncio.Task":
+    """ensure_future + a strong reference held until the task finishes."""
+    task = asyncio.ensure_future(coro, loop=loop) if loop is not None \
+        else asyncio.ensure_future(coro)
+    _BACKGROUND_TASKS.add(task)
+    task.add_done_callback(_BACKGROUND_TASKS.discard)
+    return task
+
+
 def debug_log(tag: str, env_var: str = "RAY_TPU_DEBUG_SCHED"):
     """Env-gated stderr debug logger shared by the runtime daemons."""
     import sys
@@ -164,6 +184,10 @@ class EventLoopThread:
         self._coalesce = os.environ.get(
             "RAY_TPU_SUBMIT_COALESCE", "1") != "0"
         self._stopped = False
+        # Caller-side stop latch: set at stop() entry (NOT on the loop
+        # thread) so submits racing a shutdown fail fast even when the
+        # loop thread is wedged and _shutdown never runs.
+        self._stop_requested = False
         # Futures whose coroutine was started but not yet resolved.
         # Mutated only on the loop thread; swept by stop() after the
         # thread is joined (so no concurrent mutation is possible).
@@ -194,6 +218,12 @@ class EventLoopThread:
         before that point works and the coroutine never runs). No
         current caller cancels submit() futures; holders that need a
         cancellable handle should signal the coroutine directly."""
+        if self._stop_requested:
+            # stop() has begun (possibly with the loop thread wedged in a
+            # task's blocking call, so the loop may never drain again):
+            # enqueueing would hang the caller forever. Fail fast.
+            coro.close()
+            raise RuntimeError("event loop stopping")
         if not self._coalesce:
             return asyncio.run_coroutine_threadsafe(coro, self.loop)
         fut: concurrent.futures.Future = concurrent.futures.Future()
@@ -266,6 +296,8 @@ class EventLoopThread:
             fut.set_result(task.result())
 
     def stop(self):
+        self._stop_requested = True
+
         def _shutdown():
             self._stopped = True
             self._fail_pending("event loop stopping")
@@ -290,7 +322,7 @@ class EventLoopThread:
                     pass
                 self.loop.stop()
 
-            asyncio.ensure_future(_stop_when_done(), loop=self.loop)
+            spawn_task(_stop_when_done(), loop=self.loop)
 
         try:
             self.loop.call_soon_threadsafe(_shutdown)
@@ -420,7 +452,7 @@ class RpcServer:
                     break
                 if kind != _KIND_REQUEST:
                     continue
-                asyncio.ensure_future(
+                spawn_task(
                     self._dispatch(req_id, method, payload, writer,
                                    write_lock, is_mp)
                 )
@@ -635,7 +667,7 @@ class RpcClient:
                         raise
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, 1.0)
-            asyncio.ensure_future(self._read_loop(self._reader))
+            spawn_task(self._read_loop(self._reader))
 
     async def _read_loop(self, reader):
         try:
@@ -733,7 +765,7 @@ class RpcClient:
                 # dashboard handler): blocking would stall the loop for
                 # the full timeout — heartbeats stop, nodes get declared
                 # dead. Schedule and return.
-                asyncio.ensure_future(_close())
+                spawn_task(_close())
             else:
                 # Transports are loop-affine: hand the close to the loop
                 # that created the connection, without blocking if we are

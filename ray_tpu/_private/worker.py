@@ -34,7 +34,8 @@ from ray_tpu._private.object_ref import ObjectRef, reduce_object_ref
 from ray_tpu._private.object_store import MappedObject, WritableObject
 from ray_tpu._private.reference_count import ReferenceCounter
 from ray_tpu._private.resources import ResourceSet, TPU
-from ray_tpu._private.rpc import ConnectionLost, RpcClient, RpcServer, get_io_loop
+from ray_tpu._private.rpc import (ConnectionLost, RpcClient, RpcServer,
+                                  get_io_loop, spawn_task)
 from ray_tpu._private.serialization import (
     SerializationContext, SerializedObject, deserialize_error, serialize_error,
 )
@@ -578,11 +579,40 @@ class Worker:
                 raise exc.ObjectLostError(
                     f"object {oid.hex()} was already freed by its owner")
             entry = self._entry(oid)
-            if not entry.event.wait(timeout):
+            if not self._wait_entry(entry, timeout, oid):
                 raise exc.GetTimeoutError(
                     f"get() timed out waiting for {oid.hex()}")
             return self._materialize(oid, entry, timeout)
         return self._borrowed_get(ref, timeout)
+
+    def _wait_entry(self, entry, timeout: Optional[float],
+                    oid: bytes) -> bool:
+        """Event-wait in slices so a get() can notice that the runtime it
+        is waiting on has died (worker shutdown, io loop gone) instead of
+        sleeping out its entire — possibly 600 s — budget on an object
+        that can no longer arrive. Emits a progress diagnostic every
+        couple of minutes so a wedged suite run leaves a trail."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        waited = 0.0
+        while True:
+            slice_s = 30.0
+            if deadline is not None:
+                slice_s = min(slice_s, deadline - time.monotonic())
+                if slice_s <= 0:
+                    return False
+            if entry.event.wait(slice_s):
+                return True
+            waited += slice_s
+            if self._dead:
+                raise exc.RaySystemError(
+                    f"worker shut down while waiting for {oid.hex()}")
+            if not self.io._thread.is_alive():
+                raise exc.RaySystemError(
+                    f"io loop died while waiting for {oid.hex()}")
+            if waited >= 120 and int(waited) % 120 < 30:
+                print(f"[worker] still waiting for {oid.hex()} after "
+                      f"{waited:.0f}s (task dispatch pending)",
+                      file=sys.stderr, flush=True)
 
     def _materialize(self, oid: bytes, entry: _PendingObject,
                      timeout: Optional[float], _recovered: bool = False) -> Any:
@@ -1221,6 +1251,18 @@ class Worker:
     async def _run_normal_task(self, spec: TaskSpec, attempt: int = 0) -> None:
         try:
             await self._run_normal_task_inner(spec, attempt)
+        except asyncio.CancelledError:
+            # A cancelled dispatcher (io-loop shutdown, or any stray
+            # cancellation) previously sailed past `except Exception` and
+            # left every return entry unresolved — get() callers then
+            # waited out their FULL timeout on an object that could never
+            # arrive (the in-suite materialize wedge). Resolve the
+            # entries with an error before propagating.
+            self._fail_task(spec, serialize_error(exc.RaySystemError(
+                f"dispatcher for task {spec.name} was cancelled "
+                "(worker shutting down?)")))
+            self._release_deps(spec)
+            raise
         except Exception as e:  # noqa: BLE001 — submission machinery crashed
             self._fail_task(spec, serialize_error(e))
             # Every failure path must drop the task's pinned dependency
@@ -1348,7 +1390,7 @@ class Worker:
         st.event.set()
         if not self._lease_pool_sweeper_started:
             self._lease_pool_sweeper_started = True
-            asyncio.ensure_future(self._lease_pool_sweeper())
+            spawn_task(self._lease_pool_sweeper())
 
     async def _lease_pool_sweeper(self):
         """Give leases back to their raylet after a short idle window so
@@ -1398,7 +1440,7 @@ class Worker:
         st.event.set()
         if not st.dispatcher_started:
             st.dispatcher_started = True
-            asyncio.ensure_future(self._lease_dispatcher(key, st))
+            spawn_task(self._lease_dispatcher(key, st))
         self._spawn_lease_requesters(key, st, demand, strategy,
                                      spec.runtime_env)
         # No deadline here: a saturated-but-feasible cluster queues tasks
@@ -1443,7 +1485,7 @@ class Worker:
                     st.idle.appendleft(lease)
                     break
                 st.pushing += 1
-                asyncio.ensure_future(
+                spawn_task(
                     self._push_batch(key, st, lease, batch))
 
     @staticmethod
@@ -1554,7 +1596,7 @@ class Worker:
         want = min(len(st.waiters), 16)
         while st.inflight < want:
             st.inflight += 1
-            asyncio.ensure_future(self._lease_requester(
+            spawn_task(self._lease_requester(
                 key, st, demand, strategy, runtime_env))
 
     async def _lease_requester(self, key, st: "_LeaseState", demand,
@@ -1842,7 +1884,7 @@ class Worker:
         b = self._actor_batchers.get(actor_id)
         if b is None:
             b = self._actor_batchers[actor_id] = _ActorSendQueue()
-            b.task = asyncio.ensure_future(self._actor_send_loop(actor_id, b))
+            b.task = spawn_task(self._actor_send_loop(actor_id, b))
         fut = asyncio.get_running_loop().create_future()
         b.queue.append((spec, fut))
         b.event.set()
@@ -1900,7 +1942,7 @@ class Worker:
                 # Pipelined: the next batch is framed while this one's reply
                 # is in flight; the worker starts tasks in frame order and
                 # the seq machinery keeps per-caller FIFO.
-                asyncio.ensure_future(self._deliver_actor_batch(
+                spawn_task(self._deliver_actor_batch(
                     actor_id, batch, seqs, addr))
 
     async def _deliver_actor_batch(self, actor_id, batch, seqs, addr):

@@ -32,6 +32,14 @@ def main():
     parser.add_argument("--raylet-pid", type=int, default=0)
     args = parser.parse_args()
 
+    # SIGUSR1 dumps all thread stacks to stderr (the worker log) — a
+    # wedged cluster can be post-mortemed by signalling every daemon
+    # (gcs_server/raylet register the same handler).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     # runtime_env working_dir: the raylet exports it when this worker's
     # pool was spawned for an env that sets one (env_vars arrive directly
     # in this process's environment, applied at spawn).

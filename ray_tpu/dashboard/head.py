@@ -364,7 +364,9 @@ def main() -> None:
                         "[dashboard] GCS unreachable for ~30s; exiting\n")
                     os._exit(0)
 
-    loop.create_task(_gcs_watchdog())
+    from ray_tpu._private.rpc import spawn_task
+
+    spawn_task(_gcs_watchdog(), loop=loop)
     loop.run_forever()
 
 

@@ -23,6 +23,26 @@ from ray_tpu.data.block import BlockAccessor
 from ray_tpu.data.iterator import DataIterator, SplitIterator, _SplitCoordinator
 
 
+class _RowUdf:
+    """Row-wise UDF adapted to the block/batch interface so map/filter/
+    flat_map can ride the distributed map_batches machinery when the
+    caller asks for concurrency or custom resources."""
+
+    def __init__(self, fn: Callable, kind: str):
+        self.fn = fn
+        self.kind = kind
+
+    def __call__(self, table):
+        acc = BlockAccessor(table)
+        if self.kind == "map":
+            rows = [self.fn(dict(r)) for r in acc.rows()]
+        elif self.kind == "flat_map":
+            rows = [o for r in acc.rows() for o in self.fn(dict(r))]
+        else:  # filter
+            rows = [r for r in acc.rows() if self.fn(dict(r))]
+        return BlockAccessor.from_rows(rows)
+
+
 class Dataset:
     def __init__(self, ops: List[plan_mod.Op]):
         self._ops = ops
@@ -47,14 +67,42 @@ class Dataset:
             fn_kwargs=fn_kwargs or {}, concurrency=concurrency,
             num_cpus=num_cpus, num_tpus=num_tpus))
 
-    def map(self, fn: Callable, **_ignored) -> "Dataset":
-        return self._with(plan_mod.MapRows(fn))
+    def map(self, fn: Callable, *, concurrency: Optional[int] = None,
+            num_cpus: Optional[float] = None, num_tpus: float = 0,
+            **unknown) -> "Dataset":
+        """Per-row transform. ``concurrency``/``num_cpus``/``num_tpus``
+        are honored by routing through the distributed map_batches
+        machinery (reference: `python/ray/data/dataset.py` map's
+        ray_remote_args); anything else raises instead of silently
+        running serial (which the old ``**_ignored`` did)."""
+        return self._row_op(plan_mod.MapRows, fn, "map", concurrency,
+                            num_cpus, num_tpus, unknown)
 
-    def flat_map(self, fn: Callable, **_ignored) -> "Dataset":
-        return self._with(plan_mod.FlatMap(fn))
+    def flat_map(self, fn: Callable, *, concurrency: Optional[int] = None,
+                 num_cpus: Optional[float] = None, num_tpus: float = 0,
+                 **unknown) -> "Dataset":
+        return self._row_op(plan_mod.FlatMap, fn, "flat_map", concurrency,
+                            num_cpus, num_tpus, unknown)
 
-    def filter(self, fn: Callable, **_ignored) -> "Dataset":
-        return self._with(plan_mod.Filter(fn))
+    def filter(self, fn: Callable, *, concurrency: Optional[int] = None,
+               num_cpus: Optional[float] = None, num_tpus: float = 0,
+               **unknown) -> "Dataset":
+        return self._row_op(plan_mod.Filter, fn, "filter", concurrency,
+                            num_cpus, num_tpus, unknown)
+
+    def _row_op(self, op_cls, fn, kind: str, concurrency, num_cpus,
+                num_tpus, unknown: Dict) -> "Dataset":
+        if unknown:
+            raise TypeError(
+                f"{kind}() got unsupported options {sorted(unknown)}; "
+                "supported: concurrency, num_cpus, num_tpus")
+        if concurrency is None and num_cpus is None and not num_tpus:
+            return self._with(op_cls(fn))
+        return self._with(plan_mod.MapBatches(
+            _RowUdf(fn, kind), batch_format="pyarrow",
+            concurrency=concurrency,
+            num_cpus=1 if num_cpus is None else num_cpus,
+            num_tpus=num_tpus))
 
     def limit(self, n: int) -> "Dataset":
         return self._with(plan_mod.Limit(n))

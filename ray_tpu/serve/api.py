@@ -280,14 +280,71 @@ def delete(name: str) -> None:
                 timeout=60)
 
 
-def shutdown() -> None:
+def shutdown(graceful_timeout_s: float = 20.0) -> None:
+    """Tear serve down, bounded end to end.
+
+    Graceful first (controller drains replicas), then ``ray_tpu.kill``,
+    then — if a serve system actor's worker process is STILL alive past
+    the deadline — SIGKILL it directly. A wedged controller/proxy must
+    never hang the caller: one stuck teardown used to cascade into
+    setup timeouts for every test that followed (reference discipline:
+    `serve/_private/controller.py` graceful_shutdown + fixture kills in
+    `python/ray/tests/conftest.py`)."""
+    import os
+    import signal
+    import time as _time
+
     from ray_tpu.serve._private.controller import CONTROLLER_NAME
 
-    for actor_name in (_PROXY_NAME, CONTROLLER_NAME):
+    names = (_PROXY_NAME, _GRPC_PROXY_NAME, CONTROLLER_NAME)
+    deadline = _time.monotonic() + graceful_timeout_s
+
+    # Snapshot the system actors' worker pids BEFORE killing, for the
+    # hard backstop below.
+    pids = []
+    try:
+        from ray_tpu.util import state as _state
+
+        workers = {w["worker_id"]: w.get("pid")
+                   for w in _state.list_workers()}
+        for a in _state.list_actors():
+            if a.get("name") in names and a.get("state") != "DEAD":
+                pid = workers.get(a.get("worker_id"))
+                if pid:
+                    pids.append(int(pid))
+    except Exception:
+        pass
+
+    for actor_name in names:
         try:
             actor = ray_tpu.get_actor(actor_name)
-            if actor_name == CONTROLLER_NAME:
-                ray_tpu.get(actor.graceful_shutdown.remote(), timeout=60)
+        except Exception:
+            continue
+        if actor_name == CONTROLLER_NAME:
+            try:
+                ray_tpu.get(actor.graceful_shutdown.remote(),
+                            timeout=max(2.0, deadline - _time.monotonic()))
+            except Exception:
+                pass
+        try:
             ray_tpu.kill(actor)
         except Exception:
             pass
+
+    # Hard backstop: wait briefly for the processes to die, then SIGKILL
+    # survivors. os.kill only reaches same-host pids, which is exactly
+    # the wedge this guards (test clusters are single-host; multi-node
+    # kills already went through the raylet above).
+    kill_deadline = _time.monotonic() + 5.0
+    for pid in pids:
+        while _time.monotonic() < kill_deadline:
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                break  # gone
+            _time.sleep(0.1)
+        else:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass

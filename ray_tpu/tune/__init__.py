@@ -7,19 +7,24 @@ actors) also backs every trainer's `fit()`.
 
 from ray_tpu.tune._session import get_checkpoint, get_session, report
 from ray_tpu.tune.schedulers import (
-    AsyncHyperBandScheduler, FIFOScheduler, MedianStoppingRule,
-    PopulationBasedTraining,
+    AsyncHyperBandScheduler, FIFOScheduler, HyperBandScheduler,
+    MedianStoppingRule, PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
     choice, grid_search, loguniform, randint, sample_from, uniform,
 )
+from ray_tpu.tune.suggest import (
+    ConcurrencyLimiter, GPEISearcher, OptunaSearch, TPESearcher,
+)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
-    "AsyncHyperBandScheduler", "FIFOScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "ResultGrid", "TuneConfig",
-    "Tuner", "choice", "get_checkpoint", "get_session", "grid_search",
-    "loguniform", "randint", "report", "sample_from", "uniform",
+    "AsyncHyperBandScheduler", "ConcurrencyLimiter", "FIFOScheduler",
+    "GPEISearcher", "HyperBandScheduler", "MedianStoppingRule",
+    "OptunaSearch", "PopulationBasedTraining", "ResultGrid", "TPESearcher",
+    "TuneConfig", "Tuner", "choice", "get_checkpoint", "get_session",
+    "grid_search", "loguniform", "randint", "report", "sample_from",
+    "uniform",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

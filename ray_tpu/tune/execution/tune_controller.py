@@ -19,9 +19,11 @@ import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import Result
 from ray_tpu.tune import _session as tsession
-from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, FIFOScheduler, STOP
+from ray_tpu.tune.schedulers import (CONTINUE, EXPLOIT, FIFOScheduler,
+                                     PAUSE, STOP)
 
 PENDING = "PENDING"
+PAUSED = "PAUSED"
 RUNNING = "RUNNING"
 TERMINATED = "TERMINATED"
 ERRORED = "ERRORED"
@@ -129,7 +131,40 @@ class TuneController:
             # space (or limiter) is exhausted — stop rather than spin.
             self._maybe_suggest(pending, len(running))
             trial_by_id.update({t.trial_id: t for t in self.trials})
-            if not pending and not running:
+            # Synchronous schedulers (HyperBand) park trials at rung
+            # barriers and release them in batches once the rung is
+            # decided.
+            if hasattr(self._scheduler, "pop_decisions"):
+                resume, stop = self._scheduler.pop_decisions()
+                for tid in resume:
+                    t = trial_by_id.get(tid)
+                    if t is not None and t.status == PAUSED:
+                        t.status = PENDING
+                        pending.append(t)
+                for tid in stop:
+                    t = trial_by_id.get(tid)
+                    if t is not None and t.status in (PAUSED, PENDING):
+                        t.status = TERMINATED
+                        t.stopped_early = True
+                        if t in pending:
+                            pending.remove(t)
+                        self._notify_searcher(t)
+            paused_left = any(t.status == PAUSED for t in self.trials)
+            if not pending and not running and not paused_left:
+                break
+            if not pending and not running and paused_left:
+                # Only barrier-parked trials remain. Normally the last
+                # pause already flushed its bracket; force a flush to
+                # cover restore-from-snapshot and scheduler bugs, and
+                # fail the stragglers rather than spin forever.
+                flush = getattr(self._scheduler, "flush_barriers", None)
+                if flush is not None and flush():
+                    continue
+                for t in self.trials:
+                    if t.status == PAUSED:
+                        t.status = ERRORED
+                        t.error = "parked at a rung barrier that never flushed"
+                        self._notify_searcher(t)
                 break
             while pending and len(running) < self._max_concurrent:
                 trial = pending.pop(0)
@@ -175,6 +210,8 @@ class TuneController:
             except Exception as e:  # actor died
                 trial.status = ERRORED
                 trial.error = f"trial actor died: {e}"
+                getattr(self._scheduler, "on_trial_remove",
+                        lambda _t: None)(trial_id)
                 # The session persists checkpoints to the trial dir BEFORE
                 # report() returns, so a crash can leave a newer checkpoint
                 # on disk than the last result we received — recover it for
@@ -224,7 +261,29 @@ class TuneController:
                     decision = self._scheduler.on_result(
                         trial_id, metrics["training_iteration"],
                         float(metrics[self._metric]))
-                if decision == STOP:
+                if decision == PAUSE:
+                    # Rung barrier: checkpoint stays on disk; release
+                    # the slot and park until the scheduler decides.
+                    if trial.checkpoint_path is None:
+                        # Resume would silently restart from iteration 0
+                        # while training_iteration keeps counting — rung
+                        # comparisons would then rank restarted runs.
+                        import sys
+
+                        print(f"[tune] WARNING: pausing {trial_id} with "
+                              "no checkpoint; the trainable never "
+                              "reported one, so resume restarts from "
+                              "scratch (report a Checkpoint to make "
+                              "HyperBand pause/resume meaningful)",
+                              file=sys.stderr)
+                    try:
+                        ray_tpu.get(actor.request_stop.remote(), timeout=10)
+                    except Exception:
+                        pass
+                    running.pop(trial_id)
+                    ray_tpu.kill(actor)
+                    trial.status = PAUSED
+                elif decision == STOP:
                     trial.stopped_early = True
                     trial.status = TERMINATED
                     try:

@@ -21,6 +21,10 @@ STOP = "STOP"
 # this trial with a perturbed config (PBT). The controller calls
 # `exploit_target(trial_id)` and `mutate(donor_config)` to act on it.
 EXPLOIT = "EXPLOIT"
+# Scheduler asks the controller to checkpoint + park the trial (release
+# its resources) until the scheduler later resumes or stops it via
+# `pop_decisions()` — synchronous HyperBand's rung barrier.
+PAUSE = "PAUSE"
 
 
 class FIFOScheduler:
@@ -65,6 +69,172 @@ class AsyncHyperBandScheduler:
                     return STOP
                 break
         return CONTINUE
+
+
+class HyperBandScheduler:
+    """Synchronous HyperBand proper (reference:
+    `tune/schedulers/hyperband.py:1` HyperBandScheduler), distinct from
+    ASHA: trials are grouped into brackets; each bracket runs successive
+    halving ROUNDS with a barrier — every live trial in the bracket runs
+    to the round's budget, PAUSES, and only when the whole round has
+    reported does the bracket promote its top 1/eta and stop the rest.
+    The barrier trades ASHA's asynchrony for exact top-k promotion.
+
+    Bracket s (s = s_max..0) admits n_s = ceil((s_max+1)/(s+1) * eta^s)
+    trials with initial per-round budget r_s = max_t * eta^(-s); new
+    trials fill the highest-s bracket with a free slot.
+    """
+
+    def __init__(self, metric: str = None, mode: str = "max",
+                 max_t: int = 81, reduction_factor: float = 3):
+        self.metric = metric
+        self.mode = mode
+        self._max_t = max_t
+        self._eta = reduction_factor
+        # +1e-9: math.log(243, 3) is 4.999...97 — bare int() would drop
+        # the most-exploratory bracket for exact-power inputs.
+        self._s_max = int(math.log(max_t, reduction_factor) + 1e-9)
+        self._brackets: List[_HBBracket] = [
+            _HBBracket(s, self._s_max, max_t, reduction_factor)
+            for s in range(self._s_max, -1, -1)]
+        self._bracket_of: Dict[str, _HBBracket] = {}
+        # (resume_ids, stop_ids) accumulated by rung promotions, drained
+        # by the controller via pop_decisions().
+        self._resume: List[str] = []
+        self._stop: List[str] = []
+
+    def _assign(self, trial_id: str) -> "_HBBracket":
+        b = self._bracket_of.get(trial_id)
+        if b is None:
+            b = next((bk for bk in self._brackets if bk.has_room()),
+                     self._brackets[-1])
+            b.admit(trial_id)
+            self._bracket_of[trial_id] = b
+        return b
+
+    def on_result(self, trial_id: str, iteration: int, value: float) -> str:
+        if self.mode == "min":
+            value = -value
+        b = self._assign(trial_id)
+        decision = b.on_result(trial_id, iteration, value)
+        if decision == STOP and b.live and b.round_complete():
+            # This trial finishing its full budget may have been the last
+            # straggler its bracket's barrier was waiting on.
+            keep, drop = b.promote()
+            self._resume.extend(keep)
+            self._stop.extend(drop)
+            return STOP
+        if decision == PAUSE and b.round_complete():
+            keep, drop = b.promote()
+            self._resume.extend(keep)
+            self._stop.extend(drop)
+            if trial_id in drop:
+                self._stop.remove(trial_id)
+                return STOP
+            if trial_id in keep:
+                # This trial survived its own barrier flush; let it keep
+                # running instead of a pause/resume round-trip.
+                self._resume.remove(trial_id)
+                return CONTINUE
+        return decision
+
+    def on_trial_remove(self, trial_id: str) -> None:
+        """Trial errored/left: drop it so a rung barrier can't wait on a
+        trial that will never report."""
+        b = self._bracket_of.get(trial_id)
+        if b is not None:
+            b.remove(trial_id)
+            if b.round_complete() and b.live:
+                keep, drop = b.promote()
+                self._resume.extend(keep)
+                self._stop.extend(drop)
+
+    def pop_decisions(self):
+        """-> (resume_ids, stop_ids); called by the controller loop."""
+        resume, self._resume = self._resume, []
+        stop, self._stop = self._stop, []
+        return resume, stop
+
+    def flush_barriers(self) -> bool:
+        """Force-promote every bracket whose round is complete; True if
+        any decision was produced (controller's anti-spin backstop)."""
+        produced = False
+        for b in self._brackets:
+            # Bypass the round-0 fill requirement: nothing is pending or
+            # running, so the bracket will never fill further.
+            all_paused = bool(b.live) and all(
+                t in b.paused for t in b.live)
+            if all_paused:
+                keep, drop = b.promote()
+                self._resume.extend(keep)
+                self._stop.extend(drop)
+                produced = produced or bool(keep or drop)
+        return produced
+
+
+class _HBBracket:
+    def __init__(self, s: int, s_max: int, max_t: int, eta: float):
+        self.capacity = int(math.ceil(
+            (s_max + 1) / (s + 1) * eta ** s))
+        self.r0 = max(1, int(max_t * eta ** (-s)))
+        self.max_t = max_t
+        self.eta = eta
+        self.round = 0
+        self.live: List[str] = []
+        self.admitted = 0
+        self.scores: Dict[str, float] = {}   # this round's reports
+        self.paused: set = set()
+
+    def has_room(self) -> bool:
+        return self.admitted < self.capacity
+
+    def admit(self, trial_id: str) -> None:
+        self.admitted += 1
+        self.live.append(trial_id)
+
+    def milestone(self) -> int:
+        return min(self.max_t, int(self.r0 * self.eta ** self.round))
+
+    def on_result(self, trial_id: str, iteration: int, value: float) -> str:
+        self.scores[trial_id] = value
+        if iteration >= self.max_t:
+            # Done with its full budget: drop it from the bracket so the
+            # rung barrier can't wait on it (it will never pause).
+            self.remove(trial_id)
+            return STOP
+        if iteration >= self.milestone():
+            self.paused.add(trial_id)
+            return PAUSE
+        return CONTINUE
+
+    def round_complete(self) -> bool:
+        # Round 0 additionally waits for the bracket to FILL: trials are
+        # admitted lazily, so without this the first trial to pause
+        # would "win" a one-trial rung. Partial brackets (experiment
+        # smaller than capacity) are flushed by flush_barriers() once
+        # nothing else can arrive.
+        if self.round == 0 and self.admitted < self.capacity:
+            return False
+        return bool(self.live) and all(
+            t in self.paused for t in self.live)
+
+    def remove(self, trial_id: str) -> None:
+        if trial_id in self.live:
+            self.live.remove(trial_id)
+        self.paused.discard(trial_id)
+        self.scores.pop(trial_id, None)
+
+    def promote(self):
+        """Keep the top 1/eta of this round's reporters, stop the rest;
+        advance to the next round."""
+        ranked = sorted(self.live, key=lambda t: self.scores.get(
+            t, -math.inf), reverse=True)
+        k = max(1, int(len(ranked) / self.eta))
+        keep, drop = ranked[:k], ranked[k:]
+        self.live = list(keep)
+        self.paused.clear()
+        self.round += 1
+        return keep, drop
 
 
 class MedianStoppingRule:

@@ -195,6 +195,108 @@ class TPESearcher(Searcher):
         self._obs.append((cfg, score))
 
 
+class GPEISearcher(Searcher):
+    """Native Gaussian-process searcher with Expected Improvement
+    (reference role: `tune/search/bayesopt/bayesopt_search.py`, which
+    adapts the external bayes_opt GP — rebuilt here on numpy only).
+
+    Params encode to [0,1]^d (log-scaled for LogUniform, index-scaled
+    for Categorical/Randint). After ``n_startup`` random trials, fit an
+    RBF-kernel GP posterior over observations and suggest the candidate
+    (from ``n_candidates`` random draws) maximizing EI over the best
+    observed value.
+    """
+
+    def __init__(self, n_startup: int = 6, n_candidates: int = 256,
+                 length_scale: float = 0.2, noise: float = 1e-4,
+                 xi: float = 0.01, seed: Optional[int] = None):
+        self._n_startup = n_startup
+        self._n_cand = n_candidates
+        self._ls = length_scale
+        self._noise = noise
+        self._xi = xi
+        self._rng = np.random.default_rng(seed)
+        self._pyrng = random.Random(seed)
+        self._suggested: Dict[str, Dict[str, Any]] = {}
+        self._X: List[np.ndarray] = []
+        self._y: List[float] = []
+
+    # ---------------------------------------------------------- encoding
+    def _domains(self):
+        return [(k, v) for k, v in sorted(self.param_space.items())
+                if isinstance(v, Domain)]
+
+    def _encode(self, cfg: Dict[str, Any]) -> np.ndarray:
+        xs = []
+        for key, dom in self._domains():
+            v = cfg[key]
+            if isinstance(dom, Categorical):
+                cats = list(dom.categories)
+                xs.append(cats.index(v) / max(1, len(cats) - 1)
+                          if v in cats else 0.5)
+            elif isinstance(dom, LogUniform):
+                lo, hi = math.log(dom.lower), math.log(dom.upper)
+                xs.append((math.log(v) - lo) / max(hi - lo, 1e-12))
+            else:
+                lo = float(dom.lower)
+                hi = float(getattr(dom, "upper"))
+                xs.append((float(v) - lo) / max(hi - lo, 1e-12))
+        return np.asarray(xs)
+
+    def _random_config(self) -> Dict[str, Any]:
+        return {k: (v.sample(self._pyrng) if isinstance(v, Domain) else v)
+                for k, v in self.param_space.items()}
+
+    def _kernel(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self._ls ** 2))
+
+    # ---------------------------------------------------------- protocol
+    def suggest(self, trial_id):
+        if len(self._y) < self._n_startup:
+            cfg = self._random_config()
+            self._suggested[trial_id] = cfg
+            return dict(cfg)
+        X = np.stack(self._X)
+        y = np.asarray(self._y)
+        mu0, sd0 = y.mean(), y.std() or 1.0
+        yn = (y - mu0) / sd0
+        K = self._kernel(X, X) + self._noise * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+            alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        except np.linalg.LinAlgError:
+            cfg = self._random_config()
+            self._suggested[trial_id] = cfg
+            return dict(cfg)
+        cands = [self._random_config() for _ in range(self._n_cand)]
+        C = np.stack([self._encode(c) for c in cands])
+        Ks = self._kernel(C, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v * v).sum(0), 1e-12, None)
+        sigma = np.sqrt(var)
+        best = yn.max()
+        z = (mu - best - self._xi) / sigma
+        # EI = sigma * (z * Phi(z) + phi(z))
+        phi = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+        Phi = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        ei = sigma * (z * Phi + phi)
+        cfg = cands[int(np.argmax(ei))]
+        self._suggested[trial_id] = cfg
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._suggested.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._X.append(self._encode(cfg))
+        self._y.append(score)
+
+
 class OptunaSearch(Searcher):
     """Adapter over an installed optuna (reference:
     `search/optuna/optuna_search.py`); raises ImportError with guidance

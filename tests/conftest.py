@@ -32,6 +32,17 @@ except Exception:
 
 import pytest  # noqa: E402
 
+# Rarer full collections: with per-module freeze discipline (below) gen2
+# scans only objects created since the last module boundary, but the
+# default threshold still fires a full pass every ~7k gen1 collections —
+# observed burning whole 180s test budgets inside a single collection
+# late in the suite. 10x the gen2 trigger; absolute heap growth stays
+# bounded by the module-boundary collect.
+import gc as _gc  # noqa: E402
+
+_t0, _t1, _t2 = _gc.get_threshold()
+_gc.set_threshold(_t0, _t1, _t2 * 10)
+
 # ---------------------------------------------------------------------------
 # Per-test timeout (reference enforces 180s via pytest.ini + pytest-timeout;
 # that plugin isn't in this image, so use the same SIGALRM technique).
@@ -58,9 +69,61 @@ def _install_alarm(phase, item):
         # To a real file: pytest's capture plugin swallows stderr, and a
         # post-mortem needs the stack of the thing that hung.
         try:
+            import gc
+
             with open("/tmp/ray_tpu_test_timeouts.log", "a") as f:
                 f.write(f"\n=== {item.nodeid} {phase} "
                         f"exceeded {limit}s ===\n")
+                # GC context: past wedges dumped with a collection in
+                # progress; counts distinguish "pathological full GC"
+                # from "blocked in runtime code".
+                f.write(f"gc counts={gc.get_count()} "
+                        f"thresholds={gc.get_threshold()} "
+                        f"frozen={gc.get_freeze_count()}\n")
+                # SIGUSR1 every cluster daemon: their faulthandler dumps
+                # land in the session logs, giving the raylet/GCS/worker
+                # side of the wedge (the driver stack alone showed only
+                # "waiting for an object that never arrives").
+                pids = []
+                try:
+                    for pid in os.listdir("/proc"):
+                        if not pid.isdigit():
+                            continue
+                        try:
+                            with open(f"/proc/{pid}/cmdline", "rb") as c:
+                                cmd = c.read()
+                        except OSError:
+                            continue
+                        if (b"ray_tpu._private" in cmd
+                                or b"ray_tpu/_private" in cmd):
+                            os.kill(int(pid), signal.SIGUSR1)
+                            pids.append(int(pid))
+                except Exception:
+                    pass
+                f.write(f"signalled daemons (stacks in session logs): "
+                        f"{pids}\n")
+                # Session dirs are DELETED at module teardown, taking the
+                # dumps with them — preserve the newest sessions' logs
+                # now (1.5s for the dumps to flush; the 5s re-fire
+                # tolerates it).
+                try:
+                    import glob as _glob
+                    import shutil
+                    import time as _time
+
+                    _time.sleep(1.5)
+                    dest = (f"/tmp/ray_tpu_wedge_logs/"
+                            f"{int(_time.time())}_{os.getpid()}")
+                    for d in sorted(
+                            _glob.glob("/tmp/ray_tpu/session_*/logs"),
+                            key=os.path.getmtime)[-2:]:
+                        shutil.copytree(
+                            d, os.path.join(dest, os.path.basename(
+                                os.path.dirname(d))),
+                            dirs_exist_ok=True)
+                    f.write(f"logs preserved at {dest}\n")
+                except Exception as e:
+                    f.write(f"log preservation failed: {e!r}\n")
                 faulthandler.dump_traceback(file=f)
         except Exception:
             pass

@@ -327,3 +327,110 @@ def test_optuna_adapter_gated():
             OptunaSearch()
     else:
         assert OptunaSearch() is not None
+
+
+def test_hyperband_rung_barrier_unit():
+    """Synchronous HyperBand: a bracket promotes EXACTLY its top 1/eta
+    once every live trial has paused at the rung — no promotion before
+    the barrier (reference: tune/schedulers/hyperband.py)."""
+    from ray_tpu.tune.schedulers import (
+        CONTINUE, HyperBandScheduler, PAUSE, STOP,
+    )
+
+    hb = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                            reduction_factor=3)
+    # Bracket s=2 admits 9 trials at r0=1.
+    ids = [f"t{i}" for i in range(9)]
+    for i, tid in enumerate(ids[:-1]):
+        assert hb.on_result(tid, 1, float(i)) == PAUSE
+        resume, stop = hb.pop_decisions()
+        assert resume == [] and stop == []  # barrier holds
+    # Last report flushes the rung: top 3 survive (t8 reports now).
+    assert hb.on_result(ids[-1], 1, 8.0) == CONTINUE  # t8 is top-3
+    resume, stop = hb.pop_decisions()
+    assert sorted(resume) == ["t6", "t7"]  # t8 continued in place
+    assert sorted(stop) == [f"t{i}" for i in range(6)]
+
+    # Next rung at r0*eta = 3; survivors {t6,t7,t8} pause there.
+    assert hb.on_result("t8", 3, 8.0) == PAUSE
+    assert hb.on_result("t7", 3, 7.0) == PAUSE
+    # t6's report completes the rung: k = max(1, 3//3) = 1, best (t8)
+    # survives; t6 itself is cut (STOP inline), t7 via pop_decisions.
+    assert hb.on_result("t6", 3, 6.0) == STOP
+    resume, stop = hb.pop_decisions()
+    assert resume == ["t8"] and stop == ["t7"]
+
+
+def test_hyperband_errored_trial_does_not_wedge_barrier():
+    from ray_tpu.tune.schedulers import HyperBandScheduler, PAUSE
+
+    hb = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                            reduction_factor=3)
+    for i in range(8):
+        assert hb.on_result(f"t{i}", 1, float(i)) == PAUSE
+    # 9th trial dies instead of reporting: the barrier must flush.
+    hb._assign("t8")
+    hb.on_trial_remove("t8")
+    resume, stop = hb.pop_decisions()
+    assert resume and stop
+    assert len(resume) + len(stop) == 8
+
+
+def hb_trainable(config):
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    ckpt = tune.get_checkpoint()
+    start = ckpt.to_dict()["i"] + 1 if ckpt is not None else 0
+    for i in range(start, 9):
+        tune.report({"score": config["x"] + i * 0.01},
+                    checkpoint=Checkpoint.from_dict({"i": i}))
+
+
+def test_hyperband_end_to_end(ray_start_regular, tmp_path):
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    tuner = Tuner(
+        hb_trainable,
+        param_space={"x": tune.grid_search(
+            [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0])},
+        tune_config=TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=3,
+            scheduler=HyperBandScheduler(max_t=9, reduction_factor=3)),
+        run_config=RunConfig(name="hb", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.config["x"] == 9.0
+    # Early rungs cut most trials well before max_t.
+    lens = sorted(len(r.metrics_dataframe) for r in grid)
+    assert lens[0] <= 3 and lens[-1] >= 9
+
+
+def test_gp_ei_beats_random_at_equal_budget(ray_start_regular, tmp_path):
+    """GPEISearcher converges tighter than random search with the same
+    trial budget on a smooth 2-d objective."""
+    from ray_tpu.tune.suggest import GPEISearcher
+
+    def objective(config):
+        x, y = config["x"], config["y"]
+        tune.report({"loss": (x - 0.3) ** 2 + (y - 0.7) ** 2})
+
+    space = {"x": tune.uniform(0.0, 1.0), "y": tune.uniform(0.0, 1.0)}
+    budget = 24
+
+    def best_loss(search_alg, name, seed):
+        tuner = Tuner(
+            objective, param_space=dict(space),
+            tune_config=TuneConfig(metric="loss", mode="min",
+                                   num_samples=budget,
+                                   max_concurrent_trials=1,
+                                   search_seed=seed,
+                                   search_alg=search_alg),
+            run_config=RunConfig(name=name, storage_path=str(tmp_path)))
+        grid = tuner.fit()
+        return min(r.metrics["loss"] for r in grid)
+
+    gp = best_loss(GPEISearcher(n_startup=6, seed=3), "gp", 3)
+    rnd = best_loss(None, "rnd", 3)
+    assert gp < 0.01, f"GP-EI did not converge: {gp}"
+    assert gp <= rnd, (gp, rnd)
