@@ -280,6 +280,17 @@ class Raylet:
         # servers on it (not loopback) so cross-host owner RPCs, object
         # pulls, and jax.distributed rendezvous work on real clusters.
         env["RAY_TPU_NODE_IP"] = self.host
+        # Accelerator hygiene (reference: ray sets CUDA_VISIBLE_DEVICES=""
+        # for non-GPU workers): on a node with NO TPU resource, workers
+        # must never engage a real accelerator backend — site hooks keyed
+        # on this env var initialize the TPU transport inside EVERY
+        # python process, and a down/contended transport then hangs any
+        # worker whose code merely asks jax for a device count (observed:
+        # a train worker wedged in make_c_api_client for 180 s inside the
+        # test suite). Opt out with RAY_TPU_KEEP_ACCEL_HOOK=1.
+        if (not self.total.get("TPU")
+                and not env.get("RAY_TPU_KEEP_ACCEL_HOOK")):
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         return env
 
     def _runtime_env_manager(self):

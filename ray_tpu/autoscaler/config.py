@@ -170,6 +170,10 @@ def make_provider(cfg: Dict[str, Any], gcs_addr, session_dir: str):
         from ray_tpu.autoscaler.gcp_tpu_provider import GceTpuPodProvider
 
         return GceTpuPodProvider(cfg["provider"], gcs_addr)
+    if ptype in ("kuberay", "kubernetes", "gke"):
+        from ray_tpu.autoscaler.kuberay_provider import KubeRayProvider
+
+        return KubeRayProvider(cfg["provider"], gcs_addr)
     if "." in ptype:  # external: "my.module.MyProvider"
         import importlib
 
@@ -177,5 +181,6 @@ def make_provider(cfg: Dict[str, Any], gcs_addr, session_dir: str):
         provider_cls = getattr(importlib.import_module(mod), cls)
         return provider_cls(cfg["provider"], gcs_addr, session_dir)
     raise ClusterConfigError(
-        f"unknown provider type {ptype!r}: use 'fake'/'subprocess' or a "
+        f"unknown provider type {ptype!r}: use 'fake'/'subprocess', "
+        "'gcp_tpu', 'kuberay', or a "
         "'module.Class' path")

@@ -24,6 +24,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.autoscaler.tpu_pod_provider import PodGroupProvider
+from ray_tpu.autoscaler.node_provider import GcsNodeTableMixin
 
 TPU_API = "https://tpu.googleapis.com/v2"
 
@@ -81,7 +82,7 @@ class SSHCommandRunner(CommandRunner):
 
 # --------------------------------------------------------------- provider
 
-class GceTpuPodProvider(PodGroupProvider):
+class GceTpuPodProvider(GcsNodeTableMixin, PodGroupProvider):
     """TPU VM pod slices as atomic node groups.
 
     ``provider_config``: {"project", "zone", "cluster_name",
@@ -271,26 +272,6 @@ class GceTpuPodProvider(PodGroupProvider):
                 return n["node_id"]
         return None
 
-    def _node_table(self):
-        """GCS node snapshot with a short TTL cache: the autoscaler asks
-        internal_node_id for every host of every group per reconcile —
-        one fetch serves the whole pass."""
-        now = time.monotonic()
-        cached = getattr(self, "_node_table_cache", None)
-        if cached is not None and now - cached[0] < 2.0:
-            return cached[1]
-        try:
-            from ray_tpu._private.rpc import RpcClient
-
-            gcs = RpcClient(*self._gcs_addr)
-            try:
-                nodes = gcs.call("get_all_nodes", timeout=10)
-            finally:
-                gcs.close()
-        except Exception:
-            return None
-        self._node_table_cache = (now, nodes)
-        return nodes
 
     def refresh_groups(self) -> int:
         """Rediscover slices this cluster owns (reference: the gcp

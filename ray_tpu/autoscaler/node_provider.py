@@ -37,6 +37,37 @@ class NodeProvider:
         pass
 
 
+class GcsNodeTableMixin:
+    """TTL-cached GCS node snapshot for cloud providers that resolve
+    provider node ids to cluster NodeIDs (shared by the GCE TPU and
+    KubeRay providers; one fetch serves a whole reconcile pass)."""
+
+    _gcs_addr: Optional[tuple] = None
+    _NODE_TABLE_TTL_S = 2.0
+
+    def _node_table(self):
+        if self._gcs_addr is None:
+            return None
+        import time
+
+        now = time.monotonic()
+        cached = getattr(self, "_node_table_cache", None)
+        if cached is not None and now - cached[0] < self._NODE_TABLE_TTL_S:
+            return cached[1]
+        try:
+            from ray_tpu._private.rpc import RpcClient
+
+            gcs = RpcClient(*self._gcs_addr)
+            try:
+                nodes = gcs.call("get_all_nodes", timeout=10)
+            finally:
+                gcs.close()
+        except Exception:
+            return None
+        self._node_table_cache = (now, nodes)
+        return nodes
+
+
 class FakeMultiNodeProvider(NodeProvider):
     """Starts real raylet processes on this machine as 'cloud nodes' —
     scale-up/down runs the true join/leave path with no cloud."""

@@ -1,0 +1,237 @@
+"""KubeRay-equivalent provider against a mocked Kubernetes API server
+(reference: kuberay node-provider tests run operator-free the same way).
+Covers: declarative replica scaling, operator-materialized pods,
+multi-host TPU gangs as replica-indexed pod groups, workersToDelete
+termination, CR write conflicts, and the v2 reconciler driving the whole
+lifecycle end to end."""
+
+import copy
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.kuberay_provider import KubeRayError, KubeRayProvider
+
+GCS_ADDR = ("10.0.0.1", 6379)
+
+PROVIDER_CFG = {"type": "kuberay", "namespace": "ns1",
+                "cluster_name": "rc-test"}
+
+
+def make_cr(groups):
+    return {
+        "apiVersion": "ray.io/v1", "kind": "RayCluster",
+        "metadata": {"name": "rc-test", "namespace": "ns1",
+                     "resourceVersion": "1"},
+        "spec": {"workerGroupSpecs": [
+            {"groupName": name, "replicas": 0, "numOfHosts": hosts,
+             "maxReplicas": 10}
+            for name, hosts in groups]},
+    }
+
+
+class FakeKubeApi:
+    """API server + a minimal kuberay-operator emulator: every CR write
+    reconciles pods to replicas x numOfHosts per group, honoring
+    scaleStrategy.workersToDelete (matching pods are deleted first and
+    the list is cleared, exactly like the operator)."""
+
+    def __init__(self, cr, conflict_every: int = 0):
+        self.cr = cr
+        self.pods = {}           # name -> pod dict
+        self.counter = 0
+        self.writes = 0
+        self.conflict_every = conflict_every
+        self._reconcile()
+
+    # -------------------------------------------------------- transport
+    def __call__(self, method, path, body=None, **kw):
+        if "/rayclusters/" in path:
+            if method == "GET":
+                return copy.deepcopy(self.cr)
+            if method == "PUT":
+                self.writes += 1
+                if (self.conflict_every
+                        and self.writes % self.conflict_every == 0):
+                    raise RuntimeError("HTTP 409 Conflict")
+                if (body["metadata"]["resourceVersion"]
+                        != self.cr["metadata"]["resourceVersion"]):
+                    raise RuntimeError("HTTP 409 Conflict")
+                self.cr = copy.deepcopy(body)
+                self.cr["metadata"]["resourceVersion"] = str(
+                    int(self.cr["metadata"]["resourceVersion"]) + 1)
+                self._reconcile()
+                return copy.deepcopy(self.cr)
+        if path.startswith("/api/v1/namespaces/ns1/pods"):
+            sel = dict(kv.split("=") for kv in
+                       path.split("labelSelector=")[1].split(","))
+            items = [copy.deepcopy(p) for p in self.pods.values()
+                     if all(p["metadata"]["labels"].get(k) == v
+                            for k, v in sel.items())]
+            return {"items": items}
+        raise AssertionError((method, path))
+
+    # ------------------------------------------------- operator emulator
+    def _reconcile(self):
+        for spec in self.cr["spec"]["workerGroupSpecs"]:
+            group = spec["groupName"]
+            hosts = int(spec.get("numOfHosts", 1))
+            want = int(spec.get("replicas", 0))
+            doomed = spec.get("scaleStrategy", {}).get(
+                "workersToDelete", [])
+            for name in doomed:
+                self.pods.pop(name, None)
+            if doomed:
+                spec["scaleStrategy"]["workersToDelete"] = []
+            have = {}
+            for p in self.pods.values():
+                if p["metadata"]["labels"]["ray.io/group"] == group:
+                    have.setdefault(
+                        p["metadata"]["labels"].get("ray.io/replica-index")
+                        or p["metadata"]["name"], []).append(p)
+            # Scale up: create missing replicas (each = `hosts` pods).
+            while len(have) < want:
+                self.counter += 1
+                ridx = f"{group}-rep-{self.counter}"
+                members = []
+                for h in range(hosts):
+                    name = f"{ridx}-{h}"
+                    labels = {"ray.io/cluster": "rc-test",
+                              "ray.io/node-type": "worker",
+                              "ray.io/group": group}
+                    if hosts > 1:
+                        labels["ray.io/replica-index"] = ridx
+                    pod = {"metadata": {"name": name, "labels": labels},
+                           "status": {"phase": "Running",
+                                      "podIP": f"10.2.{self.counter}.{h}"}}
+                    self.pods[name] = pod
+                    members.append(pod)
+                have[ridx] = members
+            # Scale down beyond workersToDelete: drop newest replicas.
+            while len(have) > want:
+                ridx = sorted(have)[-1]
+                for p in have.pop(ridx):
+                    self.pods.pop(p["metadata"]["name"], None)
+
+
+def _provider(api, gcs_addr=GCS_ADDR):
+    return KubeRayProvider(PROVIDER_CFG, gcs_addr, transport=api,
+                           ready_timeout_s=5, poll_interval_s=0.01)
+
+
+def test_create_node_scales_replicas_and_waits_for_pod():
+    api = FakeKubeApi(make_cr([("cpu-group", 1)]))
+    p = _provider(api)
+    pid = p.create_node("cpu-group", {})
+    assert api.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+    assert p.non_terminated_nodes() == [pid]
+    assert p.node_type_of(pid) == "cpu-group"
+
+
+def test_gang_create_makes_numOfHosts_pods():
+    api = FakeKubeApi(make_cr([("tpu-v5e-16", 4)]))
+    p = _provider(api)
+    gid = p.create_node_group("tpu-v5e-16", {}, 4)
+    assert p.node_groups() == [gid]
+    assert len(p.group_nodes(gid)) == 4
+    assert p.group_type_of(gid) == "tpu-v5e-16"
+    # One replica of the multi-host group, not four.
+    assert api.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+
+
+def test_gang_size_mismatch_rejected():
+    api = FakeKubeApi(make_cr([("tpu-v5e-16", 4)]))
+    p = _provider(api)
+    with pytest.raises(KubeRayError, match="numOfHosts"):
+        p.create_node_group("tpu-v5e-16", {}, 8)
+
+
+def test_unknown_group_rejected():
+    api = FakeKubeApi(make_cr([("cpu-group", 1)]))
+    p = _provider(api)
+    with pytest.raises(KubeRayError, match="no workerGroupSpec"):
+        p.create_node("nope", {})
+
+
+def test_terminate_uses_workersToDelete():
+    api = FakeKubeApi(make_cr([("cpu-group", 1)]))
+    p = _provider(api)
+    pid = p.create_node("cpu-group", {})
+    p.terminate_node(pid)
+    assert p.non_terminated_nodes() == []
+    assert api.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 0
+
+
+def test_terminating_one_gang_member_kills_the_slice():
+    api = FakeKubeApi(make_cr([("tpu-v5e-16", 4)]))
+    p = _provider(api)
+    gid = p.create_node_group("tpu-v5e-16", {}, 4)
+    victim = p.group_nodes(gid)[2]
+    p.terminate_node(victim)
+    assert p.non_terminated_nodes() == []
+
+
+def test_cr_write_conflicts_are_retried():
+    api = FakeKubeApi(make_cr([("cpu-group", 1)]), conflict_every=2)
+    p = _provider(api)
+    pid = p.create_node("cpu-group", {})
+    assert p.non_terminated_nodes() == [pid]
+
+
+def test_yaml_wiring():
+    from ray_tpu.autoscaler.config import make_provider, validate_cluster_config
+
+    cfg = validate_cluster_config({
+        "cluster_name": "demo",
+        "provider": PROVIDER_CFG,
+        "available_node_types": {"cpu-group": {"node_config": {}}},
+    })
+    provider = make_provider(cfg, GCS_ADDR, "/tmp/nowhere")
+    assert isinstance(provider, KubeRayProvider)
+
+
+def test_reconciler_drives_kuberay_lifecycle(ray_start_isolated):
+    """v2 reconciler end to end over the mocked k8s API: pending demand
+    -> replica bump -> operator pods -> ALLOCATED instances; vanished
+    pod -> instance TERMINATED; explicit terminate -> workersToDelete."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.autoscaler.v2.instance_manager import InstanceStatus
+    from ray_tpu.autoscaler.v2.reconciler import Reconciler
+
+    w = global_worker()
+    api = FakeKubeApi(make_cr([("bigk8s-group", 1)]))
+    provider = _provider(api, w.gcs_addr)
+    types = {"bigk8s-group": {"resources": {"CPU": 2, "bigk8s": 1},
+                              "min_workers": 0, "max_workers": 3}}
+    rec = Reconciler(w.gcs_addr, provider, types, max_workers=3,
+                     idle_timeout_s=2.0)
+
+    @ray_tpu.remote(resources={"bigk8s": 0.5})
+    def needs():
+        return 1
+
+    ref = needs.remote()  # pending demand the cluster can't satisfy
+    try:
+        import time
+
+        launched = 0
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and launched == 0:
+            launched = rec.reconcile()["launched"]
+            time.sleep(0.2)
+        assert launched == 1
+        assert api.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+        allocated = rec.im.with_status(InstanceStatus.ALLOCATED)
+        assert len(allocated) == 1
+        cid = allocated[0].cloud_instance_id
+        assert cid in provider.non_terminated_nodes()
+
+        # Pod vanishes out from under the autoscaler (preemption):
+        # instance is retired on the next pass.
+        api.pods.pop(cid)
+        rec.reconcile()
+        inst = rec.im.instances[allocated[0].instance_id]
+        assert inst.status == InstanceStatus.TERMINATED
+    finally:
+        ray_tpu.cancel(ref, force=True)

@@ -175,3 +175,71 @@ def test_marwil_rejects_rows_without_reward_signal(off_cluster):
               .offline_data(rows))
     with pytest.raises(ValueError, match="rewards"):
         config.build()
+
+
+# ------------------------------------------------------------------ parquet
+def test_parquet_rollouts_roundtrip_through_data(off_cluster, tmp_path):
+    """record_rollouts(output_format='parquet') -> data.read_parquet ->
+    DatasetReader batches (the Data-backed offline path, closing the
+    JSONL-only gap)."""
+    from ray_tpu.rllib.offline.io import DatasetReader
+
+    path = str(tmp_path / "pq")
+    stats = record_rollouts("Pendulum-v1", path, num_episodes=3, seed=0,
+                            output_format="parquet")
+    assert stats["num_episodes"] == 3
+    import glob
+    assert glob.glob(path + "/*.parquet")
+
+    reader = DatasetReader(path)
+    rows = reader.rows()
+    assert len(rows) == 600  # 3 episodes x 200 steps
+    batch = next(reader.batches(batch_size=64))
+    assert batch["obs"].shape == (64, 3)
+    assert batch["next_obs"].shape == (64, 3)
+    assert batch["actions"].shape[0] == 64
+
+
+def test_cql_beats_bc_on_random_pendulum_data(off_cluster, tmp_path):
+    """CQL on mediocre (random-policy) Pendulum data learns a policy
+    better than behavior cloning of the same data — the conservative
+    Q function supports policy improvement, cloning cannot
+    (reference: `rllib/algorithms/cql/`). Reader streams from the
+    ray_tpu.data parquet pipeline."""
+    from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig, ContinuousBC
+
+    path = str(tmp_path / "pq")
+    stats = record_rollouts("Pendulum-v1", path, num_episodes=25, seed=1,
+                            output_format="parquet")
+    behavior_mean = stats["episode_return_mean"]
+
+    def build(cls, **kw):
+        cfg = CQLConfig()
+        cfg.env = "Pendulum-v1"
+        cfg.seed = 0
+        cfg.lr = 1e-3
+        cfg.train_batch_size = 256
+        cfg.num_batches_per_iteration = 200
+        for k, v in kw.items():
+            setattr(cfg, k, v)
+        cfg.offline_data(path)  # parquet path -> Data pipeline
+        return cls(cfg)
+
+    bc = build(ContinuousBC)
+    for _ in range(2):
+        bc.train()
+    bc_return = bc.evaluate(num_episodes=5)["episode_return_mean"]
+
+    # ~2400 updates: measured convergence from random-policy data is
+    # ~-900 by 1600 updates and ~-400 by 2000 (behavior ~-1240).
+    cql = build(CQL, cql_alpha=1.0, cql_n_actions=4)
+    metrics = {}
+    for _ in range(12):
+        metrics = cql.train()
+    assert "cql_loss" in metrics
+    cql_return = cql.evaluate(num_episodes=5)["episode_return_mean"]
+
+    # Cloned random actions stay near the behavior policy's return;
+    # CQL improves on both by a clear margin.
+    assert cql_return > bc_return + 100, (cql_return, bc_return)
+    assert cql_return > behavior_mean + 100, (cql_return, behavior_mean)
