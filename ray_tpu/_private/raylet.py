@@ -144,6 +144,9 @@ class Raylet:
         self._env_failures: Dict[bytes, Tuple[str, float]] = {}
         # worker_id -> RpcClient used by the memory monitor's busy probe.
         self._worker_probe_clients: Dict[bytes, Any] = {}
+        # Killed/retired worker Popen handles awaiting reap (zombies
+        # otherwise; see _retire_proc).
+        self._dying: List[subprocess.Popen] = []
 
     # ------------------------------------------------------------------- boot
     def start(self) -> int:
@@ -511,7 +514,7 @@ class Raylet:
             self.workers.pop(handle.worker_id, None)
             self._release_worker_env(handle)
             try:
-                handle.proc.kill()
+                self._retire_proc(handle.proc)
             except Exception:
                 pass
             return
@@ -597,15 +600,38 @@ class Raylet:
                 self.workers.pop(handle.worker_id, None)
                 self._release_worker_env(handle)
                 try:
-                    handle.proc.kill()
+                    self._retire_proc(handle.proc)
                 except Exception:
                     pass
+
+    def _retire_proc(self, proc) -> None:
+        """Kill (if alive) and queue for reaping. Every removal path
+        must route here: a kill() without a later wait() leaves a ZOMBIE
+        child, and a 10^3-actor storm was observed to stack ~800 of them
+        under the raylets (eventual PID exhaustion)."""
+        try:
+            if proc.poll() is None:
+                proc.kill()
+        except Exception:
+            pass
+        self._dying.append(proc)
+
+    def _reap_dying(self) -> None:
+        still = []
+        for proc in self._dying:
+            try:
+                if proc.poll() is None:
+                    still.append(proc)
+            except Exception:
+                pass
+        self._dying = still
 
     async def _reaper_loop(self):
         """Detect dead worker processes; report actor deaths to GCS."""
         last_ttl_sweep = time.monotonic()
         while not self._dead:
             await asyncio.sleep(0.2)
+            self._reap_dying()
             if time.monotonic() - last_ttl_sweep > 5.0:
                 last_ttl_sweep = time.monotonic()
                 self._sweep_idle_ttl()
@@ -709,7 +735,7 @@ class Raylet:
                 f"{usage:.2f} > {threshold:.2f}: OOM-killing worker "
                 f"pid={victim.proc.pid} (actor={victim.is_actor})\n")
             try:
-                victim.proc.kill()
+                self._retire_proc(victim.proc)
             except Exception:
                 pass
             # Let the reaper pick up the death before re-sampling, so one
@@ -1107,7 +1133,7 @@ class Raylet:
             self.workers.pop(worker_id, None)
             self._release_worker_env(handle)
             if handle.proc.poll() is None:
-                handle.proc.kill()
+                self._retire_proc(handle.proc)
         else:
             self._offer_worker(handle)
         return True
@@ -1155,9 +1181,13 @@ class Raylet:
         if handle is None:
             return False
         if force:
-            handle.proc.kill()
+            self._retire_proc(handle.proc)
         else:
-            handle.proc.terminate()
+            try:
+                handle.proc.terminate()  # graceful; the reaper collects it
+            except Exception:
+                pass
+            self._dying.append(handle.proc)
         return True
 
     # ------------------------------------------------------------ object store
@@ -1376,7 +1406,7 @@ class Raylet:
         self._dead = True
         for handle in self.workers.values():
             try:
-                handle.proc.kill()
+                self._retire_proc(handle.proc)
             except Exception:
                 pass
         self.store.cleanup()

@@ -118,7 +118,22 @@ def main():
             # heavier than a timeout (a BUSY raylet times out, it does
             # not refuse). The RPC layer wraps ECONNREFUSED in
             # ConnectionLost, so match on the message.
-            ping_fails += 5 if "refused" in str(e).lower() else 1
+            refused = "refused" in str(e).lower()
+            if refused:
+                ping_fails += 5
+            elif not args.raylet_pid:
+                ping_fails += 1
+            else:
+                # The raylet PROCESS is verifiably alive (liveness check
+                # above) and merely too busy to answer in 10s. Weighting
+                # these like refusals mass-suicided hundreds of healthy
+                # workers during a 10^3-actor storm whose raylet loop
+                # stalled 30s+ (respawns then fed the stall) — but a
+                # PERMANENTLY wedged-yet-alive server must still
+                # fate-share eventually, so timeouts count at 1/10
+                # weight: ~60 min of CONSECUTIVE dead air to trip vs
+                # ~1 min before.
+                ping_fails += 0.1
             if ping_fails >= (30 if args.raylet_pid else 5):
                 print(f"raylet unreachable (score {ping_fails}, last: "
                       f"{e}); exiting", file=sys.stderr, flush=True)

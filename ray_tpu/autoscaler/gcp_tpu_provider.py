@@ -32,8 +32,11 @@ TPU_API = "https://tpu.googleapis.com/v2"
 # ------------------------------------------------------------- transports
 
 def rest_transport(method: str, url: str,
-                   body: Optional[dict] = None) -> dict:
-    """Default transport: urllib + GCE metadata-server access token."""
+                   body: Optional[dict] = None, *,
+                   timeout: float = 60.0) -> dict:
+    """GCE-authenticated REST transport: urllib + metadata-server access
+    token. Shared by every Google-API surface (TPU provider, BigQuery
+    source/sink) — auth/timeout fixes land once."""
     import urllib.request
 
     tok_req = urllib.request.Request(
@@ -47,7 +50,7 @@ def rest_transport(method: str, url: str,
         data=json.dumps(body).encode() if body is not None else None,
         headers={"Authorization": f"Bearer {token}",
                  "Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=60) as resp:
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
         payload = resp.read()
     return json.loads(payload) if payload else {}
 

@@ -46,10 +46,15 @@ def k8s_transport(api_server: str,
     import ssl
     import urllib.request
 
-    token = ""
-    if os.path.exists(token_path):
-        with open(token_path) as f:
-            token = f.read().strip()
+    def read_token() -> str:
+        # Re-read per request: bound serviceaccount tokens rotate on
+        # disk (~1h validity); caching the boot-time string 401s a
+        # long-running autoscaler.
+        if os.path.exists(token_path):
+            with open(token_path) as f:
+                return f.read().strip()
+        return ""
+
     ctx = ssl.create_default_context()
     ca_path = os.path.join(os.path.dirname(token_path), "ca.crt")
     if os.path.exists(ca_path):
@@ -70,7 +75,7 @@ def k8s_transport(api_server: str,
             api_server.rstrip("/") + path,
             data=json.dumps(body).encode() if body is not None else None,
             method=method,
-            headers={"Authorization": f"Bearer {token}",
+            headers={"Authorization": f"Bearer {read_token()}",
                      "Content-Type": content_type,
                      "Accept": "application/json"})
         try:
@@ -113,6 +118,7 @@ class KubeRayProvider(GcsNodeTableMixin, NodeProvider):
         self._ready_timeout = ready_timeout_s
         self._poll = poll_interval_s
         self._internal_ids: Dict[str, bytes] = {}
+        self._pods_cache: Dict[str, tuple] = {}
 
     # ------------------------------------------------------------- CR I/O
     def _cr_path(self) -> str:
@@ -128,7 +134,11 @@ class KubeRayProvider(GcsNodeTableMixin, NodeProvider):
             cr = self._get_cr()
             mutate(cr)
             try:
-                return self._t("PUT", self._cr_path(), cr)
+                out = self._t("PUT", self._cr_path(), cr)
+                # Any CR write changes the pod set (operator reconcile):
+                # serve the next read fresh, not from the TTL cache.
+                self._pods_cache.clear()
+                return out
             except Conflict:
                 time.sleep(0.1)
             except Exception as e:
@@ -148,16 +158,30 @@ class KubeRayProvider(GcsNodeTableMixin, NodeProvider):
             f"{node_type!r}; declare it in the CR before autoscaling it")
 
     # --------------------------------------------------------------- pods
-    def _pods(self, extra_selector: str = "") -> List[dict]:
+    _PODS_TTL_S = 2.0
+
+    def _pods(self, extra_selector: str = "",
+              fresh: bool = False) -> List[dict]:
+        """Label-selected pod listing with a short TTL cache: one
+        reconcile pass calls node_type_of/internal_node_id/group_nodes
+        per node — uncached that is O(N) identical LIST requests per
+        pass (API-server throttling). Wait loops pass fresh=True."""
         sel = f"ray.io/cluster={self._name},ray.io/node-type=worker"
         if extra_selector:
             sel += "," + extra_selector
+        now = time.monotonic()
+        cached = self._pods_cache.get(sel)
+        if not fresh and cached is not None \
+                and now - cached[0] < self._PODS_TTL_S:
+            return cached[1]
         out = self._t("GET", self.PODS_PATH.format(ns=self._ns)
                       + f"?labelSelector={sel}")
-        return [p for p in out.get("items", [])
+        pods = [p for p in out.get("items", [])
                 if not p.get("metadata", {}).get("deletionTimestamp")
                 and p.get("status", {}).get("phase") in ("Pending",
                                                          "Running")]
+        self._pods_cache[sel] = (now, pods)
+        return pods
 
     @staticmethod
     def _pod_name(pod: dict) -> str:
@@ -195,7 +219,8 @@ class KubeRayProvider(GcsNodeTableMixin, NodeProvider):
         deadline = time.monotonic() + self._ready_timeout
         fresh: List[dict] = []
         while time.monotonic() < deadline:
-            fresh = [p for p in self._pods(f"ray.io/group={node_type}")
+            fresh = [p for p in self._pods(f"ray.io/group={node_type}",
+                                           fresh=True)
                      if self._pod_name(p) not in before]
             if len(fresh) >= gang_size and all(
                     p["status"].get("phase") == "Running" for p in fresh):

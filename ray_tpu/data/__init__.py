@@ -101,6 +101,18 @@ def read_sql(sql: str, connection_factory, *, shards=None,
     return _read(SQLDatasource(sql, connection_factory, shards=shards))
 
 
+def read_bigquery(project: str, *, table: Optional[str] = None,
+                  query: Optional[str] = None, transport=None,
+                  **_ignored) -> Dataset:
+    """BigQuery ingest (reference: `ray.data.read_bigquery`):
+    `table="dataset.table"` reads in parallel row ranges, `query=...`
+    runs a query job. `transport` overrides the REST transport (tests)."""
+    from ray_tpu.data.bigquery import BigQueryDatasource
+
+    return _read(BigQueryDatasource(project, table=table, query=query,
+                                    transport=transport))
+
+
 def read_images(paths, *, size=None, mode="RGB", **_ignored) -> Dataset:
     """Image directory/files -> rows with a dense "image" tensor column
     (reference: `read_api.py` read_images). `size=(H, W)` resizes for the
@@ -146,7 +158,7 @@ __all__ = [
     "read_json", "read_text", "read_binary_files", "read_images",
     "from_huggingface", "from_torch", "Datasink", "ParquetDatasink",
     "CSVDatasink", "JSONDatasink", "read_datasource", "read_tfrecords",
-    "read_webdataset", "read_sql",
+    "read_webdataset", "read_sql", "read_bigquery",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

@@ -291,6 +291,16 @@ class Dataset:
 
         return self.write_datasink(SQLDatasink(table, connection_factory))
 
+    def write_bigquery(self, project: str, table: str,
+                       transport=None) -> List[Any]:
+        """Streaming-insert blocks into `dataset.table` (reference:
+        `Dataset.write_bigquery`); a custom `transport` must be picklable
+        for parallel task writes."""
+        from ray_tpu.data.bigquery import BigQueryDatasink
+
+        return self.write_datasink(
+            BigQueryDatasink(project, table, transport=transport))
+
     # ---------------------------------------------------------------- misc
     def __repr__(self) -> str:  # pragma: no cover
         return self.stats()

@@ -29,6 +29,12 @@ class AlgorithmConfig:
         self.train_batch_size = 2048
         self.num_env_runners = 1
         self.num_envs_per_runner = 4
+        # env->module / module->learner connector pipeline: a list of
+        # Connector stages or zero-arg factories (reference:
+        # config.env_runners(env_to_module_connector=...) over
+        # ConnectorV2). Factories keep the config picklable and give
+        # each runner its own stage state.
+        self.connectors = None
         self.num_learners = 1
         self.jax_platform: Optional[str] = None
         self.module_hidden = (64, 64)
@@ -58,7 +64,10 @@ class AlgorithmConfig:
         return self
 
     def env_runners(self, num_env_runners: int = None,
-                    num_envs_per_runner: int = None) -> "AlgorithmConfig":
+                    num_envs_per_runner: int = None,
+                    connectors=None) -> "AlgorithmConfig":
+        if connectors is not None:
+            self.connectors = connectors
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_runner is not None:
@@ -164,12 +173,22 @@ class Algorithm:
             ]
             learner_class = self.ma_learner_class
         else:
+            obs_space = probe_env.observation_space
+            if config.connectors:
+                # The pipeline may widen the module's input (frame
+                # stacking); build the spec from the TRANSFORMED space.
+                from ray_tpu.rllib.connectors import build_pipeline
+
+                obs_space = build_pipeline(
+                    config.connectors).transform_observation_space(
+                        obs_space)
             self.module_spec = self._default_module_spec(
-                probe_env.observation_space, probe_env.action_space)
+                obs_space, probe_env.action_space)
             self.env_runners = [
                 EnvRunner.remote(config.env, self.module_spec,
                                  num_envs=config.num_envs_per_runner,
-                                 seed=config.seed + i)
+                                 seed=config.seed + i,
+                                 connectors=config.connectors)
                 for i in range(config.num_env_runners)
             ]
         self.learner_group = LearnerGroup(

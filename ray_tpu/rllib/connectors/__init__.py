@@ -1,0 +1,359 @@
+"""Connector pipelines — composable env→module / module→learner
+transformations.
+
+Reference: `rllib/connectors/connector_v2.py:1` (ConnectorV2: every
+new-stack algorithm routes observations through an env-to-module
+pipeline before the forward pass and batches through a module-to-learner
+pipeline before the update; obs normalization, frame stacking, and
+recurrent-state handling are pipeline pieces, not runner code).
+
+Redesigned for this runtime's shape:
+
+* A stage sees *batched lanes*: `env_to_module(obs, resets)` gets the
+  [N, ...] observation of N vectorized env copies plus the lane-reset
+  mask from the previous step, and returns what the RLModule should see.
+  The runner buffers the TRANSFORMED observation — the learner trains on
+  exactly what the policy saw at action time.
+* `module_to_learner(batch)` runs once per rollout fragment on the
+  [T, N, ...] time-major batch — it is where `next_obs` gets the same
+  view (e.g. the frame-stack shifted by one) and where per-fragment
+  statistics are frozen.
+* Stages are numpy/host-side: they run in the env loop (between env.step
+  and the jitted forward), so they must not trace; anything jit-worthy
+  belongs in the RLModule itself.
+* `transform_observation_space` lets a stage change the module's input
+  space (frame stack widens it) before the module spec is built.
+
+Stages carry state (`get_state`/`set_state`) so evaluation and restored
+runners resume with the same normalizer statistics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Connector", "ConnectorPipeline", "ObsNormalizer",
+           "FrameStack", "ClipObs", "RecurrentState"]
+
+
+class Connector:
+    """One pipeline stage (reference: ConnectorV2)."""
+
+    def transform_observation_space(self, space):
+        return space
+
+    def reset(self, n_envs: int) -> None:
+        pass
+
+    def env_to_module(self, obs: np.ndarray,
+                      resets: Optional[np.ndarray] = None) -> np.ndarray:
+        """Per-step: [N, ...] raw (or upstream-transformed) obs ->
+        module view. `resets[i]` True when lane i was reset after the
+        previous step."""
+        return obs
+
+    def peek(self, obs: np.ndarray) -> np.ndarray:
+        """Side-effect-free module view of `obs` (the bootstrap forward
+        at fragment end must not advance stacks or normalizer stats)."""
+        return obs
+
+    def module_to_learner(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-fragment: [T, N, ...] time-major batch -> learner view."""
+        return batch
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+class ConnectorPipeline(Connector):
+    """Ordered composition; env→module applies stages left to right,
+    module→learner in the same order (each stage sees its upstream's
+    output, mirroring the per-step path)."""
+
+    def __init__(self, stages: Sequence[Connector]):
+        self.stages: List[Connector] = list(stages)
+        # FrameStack's module_to_learner rebuilds next_obs from the
+        # ALREADY-transformed obs plus the raw successor frame — a
+        # normalizer ordered AFTER the stack would then re-normalize the
+        # k-1 older frames a second time (silently skewed TD targets).
+        # Enforce the only sound order instead of documenting it.
+        stack_idx = next((i for i, s in enumerate(self.stages)
+                          if isinstance(s, FrameStack)), None)
+        norm_idx = next((i for i, s in enumerate(self.stages)
+                         if isinstance(s, ObsNormalizer)), None)
+        if (stack_idx is not None and norm_idx is not None
+                and norm_idx > stack_idx):
+            raise ValueError(
+                "ObsNormalizer must come BEFORE FrameStack in the "
+                "pipeline: a post-stack normalizer would double-"
+                "normalize the stacked history when next_obs is rebuilt")
+
+    def transform_observation_space(self, space):
+        for s in self.stages:
+            space = s.transform_observation_space(space)
+        return space
+
+    def reset(self, n_envs: int) -> None:
+        for s in self.stages:
+            s.reset(n_envs)
+
+    def env_to_module(self, obs, resets=None):
+        for s in self.stages:
+            obs = s.env_to_module(obs, resets)
+        return obs
+
+    def peek(self, obs):
+        for s in self.stages:
+            obs = s.peek(obs)
+        return obs
+
+    @property
+    def recurrent_stage(self) -> Optional["RecurrentState"]:
+        for s in self.stages:
+            if isinstance(s, RecurrentState):
+                return s
+        return None
+
+    def module_to_learner(self, batch):
+        for s in self.stages:
+            batch = s.module_to_learner(batch)
+        return batch
+
+    def get_state(self):
+        return {i: s.get_state() for i, s in enumerate(self.stages)}
+
+    def set_state(self, state):
+        for i, s in enumerate(self.stages):
+            if i in state or str(i) in state:
+                s.set_state(state.get(i, state.get(str(i))))
+
+
+class ObsNormalizer(Connector):
+    """Running mean/std observation normalization (reference:
+    `connectors/env_to_module/mean_std_filter.py`). Welford update on
+    every env step; the fragment's `next_obs` is normalized with the
+    stats frozen at fragment end."""
+
+    def __init__(self, clip: float = 10.0, eps: float = 1e-8):
+        self.clip = clip
+        self.eps = eps
+        self._count = 0.0
+        self._mean: Optional[np.ndarray] = None
+        self._m2: Optional[np.ndarray] = None
+
+    def _update(self, x: np.ndarray) -> None:
+        # Vectorized parallel-Welford merge (Chan et al.): O(1) numpy
+        # calls per step — this runs in the per-step rollout hot path.
+        flat = x.reshape(-1, x.shape[-1]).astype(np.float64)
+        n_b = float(flat.shape[0])
+        if n_b == 0:
+            return
+        b_mean = flat.mean(axis=0)
+        b_m2 = ((flat - b_mean) ** 2).sum(axis=0)
+        if self._mean is None:
+            self._mean, self._m2, self._count = b_mean, b_m2, n_b
+            return
+        delta = b_mean - self._mean
+        tot = self._count + n_b
+        self._mean = self._mean + delta * (n_b / tot)
+        self._m2 = self._m2 + b_m2 + delta ** 2 * (self._count * n_b / tot)
+        self._count = tot
+
+    def _norm(self, x: np.ndarray) -> np.ndarray:
+        if self._mean is None or self._count < 2:
+            return np.asarray(x, np.float32)
+        std = np.sqrt(self._m2 / (self._count - 1)) + self.eps
+        out = (x - self._mean) / std
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def env_to_module(self, obs, resets=None):
+        self._update(obs)
+        return self._norm(obs)
+
+    def peek(self, obs):
+        return self._norm(obs)
+
+    def module_to_learner(self, batch):
+        # obs was normalized per step already; next_obs gets the
+        # end-of-fragment stats (the off-by-a-few-steps drift is noise
+        # at normal fragment lengths).
+        if "next_obs" in batch:
+            batch = dict(batch)
+            batch["next_obs"] = self._norm(batch["next_obs"])
+        return batch
+
+    def get_state(self):
+        return {"count": self._count,
+                "mean": None if self._mean is None else self._mean.copy(),
+                "m2": None if self._m2 is None else self._m2.copy()}
+
+    def set_state(self, state):
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+class FrameStack(Connector):
+    """Stack the last k observations along the feature axis (reference:
+    `connectors/env_to_module/frame_stacking.py`). Lane buffers zero-pad
+    at episode starts; `next_obs` in the learner batch is the stack
+    shifted by one frame — exactly the successor view the policy would
+    see."""
+
+    def __init__(self, k: int = 4):
+        if k < 2:
+            raise ValueError("FrameStack needs k >= 2")
+        self.k = k
+        self._buf: Optional[np.ndarray] = None     # [N, k, f]
+        self._feat: Optional[int] = None
+
+    def transform_observation_space(self, space):
+        import dataclasses
+
+        f = int(np.prod(space.shape))
+        self._feat = f
+        low = np.repeat(np.asarray(space.low, np.float32).reshape(-1),
+                        self.k)
+        high = np.repeat(np.asarray(space.high, np.float32).reshape(-1),
+                         self.k)
+        try:
+            return dataclasses.replace(space, low=low, high=high)
+        except TypeError:
+            return type(space)(low=low, high=high)
+
+    def reset(self, n_envs: int) -> None:
+        self._buf = None
+
+    def env_to_module(self, obs, resets=None):
+        obs = np.asarray(obs, np.float32)
+        N, f = obs.shape[0], int(np.prod(obs.shape[1:]))
+        obs = obs.reshape(N, f)
+        if self._buf is None or self._buf.shape[0] != N:
+            self._buf = np.zeros((N, self.k, f), np.float32)
+        elif resets is not None and resets.any():
+            self._buf[resets] = 0.0
+        self._buf = np.roll(self._buf, -1, axis=1)
+        self._buf[:, -1] = obs
+        # COPY, not a view: the runner buffers this array for training,
+        # and next step's in-place lane-reset zeroing would otherwise
+        # retroactively corrupt every episode's final stacked obs.
+        return self._buf.reshape(N, self.k * f).copy()
+
+    def peek(self, obs):
+        obs = np.asarray(obs, np.float32)
+        N, f = obs.shape[0], int(np.prod(obs.shape[1:]))
+        obs = obs.reshape(N, f)
+        buf = (np.zeros((N, self.k, f), np.float32)
+               if self._buf is None or self._buf.shape[0] != N
+               else self._buf)
+        sim = np.roll(buf, -1, axis=1).copy()
+        sim[:, -1] = obs
+        return sim.reshape(N, self.k * f)
+
+    def module_to_learner(self, batch):
+        if "next_obs" not in batch:
+            return batch
+        batch = dict(batch)
+        stacked = batch["obs"]                     # [T, N, k*f] (module view)
+        nxt = np.asarray(batch["next_obs"], np.float32)
+        T, N = nxt.shape[:2]
+        f = int(np.prod(nxt.shape[2:]))
+        nxt = nxt.reshape(T, N, f)
+        # successor stack = drop oldest frame, append the true successor.
+        batch["next_obs"] = np.concatenate(
+            [stacked[..., f:], nxt], axis=-1)
+        return batch
+
+    def get_state(self):
+        return {"buf": None if self._buf is None else self._buf.copy()}
+
+    def set_state(self, state):
+        self._buf = state["buf"]
+
+
+class ClipObs(Connector):
+    """Element-wise observation clipping (the simplest stage; also the
+    canonical 'add a transform without touching the runner' example)."""
+
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def env_to_module(self, obs, resets=None):
+        return np.clip(obs, self.low, self.high).astype(np.float32)
+
+    def peek(self, obs):
+        return np.clip(obs, self.low, self.high).astype(np.float32)
+
+    def module_to_learner(self, batch):
+        if "next_obs" in batch:
+            batch = dict(batch)
+            batch["next_obs"] = np.clip(
+                batch["next_obs"], self.low, self.high).astype(np.float32)
+        return batch
+
+
+class RecurrentState(Connector):
+    """Recurrent-state plumbing (reference: ConnectorV2's STATE_IN /
+    STATE_OUT handling for RNN modules). Carries a per-lane state vector
+    across steps, zeros it on episode reset, and exposes the time-major
+    `state_in` tensor in the learner batch so a recurrent learner can
+    replay the exact state sequence the policy acted with.
+
+    Protocol with the module: `forward_exploration` receives the obs
+    with the state CONCATENATED on the feature axis is NOT assumed —
+    instead the runner consults `pipeline.recurrent_stage`: if present,
+    it passes `state_in` as an extra kwarg and reads `state_out` from
+    the forward output. A module advertises support via
+    ``is_recurrent = True`` and ``state_size``.
+    """
+
+    def __init__(self, state_size: int):
+        self.state_size = state_size
+        self._state: Optional[np.ndarray] = None
+        self._trace: List[np.ndarray] = []
+
+    def reset(self, n_envs: int) -> None:
+        self._state = np.zeros((n_envs, self.state_size), np.float32)
+        self._trace = []
+
+    # Runner hooks (not part of the obs path).
+    def state_for_step(self, n_envs: int,
+                       resets: Optional[np.ndarray]) -> np.ndarray:
+        if self._state is None or self._state.shape[0] != n_envs:
+            self.reset(n_envs)
+        elif resets is not None and resets.any():
+            self._state[resets] = 0.0
+        self._trace.append(self._state.copy())
+        return self._state
+
+    def observe_state_out(self, state_out: np.ndarray) -> None:
+        self._state = np.asarray(state_out, np.float32)
+
+    def module_to_learner(self, batch):
+        if self._trace:
+            batch = dict(batch)
+            batch["state_in"] = np.stack(self._trace)   # [T, N, d]
+            self._trace = []
+        return batch
+
+    def get_state(self):
+        return {"state": None if self._state is None
+                else self._state.copy()}
+
+    def set_state(self, state):
+        self._state = state["state"]
+
+
+def build_pipeline(connectors) -> Optional[ConnectorPipeline]:
+    """None | list of stages/factories -> pipeline (factories let configs
+    stay picklable without sharing stage state across runners)."""
+    if not connectors:
+        return None
+    stages = [c() if callable(c) and not isinstance(c, Connector) else c
+              for c in connectors]
+    return ConnectorPipeline(stages)

@@ -1,9 +1,12 @@
 """EnvRunner — rollout-collection actors.
 
 Reference: `rllib/env/single_agent_env_runner.py` (vectorized gymnasium
-envs + RLModule.forward_exploration). Here the runner steps N env copies in
-lockstep with a batched CPU forward (jax pinned to the host CPU device so a
-TPU-holding driver never contends for the chip).
+envs + RLModule.forward_exploration) + `rllib/connectors/connector_v2.py`
+(the env→module / module→learner pipelines the runner routes through).
+Here the runner steps N env copies in lockstep with a batched CPU forward
+(jax pinned to the host CPU device so a TPU-holding driver never contends
+for the chip); preprocessing lives in the configured connector pipeline,
+never hard-coded in the loop.
 """
 
 from __future__ import annotations
@@ -13,14 +16,15 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.env.cartpole import make_env
+from ray_tpu.rllib.connectors import build_pipeline
 from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.cartpole import make_env
 
 
 @ray_tpu.remote(num_cpus=1)
 class EnvRunner:
     def __init__(self, env_spec, module_spec: RLModuleSpec,
-                 num_envs: int = 1, seed: int = 0):
+                 num_envs: int = 1, seed: int = 0, connectors=None):
         import jax
 
         self._cpu = jax.devices("cpu")[0]
@@ -35,6 +39,15 @@ class EnvRunner:
                               for i, e in enumerate(self._envs)])
         self._episode_returns = np.zeros(num_envs)
         self._completed: List[float] = []
+        # env→module / module→learner pipeline (identity when None).
+        self._pipeline = build_pipeline(connectors)
+        if self._pipeline is not None:
+            self._pipeline.reset(num_envs)
+        self._recurrent = (self._pipeline.recurrent_stage
+                           if self._pipeline is not None else None)
+        # Lanes reset after the PREVIOUS step (carried across fragments
+        # so stage state resets line up with episode boundaries).
+        self._resets = np.zeros(num_envs, bool)
 
     def set_weights(self, weights) -> bool:
         import jax
@@ -42,6 +55,30 @@ class EnvRunner:
         with jax.default_device(self._cpu):
             self._params = jax.device_put(weights, self._cpu)
         return True
+
+    def get_connector_state(self) -> Optional[Dict[str, Any]]:
+        """Pipeline state (normalizer stats, stack buffers) — for
+        evaluation-side parity and checkpoint/restore."""
+        return (None if self._pipeline is None
+                else self._pipeline.get_state())
+
+    def _module_view(self, raw_obs: np.ndarray) -> np.ndarray:
+        if self._pipeline is None:
+            return raw_obs.astype(np.float32)
+        return self._pipeline.env_to_module(
+            raw_obs.astype(np.float32), self._resets)
+
+    def _forward(self, proc_obs: np.ndarray, key):
+        if self._recurrent is not None and getattr(
+                self._module, "is_recurrent", False):
+            state_in = self._recurrent.state_for_step(
+                proc_obs.shape[0], self._resets)
+            out = self._fwd(self._params, proc_obs, key,
+                            state_in=state_in)
+            self._recurrent.observe_state_out(
+                np.asarray(out["state_out"]))
+            return out
+        return self._fwd(self._params, proc_obs, key)
 
     def sample(self, num_steps: int) -> Dict[str, Any]:
         """Collect `num_steps * num_envs` transitions (fragments allowed:
@@ -56,10 +93,12 @@ class EnvRunner:
         with jax.default_device(self._cpu):
             for _ in range(num_steps):
                 self._rng, key = jax.random.split(self._rng)
-                out = self._fwd(self._params,
-                                self._obs.astype(np.float32), key)
+                proc_obs = self._module_view(self._obs)
+                out = self._forward(proc_obs, key)
                 actions = np.asarray(out["actions"])
-                obs_buf.append(self._obs.copy())
+                # Buffer the module's VIEW: the learner must train on
+                # exactly what the policy saw at action time.
+                obs_buf.append(proc_obs)
                 act_buf.append(actions)
                 logp_buf.append(np.asarray(out["logp"]))
                 vf_buf.append(np.asarray(out["vf"]))
@@ -85,18 +124,30 @@ class EnvRunner:
                         self._episode_returns[i] = 0.0
                         obs, _ = env.reset()
                     self._obs[i] = obs
+                self._resets = dones.copy()
                 rew_buf.append(rewards)
                 done_buf.append(dones)
                 term_buf.append(terms)
                 next_obs_buf.append(next_obs.copy())
 
-            # Bootstrap value for the final observation of each env lane.
+            # Bootstrap value for the final observation of each env lane
+            # — a PEEK through the pipeline (no stat/stack mutation).
             self._rng, key = jax.random.split(self._rng)
-            last_vf = np.asarray(self._fwd(
-                self._params, self._obs.astype(np.float32), key)["vf"])
+            last_proc = (self._obs.astype(np.float32)
+                         if self._pipeline is None
+                         else self._pipeline.peek(
+                             self._obs.astype(np.float32)))
+            if self._recurrent is not None and getattr(
+                    self._module, "is_recurrent", False):
+                # Current state, WITHOUT advancing the recorded trace.
+                last_out = self._fwd(self._params, last_proc, key,
+                                     state_in=self._recurrent._state)
+            else:
+                last_out = self._fwd(self._params, last_proc, key)
+            last_vf = np.asarray(last_out["vf"])
 
         completed, self._completed = self._completed, []
-        return {
+        batch = {
             # [T, N, ...] time-major rollout fragments
             "obs": np.stack(obs_buf),
             "actions": np.stack(act_buf),
@@ -110,8 +161,12 @@ class EnvRunner:
             "next_obs": np.stack(next_obs_buf),
             "vf": np.stack(vf_buf),
             "last_vf": last_vf,
-            # Final observation per env lane: lets value-based algorithms
-            # (DQN) form next_obs for the last transition of the fragment.
-            "last_obs": self._obs.copy(),
+            # Final observation per env lane (module view): lets value-
+            # based algorithms (DQN) form next_obs for the last
+            # transition of the fragment.
+            "last_obs": np.asarray(last_proc),
             "episode_returns": completed,
         }
+        if self._pipeline is not None:
+            batch = self._pipeline.module_to_learner(batch)
+        return batch

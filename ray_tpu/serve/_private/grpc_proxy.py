@@ -15,6 +15,13 @@ selects apps. Payload bytes that parse as JSON become Python values;
 replies that are bytes pass through raw, strings utf-8, anything else
 JSON. Routing state (long-polled route table, per-app handles) mirrors
 the HTTP proxy.
+
+Scope note (deliberate v1 gap vs the reference): user-DEFINED protobuf
+servicers (`grpc_servicer_functions` compiling arbitrary .proto service
+definitions into the proxy) are not supported — every payload crosses
+as the generic bytes codec above. Clients with their own protos should
+serialize to bytes client-side; the escape hatch is a custom ASGI/gRPC
+deployment. Revisit if a real consumer needs schema'd stubs.
 """
 
 from __future__ import annotations

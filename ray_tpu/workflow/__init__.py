@@ -119,6 +119,20 @@ def step(fn=None, *, max_retries: int = 0, catch_exceptions: bool = False):
     return _StepBuilder(fn, max_retries, catch_exceptions)
 
 
+class EventStep(Step):
+    """A DAG node that completes when an external event arrives
+    (reference: `workflow.wait_for_event` over event_listener.py).
+    Executes DRIVER-side (it only polls); its delivered payload
+    checkpoints like any step output, so resume replays instead of
+    re-waiting, and the listener's `event_checkpointed` ack fires only
+    after the checkpoint is durable."""
+
+    def __init__(self, listener, timeout=None, name: str = "wait_for_event"):
+        super().__init__(fn=None, args=(), kwargs={}, name=name)
+        self.listener = listener
+        self.timeout = timeout
+
+
 # ---------------------------------------------------------------- executor
 
 def _assign_ids(root: Step) -> List[Step]:
@@ -182,6 +196,19 @@ def _execute(dag: Step, workflow_id: str) -> Any:
 
             def resolve(v):
                 return results[v.step_id] if isinstance(v, Step) else v
+
+            if isinstance(node, EventStep):
+                node.listener.bind(workflow_id, _storage())
+                value = node.listener.poll_for_event(timeout=node.timeout)
+                with open(out_path + ".tmp", "wb") as f:
+                    cloudpickle.dump(value, f)
+                os.replace(out_path + ".tmp", out_path)
+                try:
+                    node.listener.event_checkpointed(value)
+                except Exception:
+                    pass  # ack is best-effort; the checkpoint is durable
+                results[node.step_id] = value
+                continue
 
             args = tuple(resolve(a) for a in node.args)
             kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
@@ -259,7 +286,11 @@ def list_all() -> List[Dict[str, Any]]:
             out.append({"workflow_id": wf_id, "status": status})
     return out
 
-from ray_tpu._private.usage_stats import record_library_usage as _rlu
+from ray_tpu.workflow.events import (  # noqa: E402
+    EventListener, FileEventListener, HTTPEventProvider, wait_for_event,
+)
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu  # noqa: E402
 
 _rlu("workflow")
 del _rlu

@@ -230,6 +230,7 @@ def test_reconciler_drives_kuberay_lifecycle(ray_start_isolated):
         # Pod vanishes out from under the autoscaler (preemption):
         # instance is retired on the next pass.
         api.pods.pop(cid)
+        provider._pods_cache.clear()  # advance past the listing TTL
         rec.reconcile()
         inst = rec.im.instances[allocated[0].instance_id]
         assert inst.status == InstanceStatus.TERMINATED

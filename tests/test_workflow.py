@@ -126,3 +126,92 @@ def test_step_options_retries_and_catch(ray_start_regular, tmp_path):
     with pytest.raises(Exception):
         handle.step(always_fails.step()).run("wf_nocatch")
     assert workflow.get_status("wf_nocatch") == "FAILED"
+
+
+# ------------------------------------------------------------------ events
+def test_wait_for_event_delivers_and_checkpoints(ray_start_regular,
+                                                 tmp_path):
+    """A workflow parks on wait_for_event until the HTTP provider
+    delivers; after success the event payload is CHECKPOINTED — resume
+    replays it even with the event file gone (reference:
+    workflow/event_listener.py + http_event_provider.py)."""
+    import json
+    import threading
+    import urllib.request
+
+    @workflow.step
+    def combine(evt, y):
+        return f"{evt['order']}-{y}"
+
+    provider = workflow.HTTPEventProvider(
+        storage_dir=workflow._storage()).start()
+    try:
+        dag = combine.step(workflow.wait_for_event("payment"), 7)
+
+        out = {}
+
+        def run_wf():
+            out["result"] = dag.run("wf_evt")
+
+        t = threading.Thread(target=run_wf)
+        t.start()
+        import time
+
+        time.sleep(1.0)
+        assert t.is_alive()  # parked on the event
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{provider.port}/event/wf_evt/payment",
+            data=json.dumps({"order": "A17"}).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["delivered"]
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert out["result"] == "A17-7"
+
+        # GET reads the delivered event back.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{provider.port}/event/wf_evt/payment",
+                timeout=10) as resp:
+            assert json.loads(resp.read())["payload"] == {"order": "A17"}
+
+        # Durability: delete the event file; resume must REPLAY the
+        # checkpointed payload, not re-wait.
+        evt_file = os.path.join(workflow._storage(), "wf_evt", "events",
+                                "payment.json")
+        os.remove(evt_file)
+        assert workflow.resume("wf_evt") == "A17-7"
+    finally:
+        provider.stop()
+
+
+def test_wait_for_event_timeout(ray_start_regular, tmp_path):
+    @workflow.step
+    def use(evt):
+        return evt
+
+    dag = use.step(workflow.wait_for_event("never", timeout=0.5))
+    with pytest.raises(Exception, match="never"):
+        dag.run("wf_evt_to")
+    assert workflow.get_status("wf_evt_to") == "FAILED"
+
+
+def test_event_checkpointed_ack_fires_after_durable(ray_start_regular,
+                                                    tmp_path):
+    acks = []
+
+    class AckListener(workflow.FileEventListener):
+        def event_checkpointed(self, event):
+            acks.append(event)
+
+    @workflow.step
+    def use(evt):
+        return evt["n"]
+
+    from ray_tpu.workflow.events import deliver_event
+
+    deliver_event(workflow._storage(), "wf_ack", "go", {"n": 5})
+    dag = use.step(workflow.wait_for_event(lambda: AckListener("go")))
+    assert dag.run("wf_ack") == 5
+    assert acks == [{"n": 5}]
