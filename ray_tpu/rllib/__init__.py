@@ -12,6 +12,7 @@ from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.rainbow import Rainbow, RainbowConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.multi_rl_module import (MultiRLModule,
@@ -23,7 +24,8 @@ from ray_tpu.rllib.env.multi_agent_env import (MultiAgentCartPole,
 
 __all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "DQN", "DQNConfig",
            "IMPALA", "IMPALAConfig", "MARWIL", "MARWILConfig",
-           "PPO", "PPOConfig", "SAC", "SACConfig",
+           "PPO", "PPOConfig", "Rainbow", "RainbowConfig",
+           "SAC", "SACConfig",
            "LearnerGroup", "MLPModule", "RLModuleSpec",
            "MultiRLModule", "MultiRLModuleSpec", "MultiAgentEnv",
            "MultiAgentCartPole", "RockPaperScissors"]

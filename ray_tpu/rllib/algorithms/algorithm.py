@@ -50,6 +50,14 @@ class AlgorithmConfig:
         # spaces from an agent mapped to this module".
         self.policies = None
         self.policy_mapping_fn = None
+        # Evaluation workers (reference: algorithm_config.evaluation() +
+        # `rllib/evaluation/worker_set.py`): a dedicated runner fleet
+        # samples whole episodes greedily every `evaluation_interval`
+        # training iterations.
+        self.evaluation_interval = None
+        self.evaluation_num_env_runners = 1
+        self.evaluation_duration = 5          # episodes per evaluation
+        self.evaluation_explore = False
 
     # fluent builder sections (reference algorithm_config.py style)
     def environment(self, env) -> "AlgorithmConfig":
@@ -96,6 +104,21 @@ class AlgorithmConfig:
             self.policies = policies
         if policy_mapping_fn is not None:
             self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def evaluation(self, evaluation_interval=None,
+                   evaluation_num_env_runners=None,
+                   evaluation_duration=None,
+                   evaluation_explore=None) -> "AlgorithmConfig":
+        """Reference: `algorithm_config.py` AlgorithmConfig.evaluation()."""
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_env_runners is not None:
+            self.evaluation_num_env_runners = evaluation_num_env_runners
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        if evaluation_explore is not None:
+            self.evaluation_explore = evaluation_explore
         return self
 
     def rl_module(self, hidden=None,
@@ -191,6 +214,20 @@ class Algorithm:
                                  connectors=config.connectors)
                 for i in range(config.num_env_runners)
             ]
+        self.eval_runners: List[Any] = []
+        if config.evaluation_interval:
+            if self.multi_agent:
+                raise NotImplementedError(
+                    "evaluation workers support single-agent algorithms; "
+                    "sample multi-agent eval episodes via the runners "
+                    "directly")
+            self.eval_runners = [
+                EnvRunner.remote(config.env, self.module_spec,
+                                 num_envs=config.num_envs_per_runner,
+                                 seed=config.seed + 10_000 + i,
+                                 connectors=config.connectors)
+                for i in range(config.evaluation_num_env_runners)
+            ]
         self.learner_group = LearnerGroup(
             learner_class, self.module_spec,
             learner_config=self._learner_config(),
@@ -236,7 +273,56 @@ class Algorithm:
             if rets:
                 metrics[f"episode_return_mean/{agent}"] = float(
                     np.mean(rets[-win:]))
+        interval = getattr(self.config, "evaluation_interval", None)
+        if self.eval_runners and interval and \
+                self._iteration % interval == 0:
+            metrics["evaluation"] = self.evaluate()
         return metrics
+
+    def _eval_weights(self, weights):
+        """Hook: adjust raw learner weights for evaluation runners (DQN
+        overrides the in-pytree epsilon, which gets zero gradient and
+        would otherwise ship at its init value)."""
+        return weights
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Run `evaluation_duration` full episodes on the dedicated eval
+        fleet with current weights (greedy by default) and aggregate
+        (reference: `Algorithm.evaluate` over the eval WorkerSet)."""
+        if not self.eval_runners:
+            raise ValueError(
+                "no evaluation workers; set config.evaluation("
+                "evaluation_interval=...) before build()")
+        weights = self._eval_weights(self.learner_group.get_weights())
+        ref = ray_tpu.put(weights)
+        syncs = [r.set_weights.remote(ref) for r in self.eval_runners]
+        if self.config.connectors:
+            state = ray_tpu.get(
+                self.env_runners[0].get_connector_state.remote(),
+                timeout=600)
+            syncs += [r.set_connector_state.remote(state)
+                      for r in self.eval_runners]
+        ray_tpu.get(syncs, timeout=600)
+        total = int(self.config.evaluation_duration)
+        n = len(self.eval_runners)
+        per = [total // n + (1 if i < total % n else 0) for i in range(n)]
+        refs = [r.sample_episodes.remote(
+                    k, explore=self.config.evaluation_explore)
+                for r, k in zip(self.eval_runners, per) if k]
+        results = ray_tpu.get(refs, timeout=600)
+        returns = [r for res in results for r in res["episode_returns"]]
+        lengths = [l for res in results for l in res["episode_lengths"]]
+        return {
+            "episode_return_mean": float(np.mean(returns)) if returns
+            else float("nan"),
+            "episode_return_min": float(np.min(returns)) if returns
+            else float("nan"),
+            "episode_return_max": float(np.max(returns)) if returns
+            else float("nan"),
+            "episode_len_mean": float(np.mean(lengths)) if lengths
+            else float("nan"),
+            "num_episodes": len(returns),
+        }
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
@@ -263,7 +349,7 @@ class Algorithm:
 
     def stop(self) -> None:
         self.learner_group.shutdown()
-        for r in self.env_runners:
+        for r in self.env_runners + self.eval_runners:
             try:
                 ray_tpu.kill(r)
             except Exception:

@@ -172,17 +172,31 @@ class DQN(Algorithm):
 
     def __init__(self, config: DQNConfig):
         super().__init__(config)
-        self._buffer = ReplayBuffer(
-            config.buffer_capacity,
-            self.module_spec.observation_space.shape)
+        self._buffer = self._make_buffer()
         self._rng = np.random.RandomState(config.seed)
         self._env_steps = 0
         self._updates = 0
+
+    def _make_buffer(self):
+        """Factory hook (Rainbow swaps in prioritized replay; a hook, not
+        allocate-then-replace — capacity-sized arrays are too big to
+        build twice)."""
+        return ReplayBuffer(self.config.buffer_capacity,
+                            self.module_spec.observation_space.shape)
 
     def _learner_config(self) -> Dict[str, Any]:
         out = super()._learner_config()
         out["gamma"] = self.config.gamma
         return out
+
+    def _eval_weights(self, weights):
+        """Eval runners explore with the CURRENT annealed epsilon (when
+        evaluation_explore=True); the raw learner pytree still carries the
+        untrained init value 1.0 — shipping that would evaluate a
+        uniformly random policy."""
+        weights = dict(weights)
+        weights["epsilon"] = np.asarray(self._epsilon(), np.float32)
+        return weights
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -215,9 +229,8 @@ class DQN(Algorithm):
                 self._updates += 1
                 if self._updates % cfg.target_update_freq == 0:
                     self.learner_group.foreach_learner("sync_target")
-        # Ship annealed epsilon with the weights.
-        weights = self.learner_group.get_weights()
-        weights["epsilon"] = np.asarray(self._epsilon(), np.float32)
-        self._sync_weights(weights)
+        # Ship annealed epsilon with the weights (same override as eval).
+        self._sync_weights(
+            self._eval_weights(self.learner_group.get_weights()))
         metrics["num_gradient_updates"] = self._updates
         return metrics

@@ -1,0 +1,363 @@
+"""Rainbow DQN — distributional (C51) double-Q with dueling heads,
+n-step returns and prioritized replay.
+
+Reference: `rllib/algorithms/dqn/dqn.py` (the reference's DQN *is*
+Rainbow-capable: `num_atoms`/`v_min`/`v_max`/`n_step`/`noisy`/dueling all
+live on DQNConfig), `dqn/dqn_rainbow_learner.py` (categorical projection
+loss) and `rllib/utils/replay_buffers/prioritized_episode_buffer.py`.
+TPU-first shape: the categorical projection is a fully vectorized jitted
+scatter-add (no per-atom Python loop), the dueling/C51 head is one flax
+module, and per-sample priorities flow back from the jitted update as an
+array metric so the driver-side PER buffer can be updated without a second
+forward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env.spaces import Box, Discrete
+
+PRIORITY_KEY = "per_sample_priorities"
+
+
+def categorical_projection(next_probs: jax.Array, rewards: jax.Array,
+                           not_terminal: jax.Array, discounts: jax.Array,
+                           z: jax.Array, v_min: float,
+                           v_max: float) -> jax.Array:
+    """Project the Bellman-updated atom support back onto the fixed grid.
+
+    C51 (Bellamare et al.): Tz = r + gamma^n * z, clipped to [v_min, v_max],
+    with each atom's mass split linearly between its two neighbouring grid
+    points. `discounts` carries the per-sample effective gamma^k (n-step
+    fragments near an episode cut use fewer than n rewards).
+
+    next_probs: [B, K] target distribution at the double-Q argmax action.
+    Returns m: [B, K], the projected target distribution (rows sum to 1).
+    """
+    k = z.shape[0]
+    delta = (v_max - v_min) / (k - 1)
+    tz = jnp.clip(
+        rewards[:, None] + not_terminal[:, None] * discounts[:, None] * z[None, :],
+        v_min, v_max)
+    b = (tz - v_min) / delta                      # fractional atom index
+    # Dense triangle-kernel contraction instead of a scatter-add: source
+    # atom k puts max(0, 1 - |b_k - j|) of its mass on grid atom j — the
+    # exact linear split, with the on-grid case falling out naturally.
+    # [B,K]x[B,K,K] einsum: batch-shardable, no gather/scatter, MXU-sized.
+    kernel = jnp.clip(
+        1.0 - jnp.abs(b[:, :, None] - jnp.arange(k)[None, None, :]),
+        0.0, 1.0)
+    return jnp.einsum("bk,bkj->bj", next_probs, kernel)
+
+
+class RainbowModule(RLModule):
+    """Dueling C51 head: value stream [K] + advantage stream [A, K],
+    combined per-atom; Q(s,a) = sum_k p_k(s,a) * z_k. Exploration is
+    epsilon-greedy over expected Q with epsilon carried in the param
+    pytree (same weight-sync trick as QModule)."""
+
+    def __init__(self, observation_space: Box, action_space: Discrete,
+                 hidden: Sequence[int] = (64, 64), num_atoms: int = 51,
+                 v_min: float = -10.0, v_max: float = 10.0,
+                 dueling: bool = True):
+        import flax.linen as nn
+
+        obs_dim = int(np.prod(observation_space.shape))
+        n_actions = action_space.n
+
+        class _Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = x
+                for width in hidden:
+                    h = nn.relu(nn.Dense(width)(h))
+                adv = nn.Dense(n_actions * num_atoms)(h).reshape(
+                    (*h.shape[:-1], n_actions, num_atoms))
+                if not dueling:
+                    return adv
+                val = nn.Dense(num_atoms)(h)[..., None, :]
+                return val + adv - adv.mean(axis=-2, keepdims=True)
+
+        self._net = _Net()
+        self._obs_dim = obs_dim
+        self._n_actions = n_actions
+        self.v_min = float(v_min)
+        self.v_max = float(v_max)
+        self.z = jnp.linspace(v_min, v_max, num_atoms)
+
+    def init(self, rng: jax.Array) -> Any:
+        dummy = jnp.zeros((1, self._obs_dim), jnp.float32)
+        return {"net": self._net.init(rng, dummy),
+                "epsilon": jnp.asarray(1.0, jnp.float32)}
+
+    def _dist_q(self, params, obs) -> Tuple[jax.Array, jax.Array]:
+        logits = self._net.apply(params["net"], obs)    # [B, A, K]
+        probs = jax.nn.softmax(logits, axis=-1)
+        q = (probs * self.z).sum(-1)                    # [B, A]
+        return logits, q
+
+    def forward_train(self, params, obs):
+        logits, q = self._dist_q(params, obs)
+        return {"logits": logits, "q": q, "action_logits": q,
+                "vf": q.max(axis=-1)}
+
+    def forward_inference(self, params, obs):
+        _, q = self._dist_q(params, obs)
+        return {"actions": jnp.argmax(q, axis=-1)}
+
+    def forward_exploration(self, params, obs, rng):
+        _, q = self._dist_q(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        k_eps, k_act = jax.random.split(rng)
+        random_a = jax.random.randint(k_act, greedy.shape, 0,
+                                      self._n_actions)
+        explore = jax.random.uniform(k_eps, greedy.shape) < params["epsilon"]
+        return {"actions": jnp.where(explore, random_a, greedy),
+                "logp": jnp.zeros_like(q[..., 0]),
+                "vf": q.max(axis=-1)}
+
+
+class RainbowLearner(DQNLearner):
+    """Categorical TD loss with double-Q action selection; emits per-sample
+    priorities (the cross-entropy, Rainbow's proxy for |TD|) as an array
+    metric the driver feeds back into the PER buffer."""
+
+    def compute_loss_from_state(self, state, batch, rng):
+        out = self.module.forward_train(state["params"], batch["obs"])
+
+        # take_along_axis, not advanced indexing: the batch axis is sharded
+        # over the learner mesh and a gather's output sharding is ambiguous.
+        def _at_action(dist_logits, actions):
+            idx = actions.astype(jnp.int32)[:, None, None]
+            idx = jnp.broadcast_to(
+                idx, (idx.shape[0], 1, dist_logits.shape[-1]))
+            return jnp.take_along_axis(dist_logits, idx, axis=1)[:, 0]
+
+        chosen_logp = jax.nn.log_softmax(
+            _at_action(out["logits"], batch["actions"]), axis=-1)  # [B, K]
+
+        # Double-Q: online net picks a*, target net's DISTRIBUTION scores it.
+        q_next_online = self.module.forward_train(
+            state["params"], batch["next_obs"])["q"]
+        a_star = jnp.argmax(q_next_online, axis=-1)
+        next_logits = self.module.forward_train(
+            state["target"], batch["next_obs"])["logits"]
+        next_probs = jax.nn.softmax(_at_action(next_logits, a_star),
+                                    axis=-1)
+
+        z = self.module.z
+        m = categorical_projection(
+            jax.lax.stop_gradient(next_probs), batch["rewards"],
+            1.0 - batch["dones"].astype(jnp.float32),
+            batch["discounts"], z, self.module.v_min, self.module.v_max)
+        ce = -(jax.lax.stop_gradient(m) * chosen_logp).sum(-1)   # [B]
+        weights = batch.get("weights")
+        loss = (ce * weights).mean() if weights is not None else ce.mean()
+        q_taken = jnp.take_along_axis(
+            out["q"], batch["actions"].astype(jnp.int32)[:, None], -1)[:, 0]
+        return loss, {"td_loss": loss, "q_mean": q_taken.mean(),
+                      PRIORITY_KEY: ce}
+
+
+class PrioritizedReplayBuffer:
+    """Proportional PER over flat n-step transitions (driver-side numpy;
+    reference: `rllib/utils/replay_buffers/prioritized_episode_buffer.py`).
+    Sampling is cumsum + searchsorted over p^alpha; importance weights are
+    (N * P(i))^-beta normalized by their batch max."""
+
+    def __init__(self, capacity: int, obs_shape, alpha: float = 0.6,
+                 eps: float = 1e-6):
+        self._cap = capacity
+        self._alpha = alpha
+        self._eps = eps
+        self._obs = np.zeros((capacity, *obs_shape), np.float32)
+        self._next_obs = np.zeros((capacity, *obs_shape), np.float32)
+        self._actions = np.zeros((capacity,), np.int32)
+        self._rewards = np.zeros((capacity,), np.float32)
+        self._dones = np.zeros((capacity,), np.float32)
+        self._discounts = np.ones((capacity,), np.float32)
+        self._prio = np.zeros((capacity,), np.float64)
+        self._max_prio = 1.0
+        self._idx = 0
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones,
+                  discounts) -> None:
+        n = len(obs)
+        if n > self._cap:
+            obs, actions = obs[-self._cap:], actions[-self._cap:]
+            rewards, next_obs = rewards[-self._cap:], next_obs[-self._cap:]
+            dones, discounts = dones[-self._cap:], discounts[-self._cap:]
+            n = self._cap
+        idx = (self._idx + np.arange(n)) % self._cap
+        self._obs[idx] = obs
+        self._next_obs[idx] = next_obs
+        self._actions[idx] = actions
+        self._rewards[idx] = rewards
+        self._dones[idx] = dones
+        self._discounts[idx] = discounts
+        self._prio[idx] = self._max_prio ** self._alpha  # fresh = max urgency
+        self._idx = int((self._idx + n) % self._cap)
+        self._size = min(self._size + n, self._cap)
+
+    def sample(self, n: int, rng: np.random.RandomState, beta: float
+               ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        p = self._prio[:self._size]
+        csum = np.cumsum(p)
+        idx = np.searchsorted(
+            csum, rng.random_sample(n) * csum[-1], side="right")
+        idx = np.minimum(idx, self._size - 1)
+        probs = p[idx] / csum[-1]
+        w = (self._size * probs) ** (-beta)
+        w /= w.max()
+        batch = {
+            "obs": self._obs[idx], "next_obs": self._next_obs[idx],
+            "actions": self._actions[idx], "rewards": self._rewards[idx],
+            "dones": self._dones[idx], "discounts": self._discounts[idx],
+            "weights": w.astype(np.float32),
+        }
+        return batch, idx
+
+    def update_priorities(self, idx: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        pr = np.abs(np.asarray(priorities, np.float64)) + self._eps
+        self._prio[idx] = pr ** self._alpha
+        self._max_prio = max(self._max_prio, float(pr.max()))
+
+
+def nstep_from_fragment(rollout: Dict[str, np.ndarray], n_step: int,
+                        gamma: float) -> Dict[str, np.ndarray]:
+    """Compose flat n-step transitions from a time-major [T, N] fragment.
+
+    For each (t, lane): R = sum_{k} gamma^k r_{t+k}, accumulating until the
+    episode ends (done) or the fragment runs out; next_obs is the TRUE
+    successor at the stopping step, `dones` is env-true termination there
+    (TD bootstraps through time-limit truncation), and `discounts` is the
+    effective gamma^(steps used) for the projection.
+    """
+    rewards = rollout["rewards"]
+    dones = rollout["dones"].astype(bool)
+    terms = rollout["terminateds"].astype(np.float32)
+    T, N = rewards.shape
+    lanes = np.arange(N)
+
+    R = np.zeros((T, N), np.float32)
+    end = np.zeros((T, N), np.int64)
+    disc = np.zeros((T, N), np.float32)
+    for t in range(T):
+        acc = np.zeros(N, np.float32)
+        g = np.ones(N, np.float32)
+        active = np.ones(N, bool)
+        stop = np.full(N, t)
+        for k in range(n_step):
+            tk = t + k
+            if tk >= T:
+                break
+            acc = np.where(active, acc + g * rewards[tk], acc)
+            stop = np.where(active, tk, stop)
+            g *= gamma
+            active &= ~dones[tk]
+        R[t] = acc
+        end[t] = stop
+        disc[t] = gamma ** (stop - t + 1)
+
+    flat = lambda a: a.reshape(T * N, *a.shape[2:])  # noqa: E731
+    return {
+        "obs": flat(rollout["obs"]),
+        "actions": flat(rollout["actions"]).astype(np.int32),
+        "rewards": flat(R),
+        "next_obs": flat(rollout["next_obs"][end, lanes[None, :]]),
+        "dones": flat(terms[end, lanes[None, :]]),
+        "discounts": flat(disc),
+    }
+
+
+class RainbowConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.n_step = 3
+        self.num_atoms = 51
+        self.v_min = -10.0
+        self.v_max = 10.0
+        self.dueling = True
+        self.per_alpha = 0.6
+        self.per_beta_initial = 0.4
+        self.per_beta_final = 1.0
+        self.per_beta_decay_steps = 20_000   # in env steps
+
+    algo_class = property(lambda self: Rainbow)
+
+
+class Rainbow(DQN):
+    learner_class = RainbowLearner
+    rl_module_class = RainbowModule
+
+    def _make_buffer(self):
+        return PrioritizedReplayBuffer(
+            self.config.buffer_capacity,
+            self.module_spec.observation_space.shape,
+            alpha=self.config.per_alpha)
+
+    def _default_module_spec(self, obs_space, act_space):
+        spec = super()._default_module_spec(obs_space, act_space)
+        cfg = self.config
+
+        def _build(observation_space, action_space, hidden,
+                   _cfg=cfg) -> RainbowModule:
+            return RainbowModule(
+                observation_space, action_space, hidden,
+                num_atoms=_cfg.num_atoms, v_min=_cfg.v_min,
+                v_max=_cfg.v_max, dueling=_cfg.dueling)
+
+        # RLModuleSpec calls module_class(obs, act, hidden); close over the
+        # distributional geometry so learners and runners build identically.
+        spec.module_class = _build
+        return spec
+
+    def _beta(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(cfg.per_beta_decay_steps, 1))
+        return float(cfg.per_beta_initial
+                     + frac * (cfg.per_beta_final - cfg.per_beta_initial))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollouts = self.sample_batch(cfg.rollout_fragment_length)
+        for ro in rollouts:
+            T, N = ro["actions"].shape
+            self._env_steps += T * N
+            flat = nstep_from_fragment(ro, cfg.n_step, cfg.gamma)
+            self._buffer.add_batch(
+                flat["obs"], flat["actions"], flat["rewards"],
+                flat["next_obs"], flat["dones"], flat["discounts"])
+
+        metrics: Dict[str, Any] = {"env_steps": self._env_steps,
+                                   "buffer_size": len(self._buffer),
+                                   "epsilon": self._epsilon(),
+                                   "per_beta": self._beta()}
+        if len(self._buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                batch, idx = self._buffer.sample(
+                    cfg.train_batch_size, self._rng, self._beta())
+                update = self.learner_group.update(batch)
+                prios = update.pop(PRIORITY_KEY, None)
+                if prios is not None:
+                    self._buffer.update_priorities(idx, prios)
+                metrics.update(update)
+                self._updates += 1
+                if self._updates % cfg.target_update_freq == 0:
+                    self.learner_group.foreach_learner("sync_target")
+        self._sync_weights(
+            self._eval_weights(self.learner_group.get_weights()))
+        metrics["num_gradient_updates"] = self._updates
+        return metrics

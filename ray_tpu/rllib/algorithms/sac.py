@@ -73,6 +73,13 @@ class SACModule(RLModule):
         }
 
     # -------------------------------------------------------------- policy
+    def forward_inference(self, params, obs):
+        """Deterministic eval action: squashed mean (the base class's
+        argmax-over-action_logits default has no meaning for a
+        continuous policy)."""
+        mean, _ = self._actor.apply(params["actor"], obs)
+        return {"actions": jnp.tanh(mean) * self._act_scale}
+
     def sample_action(self, actor_params, obs, rng):
         """Reparameterized tanh-Gaussian sample -> (action, logp)."""
         mean, log_std = self._actor.apply(actor_params, obs)

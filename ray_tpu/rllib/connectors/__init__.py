@@ -217,10 +217,12 @@ class FrameStack(Connector):
 
         f = int(np.prod(space.shape))
         self._feat = f
-        low = np.repeat(np.asarray(space.low, np.float32).reshape(-1),
-                        self.k)
-        high = np.repeat(np.asarray(space.high, np.float32).reshape(-1),
-                         self.k)
+        # Stacked layout is frame-major ([frame0 feats, frame1 feats, ...]
+        # — buf.reshape(N, k*f) below), so bounds tile whole frames.
+        low = np.tile(np.asarray(space.low, np.float32).reshape(-1),
+                      self.k)
+        high = np.tile(np.asarray(space.high, np.float32).reshape(-1),
+                       self.k)
         try:
             return dataclasses.replace(space, low=low, high=high)
         except TypeError:

@@ -115,7 +115,19 @@ class Learner:
                 self._data_sh, np.asarray(v)), batch)
         self._state, metrics = self._update_fn(
             self._state, global_batch, jax.random.key(rng_seed))
-        return {k: float(v) for k, v in metrics.items()}
+        out: Dict[str, Any] = {}
+        for k, v in metrics.items():
+            if np.ndim(v) == 0:
+                out[k] = float(v)
+            else:
+                # Per-sample array metric (e.g. Rainbow's PER priorities).
+                # Dropped when not fully addressable (multi-process mesh) —
+                # the driver then skips the priority feedback for that step.
+                try:
+                    out[k] = np.asarray(v)
+                except Exception:
+                    pass
+        return out
 
     # ---------------------------------------------------------------- weights
     def get_weights(self) -> Any:

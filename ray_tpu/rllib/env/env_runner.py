@@ -48,6 +48,8 @@ class EnvRunner:
         # Lanes reset after the PREVIOUS step (carried across fragments
         # so stage state resets line up with episode boundaries).
         self._resets = np.zeros(num_envs, bool)
+        self._infer = None          # lazily-jitted greedy inference
+        self._seed = seed
 
     def set_weights(self, weights) -> bool:
         import jax
@@ -61,6 +63,88 @@ class EnvRunner:
         evaluation-side parity and checkpoint/restore."""
         return (None if self._pipeline is None
                 else self._pipeline.get_state())
+
+    def set_connector_state(self, state: Optional[Dict[str, Any]]) -> bool:
+        """Adopt a training runner's pipeline state so evaluation sees the
+        same normalization statistics (reference: eval workers share the
+        training connectors' state)."""
+        if self._pipeline is not None and state is not None:
+            self._pipeline.set_state(state)
+        return True
+
+    def sample_episodes(self, num_episodes: int, explore: bool = False,
+                        max_env_steps: int = 20_000) -> Dict[str, Any]:
+        """Run complete fresh episodes and return their returns/lengths —
+        the evaluation path (reference: `rllib/evaluation/worker_set.py`
+        eval workers sample whole episodes, by default greedily).
+
+        Greedy mode uses `forward_inference`; recurrent modules fall back
+        to the exploration forward (their inference needs carried state,
+        which the pipeline's recurrent stage manages on the sample path).
+        """
+        import jax
+
+        n_envs = len(self._envs)
+        recurrent = self._recurrent is not None and getattr(
+            self._module, "is_recurrent", False)
+        returns, lengths = [], []
+        with jax.default_device(self._cpu):
+            if self._infer is None and not recurrent:
+                self._infer = jax.jit(self._module.forward_inference)
+            obs = np.stack([
+                e.reset(seed=self._seed * 7919 + 1000 + i)[0]
+                for i, e in enumerate(self._envs)])
+            ep_ret = np.zeros(n_envs)
+            ep_len = np.zeros(n_envs, np.int64)
+            # Fresh-episode lanes: flush stack/recurrent state everywhere.
+            resets = np.ones(n_envs, bool)
+            steps = 0
+            while len(returns) < num_episodes and steps < max_env_steps:
+                if self._pipeline is None:
+                    proc = obs.astype(np.float32)
+                else:
+                    proc = self._pipeline.env_to_module(
+                        obs.astype(np.float32), resets)
+                if explore or recurrent:
+                    self._rng, key = jax.random.split(self._rng)
+                    prev_resets, self._resets = self._resets, resets
+                    out = self._forward(proc, key)
+                    self._resets = prev_resets
+                else:
+                    out = self._infer(self._params, proc)
+                actions = np.asarray(out["actions"])
+                discrete = np.issubdtype(actions.dtype, np.integer)
+                resets = np.zeros(n_envs, bool)
+                for i, env in enumerate(self._envs):
+                    act = int(actions[i]) if discrete else actions[i]
+                    o, r, term, trunc, _ = env.step(act)
+                    ep_ret[i] += r
+                    ep_len[i] += 1
+                    if term or trunc:
+                        returns.append(float(ep_ret[i]))
+                        lengths.append(int(ep_len[i]))
+                        ep_ret[i] = 0.0
+                        ep_len[i] = 0
+                        o, _ = env.reset()
+                        resets[i] = True
+                    obs[i] = o
+                steps += n_envs
+            # Restore training lanes: next sample() starts from a reset.
+            # pipeline.reset drains the recurrent stage's eval-time state
+            # trace (else it would grow unboundedly across evaluations and
+            # pollute the next training batch's state_in) and flushes
+            # stack buffers; stateless stages (normalizer stats) keep
+            # their statistics.
+            if self._pipeline is not None:
+                self._pipeline.reset(n_envs)
+            # UNSEEDED resets: reseeding with the construction seeds would
+            # restart training from the same few initial states after
+            # every evaluation, biasing replay toward them.
+            self._obs = np.stack([e.reset()[0] for e in self._envs])
+            self._episode_returns[:] = 0.0
+            self._resets = np.ones(n_envs, bool)
+        return {"episode_returns": returns[:num_episodes],
+                "episode_lengths": lengths[:num_episodes]}
 
     def _module_view(self, raw_obs: np.ndarray) -> np.ndarray:
         if self._pipeline is None:

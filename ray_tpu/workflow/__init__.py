@@ -132,6 +132,18 @@ class EventStep(Step):
         self.listener = listener
         self.timeout = timeout
 
+    def options(self, *, max_retries=None,
+                catch_exceptions=None) -> "EventStep":
+        # Step.options copy semantics would produce a plain Step (fn=None,
+        # listener dropped) that crashes at execution.
+        out = EventStep(self.listener, self.timeout, self.name)
+        out.max_retries = (self.max_retries if max_retries is None
+                           else max_retries)
+        out.catch_exceptions = (self.catch_exceptions
+                                if catch_exceptions is None
+                                else catch_exceptions)
+        return out
+
 
 # ---------------------------------------------------------------- executor
 

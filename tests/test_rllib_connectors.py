@@ -82,6 +82,24 @@ def test_frame_stack_widens_observation_space():
     assert int(np.prod(wide.shape)) == 8
 
 
+def test_frame_stack_bounds_match_data_layout():
+    """Stacked obs are frame-major; bounds must tile whole frames so
+    bound[i] brackets element [i] of the actual stacked vector."""
+    from ray_tpu.rllib.env.spaces import Box
+
+    space = Box(low=np.array([0.0, -5.0], np.float32),
+                high=np.array([1.0, 5.0], np.float32))
+    fs = FrameStack(k=2)
+    wide = fs.transform_observation_space(space)
+    np.testing.assert_array_equal(wide.low, [0.0, -5.0, 0.0, -5.0])
+    np.testing.assert_array_equal(wide.high, [1.0, 5.0, 1.0, 5.0])
+    fs.reset(1)
+    stacked = fs.env_to_module(np.array([[0.5, -4.0]], np.float32))
+    stacked = fs.env_to_module(np.array([[1.0, 4.0]], np.float32))
+    assert np.all(stacked[0] >= wide.low - 1e-6)
+    assert np.all(stacked[0] <= wide.high + 1e-6)
+
+
 def test_recurrent_state_resets_and_trace():
     rs = RecurrentState(state_size=3)
     rs.reset(2)

@@ -1,0 +1,281 @@
+"""Rainbow DQN (C51 + n-step + PER + dueling) and evaluation workers.
+
+Reference parity: `rllib/algorithms/dqn/dqn_rainbow_learner.py`
+(categorical projection), `rllib/utils/replay_buffers/
+prioritized_episode_buffer.py`, `rllib/evaluation/worker_set.py`.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def rl_cluster():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=8, num_tpus=0,
+                        object_store_memory=256 * 1024 * 1024,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------- projection
+def test_categorical_projection_mass_and_terminal():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.rainbow import categorical_projection
+
+    k = 11
+    z = jnp.linspace(-5.0, 5.0, k)
+    rng = np.random.RandomState(0)
+    probs = rng.dirichlet(np.ones(k), size=4).astype(np.float32)
+    rewards = jnp.asarray([0.0, 1.0, -2.0, 3.0])
+    discounts = jnp.full((4,), 0.9 ** 3)
+
+    m = categorical_projection(jnp.asarray(probs), rewards,
+                               jnp.asarray([1.0, 1.0, 1.0, 0.0]),
+                               discounts, z, -5.0, 5.0)
+    m = np.asarray(m)
+    # Projection preserves probability mass.
+    np.testing.assert_allclose(m.sum(-1), 1.0, atol=1e-5)
+    # Terminal row: all mass lands on the atoms bracketing the reward.
+    row = m[3]
+    b = (3.0 - (-5.0)) / 1.0          # delta = 1.0 -> index 8 exactly
+    assert row[int(b)] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_categorical_projection_matches_bruteforce():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.rainbow import categorical_projection
+
+    k = 7
+    v_min, v_max = -2.0, 2.0
+    z = np.linspace(v_min, v_max, k)
+    delta = (v_max - v_min) / (k - 1)
+    rng = np.random.RandomState(1)
+    probs = rng.dirichlet(np.ones(k), size=8).astype(np.float32)
+    rewards = rng.uniform(-1, 1, 8).astype(np.float32)
+    nt = rng.randint(0, 2, 8).astype(np.float32)
+    disc = np.full(8, 0.97, np.float32)
+
+    expect = np.zeros((8, k))
+    for i in range(8):
+        for j in range(k):
+            tz = np.clip(rewards[i] + nt[i] * disc[i] * z[j], v_min, v_max)
+            b = (tz - v_min) / delta
+            lo, hi = int(np.floor(b)), int(np.ceil(b))
+            if lo == hi:
+                expect[i, lo] += probs[i, j]
+            else:
+                expect[i, lo] += probs[i, j] * (hi - b)
+                expect[i, hi] += probs[i, j] * (b - lo)
+
+    got = np.asarray(categorical_projection(
+        jnp.asarray(probs), jnp.asarray(rewards), jnp.asarray(nt),
+        jnp.asarray(disc), jnp.asarray(z), v_min, v_max))
+    np.testing.assert_allclose(got, expect, atol=1e-5)
+
+
+# ---------------------------------------------------------------------- PER
+def test_prioritized_buffer_bias_and_weights():
+    from ray_tpu.rllib.algorithms.rainbow import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(64, (2,), alpha=1.0)
+    obs = np.zeros((4, 2), np.float32)
+    buf.add_batch(obs, np.zeros(4, np.int32), np.zeros(4, np.float32),
+                  obs, np.zeros(4, np.float32), np.ones(4, np.float32))
+    # Give index 3 a 100x priority; it should dominate samples.
+    buf.update_priorities(np.arange(4), np.array([0.01, 0.01, 0.01, 1.0]))
+    rng = np.random.RandomState(0)
+    batch, idx = buf.sample(512, rng, beta=1.0)
+    frac = (idx == 3).mean()
+    assert frac > 0.8, frac
+    # Importance weights: rare transitions get the LARGER weight; the
+    # most-sampled one is normalized to the batch minimum.
+    w_hot = batch["weights"][idx == 3]
+    w_cold = batch["weights"][idx != 3]
+    if len(w_cold):
+        assert w_cold.min() > w_hot.max()
+    assert batch["weights"].max() == pytest.approx(1.0)
+
+
+def test_prioritized_buffer_wraps_and_tracks_max():
+    from ray_tpu.rllib.algorithms.rainbow import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(8, (1,), alpha=0.5)
+    for i in range(3):
+        obs = np.full((4, 1), i, np.float32)
+        buf.add_batch(obs, np.zeros(4, np.int32),
+                      np.zeros(4, np.float32), obs,
+                      np.zeros(4, np.float32), np.ones(4, np.float32))
+    assert len(buf) == 8
+    rng = np.random.RandomState(1)
+    batch, idx = buf.sample(32, rng, beta=0.4)
+    assert batch["obs"].min() >= 1.0   # oldest batch overwritten
+
+
+# ------------------------------------------------------------------- n-step
+def test_nstep_composition():
+    from ray_tpu.rllib.algorithms.rainbow import nstep_from_fragment
+
+    # One lane, T=5, episode terminates at t=2.
+    T = 5
+    ro = {
+        "obs": np.arange(T, dtype=np.float32).reshape(T, 1, 1),
+        "actions": np.zeros((T, 1), np.int64),
+        "rewards": np.array([[1.0], [2.0], [4.0], [8.0], [16.0]],
+                            np.float32),
+        "dones": np.array([[0], [0], [1], [0], [0]], np.float32),
+        "terminateds": np.array([[0], [0], [1], [0], [0]], np.float32),
+        "next_obs": (np.arange(T, dtype=np.float32) + 1).reshape(T, 1, 1),
+    }
+    out = nstep_from_fragment(ro, n_step=3, gamma=0.5)
+    # t=0: 1 + .5*2 + .25*4 = 3, ends at t=2 (terminal), disc=0.5^3
+    assert out["rewards"][0] == pytest.approx(3.0)
+    assert out["dones"][0] == 1.0
+    assert out["next_obs"][0, 0] == pytest.approx(3.0)
+    assert out["discounts"][0] == pytest.approx(0.125)
+    # t=1: 2 + .5*4 = 4 — accumulation stops AT the terminal step.
+    assert out["rewards"][1] == pytest.approx(4.0)
+    assert out["dones"][1] == 1.0
+    assert out["discounts"][1] == pytest.approx(0.25)
+    # t=3: crosses no boundary, truncated by fragment end at t=4:
+    # 8 + .5*16 = 16, non-terminal (bootstraps), disc=0.25.
+    assert out["rewards"][3] == pytest.approx(16.0)
+    assert out["dones"][3] == 0.0
+    assert out["next_obs"][3, 0] == pytest.approx(5.0)
+    assert out["discounts"][3] == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------- learner + algo
+def test_rainbow_learner_reduces_loss_and_reports_priorities():
+    import jax
+
+    from ray_tpu.rllib.algorithms.rainbow import (
+        PRIORITY_KEY, RainbowLearner, RainbowModule)
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+    from ray_tpu.rllib.env.spaces import Box, Discrete
+
+    spec = RLModuleSpec(
+        Box(low=-np.ones(3), high=np.ones(3)), Discrete(2),
+        hidden=(32,),
+        module_class=lambda o, a, h: RainbowModule(
+            o, a, h, num_atoms=21, v_min=-5, v_max=5))
+    learner = RainbowLearner(spec, {"lr": 5e-3, "gamma": 0.9})
+    learner.build()
+    rng = np.random.RandomState(0)
+    batch = {
+        "obs": rng.randn(64, 3).astype(np.float32),
+        "next_obs": rng.randn(64, 3).astype(np.float32),
+        "actions": rng.randint(0, 2, 64).astype(np.int32),
+        "rewards": rng.randn(64).astype(np.float32),
+        "dones": (rng.rand(64) < 0.2).astype(np.float32),
+        "discounts": np.full(64, 0.9 ** 3, np.float32),
+        "weights": np.ones(64, np.float32),
+    }
+    losses = []
+    for i in range(40):
+        m = learner.update(batch, rng_seed=i)
+        losses.append(m["td_loss"])
+        assert PRIORITY_KEY in m
+        assert m[PRIORITY_KEY].shape == (64,)
+        assert np.all(m[PRIORITY_KEY] >= 0)
+    assert losses[-1] < losses[0]
+    # After 40 online updates the (stale) target differs from params;
+    # sync_target snapshots them equal again.
+    t0 = np.asarray(jax.tree.leaves(learner._state["target"]["net"])[0])
+    p0 = np.asarray(jax.tree.leaves(learner._state["params"]["net"])[0])
+    assert not np.array_equal(t0, p0)
+    learner.sync_target()
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()),
+        learner._state["target"], learner._state["params"]))
+
+
+def test_rainbow_cartpole_improves(rl_cluster):
+    from ray_tpu.rllib import RainbowConfig
+
+    config = (RainbowConfig()
+              .environment("CartPole-v1")
+              .training(lr=1e-3, train_batch_size=64)
+              .env_runners(num_env_runners=1, num_envs_per_runner=4)
+              .learners(num_learners=1, jax_platform="cpu")
+              .rl_module(hidden=(64, 64)))
+    config.learning_starts = 300
+    config.rollout_fragment_length = 32
+    config.epsilon_decay_steps = 4000
+    config.num_updates_per_iteration = 48
+    config.target_update_freq = 100
+    config.n_step = 3
+    config.num_atoms = 31
+    config.v_min = 0.0
+    config.v_max = 120.0        # CartPole returns are non-negative
+    algo = config.build()
+    try:
+        first, best = None, -np.inf
+        for _ in range(60):
+            m = algo.train()
+            r = m.get("episode_return_mean")
+            if r is not None:
+                if first is None:
+                    first = r
+                best = max(best, r)
+            if best >= 60:
+                break
+        assert first is not None
+        assert best >= 60, (first, best)
+    finally:
+        algo.stop()
+
+
+def test_sac_forward_inference_is_deterministic_mean():
+    """Greedy evaluation must work for continuous policies: SACModule's
+    forward_inference returns the squashed mean, within action bounds."""
+    import jax
+
+    from ray_tpu.rllib.algorithms.sac import SACModule
+    from ray_tpu.rllib.env.spaces import Box
+
+    mod = SACModule(Box(low=-np.ones(3), high=np.ones(3)),
+                    Box(low=-2 * np.ones(1), high=2 * np.ones(1)),
+                    hidden=(16,))
+    params = mod.init(jax.random.key(0))
+    obs = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    a1 = np.asarray(mod.forward_inference(params, obs)["actions"])
+    a2 = np.asarray(mod.forward_inference(params, obs)["actions"])
+    np.testing.assert_array_equal(a1, a2)
+    assert a1.shape == (5, 1)
+    assert np.all(np.abs(a1) <= 2.0)
+
+
+def test_evaluation_workers(rl_cluster):
+    from ray_tpu.rllib import DQNConfig
+
+    config = (DQNConfig()
+              .environment("CartPole-v1")
+              .training(lr=1e-3, train_batch_size=32)
+              .env_runners(num_env_runners=1, num_envs_per_runner=2)
+              .learners(num_learners=1, jax_platform="cpu")
+              .evaluation(evaluation_interval=2, evaluation_duration=4,
+                          evaluation_num_env_runners=2))
+    config.learning_starts = 64
+    config.rollout_fragment_length = 16
+    config.num_updates_per_iteration = 4
+    algo = config.build()
+    try:
+        m1 = algo.train()
+        assert "evaluation" not in m1          # iteration 1, interval 2
+        m2 = algo.train()
+        ev = m2["evaluation"]
+        assert ev["num_episodes"] == 4
+        assert np.isfinite(ev["episode_return_mean"])
+        assert ev["episode_return_max"] >= ev["episode_return_mean"] \
+            >= ev["episode_return_min"]
+        assert ev["episode_len_mean"] > 0
+        # Direct evaluate() also works between train() calls.
+        ev2 = algo.evaluate()
+        assert ev2["num_episodes"] == 4
+    finally:
+        algo.stop()

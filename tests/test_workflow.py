@@ -129,6 +129,21 @@ def test_step_options_retries_and_catch(ray_start_regular, tmp_path):
 
 
 # ------------------------------------------------------------------ events
+def test_event_step_options_keeps_listener():
+    """wait_for_event(...).options(...) must stay an EventStep — Step's
+    copy semantics would drop the listener and crash at execution."""
+    from ray_tpu.workflow import EventStep
+
+    ev = workflow.wait_for_event("approved", timeout=3.0)
+    tuned = ev.options(max_retries=2, catch_exceptions=True)
+    assert isinstance(tuned, EventStep)
+    assert tuned.listener is ev.listener
+    assert tuned.timeout == 3.0
+    assert tuned.max_retries == 2 and tuned.catch_exceptions
+    # untouched original (copy semantics preserved)
+    assert ev.max_retries == 0 and not ev.catch_exceptions
+
+
 def test_wait_for_event_delivers_and_checkpoints(ray_start_regular,
                                                  tmp_path):
     """A workflow parks on wait_for_event until the HTTP provider
