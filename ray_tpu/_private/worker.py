@@ -283,7 +283,8 @@ class Worker:
                      "consume_pending_share",
                      "stack_dump", "profile",
                      "delete_object_notification", "report_generator_item",
-                     "recover_object", "wait_object_status"]:
+                     "recover_object", "wait_object_status",
+                     "early_task_result"]:
             self.server.register(name, getattr(self, f"_h_{name}"))
         self.port = self.server.start()
         self.addr = (bind_host, self.port)
@@ -368,6 +369,10 @@ class Worker:
         # task_id -> executing worker addr, while a push RPC is in flight
         # (real cancel needs the executing worker, not a broadcast).
         self._inflight_push: Dict[bytes, Tuple[str, int]] = {}
+        # Dispatch futures for multi-task push batches, keyed by task id —
+        # the early_task_result side channel resolves them before the
+        # aggregate batch reply lands (anti-deadlock; see _h_push_tasks).
+        self._inflight_futs: Dict[bytes, Any] = {}
         # Leased-worker reuse (reference: direct task submitter lease
         # caching in `lease_policy.h` / `normal_task_submitter`): a lease
         # whose task finished cleanly is handed to the next same-shaped
@@ -595,6 +600,10 @@ class Worker:
         deadline = None if timeout is None else time.monotonic() + timeout
         waited = 0.0
         while True:
+            # Already-resolved objects succeed even at timeout=0 — a
+            # zero budget means "don't block", not "don't look".
+            if entry.event.is_set():
+                return True
             slice_s = 30.0
             if deadline is not None:
                 slice_s = min(slice_s, deadline - time.monotonic())
@@ -1530,8 +1539,10 @@ class Worker:
     async def _push_batch(self, key: str, st: "_LeaseState", lease, batch):
         worker_addr = tuple(lease["worker_addr"])
         client = self._client_for(worker_addr)
-        for spec, _fut in batch:
+        for spec, fut in batch:
             self._inflight_push[spec.task_id.binary()] = worker_addr
+            if len(batch) > 1:
+                self._inflight_futs[spec.task_id.binary()] = fut
             self._record_task_event(spec, "RUNNING",
                                     worker_addr=list(worker_addr))
         try:
@@ -1547,6 +1558,7 @@ class Worker:
             except (ConnectionLost, OSError):
                 for spec, fut in batch:
                     self._inflight_push.pop(spec.task_id.binary(), None)
+                    self._inflight_futs.pop(spec.task_id.binary(), None)
                     if not fut.done():
                         fut.set_result(_WorkerCrashed(lease["worker_id"],
                                                       lease["_lessor"]))
@@ -1559,6 +1571,7 @@ class Worker:
                 # — its state is unknowable.
                 for spec, fut in batch:
                     self._inflight_push.pop(spec.task_id.binary(), None)
+                    self._inflight_futs.pop(spec.task_id.binary(), None)
                     if not fut.done():
                         fut.set_exception(e)
                 await self._discard_lease(lease)
@@ -1566,6 +1579,7 @@ class Worker:
                 return
             for (spec, fut), reply in zip(batch, replies):
                 self._inflight_push.pop(spec.task_id.binary(), None)
+                self._inflight_futs.pop(spec.task_id.binary(), None)
                 dur = (reply.pop("dur", None)
                        if isinstance(reply, dict) else None)
                 if dur is not None:
@@ -2244,6 +2258,21 @@ class Worker:
     async def _h_ping(self):
         return "pong"
 
+    async def _h_early_task_result(self, task_id, reply, worker_addr=None):
+        """Owner-side receiver for a batch sibling's eager completion (see
+        _h_push_tasks): resolves the dispatch future early so dependents
+        inside the same push batch can make progress. The sender must
+        still be the worker this attempt is inflight on — a delayed push
+        from a crashed prior attempt must not resolve a retry's future
+        with results stored on the dead worker."""
+        if (worker_addr is None
+                or self._inflight_push.get(task_id) != tuple(worker_addr)):
+            return False
+        fut = self._inflight_futs.get(task_id)
+        if fut is not None and not fut.done():
+            fut.set_result(reply)
+        return True
+
     async def _h_wait_object_status(self, object_id, wait_timeout=10.0):
         """Long-poll variant of get_object_status: blocks server-side until
         the object resolves (or the poll window closes), replacing
@@ -2349,13 +2378,33 @@ class Worker:
 
     async def _h_push_tasks(self, specs, tpu_ids):
         """Batched push: executed sequentially under the caller's single
-        lease (the owner only batches functions it has measured as short)."""
+        lease (the owner only batches functions it has measured as short).
+
+        Every completion except the batch's last is ALSO pushed eagerly to
+        the owner (`early_task_result`): results that only rode the
+        aggregate reply deadlocked any batch where a later task blocks on
+        an earlier sibling's output (the owner can't resolve the sibling
+        until the whole batch replies, and the batch can't finish until
+        the blocked task gets the sibling's value). The aggregate reply
+        remains the reliable path; the eager push is fire-and-forget."""
         loop = asyncio.get_running_loop()
         out = []
-        for spec in specs:
-            out.append(await loop.run_in_executor(
-                self._task_executor, self._execute_task, spec, tpu_ids))
+        for i, spec in enumerate(specs):
+            reply = await loop.run_in_executor(
+                self._task_executor, self._execute_task, spec, tpu_ids)
+            out.append(reply)
+            if i < len(specs) - 1 and tuple(spec.owner_addr) != self.addr:
+                spawn_task(self._notify_early_result(spec, reply))
         return out
+
+    async def _notify_early_result(self, spec, reply):
+        try:
+            owner = self._client_for(tuple(spec.owner_addr))
+            await owner.acall(
+                "early_task_result", task_id=spec.task_id.binary(),
+                reply=reply, worker_addr=list(self.addr), timeout=30)
+        except Exception:
+            pass    # aggregate reply still delivers it
 
     def _load_function(self, fn_hash: str):
         fn = self._fn_cache.get(fn_hash)

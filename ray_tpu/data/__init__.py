@@ -113,6 +113,22 @@ def read_bigquery(project: str, *, table: Optional[str] = None,
                                     transport=transport))
 
 
+def read_mongo(uri: str, database: str, collection: str, *,
+               filter: Optional[dict] = None,
+               pipeline: Optional[List[dict]] = None,
+               projection: Optional[dict] = None,
+               client_factory=None, **_ignored) -> Dataset:
+    """MongoDB ingest (reference: `ray.data.read_mongo`): parallel
+    sort(_id)+skip/limit range scans, or a single-task aggregation
+    `pipeline`. `client_factory(uri)` overrides the pymongo default
+    (tests / custom drivers); it must be picklable."""
+    from ray_tpu.data.mongo import MongoDatasource
+
+    return _read(MongoDatasource(uri, database, collection, filter=filter,
+                                 pipeline=pipeline, projection=projection,
+                                 client_factory=client_factory))
+
+
 def read_images(paths, *, size=None, mode="RGB", **_ignored) -> Dataset:
     """Image directory/files -> rows with a dense "image" tensor column
     (reference: `read_api.py` read_images). `size=(H, W)` resizes for the
@@ -158,7 +174,7 @@ __all__ = [
     "read_json", "read_text", "read_binary_files", "read_images",
     "from_huggingface", "from_torch", "Datasink", "ParquetDatasink",
     "CSVDatasink", "JSONDatasink", "read_datasource", "read_tfrecords",
-    "read_webdataset", "read_sql", "read_bigquery",
+    "read_webdataset", "read_sql", "read_bigquery", "read_mongo",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

@@ -301,6 +301,17 @@ class Dataset:
         return self.write_datasink(
             BigQueryDatasink(project, table, transport=transport))
 
+    def write_mongo(self, uri: str, database: str, collection: str,
+                    client_factory=None) -> List[Any]:
+        """insert_many blocks into the collection (reference:
+        `Dataset.write_mongo`); a custom `client_factory(uri)` must be
+        picklable for parallel task writes."""
+        from ray_tpu.data.mongo import MongoDatasink
+
+        return self.write_datasink(
+            MongoDatasink(uri, database, collection,
+                          client_factory=client_factory))
+
     # ---------------------------------------------------------------- misc
     def __repr__(self) -> str:  # pragma: no cover
         return self.stats()

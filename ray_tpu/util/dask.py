@@ -1,0 +1,164 @@
+"""Dask-on-ray_tpu scheduler shim.
+
+Reference: `python/ray/util/dask/__init__.py` + `scheduler.py:1`
+(`ray_dask_get`: a dask custom scheduler that submits each graph task as
+a Ray task, wiring dependencies as ObjectRefs so dask collections
+execute on the cluster). Redesigned dependency-free: a dask graph is a
+plain dict {key: spec} where spec is `(callable, *args)` with args that
+may be other keys or nested lists/tuples — the scheduler needs no dask
+import, so it works (and is tested) even though dask is not baked into
+this image. With dask installed, use it as
+``dask.compute(x, scheduler=ray_dask_get)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List
+
+import ray_tpu
+
+__all__ = ["ray_dask_get", "enable_dask_on_ray_tpu"]
+
+
+def _is_task(spec: Any) -> bool:
+    """Dask task convention: a tuple whose head is callable."""
+    return isinstance(spec, tuple) and bool(spec) and callable(spec[0])
+
+
+def _identity(x):
+    return x
+
+
+@ray_tpu.remote
+def _exec_task(fn, template, *resolved):
+    """One graph node. Dependency refs ride as TOP-LEVEL task args (the
+    runtime resolves them before the body runs — no blocking worker-side
+    gets, no hold-a-slot-while-waiting deadlock); `template` is the arg
+    structure with _Slot placeholders marking where each value goes."""
+    return fn(*_fill(template, resolved))
+
+
+class _Slot:
+    """Placeholder for the i-th flattened dependency."""
+
+    def __init__(self, i: int):
+        self.i = i
+
+
+def _fill(node, values):
+    if isinstance(node, _Slot):
+        return values[node.i]
+    if isinstance(node, list):
+        return [_fill(x, values) for x in node]
+    if isinstance(node, tuple):
+        return tuple(_fill(x, values) for x in node)
+    return node
+
+
+def _toposort(dsk: Dict[Hashable, Any]) -> List[Hashable]:
+    seen: Dict[Hashable, int] = {}   # 0=visiting, 1=done
+    order: List[Hashable] = []
+
+    def deps(spec, out):
+        try:
+            if spec in dsk:                 # tuple keys before containers
+                out.append(spec)
+                return
+        except TypeError:
+            pass
+        if _is_task(spec):
+            for a in spec[1:]:
+                deps(a, out)
+        elif isinstance(spec, (list, tuple)):
+            for a in spec:
+                deps(a, out)
+
+    # Iterative DFS — dask graphs routinely contain 1000+-deep linear
+    # chains, which would blow Python's recursion limit. A node popped
+    # un-expanded while marked "visiting" must be an ancestor still open
+    # (its finalize sentinel is pushed immediately on first expansion, so
+    # duplicate edges finalize before their extra entries pop) -> cycle.
+    for root in dsk:
+        stack = [(root, False)]
+        while stack:
+            key, expanded = stack.pop()
+            state = seen.get(key)
+            if expanded:
+                seen[key] = 1
+                order.append(key)
+                continue
+            if state == 1:
+                continue
+            if state == 0:
+                raise ValueError(f"cycle in dask graph at {key!r}")
+            seen[key] = 0
+            stack.append((key, True))                 # finalize sentinel
+            out: List[Hashable] = []
+            deps(dsk[key], out)
+            for d in out:
+                if seen.get(d) != 1:
+                    stack.append((d, False))
+    return order
+
+
+def ray_dask_get(dsk: Dict[Hashable, Any], keys, **_ignored):
+    """Execute a dask graph on the cluster; one ray task per graph task,
+    dependencies passed as ObjectRefs (the scheduler never materializes
+    intermediate results driver-side). `keys` may be a key, or an
+    arbitrarily nested list of keys (dask collection convention); the
+    result mirrors its shape."""
+
+    refs: Dict[Hashable, Any] = {}
+
+    def templatize(arg, deps: List[Any]):
+        """Replace keys/inline-tasks (at any nesting depth) by _Slot
+        placeholders, appending the backing ref to `deps`."""
+        # Key check FIRST: dask keys are commonly tuples like ("x", 0),
+        # which must resolve as references, not be walked as containers.
+        try:
+            if arg in refs:
+                deps.append(refs[arg])
+                return _Slot(len(deps) - 1)
+        except TypeError:
+            pass                                      # unhashable spec
+        if _is_task(arg):
+            deps.append(_submit(arg))                 # inline nested task
+            return _Slot(len(deps) - 1)
+        if isinstance(arg, list):
+            return [templatize(a, deps) for a in arg]
+        if isinstance(arg, tuple):
+            return tuple(templatize(a, deps) for a in arg)
+        return arg
+
+    def _submit(spec):
+        deps: List[Any] = []
+        template = [templatize(a, deps) for a in spec[1:]]
+        return _exec_task.remote(spec[0], template, *deps)
+
+    for key in _toposort(dsk):
+        spec = dsk[key]
+        if _is_task(spec):
+            refs[key] = _submit(spec)
+        elif isinstance(spec, (list, tuple)):
+            # Collection-of-keys value: materialize as its own task.
+            deps: List[Any] = []
+            template = templatize(spec, deps)
+            refs[key] = _exec_task.remote(_identity, [template], *deps)
+        elif isinstance(spec, Hashable) and spec in refs:
+            refs[key] = refs[spec]                    # alias key
+        else:
+            refs[key] = ray_tpu.put(spec)             # literal data
+
+    def resolve(k):
+        if isinstance(k, list):
+            return [resolve(x) for x in k]
+        return ray_tpu.get(refs[k], timeout=600)
+
+    return resolve(keys)
+
+
+def enable_dask_on_ray_tpu() -> None:
+    """Install ray_dask_get as dask's default scheduler (requires dask)."""
+    import dask
+
+    dask.config.set(scheduler=ray_dask_get)

@@ -134,3 +134,37 @@ def test_cancel_force_kills_worker(ray_start_regular, tmp_path):
         ray_tpu.get(ref, timeout=60)
 
 
+
+
+def test_batched_push_sibling_dependency_no_deadlock(ray_start_regular):
+    """A short-function batch can put a producer and a consumer (which
+    blocks on the producer's output via a serialized ref) in ONE push
+    frame. Results must flow back eagerly, not only in the aggregate
+    batch reply — otherwise the consumer waits on a sibling whose result
+    the owner can't see yet (hard wedge, found via the dask shim)."""
+    from operator import add, mul
+
+    class Holder:
+        def __init__(self, refs):
+            self.refs = refs
+
+    @ray_tpu.remote
+    def et(fn, *args):
+        out = []
+        for a in args:
+            if isinstance(a, Holder):
+                out.append([ray_tpu.get(r, timeout=60) for r in a.refs])
+            else:
+                out.append(a)
+        return fn(*out)
+
+    # Warm the function-duration EMA so the owner batches it.
+    c = et.remote(add, 1, 2)
+    d = et.remote(mul, c, 10)
+    assert ray_tpu.get(d, timeout=60) == 30
+    del c, d
+    for _ in range(4):
+        x0 = et.remote(add, 1, 2)
+        x1 = et.remote(add, 3, 4)
+        tot = et.remote(sum, Holder([x0, x1]))
+        assert ray_tpu.get(tot, timeout=90) == 10

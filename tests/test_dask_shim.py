@@ -1,0 +1,85 @@
+"""Dask-on-ray_tpu scheduler (reference: `python/ray/util/dask/
+scheduler.py` ray_dask_get). The scheduler consumes plain dask graph
+dicts, so it's tested without dask installed."""
+
+from operator import add, mul
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.dask import ray_dask_get
+
+
+@pytest.fixture(scope="module")
+def dask_cluster():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_simple_graph(dask_cluster):
+    dsk = {
+        "a": 1,
+        "b": 2,
+        "c": (add, "a", "b"),
+        "d": (mul, "c", 10),
+    }
+    assert ray_dask_get(dsk, "d") == 30
+    assert ray_dask_get(dsk, ["c", "d"]) == [3, 30]
+    assert ray_dask_get(dsk, [["a"], ["d"]]) == [[1], [30]]
+
+
+def test_tuple_keys_and_fanin(dask_cluster):
+    # dask.array-style tuple keys with a fan-in over a list of keys.
+    dsk = {
+        ("x", 0): (add, 1, 2),
+        ("x", 1): (add, 3, 4),
+        "total": (sum, [("x", 0), ("x", 1)]),
+    }
+    assert ray_dask_get(dsk, "total") == 10
+
+
+def test_inline_nested_task(dask_cluster):
+    dsk = {"y": (add, (mul, 2, 3), 4)}     # nested task as an argument
+    assert ray_dask_get(dsk, "y") == 10
+
+
+def test_alias_and_literal_keys(dask_cluster):
+    dsk = {"raw": [1, 2, 3], "alias": "raw",
+           "n": (len, "alias")}
+    assert ray_dask_get(dsk, "n") == 3
+
+
+def test_cycle_detection(dask_cluster):
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": (add, "b", 1), "b": (add, "a", 1)}, "a")
+
+
+def test_deep_chain_toposort_is_iterative():
+    """A 5000-link chain must not hit Python's recursion limit."""
+    from ray_tpu.util.dask import _toposort
+
+    n = 5000
+    dsk = {"k0": 7}
+    dsk.update({f"k{i}": (abs, f"k{i - 1}") for i in range(1, n)})
+    order = _toposort(dsk)
+    assert len(order) == n
+    assert order.index("k0") < order.index(f"k{n - 1}")
+
+
+def test_linear_chain_executes(dask_cluster):
+    def inc(x):
+        return x + 1
+
+    n = 200
+    dsk = {"k0": 0}
+    dsk.update({f"k{i}": (inc, f"k{i - 1}") for i in range(1, n)})
+    assert ray_dask_get(dsk, f"k{n - 1}") == n - 1
+
+
+def test_parallel_wide_graph(dask_cluster):
+    dsk = {f"leaf-{i}": (mul, i, i) for i in range(16)}
+    dsk["out"] = (sum, [f"leaf-{i}" for i in range(16)])
+    assert ray_dask_get(dsk, "out") == sum(i * i for i in range(16))

@@ -1,0 +1,188 @@
+"""MongoDB source/sink over an in-memory fake client.
+
+Reference parity: `python/ray/data/datasource/mongo_datasource.py`
+(read_mongo partitioned reads, pipeline mode, write_mongo).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data
+
+# One shared store so parallel tasks in the same process see one "server".
+_STORE = {}
+
+
+class _FakeCursor:
+    def __init__(self, docs):
+        self._docs = list(docs)
+
+    def sort(self, key, direction=1):
+        self._docs.sort(key=lambda d: d.get(key), reverse=direction < 0)
+        return self
+
+    def skip(self, n):
+        self._docs = self._docs[n:]
+        return self
+
+    def limit(self, n):
+        self._docs = self._docs[:n]
+        return self
+
+    def __iter__(self):
+        return iter(self._docs)
+
+
+class _FakeCollection:
+    def __init__(self, docs):
+        self._docs = docs
+
+    @staticmethod
+    def _matches(doc, filt):
+        for k, v in (filt or {}).items():
+            if isinstance(v, dict):
+                val = doc.get(k)
+                if "$gte" in v and not val >= v["$gte"]:
+                    return False
+                if "$lt" in v and not val < v["$lt"]:
+                    return False
+            elif doc.get(k) != v:
+                return False
+        return True
+
+    def count_documents(self, filt):
+        return sum(1 for d in self._docs if self._matches(d, filt))
+
+    def find(self, filt=None, projection=None):
+        docs = [dict(d) for d in self._docs if self._matches(d, filt)]
+        if projection:
+            keep = {k for k, v in projection.items() if v} | {"_id"}
+            docs = [{k: v for k, v in d.items() if k in keep}
+                    for d in docs]
+        return _FakeCursor(docs)
+
+    def aggregate(self, pipeline):
+        docs = [dict(d) for d in self._docs]
+        for stage in pipeline:
+            if "$match" in stage:
+                docs = [d for d in docs
+                        if self._matches(d, stage["$match"])]
+            elif "$limit" in stage:
+                docs = docs[:stage["$limit"]]
+        return docs
+
+    def insert_many(self, rows):
+        for r in rows:
+            doc = dict(r)
+            doc.setdefault("_id", len(self._docs))
+            self._docs.append(doc)
+
+
+class _FakeDB:
+    def __init__(self, colls):
+        self._colls = colls
+
+    def __getitem__(self, name):
+        return _FakeCollection(self._colls.setdefault(name, []))
+
+
+class _FakeClient:
+    def __init__(self, dbs):
+        self._dbs = dbs
+
+    def __getitem__(self, name):
+        return _FakeDB(self._dbs.setdefault(name, {}))
+
+    def close(self):
+        pass
+
+
+def fake_factory(uri):
+    return _FakeClient(_STORE.setdefault(uri, {}))
+
+
+@pytest.fixture
+def seeded():
+    _STORE.clear()
+    docs = _STORE.setdefault("mongodb://test", {}).setdefault(
+        "db", {}).setdefault("events", [])
+    docs.extend({"_id": i, "user": f"u{i % 3}", "value": float(i)}
+                for i in range(20))
+    yield
+    _STORE.clear()
+
+
+# Clusterless on purpose (same rationale as test_data_bigquery): the
+# fake client's store is in-process state shared between test and
+# read/write tasks; with a cluster up, workers would mutate pickled
+# copies. Distributed fan-out is covered by the other datasource suites.
+
+
+def test_read_mongo_parallel_ranges(seeded):
+    ds = data.read_mongo("mongodb://test", "db", "events",
+                         client_factory=fake_factory, parallelism=4)
+    rows = sorted(ds.take_all(), key=lambda r: r["value"])
+    assert len(rows) == 20
+    assert rows[7] == {"user": "u1", "value": 7.0}   # _id dropped
+    # Partitioned: multiple read tasks, together covering all rows once.
+    src = data.read_mongo("mongodb://test", "db", "events",
+                          client_factory=fake_factory, parallelism=4)
+    from ray_tpu.data.mongo import MongoDatasource
+
+    tasks = MongoDatasource("mongodb://test", "db", "events",
+                            client_factory=fake_factory).get_read_tasks(4)
+    assert len(tasks) == 4
+    del src
+
+
+def test_read_mongo_filter_and_projection(seeded):
+    ds = data.read_mongo(
+        "mongodb://test", "db", "events",
+        filter={"value": {"$gte": 15.0}},
+        projection={"value": 1},
+        client_factory=fake_factory)
+    rows = sorted(ds.take_all(), key=lambda r: r["value"])
+    assert [r["value"] for r in rows] == [15.0, 16.0, 17.0, 18.0, 19.0]
+    assert all("user" not in r for r in rows)
+
+
+def test_read_mongo_pipeline_mode(seeded):
+    ds = data.read_mongo(
+        "mongodb://test", "db", "events",
+        pipeline=[{"$match": {"user": "u0"}}, {"$limit": 3}],
+        client_factory=fake_factory)
+    rows = ds.take_all()
+    assert len(rows) == 3
+    assert all(r["user"] == "u0" for r in rows)
+
+
+def test_write_mongo_roundtrip(seeded):
+    src = data.from_items(
+        [{"name": f"n{i}", "score": i * 1.5} for i in range(10)])
+    src.write_mongo("mongodb://test", "db", "scores",
+                    client_factory=fake_factory)
+    back = data.read_mongo("mongodb://test", "db", "scores",
+                           client_factory=fake_factory)
+    rows = sorted(back.take_all(), key=lambda r: r["score"])
+    assert len(rows) == 10
+    assert rows[2]["name"] == "n2" and rows[2]["score"] == 3.0
+
+
+def test_read_mongo_empty_collection():
+    _STORE.clear()
+    ds = data.read_mongo("mongodb://test", "db", "nothing",
+                         client_factory=fake_factory)
+    assert ds.take_all() == []
+    _STORE.clear()
+
+
+def test_default_factory_errors_cleanly_without_pymongo():
+    from ray_tpu.data.mongo import default_client_factory
+
+    try:
+        import pymongo  # noqa: F401
+        pytest.skip("pymongo present in this environment")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="client_factory"):
+        default_client_factory("mongodb://x")
