@@ -8,7 +8,9 @@ the URL is registered in the GCS KV under "dashboard_url" so clients
 and the CLI can find it.
 
 Endpoints:
-  GET /               minimal HTML page (auto-refreshing tables)
+  GET /               SPA client (hash-routed views, no build step;
+                      `dashboard/client.py` — reference
+                      `dashboard/client/src/App.tsx`)
   GET /api/cluster    resource totals/availability
   GET /api/nodes      nodes + per-raylet stats (workers, store, OOM)
   GET /api/actors     actor table
@@ -28,64 +30,7 @@ from aiohttp import web
 
 from ray_tpu._private.rpc import RpcClient
 
-_HTML = """<!DOCTYPE html>
-<html><head><title>ray_tpu dashboard</title>
-<style>
- body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
- h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
- table { border-collapse: collapse; margin-top: .5rem; }
- th, td { border: 1px solid #ccc; padding: .3rem .6rem; font-size: .85rem; }
- th { background: #f4f4f4; text-align: left; }
- code { background: #f4f4f4; padding: 0 .2rem; }
-</style></head>
-<body>
-<h1>ray_tpu dashboard</h1>
-<div id="cluster"></div>
-<h2>Nodes</h2><table id="nodes"></table>
-<h2>Actors</h2><table id="actors"></table>
-<h2>Jobs</h2><table id="jobs"></table>
-<script>
-function table(el, rows) {
-  // Build with createElement/textContent only: actor names, class names
-  // etc. are user-controlled strings; innerHTML would be stored XSS.
-  const t = document.getElementById(el);
-  t.replaceChildren();
-  if (!rows.length) {
-    const td = document.createElement("td");
-    td.textContent = "none";
-    t.appendChild(document.createElement("tr")).appendChild(td);
-    return;
-  }
-  const cols = Object.keys(rows[0]);
-  const hr = document.createElement("tr");
-  for (const c of cols) {
-    const th = document.createElement("th");
-    th.textContent = c;
-    hr.appendChild(th);
-  }
-  t.appendChild(hr);
-  for (const r of rows) {
-    const tr = document.createElement("tr");
-    for (const c of cols) {
-      const td = document.createElement("td");
-      td.textContent = JSON.stringify(r[c]);
-      tr.appendChild(td);
-    }
-    t.appendChild(tr);
-  }
-}
-async function refresh() {
-  const cl = await (await fetch("/api/cluster")).json();
-  document.getElementById("cluster").innerText =
-    "total: " + JSON.stringify(cl.total) +
-    "  available: " + JSON.stringify(cl.available);
-  table("nodes", await (await fetch("/api/nodes")).json());
-  table("actors", await (await fetch("/api/actors")).json());
-  table("jobs", await (await fetch("/api/jobs")).json());
-}
-refresh(); setInterval(refresh, 3000);
-</script></body></html>
-"""
+from ray_tpu.dashboard.client import HTML as _HTML
 
 
 class DashboardHead:

@@ -79,8 +79,8 @@ class MongoDatasource(Datasource):
         # server work stays O(N), and ranges stay stable under
         # concurrent inserts (a skip-based split shifts every range when
         # a low-_id doc lands mid-read). Planning pays P-1 index-only
-        # skip probes once. User filters on `_id` itself are overridden
-        # by the range predicate — filter on another field instead.
+        # skip probes once. User filters on `_id` are conjoined with the
+        # range predicate via $and.
         client = self._factory(self._uri)
         try:
             coll = client[self._db][self._coll]
@@ -109,14 +109,18 @@ class MongoDatasource(Datasource):
 def _range_read_task(factory, uri, db, coll, filt, projection, lo, hi,
                      drop_id):
     """One _id range scan: [lo, hi) with None = unbounded."""
-    query = dict(filt or {})
     id_range = {}
     if lo is not None:
         id_range["$gte"] = lo
     if hi is not None:
         id_range["$lt"] = hi
-    if id_range:
-        query["_id"] = id_range
+    if not id_range:
+        query = dict(filt or {})
+    elif filt and "_id" in filt:
+        # Never clobber a user _id condition — conjoin with the range.
+        query = {"$and": [dict(filt), {"_id": id_range}]}
+    else:
+        query = {**(filt or {}), "_id": id_range}
     client = factory(uri)
     try:
         rows = [_clean(dict(d), drop_id)

@@ -146,6 +146,54 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
 # Building blocks
 # ---------------------------------------------------------------------------
 
+def quantize_weights_int8(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Weight-only int8 quantization for the decode path (serving):
+    per-output-channel symmetric scales on every large matmul weight
+    (attention/FFN projections + lm_head). Decode is HBM-bandwidth-bound
+    — each generated token reads every weight once — so halving weight
+    bytes converts ~directly into decode throughput; dequant happens
+    per-layer inside the scan (int8 travels HBM→VMEM, bf16 never
+    materializes). Norms and the embedding gather stay in bf16.
+
+    Returns a params-shaped pytree where each quantized weight `w`
+    becomes the pair `w_q` (int8) + `w_s` (f32 scales); consumed by
+    decode_step/prefill via `_weight`.
+    """
+    def quant(w):
+        w32 = w.astype(jnp.float32)
+        scale = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+        return q, scale
+
+    out: Dict[str, Any] = {"embed": params["embed"],
+                           "norm_f": params["norm_f"]}
+    layers = dict(params["layers"])
+    qlayers: Dict[str, Any] = {
+        "attn_norm": layers["attn_norm"], "ffn_norm": layers["ffn_norm"]}
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        q, s = quant(layers[name])
+        qlayers[name + "_q"] = q
+        qlayers[name + "_s"] = s
+    if "router" in layers:
+        qlayers["router"] = layers["router"]
+    out["layers"] = qlayers
+    if "lm_head" in params:
+        q, s = quant(params["lm_head"])
+        out["lm_head_q"] = q
+        out["lm_head_s"] = s
+    return out
+
+
+def _weight(p: Dict[str, Any], name: str, dtype) -> jax.Array:
+    """Fetch a matmul weight in compute dtype, dequantizing int8+scale
+    pairs in place (fused by XLA into the consuming dot's operand)."""
+    q = p.get(name + "_q")
+    if q is not None:
+        return (q.astype(dtype) * p[name + "_s"].astype(dtype))
+    return p[name].astype(dtype)
+
+
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     orig_dtype = x.dtype
     x32 = x.astype(jnp.float32)
@@ -453,28 +501,31 @@ def decode_step(params: Dict[str, Any], cache: Dict[str, jax.Array],
         x = carry
         p, k_cache, v_cache = inputs
         h = rms_norm(x, p["attn_norm"], c.norm_eps)
-        q = (h @ p["wq"].astype(c.dtype)).reshape(B, 1, c.n_heads, kd)
-        k = (h @ p["wk"].astype(c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
-        v = (h @ p["wv"].astype(c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
+        q = (h @ _weight(p, "wq", c.dtype)).reshape(B, 1, c.n_heads, kd)
+        k = (h @ _weight(p, "wk", c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
+        v = (h @ _weight(p, "wv", c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
         q, k = rope1(q), rope1(k)
         # Write this token's k/v at its position.
         bidx = jnp.arange(B)
         k_cache = k_cache.at[bidx, positions].set(k[:, 0])
         v_cache = v_cache.at[bidx, positions].set(v[:, 0])
         attn = _decode_attention(q, k_cache, v_cache, positions)
-        x = x + attn.reshape(B, 1, -1) @ p["wo"].astype(c.dtype)
+        x = x + attn.reshape(B, 1, -1) @ _weight(p, "wo", c.dtype)
         h = rms_norm(x, p["ffn_norm"], c.norm_eps)
-        gate = jax.nn.silu(h @ p["w_gate"].astype(c.dtype))
-        up = h @ p["w_up"].astype(c.dtype)
-        x = x + (gate * up) @ p["w_down"].astype(c.dtype)
+        gate = jax.nn.silu(h @ _weight(p, "w_gate", c.dtype))
+        up = h @ _weight(p, "w_up", c.dtype)
+        x = x + (gate * up) @ _weight(p, "w_down", c.dtype)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = lax.scan(
         layer, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["norm_f"], c.norm_eps)
-    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    if c.tie_embeddings:
+        head = params["embed"].T.astype(c.dtype)
+    else:
+        head = _weight(params, "lm_head", c.dtype)
     logits = jax.lax.dot_general(
-        x[:, 0], head.astype(c.dtype), (((1,), (0,)), ((), ())),
+        x[:, 0], head, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
@@ -496,26 +547,29 @@ def prefill(params: Dict[str, Any], tokens: jax.Array,
 
     def scan_body(x, p):
         h = rms_norm(x, p["attn_norm"], c.norm_eps)
-        q = (h @ p["wq"].astype(c.dtype)).reshape(B, P, c.n_heads, kd)
-        k = (h @ p["wk"].astype(c.dtype)).reshape(B, P, c.n_kv_heads, kd)
-        v = (h @ p["wv"].astype(c.dtype)).reshape(B, P, c.n_kv_heads, kd)
+        q = (h @ _weight(p, "wq", c.dtype)).reshape(B, P, c.n_heads, kd)
+        k = (h @ _weight(p, "wk", c.dtype)).reshape(B, P, c.n_kv_heads, kd)
+        v = (h @ _weight(p, "wv", c.dtype)).reshape(B, P, c.n_kv_heads, kd)
         q = apply_rope(q, cos[:P], sin[:P])
         k = apply_rope(k, cos[:P], sin[:P])
         rep = c.n_heads // c.n_kv_heads
         attn = attn_fn(q, _repeat_kv(k, rep), _repeat_kv(v, rep),
                        causal=True)
-        x = x + attn.reshape(B, P, -1) @ p["wo"].astype(c.dtype)
+        x = x + attn.reshape(B, P, -1) @ _weight(p, "wo", c.dtype)
         h = rms_norm(x, p["ffn_norm"], c.norm_eps)
-        gate = jax.nn.silu(h @ p["w_gate"].astype(c.dtype))
-        up = h @ p["w_up"].astype(c.dtype)
-        x = x + (gate * up) @ p["w_down"].astype(c.dtype)
+        gate = jax.nn.silu(h @ _weight(p, "w_gate", c.dtype))
+        up = h @ _weight(p, "w_up", c.dtype)
+        x = x + (gate * up) @ _weight(p, "w_down", c.dtype)
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["norm_f"], c.norm_eps)
-    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    if c.tie_embeddings:
+        head = params["embed"].T.astype(c.dtype)
+    else:
+        head = _weight(params, "lm_head", c.dtype)
     logits = jax.lax.dot_general(
-        x[:, -1], head.astype(c.dtype), (((1,), (0,)), ((), ())),
+        x[:, -1], head, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
     cache = init_kv_cache(c, B, S)

@@ -16,12 +16,15 @@ replies that are bytes pass through raw, strings utf-8, anything else
 JSON. Routing state (long-polled route table, per-app handles) mirrors
 the HTTP proxy.
 
-Scope note (deliberate v1 gap vs the reference): user-DEFINED protobuf
-servicers (`grpc_servicer_functions` compiling arbitrary .proto service
-definitions into the proxy) are not supported — every payload crosses
-as the generic bytes codec above. Clients with their own protos should
-serialize to bytes client-side; the escape hatch is a custom ASGI/gRPC
-deployment. Revisit if a real consumer needs schema'd stubs.
+User-DEFINED protobuf servicers are supported via
+``grpc_servicer_functions`` (reference: `grpc_options.grpc_servicer_
+functions` + `grpc_util.gRPCGenericServer`): each generated
+``add_XServicer_to_server`` function is invoked against a capture shim
+that harvests every RPC's full method path, kind, and request/response
+(de)serializers; the proxy then serves those exact paths, handing the
+DESERIALIZED request message to the deployment method named after the
+rpc and serializing its returned message back — schema'd stubs work
+unchanged against the proxy.
 """
 
 from __future__ import annotations
@@ -56,16 +59,73 @@ def _decode(raw: bytes) -> Any:
         return raw
 
 
+class _DummyServicer:
+    """Stand-in passed to generated add_*_to_server functions during
+    harvesting; generated code only getattr()s rpc method names."""
+
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+
+class _HarvestServer:
+    """Capture shim with the grpc.Server registration surface. Generated
+    code either wraps its handler dict in a generic handler
+    (add_generic_rpc_handlers) or, in newer grpcio, registers the dict
+    directly (add_registered_method_handlers); both are captured."""
+
+    def __init__(self):
+        self.methods: Dict[str, Any] = {}   # "/pkg.Svc/Rpc" -> handler
+
+    def add_generic_rpc_handlers(self, handlers):
+        for h in handlers:
+            per_method = getattr(h, "_method_handlers", None)
+            if per_method:
+                self.methods.update(per_method)
+
+    def add_registered_method_handlers(self, service, handlers):
+        for name, h in handlers.items():
+            self.methods[f"/{service}/{name}"] = h
+
+
+def harvest_servicer_methods(servicer_functions) -> Dict[str, Any]:
+    """Run each add_XServicer_to_server against the capture shim; returns
+    {method_path: grpc RpcMethodHandler} carrying each rpc's kind and
+    request_deserializer / response_serializer."""
+    import importlib
+
+    out: Dict[str, Any] = {}
+    for fn in servicer_functions or []:
+        if isinstance(fn, str):
+            module, _, attr = fn.rpartition(".")
+            fn = getattr(importlib.import_module(module), attr)
+        shim = _HarvestServer()
+        fn(_DummyServicer(), shim)
+        for path, h in shim.methods.items():
+            if getattr(h, "request_streaming", False):
+                # Client-streaming kinds would need request iterator
+                # plumbing across the handle; serving them with a unary
+                # handler mis-frames the call — reject loudly instead.
+                raise ValueError(
+                    f"grpc_servicer_functions: rpc '{path}' is "
+                    "client-streaming (stream_unary/stream_stream), "
+                    "which the proxy does not support; only unary_unary "
+                    "and unary_stream rpcs can be routed")
+            out[path] = h
+    return out
+
+
 @ray_tpu.remote(num_cpus=0.5, max_concurrency=16)
 class GrpcProxyActor(RoutePlane):
     """Per-cluster gRPC ingress actor (HeadOnly placement by default).
     Routing state comes from the shared RoutePlane mixin — one route
     table implementation for both ingress flavors."""
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 servicer_functions=None):
         from ray_tpu.serve._private.controller import get_or_create_controller
 
         self.port = None
+        self._user_methods = harvest_servicer_methods(servicer_functions)
         self._pre_init_route_plane()
         started = threading.Event()
         self._loop_thread = threading.Thread(
@@ -150,6 +210,64 @@ class GrpcProxyActor(RoutePlane):
                     break
                 yield _encode(item)
 
+        def _user_method(path: str, spec):
+            """Route a harvested user-proto rpc: app from metadata,
+            deployment method named after the rpc, request handed over
+            as the DESERIALIZED message."""
+            rpc_name = path.rsplit("/", 1)[-1]
+
+            async def unary(request, context):
+                md = _meta(context)
+                app = md.get("application", "default")
+                handle = await _handle_or_abort(app, context)
+                if md.get("multiplexed_model_id"):
+                    handle = handle.options(
+                        multiplexed_model_id=md["multiplexed_model_id"])
+                caller = getattr(handle, rpc_name)
+                try:
+                    return await asyncio.get_running_loop().run_in_executor(
+                        None,
+                        lambda: caller.remote(request).result(timeout=120))
+                except Exception as e:  # noqa: BLE001
+                    await context.abort(grpc.StatusCode.INTERNAL,
+                                        f"{type(e).__name__}: {e}")
+
+            async def stream(request, context):
+                md = _meta(context)
+                app = md.get("application", "default")
+                handle = await _handle_or_abort(app, context)
+                if md.get("multiplexed_model_id"):
+                    handle = handle.options(
+                        multiplexed_model_id=md["multiplexed_model_id"])
+                caller = getattr(handle.options(stream=True), rpc_name)
+                loop = asyncio.get_running_loop()
+                gen = await loop.run_in_executor(
+                    None, lambda: caller.remote(request))
+                it = iter(gen)
+                _stop = object()
+
+                def _next():
+                    try:
+                        return next(it)
+                    except StopIteration:
+                        return _stop
+
+                while True:
+                    item = await loop.run_in_executor(None, _next)
+                    if item is _stop:
+                        break
+                    yield item
+
+            if getattr(spec, "unary_stream", None) is not None:
+                return grpc.unary_stream_rpc_method_handler(
+                    stream,
+                    request_deserializer=spec.request_deserializer,
+                    response_serializer=spec.response_serializer)
+            return grpc.unary_unary_rpc_method_handler(
+                unary,
+                request_deserializer=spec.request_deserializer,
+                response_serializer=spec.response_serializer)
+
         class Handler(grpc.GenericRpcHandler):
             def service(self, call_details):
                 if call_details.method == PREDICT:
@@ -157,6 +275,9 @@ class GrpcProxyActor(RoutePlane):
                 if call_details.method == PREDICT_STREAM:
                     return grpc.unary_stream_rpc_method_handler(
                         predict_stream)
+                spec = outer._user_methods.get(call_details.method)
+                if spec is not None:
+                    return _user_method(call_details.method, spec)
                 return None
 
         async def _main():
@@ -171,6 +292,12 @@ class GrpcProxyActor(RoutePlane):
         loop.run_until_complete(_main())
 
     # ---- actor api --------------------------------------------------------
+    def get_user_method_paths(self):
+        """The harvested user-proto rpc paths this proxy serves (lets
+        serve.start_grpc detect a live proxy that lacks newly requested
+        servicers and recreate it)."""
+        return sorted(self._user_methods)
+
     def get_port(self) -> int:
         # The server thread publishes the port asynchronously; never hand
         # out None to a client that called right after creation.

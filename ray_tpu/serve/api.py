@@ -174,21 +174,52 @@ def start(http_port: int = 0, proxy_location: str = "HeadOnly",
 _GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 
 
-def start_grpc(grpc_port: int = 0, host: str = "127.0.0.1"):
+def start_grpc(grpc_port: int = 0, host: str = "127.0.0.1",
+               grpc_servicer_functions=None):
     """Start the gRPC ingress (reference: grpc_options on serve.start →
     the gRPC proxy in `_private/proxy.py`). Shares the HTTP proxy's
     routing plane; see `serve/_private/grpc_proxy.py` for the wire
-    contract."""
+    contract. `grpc_servicer_functions`: generated
+    ``add_XServicer_to_server`` callables (or their dotted import paths —
+    pass strings when the proxy actor may run in a process that must
+    re-import them) whose rpc methods the proxy serves with the user's
+    own proto (de)serializers."""
     from ray_tpu.serve._private.controller import get_or_create_controller
-    from ray_tpu.serve._private.grpc_proxy import GrpcProxyActor
+    from ray_tpu.serve._private.grpc_proxy import (
+        GrpcProxyActor, harvest_servicer_methods)
 
     get_or_create_controller()
     try:
-        return ray_tpu.get_actor(_GRPC_PROXY_NAME)
+        proxy = ray_tpu.get_actor(_GRPC_PROXY_NAME)
     except Exception:
-        return GrpcProxyActor.options(
-            name=_GRPC_PROXY_NAME, lifetime="detached",
-        ).remote(grpc_port, host)
+        proxy = None
+    if proxy is not None:
+        if grpc_servicer_functions:
+            # A live proxy without the requested servicers would answer
+            # every user-proto rpc UNIMPLEMENTED with no hint why —
+            # recreate it (the proxy is stateless) instead of silently
+            # dropping the argument.
+            wanted = set(harvest_servicer_methods(grpc_servicer_functions))
+            have = set(ray_tpu.get(
+                proxy.get_user_method_paths.remote(), timeout=30))
+            if not wanted <= have:
+                import time as _time
+
+                ray_tpu.kill(proxy)
+                deadline = _time.monotonic() + 10
+                while _time.monotonic() < deadline:
+                    try:
+                        ray_tpu.get_actor(_GRPC_PROXY_NAME)
+                        _time.sleep(0.1)   # name not released yet
+                    except Exception:
+                        break
+                proxy = None
+        if proxy is not None:
+            return proxy
+    return GrpcProxyActor.options(
+        name=_GRPC_PROXY_NAME, lifetime="detached",
+    ).remote(grpc_port, host,
+             servicer_functions=grpc_servicer_functions)
 
 
 def run(app: Application, *, name: str = "default",

@@ -101,12 +101,14 @@ def _toposort(dsk: Dict[Hashable, Any]) -> List[Hashable]:
     return order
 
 
-def ray_dask_get(dsk: Dict[Hashable, Any], keys, **_ignored):
+def ray_dask_get(dsk: Dict[Hashable, Any], keys, timeout: float = None,
+                 **_ignored):
     """Execute a dask graph on the cluster; one ray task per graph task,
     dependencies passed as ObjectRefs (the scheduler never materializes
     intermediate results driver-side). `keys` may be a key, or an
     arbitrarily nested list of keys (dask collection convention); the
-    result mirrors its shape."""
+    result mirrors its shape. `timeout` bounds the final gather (default
+    unbounded — a scheduler must not fail a long critical path)."""
 
     refs: Dict[Hashable, Any] = {}
 
@@ -152,7 +154,7 @@ def ray_dask_get(dsk: Dict[Hashable, Any], keys, **_ignored):
     def resolve(k):
         if isinstance(k, list):
             return [resolve(x) for x in k]
-        return ray_tpu.get(refs[k], timeout=600)
+        return ray_tpu.get(refs[k], timeout=timeout)
 
     return resolve(keys)
 

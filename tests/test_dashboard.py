@@ -50,6 +50,10 @@ def test_dashboard_endpoints(dash_cluster):
     status, ctype, body = _get(base + "/")
     assert status == 200 and ctype == "text/html"
     assert b"ray_tpu dashboard" in body
+    # SPA client markers: hash routes + the views the reference app has.
+    for marker in (b"#/overview", b"#/nodes", b"#/actors", b"#/jobs",
+                   b"#/submissions", b"#/tasks", b"hashchange"):
+        assert marker in body, marker
 
     status, _, body = _get(base + "/api/cluster")
     cluster = json.loads(body)

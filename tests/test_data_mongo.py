@@ -37,10 +37,13 @@ class _FakeCollection:
     def __init__(self, docs):
         self._docs = docs
 
-    @staticmethod
-    def _matches(doc, filt):
+    @classmethod
+    def _matches(cls, doc, filt):
         for k, v in (filt or {}).items():
-            if isinstance(v, dict):
+            if k == "$and":
+                if not all(cls._matches(doc, sub) for sub in v):
+                    return False
+            elif isinstance(v, dict):
                 val = doc.get(k)
                 if "$gte" in v and not val >= v["$gte"]:
                     return False
@@ -144,6 +147,18 @@ def test_read_mongo_filter_and_projection(seeded):
     rows = sorted(ds.take_all(), key=lambda r: r["value"])
     assert [r["value"] for r in rows] == [15.0, 16.0, 17.0, 18.0, 19.0]
     assert all("user" not in r for r in rows)
+
+
+def test_read_mongo_user_id_filter_survives_partitioning(seeded):
+    """A user _id condition must be CONJOINED with the partition range
+    ($and), never clobbered — edge partitions would otherwise return
+    rows the filter excludes."""
+    ds = data.read_mongo(
+        "mongodb://test", "db", "events",
+        filter={"_id": {"$gte": 10}},
+        client_factory=fake_factory, parallelism=3)
+    rows = sorted(ds.take_all(), key=lambda r: r["value"])
+    assert [r["value"] for r in rows] == [float(i) for i in range(10, 20)]
 
 
 def test_read_mongo_pipeline_mode(seeded):

@@ -415,6 +415,106 @@ def test_grpc_ingress_unary_and_stream(serve_cluster):
         serve.delete("grpcstream")
 
 
+def _calc_req_deser(raw: bytes):
+    import json as _json
+
+    return _json.loads(raw.decode())
+
+
+def _calc_resp_ser(value) -> bytes:
+    import json as _json
+
+    return _json.dumps(value).encode()
+
+
+def add_CalcServicer_to_server(servicer, server):
+    """Shaped exactly like protoc-generated code (grpcio-tools is not in
+    this image): a handler dict wrapped via method_handlers_generic_
+    handler — the registration surface the proxy's harvest shim captures."""
+    import grpc
+
+    rpc_method_handlers = {
+        "Square": grpc.unary_unary_rpc_method_handler(
+            servicer.Square, request_deserializer=_calc_req_deser,
+            response_serializer=_calc_resp_ser),
+        "Counts": grpc.unary_stream_rpc_method_handler(
+            servicer.Counts, request_deserializer=_calc_req_deser,
+            response_serializer=_calc_resp_ser),
+    }
+    generic_handler = grpc.method_handlers_generic_handler(
+        "test.Calc", rpc_method_handlers)
+    server.add_generic_rpc_handlers((generic_handler,))
+
+
+def test_grpc_user_defined_servicer(serve_cluster):
+    """User-proto servicers on the gRPC ingress (reference:
+    grpc_servicer_functions + gRPCGenericServer): the proxy serves the
+    servicer's own method paths with its own (de)serializers; the
+    deployment method named after the rpc receives the DESERIALIZED
+    request."""
+    import grpc
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.api import _GRPC_PROXY_NAME
+
+    @serve.deployment(name="CalcDep")
+    class Calc:
+        def Square(self, req):
+            return {"y": req["x"] ** 2}
+
+    @serve.deployment(stream=True, name="CalcStream")
+    class CalcStream:
+        def Counts(self, req):
+            for i in range(req["n"]):
+                yield {"i": i}
+
+    serve.run(Calc.bind(), name="calcapp")
+    serve.run(CalcStream.bind(), name="calcstream")
+    # The detached proxy may exist from an earlier test WITHOUT the
+    # servicer functions; recreate it with them.
+    try:
+        ray_tpu.kill(ray_tpu.get_actor(_GRPC_PROXY_NAME))
+        time.sleep(0.5)
+    except Exception:
+        pass
+    proxy = serve.start_grpc(
+        grpc_servicer_functions=[add_CalcServicer_to_server])
+    port = ray_tpu.get(proxy.get_port.remote(), timeout=60)
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        square = channel.unary_unary(
+            "/test.Calc/Square",
+            request_serializer=_calc_resp_ser,
+            response_deserializer=_calc_req_deser)
+        out = square({"x": 7}, timeout=60,
+                     metadata=[("application", "calcapp")])
+        assert out == {"y": 49}
+
+        counts = channel.unary_stream(
+            "/test.Calc/Counts",
+            request_serializer=_calc_resp_ser,
+            response_deserializer=_calc_req_deser)
+        got = list(counts({"n": 3}, timeout=60,
+                          metadata=[("application", "calcstream")]))
+        assert got == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+        # Unknown rpc paths still 404 (UNIMPLEMENTED from grpc core).
+        bogus = channel.unary_unary("/test.Calc/Nope",
+                                    request_serializer=_calc_resp_ser,
+                                    response_deserializer=_calc_req_deser)
+        with pytest.raises(grpc.RpcError):
+            bogus({}, timeout=10, metadata=[("application", "calcapp")])
+    finally:
+        channel.close()
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(_GRPC_PROXY_NAME))
+        except Exception:
+            pass
+        serve.delete("calcapp")
+        serve.delete("calcstream")
+
+
 def test_asgi_query_decoding_and_duplicate_headers():
     """Query values reach handlers percent-decoded ('+' included) and
     duplicate headers survive both directions (ADVICE r4 low)."""
