@@ -1180,6 +1180,10 @@ def main():
     import signal
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # SIGUSR2 dumps parked-coroutine stacks + submit-queue state for
+    # every event loop — faulthandler can't see awaits (rpc.py).
+    from ray_tpu._private.rpc import install_coroutine_dump_signal
+    install_coroutine_dump_signal()
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")

@@ -47,6 +47,78 @@ _MAX_FRAME = 1 << 33
 # until done.
 _BACKGROUND_TASKS: set = set()
 
+# Every live EventLoopThread, for wedge diagnostics (dump_event_loops).
+import weakref as _weakref  # noqa: E402
+
+_ALL_LOOPS: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def dump_event_loops(file=None) -> None:
+    """Wedge diagnostic: for every EventLoopThread in this process, print
+    its submit-queue state and the *coroutine* stack of every parked
+    asyncio task. faulthandler shows only OS-thread stacks — an idle
+    `select()` loop with twenty tasks awaiting lost replies looks
+    healthy in a thread dump; this shows where each coroutine actually
+    awaits. Best-effort and lock-free: meant to run from a signal
+    handler in a process that may be wedged."""
+    import io as _io
+    import sys
+
+    out = _io.StringIO()
+    for elt in list(_ALL_LOOPS):
+        try:
+            thread = getattr(elt, "_thread", None)
+            out.write(
+                f"--- EventLoopThread {getattr(thread, 'name', '?')} "
+                f"alive={bool(thread and thread.is_alive())} "
+                f"pending={len(elt._pending)} "
+                f"drain_scheduled={elt._drain_scheduled} "
+                f"inflight={len(elt._inflight)} "
+                f"stopped={elt._stopped}\n")
+            try:
+                tasks = [t for t in asyncio.all_tasks(elt.loop)]
+            except Exception as e:
+                out.write(f"    (all_tasks failed: {e!r})\n")
+                continue
+            for t in tasks:
+                try:
+                    coro = t.get_coro()
+                    name = getattr(coro, "__qualname__", repr(coro))
+                    out.write(f"  task {name} done={t.done()}\n")
+                    for frame in t.get_stack(limit=16):
+                        code = frame.f_code
+                        out.write(
+                            f"    {code.co_filename}:{frame.f_lineno} "
+                            f"in {code.co_name}\n")
+                except Exception as e:
+                    out.write(f"  (task dump failed: {e!r})\n")
+        except Exception as e:
+            out.write(f"--- (loop dump failed: {e!r})\n")
+    (file or sys.stderr).write(out.getvalue())
+    try:
+        (file or sys.stderr).flush()
+    except Exception:
+        pass
+
+
+def install_coroutine_dump_signal() -> None:
+    """Register SIGUSR2 → dump_event_loops on stderr (daemon logs).
+    Python-level handler (runs between bytecodes on the main thread):
+    fine for the parked-coroutine wedge class where the loops are idle
+    and the main thread sits in an interruptible wait."""
+    import signal
+
+    def _h(signum, frame):
+        try:
+            dump_event_loops()
+        except Exception:
+            pass
+
+    try:
+        signal.signal(signal.SIGUSR2, _h)
+    except (ValueError, OSError):
+        pass  # non-main thread or unsupported platform
+
 
 def spawn_task(coro: Awaitable, loop=None) -> "asyncio.Task":
     """ensure_future + a strong reference held until the task finishes."""
@@ -177,6 +249,7 @@ class EventLoopThread:
     run_coroutine_threadsafe."""
 
     def __init__(self, name: str = "ray_tpu-io"):
+        _ALL_LOOPS.add(self)
         self.loop = asyncio.new_event_loop()
         self._pending: deque = deque()
         self._pending_lock = threading.Lock()

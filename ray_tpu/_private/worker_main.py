@@ -39,6 +39,10 @@ def main():
     import signal
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # SIGUSR2 dumps parked-coroutine stacks + submit-queue state for
+    # every event loop — faulthandler can't see awaits (rpc.py).
+    from ray_tpu._private.rpc import install_coroutine_dump_signal
+    install_coroutine_dump_signal()
 
     # runtime_env working_dir: the raylet exports it when this worker's
     # pool was spawned for an env that sets one (env_vars arrive directly

@@ -9,11 +9,13 @@ gang's global mesh (gradients psum over ICI), not DDP-wrapped modules.
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.rainbow import Rainbow, RainbowConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.multi_rl_module import (MultiRLModule,
                                                 MultiRLModuleSpec)
@@ -22,10 +24,12 @@ from ray_tpu.rllib.env.multi_agent_env import (MultiAgentCartPole,
                                                MultiAgentEnv,
                                                RockPaperScissors)
 
-__all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "DQN", "DQNConfig",
+__all__ = ["APPO", "APPOConfig", "ARS", "ARSConfig", "BC", "BCConfig",
+           "DQN", "DQNConfig", "ES", "ESConfig",
            "IMPALA", "IMPALAConfig", "MARWIL", "MARWILConfig",
            "PPO", "PPOConfig", "Rainbow", "RainbowConfig",
            "SAC", "SACConfig",
+           "TD3", "TD3Config", "DDPG", "DDPGConfig",
            "LearnerGroup", "MLPModule", "RLModuleSpec",
            "MultiRLModule", "MultiRLModuleSpec", "MultiAgentEnv",
            "MultiAgentCartPole", "RockPaperScissors"]

@@ -247,12 +247,18 @@ class Algorithm:
             return RLModuleSpec(observation_space=obs_space,
                                 action_space=act_space,
                                 hidden=self.config.module_hidden,
-                                module_class=self.rl_module_class)
+                                module_class=self.rl_module_class,
+                                module_kwargs=self._module_kwargs())
         from ray_tpu.rllib.models.catalog import Catalog
 
         model_config = {"fcnet_hiddens": self.config.module_hidden,
                         **(self.config.model_config or {})}
         return Catalog.get_module_spec(obs_space, act_space, model_config)
+
+    def _module_kwargs(self) -> Dict[str, Any]:
+        """Extra ctor kwargs for a fixed `rl_module_class` (TD3's twin_q,
+        exploration sigma, ...); merged into the RLModuleSpec."""
+        return {}
 
     def _learner_config(self) -> Dict[str, Any]:
         return {"lr": self.config.lr, "grad_clip": self.config.grad_clip,

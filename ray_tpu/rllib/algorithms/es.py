@@ -1,0 +1,158 @@
+"""ES / ARS — distributed gradient-free policy optimization.
+
+Reference: `rllib/algorithms/es/es.py` (OpenAI-ES: antithetic Gaussian
+perturbations, centered-rank fitness shaping, Adam on the master) and
+`rllib/algorithms/ars/ars.py` (ARS: top-k perturbation selection,
+reward-std normalization). Both bypass the gradient Learner entirely —
+the "update" is a fitness-weighted combination of noise vectors.
+
+Architecture here vs the reference: the reference ships a shared noise
+table + offsets to dedicated ES workers because its policies are large.
+Our runners are the ordinary `EnvRunner` fleet (the same actors every
+other algorithm uses): per perturbation the driver enqueues an ordered
+`set_weights(theta ± sigma*eps)` then `sample_episodes(...)` pair on a
+runner — actor-call ordering guarantees the rollout sees its
+perturbation, and N pairs pipeline across the fleet in parallel. The
+combine step `w @ eps / (P*sigma)` is one jitted matmul (MXU-shaped:
+P x dim), with Adam on the flat parameter vector.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+
+
+class _WeightHolderLearner(Learner):
+    """ES never takes gradients; the learner group only holds/ships the
+    canonical params (and keeps checkpoints/state uniform with every
+    other algorithm)."""
+
+    def compute_loss(self, params, batch, rng):
+        import jax.numpy as jnp
+
+        return jnp.asarray(0.0, jnp.float32), {}
+
+
+def _centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Fitness shaping: map returns to ranks in [-0.5, 0.5] (reference
+    `es/utils.py` compute_centered_ranks) — scale-free, outlier-proof."""
+    ranks = np.empty(x.size, np.float32)
+    ranks[x.ravel().argsort()] = np.arange(x.size, dtype=np.float32)
+    return (ranks / max(x.size - 1, 1) - 0.5).reshape(x.shape)
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = "CartPole-v1"
+        self.lr = 0.02
+        self.noise_stdev = 0.05
+        self.num_perturbations = 16      # antithetic pairs per iteration
+        self.episodes_per_perturbation = 1
+        self.weight_decay = 0.005
+        # ARS-style top-k selection: keep the best fraction of pairs
+        # (by max(r+, r-)); 1.0 = plain ES over all pairs.
+        self.top_fraction = 1.0
+        self.fitness_shaping = "centered_rank"   # or "std" (ARS)
+
+    algo_class = property(lambda self: ES)
+
+
+class ARSConfig(ESConfig):
+    """Augmented Random Search (reference `ars/ars.py`): ES with top-k
+    direction selection and reward-std scaling instead of rank shaping."""
+
+    def __init__(self):
+        super().__init__()
+        self.top_fraction = 0.5
+        self.fitness_shaping = "std"
+
+    algo_class = property(lambda self: ARS)
+
+
+class ES(Algorithm):
+    learner_class = _WeightHolderLearner
+
+    def __init__(self, config: ESConfig):
+        super().__init__(config)
+        import jax
+        import optax
+        from jax.flatten_util import ravel_pytree
+
+        self._np_rng = np.random.RandomState(config.seed)
+        theta = self.learner_group.get_weights()
+        flat, self._unravel = ravel_pytree(theta)
+        self._flat = np.asarray(flat, np.float32)
+        self._opt = optax.adam(config.lr)
+        self._opt_state = self._opt.init(flat)
+
+        def _combine(flat, opt_state, w, eps, sigma, denom):
+            # g ~ E[f(theta + sigma eps) eps] / sigma; Adam ascends it.
+            g = (w @ eps) / (denom * sigma)
+            g = g - config.weight_decay * flat
+            updates, new_opt = self._opt.update(-g, opt_state, flat)
+            return optax.apply_updates(flat, updates), new_opt
+
+        self._combine = jax.jit(_combine)
+        self._total_episodes = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        P = cfg.num_perturbations
+        sigma = cfg.noise_stdev
+        dim = self._flat.size
+        eps = self._np_rng.randn(P, dim).astype(np.float32)
+
+        # Enqueue ordered (set_weights -> sample_episodes) pairs, striped
+        # over the runner fleet; antithetic twins share the noise row.
+        refs: List[Any] = []
+        n_runners = len(self.env_runners)
+        for i in range(P):
+            for s, signed in ((0, 1.0), (1, -1.0)):
+                runner = self.env_runners[(2 * i + s) % n_runners]
+                w = self._unravel(self._flat + signed * sigma * eps[i])
+                runner.set_weights.remote(w)
+                refs.append(runner.sample_episodes.remote(
+                    cfg.episodes_per_perturbation, explore=False))
+        results = ray_tpu.get(refs, timeout=600)
+        rets = np.asarray([float(np.mean(r["episode_returns"]))
+                           for r in results], np.float32).reshape(P, 2)
+        self._total_episodes += sum(
+            len(r["episode_returns"]) for r in results)
+
+        keep = np.arange(P)
+        if cfg.top_fraction < 1.0:
+            k = max(1, int(round(P * cfg.top_fraction)))
+            keep = np.argsort(-rets.max(axis=1))[:k]
+        sel = rets[keep]
+        if cfg.fitness_shaping == "centered_rank":
+            shaped = _centered_ranks(sel)
+        else:                                    # ARS: std normalization
+            shaped = sel / max(float(sel.std()), 1e-8)
+        w = shaped[:, 0] - shaped[:, 1]          # antithetic difference
+
+        new_flat, self._opt_state = self._combine(
+            self._flat, self._opt_state, w, eps[keep], sigma,
+            float(len(keep)))
+        self._flat = np.asarray(new_flat)
+
+        theta = self._unravel(self._flat)
+        self.learner_group.set_weights(theta)
+        self._sync_weights(theta)
+        self._recent_returns.extend(rets.reshape(-1).tolist())
+        return {"perturbed_return_mean": float(rets.mean()),
+                "perturbed_return_max": float(rets.max()),
+                "num_perturbations": int(P),
+                "directions_kept": int(len(keep)),
+                "update_norm": float(np.linalg.norm(self._flat)),
+                "total_episodes": self._total_episodes}
+
+
+class ARS(ES):
+    pass

@@ -1,0 +1,254 @@
+"""TD3 and DDPG — deterministic-policy continuous control.
+
+Reference: `rllib/algorithms/td3/td3.py` (twin critics, delayed policy
+updates, target policy smoothing over DDPG) and
+`rllib/algorithms/ddpg/ddpg.py`. TPU-first shape mirrors our SAC: actor,
+critics, their target copies, and the update-step counter live in ONE
+state pytree, and the whole update — critic + (masked) actor losses, one
+optimizer step, delayed polyak averaging — is a single jitted, donated
+call. The policy delay is a traced mask on the actor loss + target
+polyak (step % d), not a host-side branch, so 1 learner or 64 run the
+same XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env.spaces import Box
+
+
+class TD3Module(RLModule):
+    """Deterministic tanh actor + (optionally twin) Q critics."""
+
+    def __init__(self, observation_space: Box, action_space: Box,
+                 hidden: Sequence[int] = (64, 64), twin_q: bool = True,
+                 exploration_sigma: float = 0.1):
+        import flax.linen as nn
+
+        obs_dim = int(np.prod(observation_space.shape))
+        act_dim = int(np.prod(action_space.shape))
+        self._act_scale = np.asarray(action_space.high,
+                                     np.float32).reshape(-1)
+        self.twin_q = bool(twin_q)
+        self.exploration_sigma = float(exploration_sigma)
+
+        class _Actor(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = x
+                for width in hidden:
+                    h = nn.relu(nn.Dense(width)(h))
+                return nn.Dense(act_dim)(h)
+
+        class _Critic(nn.Module):
+            @nn.compact
+            def __call__(self, obs, act):
+                h = jnp.concatenate([obs, act], axis=-1)
+                for width in hidden:
+                    h = nn.relu(nn.Dense(width)(h))
+                return nn.Dense(1)(h)[..., 0]
+
+        self._actor, self._critic = _Actor(), _Critic()
+        self._obs_dim, self._act_dim = obs_dim, act_dim
+
+    def init(self, rng: jax.Array) -> Any:
+        k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+        obs = jnp.zeros((1, self._obs_dim), jnp.float32)
+        act = jnp.zeros((1, self._act_dim), jnp.float32)
+        params = {"actor": self._actor.init(k_pi, obs),
+                  "q1": self._critic.init(k_q1, obs, act)}
+        if self.twin_q:
+            params["q2"] = self._critic.init(k_q2, obs, act)
+        return params
+
+    # -------------------------------------------------------------- policy
+    def policy_action(self, actor_params, obs):
+        """Deterministic bounded action: tanh(mu(s)) * scale."""
+        return jnp.tanh(self._actor.apply(actor_params, obs)) * self._act_scale
+
+    def forward_inference(self, params, obs):
+        return {"actions": self.policy_action(params["actor"], obs)}
+
+    def q_values(self, params, obs, act):
+        q1 = self._critic.apply(params["q1"], obs, act)
+        if not self.twin_q:
+            return q1, q1
+        return q1, self._critic.apply(params["q2"], obs, act)
+
+    # ------------------------------------------------- env-runner protocol
+    def forward_exploration(self, params, obs, rng):
+        """Gaussian action-space noise around the deterministic policy
+        (TD3/DDPG explore in action space, not parameter space)."""
+        act = self.policy_action(params["actor"], obs)
+        noise = self.exploration_sigma * self._act_scale * jax.random.normal(
+            rng, act.shape)
+        act = jnp.clip(act + noise, -self._act_scale, self._act_scale)
+        return {"actions": act,
+                "logp": jnp.zeros(obs.shape[0], jnp.float32),
+                "vf": jnp.zeros(obs.shape[0], jnp.float32)}
+
+    def forward_train(self, params, obs):
+        return {"actions": self.policy_action(params["actor"], obs)}
+
+
+class TD3Learner(Learner):
+    """One jitted update = critic step + delay-masked actor step +
+    delay-masked polyak; the delay counter is learner state."""
+
+    def init_extra_state(self, params) -> Dict[str, Any]:
+        return {"target": jax.tree.map(jnp.copy, params),
+                "step": jnp.asarray(0, jnp.int32)}
+
+    def _actor_mask(self, state):
+        delay = int(self.config.get("policy_delay", 2))
+        if delay <= 1:
+            return jnp.asarray(1.0, jnp.float32)
+        return (state["step"] % delay == 0).astype(jnp.float32)
+
+    def compute_loss_from_state(self, state, batch, rng):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        target_noise = cfg.get("target_noise", 0.2)
+        noise_clip = cfg.get("target_noise_clip", 0.5)
+        params, target = state["params"], state["target"]
+        m: TD3Module = self.module
+        scale = jnp.asarray(m._act_scale)
+
+        # --- critic loss: y = r + gamma min Q_targ(s', pi_targ(s') + eps)
+        a_next = m.policy_action(target["actor"], batch["next_obs"])
+        if target_noise > 0:
+            eps = jnp.clip(
+                target_noise * jax.random.normal(rng, a_next.shape),
+                -noise_clip, noise_clip) * scale
+            a_next = jnp.clip(a_next + eps, -scale, scale)
+        tq1, tq2 = m.q_values(target, batch["next_obs"], a_next)
+        y = jax.lax.stop_gradient(
+            batch["rewards"] + gamma
+            * (1.0 - batch["dones"].astype(jnp.float32))
+            * jnp.minimum(tq1, tq2))
+        q1, q2 = m.q_values(params, batch["obs"], batch["actions"])
+        critic_loss = ((q1 - y) ** 2).mean()
+        if m.twin_q:
+            critic_loss = critic_loss + ((q2 - y) ** 2).mean()
+
+        # --- actor loss: -Q1(s, pi(s)) with critics frozen, masked by the
+        # policy delay (zero loss => zero actor grads on skipped steps).
+        frozen = jax.lax.stop_gradient(
+            {k: v for k, v in params.items() if k != "actor"})
+        a_pi = m.policy_action(params["actor"], batch["obs"])
+        actor_obj = -self.module._critic.apply(
+            frozen["q1"], batch["obs"], a_pi).mean()
+        mask = self._actor_mask(state)
+        loss = critic_loss + mask * actor_obj
+        return loss, {"critic_loss": critic_loss,
+                      "actor_loss": actor_obj,
+                      "q1_mean": q1.mean(),
+                      "target_q_mean": y.mean()}
+
+    def post_update_state(self, state):
+        tau = self.config.get("tau", 0.005)
+        mask = self._actor_mask(state)
+        polyak = lambda t, o: t + mask * tau * (o - t)  # noqa: E731
+        new_target = jax.tree.map(polyak, state["target"], state["params"])
+        return {**state, "target": new_target, "step": state["step"] + 1}
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.lr = 1e-3
+        self.grad_clip = 10.0
+        self.tau = 0.005
+        self.twin_q = True
+        self.policy_delay = 2
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+        self.exploration_sigma = 0.1
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1000
+        self.train_batch_size = 256
+        self.rollout_fragment_length = 32
+        self.num_updates_per_iteration = 64
+
+    algo_class = property(lambda self: TD3)
+
+
+class DDPGConfig(TD3Config):
+    """DDPG = TD3 minus the three addenda: single critic, no policy
+    delay, no target smoothing (reference `ddpg/ddpg.py`)."""
+
+    def __init__(self):
+        super().__init__()
+        self.twin_q = False
+        self.policy_delay = 1
+        self.target_noise = 0.0
+
+    algo_class = property(lambda self: DDPG)
+
+
+class TD3(Algorithm):
+    learner_class = TD3Learner
+    rl_module_class = TD3Module
+
+    def __init__(self, config: TD3Config):
+        super().__init__(config)
+        act_space = self.module_spec.action_space
+        self._buffer = ReplayBuffer(
+            config.buffer_capacity,
+            self.module_spec.observation_space.shape,
+            action_shape=act_space.shape, action_dtype=np.float32)
+        self._rng = np.random.RandomState(config.seed)
+        self._env_steps = 0
+        self._updates = 0
+
+    def _module_kwargs(self) -> Dict[str, Any]:
+        out = super()._module_kwargs()
+        out["twin_q"] = self.config.twin_q
+        out["exploration_sigma"] = self.config.exploration_sigma
+        return out
+
+    def _learner_config(self) -> Dict[str, Any]:
+        out = super()._learner_config()
+        cfg = self.config
+        out.update(gamma=cfg.gamma, tau=cfg.tau,
+                   policy_delay=cfg.policy_delay,
+                   target_noise=cfg.target_noise,
+                   target_noise_clip=cfg.target_noise_clip)
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rollouts = self.sample_batch(cfg.rollout_fragment_length)
+        for ro in rollouts:
+            T, N = ro["actions"].shape[:2]
+            self._env_steps += T * N
+            flat = lambda a: a.reshape(T * N, *a.shape[2:])  # noqa: E731
+            self._buffer.add_batch(flat(ro["obs"]), flat(ro["actions"]),
+                                   flat(ro["rewards"]),
+                                   flat(ro["next_obs"]),
+                                   flat(ro["terminateds"]))
+
+        metrics: Dict[str, Any] = {"env_steps": self._env_steps,
+                                   "buffer_size": len(self._buffer)}
+        if len(self._buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                batch = self._buffer.sample(cfg.train_batch_size, self._rng)
+                metrics.update(self.learner_group.update(batch))
+                self._updates += 1
+        self._sync_weights()
+        metrics["num_gradient_updates"] = self._updates
+        return metrics
+
+
+class DDPG(TD3):
+    pass

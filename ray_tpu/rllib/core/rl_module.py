@@ -51,10 +51,12 @@ class RLModuleSpec:
     action_space: Discrete
     hidden: Sequence[int] = (64, 64)
     module_class: Optional[type] = None
+    module_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def build(self) -> "RLModule":
         cls = self.module_class or MLPModule
-        return cls(self.observation_space, self.action_space, self.hidden)
+        return cls(self.observation_space, self.action_space, self.hidden,
+                   **self.module_kwargs)
 
 
 class MLPModule(RLModule):

@@ -14,12 +14,14 @@ from ray_tpu.tune.search import (
     choice, grid_search, loguniform, randint, sample_from, uniform,
 )
 from ray_tpu.tune.suggest import (
-    ConcurrencyLimiter, GPEISearcher, OptunaSearch, TPESearcher,
+    BOHBSearcher, ConcurrencyLimiter, GPEISearcher, OptunaSearch,
+    TPESearcher,
 )
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
-    "AsyncHyperBandScheduler", "ConcurrencyLimiter", "FIFOScheduler",
+    "AsyncHyperBandScheduler", "BOHBSearcher", "ConcurrencyLimiter",
+    "FIFOScheduler",
     "GPEISearcher", "HyperBandScheduler", "MedianStoppingRule",
     "OptunaSearch", "PopulationBasedTraining", "ResultGrid", "TPESearcher",
     "TuneConfig", "Tuner", "choice", "get_checkpoint", "get_session",

@@ -97,11 +97,25 @@ def _install_alarm(phase, item):
                         if (b"ray_tpu._private" in cmd
                                 or b"ray_tpu/_private" in cmd):
                             os.kill(int(pid), signal.SIGUSR1)
+                            # Parked-coroutine stacks too — thread dumps
+                            # can't see awaits (rpc.dump_event_loops).
+                            os.kill(int(pid), signal.SIGUSR2)
                             pids.append(int(pid))
                 except Exception:
                     pass
                 f.write(f"signalled daemons (stacks in session logs): "
                         f"{pids}\n")
+                # Driver-side loop state: submit-queue depth, drain flag,
+                # and every parked coroutine's await stack — the piece
+                # past wedge dumps were missing (all OS threads idle in
+                # select() while a dispatcher coroutine awaited a lost
+                # lease/reply forever).
+                try:
+                    from ray_tpu._private.rpc import dump_event_loops
+
+                    dump_event_loops(file=f)
+                except Exception as e:
+                    f.write(f"loop dump failed: {e!r}\n")
                 # Session dirs are DELETED at module teardown, taking the
                 # dumps with them — preserve the newest sessions' logs
                 # now (1.5s for the dumps to flush; the 5s re-fire

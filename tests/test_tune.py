@@ -434,3 +434,65 @@ def test_gp_ei_beats_random_at_equal_budget(ray_start_regular, tmp_path):
     rnd = best_loss(None, "rnd", 3)
     assert gp < 0.01, f"GP-EI did not converge: {gp}"
     assert gp <= rnd, (gp, rnd)
+
+
+def test_bohb_budget_pool_selection_units():
+    """BOHB models on the largest budget with enough points, falling
+    back to plain TPE pooling before any rung qualifies."""
+    from ray_tpu.tune import search as sp
+    from ray_tpu.tune.suggest import BOHBSearcher
+
+    s = BOHBSearcher(n_startup=4, seed=0, min_points_per_budget=3)
+    s.set_search_properties("score", "max", {"x": sp.uniform(0.0, 10.0)})
+    # Low-budget observations say "x near 1 wins"; high-budget say
+    # "x near 9 wins" — BOHB must trust the high-fidelity rung.
+    tid = 0
+    for x in (1.0, 1.2, 0.8, 1.1):
+        cfg = s.suggest(f"t{tid}")
+        cfg["x"] = x
+        s._suggested[f"t{tid}"] = cfg
+        s.on_trial_complete(
+            f"t{tid}", result={"score": -abs(x - 1.0),
+                               "training_iteration": 1})
+        tid += 1
+    assert s._model_pool() is None or 1 in s._by_budget
+    for x in (9.0, 8.8, 1.0, 2.0, 3.0, 4.0, 5.0, 6.5):
+        cfg = s.suggest(f"t{tid}")
+        cfg["x"] = x
+        s._suggested[f"t{tid}"] = cfg
+        s.on_trial_complete(
+            f"t{tid}", result={"score": -(x - 9.0) ** 2,
+                               "training_iteration": 9})
+        tid += 1
+    pool = s._model_pool()
+    assert pool is s._by_budget[9]           # highest qualifying budget
+    picks = [s.suggest(f"p{i}")["x"] for i in range(8)]
+    assert sum(1 for x in picks if x > 5.0) >= 5, picks
+
+
+def test_bohb_with_hyperband_e2e(ray_start_regular, tmp_path):
+    """BOHB proper: HyperBand rungs + budget-aware TPE find the optimum
+    and concentrate late suggestions near it."""
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+    from ray_tpu.tune.suggest import BOHBSearcher
+
+    def objective(config):
+        for i in range(9):
+            tune.report({"score": -(config["x"] - 7.0) ** 2
+                         + 0.1 * (i + 1)})
+
+    searcher = BOHBSearcher(n_startup=5, seed=0)
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.uniform(0.0, 10.0)},
+        tune_config=TuneConfig(
+            metric="score", mode="max", num_samples=16,
+            max_concurrent_trials=3, search_alg=searcher,
+            scheduler=HyperBandScheduler(max_t=9, reduction_factor=3)),
+        run_config=RunConfig(name="bohb", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert abs(best.config["x"] - 7.0) < 2.0, best.config
+    # Multi-fidelity pools actually formed at distinct rung budgets.
+    assert len(searcher._by_budget) >= 2, sorted(searcher._by_budget)
