@@ -75,10 +75,24 @@ def dump_event_loops(file=None) -> None:
                 f"drain_scheduled={elt._drain_scheduled} "
                 f"inflight={len(elt._inflight)} "
                 f"stopped={elt._stopped}\n")
-            try:
-                tasks = [t for t in asyncio.all_tasks(elt.loop)]
-            except Exception as e:
-                out.write(f"    (all_tasks failed: {e!r})\n")
+            # all_tasks iterates a WeakSet the live loop mutates
+            # concurrently — "Set changed size during iteration"
+            # RuntimeErrors are transient, so retry a few times before
+            # giving up on this loop's task list.
+            tasks = None
+            err = None
+            for _ in range(5):
+                try:
+                    tasks = [t for t in asyncio.all_tasks(elt.loop)]
+                    break
+                except RuntimeError as e:
+                    err = e
+                    continue
+                except Exception as e:
+                    err = e
+                    break
+            if tasks is None:
+                out.write(f"    (all_tasks failed: {err!r})\n")
                 continue
             for t in tasks:
                 try:

@@ -475,9 +475,18 @@ def _decode_attention(q, k_cache, v_cache, pos):
 
 def decode_step(params: Dict[str, Any], cache: Dict[str, jax.Array],
                 tokens: jax.Array, positions: jax.Array,
-                config: LlamaConfig):
+                config: LlamaConfig,
+                active: Optional[jax.Array] = None):
     """One incremental token: tokens [B] int32 at `positions` [B].
-    Returns (logits [B, V], updated cache). Jittable; scan over layers."""
+    Returns (logits [B, V], updated cache). Jittable; scan over layers.
+
+    ``active`` [B] bool (optional) slot-masks the KV write: inactive
+    rows keep their cache untouched (the write index is pushed out of
+    bounds, where scatter drops it) so a continuous-batching engine can
+    run dead slots through the same fixed-shape program without
+    corrupting rows a later prefill has already claimed. Logits for
+    inactive rows are garbage by construction — callers ignore them.
+    """
     if config.n_experts:
         raise NotImplementedError(
             "KV-cache decode for MoE configs is not implemented yet; "
@@ -505,10 +514,15 @@ def decode_step(params: Dict[str, Any], cache: Dict[str, jax.Array],
         k = (h @ _weight(p, "wk", c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
         v = (h @ _weight(p, "wv", c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
         q, k = rope1(q), rope1(k)
-        # Write this token's k/v at its position.
+        # Write this token's k/v at its position. Inactive slots write at
+        # S (out of bounds -> dropped), leaving their rows untouched.
         bidx = jnp.arange(B)
-        k_cache = k_cache.at[bidx, positions].set(k[:, 0])
-        v_cache = v_cache.at[bidx, positions].set(v[:, 0])
+        if active is None:
+            write_pos = positions
+        else:
+            write_pos = jnp.where(active, positions, k_cache.shape[1])
+        k_cache = k_cache.at[bidx, write_pos].set(k[:, 0])
+        v_cache = v_cache.at[bidx, write_pos].set(v[:, 0])
         attn = _decode_attention(q, k_cache, v_cache, positions)
         x = x + attn.reshape(B, 1, -1) @ _weight(p, "wo", c.dtype)
         h = rms_norm(x, p["ffn_norm"], c.norm_eps)
@@ -520,26 +534,31 @@ def decode_step(params: Dict[str, Any], cache: Dict[str, jax.Array],
     x, (new_k, new_v) = lax.scan(
         layer, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["norm_f"], c.norm_eps)
-    if c.tie_embeddings:
-        head = params["embed"].T.astype(c.dtype)
-    else:
-        head = _weight(params, "lm_head", c.dtype)
+    head = lm_head_weight(params, c)
     logits = jax.lax.dot_general(
         x[:, 0], head, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 
-def prefill(params: Dict[str, Any], tokens: jax.Array,
-            config: LlamaConfig, max_len: Optional[int] = None):
-    """Fill the cache from a prompt [B, P] in ONE batched forward pass
-    (all prompt positions hit the MXU together; the per-layer pre-repeat
-    k/v come out of the layer scan and land in the cache with a single
-    dynamic_update_slice). Returns (last-token logits [B, V], cache)."""
+def lm_head_weight(params: Dict[str, Any], config: LlamaConfig) -> jax.Array:
+    """Output-projection matrix [D, V] in compute dtype (tied or not)."""
+    if config.tie_embeddings:
+        return params["embed"].T.astype(config.dtype)
+    return _weight(params, "lm_head", config.dtype)
+
+
+def prefill_kv(params: Dict[str, Any], tokens: jax.Array,
+               config: LlamaConfig):
+    """Prefill trunk: prompt [B, P] -> (normed hidden [B, P, D],
+    per-layer pre-repeat ks/vs [L, B, P, n_kv, head_dim]).
+
+    Shared by `prefill` (whole-cache fill) and the continuous-batching
+    engine's insert-at-slot path (serve/llm/engine.py) so both produce
+    bit-identical KV for the same prompt."""
     c = config
     B, P = tokens.shape
-    S = max_len or c.max_seq_len
-    cos, sin = rope_freqs(c.head_dim, S, c.rope_theta)
+    cos, sin = rope_freqs(c.head_dim, P, c.rope_theta)
     attn_fn = _get_attention_fn(c.attn_impl)
     kd = c.head_dim
 
@@ -564,10 +583,21 @@ def prefill(params: Dict[str, Any], tokens: jax.Array,
 
     x, (ks, vs) = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["norm_f"], c.norm_eps)
-    if c.tie_embeddings:
-        head = params["embed"].T.astype(c.dtype)
-    else:
-        head = _weight(params, "lm_head", c.dtype)
+    return x, ks, vs
+
+
+def prefill(params: Dict[str, Any], tokens: jax.Array,
+            config: LlamaConfig, max_len: Optional[int] = None):
+    """Fill the cache from a prompt [B, P] in ONE batched forward pass
+    (all prompt positions hit the MXU together; the per-layer pre-repeat
+    k/v come out of the layer scan and land in the cache with a single
+    dynamic_update_slice). Returns (last-token logits [B, V], cache)."""
+    c = config
+    B, P = tokens.shape
+    S = max_len or c.max_seq_len
+
+    x, ks, vs = prefill_kv(params, tokens, config)
+    head = lm_head_weight(params, c)
     logits = jax.lax.dot_general(
         x[:, -1], head, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)

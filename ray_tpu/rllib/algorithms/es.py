@@ -9,12 +9,14 @@ the "update" is a fitness-weighted combination of noise vectors.
 Architecture here vs the reference: the reference ships a shared noise
 table + offsets to dedicated ES workers because its policies are large.
 Our runners are the ordinary `EnvRunner` fleet (the same actors every
-other algorithm uses): per perturbation the driver enqueues an ordered
-`set_weights(theta ± sigma*eps)` then `sample_episodes(...)` pair on a
-runner — actor-call ordering guarantees the rollout sees its
-perturbation, and N pairs pipeline across the fleet in parallel. The
-combine step `w @ eps / (P*sigma)` is one jitted matmul (MXU-shaped:
-P x dim), with Adam on the flat parameter vector.
+other algorithm uses): the canonical theta ships ONCE per iteration via
+`ray_tpu.put`, then per perturbation the driver enqueues an ordered
+`set_perturbed_weights(theta_ref, seed, sigma, sign)` then
+`sample_episodes(...)` pair on a runner — the runner regenerates its
+noise row from the seed locally, actor-call ordering guarantees the
+rollout sees its perturbation, and N pairs pipeline across the fleet in
+parallel. The combine step `w @ eps / (P*sigma)` is one jitted matmul
+(MXU-shaped: P x dim), with Adam on the flat parameter vector.
 """
 
 from __future__ import annotations
@@ -107,29 +109,63 @@ class ES(Algorithm):
         P = cfg.num_perturbations
         sigma = cfg.noise_stdev
         dim = self._flat.size
-        eps = self._np_rng.randn(P, dim).astype(np.float32)
+        # Per-perturbation noise SEEDS, not noise vectors: each runner
+        # regenerates its eps row locally (set_perturbed_weights), the
+        # driver regenerates the same rows for the combine matmul.
+        seeds = self._np_rng.randint(0, 2 ** 31 - 1, size=P)
+        eps = np.stack([np.random.RandomState(int(s)).randn(dim)
+                        .astype(np.float32) for s in seeds])
 
-        # Enqueue ordered (set_weights -> sample_episodes) pairs, striped
-        # over the runner fleet; antithetic twins share the noise row.
+        # Ship theta ONCE: a top-level ObjectRef arg resolves on the
+        # runner from the object store, so the 2*P actor calls carry
+        # (ref, seed, sigma, sign) instead of 2*P full perturbed
+        # pytrees. Antithetic twins share the noise seed.
+        theta_ref = ray_tpu.put(self._unravel(self._flat))
         refs: List[Any] = []
         n_runners = len(self.env_runners)
         for i in range(P):
             for s, signed in ((0, 1.0), (1, -1.0)):
                 runner = self.env_runners[(2 * i + s) % n_runners]
-                w = self._unravel(self._flat + signed * sigma * eps[i])
-                runner.set_weights.remote(w)
+                runner.set_perturbed_weights.remote(
+                    theta_ref, int(seeds[i]), float(sigma), signed)
                 refs.append(runner.sample_episodes.remote(
                     cfg.episodes_per_perturbation, explore=False))
         results = ray_tpu.get(refs, timeout=600)
-        rets = np.asarray([float(np.mean(r["episode_returns"]))
-                           for r in results], np.float32).reshape(P, 2)
+        # Guard: a rollout can return ZERO completed episodes (hard
+        # max_env_steps truncation) — np.mean([]) is NaN, and one NaN
+        # return would ride the combine matmul straight into theta.
+        # Invalid rollouts zero their slot and invalidate the pair.
+        means, valid = [], []
+        for r in results:
+            er = r["episode_returns"]
+            valid.append(len(er) > 0)
+            means.append(float(np.mean(er)) if len(er) else 0.0)
+        rets = np.asarray(means, np.float32).reshape(P, 2)
+        pair_valid = np.asarray(valid, bool).reshape(P, 2).all(axis=1)
         self._total_episodes += sum(
             len(r["episode_returns"]) for r in results)
+        valid_rets = rets.reshape(-1)[np.asarray(valid, bool)]
+        self._recent_returns.extend(valid_rets.tolist())
+        metrics = {
+            "perturbed_return_mean": float(valid_rets.mean())
+            if valid_rets.size else 0.0,
+            "perturbed_return_max": float(valid_rets.max())
+            if valid_rets.size else 0.0,
+            "num_perturbations": int(P),
+            "invalid_pairs": int(P - int(pair_valid.sum())),
+            "total_episodes": self._total_episodes,
+        }
 
-        keep = np.arange(P)
-        if cfg.top_fraction < 1.0:
+        keep = np.nonzero(pair_valid)[0]
+        if cfg.top_fraction < 1.0 and keep.size:
             k = max(1, int(round(P * cfg.top_fraction)))
-            keep = np.argsort(-rets.max(axis=1))[:k]
+            keep = keep[np.argsort(-rets[keep].max(axis=1))[:k]]
+        if keep.size == 0:
+            # Every pair came back empty: skip the update entirely
+            # rather than stepping Adam on a zero/garbage gradient.
+            metrics.update(directions_kept=0,
+                           update_norm=float(np.linalg.norm(self._flat)))
+            return metrics
         sel = rets[keep]
         if cfg.fitness_shaping == "centered_rank":
             shaped = _centered_ranks(sel)
@@ -139,19 +175,15 @@ class ES(Algorithm):
 
         new_flat, self._opt_state = self._combine(
             self._flat, self._opt_state, w, eps[keep], sigma,
-            float(len(keep)))
+            float(keep.size))
         self._flat = np.asarray(new_flat)
 
         theta = self._unravel(self._flat)
         self.learner_group.set_weights(theta)
         self._sync_weights(theta)
-        self._recent_returns.extend(rets.reshape(-1).tolist())
-        return {"perturbed_return_mean": float(rets.mean()),
-                "perturbed_return_max": float(rets.max()),
-                "num_perturbations": int(P),
-                "directions_kept": int(len(keep)),
-                "update_norm": float(np.linalg.norm(self._flat)),
-                "total_episodes": self._total_episodes}
+        metrics.update(directions_kept=int(keep.size),
+                       update_norm=float(np.linalg.norm(self._flat)))
+        return metrics
 
 
 class ARS(ES):

@@ -36,8 +36,22 @@ class TD3Module(RLModule):
 
         obs_dim = int(np.prod(observation_space.shape))
         act_dim = int(np.prod(action_space.shape))
-        self._act_scale = np.asarray(action_space.high,
-                                     np.float32).reshape(-1)
+        # Affine low/high map: tanh lands in [-1, 1], the bounds need not
+        # be symmetric around zero. center + tanh(mu) * scale covers any
+        # bounded Box; validated here so a bad space fails at
+        # construction, not as NaN actions mid-training.
+        low = np.asarray(action_space.low, np.float32).reshape(-1)
+        high = np.asarray(action_space.high, np.float32).reshape(-1)
+        if not (np.isfinite(low).all() and np.isfinite(high).all()):
+            raise ValueError(
+                f"TD3/DDPG require a bounded action Box; got low={low} "
+                f"high={high}")
+        if not (high > low).all():
+            raise ValueError(
+                f"degenerate action Box: high must exceed low per "
+                f"dimension (low={low}, high={high})")
+        self._act_center = (high + low) / 2.0
+        self._act_scale = (high - low) / 2.0
         self.twin_q = bool(twin_q)
         self.exploration_sigma = float(exploration_sigma)
 
@@ -72,8 +86,10 @@ class TD3Module(RLModule):
 
     # -------------------------------------------------------------- policy
     def policy_action(self, actor_params, obs):
-        """Deterministic bounded action: tanh(mu(s)) * scale."""
-        return jnp.tanh(self._actor.apply(actor_params, obs)) * self._act_scale
+        """Deterministic bounded action: center + tanh(mu(s)) * scale."""
+        return (self._act_center
+                + jnp.tanh(self._actor.apply(actor_params, obs))
+                * self._act_scale)
 
     def forward_inference(self, params, obs):
         return {"actions": self.policy_action(params["actor"], obs)}
@@ -91,13 +107,46 @@ class TD3Module(RLModule):
         act = self.policy_action(params["actor"], obs)
         noise = self.exploration_sigma * self._act_scale * jax.random.normal(
             rng, act.shape)
-        act = jnp.clip(act + noise, -self._act_scale, self._act_scale)
+        act = jnp.clip(act + noise, self._act_center - self._act_scale,
+                       self._act_center + self._act_scale)
         return {"actions": act,
                 "logp": jnp.zeros(obs.shape[0], jnp.float32),
                 "vf": jnp.zeros(obs.shape[0], jnp.float32)}
 
     def forward_train(self, params, obs):
         return {"actions": self.policy_action(params["actor"], obs)}
+
+
+def _interval_update(inner, period: int):
+    """optax transform applying `inner` only every `period`-th step.
+
+    Masking the actor LOSS alone is not enough for delayed policy
+    updates: zero grads still advance Adam — the count steps, first/second
+    moments decay, and the stale momentum moves the actor parameters on
+    every skipped step. Here skipped steps emit zero updates AND keep the
+    inner optimizer state (count, mu, nu) frozen, so the actor's Adam
+    trajectory is exactly what it would be updating once per `period`
+    steps. Both branches are computed each call (fixed XLA program);
+    `where` selects. The step counter starts at 0 and increments once per
+    update, in lockstep with the learner's `state["step"]`, so the apply
+    steps coincide with `_actor_mask`'s unmasked steps.
+    """
+    import optax
+
+    def init(params):
+        return (jnp.zeros((), jnp.int32), inner.init(params))
+
+    def update(updates, state, params=None):
+        count, inner_state = state
+        apply = (count % period == 0)
+        new_updates, new_inner = inner.update(updates, inner_state, params)
+        out = jax.tree.map(
+            lambda n: jnp.where(apply, n, jnp.zeros_like(n)), new_updates)
+        kept = jax.tree.map(
+            lambda n, o: jnp.where(apply, n, o), new_inner, inner_state)
+        return out, (count + 1, kept)
+
+    return optax.GradientTransformation(init, update)
 
 
 class TD3Learner(Learner):
@@ -107,6 +156,33 @@ class TD3Learner(Learner):
     def init_extra_state(self, params) -> Dict[str, Any]:
         return {"target": jax.tree.map(jnp.copy, params),
                 "step": jnp.asarray(0, jnp.int32)}
+
+    def _make_optimizer(self):
+        """Partition the optimizer by parameter group: the critics step
+        every update, the actor's whole optimizer (not just its loss)
+        runs on the policy-delay interval. delay <= 1 (DDPG) keeps the
+        base single chain."""
+        import optax
+
+        def base():
+            return optax.chain(
+                optax.clip_by_global_norm(
+                    self.config.get("grad_clip", 0.5)),
+                optax.adam(self.config.get("lr", 3e-4)),
+            )
+
+        delay = int(self.config.get("policy_delay", 2))
+        if delay <= 1:
+            return base()
+
+        def labels(params):
+            return {k: jax.tree.map(
+                        lambda _: "actor" if k == "actor" else "critic", v)
+                    for k, v in params.items()}
+
+        return optax.multi_transform(
+            {"actor": _interval_update(base(), delay), "critic": base()},
+            labels)
 
     def _actor_mask(self, state):
         delay = int(self.config.get("policy_delay", 2))
@@ -122,6 +198,7 @@ class TD3Learner(Learner):
         params, target = state["params"], state["target"]
         m: TD3Module = self.module
         scale = jnp.asarray(m._act_scale)
+        center = jnp.asarray(m._act_center)
 
         # --- critic loss: y = r + gamma min Q_targ(s', pi_targ(s') + eps)
         a_next = m.policy_action(target["actor"], batch["next_obs"])
@@ -129,7 +206,7 @@ class TD3Learner(Learner):
             eps = jnp.clip(
                 target_noise * jax.random.normal(rng, a_next.shape),
                 -noise_clip, noise_clip) * scale
-            a_next = jnp.clip(a_next + eps, -scale, scale)
+            a_next = jnp.clip(a_next + eps, center - scale, center + scale)
         tq1, tq2 = m.q_values(target, batch["next_obs"], a_next)
         y = jax.lax.stop_gradient(
             batch["rewards"] + gamma

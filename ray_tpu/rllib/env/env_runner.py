@@ -58,6 +58,27 @@ class EnvRunner:
             self._params = jax.device_put(weights, self._cpu)
         return True
 
+    def set_perturbed_weights(self, theta, seed: int, sigma: float,
+                              sign: float) -> bool:
+        """ES/ARS fast path: install theta + sign*sigma*eps(seed).
+
+        The driver ships the canonical theta ONCE per iteration as an
+        ObjectRef (top-level args resolve from the object store by
+        reference) and each runner regenerates its noise row locally
+        from the integer seed — so per perturbation only three scalars
+        travel, instead of a full perturbed pytree 2*P times."""
+        import jax
+        from jax.flatten_util import ravel_pytree
+
+        with jax.default_device(self._cpu):
+            flat, unravel = ravel_pytree(theta)
+            flat = np.asarray(flat, np.float32)
+            eps = np.random.RandomState(seed).randn(
+                flat.size).astype(np.float32)
+            self._params = jax.device_put(
+                unravel(flat + np.float32(sign * sigma) * eps), self._cpu)
+        return True
+
     def get_connector_state(self) -> Optional[Dict[str, Any]]:
         """Pipeline state (normalizer stats, stack buffers) — for
         evaluation-side parity and checkpoint/restore."""

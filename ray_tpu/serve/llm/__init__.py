@@ -1,0 +1,17 @@
+"""ray_tpu.serve.llm — continuous-batching LLM serving on TPU.
+
+The engine (`engine.py`) keeps a fixed pool of decode slots inside a
+bounded set of compiled XLA programs; the deployment (`deployment.py`)
+exposes it as a Serve replica. See PERF.md "Serving throughput" for the
+design narrative and bench numbers.
+"""
+
+from ray_tpu.serve.llm.deployment import LLMServer, build_llm_app
+from ray_tpu.serve.llm.engine import (
+    EngineConfig, LLMEngine, Request, RequestHandle, static_batch_generate,
+)
+
+__all__ = [
+    "EngineConfig", "LLMEngine", "LLMServer", "Request", "RequestHandle",
+    "build_llm_app", "static_batch_generate",
+]

@@ -1,0 +1,488 @@
+"""Continuous-batching LLM inference engine — the Serve-on-TPU data plane.
+
+The serving problem on TPU is a compile-boundary problem: XLA programs
+are shape-specialized, so a naive server that launches one `generate`
+per request (or per ad-hoc batch) either retraces constantly or decodes
+in lockstep where every short request pays for the longest one
+(models/llama.py::generate — the static path this engine replaces).
+Podracer (arXiv:2104.06272) and RLAX (arXiv:2512.06392) both land on
+the same answer: keep ONE fixed-shape compiled program fed continuously.
+
+Design — a bounded set of compiled programs, everything else is data:
+
+- A fixed pool of ``B = num_slots`` decode slots sharing one KV cache
+  ``[L, B, S, n_kv, head_dim]``. Per-slot position/last-token/active
+  state are device arrays with fixed shapes.
+- ONE jitted decode tick advances all live slots together
+  (models/llama.py::decode_step with the slot-active mask: dead slots
+  ride through the program but their KV writes are dropped). The tick
+  runs `decode_block` steps per dispatch through an internal lax.scan —
+  still one compiled program — to amortize host dispatch/readback on
+  tunneled TPU backends.
+- Jitted prefill at a small set of padded prompt-length buckets; the
+  resulting per-layer KV lands in the shared cache at a slot index via
+  one `dynamic_update_slice` (insert-at-slot). One compiled program per
+  bucket, so a mixed workload traces exactly
+  ``len(prefill_buckets) + 1`` engine programs — `trace_count` exposes
+  the number for the compile-guard test.
+- Slot eviction/recycling is host-side bookkeeping: EOS / stop-token /
+  max_tokens free the slot, the next queued request prefills into it.
+  Stale KV beyond a recycled slot's new position is harmless — decode
+  masks positions > pos and overwrites each position before ever
+  attending to it.
+
+Greedy decoding is token-identical to per-request
+`models.llama.generate`: padding columns contribute exact zeros through
+the masked softmax, so bucket-padded prefill and the shared-cache
+decode reproduce the static path bit-for-bit (pinned by
+tests/test_serve_llm.py::test_greedy_parity_*).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Shapes of the engine's compiled programs (all static)."""
+
+    num_slots: int = 8              # B: concurrent sequences in flight
+    max_seq_len: int = 512          # S: shared KV cache length per slot
+    # Padded prompt lengths; a prompt compiles into the smallest bucket
+    # that holds it. Keep this SHORT — each bucket is one XLA program.
+    prefill_buckets: Tuple[int, ...] = (32, 64, 128)
+    eos_id: Optional[int] = None    # config-level end-of-sequence token
+    # Decode steps per tick dispatch (lax.scan inside the ONE tick
+    # program). >1 amortizes host dispatch/readback — decisive on
+    # tunneled TPU backends (~tens of ms per round trip) — at the cost
+    # of up to K-1 speculative tokens per finished slot (computed, then
+    # discarded host-side; parity is unaffected because truncation
+    # happens at the same stop condition single-stepping would hit) and
+    # admission latency of one block.
+    decode_block: int = 1
+
+    def __post_init__(self):
+        if self.decode_block < 1:
+            raise ValueError("decode_block must be >= 1")
+        if not self.prefill_buckets:
+            raise ValueError("need at least one prefill bucket")
+        b = tuple(sorted(set(int(x) for x in self.prefill_buckets)))
+        object.__setattr__(self, "prefill_buckets", b)
+        if b[-1] > self.max_seq_len:
+            raise ValueError(
+                f"largest prefill bucket {b[-1]} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (token-id domain; tokenization is the
+    caller's concern)."""
+
+    prompt: Sequence[int]
+    max_tokens: int = 64
+    temperature: float = 0.0
+    stop: Tuple[int, ...] = ()      # tokens that halt WITHOUT being emitted
+    # Streaming hook: called as on_token(request_id, token_id) from the
+    # engine loop as each token lands.
+    on_token: Optional[Callable[[int, int], None]] = None
+
+
+class RequestHandle:
+    """Host-side view of a submitted request; completion is an Event."""
+
+    def __init__(self, request_id: int, request: Request):
+        self.request_id = request_id
+        self.request = request
+        self.tokens: List[int] = []
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.finish_reason: Optional[str] = None   # "eos"|"stop"|"length"
+        self._done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished in {timeout}s")
+        return self.tokens
+
+    # Latency accounting for the bench (seconds).
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean per-output-token latency after the first token."""
+        if self.finished_at is None or self.first_token_at is None:
+            return None
+        n = len(self.tokens)
+        if n <= 1:
+            return 0.0
+        return (self.finished_at - self.first_token_at) / (n - 1)
+
+
+class _Slot:
+    __slots__ = ("handle", "uses")
+
+    def __init__(self):
+        self.handle: Optional[RequestHandle] = None
+        self.uses = 0
+
+
+class LLMEngine:
+    """Slot-based continuous-batching engine over a Llama param set.
+
+    Host-side scheduler + two families of jitted device programs
+    (`_insert` per prefill bucket, `_tick` for the decode step). Thread
+    model: `submit()` is thread-safe; `step()`/`run()` must be driven by
+    a single scheduler thread (serve/llm/deployment.py runs one per
+    replica).
+    """
+
+    def __init__(self, params: Any, model_config: Any,
+                 engine_config: Optional[EngineConfig] = None,
+                 rng_seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models.llama import init_kv_cache
+
+        self.params = params
+        self.model_config = model_config
+        self.config = engine_config or EngineConfig()
+        c = self.config
+        B = c.num_slots
+
+        # Device state (fixed shapes for the engine's whole lifetime).
+        self._cache = init_kv_cache(model_config, B, c.max_seq_len)
+        self._tok = jnp.zeros((B,), jnp.int32)
+        self._pos = jnp.zeros((B,), jnp.int32)
+        self._key = jax.random.key(rng_seed)
+        # Host-side mirrors fed into each program call (tiny transfers).
+        self._active = np.zeros((B,), bool)
+        self._temp = np.zeros((B,), np.float32)
+
+        # Host-side scheduler state.
+        self._slots = [_Slot() for _ in range(B)]
+        self._free: deque = deque(range(B))
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._ids = itertools.count()
+        self._completed = 0
+        self._slot_reuses = 0
+
+        # Trace counters: the bodies below run ONLY when jax traces a new
+        # program, so these count compiled engine programs — the
+        # compile-guard test asserts trace_count <= n_buckets + 1.
+        self._traces = {"tick": 0, "insert": 0}
+
+        self._jit_tick = jax.jit(self._tick_fn, donate_argnums=(1, 2, 3))
+        self._jit_insert = jax.jit(self._insert_fn,
+                                   donate_argnums=(1, 2, 3))
+
+    # ------------------------------------------------------------ programs
+
+    def _tick_fn(self, params, cache, tok, pos, active, temp, key):
+        """`decode_block` decode steps for all B slots in one dispatch
+        (lax.scan — still ONE compiled program). Inactive slots are
+        computed but masked: no KV write, token/pos parked. Positions
+        clamp at S-1 so a slot finishing mid-block can speculate ahead
+        without ever attending past rows it wrote itself; the host
+        discards post-stop tokens."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import decode_step
+
+        self._traces["tick"] += 1
+        S = self.config.max_seq_len
+
+        def body(carry, _):
+            cache, tok, pos, key = carry
+            logits, cache = decode_step(params, cache, tok, pos,
+                                        self.model_config, active=active)
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, temp, sub)
+            tok = jnp.where(active, nxt, tok)
+            pos = jnp.where(active, jnp.minimum(pos + 1, S - 1), pos)
+            return (cache, tok, pos, key), tok
+
+        (cache, tok, pos, key), toks = jax.lax.scan(
+            body, (cache, tok, pos, key), None,
+            length=self.config.decode_block)
+        return cache, tok, pos, key, toks          # toks: [K, B]
+
+    def _insert_fn(self, params, cache, tok, pos, padded_prompt,
+                   prompt_len, slot, temperature, key):
+        """Prefill one bucket-padded prompt and splice its KV into the
+        shared cache at `slot`; sample the first generated token from
+        the logits at the last REAL prompt position. One trace per
+        bucket length (the shape of `padded_prompt`)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ray_tpu.models.llama import lm_head_weight, prefill_kv
+
+        self._traces["insert"] += 1
+        c = self.model_config
+        hidden, ks, vs = prefill_kv(params, padded_prompt[None], c)
+        # ks/vs: [L, 1, Pb, n_kv, hd] -> rows [0, Pb) of this slot.
+        cache = {
+            "k": lax.dynamic_update_slice(
+                cache["k"], ks.astype(c.dtype), (0, slot, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(
+                cache["v"], vs.astype(c.dtype), (0, slot, 0, 0, 0)),
+        }
+        x_last = lax.dynamic_index_in_dim(
+            hidden[0], prompt_len - 1, axis=0, keepdims=False)
+        logits = jax.lax.dot_general(
+            x_last[None], lm_head_weight(params, c),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [1, V]
+        key, sub = jax.random.split(key)
+        first = _sample(logits, temperature[None], sub)[0]
+        tok = tok.at[slot].set(first)
+        pos = pos.at[slot].set(prompt_len)
+        return cache, tok, pos, key
+
+    # ----------------------------------------------------------- submission
+
+    def submit(self, request: Request) -> RequestHandle:
+        if len(request.prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(request.prompt) > self.config.prefill_buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(request.prompt)} exceeds largest "
+                f"prefill bucket {self.config.prefill_buckets[-1]}")
+        if request.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        handle = RequestHandle(next(self._ids), request)
+        with self._lock:
+            self._queue.append(handle)
+        self._work.set()
+        return handle
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or bool(self._active.any())
+
+    # ------------------------------------------------------------ scheduling
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)  # pre-checked in submit()
+
+    def _admit(self) -> List[int]:
+        """Move queued requests into free slots (one prefill each);
+        returns the slots inserted this step."""
+        import numpy as np
+
+        inserted = []
+        while self._free:
+            with self._lock:
+                if not self._queue:
+                    break
+                handle = self._queue.popleft()
+            slot = self._free.popleft()
+            req = handle.request
+            P = len(req.prompt)
+            bucket = self._bucket_for(P)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:P] = np.asarray(req.prompt, np.int32)
+            self._cache, self._tok, self._pos, self._key = \
+                self._jit_insert(
+                    self.params, self._cache, self._tok, self._pos,
+                    padded, np.int32(P), np.int32(slot),
+                    np.float32(req.temperature), self._key)
+            st = self._slots[slot]
+            if st.uses:
+                self._slot_reuses += 1
+            st.uses += 1
+            st.handle = handle
+            self._active[slot] = True
+            self._temp[slot] = req.temperature
+            inserted.append(slot)
+        return inserted
+
+    def _emit(self, slot: int, token: int) -> None:
+        """Record one generated token for `slot`; free the slot when the
+        request is finished (eos/stop halt, max_tokens bounds)."""
+        st = self._slots[slot]
+        handle = st.handle
+        req = handle.request
+        now = time.monotonic()
+        reason = None
+        if token in req.stop:
+            reason = "stop"                      # halt, token NOT emitted
+        else:
+            handle.tokens.append(token)
+            if handle.first_token_at is None:
+                handle.first_token_at = now
+            if req.on_token is not None:
+                try:
+                    req.on_token(handle.request_id, token)
+                except Exception:
+                    pass                          # streaming is best-effort
+            if (self.config.eos_id is not None
+                    and token == self.config.eos_id):
+                reason = "eos"                   # halt, eos IS emitted
+            elif len(handle.tokens) >= req.max_tokens:
+                reason = "length"
+        # Hard cap: a slot may never write past the shared cache. The
+        # NEXT token would land at pos = prompt + len(tokens); stop while
+        # it still fits.
+        if reason is None and (len(req.prompt) + len(handle.tokens)
+                               >= self.config.max_seq_len):
+            reason = "length"
+        if reason is not None:
+            handle.finish_reason = reason
+            handle.finished_at = now
+            st.handle = None
+            self._active[slot] = False
+            self._temp[slot] = 0.0
+            self._free.append(slot)
+            self._completed += 1
+            handle._done.set()
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit queued requests into free
+        slots (prefill + first token each), then one decode tick for
+        every live slot. Returns True if any work was done."""
+        import numpy as np
+
+        inserted = self._admit()
+        if inserted:
+            # First generated token per inserted slot (before the tick
+            # below overwrites it with the second).
+            tok_host = np.asarray(self._tok)
+            for slot in inserted:
+                self._emit(slot, int(tok_host[slot]))
+        if not self._active.any():
+            return bool(inserted)
+        live = np.nonzero(self._active)[0]
+        self._cache, self._tok, self._pos, self._key, toks = \
+            self._jit_tick(
+                self.params, self._cache, self._tok, self._pos,
+                self._active.copy(), self._temp.copy(), self._key)
+        toks_host = np.asarray(toks)                # [K, B]
+        for slot in live:
+            s = int(slot)
+            for k in range(toks_host.shape[0]):
+                if self._slots[s].handle is None:
+                    break          # finished earlier in the block —
+                    #                remaining tokens were speculative
+                self._emit(s, int(toks_host[k, s]))
+        return True
+
+    def run(self, stop_event: threading.Event,
+            idle_wait_s: float = 0.02) -> None:
+        """Scheduler loop for a background thread (one per engine)."""
+        while not stop_event.is_set():
+            if not self.step():
+                self._work.clear()
+                if not self.has_work():
+                    self._work.wait(idle_wait_s)
+
+    def drain(self, timeout: float = 300.0) -> None:
+        """Synchronously step until queue and slots are empty (tests and
+        offline batch use; do not mix with a run() thread)."""
+        deadline = time.monotonic() + timeout
+        while self.has_work():
+            if time.monotonic() > deadline:
+                raise TimeoutError("engine did not drain")
+            self.step()
+
+    # ------------------------------------------------------------ inspection
+
+    @property
+    def trace_count(self) -> int:
+        """Number of engine XLA programs traced so far (compile guard:
+        must stay <= len(prefill_buckets) + 1 under any workload)."""
+        return self._traces["tick"] + self._traces["insert"]
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "num_slots": self.config.num_slots,
+            "active_slots": int(self._active.sum()),
+            "queued": len(self._queue),
+            "completed": self._completed,
+            "slot_reuses": self._slot_reuses,
+            "traces": dict(self._traces),
+            "trace_count": self.trace_count,
+        }
+
+
+def _sample(logits, temp, key):
+    """Per-row sampling: greedy where temp == 0, else temperature
+    categorical. Both branches are computed (fixed shape); `where`
+    selects."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
+
+
+def static_batch_generate(params, model_config, requests: List[Request],
+                          batch_size: int, pad_to: int,
+                          steps: Optional[int] = None,
+                          warmup: bool = True):
+    """The lockstep baseline the engine replaces: group requests in
+    arrival order, pad prompts to `pad_to`, decode `steps` (default:
+    max(max_tokens)) per group via models.llama.generate, truncate per
+    request. Used by bench.py for the continuous-vs-static comparison
+    on identical geometry (one compiled program: fixed B/P/N). Returns
+    (outputs, per-batch seconds) — the timings let the bench couple
+    batches to an arrival trace.
+
+    Throughput baseline ONLY: `generate` has no padding mask, so a
+    prompt shorter than `pad_to` sees trailing pad tokens in its context
+    and its output tokens differ from the unpadded result — which is one
+    of the deficiencies of the static path (the other, measured by the
+    bench, is that every request decodes for the group max). Compute
+    cost is identical to real content at the same shapes, so the timing
+    stands."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.llama import generate
+
+    steps = steps or max(r.max_tokens for r in requests)
+    gen = jax.jit(lambda p, t: generate(p, t, model_config,
+                                        max_new_tokens=steps))
+    if warmup:                              # compile outside the timings
+        np.asarray(gen(params, jnp.zeros((batch_size, pad_to),
+                                         jnp.int32)))
+    outs: List[List[int]] = []
+    batch_seconds: List[float] = []
+    for i in range(0, len(requests), batch_size):
+        group = requests[i:i + batch_size]
+        toks = np.zeros((batch_size, pad_to), np.int32)
+        for j, r in enumerate(group):
+            toks[j, :len(r.prompt)] = np.asarray(r.prompt, np.int32)
+        t0 = time.monotonic()
+        out = np.asarray(gen(params, jnp.asarray(toks)))
+        batch_seconds.append(time.monotonic() - t0)
+        for j, r in enumerate(group):
+            outs.append(out[j, :r.max_tokens].tolist())
+    return outs, batch_seconds

@@ -157,6 +157,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "timeout(seconds): per-test timeout override "
         "(default %ds)" % TEST_TIMEOUT_S)
+    # Tier-1 runs `-m 'not slow'` (ROADMAP.md): benchmarks and other
+    # long-haul tests opt out of the bounded tier with this marker.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the bounded tier-1 run")
 
 
 def _clear_alarm(old):

@@ -314,6 +314,34 @@ class TestEventLoopThreadSubmit:
             f.result(timeout=30)
         io.stop()
 
+    def test_dump_event_loops_retries_transient_all_tasks_failure(
+            self, monkeypatch):
+        """asyncio.all_tasks iterates a WeakSet the live loop mutates —
+        transient 'Set changed size during iteration' RuntimeErrors must
+        be retried, not reported as a failed dump."""
+        import asyncio
+        import io as _io
+
+        from ray_tpu._private import rpc
+
+        loop_thread = self._mk()
+        loop_thread.run(_async_const(1), timeout=10)
+        real = asyncio.all_tasks
+        calls = {"n": 0}
+
+        def flaky(loop=None):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise RuntimeError("Set changed size during iteration")
+            return real(loop)
+
+        monkeypatch.setattr(asyncio, "all_tasks", flaky)
+        buf = _io.StringIO()
+        rpc.dump_event_loops(file=buf)
+        assert "all_tasks failed" not in buf.getvalue()
+        assert calls["n"] >= 3
+        loop_thread.stop()
+
     def test_stop_fails_undrained_submissions(self):
         """stop() must resolve queued-but-unstarted futures instead of
         leaving run() callers blocked forever."""

@@ -410,17 +410,59 @@ def test_td3_module_and_learner_units():
     }
     leaf = lambda s: np.asarray(  # noqa: E731
         jax.tree.leaves(s["target"]["actor"])[0]).copy()
-    t0 = leaf(learner._state)
+    actor_leaf = lambda s: np.asarray(  # noqa: E731
+        jax.tree.leaves(s["params"]["actor"])[0]).copy()
+    t0, a0 = leaf(learner._state), actor_leaf(learner._state)
     metrics = learner.update(batch)
     for key in ("critic_loss", "actor_loss", "q1_mean", "target_q_mean"):
         assert key in metrics
-    t1 = leaf(learner._state)
+    t1, a1 = leaf(learner._state), actor_leaf(learner._state)
     assert not np.allclose(t0, t1)     # step 0: mask=1 -> polyak ran
+    assert not np.allclose(a0, a1)     # step 0: actor stepped
     metrics = learner.update(batch)
-    t2 = leaf(learner._state)
+    t2, a2 = leaf(learner._state), actor_leaf(learner._state)
     assert np.allclose(t1, t2)         # step 1: mask=0 -> targets frozen
+    # Step 1: actor params EXACTLY frozen — the interval optimizer must
+    # not leak Adam momentum into skipped steps (a zeroed loss alone
+    # would still move the actor).
+    assert np.array_equal(a1, a2)
     learner.update(batch)
     assert not np.allclose(t2, leaf(learner._state))  # step 2: mask=1 again
+    assert not np.allclose(a2, actor_leaf(learner._state))
+
+
+def test_td3_action_space_affine_map_and_validation():
+    """Asymmetric Box bounds map through center + tanh * scale;
+    unbounded or degenerate boxes fail at module construction."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.td3 import TD3Module
+    from ray_tpu.rllib.env.spaces import Box
+
+    obs_space = Box(low=-np.ones(3), high=np.ones(3))
+    act_space = Box(low=np.array([0.0, -1.0]), high=np.array([4.0, 3.0]))
+    mod = TD3Module(obs_space, act_space, (8,), twin_q=False,
+                    exploration_sigma=0.5)
+    params = mod.init(jax.random.key(0))
+    obs = jax.random.normal(jax.random.key(1), (64, 3))
+    det = np.asarray(mod.forward_inference(params, obs)["actions"])
+    lo, hi = np.array([0.0, -1.0]), np.array([4.0, 3.0])
+    assert det.shape == (64, 2)
+    assert (det >= lo - 1e-6).all() and (det <= hi + 1e-6).all()
+    noisy = np.asarray(
+        mod.forward_exploration(params, obs, jax.random.key(2))["actions"])
+    assert (noisy >= lo - 1e-6).all() and (noisy <= hi + 1e-6).all()
+    # Zero-mean mu hits the center of the box, not zero.
+    zero_mu = np.asarray(mod._act_center + jnp.tanh(0.0) * mod._act_scale)
+    assert np.allclose(zero_mu, (lo + hi) / 2)
+
+    with pytest.raises(ValueError):
+        TD3Module(obs_space, Box(low=np.array([-np.inf]),
+                                 high=np.array([np.inf])))
+    with pytest.raises(ValueError):
+        TD3Module(obs_space, Box(low=np.array([1.0]),
+                                 high=np.array([1.0])))
 
 
 def test_td3_pendulum_improves(rl_cluster):
