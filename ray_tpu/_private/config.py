@@ -139,6 +139,12 @@ _define("task_events_buffer_size", int, 100_000,
 _define("metrics_report_interval_s", float, 2.0,
         "Flush cadence of user-defined ray_tpu.util.metrics to the GCS "
         "(reference: metrics_report_interval_ms).")
+_define("jit_recompile_warn_budget", int, 8,
+        "Default trace budget of observability.tracked_jit wrappers: a "
+        "tracked jitted function that traces more programs than this "
+        "warns RecompileWarning once (silent XLA retracing is the #1 "
+        "TPU perf killer). Explicit trace_budget= overrides per "
+        "wrapper; 0 disables the warning.")
 
 # --- tpu ---
 _define("tpu_chips_per_host_default", int, 4, "")

@@ -120,6 +120,9 @@ class GcsServer:
         # same visibility a Prometheus target losing a process has;
         # counter resets are the scrape consumer's problem (rate()).
         self.user_metrics: Dict[str, Tuple[float, List[Dict[str, Any]]]] = {}
+        # Monotonic series (counters/histograms) of expired sources, folded
+        # here so cluster totals never go backwards when a worker exits.
+        self._metric_tombstones: Dict[str, Dict[str, Any]] = {}
 
         self._reschedule_on_start: List[bytes] = []
         self._register_handlers()
@@ -258,6 +261,7 @@ class GcsServer:
             "register_worker", "list_workers", "get_system_config",
             "cluster_resources", "available_resources", "internal_stats",
             "metrics_text", "get_cluster_load", "push_metrics",
+            "user_metrics_summary",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
 
@@ -326,28 +330,106 @@ class GcsServer:
         self.user_metrics[source] = (time.time(), records)
         return True
 
+    async def _h_user_metrics_summary(self, prefixes=None):
+        """Aggregated user metrics as plain dicts (dashboard /api/serve).
+        ``prefixes``: optional list of metric-name prefixes to keep."""
+        metas, counters, gauges, hists = self._aggregate_user_metrics()
+        out: Dict[str, Any] = {}
+        for name, meta in metas.items():
+            if prefixes and not any(name.startswith(p) for p in prefixes):
+                continue
+            typ = meta["type"]
+            entry: Dict[str, Any] = {
+                "type": typ, "description": meta.get("description", "")}
+            if typ == "counter":
+                entry["data"] = dict(counters[name])
+            elif typ == "gauge":
+                entry["data"] = dict(gauges[name])
+            else:
+                bounds = tuple(meta.get("boundaries", ()))
+                data: Dict[str, Any] = {}
+                for labels, cell in hists[name].items():
+                    if len(cell) != len(bounds) + 3:
+                        continue
+                    count = cell[len(bounds) + 2]
+                    total = cell[len(bounds) + 1]
+                    data[labels] = {
+                        "count": count, "sum": total,
+                        "mean": (total / count) if count else 0.0,
+                        "buckets": {str(b): cell[i]
+                                    for i, b in enumerate(bounds)},
+                    }
+                entry["data"] = data
+                entry["boundaries"] = list(bounds)
+            out[name] = entry
+        return out
+
     @staticmethod
     def _esc_label(v: str) -> str:
         return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
                 .replace('"', '\\"'))
 
-    def _render_user_metrics(self) -> List[str]:
-        """Aggregate pushed ray_tpu.util.metrics snapshots into exposition
-        lines: counters/histograms summed across processes, gauges exported
-        per-process with a pid label. Sources that stopped pushing (dead
-        workers) expire after 10 flush intervals."""
+    def _expire_user_metric_sources(self) -> None:
+        """Drop sources that stopped pushing (dead workers) after 10 flush
+        intervals. Their counters/histograms — cumulative by contract — are
+        folded into the tombstone accumulator first, so `rtpu_*_total`
+        series keep their contribution and never go backwards on worker
+        exit. Gauges are per-process state and are simply dropped."""
         ttl = GlobalConfig.metrics_report_interval_s * 10
         now = time.time()
         for source in [s for s, (ts, _) in self.user_metrics.items()
                        if now - ts > ttl]:
-            del self.user_metrics[source]
+            _, records = self.user_metrics.pop(source)
+            self._fold_tombstones(records)
+
+    def _fold_tombstones(self, records) -> None:
+        for rec in records:
+            typ = rec.get("type")
+            if typ not in ("counter", "histogram"):
+                continue
+            name = rec.get("name")
+            tomb = self._metric_tombstones.get(name)
+            if tomb is None:
+                tomb = dict(rec)
+                tomb["data"] = {
+                    k: (list(v) if isinstance(v, list) else float(v))
+                    for k, v in rec.get("data", {}).items()}
+                self._metric_tombstones[name] = tomb
+                continue
+            if tomb.get("type") != typ or (
+                    typ == "histogram"
+                    and tuple(tomb.get("boundaries", ()))
+                    != tuple(rec.get("boundaries", ()))):
+                continue  # conflicting registration; skip, never crash
+            data = tomb["data"]
+            for tagvals, cell in rec.get("data", {}).items():
+                prior = data.get(tagvals)
+                if prior is None:
+                    data[tagvals] = (list(cell) if isinstance(cell, list)
+                                     else float(cell))
+                elif isinstance(cell, list):
+                    if len(prior) == len(cell):
+                        for i, v in enumerate(cell):
+                            prior[i] += v
+                else:
+                    data[tagvals] = float(prior) + float(cell)
+
+    def _aggregate_user_metrics(self):
+        """Merge pushed ray_tpu.util.metrics snapshots (live sources plus
+        tombstones of expired ones): counters/histograms summed across
+        processes, gauges kept per-process keyed by a pid label."""
+        self._expire_user_metric_sources()
         # (name) -> merged view
         metas: Dict[str, Dict[str, Any]] = {}
         counters: Dict[str, Dict[str, float]] = defaultdict(
             lambda: defaultdict(float))
         gauges: Dict[str, Dict[str, float]] = defaultdict(dict)
         hists: Dict[str, Dict[str, List[float]]] = defaultdict(dict)
-        for source, (_, records) in self.user_metrics.items():
+        sources = list(self.user_metrics.items())
+        if self._metric_tombstones:
+            sources.append(
+                ("(exited)", (0.0, list(self._metric_tombstones.values()))))
+        for source, (_, records) in sources:
             for rec in records:
                 name, typ = rec["name"], rec["type"]
                 meta = metas.setdefault(name, rec)
@@ -376,6 +458,11 @@ class GcsServer:
                         else:
                             for i, v in enumerate(cell):
                                 acc[i] += v
+        return metas, counters, gauges, hists
+
+    def _render_user_metrics(self) -> List[str]:
+        """User metrics as Prometheus exposition lines."""
+        metas, counters, gauges, hists = self._aggregate_user_metrics()
         out: List[str] = []
         for name, meta in metas.items():
             typ = meta["type"]

@@ -34,6 +34,33 @@ _PANELS: List[Dict[str, str]] = [
     {"title": "Placement groups",
      "expr": "rtpu_placement_groups_total", "legend": "{{state}}",
      "unit": "short"},
+    # --- serving / JIT / device telemetry (observability plane) ---
+    {"title": "Serve TTFT p50/p99",
+     "expr": 'histogram_quantile(0.5, '
+             'rate(rtpu_serve_ttft_seconds_bucket[5m]))',
+     "expr_b": 'histogram_quantile(0.99, '
+               'rate(rtpu_serve_ttft_seconds_bucket[5m]))',
+     "unit": "s"},
+    {"title": "Serve e2e latency p50/p99",
+     "expr": 'histogram_quantile(0.5, '
+             'rate(rtpu_serve_e2e_seconds_bucket[5m]))',
+     "expr_b": 'histogram_quantile(0.99, '
+               'rate(rtpu_serve_e2e_seconds_bucket[5m]))',
+     "unit": "s"},
+    {"title": "Serve tokens/sec",
+     "expr": "rate(rtpu_serve_tokens_total[1m])", "unit": "short"},
+    {"title": "Serve queue depth / active slots",
+     "expr": "rtpu_serve_queue_depth",
+     "expr_b": "rtpu_serve_active_slots", "unit": "short"},
+    {"title": "JIT retraces (recompiles)",
+     "expr": "rate(rtpu_jit_traces_total[5m])",
+     "legend": "{{fn}}", "unit": "short"},
+    {"title": "JIT compile time",
+     "expr": "rate(rtpu_jit_compile_seconds_sum[5m])",
+     "legend": "{{fn}}", "unit": "s"},
+    {"title": "Device HBM used vs total",
+     "expr": "rtpu_device_hbm_used_bytes",
+     "expr_b": "rtpu_device_hbm_total_bytes", "unit": "bytes"},
 ]
 
 

@@ -16,6 +16,8 @@ Endpoints:
   GET /api/actors     actor table
   GET /api/jobs       job table
   GET /api/tasks      recent task lifecycle events
+  GET /api/timeline   Chrome-trace JSON download (chrome://tracing)
+  GET /api/serve      live serving/JIT telemetry summary
   GET /metrics        Prometheus text (scrape target)
 """
 
@@ -132,6 +134,31 @@ class DashboardHead:
         text = await self._gcs.acall("metrics_text", timeout=10)
         return web.Response(text=text, content_type="text/plain")
 
+    async def timeline(self, req) -> web.Response:
+        """Chrome-trace JSON of the task-event ring buffer — load in
+        chrome://tracing or https://ui.perfetto.dev."""
+        from ray_tpu._private.config import GlobalConfig
+        from ray_tpu.observability.timeline import build_chrome_trace
+
+        limit = int(req.query.get(
+            "limit", GlobalConfig.task_events_buffer_size))
+        events = await self._gcs.acall("get_task_events", limit=limit,
+                                       timeout=30)
+        trace = build_chrome_trace(events or [])
+        resp = web.json_response(
+            trace, dumps=lambda o: json.dumps(o, default=str))
+        resp.headers["Content-Disposition"] = (
+            'attachment; filename="timeline.json"')
+        return resp
+
+    async def serve_stats(self, _req) -> web.Response:
+        """Live serving/JIT telemetry aggregated on the GCS (engine
+        latency histograms, queue gauges, compile counters)."""
+        summary = await self._gcs.acall(
+            "user_metrics_summary",
+            prefixes=["serve_", "jit_", "device_"], timeout=10)
+        return web.json_response(summary or {})
+
     # ---- profiling (reference: dashboard/modules/reporter/
     # profile_manager.py — on-demand stack dump + sampling CPU profile
     # per worker, flamegraph-able folded-stack payloads) ----------------
@@ -234,6 +261,8 @@ class DashboardHead:
         app.router.add_get("/api/jobs", self.jobs)
         app.router.add_get("/api/tasks", self.tasks)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/api/timeline", self.timeline)
+        app.router.add_get("/api/serve", self.serve_stats)
         app.router.add_get("/api/profile", self.profile)
         app.router.add_get("/api/profile/stacks", self.profile)
         app.router.add_post("/api/job_submissions", self.submit_job)

@@ -1,0 +1,49 @@
+"""TPU-aware telemetry plane.
+
+The two things that silently kill TPU performance are XLA recompiles
+and idle device time (Podracer, arXiv:2104.06272, attributes its TPU
+efficiency to exactly this per-step accounting). This package is the
+shared instrumentation layer every hot path reports through:
+
+- ``jit``: compile tracking for the ``jax.jit`` entry points we own —
+  per-function trace/compile counters, compile wall-time histograms,
+  ``jit_compile`` spans, and a recompile detector that warns once a
+  function re-traces past its budget (:class:`TrackedJit`).
+- ``device``: per-device HBM/count gauges sampled by the metrics
+  flusher (``device.memory_stats()`` where the backend provides it).
+- ``serve``: TTFT/TPOT/e2e/queue-wait histograms, queue-depth /
+  active-slot / batch-utilization gauges, and token/request counters
+  for the continuous-batching LLM engine.
+- ``train``: step-duration / samples-per-sec / loss reporting for
+  ``train`` sessions and RLlib learners.
+- ``timeline``: the Chrome-trace builder shared by
+  ``ray_tpu.timeline()`` and the dashboard's ``GET /api/timeline``.
+
+Everything exports through the existing plane: metric objects are
+``ray_tpu.util.metrics`` Counters/Gauges/Histograms (flushed to the GCS
+``/metrics`` scrape endpoint with the ``rtpu_`` prefix), spans are
+``ray_tpu.util.tracing`` events (rendered by ``ray_tpu.timeline()``).
+"""
+
+from ray_tpu.observability.jit import (  # noqa: F401
+    RecompileWarning,
+    TrackedJit,
+    jit_stats,
+    tracked_jit,
+)
+from ray_tpu.observability.device import (  # noqa: F401
+    sample_device_metrics,
+)
+from ray_tpu.observability.serve import serve_metrics  # noqa: F401
+from ray_tpu.observability.timeline import build_chrome_trace  # noqa: F401
+from ray_tpu.observability.train import (  # noqa: F401
+    batch_num_samples,
+    learner_metrics,
+    train_metrics,
+)
+
+__all__ = [
+    "RecompileWarning", "TrackedJit", "tracked_jit", "jit_stats",
+    "sample_device_metrics", "serve_metrics", "train_metrics",
+    "learner_metrics", "batch_num_samples", "build_chrome_trace",
+]

@@ -1,0 +1,190 @@
+"""JIT compile telemetry: trace/compile accounting for jitted programs.
+
+XLA programs are shape-specialized, so a hot path that feeds a jitted
+function changing shapes/dtypes retraces (and recompiles) silently —
+the serving engine's ad-hoc ``_traces`` guard existed precisely to
+catch that. :class:`TrackedJit` generalizes it onto a shared API:
+
+    tick = tracked_jit(tick_fn, name="engine_tick", trace_budget=1,
+                       donate_argnums=(1,))
+    out = tick(params, state)           # drop-in for jax.jit(tick_fn)
+    tick.traces                         # programs traced by THIS wrapper
+
+Each new trace increments the ``jit_traces_total`` / ``jit_compiles_total``
+counters (tagged by function name), observes the first-call wall time —
+trace + lower + compile + first execute, the cost a user actually waits
+for — into the ``jit_compile_seconds`` histogram, and records a
+``jit_compile`` span so ``ray_tpu.timeline()`` shows compiles inline
+with the run. When an instance re-traces past ``trace_budget`` it warns
+ONCE with :class:`RecompileWarning` naming the function and the
+argument signature that caused the re-trace.
+
+Budgets are per-instance (a fresh engine legitimately re-traces its own
+programs); the counters aggregate per function name across instances
+and processes.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+# name -> {"traces": int, "compiles": int, "compile_seconds_total": float}
+_stats: Dict[str, Dict[str, float]] = {}
+
+_metrics = None
+
+
+class RecompileWarning(UserWarning):
+    """A tracked jitted function re-traced beyond its trace budget."""
+
+
+def _jit_metrics():
+    """Lazy module-level metric singletons (one registry entry per
+    process regardless of how many TrackedJit instances exist)."""
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        _metrics = {
+            "traces": Counter(
+                "jit_traces_total",
+                description="XLA traces of tracked jitted functions.",
+                tag_keys=("fn",)),
+            "compiles": Counter(
+                "jit_compiles_total",
+                description="XLA compiles of tracked jitted functions.",
+                tag_keys=("fn",)),
+            "compile_seconds": Histogram(
+                "jit_compile_seconds",
+                description="First-call wall time of newly traced "
+                            "programs (trace+compile+execute).",
+                boundaries=(0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 60.0,
+                            300.0),
+                tag_keys=("fn",)),
+        }
+    return _metrics
+
+
+def _arg_signature(args, kwargs) -> str:
+    """Compact human-readable shape/dtype signature for the warning."""
+    def one(a: Any) -> str:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None:
+            return f"{dtype}[{','.join(map(str, shape))}]"
+        if isinstance(a, (dict, list, tuple)):
+            return type(a).__name__
+        return f"{type(a).__name__}:{a!r}"[:40]
+
+    parts = [one(a) for a in args]
+    parts += [f"{k}={one(v)}" for k, v in kwargs.items()]
+    return "(" + ", ".join(parts) + ")"
+
+
+class TrackedJit:
+    """``jax.jit`` plus trace/compile telemetry and a recompile budget.
+
+    The wrapped python callable only runs when jax traces a new
+    program, so ``traces`` counts compiled programs exactly — the same
+    mechanism as the engine's original ``_traces`` guard.
+    """
+
+    def __init__(self, fn: Callable, *, name: Optional[str] = None,
+                 trace_budget: Optional[int] = None, **jit_kwargs):
+        import jax
+
+        self.name = name or getattr(fn, "__name__", "jitted")
+        self.traces = 0
+        if trace_budget is None:
+            from ray_tpu._private.config import GlobalConfig
+
+            trace_budget = GlobalConfig.jit_recompile_warn_budget
+        self.trace_budget = trace_budget
+        self._warned = False
+        self._fn = fn
+
+        def probe(*args, **kwargs):
+            # Runs only under tracing: count the new program here.
+            self.traces += 1
+            with _lock:
+                st = _stats.setdefault(self.name, {
+                    "traces": 0, "compiles": 0,
+                    "compile_seconds_total": 0.0})
+                st["traces"] += 1
+            return fn(*args, **kwargs)
+
+        self._jitted = jax.jit(probe, **jit_kwargs)
+
+    def __call__(self, *args, **kwargs):
+        import time
+
+        before = self.traces
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        if self.traces > before:
+            dt = time.perf_counter() - t0
+            self._on_compile(dt, args, kwargs)
+        return out
+
+    def _on_compile(self, seconds: float, args, kwargs) -> None:
+        with _lock:
+            st = _stats[self.name]
+            st["compiles"] += 1
+            st["compile_seconds_total"] += seconds
+        try:
+            m = _jit_metrics()
+            tags = {"fn": self.name}
+            m["compiles"].inc(1.0, tags=tags)
+            m["traces"].inc(1.0, tags=tags)
+            m["compile_seconds"].observe(seconds, tags=tags)
+        except Exception:
+            pass  # telemetry must never break the hot path
+        try:
+            import time
+
+            from ray_tpu.util.tracing import record_span
+
+            record_span("jit_compile", time.time() - seconds, seconds,
+                        attrs={"fn": self.name, "traces": self.traces})
+        except Exception:
+            pass
+        if (self.trace_budget and self.traces > self.trace_budget
+                and not self._warned):
+            self._warned = True
+            warnings.warn(
+                f"jitted function {self.name!r} traced {self.traces} "
+                f"programs (budget {self.trace_budget}); last re-trace "
+                f"caused by call {_arg_signature(args, kwargs)} — "
+                f"check for varying shapes/dtypes/static args on the "
+                f"hot path", RecompileWarning, stacklevel=4)
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+
+def tracked_jit(fn: Optional[Callable] = None, *,
+                name: Optional[str] = None,
+                trace_budget: Optional[int] = None,
+                **jit_kwargs):
+    """Drop-in ``jax.jit`` replacement with compile telemetry.
+
+    Usable directly (``tracked_jit(fn, donate_argnums=...)``) or as a
+    decorator (``@tracked_jit(name="step")``).
+    """
+    if fn is None:
+        def deco(f):
+            return TrackedJit(f, name=name, trace_budget=trace_budget,
+                              **jit_kwargs)
+        return deco
+    return TrackedJit(fn, name=name, trace_budget=trace_budget,
+                      **jit_kwargs)
+
+
+def jit_stats() -> Dict[str, Dict[str, float]]:
+    """Per-function aggregate {traces, compiles, compile_seconds_total}
+    for every tracked function in this process."""
+    with _lock:
+        return {k: dict(v) for k, v in _stats.items()}

@@ -1,0 +1,79 @@
+"""Serving instrumentation: the LLM engine's metric set.
+
+One process-wide singleton (engines in the same replica share the
+registry entries; counters/histograms aggregate across replicas on the
+GCS scrape side). Latency semantics follow the serving literature:
+
+- ``serve_queue_wait_seconds``: submit -> admitted into a decode slot.
+- ``serve_ttft_seconds``: submit -> first generated token.
+- ``serve_tpot_seconds``: mean per-output-token latency after the
+  first token (one observation per finished request).
+- ``serve_e2e_seconds``: submit -> finish.
+
+Gauges (exported per-process with a pid label) carry the engine's live
+state: queue depth, active slots, and batch utilization (active /
+num_slots — the fraction of the ONE compiled decode program doing real
+work; idle slots ride through the program as masked lanes).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_singleton = None
+_lock = threading.Lock()
+
+
+class ServeMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        lat = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+               10.0, 30.0, 60.0)
+        self.ttft = Histogram(
+            "serve_ttft_seconds", boundaries=lat,
+            description="Time to first token (submit -> first token).")
+        self.tpot = Histogram(
+            "serve_tpot_seconds",
+            boundaries=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                        0.25, 0.5, 1.0),
+            description="Mean per-output-token latency after the first "
+                        "token, one observation per request.")
+        self.e2e = Histogram(
+            "serve_e2e_seconds", boundaries=lat,
+            description="Request end-to-end latency (submit -> finish).")
+        self.queue_wait = Histogram(
+            "serve_queue_wait_seconds", boundaries=lat,
+            description="Submit -> admission into a decode slot.")
+        self.queue_depth = Gauge(
+            "serve_queue_depth",
+            description="Requests waiting for a decode slot.")
+        self.active_slots = Gauge(
+            "serve_active_slots",
+            description="Decode slots with a live request.")
+        self.batch_utilization = Gauge(
+            "serve_batch_utilization",
+            description="active_slots / num_slots of the compiled "
+                        "decode program.")
+        self.tokens = Counter(
+            "serve_tokens_total",
+            description="Generated tokens emitted to requests.")
+        self.requests = Counter(
+            "serve_requests_total", tag_keys=("finish_reason",),
+            description="Finished requests by finish reason.")
+        self.slot_reuses = Counter(
+            "serve_slot_reuses_total",
+            description="Decode-slot recycles (continuous batching at "
+                        "work).")
+        self.request_timeouts = Counter(
+            "serve_request_timeouts_total",
+            description="Server-side waits that gave up before the "
+                        "engine finished the request.")
+
+
+def serve_metrics() -> ServeMetrics:
+    global _singleton
+    with _lock:
+        if _singleton is None:
+            _singleton = ServeMetrics()
+        return _singleton

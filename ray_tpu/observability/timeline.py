@@ -1,0 +1,64 @@
+"""Chrome-trace builder over GCS task events.
+
+The one rendering of the head's task-event ring buffer, shared by
+``ray_tpu.timeline()`` (driver API) and the dashboard's
+``GET /api/timeline`` (download endpoint). Output loads in
+chrome://tracing / Perfetto:
+
+- ``cat:"task"``     one complete (``ph:"X"``) event per task
+  execution, RUNNING -> FINISHED/FAILED, rowed by worker address.
+- ``cat:"submit"``   the submission->execution flow arrow
+  (PENDING -> RUNNING), rowed by submitting driver/worker pid.
+- ``cat:"span"``     user spans from ``ray_tpu.util.tracing`` —
+  including the telemetry plane's ``jit_compile`` and per-request
+  ``llm.*`` lifecycle spans.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+
+def build_chrome_trace(events: List[Dict]) -> List[Dict]:
+    by_task: Dict[bytes, Dict[str, Dict]] = {}
+    spans: List[Dict] = []
+    for e in events:
+        if e["state"] == "SPAN":
+            spans.append(e)
+            continue
+        by_task.setdefault(e["task_id"], {})[e["state"]] = e
+    trace: List[Dict] = []
+    for tid, states in by_task.items():
+        run, end = states.get("RUNNING"), (
+            states.get("FINISHED") or states.get("FAILED"))
+        if not run:
+            continue
+        worker = ":".join(map(str, run.get("worker_addr", ["?"])))
+        end_ts = end["ts"] if end else time.time()
+        trace.append({
+            "name": run["name"], "cat": "task", "ph": "X",
+            "ts": run["ts"] * 1e6, "dur": max(end_ts - run["ts"], 0) * 1e6,
+            "pid": worker, "tid": worker,
+            "args": {"task_id": tid.hex(),
+                     "state": end["state"] if end else "RUNNING"},
+        })
+        sub = states.get("PENDING")
+        if sub:  # flow arrow: submission -> execution
+            trace.append({
+                "name": run["name"], "cat": "submit", "ph": "X",
+                "ts": sub["ts"] * 1e6,
+                "dur": max(run["ts"] - sub["ts"], 0) * 1e6,
+                "pid": f"driver-{sub.get('owner_pid', '?')}",
+                "tid": f"driver-{sub.get('owner_pid', '?')}",
+                "args": {"task_id": tid.hex()},
+            })
+    for e in spans:  # user spans from ray_tpu.util.tracing
+        trace.append({
+            "name": e["name"], "cat": "span", "ph": "X",
+            "ts": e["ts"] * 1e6, "dur": e.get("dur", 0) * 1e6,
+            "pid": f"spans-{e.get('owner_pid', '?')}",
+            "tid": e["task_id"].hex()[:12],
+            "args": e.get("attrs", {}),
+        })
+    return trace

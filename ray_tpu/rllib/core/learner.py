@@ -108,13 +108,24 @@ class Learner:
         the same global step; `make_array_from_process_local_data` assembles
         the global sharded array and the psum rides the mesh.
         """
+        import time
+
+        from ray_tpu.observability import batch_num_samples, learner_metrics
+        from ray_tpu.util.tracing import span
+
+        lm = learner_metrics()
+        t0 = time.perf_counter()
         # tree.map so nested multi-agent batches ({module_id: {k: v}})
         # shard leaf-wise exactly like flat single-agent ones.
-        global_batch = jax.tree.map(
-            lambda v: jax.make_array_from_process_local_data(
-                self._data_sh, np.asarray(v)), batch)
-        self._state, metrics = self._update_fn(
-            self._state, global_batch, jax.random.key(rng_seed))
+        with span("learner.update"):
+            global_batch = jax.tree.map(
+                lambda v: jax.make_array_from_process_local_data(
+                    self._data_sh, np.asarray(v)), batch)
+            self._state, metrics = self._update_fn(
+                self._state, global_batch, jax.random.key(rng_seed))
+        lm.update_seconds.observe(time.perf_counter() - t0)
+        lm.updates.inc()
+        lm.samples.inc(batch_num_samples(batch))
         out: Dict[str, Any] = {}
         for k, v in metrics.items():
             if np.ndim(v) == 0:
@@ -127,6 +138,8 @@ class Learner:
                     out[k] = np.asarray(v)
                 except Exception:
                     pass
+        if isinstance(out.get("total_loss"), float):
+            lm.loss.set(out["total_loss"])
         return out
 
     # ---------------------------------------------------------------- weights

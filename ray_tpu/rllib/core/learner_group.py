@@ -79,12 +79,22 @@ class LearnerGroup:
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """One lockstep SPMD gradient step: the global batch is split evenly;
         each learner feeds its process-local shard into the shared mesh."""
+        import time
+
+        from ray_tpu.observability import learner_metrics
+        from ray_tpu.util.tracing import span
+
         n = self.num_learners
         self._step += 1
-        shards = _split_batch(batch, n)
-        refs = [w.execute.remote(_learner_update, shards[i], self._step)
-                for i, w in enumerate(self._group.workers)]
-        metrics = ray_tpu.get(refs, timeout=600)
+        t0 = time.perf_counter()
+        with span("learner_group.update",
+                  attrs={"learners": n, "step": self._step}):
+            shards = _split_batch(batch, n)
+            refs = [w.execute.remote(_learner_update, shards[i], self._step)
+                    for i, w in enumerate(self._group.workers)]
+            metrics = ray_tpu.get(refs, timeout=600)
+        learner_metrics().group_update_seconds.observe(
+            time.perf_counter() - t0)
         return metrics[0]
 
     def foreach_learner(self, method: str, *args, **kwargs) -> List[Any]:

@@ -67,15 +67,23 @@ class LLMServer:
         "temperature": float, "stop": [token ids]} -> completed tokens
         plus latency detail. Blocks the calling Serve thread; the engine
         thread interleaves all concurrent requests."""
+        from ray_tpu.observability import serve_metrics
         from ray_tpu.serve.llm.engine import Request
+        from ray_tpu.util.tracing import span
 
         handle = self._engine.submit(Request(
             prompt=list(request["prompt"]),
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             stop=tuple(request.get("stop", ()))))
-        tokens = handle.result(timeout=float(
-            request.get("timeout_s", 300.0)))
+        with span("llm.server_call",
+                  attrs={"prompt_len": len(request["prompt"])}):
+            try:
+                tokens = handle.result(timeout=float(
+                    request.get("timeout_s", 300.0)))
+            except TimeoutError:
+                serve_metrics().request_timeouts.inc()
+                raise
         return {
             "tokens": tokens,
             "num_tokens": len(tokens),
@@ -85,7 +93,12 @@ class LLMServer:
         }
 
     def stats(self) -> Dict[str, Any]:
-        return self._engine.stats()
+        from ray_tpu.observability import jit_stats
+
+        out = self._engine.stats()
+        out["jit"] = {k: v for k, v in jit_stats().items()
+                      if k.startswith("llm_engine_")}
+        return out
 
     def check_health(self) -> None:
         if not self._thread.is_alive():

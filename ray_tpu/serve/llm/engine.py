@@ -102,8 +102,13 @@ class RequestHandle:
         self.request = request
         self.tokens: List[int] = []
         self.submitted_at = time.monotonic()
+        self.admitted_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # Wall-clock mirror of submitted_at: lifecycle spans need
+        # epoch timestamps (timeline rows), latency math stays
+        # monotonic.
+        self.submitted_wall = time.time()
         self.finish_reason: Optional[str] = None   # "eos"|"stop"|"length"
         self._done = threading.Event()
 
@@ -186,14 +191,23 @@ class LLMEngine:
         self._completed = 0
         self._slot_reuses = 0
 
-        # Trace counters: the bodies below run ONLY when jax traces a new
-        # program, so these count compiled engine programs — the
-        # compile-guard test asserts trace_count <= n_buckets + 1.
-        self._traces = {"tick": 0, "insert": 0}
+        # Compile tracking through the shared telemetry plane: the
+        # TrackedJit probe runs ONLY when jax traces a new program, so
+        # .traces counts compiled engine programs — the compile-guard
+        # test asserts trace_count <= n_buckets + 1, and the recompile
+        # detector warns if either program family exceeds its budget
+        # (ONE tick, one insert per prefill bucket).
+        from ray_tpu.observability import serve_metrics, tracked_jit
+        from ray_tpu.observability.device import ensure_sampler_registered
 
-        self._jit_tick = jax.jit(self._tick_fn, donate_argnums=(1, 2, 3))
-        self._jit_insert = jax.jit(self._insert_fn,
-                                   donate_argnums=(1, 2, 3))
+        self._jit_tick = tracked_jit(
+            self._tick_fn, name="llm_engine_tick", trace_budget=1,
+            donate_argnums=(1, 2, 3))
+        self._jit_insert = tracked_jit(
+            self._insert_fn, name="llm_engine_insert",
+            trace_budget=len(c.prefill_buckets), donate_argnums=(1, 2, 3))
+        self._metrics = serve_metrics()
+        ensure_sampler_registered()
 
     # ------------------------------------------------------------ programs
 
@@ -209,7 +223,6 @@ class LLMEngine:
 
         from ray_tpu.models.llama import decode_step
 
-        self._traces["tick"] += 1
         S = self.config.max_seq_len
 
         def body(carry, _):
@@ -239,7 +252,6 @@ class LLMEngine:
 
         from ray_tpu.models.llama import lm_head_weight, prefill_kv
 
-        self._traces["insert"] += 1
         c = self.model_config
         hidden, ks, vs = prefill_kv(params, padded_prompt[None], c)
         # ks/vs: [L, 1, Pb, n_kv, hd] -> rows [0, Pb) of this slot.
@@ -302,6 +314,9 @@ class LLMEngine:
                 handle = self._queue.popleft()
             slot = self._free.popleft()
             req = handle.request
+            handle.admitted_at = time.monotonic()
+            self._metrics.queue_wait.observe(
+                handle.admitted_at - handle.submitted_at)
             P = len(req.prompt)
             bucket = self._bucket_for(P)
             padded = np.zeros((bucket,), np.int32)
@@ -314,6 +329,7 @@ class LLMEngine:
             st = self._slots[slot]
             if st.uses:
                 self._slot_reuses += 1
+                self._metrics.slot_reuses.inc()
             st.uses += 1
             st.handle = handle
             self._active[slot] = True
@@ -359,7 +375,48 @@ class LLMEngine:
             self._temp[slot] = 0.0
             self._free.append(slot)
             self._completed += 1
+            self._record_finished(handle)
             handle._done.set()
+
+    def _record_finished(self, handle: RequestHandle) -> None:
+        """Latency histograms + per-request lifecycle spans
+        (queued -> prefill -> decode) so `/metrics` and
+        `ray_tpu.timeline()` both render a serve run end-to-end."""
+        m = self._metrics
+        e2e = handle.finished_at - handle.submitted_at
+        m.e2e.observe(e2e)
+        if handle.ttft_s is not None:
+            m.ttft.observe(handle.ttft_s)
+        if handle.tpot_s is not None:
+            m.tpot.observe(handle.tpot_s)
+        m.tokens.inc(float(len(handle.tokens)))
+        m.requests.inc(tags={"finish_reason": handle.finish_reason})
+        try:
+            from ray_tpu.util.tracing import record_span
+
+            # Monotonic offsets re-anchored on the wall-clock submit
+            # time so span rows line up with task events.
+            wall0 = handle.submitted_wall
+            rid = handle.request_id
+            admit = handle.admitted_at or handle.finished_at
+            record_span("llm.queued", wall0,
+                        admit - handle.submitted_at, attrs={"rid": rid})
+            if handle.first_token_at is not None:
+                record_span(
+                    "llm.prefill",
+                    wall0 + (admit - handle.submitted_at),
+                    handle.first_token_at - admit, attrs={"rid": rid})
+                record_span(
+                    "llm.decode",
+                    wall0 + (handle.first_token_at - handle.submitted_at),
+                    handle.finished_at - handle.first_token_at,
+                    attrs={"rid": rid,
+                           "tokens": len(handle.tokens)})
+            record_span("llm.request", wall0, e2e, attrs={
+                "rid": rid, "tokens": len(handle.tokens),
+                "finish_reason": handle.finish_reason})
+        except Exception:
+            pass  # telemetry must never break the scheduler
 
     def step(self) -> bool:
         """One scheduler iteration: admit queued requests into free
@@ -375,6 +432,7 @@ class LLMEngine:
             for slot in inserted:
                 self._emit(slot, int(tok_host[slot]))
         if not self._active.any():
+            self._update_gauges()
             return bool(inserted)
         live = np.nonzero(self._active)[0]
         self._cache, self._tok, self._pos, self._key, toks = \
@@ -389,7 +447,15 @@ class LLMEngine:
                     break          # finished earlier in the block —
                     #                remaining tokens were speculative
                 self._emit(s, int(toks_host[k, s]))
+        self._update_gauges()
         return True
+
+    def _update_gauges(self) -> None:
+        m = self._metrics
+        active = int(self._active.sum())
+        m.queue_depth.set(float(len(self._queue)))
+        m.active_slots.set(float(active))
+        m.batch_utilization.set(active / self.config.num_slots)
 
     def run(self, stop_event: threading.Event,
             idle_wait_s: float = 0.02) -> None:
@@ -415,7 +481,7 @@ class LLMEngine:
     def trace_count(self) -> int:
         """Number of engine XLA programs traced so far (compile guard:
         must stay <= len(prefill_buckets) + 1 under any workload)."""
-        return self._traces["tick"] + self._traces["insert"]
+        return self._jit_tick.traces + self._jit_insert.traces
 
     def stats(self) -> Dict[str, Any]:
         return {
@@ -424,7 +490,8 @@ class LLMEngine:
             "queued": len(self._queue),
             "completed": self._completed,
             "slot_reuses": self._slot_reuses,
-            "traces": dict(self._traces),
+            "traces": {"tick": self._jit_tick.traces,
+                       "insert": self._jit_insert.traces},
             "trace_count": self.trace_count,
         }
 

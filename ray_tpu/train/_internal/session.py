@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 import traceback
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
@@ -82,6 +83,7 @@ class _TrainSession:
         self._config = config
         self._thread: Optional[threading.Thread] = None
         self._report_counter = 0
+        self._last_report_ts: Optional[float] = None
 
     def start(self):
         def _run():
@@ -113,22 +115,56 @@ class _TrainSession:
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
+        self._record_telemetry(metrics)
         ckpt_path = None
         if checkpoint is not None:
             # Name by a session-side monotonic counter, never user metrics:
             # duplicate names would alias directories and break driver-side
             # top-k retention (reference names checkpoints driver-side with
             # a monotonic index for the same reason).
-            persisted = checkpoint.persist(
-                self.context.storage_dir,
-                name=f"checkpoint_{self._report_counter:06d}"
-                     f"_rank{self.context.world_rank}")
+            from ray_tpu.util.tracing import span
+
+            with span("train.checkpoint_persist",
+                      attrs={"rank": self.context.world_rank}):
+                persisted = checkpoint.persist(
+                    self.context.storage_dir,
+                    name=f"checkpoint_{self._report_counter:06d}"
+                         f"_rank{self.context.world_rank}")
             self._report_counter += 1
             self.latest_checkpoint = persisted
             ckpt_path = persisted.path
         # Blocks when the driver falls behind (backpressure, reference
         # bounded-queue behavior).
         self._result_queue.put((REPORT, metrics, ckpt_path))
+
+    def _record_telemetry(self, metrics: Dict[str, Any]) -> None:
+        """One training step per report(): step duration is the wall
+        time since the previous report, loss/throughput are lifted from
+        the user's metrics dict when recognizably named."""
+        try:
+            from ray_tpu.observability import train_metrics
+
+            tm = train_metrics()
+            now = time.monotonic()
+            tm.reports.inc()
+            if self._last_report_ts is not None:
+                step_s = now - self._last_report_ts
+                tm.step_seconds.observe(step_s)
+            else:
+                step_s = None
+            self._last_report_ts = now
+            if isinstance(metrics, dict):
+                for key in ("loss", "total_loss", "train_loss"):
+                    if isinstance(metrics.get(key), (int, float)):
+                        tm.loss.set(float(metrics[key]))
+                        break
+                for key in ("num_samples", "samples", "batch_size"):
+                    n = metrics.get(key)
+                    if isinstance(n, (int, float)) and step_s:
+                        tm.samples_per_sec.set(float(n) / step_s)
+                        break
+        except Exception:
+            pass  # telemetry must never fail a training step
 
     def next_result(self, timeout: Optional[float] = None):
         try:

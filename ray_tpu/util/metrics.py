@@ -174,9 +174,31 @@ class Histogram(Metric):
 
 # --------------------------------------------------------------------- flush
 
+_flush_samplers: List = []
+
+
+def register_flush_sampler(fn) -> None:
+    """Register a callable invoked right before every metrics flush —
+    the hook for sampled gauges (device HBM, engine queue depth) that
+    must be fresh at export time without their own timer threads."""
+    with _registry_lock:
+        if fn not in _flush_samplers:
+            _flush_samplers.append(fn)
+    _ensure_flusher()
+
+
+def _run_samplers() -> None:
+    for fn in list(_flush_samplers):
+        try:
+            fn()
+        except Exception:
+            pass  # a broken sampler must not stop the flush
+
+
 def snapshot_records() -> List[Dict[str, object]]:
     """Serializable snapshots of every registered metric (for async push
     paths that cannot use the sync GCS client, e.g. worker kill)."""
+    _run_samplers()
     with _registry_lock:
         return [m._snapshot() for m in _registry.values()]
 
@@ -188,6 +210,7 @@ def _flush_once() -> bool:
     w = global_worker_or_none()
     if w is None or getattr(w, "_dead", False):
         return False
+    _run_samplers()
     with _registry_lock:
         snaps = [m._snapshot() for m in _registry.values()]
     if not snaps:

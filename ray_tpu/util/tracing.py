@@ -30,30 +30,44 @@ from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional
 
 
-@contextmanager
-def span(name: str, attrs: Optional[Dict[str, Any]] = None) -> Iterator[None]:
-    """Record a named span inside the current task/driver."""
+def record_span(name: str, start: float, dur: float,
+                attrs: Optional[Dict[str, Any]] = None) -> None:
+    """Record a span with explicit wall-clock start/duration — for
+    callers that reconstruct lifecycle phases after the fact (the LLM
+    engine's queued/prefill/decode phases, jit-compile events)."""
     from ray_tpu._private.worker import global_worker_or_none
 
+    w = global_worker_or_none()
+    # Thin-client drivers (ray_tpu://) have no local event buffer;
+    # spans there are a no-op rather than an AttributeError.
+    if (w is not None and not getattr(w, "_dead", False)
+            and hasattr(w, "_task_events_lock")):
+        tid = w.current_task_id()
+        event = {
+            "task_id": tid.binary() if tid else b"driver",
+            "name": name, "job_id": b"", "state": "SPAN",
+            "ts": start, "dur": dur,
+            "owner_pid": __import__("os").getpid(),
+            "attrs": attrs or {},
+        }
+        with w._task_events_lock:
+            w._task_events.append(event)
+
+
+@contextmanager
+def span(name: str, attrs: Optional[Dict[str, Any]] = None) -> Iterator[None]:
+    """Record a named span inside the current task/driver. A raising
+    body still records the span, tagged ``attrs["error"]`` with the
+    exception type so timelines distinguish failures from successes."""
     start = time.time()
+    attrs = dict(attrs) if attrs else {}
     try:
         yield
+    except BaseException as e:
+        attrs["error"] = type(e).__name__
+        raise
     finally:
-        w = global_worker_or_none()
-        # Thin-client drivers (ray_tpu://) have no local event buffer;
-        # spans there are a no-op rather than an AttributeError.
-        if (w is not None and not getattr(w, "_dead", False)
-                and hasattr(w, "_task_events_lock")):
-            tid = w.current_task_id()
-            event = {
-                "task_id": tid.binary() if tid else b"driver",
-                "name": name, "job_id": b"", "state": "SPAN",
-                "ts": start, "dur": time.time() - start,
-                "owner_pid": __import__("os").getpid(),
-                "attrs": attrs or {},
-            }
-            with w._task_events_lock:
-                w._task_events.append(event)
+        record_span(name, start, time.time() - start, attrs)
 
 
 def span_tree() -> List[Dict[str, Any]]:

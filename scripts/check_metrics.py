@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Static lint of metric declarations (CI gate, also run as a unit test).
+
+Walks the package AST for every ``Counter(...)`` / ``Gauge(...)`` /
+``Histogram(...)`` call whose binding provably comes from
+``ray_tpu.util.metrics`` (import-provenance filtering keeps e.g.
+``collections.Counter`` out) and enforces the registry contract the
+runtime can only check per-process:
+
+- names are snake_case identifiers that export cleanly with the
+  ``rtpu_`` prefix (``^[a-z][a-z0-9_]*$``, no double prefix);
+- a name declared in two places must agree on metric type, tag_keys
+  and (histograms) boundaries — the runtime raises on such collisions,
+  but only when both declarations happen to run in one process, so the
+  lint catches what tests might never co-execute.
+
+Usage: ``python scripts/check_metrics.py [root]`` — exits nonzero and
+prints one line per violation. ``check_paths()`` is the library entry
+point used by tests/test_observability.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_METRIC_CLASSES = ("Counter", "Gauge", "Histogram")
+_METRICS_MODULE = "ray_tpu.util.metrics"
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _metric_bindings(tree: ast.Module) -> Dict[str, str]:
+    """local name -> metric class, for names imported from the metrics
+    module (``from ray_tpu.util.metrics import Counter [as C]``)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and \
+                node.module == _METRICS_MODULE:
+            for alias in node.names:
+                if alias.name in _METRIC_CLASSES:
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _module_aliases(tree: ast.Module) -> List[str]:
+    """Names the metrics *module* is bound to (``import
+    ray_tpu.util.metrics [as m]`` / ``from ray_tpu.util import
+    metrics``) — calls like ``m.Counter(...)`` count too."""
+    out: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == _METRICS_MODULE:
+                    out.append(alias.asname or "ray_tpu")
+        elif isinstance(node, ast.ImportFrom) and \
+                node.module == "ray_tpu.util":
+            for alias in node.names:
+                if alias.name == "metrics":
+                    out.append(alias.asname or "metrics")
+    return out
+
+
+def _call_metric_class(call: ast.Call, bindings: Dict[str, str],
+                       mod_aliases: List[str]) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return bindings.get(f.id)
+    if isinstance(f, ast.Attribute) and f.attr in _METRIC_CLASSES:
+        # metrics.Counter(...) / ray_tpu.util.metrics.Counter(...)
+        base = f.value
+        if isinstance(base, ast.Name) and base.id in mod_aliases:
+            return f.attr
+        if (isinstance(base, ast.Attribute)
+                and ast.unparse(base).endswith("util.metrics")):
+            return f.attr
+    return None
+
+
+def _literal(node: Optional[ast.expr]) -> Any:
+    """Literal value or None for dynamic expressions (dynamic names are
+    reported as unlintable rather than guessed at)."""
+    if node is None:
+        return None
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _collect_file(path: str) -> Tuple[List[Dict[str, Any]], List[str]]:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    bindings = _metric_bindings(tree)
+    mod_aliases = _module_aliases(tree)
+    decls: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cls = _call_metric_class(node, bindings, mod_aliases)
+        if cls is None:
+            continue
+        where = f"{path}:{node.lineno}"
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        name_node = node.args[0] if node.args else kw.get("name")
+        name = _literal(name_node)
+        if not isinstance(name, str):
+            problems.append(f"{where}: {cls} name is not a string "
+                            f"literal — cannot lint")
+            continue
+        decls.append({
+            "where": where, "class": cls, "name": name,
+            "tag_keys": _literal(kw.get("tag_keys")),
+            "boundaries": _literal(kw.get("boundaries")),
+        })
+    return decls, problems
+
+
+def check_paths(root: str) -> List[str]:
+    """Lint every .py under ``root``; returns violation strings."""
+    decls: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                d, p = _collect_file(os.path.join(dirpath, fn))
+                decls.extend(d)
+                problems.extend(p)
+
+    for d in decls:
+        name = d["name"]
+        if not _NAME_RE.match(name):
+            problems.append(
+                f"{d['where']}: metric name {name!r} is not snake_case "
+                f"([a-z][a-z0-9_]*) — it would export badly as "
+                f"rtpu_{name}")
+        if name.startswith("rtpu_"):
+            problems.append(
+                f"{d['where']}: metric name {name!r} already carries the "
+                f"rtpu_ prefix; the exporter adds it (would become "
+                f"rtpu_rtpu_...)")
+
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for d in decls:
+        by_name.setdefault(d["name"], []).append(d)
+    for name, group in sorted(by_name.items()):
+        first = group[0]
+        for other in group[1:]:
+            for field in ("class", "tag_keys", "boundaries"):
+                a = first.get(field)
+                b = other.get(field)
+                if _norm(a) != _norm(b):
+                    problems.append(
+                        f"{other['where']}: metric {name!r} redeclared "
+                        f"with different {field} ({_norm(b)!r}) than "
+                        f"{first['where']} ({_norm(a)!r}) — the runtime "
+                        f"registry raises on this collision")
+    return problems
+
+
+def _norm(v: Any) -> Any:
+    return tuple(v) if isinstance(v, (list, tuple)) else v
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ray_tpu")
+    problems = check_paths(root)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"check_metrics: {len(problems)} problem(s)",
+              file=sys.stderr)
+        return 1
+    print("check_metrics: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -270,8 +270,12 @@ def test_timeline_and_span_tree(ray_start_regular):
     global_worker().flush_task_events()
     # Worker-side events (the leaf tasks + spans) flush on a 2s cadence.
     def _all_arrived():
-        names = {e["name"] for e in ray_tpu.timeline()}
-        return {"obs_parent", "leaf-work"} <= names
+        events = ray_tpu.timeline()
+        names = {e["name"] for e in events}
+        # Both leaf workers must have flushed their span buffers, not
+        # just one — the span-tree assertions below inspect each leaf.
+        n_spans = sum(1 for e in events if e["name"] == "leaf-work")
+        return "obs_parent" in names and n_spans >= 2
 
     assert _wait_for(_all_arrived, timeout=15), \
         {e["name"] for e in ray_tpu.timeline()}
@@ -289,6 +293,9 @@ def test_timeline_and_span_tree(ray_start_regular):
     assert "leaf-work" in names            # user span surfaced
     complete = [e for e in trace if e["cat"] == "task"]
     assert all(e["ph"] == "X" and e["dur"] >= 0 for e in complete)
+    # All three chrome-trace event families render: task executions,
+    # submit flow arrows, and user spans.
+    assert {"task", "submit", "span"} <= {e["cat"] for e in trace}
 
     roots = tracing.span_tree()
     # The driver-submitted parent task has the two leaves as children.
@@ -306,3 +313,237 @@ def test_timeline_and_span_tree(ray_start_regular):
     assert len([c for c in pnode["children"] if c["name"] == "leaf"]) == 2
     leaf_node = find(pnode["children"], "leaf")
     assert any(s["name"] == "leaf-work" for s in leaf_node["spans"])
+
+
+# ------------------------------------------------------- telemetry plane
+
+def _tiny_engine(buckets=(8,), slots=2, S=32):
+    import jax
+
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine
+
+    config = LlamaConfig.tiny()
+    params = init_params(config, jax.random.key(0))
+    return config, LLMEngine(params, config, EngineConfig(
+        num_slots=slots, max_seq_len=S, prefill_buckets=buckets))
+
+
+def test_tracked_jit_counts_and_warns():
+    """TrackedJit counts traced programs exactly (probe runs only under
+    tracing) and warns ONCE past the trace budget."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from ray_tpu.observability import (
+        RecompileWarning, jit_stats, tracked_jit)
+
+    @tracked_jit(name="obs_tracked_fn", trace_budget=1)
+    def f(x):
+        return x * 2
+
+    assert float(f(jnp.ones((4,))).sum()) == 8.0
+    f(jnp.ones((4,)))                    # cache hit: no new trace
+    assert f.traces == 1
+    with pytest.warns(RecompileWarning, match="obs_tracked_fn"):
+        f(jnp.ones((8,)))                # new shape -> re-trace > budget
+    assert f.traces == 2
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")   # warned once, never again
+        f(jnp.ones((16,)))
+    assert f.traces == 3
+    st = jit_stats()["obs_tracked_fn"]
+    assert st["traces"] >= 3 and st["compiles"] >= 3
+    assert st["compile_seconds_total"] > 0
+
+
+def test_engine_recompile_detector_fires():
+    """Deliberately violating the engine's prefill bucket guard (a pad
+    length that is not a configured bucket) re-traces the insert program
+    past its budget and fires the detector."""
+    import numpy as np
+
+    from ray_tpu.observability import RecompileWarning
+
+    _, engine = _tiny_engine(buckets=(8,))   # insert budget == 1
+    from ray_tpu.serve.llm.engine import Request
+
+    h = engine.submit(Request(prompt=[1, 2, 3], max_tokens=2))
+    engine.drain()
+    assert h.finish_reason == "length"
+    assert engine._jit_insert.traces == 1
+    with pytest.warns(RecompileWarning, match="llm_engine_insert"):
+        engine._cache, engine._tok, engine._pos, engine._key = \
+            engine._jit_insert(
+                engine.params, engine._cache, engine._tok, engine._pos,
+                np.zeros((12,), np.int32), np.int32(3), np.int32(0),
+                np.float32(0.0), engine._key)
+    assert engine._jit_insert.traces == 2
+
+
+def test_serve_telemetry_end_to_end(ray_start_regular):
+    """Acceptance: a short serve run exports the serving histograms and
+    jit counters on /metrics, and the timeline carries per-request
+    lifecycle spans plus jit-compile spans."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.serve.llm.engine import Request
+    from ray_tpu.util import metrics
+
+    config, engine = _tiny_engine(buckets=(8,))
+    rng = np.random.RandomState(7)
+    handles = [engine.submit(Request(
+        prompt=rng.randint(0, config.vocab_size, 5).tolist(),
+        max_tokens=4)) for _ in range(3)]
+    engine.drain()
+    assert all(h.finish_reason == "length" for h in handles)
+    st = engine.stats()
+    assert st["trace_count"] == (st["traces"]["tick"]
+                                 + st["traces"]["insert"])
+
+    assert metrics.flush()
+    w = global_worker()
+    text = w.gcs.call("metrics_text", timeout=30)
+    assert "rtpu_serve_ttft_seconds_bucket" in text
+    assert "rtpu_serve_ttft_seconds_sum" in text
+    assert "rtpu_serve_ttft_seconds_count" in text
+    assert "rtpu_serve_e2e_seconds_bucket" in text
+    assert 'rtpu_serve_requests_total{finish_reason="length"}' in text
+    assert "rtpu_serve_tokens_total" in text
+    assert 'rtpu_jit_compiles_total{fn="llm_engine_tick"}' in text
+    assert 'rtpu_jit_compiles_total{fn="llm_engine_insert"}' in text
+    assert "rtpu_jit_compile_seconds_bucket" in text
+    # Gauges export per-process with a pid label.
+    assert 'rtpu_serve_queue_depth{pid="' in text
+    assert 'rtpu_serve_batch_utilization{pid="' in text
+
+    w.flush_task_events()
+
+    def _spans_arrived():
+        names = {e["name"] for e in ray_tpu.timeline()}
+        return {"llm.request", "jit_compile"} <= names
+
+    assert _wait_for(_spans_arrived, timeout=15), \
+        {e["name"] for e in ray_tpu.timeline()}
+    trace = ray_tpu.timeline()
+    req_spans = [e for e in trace if e["name"] == "llm.request"]
+    assert len(req_spans) >= 3
+    assert all(e["cat"] == "span" for e in req_spans)
+    assert all(e["args"].get("finish_reason") == "length"
+               for e in req_spans)
+    names = {e["name"] for e in trace}
+    assert {"llm.queued", "llm.prefill", "llm.decode"} <= names
+
+
+def test_span_error_tagging(ray_start_regular):
+    """A raising span body still records the span, tagged with the
+    exception type."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util import tracing
+
+    with pytest.raises(ValueError):
+        with tracing.span("obs-err-span", attrs={"k": "v"}):
+            raise ValueError("boom")
+    global_worker().flush_task_events()
+
+    def _arrived():
+        return any(e["name"] == "obs-err-span"
+                   for e in ray_tpu.timeline())
+
+    assert _wait_for(_arrived, timeout=15)
+    ev = [e for e in ray_tpu.timeline() if e["name"] == "obs-err-span"][0]
+    assert ev["args"]["error"] == "ValueError"
+    assert ev["args"]["k"] == "v"            # user attrs preserved
+
+
+def test_device_sampler_units():
+    """Device HBM/count gauges sample only already-live jax backends."""
+    import jax
+
+    from ray_tpu.observability.device import sample_device_metrics
+
+    jax.devices()                            # force backend init (cpu)
+    assert sample_device_metrics() >= 1
+    from ray_tpu.util.metrics import _registry
+    assert "device_count" in _registry
+
+
+def test_gcs_metric_tombstones():
+    """Expired sources' counters/histograms fold into the tombstone
+    accumulator (totals never go backwards on worker exit); their
+    gauges are pruned."""
+    import asyncio
+
+    from ray_tpu._private.gcs_server import GcsServer
+
+    gcs = GcsServer()                        # no socket until start()
+    recs = [
+        {"name": "tomb_requests", "type": "counter", "description": "",
+         "tag_keys": (), "default_tags": {}, "data": {"": 5.0}},
+        {"name": "tomb_depth", "type": "gauge", "description": "",
+         "tag_keys": (), "default_tags": {}, "data": {"": 7.0}},
+        {"name": "tomb_lat", "type": "histogram", "description": "",
+         "tag_keys": (), "boundaries": (1.0,), "default_tags": {},
+         "data": {"": [2.0, 3.0, 4.5, 3.0]}},
+    ]
+    asyncio.run(gcs._h_push_metrics("111@aa", recs))
+    live = "\n".join(gcs._render_user_metrics())
+    assert "rtpu_tomb_requests 5.0" in live
+    assert 'rtpu_tomb_depth{pid="111@aa"} 7.0' in live
+
+    # Expire the source, then a fresh worker pushes its own counts.
+    ts, r = gcs.user_metrics["111@aa"]
+    gcs.user_metrics["111@aa"] = (ts - 1e6, r)
+    asyncio.run(gcs._h_push_metrics("222@bb", [
+        {"name": "tomb_requests", "type": "counter", "description": "",
+         "tag_keys": (), "default_tags": {}, "data": {"": 2.0}}]))
+    text = "\n".join(gcs._render_user_metrics())
+    assert "rtpu_tomb_requests 7.0" in text   # 5 retained + 2 live
+    assert "tomb_depth" not in text           # gauge pruned with source
+    assert "rtpu_tomb_lat_count 3.0" in text  # histogram retained
+    # Idempotent: tombstones never double-fold across renders.
+    text2 = "\n".join(gcs._render_user_metrics())
+    assert "rtpu_tomb_requests 7.0" in text2
+
+    summary = asyncio.run(gcs._h_user_metrics_summary(
+        prefixes=["tomb_"]))
+    assert summary["tomb_requests"]["data"][""] == 7.0
+    assert summary["tomb_lat"]["data"][""]["count"] == 3.0
+
+
+def test_check_metrics_lint(tmp_path):
+    """The AST metric lint: the shipped package passes clean; bad names
+    and conflicting redeclarations are flagged; import provenance keeps
+    non-metric Counter classes out."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics",
+        os.path.join(_repo_root(), "scripts", "check_metrics.py"))
+    cm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cm)
+
+    assert cm.check_paths(os.path.join(_repo_root(), "ray_tpu")) == []
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from ray_tpu.util.metrics import Counter, Histogram\n"
+        "from collections import Counter as CC\n"
+        "c1 = Counter('BadName')\n"
+        "c2 = Counter('rtpu_double')\n"
+        "h1 = Histogram('dup_hist', boundaries=[1.0])\n"
+        "h2 = Histogram('dup_hist', boundaries=[2.0])\n"
+        "ok = CC()\n"
+        "d = Counter('dup2', tag_keys=('a',))\n"
+        "e = Counter('dup2')\n")
+    problems = cm.check_paths(str(tmp_path))
+    joined = "\n".join(problems)
+    assert "BadName" in joined
+    assert "rtpu_double" in joined
+    assert "dup_hist" in joined and "boundaries" in joined
+    assert "dup2" in joined and "tag_keys" in joined
+    assert "CC" not in joined                # provenance-filtered
