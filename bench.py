@@ -366,10 +366,13 @@ def main() -> None:
     kind = _wait_for_backend()
     if kind is None:
         # Emit a parseable failure record (so the round's bench artifact
-        # carries the diagnosis, not just an rc), then fail.
+        # carries the diagnosis — rc + machine-readable reason — instead
+        # of a bare nonzero exit that loses the round silently), then
+        # fail with the same rc.
         print(json.dumps({
             "metric": "llama_train_tokens_per_sec_per_chip",
             "value": None, "unit": "tokens/s", "vs_baseline": None,
+            "rc": 1, "reason": "tpu_unavailable",
             "error": "accelerator backend unavailable after bounded retry",
         }))
         raise SystemExit(1)

@@ -75,6 +75,8 @@ class NodeObjectStore:
         self.num_evictions = 0
         self.num_spills = 0
         self.num_restores = 0
+        self.spill_time_s = 0.0
+        self.restore_time_s = 0.0
 
         backend = backend or getattr(GlobalConfig, "object_store_backend",
                                      "native")
@@ -352,13 +354,16 @@ class NodeObjectStore:
                             self._prefix + object_id.hex())
 
     def _spill(self, entry: _Entry) -> None:
+        t0 = time.perf_counter()
         dest = self._spill_target(entry.object_id)
         shutil.move(entry.path, dest)
         entry.spilled_path = dest
         self.used -= entry.size
         self.num_spills += 1
+        self.spill_time_s += time.perf_counter() - t0
 
     def _spill_arena(self, victim: Tuple[bytes, int, int]) -> None:
+        t0 = time.perf_counter()
         oid, offset, size = victim
         dest = self._spill_target(oid)
         with open(dest, "wb") as f:
@@ -368,8 +373,10 @@ class NodeObjectStore:
         if entry is not None:
             entry.spilled_path = dest
         self.num_spills += 1
+        self.spill_time_s += time.perf_counter() - t0
 
     def _restore(self, entry: _Entry) -> None:
+        t0 = time.perf_counter()
         if self._arena is not None:
             offset = self._arena_create(entry.object_id, entry.size)
             with open(entry.spilled_path, "rb") as f:
@@ -386,22 +393,50 @@ class NodeObjectStore:
             entry.spilled_path = None
             self.used += entry.size
         self.num_restores += 1
+        self.restore_time_s += time.perf_counter() - t0
 
     # -- stats --------------------------------------------------------------
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, Any]:
         if self._arena is not None:
             cap, used, _n, evictions = self._arena.stats()
             self.used = used
             self.num_evictions = max(self.num_evictions, evictions)
+        pinned_bytes = 0
+        spilled_bytes = 0
+        for e in self._entries.values():
+            if e.spilled_path is not None:
+                spilled_bytes += e.size
+            elif e.pinned:
+                pinned_bytes += e.size
         return {
             "backend": self.backend,
             "capacity": self.capacity,
             "used": self.used,
             "num_objects": len(self._entries),
+            "pinned_bytes": pinned_bytes,
+            "spilled_bytes": spilled_bytes,
             "num_evictions": self.num_evictions,
             "num_spills": self.num_spills,
             "num_restores": self.num_restores,
+            "spill_time_s": self.spill_time_s,
+            "restore_time_s": self.restore_time_s,
         }
+
+    def object_table(self, limit: Optional[int] = None
+                     ) -> List[Dict[str, Any]]:
+        """Per-object rows for memory introspection (``memory_summary``,
+        ``GET /api/memory``), largest first."""
+        now = time.monotonic()
+        rows = [{
+            "object_id": e.object_id.hex(),
+            "size": e.size,
+            "sealed": e.sealed,
+            "pinned": e.pinned,
+            "spilled": e.spilled_path is not None,
+            "idle_s": max(now - e.last_access, 0.0),
+        } for e in self._entries.values()]
+        rows.sort(key=lambda r: r["size"], reverse=True)
+        return rows[:limit] if limit else rows
 
     def cleanup(self) -> None:
         self.delete(list(self._entries.keys()))

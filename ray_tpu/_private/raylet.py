@@ -194,7 +194,7 @@ class Raylet:
             "contains_object",
             "delete_objects", "pin_object", "unpin_object", "read_chunk",
             "release_object", "release_objects",
-            "object_info", "store_stats",
+            "object_info", "store_stats", "memory_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "kill_worker", "node_stats", "shutdown_node", "get_tasks_info",
             "profile_worker",
@@ -659,6 +659,7 @@ class Raylet:
                     self._maybe_replenish(handle.job_id, handle.runtime_env)
                 if handle.lease is not None:
                     self._release_lease(handle)
+                self._release_orphaned_leases(worker_id)
                 if handle.is_actor and handle.actor_id is not None:
                     try:
                         await self.gcs.acall(
@@ -754,6 +755,15 @@ class Raylet:
             return
         node = self.node_id.hex()[:12]
         psutil.cpu_percent(interval=None)  # prime the sampler
+        try:
+            from ray_tpu.observability.object_store import (
+                register_store_sampler,
+            )
+            from ray_tpu.util import metrics as _metrics
+
+            register_store_sampler(self.store.stats, node)
+        except Exception:
+            _metrics = None
 
         def g(name, desc, tag_keys, data):
             return {"name": name, "type": "gauge", "description": desc,
@@ -798,6 +808,12 @@ class Raylet:
                     g("worker_rss_bytes", "Per-worker resident memory.",
                       ("node", "worker_pid"), rss),
                 ]
+                if _metrics is not None:
+                    # The raylet has no global worker, so the shared
+                    # metrics flusher never runs here — ship the
+                    # registry (the object-store gauges/counters fed by
+                    # the store sampler) with the reporter push instead.
+                    records.extend(_metrics.snapshot_records())
                 await self.gcs.acall("push_metrics",
                                      source=f"reporter:{node}",
                                      records=records, timeout=10)
@@ -889,7 +905,8 @@ class Raylet:
     async def _h_request_worker_lease(self, demand, job_id, strategy_kind="DEFAULT",
                                       strategy_node=None, soft=False,
                                       hard_labels=None, soft_labels=None,
-                                      lease_timeout=25.0, runtime_env=None):
+                                      lease_timeout=25.0, runtime_env=None,
+                                      owner_id=None):
         """Returns {granted, worker_addr, worker_id, tpu_ids} |
         {spillback_to: addr} | {infeasible: True} | {timeout: True}."""
         from ray_tpu._private.task_spec import SchedulingStrategySpec
@@ -904,12 +921,12 @@ class Raylet:
         if (strategy_kind in ("DEFAULT", "PLACEMENT_GROUP")
                 and self.local.available.is_superset_of(demand_rs)):
             return await self._grant_local(demand_rs, job_id, timeout,
-                                           strategy, runtime_env)
+                                           strategy, runtime_env, owner_id)
 
         target = pick_node(self.view, demand_rs, strategy, self.node_id)
         if target == self.node_id:
             return await self._grant_local(demand_rs, job_id, timeout,
-                                           strategy, runtime_env)
+                                           strategy, runtime_env, owner_id)
         if target is not None:
             return {"spillback_to": self._node_addrs.get(target),
                     "spillback_node": target}
@@ -920,7 +937,7 @@ class Raylet:
                 and self._strategy_allows_local(strategy)):
             fut = asyncio.get_running_loop().create_future()
             self._lease_queue.append((demand_rs, job_id, strategy, fut,
-                                      runtime_env))
+                                      runtime_env, owner_id))
             self._lease_queue_event.set()
             try:
                 return await asyncio.wait_for(fut, timeout)
@@ -943,7 +960,8 @@ class Raylet:
         return {"retry": True}
 
     async def _grant_local(self, demand: ResourceSet, job_id: bytes,
-                           timeout: float, strategy=None, runtime_env=None):
+                           timeout: float, strategy=None, runtime_env=None,
+                           owner_id=None):
         if runtime_env:
             failure = self._env_failures.get(
                 self._pool_key(job_id, runtime_env))
@@ -952,7 +970,7 @@ class Raylet:
         if not self.local.try_allocate(demand):
             fut = asyncio.get_running_loop().create_future()
             self._lease_queue.append((demand, job_id, strategy, fut,
-                                      runtime_env))
+                                      runtime_env, owner_id))
             self._lease_queue_event.set()
             try:
                 return await asyncio.wait_for(fut, timeout)
@@ -964,7 +982,8 @@ class Raylet:
             self.local.release(demand)
             self._release_tpu_chips(demand, tpu_ids)
             return {"timeout": True}
-        handle.lease = {"demand": demand, "tpu_ids": tpu_ids}
+        handle.lease = {"demand": demand, "tpu_ids": tpu_ids,
+                        "owner_id": owner_id}
         handle.lease_ts = time.monotonic()
         handle.lease_epoch += 1
         return {"granted": True, "worker_addr": handle.addr,
@@ -1069,6 +1088,34 @@ class Raylet:
         self._release_tpu_chips(lease["demand"], lease["tpu_ids"])
         self._lease_queue_event.set()
 
+    def _release_orphaned_leases(self, owner_id: bytes) -> None:
+        """Reclaim task-worker leases whose *owner* worker died on this
+        node.  Leases are normally returned by the owner's idle sweeper,
+        but a force-killed owner (e.g. ``ray_tpu.kill`` of an actor that
+        was mid-stream driving remote tasks) never gets to return them —
+        observed as a streaming_split coordinator kill landing inside the
+        owner's 0.5s lease-idle window and permanently leaking the leased
+        CPUs, wedging every later lease request on the saturated node.
+        Dedicated actor workers are excluded: their lifetime belongs to
+        the GCS actor manager, not to a task lease."""
+        if not owner_id:
+            return
+        for h in list(self.workers.values()):
+            if (h.is_actor or h.lease is None
+                    or h.lease.get("owner_id") != owner_id):
+                continue
+            sys.stderr.write(
+                f"[raylet] reclaiming lease of worker "
+                f"{h.worker_id.hex()[:12]}: owner "
+                f"{owner_id.hex()[:12]} died\n")
+            self._release_lease(h)
+            # The worker may still be executing a push from the dead
+            # owner; its results have nowhere to go, so retire the
+            # process rather than re-offering it mid-task.
+            self.workers.pop(h.worker_id, None)
+            self._release_worker_env(h)
+            self._retire_proc(h.proc)
+
     async def _lease_dispatch_loop(self):
         """Re-schedule queued lease requests whenever resources free up or the
         cluster view changes — including spilling a queued task to another
@@ -1086,12 +1133,13 @@ class Raylet:
             pending = len(self._lease_queue)
             for _ in range(pending):
                 (demand, job_id, strategy, fut,
-                 runtime_env) = self._lease_queue.popleft()
+                 runtime_env, owner_id) = self._lease_queue.popleft()
                 if fut.done():
                     continue
                 if self.local.available.is_superset_of(demand):
                     reply = await self._grant_local(demand, job_id, 60.0,
-                                                    strategy, runtime_env)
+                                                    strategy, runtime_env,
+                                                    owner_id)
                     if not fut.done():
                         fut.set_result(reply)
                     continue
@@ -1105,7 +1153,7 @@ class Raylet:
                              "spillback_node": target})
                     continue
                 self._lease_queue.append((demand, job_id, strategy, fut,
-                                          runtime_env))
+                                          runtime_env, owner_id))
             await asyncio.sleep(0.005)
 
     async def _h_return_worker(self, worker_id, kill=False,
@@ -1174,6 +1222,7 @@ class Raylet:
                 self._idle[handle.pool_key].remove(handle)
             except ValueError:
                 pass
+            self._release_orphaned_leases(worker_id)
         return True
 
     async def _h_kill_worker(self, worker_id, force=True):
@@ -1317,6 +1366,13 @@ class Raylet:
 
     async def _h_store_stats(self):
         return self.store.stats()
+
+    async def _h_memory_stats(self, top_n=50):
+        """One-shot memory introspection snapshot for `memory_summary()`
+        and `GET /api/memory`: the store's aggregate stats plus the
+        largest objects it is tracking."""
+        return {"store": self.store.stats(),
+                "objects": self.store.object_table(int(top_n) or 50)}
 
     # -------------------------------------------------------------- PG bundles
     async def _h_prepare_bundle(self, pg_id, bundle_index, resources):

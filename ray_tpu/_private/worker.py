@@ -1643,6 +1643,7 @@ class Worker:
                         hard_labels=strategy.hard_labels,
                         soft_labels=strategy.soft_labels,
                         lease_timeout=25.0, runtime_env=runtime_env,
+                        owner_id=self.worker_id.binary(),
                         timeout=30.0)
                 except (ConnectionLost, OSError):
                     await asyncio.sleep(0.2)

@@ -61,6 +61,22 @@ _PANELS: List[Dict[str, str]] = [
     {"title": "Device HBM used vs total",
      "expr": "rtpu_device_hbm_used_bytes",
      "expr_b": "rtpu_device_hbm_total_bytes", "unit": "bytes"},
+    # --- memory & data-pipeline observability plane ---
+    {"title": "Object store utilization (per node)",
+     "expr": "rtpu_object_store_used_bytes",
+     "expr_b": "rtpu_object_store_capacity_bytes",
+     "legend": "{{node}}", "unit": "bytes"},
+    {"title": "Spill / restore rate",
+     "expr": "rate(rtpu_object_store_spills_total[5m])",
+     "expr_b": "rate(rtpu_object_store_restores_total[5m])",
+     "legend": "{{node}}", "unit": "short"},
+    {"title": "Data pipeline rows/sec per stage",
+     "expr": "rate(rtpu_data_rows_out_total[1m])",
+     "legend": "{{stage}}", "unit": "short"},
+    {"title": "Data backpressure: in-flight / queued per stage",
+     "expr": "rtpu_data_inflight_tasks",
+     "expr_b": "rtpu_data_queued_blocks",
+     "legend": "{{stage}}", "unit": "short"},
 ]
 
 

@@ -18,6 +18,8 @@ Endpoints:
   GET /api/tasks      recent task lifecycle events
   GET /api/timeline   Chrome-trace JSON download (chrome://tracing)
   GET /api/serve      live serving/JIT telemetry summary
+  GET /api/memory     per-node object-store introspection + spill metrics
+  GET /api/data       data-pipeline (DatasetStats) metric summary
   GET /metrics        Prometheus text (scrape target)
 """
 
@@ -159,6 +161,42 @@ class DashboardHead:
             prefixes=["serve_", "jit_", "device_"], timeout=10)
         return web.json_response(summary or {})
 
+    async def memory(self, req) -> web.Response:
+        """Object-store memory introspection: live per-node snapshots
+        straight from each raylet's store (same numbers
+        ``ray_tpu.util.state.memory_summary()`` renders) plus the
+        cluster-folded ``object_store_*`` metric series (which survive
+        node exit via GCS tombstone folding)."""
+        top_n = int(req.query.get("top_n", 10))
+        nodes = await self._gcs.acall("get_all_nodes", timeout=10)
+        out: List[Dict[str, Any]] = []
+        for n in nodes or []:
+            if n["state"] != "ALIVE":
+                continue
+            row: Dict[str, Any] = {"node_id": n["node_id"].hex()[:12]}
+            client = RpcClient(*tuple(n["addr"]))
+            try:
+                snap = await client.acall("memory_stats", top_n=top_n,
+                                          timeout=10)
+                row["store"] = snap.get("store", {})
+                row["top_objects"] = snap.get("objects", [])[:top_n]
+            except Exception as e:
+                row["stats_error"] = str(e)
+            finally:
+                client.close()
+            out.append(row)
+        summary = await self._gcs.acall(
+            "user_metrics_summary", prefixes=["object_store_"], timeout=10)
+        return web.json_response({"nodes": out, "metrics": summary or {}})
+
+    async def data_stats(self, _req) -> web.Response:
+        """Data-pipeline telemetry: per-stage ``data_*`` series (rows/
+        bytes/blocks out, wall vs blocked time, in-flight tasks and queue
+        depth) aggregated on the GCS."""
+        summary = await self._gcs.acall(
+            "user_metrics_summary", prefixes=["data_"], timeout=10)
+        return web.json_response(summary or {})
+
     # ---- profiling (reference: dashboard/modules/reporter/
     # profile_manager.py — on-demand stack dump + sampling CPU profile
     # per worker, flamegraph-able folded-stack payloads) ----------------
@@ -263,6 +301,8 @@ class DashboardHead:
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/api/timeline", self.timeline)
         app.router.add_get("/api/serve", self.serve_stats)
+        app.router.add_get("/api/memory", self.memory)
+        app.router.add_get("/api/data", self.data_stats)
         app.router.add_get("/api/profile", self.profile)
         app.router.add_get("/api/profile/stacks", self.profile)
         app.router.add_post("/api/job_submissions", self.submit_job)

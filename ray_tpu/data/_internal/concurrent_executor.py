@@ -24,6 +24,20 @@ from typing import Any, Dict, Iterator, List, Optional
 import ray_tpu
 
 
+def _set_backpressure_gauges(stage: str, inflight: int, queued: int) -> None:
+    """Live scheduler state on /metrics (best-effort): in-flight tasks
+    and input-queue depth per op — a deep queue with idle in-flight
+    downstream pinpoints the bottleneck stage."""
+    try:
+        from ray_tpu.observability.data import data_metrics
+
+        m = data_metrics()
+        m.inflight.set(inflight, tags={"stage": stage})
+        m.queued.set(queued, tags={"stage": stage})
+    except Exception:
+        pass
+
+
 # --------------------------------------------------------------- policies
 
 class BackpressurePolicy:
@@ -93,8 +107,9 @@ class _OpState:
 
 
 class _SourceState(_OpState):
-    def __init__(self, read_tasks: List[Any], fused, budget_slots: int):
-        super().__init__("source", budget_slots)
+    def __init__(self, read_tasks: List[Any], fused, budget_slots: int,
+                 name: str = "source"):
+        super().__init__(name, budget_slots)
         for i, t in enumerate(read_tasks):
             self.inputs.append((i, t))
         self._fused = fused
@@ -117,8 +132,9 @@ class _InputRefsState(_OpState):
 
 
 class _TaskMapState(_OpState):
-    def __init__(self, fused_fn, budget_slots: int, index: int):
-        super().__init__(f"map:{index}", budget_slots)
+    def __init__(self, fused_fn, budget_slots: int, index: int,
+                 name: Optional[str] = None):
+        super().__init__(name or f"map:{index}", budget_slots)
         self._fn = fused_fn
 
     def launch(self, execr):
@@ -135,10 +151,11 @@ class _ActorMapState(_OpState):
     """Stateful-UDF stage on a pool of actors (reference:
     actor_pool_map_operator)."""
 
-    def __init__(self, op, budget_slots: int, index: int):
+    def __init__(self, op, budget_slots: int, index: int,
+                 name: Optional[str] = None):
         from ray_tpu.data._internal.plan import MapBatches
 
-        super().__init__(f"actor_map:{index}",
+        super().__init__(name or f"actor_map:{index}",
                          min(budget_slots, (op.concurrency or 2) * 2))
         self._op = MapBatches(op.fn, batch_size=op.batch_size,
                               batch_format=op.batch_format,
@@ -185,12 +202,15 @@ class ConcurrentExecutor:
     """
 
     def __init__(self, source: _OpState, map_states: List[_OpState],
-                 policies=DEFAULT_POLICIES):
+                 policies=DEFAULT_POLICIES, stats=None):
         self.ops: List[_OpState] = [source] + list(map_states)
         self.policies = list(policies)
         self.outputs: Dict[int, Any] = {}  # seq -> final ref
         self._next_emit = 0
         self._total: Optional[int] = None
+        # Submission counts / backpressure samples land here; the owning
+        # StreamingExecutor finalizes (spans + counter export).
+        self.stats = stats
 
     def op_after(self, op: _OpState) -> Optional[_OpState]:
         i = self.ops.index(op)
@@ -235,14 +255,25 @@ class ConcurrentExecutor:
             yield from self._drain_ready_outputs(final=True)
         finally:
             for op in self.ops:
+                _set_backpressure_gauges(op.name, 0, 0)
                 if isinstance(op, _ActorMapState):
                     op.close()
 
     def _launch_all(self) -> None:
         for op in self.ops:
+            launched = 0
             while op.inputs and all(p.can_launch(op, self)
                                     for p in self.policies):
                 op.launch(self)
+                launched += 1
+            if self.stats is not None and launched:
+                st = self.stats.stage(op.name)
+                if isinstance(op, _ActorMapState):
+                    st.actor_tasks_submitted += launched
+                else:
+                    st.tasks_submitted += launched
+            _set_backpressure_gauges(op.name, len(op.pending),
+                                     len(op.inputs))
 
     def _wait_any(self) -> None:
         refs = [r for op in self.ops for r in op.pending]
@@ -282,7 +313,8 @@ class ConcurrentExecutor:
 
 
 def build_pipeline(first, fused, map_stages: List[Any],
-                   policies=DEFAULT_POLICIES) -> Optional[ConcurrentExecutor]:
+                   policies=DEFAULT_POLICIES,
+                   stats=None) -> Optional[ConcurrentExecutor]:
     """Build a ConcurrentExecutor for a Source + map-stage prefix, or
     None when the source kind can't feed it. ``map_stages`` entries are
     either fused-op lists or actor MapBatches ops (split_stages output)."""
@@ -293,7 +325,8 @@ def build_pipeline(first, fused, map_stages: List[Any],
     if isinstance(first, plan_mod.Read):
         tasks = first.datasource.get_read_tasks(
             first.parallelism if first.parallelism > 0 else 8)
-        source: _OpState = _SourceState(tasks, fused, slots)
+        source: _OpState = _SourceState(tasks, fused, slots,
+                                        name=plan_mod.stage_name(first))
     elif isinstance(first, plan_mod.InputBlocks):
         from ray_tpu import ObjectRef
 
@@ -313,10 +346,12 @@ def build_pipeline(first, fused, map_stages: List[Any],
     states: List[_OpState] = []
     for idx, stage in enumerate(map_stages):
         if stage is None:  # the fused fn carried over from the source
-            states.append(_TaskMapState(fused, slots, idx))
+            states.append(_TaskMapState(fused, slots, idx, name="fused_map"))
         elif isinstance(stage, list):
             states.append(_TaskMapState(
-                plan_mod.compile_block_fn(stage), slots, idx))
+                plan_mod.compile_block_fn(stage), slots, idx,
+                name=plan_mod.stage_name(stage)))
         else:  # actor MapBatches
-            states.append(_ActorMapState(stage, slots, idx))
-    return ConcurrentExecutor(source, states, policies)
+            states.append(_ActorMapState(stage, slots, idx,
+                                         name=plan_mod.stage_name(stage)))
+    return ConcurrentExecutor(source, states, policies, stats=stats)

@@ -123,6 +123,28 @@ def compile_block_fn(ops: List[Op]) -> Callable[[Any], Any]:
     return apply
 
 
+def op_name(op: Op) -> str:
+    """Snake_case display name of one logical op (stats/metrics label)."""
+    name = type(op).__name__
+    out = [name[0].lower()]
+    for ch in name[1:]:
+        if ch.isupper():
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+def stage_name(stage: Any) -> str:
+    """Display name of one split_stages() entry: a source/barrier op, an
+    actor-pool MapBatches, or a fused run of map-like ops (joined with
+    ``->`` the way the planner fused them)."""
+    if isinstance(stage, list):
+        return "->".join(op_name(op) for op in stage) or "noop"
+    if isinstance(stage, MapBatches) and stage.uses_actors:
+        return "actor_" + op_name(stage)
+    return op_name(stage)
+
+
 def split_stages(ops: List[Op]) -> List[Any]:
     """Group the op list into stages: each stage is either a source op, a
     barrier op, an actor-pool MapBatches, or a fused list of map-like

@@ -1,0 +1,287 @@
+"""Per-stage Dataset execution statistics.
+
+Reference model: `python/ray/data/_internal/stats.py` (DatasetStats /
+StageStatsSummary) — every executed stage records wall time, block/row/
+byte counts and where the time went (blocked on input vs executing), and
+``Dataset.stats()`` renders the per-operator summary that is the primary
+tool for finding input-pipeline bottlenecks.
+
+Mechanics: the executors wrap each stage's input and output iterators in
+counting/timing shims (`wrap_input` / `wrap_output`).  For a stage S:
+
+- ``blocked_on_input_s``: time S spent inside ``next()`` on its
+  upstream iterator (waiting for input);
+- ``wall_time_s``: time spent inside ``next()`` on S's *output* —
+  i.e. everything S did to produce blocks, including its input waits,
+  but excluding time the downstream consumer sat on the block;
+- ``executing_s``: the difference — S's own compute/submission time.
+
+On stream completion (or early close, e.g. ``limit``) the run emits one
+``data.stage:<name>`` span per stage into the task-event ring buffer
+(so pipelines render in ``ray_tpu.timeline()`` next to train steps) and
+bumps the ``data_*`` counters exported on ``/metrics`` with the
+``rtpu_`` prefix.  Multiple runs/consumers of one Dataset merge into a
+single aggregate (``DatasetStats.merge``), which is what
+``streaming_split`` coordinators ship back to the driver as dicts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+_COUNT_FIELDS = (
+    "wall_time_s", "blocked_on_input_s",
+    "blocks_in", "rows_in", "bytes_in",
+    "blocks_out", "rows_out", "bytes_out",
+    "tasks_submitted", "actor_tasks_submitted",
+)
+
+
+@dataclasses.dataclass
+class StageStats:
+    """Counters for one physical stage of one (or more, merged) runs."""
+
+    name: str
+    wall_time_s: float = 0.0
+    blocked_on_input_s: float = 0.0
+    blocks_in: int = 0
+    rows_in: int = 0
+    bytes_in: int = 0
+    blocks_out: int = 0
+    rows_out: int = 0
+    bytes_out: int = 0
+    tasks_submitted: int = 0
+    actor_tasks_submitted: int = 0
+    start_ts: float = 0.0  # wall clock of the first output pull
+
+    @property
+    def executing_s(self) -> float:
+        return max(self.wall_time_s - self.blocked_on_input_s, 0.0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "StageStats":
+        return StageStats(**{k: d[k] for k in d
+                             if k in StageStats.__dataclass_fields__})
+
+
+def _block_meta(block) -> tuple:
+    """(rows, bytes) of a block; defensive — stats must never break a
+    pipeline over an exotic block type."""
+    try:
+        from ray_tpu.data.block import BlockAccessor
+
+        acc = BlockAccessor(block)
+        return acc.num_rows(), acc.size_bytes()
+    except Exception:
+        return 0, 0
+
+
+class DatasetStats:
+    """Ordered per-stage stats for one execution (or a merged aggregate).
+
+    Thread-safe for the merge/stage paths (streaming_split consumers pull
+    concurrently); the per-block hot path mutates plain attributes of a
+    StageStats owned by a single generator chain.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stages: Dict[str, StageStats] = {}  # insertion-ordered
+        self.runs = 0
+        self.start_ts: float = 0.0
+        self.end_ts: float = 0.0
+        self._finalized = False
+
+    # Locks don't pickle; stats objects travel driver <-> coordinator.
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_lock", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ recording
+    def stage(self, name: str) -> StageStats:
+        with self._lock:
+            st = self.stages.get(name)
+            if st is None:
+                st = self.stages[name] = StageStats(name)
+            return st
+
+    def wrap_input(self, name: str, source: Iterator[Any]) -> Iterator[Any]:
+        """Count a stage's input stream; time inside ``next(source)`` is
+        the stage's blocked-on-input time."""
+        st = self.stage(name)
+        it = iter(source)
+
+        def gen():
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    block = next(it)
+                except StopIteration:
+                    st.blocked_on_input_s += time.perf_counter() - t0
+                    return
+                st.blocked_on_input_s += time.perf_counter() - t0
+                rows, nbytes = _block_meta(block)
+                st.blocks_in += 1
+                st.rows_in += rows
+                st.bytes_in += nbytes
+                yield block
+
+        return gen()
+
+    def wrap_output(self, name: str, source: Iterator[Any]) -> Iterator[Any]:
+        """Count a stage's output stream; time inside ``next(source)`` is
+        the stage's wall time (its input waits included, its consumer's
+        time excluded)."""
+        st = self.stage(name)
+        it = iter(source)
+
+        def gen():
+            if not self.start_ts:
+                self.start_ts = time.time()
+            while True:
+                if not st.start_ts:
+                    st.start_ts = time.time()
+                t0 = time.perf_counter()
+                try:
+                    block = next(it)
+                except StopIteration:
+                    st.wall_time_s += time.perf_counter() - t0
+                    return
+                st.wall_time_s += time.perf_counter() - t0
+                rows, nbytes = _block_meta(block)
+                st.blocks_out += 1
+                st.rows_out += rows
+                st.bytes_out += nbytes
+                yield block
+
+        return gen()
+
+    # ------------------------------------------------------------- closing
+    def finalize(self) -> None:
+        """Emit this run's spans + metrics exactly once (also reached on
+        early close, e.g. a ``limit`` stopping the stream)."""
+        with self._lock:
+            if self._finalized:
+                return
+            self._finalized = True
+            self.end_ts = time.time()
+            self.runs = max(self.runs, 1)
+        try:
+            self._emit()
+        except Exception:
+            pass  # telemetry must never fail the pipeline
+
+    def _emit(self) -> None:
+        from ray_tpu.observability.data import data_metrics
+        from ray_tpu.util import tracing
+
+        m = data_metrics()
+        for st in self.stages.values():
+            tags = {"stage": st.name}
+            m.blocks_out.inc(st.blocks_out, tags=tags)
+            m.rows_out.inc(st.rows_out, tags=tags)
+            m.bytes_out.inc(st.bytes_out, tags=tags)
+            m.stage_wall.inc(st.wall_time_s, tags=tags)
+            m.stage_blocked.inc(st.blocked_on_input_s, tags=tags)
+            if st.tasks_submitted:
+                m.tasks.inc(st.tasks_submitted,
+                            tags={"stage": st.name, "kind": "task"})
+            if st.actor_tasks_submitted:
+                m.tasks.inc(st.actor_tasks_submitted,
+                            tags={"stage": st.name, "kind": "actor"})
+            tracing.record_span(
+                f"data.stage:{st.name}",
+                st.start_ts or self.start_ts, st.wall_time_s,
+                attrs={"blocks_out": st.blocks_out, "rows_out": st.rows_out,
+                       "bytes_out": st.bytes_out,
+                       "blocked_s": round(st.blocked_on_input_s, 6),
+                       "executing_s": round(st.executing_s, 6)})
+
+    # ----------------------------------------------------------- aggregation
+    def merge(self, other: "DatasetStats") -> None:
+        """Fold another run/consumer into this aggregate (field-wise sums;
+        used by Dataset across runs and by streaming_split across the
+        coordinator's epochs)."""
+        with self._lock:
+            for st in other.stages.values():
+                mine = self.stages.get(st.name)
+                if mine is None:
+                    mine = self.stages[st.name] = StageStats(st.name)
+                for f in _COUNT_FIELDS:
+                    setattr(mine, f, getattr(mine, f) + getattr(st, f))
+                if st.start_ts and (not mine.start_ts
+                                    or st.start_ts < mine.start_ts):
+                    mine.start_ts = st.start_ts
+            self.runs += max(other.runs, 1)
+            if other.start_ts and (not self.start_ts
+                                   or other.start_ts < self.start_ts):
+                self.start_ts = other.start_ts
+            self.end_ts = max(self.end_ts, other.end_ts)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"runs": self.runs, "start_ts": self.start_ts,
+                "end_ts": self.end_ts,
+                "stages": [st.to_dict() for st in self.stages.values()]}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "DatasetStats":
+        out = DatasetStats()
+        out.runs = d.get("runs", 1)
+        out.start_ts = d.get("start_ts", 0.0)
+        out.end_ts = d.get("end_ts", 0.0)
+        for sd in d.get("stages", []):
+            st = StageStats.from_dict(sd)
+            out.stages[st.name] = st
+        return out
+
+    # ------------------------------------------------------------- rendering
+    def summary(self, plan_desc: str = "") -> str:
+        if not self.stages:
+            return (f"{plan_desc}\nNo execution stats recorded yet — "
+                    f"consume the dataset first (count/take/iter_batches).")
+        lines: List[str] = []
+        if plan_desc:
+            lines.append(plan_desc)
+        lines.append(f"Execution stats over {max(self.runs, 1)} run(s):")
+        total_wall = 0.0
+        for i, st in enumerate(self.stages.values()):
+            total_wall += st.wall_time_s
+            lines.append(
+                f"Stage {i} {st.name}: {st.blocks_out} blocks produced "
+                f"in {st.wall_time_s:.2f}s")
+            lines.append(
+                f"* Rows: {st.rows_in} in / {st.rows_out} out; bytes: "
+                f"{_fmt_bytes(st.bytes_in)} in / "
+                f"{_fmt_bytes(st.bytes_out)} out")
+            lines.append(
+                f"* Tasks submitted: {st.tasks_submitted} task(s), "
+                f"{st.actor_tasks_submitted} actor task(s)")
+            lines.append(
+                f"* Time blocked on input: {st.blocked_on_input_s:.2f}s; "
+                f"executing: {st.executing_s:.2f}s")
+        span = (self.end_ts - self.start_ts
+                if self.end_ts and self.start_ts else total_wall)
+        lines.append(f"Total wall time: {max(span, 0.0):.2f}s "
+                     f"(sum of stage time: {total_wall:.2f}s)")
+        return "\n".join(lines)
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"

@@ -20,6 +20,7 @@ from typing import Any, Callable, Iterator, List, Optional
 import ray_tpu
 from ray_tpu._private.worker import global_worker_or_none
 from ray_tpu.data._internal import plan as plan_mod
+from ray_tpu.data._internal.stats import DatasetStats
 from ray_tpu.data.block import BlockAccessor
 
 DEFAULT_IN_FLIGHT = 8
@@ -27,6 +28,17 @@ DEFAULT_IN_FLIGHT = 8
 
 def _cluster_available() -> bool:
     return global_worker_or_none() is not None
+
+
+def _set_inflight(stage: str, n: int) -> None:
+    """Backpressure gauge: remote tasks submitted but not yet consumed
+    for one stage (best-effort — telemetry never fails the pipeline)."""
+    try:
+        from ray_tpu.observability.data import data_metrics
+
+        data_metrics().inflight.set(n, tags={"stage": stage})
+    except Exception:
+        pass
 
 
 from ray_tpu.data._internal.remote_ops import (  # noqa: E402
@@ -64,26 +76,40 @@ def _gather_slices(parts: List[Any]) -> List[Any]:
 class StreamingExecutor:
     """Executes a logical op list, yielding blocks (arrow tables)."""
 
-    def __init__(self, ops: List[Any], in_flight: int = DEFAULT_IN_FLIGHT):
+    def __init__(self, ops: List[Any], in_flight: int = DEFAULT_IN_FLIGHT,
+                 stats_parent: Optional[DatasetStats] = None):
         self._ops = ops
         self._in_flight = in_flight
+        # Run-local stats; folded into stats_parent (the Dataset's or
+        # coordinator's aggregate) when the stream closes.
+        self.stats = DatasetStats()
+        self._stats_parent = stats_parent
 
     # ------------------------------------------------------------- public
     def stream_blocks(self) -> Iterator[Any]:
         """Yield output blocks with streaming/backpressure semantics."""
         stages = plan_mod.split_stages(self._ops)
-        yield from self._run_stages(stages)
+        try:
+            yield from self._run_stages(stages)
+        finally:
+            # Reached on exhaustion AND on early close (limit / abandoned
+            # consumer): spans + metrics always flush.
+            self.stats.finalize()
+            if self._stats_parent is not None:
+                self._stats_parent.merge(self.stats)
 
     # ------------------------------------------------------------ internal
     def _run_stages(self, stages: List[Any]) -> Iterator[Any]:
         if not stages:
             return
         first, rest = stages[0], stages[1:]
+        src_name = plan_mod.stage_name(first)
 
         # Fuse a map-stage directly into the source wave.
         fused: Optional[Callable] = None
         if rest and isinstance(rest[0], list):
             fused = plan_mod.compile_block_fn(rest[0])
+            src_name = f"{src_name}->{plan_mod.stage_name(rest[0])}"
             rest = rest[1:]
 
         # All-to-all barrier directly after the (fused) source: run it as
@@ -93,7 +119,7 @@ class StreamingExecutor:
         if (rest and _cluster_available()
                 and isinstance(rest[0], (plan_mod.RandomShuffle,
                                          plan_mod.Repartition))):
-            refs = self._source_refs(first, fused)
+            refs = self._source_refs(first, fused, src_name)
             if refs is not None:
                 from ray_tpu.data._internal import shuffle as shuffle_mod
 
@@ -104,8 +130,10 @@ class StreamingExecutor:
                 else:
                     out_refs = shuffle_mod.distributed_repartition(
                         refs, barrier.n)
-                yield from self._apply_rest(
-                    self._stream_input(out_refs, None), rest[1:])
+                barrier_out = self.stats.wrap_output(
+                    plan_mod.stage_name(barrier),
+                    self._stream_input(out_refs, None))
+                yield from self._apply_rest(barrier_out, rest[1:])
                 return
 
         # Concurrent pipelined prefix: when MORE remote stages follow the
@@ -127,7 +155,7 @@ class StreamingExecutor:
                 build_pipeline,
             )
 
-            pipe = build_pipeline(first, fused, prefix)
+            pipe = build_pipeline(first, fused, prefix, stats=self.stats)
             if pipe is not None:
                 yield from self._apply_rest(pipe.stream(), tail)
                 return
@@ -135,22 +163,27 @@ class StreamingExecutor:
         if isinstance(first, plan_mod.Read):
             tasks = first.datasource.get_read_tasks(
                 first.parallelism if first.parallelism > 0 else 8)
-            source = self._stream_tasks(tasks, fused)
+            source = self._stream_tasks(tasks, fused, src_name)
         elif isinstance(first, plan_mod.InputBlocks):
             source = self._stream_input(first.refs, fused)
         else:
             raise TypeError(f"bad source op {first}")
 
-        yield from self._apply_rest(source, rest)
+        yield from self._apply_rest(
+            self.stats.wrap_output(src_name, source), rest)
 
-    def _source_refs(self, first, fused) -> Optional[List[Any]]:
+    def _source_refs(self, first, fused,
+                     name: Optional[str] = None) -> Optional[List[Any]]:
         """Materialize the source stage as refs of block-lists (no driver
         fetch). None when the source kind doesn't support it."""
         from ray_tpu import ObjectRef
 
+        st = self.stats.stage(name) if name else None
         if isinstance(first, plan_mod.Read):
             tasks = first.datasource.get_read_tasks(
                 first.parallelism if first.parallelism > 0 else 8)
+            if st is not None:
+                st.tasks_submitted += len(tasks)
             return [_run_read.remote(t, fused) for t in tasks]
         if isinstance(first, plan_mod.InputBlocks):
             refs = []
@@ -158,6 +191,8 @@ class StreamingExecutor:
                 if isinstance(r, ObjectRef) and fused is None:
                     refs.append(r)
                 elif isinstance(r, ObjectRef):
+                    if st is not None:
+                        st.tasks_submitted += 1
                     refs.append(_as_block_list.remote(r, fused))
                 else:
                     blocks = r if isinstance(r, list) else [r]
@@ -173,13 +208,17 @@ class StreamingExecutor:
             yield from source
             return
         head, rest = stages[0], stages[1:]
+        name = plan_mod.stage_name(head)
+        # Input side of the timing shim: time this stage spends pulling
+        # `source` is its blocked-on-input time.
+        inner = self.stats.wrap_input(name, source)
         if isinstance(head, list):
             fn = plan_mod.compile_block_fn(head)
-            yield from self._apply_rest((fn(b) for b in source), rest)
+            produced = (fn(b) for b in inner)
         elif isinstance(head, plan_mod.Limit):
             def limited():
                 seen = 0
-                for b in source:
+                for b in inner:
                     take = min(b.num_rows, head.n - seen)
                     if take < b.num_rows:
                         b = b.slice(0, take)
@@ -187,27 +226,35 @@ class StreamingExecutor:
                     yield b
                     if seen >= head.n:
                         return  # early exit stops upstream submission
-            yield from self._apply_rest(limited(), rest)
+            produced = limited()
         elif isinstance(head, plan_mod.MapBatches) and head.uses_actors:
-            yield from self._apply_rest(
-                self._actor_pool_map(source, head), rest)
+            produced = self._actor_pool_map(inner, head, name)
         elif isinstance(head, plan_mod.Repartition):
-            yield from self._apply_rest(
-                self._repartition(list(source), head.n), rest)
+            produced = self._repartition_lazy(inner, head.n)
         elif isinstance(head, plan_mod.RandomShuffle):
-            yield from self._apply_rest(
-                self._shuffle(list(source), head.seed), rest)
+            produced = self._shuffle_lazy(inner, head.seed)
         elif isinstance(head, plan_mod.Union):
             def unioned():
-                yield from source
+                yield from inner
                 for branch in head.branches:
                     yield from StreamingExecutor(
-                        branch, self._in_flight).stream_blocks()
-            yield from self._apply_rest(unioned(), rest)
+                        branch, self._in_flight,
+                        stats_parent=self.stats).stream_blocks()
+            produced = unioned()
         elif isinstance(head, plan_mod.Zip):
-            yield from self._apply_rest(self._zip(source, head.other), rest)
+            produced = self._zip(inner, head.other)
         else:
             raise TypeError(f"unsupported stage {head}")
+        yield from self._apply_rest(
+            self.stats.wrap_output(name, produced), rest)
+
+    def _repartition_lazy(self, source: Iterator[Any], n: int
+                          ) -> Iterator[Any]:
+        yield from self._repartition(list(source), n)
+
+    def _shuffle_lazy(self, source: Iterator[Any], seed: Optional[int]
+                      ) -> Iterator[Any]:
+        yield from self._shuffle(list(source), seed)
 
     def _zip(self, source: Iterator[Any], other_ops: List[Any]
              ) -> Iterator[Any]:
@@ -216,7 +263,8 @@ class StreamingExecutor:
         import pyarrow as pa
 
         right_iter = StreamingExecutor(
-            other_ops, self._in_flight).stream_blocks()
+            other_ops, self._in_flight,
+            stats_parent=self.stats).stream_blocks()
         rbuf: list = []      # right arrow tables not yet consumed
         rrows = 0
 
@@ -270,8 +318,8 @@ class StreamingExecutor:
                 "zip(): right dataset has more rows than left")
 
     # -------------------------------------------------------- actor pool
-    def _actor_pool_map(self, source: Iterator[Any],
-                        op) -> Iterator[Any]:
+    def _actor_pool_map(self, source: Iterator[Any], op,
+                        name: Optional[str] = None) -> Iterator[Any]:
         """Stateful-UDF stage on a pool of actors (reference:
         `execution/operators/actor_pool_map_operator.py`): the class
         constructs once per actor; blocks pipeline through the pool with
@@ -287,6 +335,8 @@ class StreamingExecutor:
                 yield fn(b)
             return
 
+        name = name or plan_mod.stage_name(op)
+        st = self.stats.stage(name)
         size = op.concurrency or 2
         opts = {"num_cpus": op.num_cpus}
         if op.num_tpus:
@@ -300,11 +350,16 @@ class StreamingExecutor:
             for block in source:
                 while len(pending) >= size * per_actor_window:
                     yield ray_tpu.get(pending.popleft(), timeout=600)
+                    _set_inflight(name, len(pending))
                 pending.append(pool[rr % size].apply.remote(block))
+                st.actor_tasks_submitted += 1
                 rr += 1
+                _set_inflight(name, len(pending))
             while pending:
                 yield ray_tpu.get(pending.popleft(), timeout=600)
+                _set_inflight(name, len(pending))
         finally:
+            _set_inflight(name, 0)
             for a in pool:
                 try:
                     ray_tpu.kill(a)
@@ -312,12 +367,14 @@ class StreamingExecutor:
                     pass
 
     # -------------------------------------------------------------- waves
-    def _stream_tasks(self, read_tasks: List[Any], fused) -> Iterator[Any]:
+    def _stream_tasks(self, read_tasks: List[Any], fused,
+                      name: Optional[str] = None) -> Iterator[Any]:
         if not _cluster_available():
             for t in read_tasks:
                 for block in t():
                     yield fused(block) if fused is not None else block
             return
+        st = self.stats.stage(name) if name else None
         # Byte-budget backpressure (reference:
         # `execution/backpressure_policy/streaming_output_backpressure_policy`):
         # the in-flight window adapts to observed task-output size so a
@@ -328,25 +385,33 @@ class StreamingExecutor:
         pending: deque = deque()
         it = iter(read_tasks)
         exhausted = False
-        while pending or not exhausted:
-            if ema_task_bytes:
-                budget = max(2, int(target_bytes / max(ema_task_bytes, 1)))
-            else:
-                budget = self._in_flight
-            window = min(max(2, budget), 4 * self._in_flight)
-            while not exhausted and len(pending) < window:
-                try:
-                    t = next(it)
-                except StopIteration:
-                    exhausted = True
-                    break
-                pending.append(_run_read.remote(t, fused))
-            if pending:
-                blocks = ray_tpu.get(pending.popleft(), timeout=600)
-                size = sum(BlockAccessor(b).size_bytes() for b in blocks)
-                ema_task_bytes = (size if ema_task_bytes is None
-                                  else 0.7 * ema_task_bytes + 0.3 * size)
-                yield from blocks
+        try:
+            while pending or not exhausted:
+                if ema_task_bytes:
+                    budget = max(2, int(target_bytes / max(ema_task_bytes, 1)))
+                else:
+                    budget = self._in_flight
+                window = min(max(2, budget), 4 * self._in_flight)
+                while not exhausted and len(pending) < window:
+                    try:
+                        t = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    pending.append(_run_read.remote(t, fused))
+                    if st is not None:
+                        st.tasks_submitted += 1
+                if name:
+                    _set_inflight(name, len(pending))
+                if pending:
+                    blocks = ray_tpu.get(pending.popleft(), timeout=600)
+                    size = sum(BlockAccessor(b).size_bytes() for b in blocks)
+                    ema_task_bytes = (size if ema_task_bytes is None
+                                      else 0.7 * ema_task_bytes + 0.3 * size)
+                    yield from blocks
+        finally:
+            if name:
+                _set_inflight(name, 0)
 
     def _stream_input(self, refs: List[Any], fused) -> Iterator[Any]:
         from ray_tpu import ObjectRef

@@ -16,6 +16,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.data._internal import plan as plan_mod
+from ray_tpu.data._internal.stats import DatasetStats
 from ray_tpu.data._internal.streaming_executor import (
     DEFAULT_IN_FLIGHT, StreamingExecutor, _cluster_available,
 )
@@ -46,6 +47,13 @@ class _RowUdf:
 class Dataset:
     def __init__(self, ops: List[plan_mod.Op]):
         self._ops = ops
+        # Execution stats accumulate here across every consumption of
+        # this Dataset object (reference: `DatasetStats` in
+        # `python/ray/data/_internal/stats.py`). Transforms return NEW
+        # Dataset objects with fresh stats — stats describe executions
+        # of *this* plan.
+        self._stats = DatasetStats()
+        self._split_coords: List[Any] = []
 
     # ------------------------------------------------------------ transforms
     def _with(self, op: plan_mod.Op) -> "Dataset":
@@ -138,7 +146,8 @@ class Dataset:
 
     # ----------------------------------------------------------- consumption
     def _stream(self, in_flight: int = DEFAULT_IN_FLIGHT) -> Iterator[Any]:
-        return StreamingExecutor(self._ops, in_flight).stream_blocks()
+        return StreamingExecutor(self._ops, in_flight,
+                                 stats_parent=self._stats).stream_blocks()
 
     def iter_batches(self, **kw) -> Iterator[Any]:
         return DataIterator(self._stream).iter_batches(**kw)
@@ -201,6 +210,24 @@ class Dataset:
             print(row)
 
     def stats(self) -> str:
+        """Per-stage execution statistics for every run of this Dataset,
+        rendered Ray-style (reference: `Dataset.stats()`): block/row/byte
+        throughput, task submissions, and time blocked on input vs
+        executing per stage.  streaming_split runs execute inside a
+        coordinator actor, so their stats are fetched and folded in
+        here."""
+        agg = DatasetStats()
+        agg.merge(self._stats)
+        agg.runs = self._stats.runs  # merge() inflates empty runs to 1
+        for coord in self._split_coords:
+            try:
+                remote = ray_tpu.get(coord.stats.remote(), timeout=30)
+                agg.merge(DatasetStats.from_dict(remote))
+            except Exception:
+                pass  # coordinator may already be dead; report what we have
+        return agg.summary(self._plan_desc())
+
+    def _plan_desc(self) -> str:
         stages = plan_mod.split_stages(self._ops)
         return f"Dataset({len(self._ops)} ops, {len(stages)} stages)"
 
@@ -230,6 +257,7 @@ class Dataset:
         coord = _SplitCoordinator.options(
             name=f"split-coord-{id(self)}-{np.random.randint(1 << 30)}",
         ).remote(self._ops)
+        self._split_coords.append(coord)
         return [SplitIterator(coord, i) for i in range(n)]
 
     def _split_blocks_local(self, n: int) -> List[List[Any]]:
@@ -314,7 +342,7 @@ class Dataset:
 
     # ---------------------------------------------------------------- misc
     def __repr__(self) -> str:  # pragma: no cover
-        return self.stats()
+        return self._plan_desc()
 
 
 @ray_tpu.remote

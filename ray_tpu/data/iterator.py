@@ -96,11 +96,16 @@ class _SplitCoordinator:
     """
 
     def __init__(self, ops: List[Any], in_flight: int = 4):
+        from ray_tpu.data._internal.stats import DatasetStats
+
         self._ops = ops
         self._in_flight = in_flight
         self._epoch = -1
         self._stream: Optional[Iterator[Any]] = None
         self._lock = threading.Lock()
+        # Aggregate across epochs; each epoch's executor merges into this
+        # on completion, and the driver's Dataset.stats() pulls it back.
+        self._stats = DatasetStats()
 
     def next_block(self, epoch: int):
         with self._lock:
@@ -111,7 +116,8 @@ class _SplitCoordinator:
 
                 self._epoch = epoch
                 self._stream = StreamingExecutor(
-                    self._ops, self._in_flight).stream_blocks()
+                    self._ops, self._in_flight,
+                    stats_parent=self._stats).stream_blocks()
             if epoch < self._epoch or self._stream is None:
                 return None  # stale epoch: treat as exhausted
             try:
@@ -119,6 +125,10 @@ class _SplitCoordinator:
             except StopIteration:
                 self._stream = None
                 return None
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._stats.to_dict()
 
 
 class SplitIterator(DataIterator):
@@ -139,6 +149,16 @@ class SplitIterator(DataIterator):
             if block is None:
                 return
             yield block
+
+    def stats(self) -> str:
+        """Summary of the shared execution behind all splits (the
+        coordinator's per-epoch aggregate), rendered like
+        ``Dataset.stats()``."""
+        from ray_tpu.data._internal.stats import DatasetStats
+
+        d = ray_tpu.get(self._coord.stats.remote(), timeout=30)
+        return DatasetStats.from_dict(d).summary(
+            f"streaming_split consumer {self._index}")
 
     def __reduce__(self):
         return (_rebuild_split_iterator, (self._coord, self._index))

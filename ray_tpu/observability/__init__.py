@@ -16,6 +16,12 @@ shared instrumentation layer every hot path reports through:
   for the continuous-batching LLM engine.
 - ``train``: step-duration / samples-per-sec / loss reporting for
   ``train`` sessions and RLlib learners.
+- ``data``: the Dataset executors' metric set — per-stage throughput
+  counters finalized by ``DatasetStats`` plus live backpressure gauges
+  (in-flight tasks, queued blocks) from the scheduler loops.
+- ``object_store``: per-node object-store memory-pressure metrics
+  (used/capacity/pinned/spilled gauges, spill/restore/eviction
+  counters) sampled from ``NodeObjectStore.stats()`` at each flush.
 - ``timeline``: the Chrome-trace builder shared by
   ``ray_tpu.timeline()`` and the dashboard's ``GET /api/timeline``.
 
@@ -34,6 +40,11 @@ from ray_tpu.observability.jit import (  # noqa: F401
 from ray_tpu.observability.device import (  # noqa: F401
     sample_device_metrics,
 )
+from ray_tpu.observability.data import data_metrics  # noqa: F401
+from ray_tpu.observability.object_store import (  # noqa: F401
+    object_store_metrics,
+    register_store_sampler,
+)
 from ray_tpu.observability.serve import serve_metrics  # noqa: F401
 from ray_tpu.observability.timeline import build_chrome_trace  # noqa: F401
 from ray_tpu.observability.train import (  # noqa: F401
@@ -46,4 +57,5 @@ __all__ = [
     "RecompileWarning", "TrackedJit", "tracked_jit", "jit_stats",
     "sample_device_metrics", "serve_metrics", "train_metrics",
     "learner_metrics", "batch_num_samples", "build_chrome_trace",
+    "data_metrics", "object_store_metrics", "register_store_sampler",
 ]

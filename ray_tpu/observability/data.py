@@ -1,0 +1,64 @@
+"""Data-pipeline instrumentation: the Dataset executors' metric set.
+
+One process-wide singleton (every StreamingExecutor / ConcurrentExecutor
+run in a process shares the registry entries; counters aggregate across
+processes on the GCS scrape side, so ``rtpu_data_rows_out_total`` is the
+whole cluster's ingestion throughput).
+
+Counters carry per-stage totals finalized at the end of each run by
+``DatasetStats``; the gauges are live backpressure state updated from
+inside the scheduler loops:
+
+- ``data_inflight_tasks{stage}``: remote tasks currently in flight for
+  the stage (the concurrency the scheduler actually achieved);
+- ``data_queued_blocks{stage}``: blocks sitting in the stage's input
+  queue waiting for a free slot — a persistently deep queue on stage N
+  with idle in-flight on stage N+1 means N+1 is the bottleneck.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_singleton = None
+_lock = threading.Lock()
+
+
+class DataMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        self.blocks_out = Counter(
+            "data_blocks_out_total", tag_keys=("stage",),
+            description="Blocks produced by a Dataset stage.")
+        self.rows_out = Counter(
+            "data_rows_out_total", tag_keys=("stage",),
+            description="Rows produced by a Dataset stage.")
+        self.bytes_out = Counter(
+            "data_bytes_out_total", tag_keys=("stage",),
+            description="Block bytes produced by a Dataset stage.")
+        self.tasks = Counter(
+            "data_tasks_submitted_total", tag_keys=("stage", "kind"),
+            description="Remote submissions per stage (kind=task|actor).")
+        self.stage_wall = Counter(
+            "data_stage_wall_seconds_total", tag_keys=("stage",),
+            description="Wall time spent producing a stage's output.")
+        self.stage_blocked = Counter(
+            "data_stage_blocked_seconds_total", tag_keys=("stage",),
+            description="Time a stage spent blocked waiting on its "
+                        "input stream.")
+        self.inflight = Gauge(
+            "data_inflight_tasks", tag_keys=("stage",),
+            description="Remote tasks currently in flight for a stage.")
+        self.queued = Gauge(
+            "data_queued_blocks", tag_keys=("stage",),
+            description="Blocks queued at a stage's input awaiting a "
+                        "launch slot (backpressure depth).")
+
+
+def data_metrics() -> DataMetrics:
+    global _singleton
+    with _lock:
+        if _singleton is None:
+            _singleton = DataMetrics()
+        return _singleton

@@ -110,6 +110,86 @@ def list_objects() -> List[Dict[str, Any]]:
     return out
 
 
+def memory_summary(top_n: int = 10) -> Dict[str, Any]:
+    """Cluster object-store memory introspection (reference: `ray memory`
+    / `ray_private.internal_api.memory_summary`).
+
+    Returns::
+
+        {"nodes":   [per-node store stats: used/capacity/num_objects/
+                     pinned_bytes/spilled_bytes, spill/restore/eviction
+                     counters + cumulative spill/restore wall time],
+         "totals":  the same counters summed across nodes,
+         "top_objects": top-N objects cluster-wide by size, each with
+                     node, size, sealed/pinned/spilled state, idle age,
+                     and this driver's reference-count view (owned /
+                     borrowed / untracked),
+         "hints":   reference-leak heuristics — pinned primaries this
+                     driver no longer tracks, long-idle pinned objects}
+    """
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    nodes: List[Dict[str, Any]] = []
+    objects: List[Dict[str, Any]] = []
+    for node in _gcs().call("get_all_nodes", timeout=30):
+        if node.get("state") != "ALIVE":
+            continue
+        client = w._raylet_for_node(node["node_id"])
+        if client is None:
+            continue
+        try:
+            stats = client.call("memory_stats", top_n=max(top_n, 0),
+                                timeout=15)
+        except Exception:
+            continue
+        node_hex = node["node_id"].hex()
+        row = dict(stats.get("store") or {})
+        row["node_id"] = node_hex
+        nodes.append(row)
+        for obj in stats.get("objects") or []:
+            obj = dict(obj)
+            obj["node_id"] = node_hex
+            objects.append(obj)
+
+    totals: Dict[str, float] = {}
+    for row in nodes:
+        for k, v in row.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                totals[k] = totals.get(k, 0) + v
+
+    objects.sort(key=lambda o: o.get("size", 0), reverse=True)
+    top = objects[:top_n] if top_n else objects
+    hints: List[str] = []
+    for obj in top:
+        try:
+            oid = bytes.fromhex(obj["object_id"])
+        except (KeyError, ValueError):
+            oid = None
+        snap = w.reference_counter.snapshot(oid) if oid is not None else None
+        if snap is None:
+            obj["reference"] = "untracked"
+        elif snap["freed"]:
+            obj["reference"] = "freed"
+        else:
+            obj["reference"] = ("owned" if snap["is_owned_by_us"]
+                                else "borrowed")
+        if obj.get("pinned") and obj["reference"] == "untracked":
+            hints.append(
+                f"object {obj['object_id'][:12]} ({obj['size']} B, node "
+                f"{obj['node_id'][:12]}) is pinned but this driver holds "
+                f"no reference — possible leaked primary (owner exited "
+                f"without cleanup?)")
+        elif obj.get("pinned") and obj.get("idle_s", 0) > 600:
+            hints.append(
+                f"object {obj['object_id'][:12]} ({obj['size']} B) pinned "
+                f"and idle {obj['idle_s']:.0f}s — check for a retained "
+                f"ObjectRef that is no longer needed")
+    return {"nodes": nodes, "totals": totals, "top_objects": top,
+            "hints": hints,
+            "num_tracked_refs": w.reference_counter.num_tracked()}
+
+
 def cluster_resources() -> Dict[str, float]:
     total: Dict[str, float] = {}
     for node in _gcs().call("get_all_nodes", timeout=30):

@@ -12,7 +12,14 @@ runtime can only check per-process:
 - a name declared in two places must agree on metric type, tag_keys
   and (histograms) boundaries — the runtime raises on such collisions,
   but only when both declarations happen to run in one process, so the
-  lint catches what tests might never co-execute.
+  lint catches what tests might never co-execute;
+- framework metrics belong to a registered family prefix (``data_``,
+  ``object_store_``, ``serve_``, ...) so the ``rtpu_*`` exposition
+  stays grouped — a new subsystem extends ``_FAMILIES`` once, in one
+  reviewable place;
+- gauges must not declare a ``pid`` tag key: the exporter appends its
+  own ``pid=<source>`` label to every gauge and duplicate label names
+  break the whole Prometheus scrape.
 
 Usage: ``python scripts/check_metrics.py [root]`` — exits nonzero and
 prints one line per violation. ``check_paths()`` is the library entry
@@ -30,6 +37,22 @@ from typing import Any, Dict, List, Optional, Tuple
 _METRIC_CLASSES = ("Counter", "Gauge", "Histogram")
 _METRICS_MODULE = "ray_tpu.util.metrics"
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# Registered metric families: every metric the framework itself declares
+# must start with one of these (exported as rtpu_<family>...). New
+# subsystems add their prefix here — one reviewable place instead of
+# ad-hoc names scattered over /metrics.
+_FAMILIES = (
+    "data_",          # Dataset pipeline stages (stats.py / executors)
+    "device_",        # accelerator HBM / device-count gauges
+    "jit_",           # tracked_jit compile/trace telemetry
+    "learner_",       # RLlib learner update metrics
+    "node_",          # raylet reporter node gauges
+    "object_store_",  # per-node store pressure (spill/evict/pin)
+    "serve_",         # LLM serving latency/queue metrics
+    "train_",         # train-session report metrics
+    "worker_",        # per-worker process gauges
+)
 
 
 def _metric_bindings(tree: ast.Module) -> Dict[str, str]:
@@ -144,6 +167,19 @@ def check_paths(root: str) -> List[str]:
                 f"{d['where']}: metric name {name!r} already carries the "
                 f"rtpu_ prefix; the exporter adds it (would become "
                 f"rtpu_rtpu_...)")
+        if not name.startswith(_FAMILIES):
+            problems.append(
+                f"{d['where']}: metric name {name!r} is outside the "
+                f"registered families {sorted(set(_FAMILIES))}; prefix it "
+                f"with its subsystem family (or extend _FAMILIES in "
+                f"scripts/check_metrics.py)")
+        tag_keys = d.get("tag_keys")
+        if d["class"] == "Gauge" and tag_keys and "pid" in tag_keys:
+            problems.append(
+                f"{d['where']}: gauge {name!r} declares tag key 'pid' — "
+                f"the exporter appends its own pid=<source> label to "
+                f"every gauge and duplicate label names break the "
+                f"Prometheus scrape")
 
     by_name: Dict[str, List[Dict[str, Any]]] = {}
     for d in decls:

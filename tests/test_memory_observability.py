@@ -1,0 +1,269 @@
+"""Memory & data-pipeline observability: DatasetStats, memory_summary,
+spill/eviction accounting, and the dashboard surfacing endpoints
+(reference: `python/ray/data/_internal/stats.py`, `ray memory` /
+`internal_api.memory_summary`).
+"""
+
+import gc
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data._internal.stats import DatasetStats
+
+MB = 1024 * 1024
+
+
+@pytest.fixture(scope="module")
+def small_store_cluster():
+    """Tiny object store so a few MiB-sized puts force spills; dashboard
+    on so the HTTP surfacing can be checked against the same cluster."""
+    info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                        object_store_memory=8 * MB,
+                        include_dashboard=True,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- DatasetStats
+
+class TestDatasetStats:
+    def test_wrap_output_counts_blocks_rows_bytes(self):
+        from ray_tpu.data.block import BlockAccessor
+
+        stats = DatasetStats()
+        blocks = [BlockAccessor.from_rows([{"x": i}]) for i in range(3)]
+        out = list(stats.wrap_output("s", iter(blocks)))
+        assert len(out) == 3
+        st = stats.stages["s"]
+        assert st.blocks_out == 3 and st.rows_out == 3
+        assert st.bytes_out > 0 and st.wall_time_s >= 0
+
+    def test_blocked_vs_executing_split(self):
+        stats = DatasetStats()
+
+        def slow_source():
+            for i in range(2):
+                time.sleep(0.05)  # upstream latency = blocked time
+                yield object()
+
+        inner = stats.wrap_input("s", slow_source())
+        list(stats.wrap_output("s", inner))
+        st = stats.stages["s"]
+        assert st.blocked_on_input_s >= 0.08
+        assert st.wall_time_s >= st.blocked_on_input_s
+        assert st.executing_s == pytest.approx(
+            st.wall_time_s - st.blocked_on_input_s)
+
+    def test_merge_and_dict_roundtrip(self):
+        a, b = DatasetStats(), DatasetStats()
+        a.stage("s").rows_out = 10
+        a.stage("s").tasks_submitted = 2
+        b.stage("s").rows_out = 5
+        b.stage("t").actor_tasks_submitted = 1
+        a.merge(b)
+        assert a.stages["s"].rows_out == 15
+        assert a.stages["t"].actor_tasks_submitted == 1
+        rt = DatasetStats.from_dict(a.to_dict())
+        assert rt.stages["s"].rows_out == 15
+        assert rt.stages["s"].tasks_submitted == 2
+
+    def test_finalize_emits_once(self):
+        stats = DatasetStats()
+        stats.stage("s").blocks_out = 1
+        stats.finalize()
+        end = stats.end_ts
+        time.sleep(0.01)
+        stats.finalize()  # second call is a no-op
+        assert stats.end_ts == end
+
+    def test_summary_renders_all_stages(self):
+        stats = DatasetStats()
+        stats.stage("read").rows_out = 100
+        stats.stage("map_batches").rows_out = 100
+        text = stats.summary("plan")
+        assert "Stage 0 read" in text and "Stage 1 map_batches" in text
+        assert "blocked on input" in text
+
+    def test_local_dataset_stats_report(self):
+        # No cluster needed: the inline executor records stats too.
+        ds = rdata.range(50).map_batches(lambda b: b)
+        assert ds.count() == 50
+        report = ds.stats()
+        assert "Execution stats over 1 run(s)" in report
+        assert "blocks produced" in report
+        # A second run folds into the same aggregate.
+        ds.count()
+        assert "over 2 run(s)" in ds.stats()
+
+
+def test_dataset_stats_distributed(small_store_cluster):
+    """Multi-stage pipeline: per-stage submissions counted, the run's
+    stages land in ray_tpu.timeline() as data.stage spans, and the
+    rtpu_data_* series reach /metrics."""
+    ds = rdata.range(400, override_num_blocks=4).map_batches(
+        lambda b: {"id": b["id"] * 2})
+    assert ds.count() == 400
+    report = ds.stats()
+    assert "Execution stats" in report
+    st = ds._stats.stages
+    assert st and any(s.tasks_submitted > 0 for s in st.values())
+    assert all(s.executing_s >= 0 for s in st.values())
+
+    spans = []
+    for _ in range(25):  # wait out the task-event flush interval
+        spans = [e for e in ray_tpu.timeline()
+                 if str(e.get("name", "")).startswith("data.stage:")]
+        if spans:
+            break
+        time.sleep(0.4)
+    assert spans, "no data.stage spans reached the timeline"
+
+    from ray_tpu.util import metrics as _metrics
+
+    _metrics.flush()
+    w = ray_tpu._private.worker.global_worker()
+    text = w.gcs.call("metrics_text", timeout=10)
+    assert "rtpu_data_rows_out_total" in text
+    assert "rtpu_data_tasks_submitted_total" in text
+
+
+def test_streaming_split_stats_aggregate(small_store_cluster):
+    ds = rdata.range(40, override_num_blocks=4)
+    (it,) = ds.streaming_split(1)
+    n = sum(len(b["id"]) for b in it.iter_batches(batch_size=None))
+    assert n == 40
+    # The coordinator executed the plan; both handles see its stats.
+    assert "read" in it.stats()
+    report = ds.stats()
+    assert "Stage 0 read" in report and "40 out" in report
+
+
+# ------------------------------------------------------- memory introspection
+
+def _totals():
+    from ray_tpu.util.state import memory_summary
+
+    return memory_summary(top_n=10)
+
+
+_MONOTONE = ("num_spills", "num_restores", "num_evictions",
+             "spill_time_s", "restore_time_s")
+
+
+def _assert_monotone(before, after):
+    for k in _MONOTONE:
+        assert after["totals"][k] >= before["totals"][k], k
+
+
+def test_memory_summary_spill_restore_delete_cycle(small_store_cluster):
+    """Counters are monotone and consistent across a forced
+    spill -> restore -> delete cycle (satellite: spill accounting)."""
+    base = _totals()
+    payload = b"x" * (3 * MB)
+    refs = [ray_tpu.put(payload) for _ in range(4)]  # 12 MiB into 8 MiB
+
+    spilled = _totals()
+    _assert_monotone(base, spilled)
+    assert spilled["totals"]["num_spills"] > base["totals"]["num_spills"]
+    assert spilled["totals"]["spilled_bytes"] > 0
+    assert spilled["totals"]["spill_time_s"] > base["totals"]["spill_time_s"]
+    # Every byte is accounted for: in memory or on disk, never dropped.
+    assert (spilled["totals"]["used"] + spilled["totals"]["spilled_bytes"]
+            >= 4 * 3 * MB)
+
+    # Reading a spilled object restores it (and may spill others).
+    for r in refs:
+        assert ray_tpu.get(r, timeout=60) == payload
+    restored = _totals()
+    _assert_monotone(spilled, restored)
+    assert (restored["totals"]["num_restores"]
+            > spilled["totals"]["num_restores"])
+    assert (restored["totals"]["restore_time_s"]
+            > spilled["totals"]["restore_time_s"])
+
+    # top-N view: owned by this driver, size-ordered.
+    top = restored["top_objects"]
+    assert top and top[0]["size"] >= top[-1]["size"]
+    assert any(o["reference"] == "owned" for o in top)
+
+    # Deleting the refs shrinks the store; counters never regress.
+    del refs
+    gc.collect()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        after = _totals()
+        if (after["totals"]["num_objects"]
+                <= restored["totals"]["num_objects"] - 4):
+            break
+        time.sleep(0.5)
+    _assert_monotone(restored, after)
+    assert (after["totals"]["used"] + after["totals"]["spilled_bytes"]
+            < restored["totals"]["used"]
+            + restored["totals"]["spilled_bytes"])
+
+
+def test_pinned_data_survives_pressure(small_store_cluster):
+    """Pinned-object safety, reconciled with the store's actual
+    semantics: primary (pinned) copies are SPILLED to disk under
+    pressure — never evicted/dropped — so every pinned ref stays fully
+    readable; evictions only ever claim unpinned secondary copies."""
+    base = _totals()
+    payloads = [bytes([i]) * (2 * MB) for i in range(6)]  # 12 MiB > 8 MiB
+    refs = [ray_tpu.put(p) for p in payloads]
+
+    under_pressure = _totals()
+    # Pressure was relieved by spilling, not by evicting pinned data.
+    assert (under_pressure["totals"]["num_spills"]
+            > base["totals"]["num_spills"])
+    assert (under_pressure["totals"]["num_evictions"]
+            == base["totals"]["num_evictions"])
+    # All pinned objects remain intact and readable.
+    for r, p in zip(refs, payloads):
+        assert ray_tpu.get(r, timeout=60) == p
+    del refs
+
+
+def test_api_memory_and_data_serve_same_numbers(small_store_cluster):
+    """GET /api/memory mirrors memory_summary(); GET /api/data exposes
+    the data_* series the executors emitted."""
+    from ray_tpu import _local_node
+
+    base = _local_node.dashboard_url
+    assert base
+    keep = ray_tpu.put(b"y" * MB)  # noqa: F841  (hold a live object)
+
+    ms = _totals()
+    mem = json.loads(urllib.request.urlopen(
+        base + "/api/memory?top_n=10", timeout=15).read())
+    assert len(mem["nodes"]) == len(ms["nodes"]) == 1
+    store = mem["nodes"][0]["store"]
+    # Static fields match exactly; activity counters can only have moved
+    # forward between the two snapshots.
+    assert store["capacity"] == ms["totals"]["capacity"]
+    assert store["num_spills"] >= ms["totals"]["num_spills"]
+    assert store["num_restores"] >= ms["totals"]["num_restores"]
+    assert mem["nodes"][0]["top_objects"]
+
+    # Per-node store gauges flow through the raylet reporter push.
+    deadline = time.time() + 30
+    series = {}
+    while time.time() < deadline:
+        series = mem.get("metrics") or {}
+        if any(k.startswith("object_store_used") for k in series):
+            break
+        time.sleep(1.0)
+        mem = json.loads(urllib.request.urlopen(
+            base + "/api/memory?top_n=10", timeout=15).read())
+    assert any(k.startswith("object_store_used") for k in series)
+    assert any(k.startswith("object_store_spills_total") for k in series)
+
+    dat = json.loads(urllib.request.urlopen(
+        base + "/api/data", timeout=15).read())
+    assert any(k.startswith("data_rows_out") for k in dat)
+    assert any(k.startswith("data_tasks_submitted") for k in dat)
