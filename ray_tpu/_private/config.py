@@ -136,6 +136,12 @@ _define("event_stats", bool, True,
 _define("task_events_buffer_size", int, 100_000,
         "Ring buffer capacity of task lifecycle events kept on the head "
         "(reference: gcs task manager ring buffer).")
+_define("cluster_events_buffer_size", int, 10_000,
+        "Ring buffer capacity of the GCS ClusterEventLog (typed "
+        "failure-forensics events; reference: gcs event export).")
+_define("worker_exit_tail_lines", int, 20,
+        "How many trailing log lines the raylet captures from a dead "
+        "worker's stdout/stderr files for death-error enrichment.")
 _define("metrics_report_interval_s", float, 2.0,
         "Flush cadence of user-defined ray_tpu.util.metrics to the GCS "
         "(reference: metrics_report_interval_ms).")

@@ -110,6 +110,19 @@ class GcsServer:
         # task events ring buffer (reference: gcs_task_manager.h:85)
         self.task_events: deque = deque(
             maxlen=GlobalConfig.task_events_buffer_size)
+        # Monotone per-state totals of everything ever pushed, so the
+        # rtpu_tasks_events_total exposition has counter semantics (the
+        # ring buffer itself shrinks as entries fall out).
+        self._task_event_counts: Dict[str, int] = defaultdict(int)
+
+        # Cluster event log (reference: event.proto structured export):
+        # typed, severity-tagged failure-forensics events in a bounded
+        # ring; severities/types validated against the schema registry.
+        self.cluster_events: deque = deque(
+            maxlen=GlobalConfig.cluster_events_buffer_size)
+        self._event_seq = 0
+        # (type, severity) -> monotone count for the Prometheus counter.
+        self._event_counts: Dict[Tuple[str, str], int] = defaultdict(int)
 
         # internal worker info registry (worker_id -> info)
         self.workers: Dict[bytes, Dict[str, Any]] = {}
@@ -262,42 +275,113 @@ class GcsServer:
             "cluster_resources", "available_resources", "internal_stats",
             "metrics_text", "get_cluster_load", "push_metrics",
             "user_metrics_summary",
+            "report_cluster_event", "list_cluster_events",
+            "summary_cluster_events",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
+
+    # --------------------------------------------------------- cluster events
+    def _record_event(self, event_type: str, message: str,
+                      severity: Optional[str] = None,
+                      node_id: Optional[str] = None, **extra) -> None:
+        """Append one typed event to the ClusterEventLog. ERROR-severity
+        events are additionally broadcast on the "logs" pubsub channel
+        so every driver echoes them (reference: error-message pubsub)."""
+        from ray_tpu.observability import events as _events
+
+        try:
+            event = _events.make_event(event_type, message,
+                                       severity=severity,
+                                       node_id=node_id, **extra)
+        except ValueError as e:
+            print(f"[gcs] WARNING: dropping malformed cluster event: {e}",
+                  file=sys.stderr, flush=True)
+            return
+        self._event_seq += 1
+        event["seq"] = self._event_seq
+        self.cluster_events.append(event)
+        self._event_counts[(event["type"], event["severity"])] += 1
+        if event["severity"] == "ERROR":
+            self.pubsub.publish("logs", {"cluster_event": event})
+
+    async def _h_report_cluster_event(self, event_type, message,
+                                      severity=None, node_id=None,
+                                      extra=None):
+        self._record_event(event_type, message, severity=severity,
+                           node_id=node_id, **(extra or {}))
+        return True
+
+    async def _h_list_cluster_events(self, event_type=None, severity=None,
+                                     node_id=None, limit=100):
+        """Newest-last slice of the event ring, optionally filtered by
+        type, severity, and node-id hex prefix."""
+        out = []
+        for e in self.cluster_events:
+            if event_type is not None and e["type"] != event_type:
+                continue
+            if severity is not None and e["severity"] != severity:
+                continue
+            if node_id is not None and not (
+                    e.get("node_id") or "").startswith(node_id):
+                continue
+            out.append(e)
+        return out[-max(int(limit), 0):]
+
+    async def _h_summary_cluster_events(self):
+        """Rollup by (type, severity) over everything ever recorded —
+        counts are monotone, unlike the bounded ring itself."""
+        by_type: Dict[str, Dict[str, int]] = defaultdict(dict)
+        for (etype, sev), n in self._event_counts.items():
+            by_type[etype][sev] = n
+        return {"total_recorded": self._event_seq,
+                "in_buffer": len(self.cluster_events),
+                "by_type": {t: dict(v) for t, v in by_type.items()}}
 
     # --------------------------------------------------------------- metrics
     async def _h_metrics_text(self) -> str:
         """Cluster metrics in Prometheus exposition format (reference:
         `stats/metric_defs.h` + MetricsAgent -> Prometheus scrape)."""
+        # Naming discipline (linted by scripts/check_metrics.py): the
+        # `_total` suffix is reserved for counters; state-breakdown
+        # gauges export without it.
         lines = [
-            "# HELP rtpu_nodes_total Nodes by liveness state.",
-            "# TYPE rtpu_nodes_total gauge",
+            "# HELP rtpu_nodes Nodes by liveness state.",
+            "# TYPE rtpu_nodes gauge",
         ]
         by_state: Dict[str, int] = defaultdict(int)
         for info in self.nodes.values():
             by_state[info["state"]] += 1
         for state, n in by_state.items():
-            lines.append(f'rtpu_nodes_total{{state="{state}"}} {n}')
+            lines.append(f'rtpu_nodes{{state="{state}"}} {n}')
 
-        lines += ["# HELP rtpu_actors_total Actors by lifecycle state.",
-                  "# TYPE rtpu_actors_total gauge"]
+        lines += ["# HELP rtpu_actors Actors by lifecycle state.",
+                  "# TYPE rtpu_actors gauge"]
         actor_states: Dict[str, int] = defaultdict(int)
         for a in self.actors.values():
             actor_states[a.get("state", "UNKNOWN")] += 1
         for state, n in actor_states.items():
-            lines.append(f'rtpu_actors_total{{state="{state}"}} {n}')
+            lines.append(f'rtpu_actors{{state="{state}"}} {n}')
 
+        # Counter semantics: monotone totals of everything ever pushed,
+        # NOT a scan of the ring buffer (which shrinks as entries age
+        # out and would make rate() see phantom resets).
         lines += ["# HELP rtpu_tasks_events_total Task lifecycle events "
-                  "recorded (ring buffer).",
-                  "# TYPE rtpu_tasks_events_total gauge"]
-        task_states: Dict[str, int] = defaultdict(int)
-        for e in self.task_events:
-            task_states[e.get("state", "UNKNOWN")] += 1
-        for state, n in task_states.items():
+                  "recorded since GCS start.",
+                  "# TYPE rtpu_tasks_events_total counter"]
+        for state, n in self._task_event_counts.items():
             lines.append(f'rtpu_tasks_events_total{{state="{state}"}} {n}')
 
-        lines += ["# HELP rtpu_resource_total Cluster resource capacity.",
-                  "# TYPE rtpu_resource_total gauge",
+        lines += ["# HELP rtpu_cluster_events_total Cluster events "
+                  "recorded since GCS start, by type and severity.",
+                  "# TYPE rtpu_cluster_events_total counter"]
+        for (etype, sev), n in self._event_counts.items():
+            lines.append(
+                f'rtpu_cluster_events_total{{type="{etype}",'
+                f'severity="{sev}"}} {n}')
+
+        lines += ["# HELP rtpu_resource_capacity Cluster resource "
+                  "capacity.",
+                  "# TYPE rtpu_resource_capacity gauge",
                   "# HELP rtpu_resource_available Cluster resource "
                   "availability.",
                   "# TYPE rtpu_resource_available gauge"]
@@ -307,22 +391,22 @@ class GcsServer:
             nid = snap["node_id"].hex()[:12]
             for key, val in snap["total"].items():
                 lines.append(
-                    f'rtpu_resource_total{{node="{nid}",resource="{key}"}} '
-                    f'{val}')
+                    f'rtpu_resource_capacity{{node="{nid}",'
+                    f'resource="{key}"}} {val}')
             for key, val in snap["available"].items():
                 lines.append(
                     f'rtpu_resource_available{{node="{nid}",'
                     f'resource="{key}"}} {val}')
 
-        lines += ["# HELP rtpu_placement_groups_total Placement groups by "
+        lines += ["# HELP rtpu_placement_groups Placement groups by "
                   "state.",
-                  "# TYPE rtpu_placement_groups_total gauge"]
+                  "# TYPE rtpu_placement_groups gauge"]
         pg_states: Dict[str, int] = defaultdict(int)
         for pg in self.placement_groups.values():
             pg_states[pg.get("state", "UNKNOWN")] += 1
         for state, n in pg_states.items():
             lines.append(
-                f'rtpu_placement_groups_total{{state="{state}"}} {n}')
+                f'rtpu_placement_groups{{state="{state}"}} {n}')
         lines.extend(self._render_user_metrics())
         return "\n".join(lines) + "\n"
 
@@ -549,6 +633,11 @@ class GcsServer:
         self._last_heartbeat[node_id] = time.monotonic()
         self.pubsub.publish("node", {"event": "ALIVE", "node_id": node_id,
                                      "addr": addr})
+        self._record_event(
+            "NODE_ADDED",
+            f"node {node_id.hex()[:12]} joined at "
+            f"{addr[0]}:{addr[1]} with {resources}",
+            node_id=node_id.hex())
         return {"system_config": GlobalConfig.dump_system_config(),
                 "nodes": self._nodes_snapshot()}
 
@@ -626,6 +715,13 @@ class GcsServer:
         self._view_seq += 1
         self.pubsub.publish("node", {"event": "DEAD", "node_id": node_id,
                                      "reason": reason})
+        # A drain is operator intent; anything else is a failure.
+        self._record_event(
+            "NODE_REMOVED",
+            f"node {node_id.hex()[:12]} marked DEAD: {reason} "
+            f"(last heartbeat {age} ago)",
+            severity="WARNING" if reason == "drained" else "ERROR",
+            node_id=node_id.hex(), reason=reason)
         # Fail/restart actors that lived on this node.
         for actor_id, a in list(self.actors.items()):
             if a.get("node_id") == node_id and a["state"] == ALIVE:
@@ -923,18 +1019,34 @@ class GcsServer:
         print(f"[gcs] actor {actor_id.hex()[:12]} failed "
               f"(restarts_used={a['restarts_used']}/{spec.max_restarts}): "
               f"{cause}", file=sys.stderr, flush=True)
+        node_hex = (a.get("node_id") or b"").hex() or None
         if a["restarts_used"] < spec.max_restarts or spec.max_restarts == -1:
             a["restarts_used"] += 1
             a["state"] = RESTARTING
             a["addr"] = None
             self.pubsub.publish("actor", {"actor_id": actor_id,
                                           "state": RESTARTING})
+            self._record_event(
+                "ACTOR_RESTART",
+                f"actor {actor_id.hex()[:12]} "
+                f"({a.get('class_name', '')}) restarting "
+                f"(restart {a['restarts_used']}/{spec.max_restarts}): "
+                f"{cause}",
+                node_id=node_hex, actor_id=actor_id.hex(), cause=str(cause))
             spawn_task(self._schedule_actor(actor_id))
         else:
             a["state"] = DEAD
             a["death_cause"] = cause
             self.pubsub.publish("actor", {"actor_id": actor_id, "state": DEAD,
                                           "cause": cause})
+            intended = "killed via kill_actor" in str(cause)
+            self._record_event(
+                "ACTOR_DEATH",
+                f"actor {actor_id.hex()[:12]} "
+                f"({a.get('class_name', '')}) died: {cause}",
+                # ray_tpu.kill is user intent, not a failure to page on.
+                severity="INFO" if intended else "ERROR",
+                node_id=node_hex, actor_id=actor_id.hex(), cause=str(cause))
             self._actor_events.setdefault(actor_id, asyncio.Event()).set()
             name_key = (a["name"], a["namespace"])
             if a["name"] and self.named_actors.get(name_key) == actor_id:
@@ -1190,6 +1302,10 @@ class GcsServer:
         self.jobs[job_id] = {"job_id": job_id, "driver_addr": driver_addr,
                              "metadata": metadata or {}, "state": "RUNNING",
                              "start_time": time.time()}
+        self._record_event("JOB_STARTED",
+                           f"job {job_id.hex()} registered by driver at "
+                           f"{driver_addr[0]}:{driver_addr[1]}",
+                           job_id=job_id.hex())
         return True
 
     async def _h_mark_job_finished(self, job_id):
@@ -1197,6 +1313,9 @@ class GcsServer:
         if job_id in self.jobs:
             self.jobs[job_id]["state"] = "FINISHED"
             self.jobs[job_id]["end_time"] = time.time()
+            self._record_event("JOB_FINISHED",
+                               f"job {job_id.hex()} finished",
+                               job_id=job_id.hex())
         return True
 
     async def _h_list_jobs(self):
@@ -1221,6 +1340,8 @@ class GcsServer:
     # ------------------------------------------------------------- task events
     async def _h_push_task_events(self, events):
         self.task_events.extend(events)
+        for e in events:
+            self._task_event_counts[e.get("state", "UNKNOWN")] += 1
         return True
 
     async def _h_get_task_events(self, job_id=None, limit=1000):

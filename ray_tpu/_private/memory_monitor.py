@@ -47,17 +47,25 @@ def usage_fraction(test_path: str = "") -> Optional[float]:
         return None
 
 
-def pick_victim(workers, busy_ids=frozenset()) -> Optional[object]:
+def pick_victim(workers, busy_ids=frozenset(),
+                rss=None) -> Optional[object]:
     """Worker-killing policy over _WorkerHandle values: leased task
     workers before actors (tasks retry for free; actors lose state);
     within a class, workers actually executing before idle-leased ones
-    (killing a pool-idle worker frees no task memory); newest lease
-    first (its work loses the least progress). ``busy_ids`` is the set
-    of worker_ids observed executing (raylet probes `busy_info`)."""
+    (killing a pool-idle worker frees no task memory); then largest
+    resident set first — the kill should be attributed to the worker
+    actually holding the memory, not whichever leased newest (observed:
+    newest-lease-first shooting a 50 MB bystander while a 4 GB hog kept
+    thrashing). ``busy_ids`` is the set of worker_ids observed executing
+    (raylet probes `busy_info`); ``rss`` maps worker_id -> resident
+    bytes (missing entries rank lowest). Lease recency breaks ties."""
     leased = [h for h in workers if h.lease is not None]
     if not leased:
         return None
     tasks = [h for h in leased if not h.is_actor]
     pool = tasks or leased
+    rss = rss or {}
     return max(pool, key=lambda h: (getattr(h, "worker_id", None) in busy_ids,
+                                    rss.get(getattr(h, "worker_id", None),
+                                            0.0),
                                     getattr(h, "lease_ts", 0.0)))

@@ -51,6 +51,8 @@ class _WorkerHandle:
         self.pool_key = pool_key if pool_key is not None else job_id
         self.runtime_env = runtime_env
         self.env_uris: list = []      # runtime_env cache entries in use
+        self.out_path: Optional[str] = None   # stdout log file
+        self.err_path: Optional[str] = None   # stderr log file
         self.lease: Optional[Dict[str, Any]] = None  # demand + tpu ids
         self.is_actor = False
         self.actor_id: Optional[bytes] = None
@@ -137,6 +139,17 @@ class Raylet:
         # worker_id -> True for workers the memory monitor shot; owners ask
         # via get_worker_exit_info to turn the crash into OutOfMemoryError.
         self._oom_killed: Set[bytes] = set()
+        # Workers whose death THIS raylet caused on purpose (pool cap,
+        # idle TTL, lease return, kill_worker, graceful worker_exiting):
+        # the reaper classifies them INTENDED_EXIT instead of reading the
+        # SIGKILL we sent as SYSTEM_ERROR.
+        self._intended_exit: Set[bytes] = set()
+        # worker_id -> exit forensics (taxonomy, exit code, last log
+        # lines) captured at reap time; served via get_worker_exit_info
+        # so owners enrich WorkerCrashedError/ActorDiedError messages.
+        self._exit_info: Dict[bytes, Dict[str, Any]] = {}
+        # Spill counter watermark for SPILL_PRESSURE events.
+        self._spills_reported = 0
         self._worker_info_cache: Dict[bytes, Any] = {}
         # pool_key -> (message, ts) of the last runtime_env setup failure:
         # turned into a fast lease error so owners fail tasks with
@@ -198,9 +211,26 @@ class Raylet:
             "prepare_bundle", "commit_bundle", "return_bundle",
             "kill_worker", "node_stats", "shutdown_node", "get_tasks_info",
             "profile_worker",
-            "get_worker_exit_info", "runtime_env_stats",
+            "get_worker_exit_info", "runtime_env_stats", "get_log",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
+
+    def _report_event(self, event_type: str, message: str,
+                      severity: Optional[str] = None, **extra) -> None:
+        """Fire-and-forget a typed event to the GCS ClusterEventLog."""
+        if self._dead:
+            return
+
+        async def _send():
+            try:
+                await self.gcs.acall(
+                    "report_cluster_event", event_type=event_type,
+                    message=message, severity=severity,
+                    node_id=self.node_id.hex(), extra=extra, timeout=10)
+            except Exception:
+                pass
+
+        spawn_task(_send())
 
     # -------------------------------------------------------------- heartbeat
     async def _heartbeat_loop(self):
@@ -369,8 +399,15 @@ class Raylet:
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         worker_id = WorkerID.from_random()
-        out = open(os.path.join(
-            log_dir, f"worker-{worker_id.hex()[:12]}.out"), "wb")
+        out_path = os.path.join(
+            log_dir, f"worker-{worker_id.hex()[:12]}.out")
+        err_path = os.path.join(
+            log_dir, f"worker-{worker_id.hex()[:12]}.err")
+        out = open(out_path, "wb")
+        # Separate stderr stream: tracebacks must reach the driver tagged
+        # as stderr (and survive for exit forensics) instead of being
+        # interleaved into stdout.
+        err = open(err_path, "wb")
         env = self._worker_env()
         env_uris = []
         python_exe = sys.executable
@@ -383,6 +420,7 @@ class Raylet:
                 ctx = await self._runtime_env_manager().setup(runtime_env)
             except Exception as e:
                 out.close()
+                err.close()
                 self._starting[pool_key] = max(
                     0, self._starting[pool_key] - 1)
                 sys.stderr.write(f"[raylet] runtime_env setup failed: {e}\n")
@@ -448,15 +486,21 @@ class Raylet:
             try:
                 proc = await loop.run_in_executor(
                     None, lambda: subprocess.Popen(
-                        cmd, stdout=out, stderr=subprocess.STDOUT, env=env,
+                        cmd, stdout=out, stderr=err, env=env,
                         start_new_session=True))
             except Exception as e:
+                err.close()
                 return self._spawn_failed(e, out, pool_key, env_uris)
+            # The child holds its own copies of the log fds now.
+            out.close()
+            err.close()
             # Handle is completed when the worker registers back.
             handle = _WorkerHandle(worker_id.binary(), proc, ("", 0),
                                    job_id, pool_key=pool_key,
                                    runtime_env=runtime_env)
             handle.env_uris = env_uris
+            handle.out_path = out_path
+            handle.err_path = err_path
             self.workers[worker_id.binary()] = handle
             # Hold the startup-concurrency slot until the worker
             # REGISTERS: the expensive part of a spawn is the Python
@@ -513,6 +557,7 @@ class Raylet:
         if len(self._idle[handle.pool_key]) >= self._max_workers:
             self.workers.pop(handle.worker_id, None)
             self._release_worker_env(handle)
+            self._intended_exit.add(handle.worker_id)
             try:
                 self._retire_proc(handle.proc)
             except Exception:
@@ -599,6 +644,7 @@ class Raylet:
                 handle = idle.popleft()
                 self.workers.pop(handle.worker_id, None)
                 self._release_worker_env(handle)
+                self._intended_exit.add(handle.worker_id)
                 try:
                     self._retire_proc(handle.proc)
                 except Exception:
@@ -626,8 +672,70 @@ class Raylet:
                 pass
         self._dying = still
 
+    def _classify_exit(self, worker_id: bytes, handle, code) -> Dict[str, Any]:
+        """Waitpid-status exit taxonomy + last-K log line capture, cached
+        for get_worker_exit_info (reference: WorkerExitType plumbing in
+        worker-failure RPCs)."""
+        from ray_tpu._private.log_monitor import tail_file
+        from ray_tpu.observability import events as _events
+
+        exit_type = _events.classify_worker_exit(
+            code, oom_killed=worker_id in self._oom_killed,
+            intended=worker_id in self._intended_exit)
+        self._intended_exit.discard(worker_id)
+        # Marks for workers retired outside the reaper's view (popped
+        # from self.workers before the kill) are never consumed; bound
+        # the set so long-lived churny raylets don't grow it forever.
+        if len(self._intended_exit) > 4096:
+            self._intended_exit.clear()
+        k = GlobalConfig.worker_exit_tail_lines
+        info = {
+            "exit_type": exit_type,
+            "exit_code": code,
+            "oom_killed": exit_type == "OOM_KILLED",
+            "pid": handle.proc.pid,
+            "node_id": self.node_id.hex(),
+            "last_lines": tail_file(handle.out_path, k)
+            if handle.out_path else [],
+            "last_err_lines": tail_file(handle.err_path, k)
+            if handle.err_path else [],
+        }
+        self._exit_info[worker_id] = info
+        while len(self._exit_info) > 1024:
+            self._exit_info.pop(next(iter(self._exit_info)))
+        return info
+
+    def _observe_worker_death(self, worker_id: bytes, handle,
+                              code) -> Dict[str, Any]:
+        """Classify a worker death and report the WORKER_EXIT event —
+        exactly once, whichever path saw the corpse first. The reaper's
+        200ms poll usually loses the race to the owner's return_worker
+        RPC (the owner sees the connection drop within ms), so without
+        the return-path hook most task-worker crashes would vanish from
+        the event log unclassified."""
+        if worker_id in self._exit_info:
+            return self._exit_info[worker_id]
+        from ray_tpu.observability import events as _events
+
+        info = self._classify_exit(worker_id, handle, code)
+        exit_type = info["exit_type"]
+        self._report_event(
+            "WORKER_EXIT",
+            f"worker {worker_id.hex()[:12]} (pid "
+            f"{handle.proc.pid}) exited with code {code}: "
+            f"{exit_type}",
+            severity=_events.exit_severity(exit_type),
+            worker_id=worker_id.hex(), pid=handle.proc.pid,
+            exit_code=code, exit_type=exit_type,
+            is_actor=handle.is_actor)
+        return info
+
     async def _reaper_loop(self):
-        """Detect dead worker processes; report actor deaths to GCS."""
+        """Detect dead worker processes; classify each exit from its
+        waitpid status, capture log tails for forensics, report actor
+        deaths (with the classification) and WORKER_EXIT events to GCS."""
+        from ray_tpu.observability import events as _events
+
         last_ttl_sweep = time.monotonic()
         while not self._dead:
             await asyncio.sleep(0.2)
@@ -660,12 +768,16 @@ class Raylet:
                 if handle.lease is not None:
                     self._release_lease(handle)
                 self._release_orphaned_leases(worker_id)
+                info = self._observe_worker_death(worker_id, handle, code)
+                exit_type = info["exit_type"]
                 if handle.is_actor and handle.actor_id is not None:
+                    cause = (f"worker process exited with code {code} "
+                             f"[{exit_type}]")
+                    detail = _events.format_exit_detail(info)
                     try:
                         await self.gcs.acall(
                             "report_actor_death", actor_id=handle.actor_id,
-                            cause=f"worker process exited with code {code}",
-                            timeout=10)
+                            cause=cause + detail, timeout=10)
                     except Exception:
                         pass
 
@@ -819,6 +931,23 @@ class Raylet:
                                      records=records, timeout=10)
             except Exception:
                 pass
+            # Spill watermark -> SPILL_PRESSURE cluster event: one event
+            # per batch of new spills, not one per poll.
+            try:
+                stats = self.store.stats()
+                spills = int(stats.get("num_spills", 0))
+                if spills > self._spills_reported:
+                    self._report_event(
+                        "SPILL_PRESSURE",
+                        f"object store spilled "
+                        f"{spills - self._spills_reported} object(s) "
+                        f"({int(stats.get('spilled_bytes', 0))} bytes "
+                        f"spilled since start)",
+                        num_spills=spills,
+                        spilled_bytes=int(stats.get("spilled_bytes", 0)))
+                    self._spills_reported = spills
+            except Exception:
+                pass
 
     async def _h_profile_worker(self, worker_id=None, duration_s=5.0,
                                 kind="profile"):
@@ -887,7 +1016,21 @@ class Raylet:
                 return h.worker_id
         hits = await asyncio.gather(*(probe(h) for h in leased))
         busy = {wid for wid in hits if wid is not None}
-        return memory_monitor.pick_victim(leased, busy)
+        # Per-worker RSS so the kill is attributed to the worker actually
+        # holding the memory, not whichever leased newest.
+        rss: Dict[bytes, float] = {}
+        try:
+            import psutil
+
+            for h in leased:
+                try:
+                    rss[h.worker_id] = float(
+                        psutil.Process(h.proc.pid).memory_info().rss)
+                except Exception:
+                    pass
+        except Exception:
+            pass
+        return memory_monitor.pick_victim(leased, busy, rss)
 
     # ---------------------------------------------------------- lease protocol
     def _strategy_allows_local(self, strategy) -> bool:
@@ -1108,12 +1251,18 @@ class Raylet:
                 f"[raylet] reclaiming lease of worker "
                 f"{h.worker_id.hex()[:12]}: owner "
                 f"{owner_id.hex()[:12]} died\n")
+            self._report_event(
+                "LEASE_RECLAIMED",
+                f"reclaimed lease of worker {h.worker_id.hex()[:12]}: "
+                f"owner {owner_id.hex()[:12]} died",
+                worker_id=h.worker_id.hex(), owner_id=owner_id.hex())
             self._release_lease(h)
             # The worker may still be executing a push from the dead
             # owner; its results have nowhere to go, so retire the
             # process rather than re-offering it mid-task.
             self.workers.pop(h.worker_id, None)
             self._release_worker_env(h)
+            self._intended_exit.add(h.worker_id)
             self._retire_proc(h.proc)
 
     async def _lease_dispatch_loop(self):
@@ -1177,11 +1326,19 @@ class Raylet:
                 f"{worker_id.hex()[:12]}\n")
             return False
         self._release_lease(handle)
-        if kill or handle.proc.poll() is not None:
+        code = handle.proc.poll()
+        if kill or code is not None:
             self.workers.pop(worker_id, None)
             self._release_worker_env(handle)
-            if handle.proc.poll() is None:
+            if code is None:
+                self._intended_exit.add(worker_id)
                 self._retire_proc(handle.proc)
+            else:
+                # The worker is already a corpse: the owner noticed the
+                # crash and returned the lease before the reaper's poll.
+                # Classify + report here or the death never hits the
+                # event log.
+                self._observe_worker_death(worker_id, handle, code)
         else:
             self._offer_worker(handle)
         return True
@@ -1214,6 +1371,7 @@ class Raylet:
                 "worker_id": handle.worker_id, "tpu_ids": tpu_ids}
 
     async def _h_worker_exiting(self, worker_id):
+        self._intended_exit.add(worker_id)
         handle = self.workers.pop(worker_id, None)
         if handle is not None:
             self._release_lease(handle)
@@ -1229,6 +1387,9 @@ class Raylet:
         handle = self.workers.get(worker_id)
         if handle is None:
             return False
+        # A kill the framework itself issued must not read as
+        # SYSTEM_ERROR when the reaper classifies the SIGKILL.
+        self._intended_exit.add(worker_id)
         if force:
             self._retire_proc(handle.proc)
         else:
@@ -1442,9 +1603,59 @@ class Raylet:
 
     async def _h_get_worker_exit_info(self, worker_id):
         """Why did this worker die? Lets the owner raise OutOfMemoryError
-        instead of a generic WorkerCrashedError (reference: exit-type
-        plumbing in worker failure RPCs)."""
-        return {"oom_killed": worker_id in self._oom_killed}
+        instead of a generic WorkerCrashedError, and enrich the death
+        error with the exit classification + the worker's last log lines
+        (reference: exit-type plumbing in worker failure RPCs)."""
+        info = dict(self._exit_info.get(worker_id) or {})
+        info["oom_killed"] = (info.get("oom_killed", False)
+                              or worker_id in self._oom_killed)
+        return info
+
+    async def _h_get_log(self, worker_id=None, task_id=None, tail=100):
+        """Per-task / per-worker log retrieval over the raylet (reference:
+        `ListLogs`/`StreamLog` in the reference dashboard agent). Log
+        files outlive their workers, so this serves dead workers too —
+        exactly the ones a postmortem cares about. Returns {"lines":
+        [...]} where stderr lines follow stdout lines per file."""
+        from ray_tpu._private import log_monitor
+
+        tail = max(int(tail), 0)
+        log_dir = os.path.join(self.session_dir, "logs") \
+            if self.session_dir else ""
+        lines: List[str] = []
+        if worker_id is not None:
+            wid_hex = worker_id.hex() if isinstance(worker_id, bytes) \
+                else str(worker_id)
+            prefix = wid_hex[:12]
+            for suffix in (".out", ".err"):
+                path = os.path.join(log_dir, f"worker-{prefix}{suffix}")
+                got = log_monitor.read_task_lines(
+                    path, task_id_hex=None, max_lines=tail)
+                if got and suffix == ".err":
+                    lines.extend(f"[stderr] {ln}" for ln in got)
+                else:
+                    lines.extend(got)
+        elif task_id is not None:
+            tid_hex = task_id.hex() if isinstance(task_id, bytes) \
+                else str(task_id)
+            try:
+                names = sorted(os.listdir(log_dir))
+            except OSError:
+                names = []
+            for name in names:
+                if not (name.startswith("worker-")
+                        and name.endswith((".out", ".err"))):
+                    continue
+                got = log_monitor.read_task_lines(
+                    os.path.join(log_dir, name), task_id_hex=tid_hex,
+                    max_lines=tail)
+                if got and name.endswith(".err"):
+                    lines.extend(f"[stderr] {ln}" for ln in got)
+                else:
+                    lines.extend(got)
+        if tail:
+            lines = lines[-tail:]
+        return {"lines": lines}
 
     async def _h_get_tasks_info(self):
         out = []

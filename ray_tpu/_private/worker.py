@@ -1317,19 +1317,12 @@ class Worker:
                     return
                 if attempt < spec.max_retries:
                     attempt += 1
+                    self._report_task_retry(spec, attempt,
+                                            "worker crashed")
                     await asyncio.sleep(min(0.05 * (2 ** attempt), 2.0))
                     continue
-                err_cls = exc.WorkerCrashedError
-                detail = ""
-                try:
-                    info = await outcome.lessor.acall(
-                        "get_worker_exit_info",
-                        worker_id=outcome.worker_id, timeout=5)
-                    if info.get("oom_killed"):
-                        err_cls = exc.OutOfMemoryError
-                        detail = " (OOM-killed by the node memory monitor)"
-                except Exception:
-                    pass
+                err_cls, detail = await self._describe_worker_death(
+                    outcome)
                 self._fail_task(spec, serialize_error(err_cls(
                     f"worker died while executing task {spec.name} "
                     f"(after {attempt} retries){detail}")))
@@ -1350,6 +1343,8 @@ class Worker:
                         and self._should_retry_app_error(
                             spec, reply["app_error"], attempt)):
                     attempt += 1
+                    self._report_task_retry(spec, attempt,
+                                            "application error")
                     continue
                 self._fail_task(spec, reply["app_error"])
                 self._release_deps(spec)
@@ -1358,6 +1353,79 @@ class Worker:
             self._release_deps(spec)
             self._record_task_event(spec, "FINISHED")
             return
+
+    def _report_task_retry(self, spec: TaskSpec, attempt: int,
+                           reason: str) -> None:
+        """Fire-and-forget TASK_RETRY cluster event; forensics must never
+        slow down or fail the retry itself."""
+        async def _send():
+            try:
+                await self.gcs.acall(
+                    "report_cluster_event", event_type="TASK_RETRY",
+                    message=f"task {spec.name} attempt {attempt}/"
+                            f"{spec.max_retries} retrying: {reason}",
+                    extra={"task_id": spec.task_id.hex(),
+                           "attempt": attempt, "reason": reason},
+                    timeout=10)
+            except Exception:
+                pass
+
+        try:
+            asyncio.get_running_loop().create_task(_send())
+        except RuntimeError:
+            pass
+
+    async def _describe_worker_death(self, outcome: "_WorkerCrashed"):
+        """Forensics for a final (retries-exhausted) worker death: exit
+        classification + last log lines from the lessor raylet, recent
+        same-node cluster events from the GCS. The lessor being
+        unreachable while the GCS says its node is DEAD classifies as
+        NODE_DEATH. Returns (exception_class, message_suffix)."""
+        from ray_tpu.observability import events as _events
+
+        err_cls = exc.WorkerCrashedError
+        detail = ""
+        info: dict = {}
+        node_hex = None
+        try:
+            info = await outcome.lessor.acall(
+                "get_worker_exit_info",
+                worker_id=outcome.worker_id, timeout=5) or {}
+            if not info.get("exit_type"):
+                # The raylet's reaper polls every 200ms; the crash was
+                # noticed here first. One short retry for the verdict.
+                await asyncio.sleep(0.5)
+                info = await outcome.lessor.acall(
+                    "get_worker_exit_info",
+                    worker_id=outcome.worker_id, timeout=5) or {}
+            node_hex = info.get("node_id")
+        except Exception:
+            try:
+                nodes = await self.gcs.acall("get_all_nodes", timeout=5)
+                lessor_addr = (outcome.lessor.host, outcome.lessor.port)
+                for n in nodes:
+                    if tuple(n.get("addr") or ()) == lessor_addr:
+                        node_hex = n["node_id"].hex()
+                        if n.get("state") == "DEAD":
+                            info = {"exit_type": "NODE_DEATH"}
+                        break
+            except Exception:
+                pass
+        if info.get("oom_killed"):
+            err_cls = exc.OutOfMemoryError
+            detail = " (OOM-killed by the node memory monitor)"
+            info.setdefault("exit_type", "OOM_KILLED")
+        elif info.get("exit_type") == "NODE_DEATH":
+            detail = " (the node hosting the worker died)"
+        recent = None
+        if node_hex:
+            try:
+                recent = await self.gcs.acall(
+                    "list_cluster_events", node_id=node_hex, limit=5,
+                    timeout=5)
+            except Exception:
+                recent = None
+        return err_cls, detail + _events.format_exit_detail(info, recent)
 
     def _should_retry_app_error(self, spec: TaskSpec, payload: bytes,
                                 attempt: int) -> bool:
@@ -2436,10 +2504,41 @@ class Worker:
             args, kwargs = values, {}
         return args, kwargs
 
+    def _mark_log_task(self, spec: Optional[TaskSpec],
+                       actor_id_hex: str = "",
+                       end_tid: Optional[str] = None) -> None:
+        """Bracket this process's log streams with task-attribution
+        markers (consumed by the raylet's LogMonitor, never echoed) so
+        `get_log(task_id=...)` can slice one task's output out of a
+        pooled worker's log file. spec=None closes the open span
+        (``end_tid`` hex, or the calling thread's current task)."""
+        if self.mode != MODE_WORKER:
+            return
+        from ray_tpu._private.log_monitor import (
+            task_end_marker, task_marker,
+        )
+
+        if spec is None:
+            tid_hex = end_tid or (self._ctx.task_id.hex()
+                                  if self._ctx.task_id else None)
+            if tid_hex is None:
+                return
+            line = task_end_marker(tid_hex)
+        else:
+            line = task_marker(spec.task_id.hex(), actor_id_hex,
+                               spec.name)
+        for stream in (sys.stdout, sys.stderr):
+            try:
+                stream.write(line + "\n")
+                stream.flush()
+            except Exception:
+                pass
+
     def _execute_task(self, spec: TaskSpec, tpu_ids) -> Dict[str, Any]:
         if spec.task_id.binary() in self._cancelled_tasks:
             return {"results": [], "app_error": serialize_error(
                 exc.TaskCancelledError(f"task {spec.name} cancelled"))}
+        self._mark_log_task(spec)
         self._ctx.task_id = spec.task_id
         self._ctx.task_name = spec.name
         self._ctx.tpu_ids = list(tpu_ids or [])
@@ -2469,6 +2568,7 @@ class Worker:
         finally:
             self._executing_tids.pop(tid, None)
             self._thread_task.pop(threading.get_ident(), None)
+            self._mark_log_task(None)
             self._ctx.task_id = None
             self._ctx.task_name = ""
 
@@ -2759,6 +2859,7 @@ class Worker:
         if method is None:
             return {"results": [], "app_error": serialize_error(
                 AttributeError(f"actor has no method {method_name!r}"))}
+        self._mark_log_task(spec, actor.spec.actor_id.hex())
         try:
             args, kwargs = await loop.run_in_executor(
                 self._task_executor, self._resolve_args, spec)
@@ -2782,6 +2883,8 @@ class Worker:
             return {"results": results, "contained": contained}
         except Exception as e:  # noqa: BLE001
             return {"results": [], "app_error": serialize_error(e)}
+        finally:
+            self._mark_log_task(None, end_tid=spec.task_id.hex())
 
     # ======================================================================
     # Runtime context / shutdown

@@ -15,24 +15,30 @@ import json
 from typing import Any, Dict, List, Optional
 
 _PANELS: List[Dict[str, str]] = [
-    {"title": "Alive nodes", "expr": 'rtpu_nodes_total{state="ALIVE"}',
+    {"title": "Alive nodes", "expr": 'rtpu_nodes{state="ALIVE"}',
      "unit": "short"},
-    {"title": "Actors by state", "expr": "rtpu_actors_total",
+    {"title": "Actors by state", "expr": "rtpu_actors",
      "legend": "{{state}}", "unit": "short"},
-    {"title": "Task events by state", "expr": "rtpu_tasks_events_total",
+    {"title": "Task events by state",
+     "expr": "rate(rtpu_tasks_events_total[5m])",
      "legend": "{{state}}", "unit": "short"},
+    {"title": "Cluster events rate",
+     "expr": "rate(rtpu_cluster_events_total[5m])",
+     "legend": "{{type}}/{{severity}}", "unit": "short"},
     {"title": "CPU available vs total",
      "expr": 'rtpu_resource_available{resource="CPU"}',
-     "expr_b": 'rtpu_resource_total{resource="CPU"}', "unit": "short"},
+     "expr_b": 'rtpu_resource_capacity{resource="CPU"}',
+     "unit": "short"},
     {"title": "TPU available vs total",
      "expr": 'rtpu_resource_available{resource="TPU"}',
-     "expr_b": 'rtpu_resource_total{resource="TPU"}', "unit": "short"},
+     "expr_b": 'rtpu_resource_capacity{resource="TPU"}',
+     "unit": "short"},
     {"title": "Object store used",
-     "expr": 'rtpu_resource_total{resource="object_store_memory"} - '
+     "expr": 'rtpu_resource_capacity{resource="object_store_memory"} - '
              'rtpu_resource_available{resource="object_store_memory"}',
      "unit": "bytes"},
     {"title": "Placement groups",
-     "expr": "rtpu_placement_groups_total", "legend": "{{state}}",
+     "expr": "rtpu_placement_groups", "legend": "{{state}}",
      "unit": "short"},
     # --- serving / JIT / device telemetry (observability plane) ---
     {"title": "Serve TTFT p50/p99",

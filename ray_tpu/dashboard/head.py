@@ -20,6 +20,15 @@ Endpoints:
   GET /api/serve      live serving/JIT telemetry summary
   GET /api/memory     per-node object-store introspection + spill metrics
   GET /api/data       data-pipeline (DatasetStats) metric summary
+  GET /api/events     ClusterEventLog (failure forensics) with ?type=,
+                      ?severity= (INFO/WARNING/ERROR), ?node=, ?limit=
+                      filters. Registered event types: WORKER_EXIT,
+                      ACTOR_DEATH, ACTOR_RESTART, NODE_ADDED,
+                      NODE_REMOVED, LEASE_RECLAIMED, TASK_RETRY,
+                      SPILL_PRESSURE, JOB_STARTED, JOB_FINISHED.
+  GET /api/logs       per-task/actor/worker log retrieval: exactly one
+                      of ?task_id=, ?actor_id=, ?worker_id= (hex), plus
+                      ?tail=N (default 100)
   GET /metrics        Prometheus text (scrape target)
 """
 
@@ -189,6 +198,93 @@ class DashboardHead:
             "user_metrics_summary", prefixes=["object_store_"], timeout=10)
         return web.json_response({"nodes": out, "metrics": summary or {}})
 
+    async def events(self, req) -> web.Response:
+        """ClusterEventLog query surface (failure forensics): typed,
+        severity-tagged events with type/severity/node filters."""
+        try:
+            limit = int(req.query.get("limit", 100))
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        rows = await self._gcs.acall(
+            "list_cluster_events",
+            event_type=req.query.get("type"),
+            severity=req.query.get("severity"),
+            node_id=req.query.get("node"),
+            limit=limit, timeout=10)
+        return web.json_response(rows or [])
+
+    async def logs(self, req) -> web.Response:
+        """Per-task / per-actor / per-worker log retrieval, resolved
+        through the GCS and served by the owning raylet from the on-disk
+        log files (so dead workers' logs remain retrievable)."""
+        task_id = req.query.get("task_id")
+        actor_id = req.query.get("actor_id")
+        worker_id = req.query.get("worker_id")
+        if sum(bool(s) for s in (task_id, actor_id, worker_id)) != 1:
+            return web.json_response(
+                {"error": "exactly one of task_id=, actor_id=, "
+                          "worker_id= is required"}, status=400)
+        try:
+            tail = int(req.query.get("tail", 100))
+        except ValueError:
+            return web.json_response({"error": "bad tail"}, status=400)
+        try:
+            if actor_id:
+                info = await self._gcs.acall(
+                    "get_actor_info", actor_id=bytes.fromhex(actor_id),
+                    timeout=10)
+                if not info or not info.get("worker_id"):
+                    return web.json_response(
+                        {"error": f"actor {actor_id} not found or has "
+                                  "no worker"}, status=404)
+                worker_id = info["worker_id"].hex()
+            if worker_id:
+                node_hex = None
+                for row in await self._gcs.acall("list_workers",
+                                                 timeout=10):
+                    if row["worker_id"].hex() == worker_id:
+                        node_hex = row["node_id"].hex()
+                        break
+                if node_hex is None:
+                    return web.json_response(
+                        {"error": f"worker {worker_id} not found"},
+                        status=404)
+                client = await self._node_raylet(node_hex)
+                if client is None:
+                    return web.json_response(
+                        {"error": f"node {node_hex[:12]} unreachable"},
+                        status=404)
+                try:
+                    reply = await client.acall(
+                        "get_log", worker_id=bytes.fromhex(worker_id),
+                        tail=tail, timeout=15)
+                finally:
+                    client.close()
+                return web.json_response(
+                    {"lines": reply.get("lines", [])})
+            # task_id: fan out to every alive node; the attribution
+            # markers make non-owners return nothing.
+            lines: List[str] = []
+            nodes = await self._gcs.acall("get_all_nodes", timeout=10)
+            for n in nodes or []:
+                if n["state"] != "ALIVE":
+                    continue
+                client = RpcClient(*tuple(n["addr"]))
+                try:
+                    reply = await client.acall(
+                        "get_log", task_id=task_id, tail=tail,
+                        timeout=15)
+                    lines.extend(reply.get("lines", []))
+                except Exception:
+                    pass
+                finally:
+                    client.close()
+            if tail:
+                lines = lines[-tail:]
+            return web.json_response({"lines": lines})
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+
     async def data_stats(self, _req) -> web.Response:
         """Data-pipeline telemetry: per-stage ``data_*`` series (rows/
         bytes/blocks out, wall vs blocked time, in-flight tasks and queue
@@ -303,6 +399,8 @@ class DashboardHead:
         app.router.add_get("/api/serve", self.serve_stats)
         app.router.add_get("/api/memory", self.memory)
         app.router.add_get("/api/data", self.data_stats)
+        app.router.add_get("/api/events", self.events)
+        app.router.add_get("/api/logs", self.logs)
         app.router.add_get("/api/profile", self.profile)
         app.router.add_get("/api/profile/stacks", self.profile)
         app.router.add_post("/api/job_submissions", self.submit_job)

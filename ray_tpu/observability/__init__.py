@@ -24,6 +24,11 @@ shared instrumentation layer every hot path reports through:
   counters) sampled from ``NodeObjectStore.stats()`` at each flush.
 - ``timeline``: the Chrome-trace builder shared by
   ``ray_tpu.timeline()`` and the dashboard's ``GET /api/timeline``.
+- ``events``: the cluster event schema registry — typed,
+  severity-tagged failure-forensics events (worker-exit taxonomy,
+  actor death/restart, node membership, lease reclaim, OOM) recorded
+  in the GCS ClusterEventLog and queried via
+  ``ray_tpu.util.state.list_cluster_events`` / ``GET /api/events``.
 
 Everything exports through the existing plane: metric objects are
 ``ray_tpu.util.metrics`` Counters/Gauges/Histograms (flushed to the GCS
@@ -41,6 +46,13 @@ from ray_tpu.observability.device import (  # noqa: F401
     sample_device_metrics,
 )
 from ray_tpu.observability.data import data_metrics  # noqa: F401
+from ray_tpu.observability.events import (  # noqa: F401
+    EVENT_TYPES,
+    SEVERITIES,
+    WORKER_EXIT_TYPES,
+    classify_worker_exit,
+    make_event,
+)
 from ray_tpu.observability.object_store import (  # noqa: F401
     object_store_metrics,
     register_store_sampler,
@@ -58,4 +70,6 @@ __all__ = [
     "sample_device_metrics", "serve_metrics", "train_metrics",
     "learner_metrics", "batch_num_samples", "build_chrome_trace",
     "data_metrics", "object_store_metrics", "register_store_sampler",
+    "EVENT_TYPES", "SEVERITIES", "WORKER_EXIT_TYPES",
+    "classify_worker_exit", "make_event",
 ]

@@ -1,0 +1,151 @@
+"""Cluster event schema registry — the failure-forensics vocabulary.
+
+Reference: `src/ray/protobuf/event.proto` (structured export events) and
+the `WorkerExitType` taxonomy consumed by `gcs_worker_manager`. Every
+event the framework records in the GCS ClusterEventLog MUST use a type
+declared here; a unit-test lint (tests/test_failure_forensics.py)
+enforces that, plus that every registered type is documented in the
+dashboard endpoint table (`ray_tpu/dashboard/head.py` docstring).
+
+The taxonomy exists so a dead worker is diagnosable from the driver:
+the raylet classifies each exit from the waitpid status (exit code vs.
+signal, cross-checked against the memory monitor's kill list and the
+pool's own intended retirements), and that classification rides the
+worker-death error all the way into the exception message.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("INFO", "WARNING", "ERROR")
+
+# Registered event types -> one-line description. One reviewable place;
+# emission sites reference these names as string literals so the lint
+# can cross-check them statically.
+EVENT_TYPES: Dict[str, str] = {
+    "WORKER_EXIT": "A worker process left the node's pool "
+                   "(classified by exit taxonomy).",
+    "ACTOR_DEATH": "An actor died permanently (restarts exhausted or "
+                   "no_restart kill).",
+    "ACTOR_RESTART": "An actor died and is being restarted.",
+    "NODE_ADDED": "A raylet registered with the GCS.",
+    "NODE_REMOVED": "A node was marked DEAD (drain, health-check "
+                    "failure, ...).",
+    "LEASE_RECLAIMED": "A raylet reclaimed a task-worker lease whose "
+                       "owner died.",
+    "TASK_RETRY": "A task attempt failed and is being retried.",
+    "SPILL_PRESSURE": "An object store spilled under memory pressure.",
+    "JOB_STARTED": "A driver registered a job.",
+    "JOB_FINISHED": "A job was marked finished.",
+}
+
+# Worker exit taxonomy (reference: `WorkerExitType`). The raylet picks
+# one per reaped worker; OOM_KILLED and INTENDED_EXIT take precedence
+# over the raw waitpid status because the raylet itself caused those
+# deaths (a SIGKILL it sent must not read as SYSTEM_ERROR).
+WORKER_EXIT_TYPES = (
+    "INTENDED_EXIT",   # clean exit 0, pool retirement, ray_tpu.kill
+    "USER_ERROR",      # nonzero exit code (uncaught exception, sys.exit)
+    "SYSTEM_ERROR",    # killed by a signal the framework didn't send
+    "OOM_KILLED",      # shot by the node memory monitor
+    "NODE_DEATH",      # the whole node went away
+)
+
+# Default severity per event type; emitters may override (e.g. a
+# WORKER_EXIT is INFO when intended, ERROR when OOM-killed).
+DEFAULT_SEVERITY: Dict[str, str] = {
+    "WORKER_EXIT": "WARNING",
+    "ACTOR_DEATH": "ERROR",
+    "ACTOR_RESTART": "WARNING",
+    "NODE_ADDED": "INFO",
+    "NODE_REMOVED": "ERROR",
+    "LEASE_RECLAIMED": "WARNING",
+    "TASK_RETRY": "WARNING",
+    "SPILL_PRESSURE": "WARNING",
+    "JOB_STARTED": "INFO",
+    "JOB_FINISHED": "INFO",
+}
+
+_EXIT_SEVERITY = {
+    "INTENDED_EXIT": "INFO",
+    "USER_ERROR": "WARNING",
+    "SYSTEM_ERROR": "ERROR",
+    "OOM_KILLED": "ERROR",
+    "NODE_DEATH": "ERROR",
+}
+
+
+def make_event(event_type: str, message: str,
+               severity: Optional[str] = None,
+               node_id: Optional[str] = None,
+               **extra: Any) -> Dict[str, Any]:
+    """Build a validated, JSON-able event record. ``node_id`` and all
+    ``extra`` values must already be plain (hex strings, ints) — events
+    flow to the dashboard's JSON endpoints unmodified."""
+    if event_type not in EVENT_TYPES:
+        raise ValueError(f"unregistered cluster event type {event_type!r}; "
+                         f"declare it in ray_tpu.observability.events")
+    sev = severity or DEFAULT_SEVERITY[event_type]
+    if sev not in SEVERITIES:
+        raise ValueError(f"unknown severity {sev!r} (want one of "
+                         f"{SEVERITIES})")
+    event = {"type": event_type, "severity": sev, "message": message,
+             "node_id": node_id, "ts": time.time()}
+    event.update(extra)
+    return event
+
+
+def classify_worker_exit(returncode: Optional[int], *,
+                         oom_killed: bool = False,
+                         intended: bool = False) -> str:
+    """Map a reaped worker's waitpid status to the exit taxonomy.
+
+    Popen semantics: negative returncode = killed by that signal,
+    0 = clean exit, positive = abnormal interpreter exit. The two
+    raylet-caused deaths override the raw status — the raylet SIGKILLs
+    both retired pool workers (intended) and OOM victims."""
+    if oom_killed:
+        return "OOM_KILLED"
+    if intended:
+        return "INTENDED_EXIT"
+    if returncode is None or returncode == 0:
+        return "INTENDED_EXIT"
+    if returncode < 0:
+        return "SYSTEM_ERROR"
+    return "USER_ERROR"
+
+
+def exit_severity(exit_type: str) -> str:
+    return _EXIT_SEVERITY.get(exit_type, "WARNING")
+
+
+def format_exit_detail(info: Optional[Dict[str, Any]],
+                       recent_events: Optional[List[Dict[str, Any]]] = None
+                       ) -> str:
+    """Render a worker-exit info record (raylet ``get_worker_exit_info``)
+    plus recent same-node events into the suffix of a death error
+    message. Returns "" when nothing is known."""
+    if not info:
+        return ""
+    parts: List[str] = []
+    exit_type = info.get("exit_type")
+    if exit_type:
+        code = info.get("exit_code")
+        parts.append(f"exit type: {exit_type}"
+                     + (f" (exit code {code})" if code is not None else ""))
+    for key, label in (("last_lines", "last stdout lines"),
+                       ("last_err_lines", "last stderr lines")):
+        lines = info.get(key)
+        if lines:
+            body = "\n".join(f"    {ln}" for ln in lines)
+            parts.append(f"{label}:\n{body}")
+    if recent_events:
+        body = "\n".join(
+            f"    [{e.get('severity')}] {e.get('type')}: {e.get('message')}"
+            for e in recent_events)
+        parts.append(f"recent events on the node:\n{body}")
+    if not parts:
+        return ""
+    return "\n  " + "\n  ".join(parts)

@@ -241,6 +241,89 @@ def summary_tasks() -> List[Dict[str, Any]]:
     return out
 
 
+def list_cluster_events(event_type: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        node_id: Optional[str] = None,
+                        limit: int = 100) -> List[Dict[str, Any]]:
+    """Typed failure-forensics events from the GCS ClusterEventLog
+    (reference: `ray list cluster-events` / gcs event export). Filters:
+    ``event_type`` (see ray_tpu.observability.EVENT_TYPES), ``severity``
+    (INFO/WARNING/ERROR), ``node_id`` hex prefix."""
+    return _gcs().call("list_cluster_events", event_type=event_type,
+                       severity=severity, node_id=node_id, limit=limit,
+                       timeout=30)
+
+
+def summary_events() -> Dict[str, Any]:
+    """Rollup of the ClusterEventLog: total recorded, currently
+    buffered, and a type -> severity -> count table."""
+    return _gcs().call("summary_cluster_events", timeout=30)
+
+
+def get_log(task_id: Optional[str] = None, actor_id: Optional[str] = None,
+            worker_id: Optional[str] = None,
+            tail: int = 100) -> List[str]:
+    """Retrieve log lines for one task, actor, or worker (reference:
+    `ray.util.state.get_log`). Exactly one selector is required; IDs are
+    hex strings (as returned by the list_* APIs / ``ref.task_id().hex()``).
+    Task logs are sliced out of the owning worker's log file via the
+    per-task attribution markers, so a pooled worker that ran many tasks
+    returns only the requested task's lines. Served by the raylet from
+    the on-disk log files, so logs of dead workers remain retrievable."""
+    from ray_tpu._private.worker import global_worker
+
+    selectors = [s for s in (task_id, actor_id, worker_id) if s]
+    if len(selectors) != 1:
+        raise ValueError(
+            "get_log requires exactly one of task_id=, actor_id=, "
+            "worker_id=")
+    w = global_worker()
+    gcs = _gcs()
+    if actor_id is not None:
+        # Resolve the actor to its current worker; the worker branch
+        # below then finds the node.
+        info = gcs.call("get_actor_info",
+                        actor_id=bytes.fromhex(actor_id), timeout=30)
+        if not info or not info.get("worker_id"):
+            raise ValueError(f"actor {actor_id} not found or has no "
+                             "worker")
+        worker_id = info["worker_id"].hex()
+    if worker_id is not None:
+        node_hex = None
+        for row in gcs.call("list_workers", timeout=30):
+            if row["worker_id"].hex() == worker_id:
+                node_hex = row["node_id"].hex()
+                break
+        if node_hex is None:
+            raise ValueError(f"worker {worker_id} not found")
+        client = w._raylet_for_node(bytes.fromhex(node_hex))
+        if client is None:
+            raise ValueError(f"node {node_hex[:12]} hosting worker "
+                             f"{worker_id[:12]} is unreachable")
+        reply = client.call("get_log",
+                            worker_id=bytes.fromhex(worker_id),
+                            tail=tail, timeout=30)
+        return reply.get("lines", [])
+    # task_id: the owning worker isn't tracked after the fact — fan out
+    # to every alive node; the markers make non-owners return nothing.
+    lines: List[str] = []
+    for node in gcs.call("get_all_nodes", timeout=30):
+        if node.get("state") != "ALIVE":
+            continue
+        client = w._raylet_for_node(node["node_id"])
+        if client is None:
+            continue
+        try:
+            reply = client.call("get_log", task_id=task_id, tail=tail,
+                                timeout=30)
+        except Exception:
+            continue
+        lines.extend(reply.get("lines", []))
+    if tail:
+        lines = lines[-int(tail):]
+    return lines
+
+
 def summary_actors() -> List[Dict[str, Any]]:
     """Per-class rollup of actor states (reference: `ray summary
     actors`)."""

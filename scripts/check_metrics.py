@@ -19,7 +19,13 @@ runtime can only check per-process:
   reviewable place;
 - gauges must not declare a ``pid`` tag key: the exporter appends its
   own ``pid=<source>`` label to every gauge and duplicate label names
-  break the whole Prometheus scrape.
+  break the whole Prometheus scrape;
+- hand-rolled Prometheus exposition blocks (``# TYPE name kind`` lines
+  inside string literals, e.g. the GCS ``metrics_text`` builder) obey
+  the naming convention: a ``_total`` suffix is reserved for counters,
+  and counters must carry it — Prometheus clients infer semantics from
+  the suffix, so a gauge named ``*_total`` reads as a counter and gets
+  rate()'d into garbage.
 
 Usage: ``python scripts/check_metrics.py [root]`` — exits nonzero and
 prints one line per violation. ``check_paths()`` is the library entry
@@ -143,6 +149,35 @@ def _collect_file(path: str) -> Tuple[List[Dict[str, Any]], List[str]]:
     return decls, problems
 
 
+# ``# TYPE <name> <kind>`` lines as they appear inside f-string/str
+# literals that hand-roll Prometheus exposition text (gcs_server's
+# metrics_text builder). Scanned over raw file text: the lines live
+# inside string literals, so the AST walk above never sees them.
+_EXPOSITION_TYPE_RE = re.compile(
+    r"#\s*TYPE\s+([a-zA-Z_:][a-zA-Z0-9_:]*)\s+"
+    r"(counter|gauge|histogram|summary)\b")
+
+
+def check_exposition_text(src: str, where: str) -> List[str]:
+    """Lint hand-rolled Prometheus exposition blocks in raw source text:
+    the ``_total`` suffix is reserved for counters and required of them
+    (https://prometheus.io/docs/practices/naming/)."""
+    problems: List[str] = []
+    for m in _EXPOSITION_TYPE_RE.finditer(src):
+        name, kind = m.group(1), m.group(2)
+        line = src.count("\n", 0, m.start()) + 1
+        if name.endswith("_total") and kind != "counter":
+            problems.append(
+                f"{where}:{line}: exposition declares '# TYPE {name} "
+                f"{kind}' but the _total suffix is reserved for "
+                f"counters — clients rate() it into garbage")
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"{where}:{line}: exposition declares counter {name!r} "
+                f"without the conventional _total suffix")
+    return problems
+
+
 def check_paths(root: str) -> List[str]:
     """Lint every .py under ``root``; returns violation strings."""
     decls: List[Dict[str, Any]] = []
@@ -151,9 +186,12 @@ def check_paths(root: str) -> List[str]:
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in sorted(filenames):
             if fn.endswith(".py"):
-                d, p = _collect_file(os.path.join(dirpath, fn))
+                path = os.path.join(dirpath, fn)
+                d, p = _collect_file(path)
                 decls.extend(d)
                 problems.extend(p)
+                with open(path, "r", encoding="utf-8") as f:
+                    problems.extend(check_exposition_text(f.read(), path))
 
     for d in decls:
         name = d["name"]

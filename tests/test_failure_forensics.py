@@ -1,0 +1,540 @@
+"""Failure forensics: cluster event log, worker-exit taxonomy, and
+per-task log retrieval (reference: `src/ray/protobuf/event.proto`,
+`WorkerExitType`, `ray.util.state.get_log`).
+
+Covers the event-schema registry (+ the lint tying emission sites,
+registry, and dashboard docs together), the LogMonitor tailer
+(partial-line carry, read-cap resumption, noise filter, stderr flag,
+per-task attribution markers), and end-to-end: a SIGKILLed actor
+surfaces a classified death error with its final log lines, the event
+shows up in both `util.state.list_cluster_events()` and
+`GET /api/events`, an OOM kill classifies as OOM_KILLED, and
+`get_log(task_id=...)` slices one task's lines out of a pooled worker.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ event schema
+
+class TestEventRegistry:
+    def test_classify_worker_exit_taxonomy(self):
+        from ray_tpu.observability.events import classify_worker_exit
+
+        assert classify_worker_exit(0) == "INTENDED_EXIT"
+        assert classify_worker_exit(None) == "INTENDED_EXIT"
+        assert classify_worker_exit(1) == "USER_ERROR"
+        assert classify_worker_exit(77) == "USER_ERROR"
+        assert classify_worker_exit(-signal.SIGKILL) == "SYSTEM_ERROR"
+        assert classify_worker_exit(-signal.SIGSEGV) == "SYSTEM_ERROR"
+        # Raylet-caused deaths override the raw waitpid status: a SIGKILL
+        # the framework itself sent must not read as SYSTEM_ERROR.
+        assert classify_worker_exit(-9, oom_killed=True) == "OOM_KILLED"
+        assert classify_worker_exit(-9, intended=True) == "INTENDED_EXIT"
+        # OOM wins over intended (the memory monitor's verdict is the
+        # diagnosis the user needs).
+        assert classify_worker_exit(
+            -9, oom_killed=True, intended=True) == "OOM_KILLED"
+
+    def test_exit_severity(self):
+        from ray_tpu.observability.events import exit_severity
+
+        assert exit_severity("INTENDED_EXIT") == "INFO"
+        assert exit_severity("USER_ERROR") == "WARNING"
+        assert exit_severity("SYSTEM_ERROR") == "ERROR"
+        assert exit_severity("OOM_KILLED") == "ERROR"
+        assert exit_severity("NODE_DEATH") == "ERROR"
+
+    def test_make_event_validates(self):
+        from ray_tpu.observability.events import make_event
+
+        e = make_event("WORKER_EXIT", "w died", node_id="ab" * 14,
+                       exit_code=-9)
+        assert e["type"] == "WORKER_EXIT"
+        assert e["severity"] == "WARNING"  # default for WORKER_EXIT
+        assert e["exit_code"] == -9
+        assert e["ts"] > 0
+        with pytest.raises(ValueError):
+            make_event("NOT_A_TYPE", "boom")
+        with pytest.raises(ValueError):
+            make_event("WORKER_EXIT", "w", severity="FATAL")
+
+    def test_format_exit_detail(self):
+        from ray_tpu.observability.events import format_exit_detail
+
+        assert format_exit_detail(None) == ""
+        assert format_exit_detail({}) == ""
+        out = format_exit_detail(
+            {"exit_type": "SYSTEM_ERROR", "exit_code": -9,
+             "last_lines": ["a", "b"], "last_err_lines": ["tb"]},
+            recent_events=[{"severity": "ERROR", "type": "WORKER_EXIT",
+                            "message": "m"}])
+        assert "exit type: SYSTEM_ERROR (exit code -9)" in out
+        assert "last stdout lines:" in out and "    a" in out
+        assert "last stderr lines:" in out and "    tb" in out
+        assert "recent events on the node:" in out
+        assert "[ERROR] WORKER_EXIT: m" in out
+
+
+class TestEventLint:
+    """Every emitted event type is registered; every registered type is
+    documented in the dashboard endpoint table."""
+
+    _EMIT_RE = re.compile(
+        r"""(?:_record_event\(\s*|_report_event\(\s*|
+            event_type\s*=\s*)["']([A-Z][A-Z_]+)["']""", re.VERBOSE)
+
+    def _emitted_types(self):
+        found = {}
+        pkg = os.path.join(_repo_root(), "ray_tpu")
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                for m in self._EMIT_RE.finditer(src):
+                    found.setdefault(m.group(1), path)
+        return found
+
+    def test_every_emitted_type_is_registered(self):
+        from ray_tpu.observability.events import EVENT_TYPES
+
+        for etype, path in self._emitted_types().items():
+            assert etype in EVENT_TYPES, (
+                f"{path} emits unregistered cluster event {etype!r}; "
+                f"declare it in ray_tpu/observability/events.py")
+
+    def test_every_registered_type_is_emitted(self):
+        from ray_tpu.observability.events import EVENT_TYPES
+
+        emitted = self._emitted_types()
+        dead = sorted(set(EVENT_TYPES) - set(emitted))
+        assert not dead, (
+            f"registered cluster event types {dead} have no emission "
+            f"site — dead schema entries mislead postmortems")
+
+    def test_every_registered_type_documented_in_dashboard(self):
+        from ray_tpu.observability.events import EVENT_TYPES
+
+        path = os.path.join(_repo_root(), "ray_tpu", "dashboard",
+                            "head.py")
+        with open(path, encoding="utf-8") as f:
+            docstring = f.read().split('"""')[1]
+        for etype in EVENT_TYPES:
+            assert etype in docstring, (
+                f"cluster event type {etype!r} is registered but "
+                f"missing from the GET /api/events row of the "
+                f"dashboard endpoint table ({path} module docstring)")
+
+
+def test_exposition_text_lint(tmp_path):
+    """check_metrics lints hand-rolled `# TYPE` lines: _total is
+    reserved for counters and required of them; the shipped tree is
+    clean."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics",
+        os.path.join(_repo_root(), "scripts", "check_metrics.py"))
+    cm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cm)
+
+    problems = cm.check_exposition_text(
+        'lines = ["# TYPE rtpu_things_total gauge",\n'
+        '         "# TYPE rtpu_stuff counter",\n'
+        '         "# TYPE rtpu_fine_total counter",\n'
+        '         "# TYPE rtpu_also_fine gauge"]\n', "synthetic.py")
+    assert len(problems) == 2
+    assert any("rtpu_things_total" in p and "reserved for" in p
+               for p in problems)
+    assert any("rtpu_stuff" in p and "without the conventional" in p
+               for p in problems)
+
+    assert cm.check_paths(os.path.join(_repo_root(), "ray_tpu")) == []
+
+
+# ------------------------------------------------------------- LogMonitor
+
+class TestLogMonitor:
+    def _monitor(self, tmp_path, **kw):
+        from ray_tpu._private.log_monitor import LogMonitor
+
+        return LogMonitor(str(tmp_path), **kw)
+
+    def test_partial_line_carry_over(self, tmp_path):
+        mon = self._monitor(tmp_path)
+        p = tmp_path / "worker-abc123.out"
+        p.write_bytes(b"complete line\npartial wor")
+        msgs = mon.scan()
+        assert len(msgs) == 1
+        assert msgs[0]["lines"] == ["complete line"]
+        with open(p, "ab") as f:
+            f.write(b"ld finished\nnext\n")
+        msgs = mon.scan()
+        assert len(msgs) == 1
+        assert msgs[0]["lines"] == ["partial world finished", "next"]
+
+    def test_max_read_per_scan_resumption(self, tmp_path):
+        mon = self._monitor(tmp_path, max_read=64)
+        p = tmp_path / "worker-abc123.out"
+        lines = [f"line-{i:04d}" for i in range(40)]
+        p.write_bytes(("\n".join(lines) + "\n").encode())
+        got = []
+        for _ in range(100):
+            msgs = mon.scan()
+            if not msgs:
+                break
+            for m in msgs:
+                got.extend(m["lines"])
+        assert got == lines  # nothing lost, nothing duplicated
+
+    def test_noise_filter(self, tmp_path):
+        mon = self._monitor(tmp_path)
+        p = tmp_path / "worker-abc123.out"
+        p.write_bytes(
+            b"WARNING: this xla_bridge backend is experimental\n"
+            b"\n"
+            b"   \n"
+            b"real output\n")
+        msgs = mon.scan()
+        assert len(msgs) == 1
+        assert msgs[0]["lines"] == ["real output"]
+
+    def test_err_stream_flag_and_render(self, tmp_path):
+        from ray_tpu._private.log_monitor import echo_to_driver
+
+        mon = self._monitor(tmp_path)
+        (tmp_path / "worker-abc123.out").write_bytes(b"out line\n")
+        (tmp_path / "worker-abc123.err").write_bytes(b"Traceback!\n")
+        msgs = {m["is_err"]: m for m in mon.scan()}
+        assert set(msgs) == {False, True}
+        assert msgs[True]["lines"] == ["Traceback!"]
+
+        rendered = []
+        echo_to_driver(msgs[True], "1.2.3.4", rendered.append)
+        echo_to_driver(msgs[False], "1.2.3.4", rendered.append)
+        assert "[stderr]" in rendered[0] and "Traceback!" in rendered[0]
+        assert "[stderr]" not in rendered[1]
+
+    def test_marker_attribution_and_segments(self, tmp_path):
+        from ray_tpu._private.log_monitor import (
+            task_end_marker, task_marker,
+        )
+
+        mon = self._monitor(tmp_path)
+        p = tmp_path / "worker-abc123.out"
+        tid_a, tid_b = "aa" * 8, "bb" * 8
+        p.write_bytes((
+            "before any task\n"
+            + task_marker(tid_a, name="f") + "\n"
+            + "from task a\n"
+            + task_end_marker(tid_a) + "\n"
+            + task_marker(tid_b, "cc" * 8, "Actor.m") + "\n"
+            + "from task b\n").encode())
+        msgs = mon.scan()
+        # Three segments; markers themselves are consumed, never echoed.
+        assert [m["lines"] for m in msgs] == [
+            ["before any task"], ["from task a"], ["from task b"]]
+        assert [m["task_id"] for m in msgs] == [None, tid_a, tid_b]
+        assert msgs[2]["actor_id"] == "cc" * 8
+        # The open span persists across scans.
+        with open(p, "ab") as f:
+            f.write(b"still task b\n")
+        msgs = mon.scan()
+        assert msgs[0]["task_id"] == tid_b
+
+    def test_read_task_lines_slices_one_task(self, tmp_path):
+        from ray_tpu._private.log_monitor import (
+            read_task_lines, tail_file, task_end_marker, task_marker,
+        )
+
+        p = tmp_path / "worker-abc123.out"
+        tid_a, tid_b = "aa" * 8, "bb" * 8
+        p.write_bytes((
+            task_marker(tid_a) + "\n" + "a1\na2\n"
+            + task_end_marker(tid_a) + "\n"
+            + task_marker(tid_b) + "\n" + "b1\n"
+            + task_end_marker(tid_b) + "\n"
+            + task_marker(tid_a) + "\n" + "a3\n"
+            + task_end_marker(tid_a) + "\n").encode())
+        assert read_task_lines(str(p), tid_a) == ["a1", "a2", "a3"]
+        assert read_task_lines(str(p), tid_b) == ["b1"]
+        assert read_task_lines(str(p), tid_a, max_lines=1) == ["a3"]
+        # task=None -> every non-marker line (tail_file).
+        assert tail_file(str(p), 10) == ["a1", "a2", "b1", "a3"]
+        assert read_task_lines(str(tmp_path / "missing.out"), tid_a) == []
+
+
+# ------------------------------------------------------------------- e2e
+
+@pytest.fixture(scope="module")
+def forensics_cluster():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024,
+                        include_dashboard=True,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _dashboard_base():
+    from ray_tpu import _local_node
+
+    return _local_node.dashboard_url
+
+
+def test_cluster_event_log_basics(forensics_cluster):
+    from ray_tpu.util import state
+
+    events = state.list_cluster_events(limit=1000)
+    types = {e["type"] for e in events}
+    # Cluster bring-up alone records these.
+    assert "NODE_ADDED" in types
+    assert "JOB_STARTED" in types
+    for e in events:
+        assert e["severity"] in ("INFO", "WARNING", "ERROR")
+        assert isinstance(e["ts"], float)
+
+    only_info = state.list_cluster_events(severity="INFO", limit=1000)
+    assert only_info and all(e["severity"] == "INFO" for e in only_info)
+    only_nodes = state.list_cluster_events(event_type="NODE_ADDED")
+    assert only_nodes and all(e["type"] == "NODE_ADDED"
+                              for e in only_nodes)
+
+    summ = state.summary_events()
+    assert summ["total_recorded"] >= len(events)
+    assert summ["by_type"].get("NODE_ADDED", {}).get("INFO", 0) >= 1
+
+
+def test_sigkilled_actor_forensics(forensics_cluster):
+    """The acceptance-criteria e2e: SIGKILL an actor worker out-of-band;
+    the driver-side error carries the exit classification and the
+    actor's final log lines, and the WORKER_EXIT event is visible in
+    both the state API and GET /api/events."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Doomed:
+        def pid(self):
+            print("doomed actor last words", flush=True)
+            return os.getpid()
+
+        def ping(self):
+            return "pong"
+
+    a = Doomed.remote()
+    pid = ray_tpu.get(a.pid.remote(), timeout=60)
+    os.kill(pid, signal.SIGKILL)
+
+    with pytest.raises(exc.ActorDiedError) as ei:
+        ray_tpu.get(a.ping.remote(), timeout=60)
+    msg = str(ei.value)
+    # Exit taxonomy: an out-of-band SIGKILL is a signal the framework
+    # didn't send -> SYSTEM_ERROR, not INTENDED_EXIT.
+    assert "SYSTEM_ERROR" in msg
+    # Death-error enrichment: the worker's captured final log lines.
+    assert "doomed actor last words" in msg
+
+    deadline = time.monotonic() + 30
+    exits = []
+    while time.monotonic() < deadline:
+        exits = [e for e in state.list_cluster_events(
+            event_type="WORKER_EXIT", limit=1000)
+            if e.get("pid") == pid]
+        if exits:
+            break
+        time.sleep(0.5)
+    assert exits, "WORKER_EXIT event for the killed pid never appeared"
+    assert exits[-1]["exit_type"] == "SYSTEM_ERROR"
+    assert exits[-1]["severity"] == "ERROR"
+
+    base = _dashboard_base()
+    assert base
+    rows = json.loads(urllib.request.urlopen(
+        base + "/api/events?type=WORKER_EXIT&severity=ERROR&limit=1000",
+        timeout=15).read())
+    assert any(r.get("pid") == pid for r in rows)
+    # Filters actually filter.
+    rows = json.loads(urllib.request.urlopen(
+        base + "/api/events?type=NODE_ADDED", timeout=15).read())
+    assert rows and all(r["type"] == "NODE_ADDED" for r in rows)
+
+
+def test_get_log_by_task_returns_only_that_task(forensics_cluster):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def chatty(tag):
+        print(f"chatty says {tag}", flush=True)
+        return tag
+
+    ref_a = chatty.remote("alpha")
+    ref_b = chatty.remote("beta")
+    assert ray_tpu.get([ref_a, ref_b], timeout=60) == ["alpha", "beta"]
+
+    tid_a = ref_a.task_id().hex()
+    deadline = time.monotonic() + 20
+    lines = []
+    while time.monotonic() < deadline:
+        lines = state.get_log(task_id=tid_a, tail=50)
+        if lines:
+            break
+        time.sleep(0.25)
+    assert any("chatty says alpha" in ln for ln in lines), lines
+    assert not any("beta" in ln for ln in lines), (
+        f"get_log(task_id=) leaked another task's lines: {lines}")
+
+    base = _dashboard_base()
+    body = json.loads(urllib.request.urlopen(
+        base + f"/api/logs?task_id={tid_a}&tail=50", timeout=15).read())
+    assert any("chatty says alpha" in ln for ln in body["lines"])
+    assert not any("beta" in ln for ln in body["lines"])
+
+    # Selector validation: zero selectors is an error on both surfaces.
+    with pytest.raises(ValueError):
+        state.get_log(tail=5)
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(base + "/api/logs?tail=5", timeout=15)
+
+
+def test_get_log_by_actor(forensics_cluster):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Talker:
+        def say(self, what):
+            print(f"talker: {what}", flush=True)
+            return what
+
+    t = Talker.remote()
+    assert ray_tpu.get(t.say.remote("hello-logs"), timeout=60) \
+        == "hello-logs"
+    aid = None
+    for a in state.list_actors():
+        if a["class_name"] == "Talker" and a["state"] == "ALIVE":
+            aid = a["actor_id"]
+    assert aid
+    deadline = time.monotonic() + 20
+    lines = []
+    while time.monotonic() < deadline:
+        lines = state.get_log(actor_id=aid, tail=50)
+        if any("talker: hello-logs" in ln for ln in lines):
+            break
+        time.sleep(0.25)
+    assert any("talker: hello-logs" in ln for ln in lines), lines
+
+
+def test_oom_kill_classified_oom_not_system_error(tmp_path):
+    """Simulated memory pressure -> the monitor's kill classifies as
+    OOM_KILLED (the SIGKILL must not read as SYSTEM_ERROR), the error
+    class is OutOfMemoryError, and the driver echoes the ERROR-severity
+    WORKER_EXIT cluster event."""
+    usage = tmp_path / "usage"
+    usage.write_text("0.10")
+    started = tmp_path / "started"
+    script = tmp_path / "driver.py"
+    script.write_text(f"""
+import os, time
+import ray_tpu
+from ray_tpu import exceptions as exc
+ray_tpu.init(num_cpus=2, _system_config={{
+    "memory_monitor_test_usage_path": {str(usage)!r},
+    "memory_usage_threshold": 0.9,
+    "memory_monitor_refresh_ms": 100,
+}})
+
+@ray_tpu.remote(max_retries=0)
+def hog():
+    with open({str(started)!r}, "w") as f:
+        f.write(str(os.getpid()))
+    time.sleep(30.0)
+    return "survived"
+
+ref = hog.remote()
+while not os.path.exists({str(started)!r}):
+    time.sleep(0.05)
+with open({str(usage)!r}, "w") as f:
+    f.write("0.99")
+try:
+    ray_tpu.get(ref, timeout=60)
+    print("VERDICT:no-error")
+except exc.OutOfMemoryError as e:
+    print("VERDICT:oom:" + repr(str(e)))
+except Exception as e:
+    print("VERDICT:other:" + type(e).__name__ + ":" + repr(str(e)))
+with open({str(usage)!r}, "w") as f:
+    f.write("0.10")
+time.sleep(3.0)  # let the ERROR-severity event echo to this driver
+ray_tpu.shutdown()
+""")
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=180, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                          "PYTHONPATH": _repo_root()})
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    verdict = [ln for ln in proc.stdout.splitlines()
+               if ln.startswith("VERDICT:")]
+    assert verdict and verdict[0].startswith("VERDICT:oom:"), out
+    assert "OOM_KILLED" in verdict[0], verdict[0]
+    assert "SYSTEM_ERROR" not in verdict[0], verdict[0]
+    # Driver-side echo of the ERROR-severity cluster event.
+    assert "[cluster event] ERROR WORKER_EXIT" in out, out
+
+
+def test_worker_exit_info_rpc_shape(forensics_cluster):
+    """get_worker_exit_info returns the cached classification + captured
+    tails for a worker the raylet reaped."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Victim:
+        def pid(self):
+            print("victim breadcrumb", flush=True)
+            return os.getpid()
+
+    v = Victim.remote()
+    pid = ray_tpu.get(v.pid.remote(), timeout=60)
+    wid = None
+    for row in state.list_workers():
+        if row.get("pid") == pid:
+            wid = row["worker_id"]
+    assert wid
+    os.kill(pid, signal.SIGKILL)
+
+    w = global_worker()
+    nodes = w.gcs.call("get_all_nodes", timeout=10)
+    raylet = w._raylet_for_node(nodes[0]["node_id"])
+    assert raylet is not None
+    info = {}
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        info = raylet.call("get_worker_exit_info",
+                           worker_id=bytes.fromhex(wid), timeout=10)
+        if info.get("exit_type"):
+            break
+        time.sleep(0.25)
+    assert info.get("exit_type") == "SYSTEM_ERROR"
+    assert info.get("exit_code") == -signal.SIGKILL
+    assert any("victim breadcrumb" in ln
+               for ln in info.get("last_lines", [])), info

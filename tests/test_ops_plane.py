@@ -65,8 +65,11 @@ def test_prometheus_metrics_rpc_and_http(ray_start_regular):
 
     w = global_worker()
     text = w.gcs.call("metrics_text", timeout=30)
-    assert "rtpu_nodes_total" in text
-    assert 'rtpu_resource_total{' in text
+    assert "rtpu_nodes" in text
+    assert 'rtpu_resource_capacity{' in text
+    # Counter-suffix discipline: _total only on counters.
+    assert "rtpu_nodes_total" not in text
+    assert "rtpu_cluster_events_total" in text
 
     port_raw = w.gcs.call("kv_get", namespace="__internal__",
                           key="metrics_port")
@@ -74,7 +77,7 @@ def test_prometheus_metrics_rpc_and_http(ray_start_regular):
     port = int(port_raw.decode())
     body = urllib.request.urlopen(
         f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
-    assert "rtpu_nodes_total" in body
+    assert "rtpu_nodes" in body
 
 
 def test_job_submission_lifecycle(ray_start_regular, tmp_path):
