@@ -145,6 +145,25 @@ _define("worker_exit_tail_lines", int, 20,
 _define("metrics_report_interval_s", float, 2.0,
         "Flush cadence of user-defined ray_tpu.util.metrics to the GCS "
         "(reference: metrics_report_interval_ms).")
+_define("sched_phase_instrumentation", bool, True,
+        "Record per-task scheduling-phase timestamps (PENDING -> "
+        "LEASE_GRANTED -> WORKER_STARTED -> ARGS_READY -> RUNNING) "
+        "through the lease protocol: task-event ring entries, segmented "
+        "timeline submit arrows, and the rtpu_sched_phase_seconds{phase} "
+        "histogram. Off = only the PENDING/RUNNING/FINISHED skeleton.")
+_define("profiler_default_hz", int, 100,
+        "Default sampling rate of the wall-clock stack profiler "
+        "(observability.profiling.StackSampler / util.state.profile).")
+_define("profiler_max_unique_stacks", int, 10_000,
+        "Bound on distinct (thread, stack) keys one StackSampler run "
+        "retains; overflowing samples are counted as dropped instead of "
+        "allocated, so profiling can never OOM the target.")
+_define("profiler_max_duration_s", float, 60.0,
+        "Cap on a single worker-side profile RPC window (long profiles "
+        "are chunked by the util.state.profile client).")
+_define("tpu_profile_dir", str, "",
+        "Directory for util.state.tpu_profile jax.profiler artifacts; "
+        "defaults under the system temp dir.")
 _define("jit_recompile_warn_budget", int, 8,
         "Default trace budget of observability.tracked_jit wrappers: a "
         "tracked jitted function that traces more programs than this "

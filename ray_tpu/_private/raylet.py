@@ -210,7 +210,7 @@ class Raylet:
             "object_info", "store_stats", "memory_stats",
             "prepare_bundle", "commit_bundle", "return_bundle",
             "kill_worker", "node_stats", "shutdown_node", "get_tasks_info",
-            "profile_worker",
+            "profile_worker", "dump_stacks",
             "get_worker_exit_info", "runtime_env_stats", "get_log",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
@@ -950,10 +950,12 @@ class Raylet:
                 pass
 
     async def _h_profile_worker(self, worker_id=None, duration_s=5.0,
-                                kind="profile"):
+                                kind="profile", hz=None):
         """On-demand worker profiling (reference: `profile_manager.py`):
-        forwards to the worker's sampling profiler / stack dumper. With
-        no worker_id, covers every live worker on this node."""
+        forwards to the worker's sampling profiler / stack dumper /
+        jax.profiler device-trace bracket (``kind`` = "profile" |
+        "stacks" | "tpu_profile"). With no worker_id, covers every live
+        worker on this node."""
         from ray_tpu._private.rpc import RpcClient
 
         targets = ([self.workers[worker_id]] if worker_id in self.workers
@@ -966,12 +968,17 @@ class Raylet:
                 if client is None:
                     client = RpcClient(*h.addr)
                     self._worker_probe_clients[h.worker_id] = client
-                if kind == "stacks":
-                    reply = await client.acall("stack_dump", timeout=10)
+                if kind in ("stacks", "dump_stacks"):
+                    reply = await client.acall("dump_stacks", timeout=10)
+                elif kind == "tpu_profile":
+                    reply = await asyncio.wait_for(
+                        client.acall("tpu_profile", duration_s=duration_s,
+                                     timeout=duration_s + 60),
+                        duration_s + 60)
                 else:
                     reply = await asyncio.wait_for(
                         client.acall("profile", duration_s=duration_s,
-                                     timeout=duration_s + 30),
+                                     hz=hz, timeout=duration_s + 30),
                         duration_s + 30)
                 return h.worker_id.hex(), reply
             except Exception as e:  # noqa: BLE001
@@ -982,6 +989,13 @@ class Raylet:
         pairs = await asyncio.gather(
             *(one(h) for h in targets if h.addr != ("", 0)))
         return dict(pairs)
+
+    async def _h_dump_stacks(self, worker_id=None):
+        """One-shot cluster-stack fan-out (the `ray stack` node hop):
+        every live worker's all-thread Python stacks, keyed by worker id
+        hex. util.state.stack() calls this on one or every raylet."""
+        return await self._h_profile_worker(worker_id=worker_id,
+                                            kind="stacks")
 
     async def _pick_oom_victim(self):
         """Worker-killing policy (reference `worker_killing_policy.h:34`):

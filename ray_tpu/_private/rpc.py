@@ -115,8 +115,48 @@ def dump_event_loops(file=None) -> None:
         pass
 
 
+def dump_thread_stacks(file=None) -> None:
+    """Wedge diagnostic companion to dump_event_loops: the *OS-thread*
+    Python stacks of every thread in this process. A wedged worker
+    blocked in user code (a lock, a collective, a C extension holding
+    the GIL between bytecodes) never shows up in the coroutine dump —
+    this is the half that does. Lock-free and best-effort, implemented
+    inline (no imports at dump time): a signal handler in a wedged
+    process must not touch the import machinery."""
+    import io as _io
+    import sys
+    import threading
+    import traceback
+
+    out = _io.StringIO()
+    out.write(f"--- Python thread stacks (pid {os.getpid()}, "
+              f"{threading.active_count()} threads) ---\n")
+    try:
+        frames = sys._current_frames()
+    except Exception as e:  # noqa: BLE001
+        frames = {}
+        out.write(f"    (sys._current_frames failed: {e!r})\n")
+    names = {t.ident: t for t in threading.enumerate()}
+    for ident, frame in frames.items():
+        t = names.get(ident)
+        name = t.name if t else f"thread-{ident}"
+        daemon = " daemon" if (t and t.daemon) else ""
+        out.write(f"--- thread {name}{daemon} ---\n")
+        try:
+            out.write("".join(traceback.format_stack(frame)))
+        except Exception as e:  # noqa: BLE001
+            out.write(f"    (stack dump failed: {e!r})\n")
+    (file or sys.stderr).write(out.getvalue())
+    try:
+        (file or sys.stderr).flush()
+    except Exception:
+        pass
+
+
 def install_coroutine_dump_signal() -> None:
-    """Register SIGUSR2 → dump_event_loops on stderr (daemon logs).
+    """Register SIGUSR2 → dump_event_loops + dump_thread_stacks on
+    stderr (the worker's .err file, so the raylet's worker_exit_tail
+    capture includes a final stack on wedged-worker kills).
     Python-level handler (runs between bytecodes on the main thread):
     fine for the parked-coroutine wedge class where the loops are idle
     and the main thread sits in an interruptible wait."""
@@ -125,6 +165,10 @@ def install_coroutine_dump_signal() -> None:
     def _h(signum, frame):
         try:
             dump_event_loops()
+        except Exception:
+            pass
+        try:
+            dump_thread_stacks()
         except Exception:
             pass
 

@@ -117,6 +117,12 @@ class TaskSpec:
     parent_task_id: Optional[TaskID] = None
     depth: int = 0
     labels: Dict[str, str] = field(default_factory=dict)
+    # Owner-side scheduling-phase timestamps (PENDING / LEASE_GRANTED
+    # wall clocks; see observability.profiling.SCHED_PHASES). Stashed on
+    # the spec rather than a side table so the stash dies with the task
+    # — retries reuse the same spec object and keep the original submit
+    # time. Rides the wire as a small dict; executing workers ignore it.
+    phase_ts: Optional[Dict[str, float]] = None
 
     def __reduce__(self):
         return (_rebuild_task_spec, tuple(

@@ -281,7 +281,7 @@ class Worker:
                      "get_object_status", "kill_self", "cancel_task", "ping",
                      "busy_info", "add_borrower", "release_borrower",
                      "consume_pending_share",
-                     "stack_dump", "profile",
+                     "stack_dump", "dump_stacks", "profile", "tpu_profile",
                      "delete_object_notification", "report_generator_item",
                      "recover_object", "wait_object_status",
                      "early_task_result"]:
@@ -1182,7 +1182,15 @@ class Worker:
             # Register generator state before dispatch: a streaming item
             # push may arrive before the submit coroutine even runs.
             self._generators[task_id.binary()] = _GeneratorState()
-        self._record_task_event(spec, "PENDING")
+        if GlobalConfig.sched_phase_instrumentation:
+            # Phase breakdown anchor: the same wall clock goes into the
+            # task-event ring and the spec stash, so the histogram and
+            # the timeline segments agree to the microsecond.
+            spec.phase_ts = {"PENDING": time.time()}
+            self._record_task_event(spec, "PENDING",
+                                    ts=spec.phase_ts["PENDING"])
+        else:
+            self._record_task_event(spec, "PENDING")
         self.io.submit(self._run_normal_task(spec))
         if streaming:
             from ray_tpu._private.object_ref import ObjectRefGenerator
@@ -1226,6 +1234,32 @@ class Worker:
             self.io.submit(_push())
         except Exception:
             pass
+
+    def _record_reply_phases(self, spec: TaskSpec,
+                             wphases: Dict[str, float],
+                             worker_addr) -> None:
+        """Owner-side landing of the executing worker's phase clocks
+        (WORKER_STARTED / ARGS_READY / RUNNING, stamped worker-side and
+        carried in the task reply): append them to the task-event ring
+        with their original timestamps — the refined RUNNING supersedes
+        the push-time one in the timeline — and fold the full
+        PENDING->...->RUNNING chain into rtpu_sched_phase_seconds."""
+        from ray_tpu.observability import profiling as _profiling
+
+        for state in ("WORKER_STARTED", "ARGS_READY", "RUNNING"):
+            ts = wphases.get(state)
+            if ts is None:
+                continue
+            extra = {"ts": ts}
+            if state == "RUNNING":
+                extra["worker_addr"] = list(worker_addr)
+            self._record_task_event(spec, state, **extra)
+        chain = dict(spec.phase_ts or {})
+        chain.update(wphases)
+        try:
+            _profiling.observe_sched_phases(chain)
+        except Exception:
+            pass  # metrics must never fail a task
 
     async def _resolve_deps(self, spec: TaskSpec) -> Optional[bytes]:
         """Wait for owned arg refs to be available; returns error payload if a
@@ -1607,10 +1641,23 @@ class Worker:
     async def _push_batch(self, key: str, st: "_LeaseState", lease, batch):
         worker_addr = tuple(lease["worker_addr"])
         client = self._client_for(worker_addr)
+        phases_on = GlobalConfig.sched_phase_instrumentation
         for spec, fut in batch:
             self._inflight_push[spec.task_id.binary()] = worker_addr
             if len(batch) > 1:
                 self._inflight_futs[spec.task_id.binary()] = fut
+            if phases_on:
+                # The lease is paired with this waiter right here —
+                # everything before is scheduling (queueing + raylet
+                # lease grant), everything after is dispatch.
+                now = time.time()
+                spec.phase_ts = dict(spec.phase_ts or {})
+                spec.phase_ts["LEASE_GRANTED"] = now
+                self._record_task_event(spec, "LEASE_GRANTED", ts=now)
+            # Push-time RUNNING: live and crashed tasks must render a
+            # task bar even if no reply ever arrives; on reply the
+            # worker's exec-start-accurate RUNNING supersedes it
+            # (timeline keeps the newest event per state).
             self._record_task_event(spec, "RUNNING",
                                     worker_addr=list(worker_addr))
         try:
@@ -1648,6 +1695,10 @@ class Worker:
             for (spec, fut), reply in zip(batch, replies):
                 self._inflight_push.pop(spec.task_id.binary(), None)
                 self._inflight_futs.pop(spec.task_id.binary(), None)
+                wphases = (reply.pop("phases", None)
+                           if isinstance(reply, dict) else None)
+                if phases_on and wphases:
+                    self._record_reply_phases(spec, wphases, worker_addr)
                 dur = (reply.pop("dur", None)
                        if isinstance(reply, dict) else None)
                 if dur is not None:
@@ -2273,48 +2324,63 @@ class Worker:
     async def _h_stack_dump(self):
         """All-thread stack traces (reference: the dashboard's py-spy
         dump route, `profile_manager.py:188` — here via sys._current
-        _frames, no external tool)."""
-        import traceback
+        _frames, no external tool). Returns both the structured
+        per-thread rows (``threads``) and the joined text blob
+        (``stacks``, the shape the dashboard prints)."""
+        from ray_tpu.observability import profiling as _profiling
 
-        frames = sys._current_frames()
-        names = {t.ident: t.name for t in threading.enumerate()}
-        out = []
-        for ident, frame in frames.items():
-            stack = "".join(traceback.format_stack(frame))
-            out.append(f"--- thread {names.get(ident, ident)} ---\n{stack}")
-        return {"pid": os.getpid(), "stacks": "\n".join(out)}
+        threads = _profiling.capture_thread_stacks()
+        return {"pid": os.getpid(),
+                "worker_id": self.worker_id.hex(),
+                "threads": threads,
+                "stacks": _profiling.format_thread_stacks(threads)}
 
-    async def _h_profile(self, duration_s=5.0, interval_ms=10.0):
-        """Sampling CPU profile in folded-stack format (flamegraph.pl /
-        speedscope compatible): `frame;frame;frame count` lines.
-        Sampling runs in a helper thread so the event loop stays live."""
-        duration_s = min(float(duration_s), 60.0)
-        interval = max(float(interval_ms), 1.0) / 1000.0
-        counts: Dict[str, int] = {}
+    async def _h_dump_stacks(self):
+        """`ray stack` RPC name (the raylet fans this out per node)."""
+        return await self._h_stack_dump()
 
-        def _sample():
-            deadline = time.monotonic() + duration_s
-            while time.monotonic() < deadline:
-                for ident, frame in sys._current_frames().items():
-                    if ident == threading.get_ident():
-                        continue  # never sample the sampler itself
-                    stack = []
-                    f = frame
-                    while f is not None:
-                        code = f.f_code
-                        stack.append(
-                            f"{code.co_filename.rsplit('/', 1)[-1]}:"
-                            f"{code.co_name}:{f.f_lineno}")
-                        f = f.f_back
-                    key = ";".join(reversed(stack))
-                    counts[key] = counts.get(key, 0) + 1
-                time.sleep(interval)
+    async def _h_profile(self, duration_s=5.0, interval_ms=None, hz=None):
+        """Wall-clock sampling profile over a StackSampler daemon
+        thread: per-thread folded-stack counts + flamegraph.pl text.
+        The event loop stays live (the sampler runs on its own thread,
+        this handler just sleeps the window), so profiling never blocks
+        the worker's task push path. ``interval_ms`` is the legacy
+        spelling of the rate; ``hz`` wins when both are given."""
+        from ray_tpu.observability import profiling as _profiling
 
-        await asyncio.get_running_loop().run_in_executor(None, _sample)
-        folded = "\n".join(f"{k} {v}" for k, v in
-                           sorted(counts.items(), key=lambda kv: -kv[1]))
-        return {"pid": os.getpid(), "duration_s": duration_s,
-                "samples": sum(counts.values()), "folded": folded}
+        duration_s = min(float(duration_s),
+                         GlobalConfig.profiler_max_duration_s)
+        if hz is None and interval_ms is not None:
+            hz = 1000.0 / max(float(interval_ms), 1.0)
+        sampler = _profiling.StackSampler(hz=hz)
+        sampler.start()
+        try:
+            await asyncio.sleep(duration_s)
+        finally:
+            result = sampler.stop()
+        return {"pid": os.getpid(),
+                "worker_id": self.worker_id.hex(),
+                "duration_s": result["duration_s"],
+                "hz": sampler.hz,
+                "samples": result["samples"],
+                "dropped": result["dropped"],
+                "counts": result["counts"],
+                "folded": _profiling.collapse(result["counts"])}
+
+    async def _h_tpu_profile(self, duration_s=1.0, trace_dir=None):
+        """Device-trace capture bracket (jax.profiler start/stop_trace)
+        on this worker; no-op-with-reason when the process has no TPU
+        backend. Runs in an executor thread — start_trace/stop_trace
+        block, and the event loop must keep serving task pushes."""
+        from ray_tpu.observability import profiling as _profiling
+
+        duration_s = min(float(duration_s),
+                         GlobalConfig.profiler_max_duration_s)
+        reply = await asyncio.get_running_loop().run_in_executor(
+            None, _profiling.capture_tpu_trace, duration_s, trace_dir)
+        reply["pid"] = os.getpid()
+        reply["worker_id"] = self.worker_id.hex()
+        return reply
 
     async def _h_busy_info(self):
         """Liveness+load probe for the raylet's worker-killing policy: a
@@ -2551,20 +2617,29 @@ class Worker:
         self._executing_tids[tid] = threading.get_ident()
         self._thread_task[threading.get_ident()] = tid
         t_start = time.monotonic()
+        # Scheduling-phase clocks, stamped on THIS host as execution
+        # proceeds and returned in the reply: the owner lands them in
+        # the task-event ring and the sched_phase_seconds histogram.
+        phases = ({"WORKER_STARTED": time.time()}
+                  if GlobalConfig.sched_phase_instrumentation else None)
         try:
             fn = self._load_function(spec.function.function_hash)
             args, kwargs = self._resolve_args(spec)
+            if phases is not None:
+                phases["ARGS_READY"] = time.time()
+                phases["RUNNING"] = time.time()
             result = fn(*args, **kwargs)
             if spec.num_returns < 0:
                 results, count = self._store_generator_returns(spec, result)
                 return {"results": results, "generator_count": count,
-                        "dur": time.monotonic() - t_start}
+                        "dur": time.monotonic() - t_start,
+                        "phases": phases}
             results, contained = self._store_returns(spec, result)
             return {"results": results, "contained": contained,
-                    "dur": time.monotonic() - t_start}
+                    "dur": time.monotonic() - t_start, "phases": phases}
         except Exception as e:  # noqa: BLE001 — application error
             return {"results": [], "app_error": serialize_error(e),
-                    "dur": time.monotonic() - t_start}
+                    "dur": time.monotonic() - t_start, "phases": phases}
         finally:
             self._executing_tids.pop(tid, None)
             self._thread_task.pop(threading.get_ident(), None)

@@ -67,6 +67,13 @@ _PANELS: List[Dict[str, str]] = [
     {"title": "Device HBM used vs total",
      "expr": "rtpu_device_hbm_used_bytes",
      "expr_b": "rtpu_device_hbm_total_bytes", "unit": "bytes"},
+    # --- live profiling plane: scheduling-latency breakdown ---
+    {"title": "Scheduling phase latency p50/p99",
+     "expr": 'histogram_quantile(0.5, '
+             'rate(rtpu_sched_phase_seconds_bucket[5m]))',
+     "expr_b": 'histogram_quantile(0.99, '
+               'rate(rtpu_sched_phase_seconds_bucket[5m]))',
+     "legend": "{{phase}}", "unit": "s"},
     # --- memory & data-pipeline observability plane ---
     {"title": "Object store utilization (per node)",
      "expr": "rtpu_object_store_used_bytes",

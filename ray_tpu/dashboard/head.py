@@ -29,6 +29,15 @@ Endpoints:
   GET /api/logs       per-task/actor/worker log retrieval: exactly one
                       of ?task_id=, ?actor_id=, ?worker_id= (hex), plus
                       ?tail=N (default 100)
+  GET /api/stacks     cluster-wide all-thread Python stack dump (the
+                      `ray stack` equivalent): ?node=<hex prefix>,
+                      ?worker=<hex> narrow the fan-out
+  GET /api/profile    on-demand wall-clock sampling profile of a node's
+                      workers: ?node=, ?worker=, ?duration=, ?hz=;
+                      ?format=speedscope merges every worker into one
+                      speedscope JSON (threads namespaced by worker)
+  GET /api/profile/stacks  single-node stack dump (legacy spelling of
+                      /api/stacks with a ?node= scope)
   GET /metrics        Prometheus text (scrape target)
 """
 
@@ -313,14 +322,55 @@ class DashboardHead:
             return web.json_response({"error": "no such node"}, status=404)
         kind = ("stacks" if req.path.endswith("/stacks") else "profile")
         wid = req.query.get("worker")
+        hz = req.query.get("hz")
         try:
             out = await client.acall(
                 "profile_worker",
                 worker_id=bytes.fromhex(wid) if wid else None,
                 duration_s=float(req.query.get("duration", 5.0)),
-                kind=kind, timeout=120)
+                kind=kind, hz=float(hz) if hz else None, timeout=120)
         finally:
             client.close()
+        if kind == "profile" and req.query.get("format") == "speedscope":
+            # One merged speedscope document: every worker's threads,
+            # namespaced `<worker8>:<thread>`, over a shared frame table.
+            from ray_tpu.observability import profiling as _profiling
+
+            counts = {}
+            for whex, rep in (out or {}).items():
+                if isinstance(rep, dict):
+                    _profiling.merge_counts(
+                        counts, rep.get("counts") or {},
+                        thread_prefix=f"{whex[:8]}:")
+            return web.json_response(_profiling.render_speedscope(
+                counts, name="ray_tpu node profile"))
+        return web.json_response(out)
+
+    async def stacks(self, req) -> web.Response:
+        """Cluster-wide stack dump: fan the raylet `dump_stacks` RPC out
+        to every alive node (optionally scoped by ?node= / ?worker=) and
+        merge the per-worker replies."""
+        node_prefix = req.query.get("node")
+        wid = req.query.get("worker")
+        nodes = await self._gcs.acall("get_all_nodes", timeout=10)
+        out: Dict[str, Any] = {}
+        for n in nodes or []:
+            if n["state"] != "ALIVE":
+                continue
+            if node_prefix and \
+                    not n["node_id"].hex().startswith(node_prefix):
+                continue
+            client = RpcClient(*tuple(n["addr"]))
+            try:
+                reply = await client.acall(
+                    "dump_stacks",
+                    worker_id=bytes.fromhex(wid) if wid else None,
+                    timeout=20)
+                out.update(reply or {})
+            except Exception as e:  # noqa: BLE001
+                out[f"node-{n['node_id'].hex()[:12]}"] = {"error": str(e)}
+            finally:
+                client.close()
         return web.json_response(out)
 
     # ---- job submission REST (reference: dashboard/modules/job/job_head
@@ -403,6 +453,7 @@ class DashboardHead:
         app.router.add_get("/api/logs", self.logs)
         app.router.add_get("/api/profile", self.profile)
         app.router.add_get("/api/profile/stacks", self.profile)
+        app.router.add_get("/api/stacks", self.stacks)
         app.router.add_post("/api/job_submissions", self.submit_job)
         app.router.add_get("/api/job_submissions", self.list_job_submissions)
         app.router.add_get("/api/job_submissions/{sid}", self.job_submission)

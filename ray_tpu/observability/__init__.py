@@ -23,7 +23,17 @@ shared instrumentation layer every hot path reports through:
   (used/capacity/pinned/spilled gauges, spill/restore/eviction
   counters) sampled from ``NodeObjectStore.stats()`` at each flush.
 - ``timeline``: the Chrome-trace builder shared by
-  ``ray_tpu.timeline()`` and the dashboard's ``GET /api/timeline``.
+  ``ray_tpu.timeline()`` and the dashboard's ``GET /api/timeline`` —
+  including the segmented submit arrows of the scheduling-phase
+  breakdown (PENDING → LEASE_GRANTED → WORKER_STARTED → ARGS_READY →
+  RUNNING).
+- ``profiling``: the live profiling plane — the wall-clock
+  :class:`StackSampler` (bounded memory, per-thread attribution)
+  behind ``util.state.profile()`` flamegraphs, the one-shot stack
+  dumps behind ``util.state.stack()`` / ``GET /api/stacks``, the
+  jax.profiler device-trace bracket behind ``util.state.tpu_profile()``
+  and the ``rtpu_sched_phase_seconds{phase}`` scheduling-latency
+  histogram.
 - ``events``: the cluster event schema registry — typed,
   severity-tagged failure-forensics events (worker-exit taxonomy,
   actor death/restart, node membership, lease reclaim, OOM) recorded
@@ -57,6 +67,17 @@ from ray_tpu.observability.object_store import (  # noqa: F401
     object_store_metrics,
     register_store_sampler,
 )
+from ray_tpu.observability.profiling import (  # noqa: F401
+    SCHED_PHASES,
+    SCHED_SEGMENT_LABELS,
+    StackSampler,
+    capture_thread_stacks,
+    collapse,
+    format_thread_stacks,
+    merge_counts,
+    observe_sched_phases,
+    render_speedscope,
+)
 from ray_tpu.observability.serve import serve_metrics  # noqa: F401
 from ray_tpu.observability.timeline import build_chrome_trace  # noqa: F401
 from ray_tpu.observability.train import (  # noqa: F401
@@ -72,4 +93,7 @@ __all__ = [
     "data_metrics", "object_store_metrics", "register_store_sampler",
     "EVENT_TYPES", "SEVERITIES", "WORKER_EXIT_TYPES",
     "classify_worker_exit", "make_event",
+    "SCHED_PHASES", "SCHED_SEGMENT_LABELS", "StackSampler",
+    "capture_thread_stacks", "collapse", "format_thread_stacks",
+    "merge_counts", "observe_sched_phases", "render_speedscope",
 ]

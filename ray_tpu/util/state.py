@@ -324,6 +324,178 @@ def get_log(task_id: Optional[str] = None, actor_id: Optional[str] = None,
     return lines
 
 
+def _resolve_actor_worker(actor_id: str) -> str:
+    """actor id hex -> its current worker id hex (via the GCS actor
+    table); raises ValueError for unknown/worker-less actors."""
+    info = _gcs().call("get_actor_info",
+                       actor_id=bytes.fromhex(actor_id), timeout=30)
+    if not info or not info.get("worker_id"):
+        raise ValueError(f"actor {actor_id} not found or has no worker")
+    return info["worker_id"].hex()
+
+
+def _worker_row(worker_id: str) -> Dict[str, Any]:
+    """GCS registration row (node_id, addr, pid) for one worker id hex."""
+    for row in _gcs().call("list_workers", timeout=30):
+        if row["worker_id"].hex() == worker_id:
+            return row
+    raise ValueError(f"worker {worker_id} not found")
+
+
+def stack(node_id: Optional[str] = None, worker_id: Optional[str] = None,
+          actor_id: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+    """Live all-thread Python stacks across the cluster (the `ray stack`
+    equivalent). Selectors narrow the fan-out: ``actor_id`` -> that
+    actor's worker, ``worker_id`` -> that worker, ``node_id`` (hex
+    prefix) -> every worker on that node; with none, every worker on
+    every alive node. Returns ``{worker_id_hex: {"pid", "threads":
+    [{"thread_name", "stack", ...}], "stacks": text}}`` — unreachable
+    workers report ``{"error": ...}`` instead of failing the sweep."""
+    from ray_tpu._private.worker import global_worker
+
+    if sum(bool(s) for s in (node_id, worker_id, actor_id)) > 1:
+        raise ValueError("stack() takes at most one of node_id=, "
+                         "worker_id=, actor_id=")
+    w = global_worker()
+    gcs = _gcs()
+    if actor_id is not None:
+        worker_id = _resolve_actor_worker(actor_id)
+    target_worker = bytes.fromhex(worker_id) if worker_id else None
+    if worker_id is not None:
+        node_id = _worker_row(worker_id)["node_id"].hex()
+    out: Dict[str, Dict[str, Any]] = {}
+    for node in gcs.call("get_all_nodes", timeout=30):
+        if node.get("state") != "ALIVE":
+            continue
+        if node_id and not node["node_id"].hex().startswith(node_id):
+            continue
+        client = w._raylet_for_node(node["node_id"])
+        if client is None:
+            continue
+        try:
+            out.update(client.call("dump_stacks", worker_id=target_worker,
+                                   timeout=30) or {})
+        except Exception as e:  # noqa: BLE001
+            out[f"node-{node['node_id'].hex()[:12]}"] = {"error": repr(e)}
+    return out
+
+
+def profile(actor_id: Optional[str] = None,
+            worker_id: Optional[str] = None,
+            duration: float = 1.0,
+            hz: Optional[float] = None) -> Dict[str, Any]:
+    """Wall-clock flamegraph of one actor's (or worker's) process:
+    samples every thread at ``hz`` for ``duration`` seconds and merges
+    them into a collapsed-stack (``folded``) + speedscope
+    (``speedscope``) payload with per-thread attribution.
+
+    The window is chunked into short worker-side RPCs, so a target that
+    dies mid-profile yields the samples gathered so far instead of a
+    hang: the reply is tagged ``partial=True`` with the raylet's PR-4
+    exit classification under ``exit`` (exit_type / detail) explaining
+    *why* the profile came back short."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.observability import profiling as _profiling
+
+    if sum(bool(s) for s in (worker_id, actor_id)) != 1:
+        raise ValueError("profile() requires exactly one of actor_id=, "
+                         "worker_id=")
+    if actor_id is not None:
+        worker_id = _resolve_actor_worker(actor_id)
+    row = _worker_row(worker_id)
+    w = global_worker()
+    client = w._client_for(tuple(row["addr"]))
+    counts: Dict[str, Dict[str, int]] = {}
+    samples = dropped = 0
+    sampled_s = 0.0
+    partial = False
+    exit_info: Optional[Dict[str, Any]] = None
+    remaining = max(float(duration), 0.05)
+    chunk = min(0.5, remaining)
+    while remaining > 1e-3:
+        win = min(chunk, remaining)
+        try:
+            reply = client.call("profile", duration_s=win, hz=hz,
+                                timeout=win + 15)
+        except Exception:  # noqa: BLE001 — died mid-window
+            partial = True
+            exit_info = _classify_worker_exit(w, row, worker_id)
+            break
+        _profiling.merge_counts(counts, reply.get("counts") or {})
+        samples += reply.get("samples", 0)
+        dropped += reply.get("dropped", 0)
+        sampled_s += reply.get("duration_s", win)
+        hz = reply.get("hz", hz)
+        remaining -= win
+    label = f"{'actor ' + actor_id if actor_id else 'worker ' + worker_id}"
+    return {
+        "worker_id": worker_id, "pid": row.get("pid"),
+        "duration_s": sampled_s, "hz": hz,
+        "samples": samples, "dropped": dropped,
+        "folded": _profiling.collapse(counts),
+        "speedscope": _profiling.render_speedscope(
+            counts, name=f"ray_tpu profile: {label}"),
+        "partial": partial, "exit": exit_info,
+    }
+
+
+def _classify_worker_exit(w, row: Dict[str, Any],
+                          worker_id: str) -> Dict[str, Any]:
+    """Why did the profile target go away mid-window? Ask its lessor
+    raylet for the PR-4 exit classification (one short retry — the
+    reaper polls every 200ms, the profiler often notices first)."""
+    from ray_tpu.observability import events as _events
+
+    info: Dict[str, Any] = {}
+    client = w._raylet_for_node(row["node_id"])
+    if client is not None:
+        for attempt in range(2):
+            try:
+                info = client.call(
+                    "get_worker_exit_info",
+                    worker_id=bytes.fromhex(worker_id), timeout=5) or {}
+            except Exception:  # noqa: BLE001
+                info = {}
+            if info.get("exit_type"):
+                break
+            if attempt == 0:
+                import time as _time
+
+                _time.sleep(0.5)
+    else:
+        info = {"exit_type": "NODE_DEATH"}
+    out = dict(info)
+    out.setdefault("exit_type", "SYSTEM_ERROR")
+    try:
+        out["detail"] = _events.format_exit_detail(info, None)
+    except Exception:  # noqa: BLE001
+        out["detail"] = ""
+    return out
+
+
+def tpu_profile(actor_id: Optional[str] = None,
+                worker_id: Optional[str] = None,
+                duration: float = 1.0) -> Dict[str, Any]:
+    """Capture a jax.profiler device trace on the target worker for
+    ``duration`` seconds and return ``{"artifact": path}`` (a TensorBoard
+    / xprof-loadable trace directory on the worker's host). On a
+    process without a TPU backend this is a no-op with a ``skipped``
+    reason — host flamegraphs (:func:`profile`) still work there."""
+    from ray_tpu._private.worker import global_worker
+
+    if sum(bool(s) for s in (worker_id, actor_id)) != 1:
+        raise ValueError("tpu_profile() requires exactly one of "
+                         "actor_id=, worker_id=")
+    if actor_id is not None:
+        worker_id = _resolve_actor_worker(actor_id)
+    row = _worker_row(worker_id)
+    w = global_worker()
+    client = w._client_for(tuple(row["addr"]))
+    reply = client.call("tpu_profile", duration_s=float(duration),
+                        timeout=float(duration) + 60)
+    return reply
+
+
 def summary_actors() -> List[Dict[str, Any]]:
     """Per-class rollup of actor states (reference: `ray summary
     actors`)."""

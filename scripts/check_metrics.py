@@ -17,6 +17,9 @@ runtime can only check per-process:
   ``object_store_``, ``serve_``, ...) so the ``rtpu_*`` exposition
   stays grouped — a new subsystem extends ``_FAMILIES`` once, in one
   reviewable place;
+- histogram families must end in ``_seconds`` or ``_bytes``: the unit
+  suffix is the only machine-readable statement of what the buckets
+  measure, and every boundary table in the repo is one of the two;
 - gauges must not declare a ``pid`` tag key: the exporter appends its
   own ``pid=<source>`` label to every gauge and duplicate label names
   break the whole Prometheus scrape;
@@ -55,6 +58,7 @@ _FAMILIES = (
     "learner_",       # RLlib learner update metrics
     "node_",          # raylet reporter node gauges
     "object_store_",  # per-node store pressure (spill/evict/pin)
+    "sched_",         # scheduling-latency phase breakdown (profiling.py)
     "serve_",         # LLM serving latency/queue metrics
     "train_",         # train-session report metrics
     "worker_",        # per-worker process gauges
@@ -211,6 +215,13 @@ def check_paths(root: str) -> List[str]:
                 f"registered families {sorted(set(_FAMILIES))}; prefix it "
                 f"with its subsystem family (or extend _FAMILIES in "
                 f"scripts/check_metrics.py)")
+        if d["class"] == "Histogram" and \
+                not name.endswith(("_seconds", "_bytes")):
+            problems.append(
+                f"{d['where']}: histogram {name!r} must end in _seconds "
+                f"or _bytes — the unit suffix is how dashboards and "
+                f"histogram_quantile() users know what the buckets "
+                f"measure (https://prometheus.io/docs/practices/naming/)")
         tag_keys = d.get("tag_keys")
         if d["class"] == "Gauge" and tag_keys and "pid" in tag_keys:
             problems.append(
