@@ -1,0 +1,56 @@
+"""graftlint: project-wide static analysis for jit-hygiene, distributed
+deadlocks, collective consistency, lock discipline, async-blocking
+calls, metric declarations and the cluster-event schema.
+
+The costliest bug classes in a TPU-native stack only bite at pod scale:
+a silent recompile from an unhashable static arg burns minutes of XLA
+compile time, a blocking call wedges an RPC event loop, divergent
+collective sequences across replicas hang the whole mesh. None of them
+fail a unit test. graftlint is the AST-level gate that catches them at
+review time instead of in a pod postmortem.
+
+Architecture:
+
+- :mod:`ray_tpu._private.lint.core` — the framework: :class:`Finding`,
+  :class:`ModuleInfo`, the pass registry, per-line / per-file
+  suppression comments (``# graftlint: disable=<rule>``), the baseline
+  file for grandfathered findings, and :func:`run_lint`.
+- :mod:`ray_tpu._private.lint.passes` — the passes. Importing it
+  registers every built-in pass.
+- :mod:`ray_tpu._private.lint.cli` — ``python -m ray_tpu._private.lint``
+  (also reachable as ``scripts/graftlint.py``).
+
+Adding a pass: subclass :class:`~ray_tpu._private.lint.core.LintPass`
+in a new module under ``passes/``, decorate it with ``@register``, and
+import the module from ``passes/__init__``. See README "Static
+analysis".
+"""
+
+from ray_tpu._private.lint.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    LintPass,
+    LintResult,
+    ModuleInfo,
+    all_passes,
+    iter_modules,
+    register,
+    registered_passes,
+    run_lint,
+)
+
+# Importing the passes package registers every built-in pass.
+from ray_tpu._private.lint import passes  # noqa: F401, E402
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "LintPass",
+    "LintResult",
+    "ModuleInfo",
+    "all_passes",
+    "iter_modules",
+    "register",
+    "registered_passes",
+    "run_lint",
+]

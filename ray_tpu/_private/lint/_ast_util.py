@@ -1,0 +1,118 @@
+"""Shared AST helpers for graftlint passes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted(node: ast.expr) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' for anything else (a
+    subscripted/called base still yields its attribute tail, so
+    ``x[0].recv`` -> ``.recv`` and membership checks on suffixes keep
+    working)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted(call.func)
+
+
+def walk_scope(fn: ast.AST, skip_nested: bool = True) -> Iterator[ast.AST]:
+    """Yield descendants of ``fn``; with ``skip_nested`` the walk does
+    not descend into nested def/async-def/lambda scopes (their bodies
+    run under different execution rules — e.g. a run_in_executor lambda
+    inside an async def is *supposed* to block)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if skip_nested and isinstance(node, _SCOPE_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def awaited_calls(tree: ast.AST) -> Set[int]:
+    """ids of Call nodes that sit directly under an ``await``."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            out.add(id(node.value))
+    return out
+
+
+def consumed_calls(tree: ast.AST) -> Set[int]:
+    """ids of Call nodes that are *consumed* by an enclosing await or
+    call expression — ``await asyncio.wait_for(ev.wait(), t)`` never
+    executes ``ev.wait`` synchronously (it builds a coroutine/argument
+    for the wrapper), so wait-ish rules must not flag it."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        inner: Iterator[ast.AST] = ()
+        if isinstance(node, ast.Await):
+            inner = ast.walk(node.value)
+        elif isinstance(node, ast.Call):
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            inner = (n for a in args for n in ast.walk(a))
+        for sub in inner:
+            if isinstance(sub, ast.Call):
+                out.add(id(sub))
+    return out
+
+
+def literal(node: Optional[ast.expr]):
+    """ast.literal_eval or None for dynamic expressions."""
+    if node is None:
+        return None
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def has_timeout(call: ast.Call) -> bool:
+    """True when the call passes any ``timeout``-ish argument."""
+    for kw in call.keywords:
+        if kw.arg and ("timeout" in kw.arg or kw.arg == "deadline"):
+            return True
+    return False
+
+
+def enclosing_class_map(tree: ast.Module):
+    """function/method def -> enclosing ClassDef name ('' at module
+    level), plus {class name: ClassDef}."""
+    owner = {}
+    classes = {}
+
+    def visit(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                classes[child.name] = child
+                visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                owner[child] = cls
+                visit(child, cls)
+            else:
+                visit(child, cls)
+
+    visit(tree, "")
+    return owner, classes

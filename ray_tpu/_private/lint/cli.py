@@ -1,0 +1,105 @@
+"""graftlint CLI: ``python -m ray_tpu._private.lint`` /
+``scripts/graftlint.py``.
+
+Exit status is 0 iff there are zero unbaselined, unsuppressed findings
+(stale baseline entries are reported but don't fail — prune them with
+``--baseline-update``). Run with ``--baseline-update`` after fixing or
+justifying findings; it rewrites the baseline to exactly the current
+finding set, preserving justifications of entries that still match.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+
+def repo_root() -> str:
+    import ray_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(ray_tpu.__file__)))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), ".graftlint-baseline.json")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from ray_tpu._private.lint import (
+        Baseline, all_passes, registered_passes, run_lint,
+    )
+
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST static analysis for jit-hygiene, distributed-"
+                    "deadlock, collective-consistency, lock-discipline, "
+                    "async-blocking, metric and event-schema bugs.")
+    parser.add_argument(
+        "roots", nargs="*",
+        help="files/directories to lint (default: the ray_tpu package)")
+    parser.add_argument(
+        "--select", action="append", default=None, metavar="PASS",
+        help="run only this pass (repeatable; see --list-passes)")
+    parser.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="baseline file (default: <repo>/.graftlint-baseline.json)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding")
+    parser.add_argument(
+        "--baseline-update", action="store_true",
+        help="rewrite the baseline to the current finding set "
+             "(keeps justifications of entries that still match)")
+    parser.add_argument(
+        "--list-passes", action="store_true",
+        help="list registered passes and their rules")
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="print findings only (no summary)")
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for p in all_passes():
+            print(f"{p.name}: {p.description}")
+            for r in p.rules:
+                print(f"    {r}")
+        return 0
+
+    root = repo_root()
+    roots = args.roots or [os.path.join(root, "ray_tpu")]
+    baseline_path = None if args.no_baseline else (
+        args.baseline or default_baseline_path())
+
+    result = run_lint(roots, select=args.select,
+                      baseline=baseline_path, rel_to=root)
+
+    if args.baseline_update:
+        path = args.baseline or default_baseline_path()
+        prev = Baseline.load(path if os.path.exists(path) else None)
+        new_base = Baseline.from_findings(
+            result.findings + result.baselined, previous=prev)
+        new_base.save(path)
+        print(f"graftlint: baseline written to {path} "
+              f"({len(new_base.entries)} entries)")
+        return 0
+
+    for f in result.findings:
+        print(f.render())
+    for e in result.stale_baseline:
+        print(f"graftlint: stale baseline entry (fixed? prune with "
+              f"--baseline-update): {e['path']}: [{e['rule']}] "
+              f"{e.get('context', '')!r}", file=sys.stderr)
+    if result.findings:
+        print(f"graftlint: {len(result.findings)} new finding(s) "
+              f"({len(result.baselined)} baselined, "
+              f"{len(result.suppressed)} suppressed)", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"graftlint: OK ({len(result.modules)} files, "
+              f"{len(result.baselined)} baselined, "
+              f"{len(result.suppressed)} suppressed, "
+              f"{len(registered_passes())} passes)")
+    return 0

@@ -1,0 +1,356 @@
+"""graftlint framework: findings, pass registry, suppressions, baseline.
+
+Everything here is pure stdlib + ``ast``; passes never import (let
+alone execute) the code under analysis, so linting a file with a
+syntax-level hazard cannot run it.
+
+Suppression grammar (scanned from raw source, so it works inside any
+statement the AST attributes to that line):
+
+- ``expr  # graftlint: disable=rule-a,rule-b`` — suppress those rules
+  on this line (a pass name suppresses every rule the pass owns;
+  ``all`` suppresses everything).
+- ``# graftlint: disable-file=rule-a`` on a line of its own — suppress
+  for the whole file.
+
+Baseline file: JSON with one entry per grandfathered finding, matched
+by ``(rule, path, context)`` where context is the stripped source line
+— line-number independent, so unrelated edits above a grandfathered
+finding don't resurrect it. Every entry carries a human-written
+``justification``; ``--baseline-update`` preserves justifications of
+entries that still match and stamps new ones with ``TODO: justify``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+__all__ = [
+    "Finding", "ModuleInfo", "LintPass", "LintResult", "Baseline",
+    "register", "registered_passes", "all_passes", "iter_modules",
+    "run_lint",
+]
+
+
+@dataclass
+class Finding:
+    """One violation: a rule id, a location, and a message.
+
+    ``context`` (the stripped source line) is the stable half of the
+    identity used for baseline matching; ``line`` is for humans.
+    """
+
+    rule: str
+    path: str          # repo-relative (or as-given when rel_to=None)
+    line: int
+    message: str
+    context: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.context)
+
+
+@dataclass
+class ModuleInfo:
+    """A parsed source file handed to every pass."""
+
+    path: str          # absolute on disk
+    relpath: str       # as reported in findings
+    src: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+
+    def context_for(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(rule=rule, path=self.relpath, line=int(line),
+                       message=message,
+                       context=self.context_for(int(line)))
+
+
+class LintPass:
+    """Base class for graftlint passes.
+
+    Subclasses set ``name`` (kebab-case pass id), ``rules`` (the rule
+    ids they may emit — used by ``--list-passes`` and suppression-by-
+    pass-name), and ``description``. Per-file logic goes in
+    :meth:`check_module`; cross-file logic (consistency tables, lock
+    graphs) accumulates state in :meth:`check_module` and reports from
+    :meth:`finalize`. A fresh instance is built per run, so instance
+    state never leaks across runs.
+    """
+
+    name: str = ""
+    rules: Sequence[str] = ()
+    description: str = ""
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Type[LintPass]] = {}
+
+
+def register(cls: Type[LintPass]) -> Type[LintPass]:
+    if not cls.name:
+        raise ValueError(f"{cls.__name__} has no pass name")
+    if cls.name in _REGISTRY and _REGISTRY[cls.name] is not cls:
+        raise ValueError(f"duplicate graftlint pass {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def registered_passes() -> Dict[str, Type[LintPass]]:
+    return dict(_REGISTRY)
+
+
+def all_passes(select: Optional[Sequence[str]] = None) -> List[LintPass]:
+    """Fresh instances of the selected (default: all) passes."""
+    if select:
+        unknown = sorted(set(select) - set(_REGISTRY))
+        if unknown:
+            raise ValueError(
+                f"unknown pass(es) {unknown}; registered: "
+                f"{sorted(_REGISTRY)}")
+        names = [n for n in sorted(_REGISTRY) if n in set(select)]
+    else:
+        names = sorted(_REGISTRY)
+    return [_REGISTRY[n]() for n in names]
+
+
+# --------------------------------------------------------------- modules
+
+def iter_modules(roots: Sequence[str],
+                 rel_to: Optional[str] = None,
+                 exclude_dirs: Sequence[str] = ("__pycache__",),
+                 ) -> List[ModuleInfo]:
+    """Parse every ``.py`` under ``roots`` (a file path is taken as-is).
+
+    Files that fail to parse are skipped here; the runner reports them
+    as ``parse-error`` findings so a broken file can't silently dodge
+    the lint.
+    """
+    mods: List[ModuleInfo] = []
+    seen = set()
+    for root in roots:
+        paths: List[str] = []
+        if os.path.isfile(root):
+            paths.append(root)
+        else:
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in exclude_dirs)
+                paths.extend(os.path.join(dirpath, fn)
+                             for fn in sorted(filenames)
+                             if fn.endswith(".py"))
+        for path in paths:
+            apath = os.path.abspath(path)
+            if apath in seen:
+                continue
+            seen.add(apath)
+            with open(apath, "r", encoding="utf-8") as f:
+                src = f.read()
+            rel = (os.path.relpath(apath, rel_to).replace(os.sep, "/")
+                   if rel_to else path)
+            try:
+                tree = ast.parse(src, filename=apath)
+            except SyntaxError as e:
+                # A ModuleInfo with an empty tree + a marker the runner
+                # turns into a parse-error finding.
+                tree = ast.Module(body=[], type_ignores=[])
+                mods.append(ModuleInfo(
+                    path=apath, relpath=rel, src=src, tree=tree,
+                    lines=src.splitlines()))
+                mods[-1].parse_error = e  # type: ignore[attr-defined]
+                continue
+            mods.append(ModuleInfo(path=apath, relpath=rel, src=src,
+                                   tree=tree, lines=src.splitlines()))
+    return mods
+
+
+# ----------------------------------------------------------- suppression
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-file)\s*=\s*"
+    r"([a-zA-Z0-9_,\-\s]+)")
+
+
+def parse_suppressions(src: str):
+    """Returns ``(line -> set(rules), set(file_rules))``."""
+    per_line: Dict[int, set] = {}
+    per_file: set = set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+        if m.group(1) == "disable-file":
+            per_file |= rules
+        else:
+            per_line.setdefault(i, set()).update(rules)
+    return per_line, per_file
+
+
+def _suppressed(finding: Finding, per_line, per_file,
+                rule_owner: Dict[str, str]) -> bool:
+    names = {finding.rule, rule_owner.get(finding.rule, ""), "all"}
+    if per_file & names:
+        return True
+    return bool(per_line.get(finding.line, set()) & names)
+
+
+# -------------------------------------------------------------- baseline
+
+class Baseline:
+    """Grandfathered findings, matched multiset-wise by
+    ``(rule, path, context)``."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries: List[dict] = list(entries or [])
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls()
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(data.get("findings", []))
+
+    def save(self, path: str) -> None:
+        data = {
+            "// about": "graftlint baseline: grandfathered findings. "
+                        "Matched by (rule, path, context); every entry "
+                        "needs a justification. Regenerate with "
+                        "scripts/graftlint.py --baseline-update.",
+            "version": 1,
+            "findings": sorted(
+                self.entries,
+                key=lambda e: (e["path"], e["rule"], e["context"])),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    def partition(self, findings: Sequence[Finding]):
+        """Split findings into (new, grandfathered); also returns the
+        stale baseline entries nothing matched (fixed or moved code —
+        prune them with --baseline-update)."""
+        pool: Dict[Tuple[str, str, str], List[dict]] = {}
+        for e in self.entries:
+            key = (e.get("rule", ""), e.get("path", ""),
+                   e.get("context", ""))
+            pool.setdefault(key, []).append(e)
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f in findings:
+            bucket = pool.get(f.baseline_key())
+            if bucket:
+                bucket.pop()
+                old.append(f)
+            else:
+                new.append(f)
+        stale = [e for bucket in pool.values() for e in bucket]
+        return new, old, stale
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding],
+                      previous: Optional["Baseline"] = None) -> "Baseline":
+        """Baseline covering ``findings``, keeping justifications from
+        ``previous`` for entries that still match."""
+        just: Dict[Tuple[str, str, str], List[str]] = {}
+        for e in (previous.entries if previous else []):
+            key = (e.get("rule", ""), e.get("path", ""),
+                   e.get("context", ""))
+            just.setdefault(key, []).append(
+                e.get("justification", "TODO: justify"))
+        entries = []
+        for f in findings:
+            bucket = just.get(f.baseline_key())
+            entries.append({
+                "rule": f.rule,
+                "path": f.path,
+                "context": f.context,
+                "justification": (bucket.pop(0) if bucket
+                                  else "TODO: justify"),
+            })
+        return cls(entries)
+
+
+# ---------------------------------------------------------------- runner
+
+@dataclass
+class LintResult:
+    findings: List[Finding]        # new, unbaselined, unsuppressed
+    baselined: List[Finding]       # matched a baseline entry
+    suppressed: List[Finding]      # killed by a suppression comment
+    stale_baseline: List[dict]     # baseline entries nothing matched
+    modules: List[ModuleInfo]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def run_lint(roots: Sequence[str],
+             select: Optional[Sequence[str]] = None,
+             baseline: Optional[str] = None,
+             rel_to: Optional[str] = None,
+             passes: Optional[Sequence[LintPass]] = None) -> LintResult:
+    """Run the selected passes over ``roots``.
+
+    ``rel_to`` makes finding paths (and baseline keys) relative — the
+    repo-root invocation passes the repo root so the baseline file is
+    machine-independent. ``passes`` overrides the registry (tests).
+    """
+    mods = iter_modules(roots, rel_to=rel_to)
+    active = list(passes) if passes is not None else all_passes(select)
+    rule_owner: Dict[str, str] = {}
+    for p in active:
+        for r in p.rules:
+            rule_owner[r] = p.name
+
+    raw: List[Finding] = []
+    for mod in mods:
+        err = getattr(mod, "parse_error", None)
+        if err is not None:
+            raw.append(Finding(
+                rule="parse-error", path=mod.relpath,
+                line=getattr(err, "lineno", 0) or 0,
+                message=f"file does not parse: {err.msg}",
+                context=""))
+            continue
+        for p in active:
+            raw.extend(p.check_module(mod))
+    for p in active:
+        raw.extend(p.finalize())
+
+    supp_cache = {m.relpath: parse_suppressions(m.src) for m in mods}
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in raw:
+        per_line, per_file = supp_cache.get(f.path, ({}, set()))
+        if _suppressed(f, per_line, per_file, rule_owner):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    base = Baseline.load(baseline)
+    new, old, stale = base.partition(kept)
+    return LintResult(findings=new, baselined=old, suppressed=suppressed,
+                      stale_baseline=stale, modules=mods)

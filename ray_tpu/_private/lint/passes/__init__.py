@@ -1,0 +1,11 @@
+"""Built-in graftlint passes. Importing this package registers them."""
+
+from ray_tpu._private.lint.passes import (  # noqa: F401
+    async_blocking,
+    collectives,
+    deadlock,
+    events,
+    jit_hygiene,
+    locks,
+    metrics,
+)

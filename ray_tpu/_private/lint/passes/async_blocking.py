@@ -1,0 +1,129 @@
+"""async-blocking: blocking calls inside ``async def`` bodies.
+
+Every async def in this codebase runs on an :class:`EventLoopThread`
+(rpc.py) — one wedged coroutine stalls heartbeats, lease dispatch and
+every other handler sharing the loop. This is the bug class PR 5's
+SIGUSR2 stack dumps keep diagnosing *post hoc*; here it fails review
+instead.
+
+Only the coroutine's *direct* scope is scanned: nested ``def``/
+``lambda`` bodies are skipped because the idiomatic fix is exactly to
+move the blocking call into a ``run_in_executor`` payload, and flagging
+the payload would punish the fix. Awaited calls are never flagged.
+
+Two rules:
+
+- ``async-blocking-call``: a known-blocking API (``time.sleep``, sync
+  ``subprocess``, sync socket ops, ``open``/file I/O, the sync
+  ``RpcClient.call``) invoked without ``await``.
+- ``async-unawaited-wait``: a bare ``x.wait()`` / ``x.result()`` /
+  ``x.join()`` with no arguments and no await — either a blocking
+  ``threading`` primitive on the loop or a forgotten ``await`` on an
+  asyncio one; both wedge.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.lint._ast_util import (
+    awaited_calls, call_name, consumed_calls, has_timeout, walk_scope,
+)
+from ray_tpu._private.lint.core import Finding, LintPass, ModuleInfo, register
+
+_BLOCKING_EXACT = {
+    "time.sleep": "sleeps the whole event loop — use asyncio.sleep",
+    "os.system": "blocks the loop for the child's lifetime",
+    "os.popen": "blocks the loop on child I/O",
+    "os.wait": "blocks the loop until a child exits",
+    "socket.create_connection":
+        "sync connect on the loop — use asyncio.open_connection",
+    "subprocess.run": "blocks the loop for the child's lifetime",
+    "subprocess.call": "blocks the loop for the child's lifetime",
+    "subprocess.check_call": "blocks the loop for the child's lifetime",
+    "subprocess.check_output": "blocks the loop for the child's lifetime",
+    "subprocess.getoutput": "blocks the loop for the child's lifetime",
+    "subprocess.getstatusoutput":
+        "blocks the loop for the child's lifetime",
+    "subprocess.Popen":
+        "fork+exec can block the loop for tens of ms under load",
+    "open": "sync file I/O on the event loop",
+    "io.open": "sync file I/O on the event loop",
+    "requests.get": "sync HTTP on the event loop",
+    "requests.post": "sync HTTP on the event loop",
+    "requests.request": "sync HTTP on the event loop",
+    "urllib.request.urlopen": "sync HTTP on the event loop",
+}
+
+# Attribute-call suffixes that are blocking on their common receivers
+# (sockets / pipes / the sync RpcClient.call transport).
+_BLOCKING_SUFFIX = {
+    ".recv": "sync socket/pipe read on the event loop",
+    ".recv_into": "sync socket read on the event loop",
+    ".accept": "sync accept on the event loop",
+    ".sendall": "sync socket write on the event loop",
+    ".call": ("sync RPC on the event loop — use 'await "
+              "client.acall(...)'"),
+}
+
+# Bare x.wait()/x.join() with no bound: blocking threading primitive or
+# forgotten await. ``.result`` is deliberately absent — ``fut.result()``
+# on an already-completed asyncio future (the post-``asyncio.wait``
+# idiom) is non-blocking and statically indistinguishable.
+_WAITISH = (".wait", ".join")
+
+
+@register
+class AsyncBlockingPass(LintPass):
+    name = "async-blocking"
+    rules = ("async-blocking-call", "async-unawaited-wait")
+    description = ("blocking calls and unawaited waits inside async "
+                   "event-loop coroutines")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        out: List[Finding] = []
+        awaited = awaited_calls(mod.tree)
+        consumed = consumed_calls(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for sub in walk_scope(node, skip_nested=True):
+                if not isinstance(sub, ast.Call) or id(sub) in awaited:
+                    continue
+                name = call_name(sub)
+                if not name:
+                    continue
+                why = _BLOCKING_EXACT.get(name)
+                if name == "os.waitpid":
+                    flags = " ".join(
+                        ast.unparse(a) for a in sub.args[1:])
+                    why = (None if "WNOHANG" in flags
+                           else "blocks the loop until the child exits "
+                                "— pass os.WNOHANG or poll in an "
+                                "executor")
+                if why is None and "." in name:
+                    for suffix, reason in _BLOCKING_SUFFIX.items():
+                        if name.endswith(suffix) and \
+                                not name.endswith(".acall"):
+                            why = reason
+                            break
+                if why is not None:
+                    out.append(mod.finding(
+                        "async-blocking-call", sub,
+                        f"{name}() inside 'async def {node.name}': "
+                        f"{why}"))
+                    continue
+                # x.wait() / x.join() with no bound, no await, and not
+                # consumed by a wrapper call (asyncio.wait_for(ev.wait())
+                # builds a coroutine — it doesn't block here).
+                if "." in name and name.endswith(_WAITISH) \
+                        and not sub.args and not has_timeout(sub) \
+                        and id(sub) not in consumed:
+                    out.append(mod.finding(
+                        "async-unawaited-wait", sub,
+                        f"unawaited, unbounded {name}() inside 'async "
+                        f"def {node.name}': a threading primitive here "
+                        f"blocks the loop forever; an asyncio one "
+                        f"needs 'await'"))
+        return out

@@ -1,0 +1,183 @@
+"""collective-consistency: axis-name and branch discipline for
+collectives.
+
+Two SPMD invariants no unit test on a 1-device CPU backend can check:
+
+- ``collective-unknown-axis``: a ``psum``/``all_gather``/``ppermute``
+  axis name must be bound by some enclosing mesh / axis declaration.
+  A typo'd axis fails only when the program finally runs on a real
+  mesh — at pod bring-up, inside a 30-minute compile. The pass
+  compares every literal axis argument against the axes declared
+  anywhere in the same module (``Mesh(...)`` tuples, ``make_mesh``
+  dict keys, ``PartitionSpec``/``P`` entries, ``axis_name=``-style
+  defaults and kwargs) plus the repo-wide ``AXIS_ORDER`` axes.
+- ``collective-divergent-branches``: inside a function that issues
+  collectives, an ``if``/``else`` whose two branches issue *different*
+  collective sequences hangs the mesh when replicas disagree on the
+  predicate — each replica enters a different collective schedule and
+  everyone waits forever (the Podracer actor/learner split is the most
+  sensitive consumer). Branches where only one side has collectives
+  are the common static fallback shape (``if axis_size == 1``) and are
+  not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ray_tpu._private.lint._ast_util import call_name, walk_scope
+from ray_tpu._private.lint.core import Finding, LintPass, ModuleInfo, register
+
+# Repo-wide mesh axes (ray_tpu/parallel/mesh.py AXIS_ORDER): usable from
+# any module without a local declaration.
+_GLOBAL_AXES = {"data", "fsdp", "pipe", "seq", "tensor"}
+
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle", "pbroadcast", "axis_index",
+    "axis_size", "pcast", "pvary",
+}
+
+
+def _axis_strings(node: Optional[ast.expr]) -> List[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+        return out
+    return []
+
+
+def _collective_axis(call: ast.Call) -> Tuple[Optional[str], List[str]]:
+    """(op, literal axis names) for a collective call, else (None, [])."""
+    name = call_name(call)
+    op = name.rsplit(".", 1)[-1]
+    if op not in _COLLECTIVES:
+        return None, []
+    axes: List[str] = []
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis", "axis_names"):
+            axes.extend(_axis_strings(kw.value))
+    if not axes:
+        # Positional axis arg: arg 0 for axis_index/axis_size, arg 1
+        # for value-first collectives.
+        idx = 0 if op in ("axis_index", "axis_size") else 1
+        if len(call.args) > idx:
+            axes.extend(_axis_strings(call.args[idx]))
+    return op, axes
+
+
+def _declared_axes(mod: ModuleInfo) -> Set[str]:
+    axes: Set[str] = set(_GLOBAL_AXES)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node).rsplit(".", 1)[-1]
+            if name == "Mesh":
+                if len(node.args) > 1:
+                    axes.update(_axis_strings(node.args[1]))
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        axes.update(_axis_strings(kw.value))
+            elif name in ("PartitionSpec", "P", "NamedSharding"):
+                for a in node.args:
+                    axes.update(_axis_strings(a))
+            elif name in ("make_mesh", "device_mesh"):
+                cands = list(node.args)
+                cands += [kw.value for kw in node.keywords
+                          if kw.arg in ("axes", "mesh_shape")]
+                for c in cands:
+                    if isinstance(c, ast.Dict):
+                        for k in c.keys:
+                            if isinstance(k, ast.Constant) and \
+                                    isinstance(k.value, str):
+                                axes.add(k.value)
+            # Any axis-ish kwarg on a NON-collective call binds the name
+            # for this module's collectives (e.g. shard_map wrappers
+            # taking axis_name="sp", functools.partial(..., axis_name=..)).
+            # Collective calls are excluded: a psum's own axis_name must
+            # not vouch for itself, or kwarg-form typos become invisible.
+            if name not in _COLLECTIVES:
+                for kw in node.keywords:
+                    if kw.arg and ("axis" in kw.arg) and \
+                            kw.arg not in ("axis_index_groups",):
+                        axes.update(_axis_strings(kw.value))
+        elif isinstance(node, ast.arguments):
+            # String defaults of axis-named parameters.
+            pos = node.posonlyargs + node.args + node.kwonlyargs
+            defaults = list(node.defaults) + list(node.kw_defaults)
+            first_default = len(pos) - len(defaults)
+            for i, a in enumerate(pos):
+                if i < first_default:
+                    continue
+                if "axis" in a.arg:
+                    axes.update(_axis_strings(defaults[i - first_default]))
+    return axes
+
+
+@register
+class CollectivesPass(LintPass):
+    name = "collective-consistency"
+    rules = ("collective-unknown-axis", "collective-divergent-branches")
+    description = ("collective axis names must be declared; conditional "
+                   "branches must issue identical collective sequences")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        out: List[Finding] = []
+        declared = _declared_axes(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                op, axes = _collective_axis(node)
+                if op is None:
+                    continue
+                for axis in axes:
+                    if axis not in declared:
+                        out.append(mod.finding(
+                            "collective-unknown-axis", node,
+                            f"{op}(..., {axis!r}): axis {axis!r} is not "
+                            f"declared by any mesh/PartitionSpec/"
+                            f"axis_name binding in this module (known "
+                            f"here: {sorted(declared)}) — a typo'd "
+                            f"axis only fails at pod bring-up"))
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_branches(mod, node))
+        return out
+
+    def _branch_sig(self, stmts) -> List[Tuple[str, Tuple[str, ...]]]:
+        sig = []
+        for stmt in stmts:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(sub, ast.Call):
+                    op, axes = _collective_axis(sub)
+                    if op is not None and op not in ("axis_index",
+                                                     "axis_size"):
+                        sig.append((op, tuple(sorted(axes))))
+        return sig
+
+    def _check_branches(self, mod: ModuleInfo, fn) -> Iterable[Finding]:
+        for node in walk_scope(fn, skip_nested=True):
+            if not isinstance(node, ast.If) or not node.orelse:
+                continue
+            body_sig = self._branch_sig(node.body)
+            else_sig = self._branch_sig(node.orelse)
+            # One-sided collectives are the static-fallback shape
+            # ("if n == 1: no ring"); only flag when BOTH branches
+            # issue collectives and disagree.
+            if body_sig and else_sig and body_sig != else_sig:
+                yield mod.finding(
+                    "collective-divergent-branches", node,
+                    f"'if' branches inside {fn.name}() issue different "
+                    f"collective sequences ({body_sig} vs {else_sig}): "
+                    f"replicas disagreeing on the predicate enter "
+                    f"different collective schedules and the mesh "
+                    f"hangs — hoist the collectives out of the branch "
+                    f"or make both arms issue the same sequence")

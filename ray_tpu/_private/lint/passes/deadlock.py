@@ -1,0 +1,128 @@
+"""distributed-deadlock: self-waits and unbounded waits in remote bodies.
+
+The two wedge shapes the cluster forensics plane (PR 4/5) keeps
+attributing after the fact:
+
+- ``deadlock-self-get``: ``ray_tpu.get(self.<m>.remote(...))`` inside
+  an actor method. An actor executes one method at a time; getting the
+  result of a call *to itself* waits on work that can only start after
+  the current method returns — a guaranteed single-actor deadlock.
+  Simple ref-through-local flows (``r = self.m.remote(); ...
+  ray_tpu.get(r)``) are tracked too.
+- ``deadlock-unbounded-wait``: ``.call()`` / ``.acall()`` / bare
+  ``.wait()`` / ``.result()`` / ``.join()`` with no timeout inside a
+  remote body. Cross-worker RPCs without a bound turn one lost peer
+  into a wedged actor that the lease reaper then can't distinguish
+  from a long-running task.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ray_tpu._private.lint._ast_util import (
+    awaited_calls, call_name, consumed_calls, dotted, has_timeout,
+    walk_scope,
+)
+from ray_tpu._private.lint.core import Finding, LintPass, ModuleInfo, register
+
+_GET_ROOTS = ("ray_tpu", "ray")
+_WAITISH = (".call", ".acall", ".wait", ".result", ".join")
+
+
+def _is_remote_decorated(node) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if dotted(target).rsplit(".", 1)[-1] == "remote":
+            return True
+    return False
+
+
+def _is_get_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    return (name.endswith(".get")
+            and name.rsplit(".", 1)[0].rsplit(".", 1)[-1] in _GET_ROOTS)
+
+
+def _self_remote_call(node: ast.AST) -> bool:
+    """Does this expression subtree contain ``self.<m>.remote(...)``?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = call_name(sub)
+            if name.startswith("self.") and name.endswith(".remote"):
+                return True
+    return False
+
+
+@register
+class DeadlockPass(LintPass):
+    name = "distributed-deadlock"
+    rules = ("deadlock-self-get", "deadlock-unbounded-wait")
+    description = ("self-gets and unbounded cross-worker waits inside "
+                   "@remote task/actor bodies")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        out: List[Finding] = []
+        bodies: List[ast.AST] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_remote_decorated(node):
+                bodies.append(node)
+            elif isinstance(node, ast.ClassDef) \
+                    and _is_remote_decorated(node):
+                bodies.extend(
+                    c for c in node.body
+                    if isinstance(c, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)))
+        awaited = awaited_calls(mod.tree) | consumed_calls(mod.tree)
+        for fn in bodies:
+            out.extend(self._scan(mod, fn, awaited))
+        return out
+
+    def _scan(self, mod: ModuleInfo, fn, awaited: Set[int]):
+        # Locals assigned from self.<m>.remote(...) — refs whose get()
+        # is a self-wait even when it happens lines later.
+        self_refs: Set[str] = set()
+        for node in walk_scope(fn, skip_nested=True):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, (ast.Call, ast.List,
+                                            ast.Tuple)) and \
+                    _self_remote_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self_refs.add(t.id)
+
+        for node in walk_scope(fn, skip_nested=True):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if _is_get_call(node):
+                direct = any(_self_remote_call(a) for a in node.args)
+                via_ref = any(
+                    isinstance(a, ast.Name) and a.id in self_refs
+                    for a in node.args) or any(
+                    isinstance(e, ast.Name) and e.id in self_refs
+                    for a in node.args if isinstance(a, (ast.List,
+                                                         ast.Tuple))
+                    for e in a.elts)
+                if direct or via_ref:
+                    yield mod.finding(
+                        "deadlock-self-get", node,
+                        f"{name}() on this actor's own .remote() "
+                        f"result inside {fn.name}(): the actor runs "
+                        f"one method at a time, so it waits on work "
+                        f"that can only start after this method "
+                        f"returns — guaranteed deadlock")
+                continue
+            if "." in name and name.endswith(_WAITISH) \
+                    and id(node) not in awaited \
+                    and not node.args and not has_timeout(node):
+                # Zero positional args also exempts str.join(iterable)
+                # and friends — everything blocking here takes its
+                # bound positionally.
+                yield mod.finding(
+                    "deadlock-unbounded-wait", node,
+                    f"unbounded {name}() inside remote body "
+                    f"{fn.name}(): a lost peer wedges this "
+                    f"worker forever — pass a timeout and handle it")

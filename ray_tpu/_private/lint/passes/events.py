@@ -1,0 +1,103 @@
+"""event-schema: cluster-event emission sites vs. the registry vs. docs.
+
+Migrated from the PR-4 test-side lint (tests/test_failure_forensics.py
+``TestEventLint``): every event type emitted anywhere in the package
+must be registered in ``observability/events.py``; every registered
+type must have at least one emission site (dead schema entries mislead
+postmortems); and every registered type must be documented in the
+dashboard ``GET /api/events`` table (``dashboard/head.py`` module
+docstring).
+
+The registry is read *statically* (AST of the events module inside the
+linted tree), so the pass works on fixture trees and never imports the
+code under analysis. Trees without an ``observability/events.py`` are
+exempt — the schema doesn't apply to them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional
+
+from ray_tpu._private.lint.core import (
+    Finding, LintPass, ModuleInfo, register,
+)
+
+_EMIT_RE = re.compile(
+    r"""(?:_record_event\(\s*|_report_event\(\s*|
+        event_type\s*=\s*)["']([A-Z][A-Z_]+)["']""", re.VERBOSE)
+
+
+def _registry_keys(tree: ast.Module) -> Optional[Dict[str, int]]:
+    """{event type: line} from the ``EVENT_TYPES = {...}`` literal."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if not any(isinstance(t, ast.Name) and t.id == "EVENT_TYPES"
+                       for t in targets):
+                continue
+            if isinstance(node.value, ast.Dict):
+                out = {}
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        out[k.value] = k.lineno
+                return out
+    return None
+
+
+@register
+class EventSchemaPass(LintPass):
+    name = "event-schema"
+    rules = ("event-unregistered-emit", "event-dead-type",
+             "event-undocumented-type")
+    description = ("cluster-event emission sites ⊆ registry ⊆ dashboard "
+                   "docs (ex tests/test_failure_forensics TestEventLint)")
+
+    def __init__(self):
+        self._emitted: Dict[str, List[Finding]] = {}
+        self._registry: Optional[Dict[str, int]] = None
+        self._registry_mod: Optional[ModuleInfo] = None
+        self._dashboard_doc: Optional[str] = None
+        self._dashboard_mod: Optional[ModuleInfo] = None
+
+    def check_module(self, mod: ModuleInfo):
+        if mod.relpath.endswith("observability/events.py"):
+            self._registry = _registry_keys(mod.tree)
+            self._registry_mod = mod
+        if mod.relpath.endswith("dashboard/head.py"):
+            self._dashboard_doc = ast.get_docstring(mod.tree) or ""
+            self._dashboard_mod = mod
+        for m in _EMIT_RE.finditer(mod.src):
+            etype = m.group(1)
+            line = mod.src.count("\n", 0, m.start()) + 1
+            self._emitted.setdefault(etype, []).append(mod.finding(
+                "event-unregistered-emit", line,
+                f"emits unregistered cluster event {etype!r}; declare "
+                f"it in ray_tpu/observability/events.py"))
+        return ()
+
+    def finalize(self):
+        if self._registry is None:
+            return  # no schema in this tree — nothing to check against
+        for etype, findings in sorted(self._emitted.items()):
+            if etype not in self._registry:
+                yield findings[0]
+        rmod = self._registry_mod
+        for etype, line in sorted(self._registry.items()):
+            if etype not in self._emitted:
+                yield rmod.finding(
+                    "event-dead-type", line,
+                    f"registered cluster event type {etype!r} has no "
+                    f"emission site — dead schema entries mislead "
+                    f"postmortems")
+            if self._dashboard_doc is not None and \
+                    etype not in self._dashboard_doc:
+                yield rmod.finding(
+                    "event-undocumented-type", line,
+                    f"cluster event type {etype!r} is registered but "
+                    f"missing from the GET /api/events row of the "
+                    f"dashboard endpoint table "
+                    f"({self._dashboard_mod.relpath} module docstring)")

@@ -1,0 +1,235 @@
+"""jit-hygiene: impure ops and recompile hazards inside jitted bodies.
+
+A ``jax.jit``/``tracked_jit`` body executes at *trace* time, not call
+time: ``print``/``time.time``/``np.random`` run once per compile and
+silently freeze their value into the program — correct-looking code
+with wrong semantics, and a classic source of "why does this only log
+once". Mutating attributes or globals from a traced body is the same
+bug in the other direction. Unhashable static args and Python branches
+on traced values are the two recompile amplifiers ``TrackedJit``
+(observability/jit.py) can only *count* after the compile time is
+already burned; this pass rejects them before they ship.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu._private.lint._ast_util import (
+    call_name, dotted, kwarg, literal, walk_scope,
+)
+from ray_tpu._private.lint.core import Finding, LintPass, ModuleInfo, register
+
+# Call roots that are impure at trace time. jax.debug.print /
+# jax.debug.callback are the sanctioned escape hatches and do not match.
+_IMPURE_EXACT = {"print", "input", "breakpoint"}
+_IMPURE_PREFIX = ("time.", "np.random.", "numpy.random.", "random.")
+
+_SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "bytes"}
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """Is this expression a jit transform? Covers ``jax.jit``, ``jit``,
+    ``tracked_jit``, ``pjit``, ``partial(jax.jit, ...)`` and the
+    factory form ``jax.jit(static_argnums=...)`` used as a decorator."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name.rsplit(".", 1)[-1] == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        return name.rsplit(".", 1)[-1] in ("jit", "tracked_jit", "pjit")
+    name = dotted(node)
+    return name.rsplit(".", 1)[-1] in ("jit", "tracked_jit", "pjit")
+
+
+def _static_params(fn: ast.FunctionDef,
+                   jit_call: Optional[ast.Call]) -> Set[str]:
+    """Parameter names marked static on the wrapping jit call."""
+    if jit_call is None:
+        return set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: Set[str] = set()
+    names = literal(kwarg(jit_call, "static_argnames"))
+    if isinstance(names, str):
+        static.add(names)
+    elif isinstance(names, (list, tuple)):
+        static.update(n for n in names if isinstance(n, str))
+    nums = literal(kwarg(jit_call, "static_argnums"))
+    if isinstance(nums, int):
+        nums = (nums,)
+    if isinstance(nums, (list, tuple)):
+        for i in nums:
+            if isinstance(i, int) and 0 <= i < len(params):
+                static.add(params[i])
+    return static
+
+
+@register
+class JitHygienePass(LintPass):
+    name = "jit-hygiene"
+    rules = ("jit-impure-call", "jit-global-mutation",
+             "jit-unhashable-static", "jit-traced-branch")
+    description = ("impure ops, unhashable static args and traced-value "
+                   "branching inside jax.jit/tracked_jit bodies")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        out: List[Finding] = []
+        # Every def in the module, by name (methods included): call-site
+        # wrapping (`self._tick = tracked_jit(self._tick_impl)`) resolves
+        # through this table.
+        defs: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, []).append(node)
+
+        # (fn def, jit call or None) pairs to scan.
+        jitted: List[Tuple[ast.FunctionDef, Optional[ast.Call]]] = []
+        seen: Set[int] = set()
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    if _is_jit_expr(dec):
+                        call = dec if isinstance(dec, ast.Call) else None
+                        if id(node) not in seen:
+                            seen.add(id(node))
+                            jitted.append((node, call))
+            elif isinstance(node, ast.Call) and _is_jit_expr(node.func) \
+                    and node.args:
+                target = node.args[0]
+                tname = None
+                if isinstance(target, ast.Name):
+                    tname = target.id
+                elif isinstance(target, ast.Attribute):
+                    tname = target.attr
+                for fn in defs.get(tname, []):
+                    if id(fn) not in seen:
+                        seen.add(id(fn))
+                        jitted.append((fn, node))
+                # Unhashable static args are checkable even when the
+                # wrapped fn lives elsewhere.
+                if tname not in defs:
+                    out.extend(self._check_static_hashable(
+                        mod, None, node))
+
+        for fn, call in jitted:
+            out.extend(self._scan_body(mod, fn))
+            out.extend(self._check_static_hashable(mod, fn, call))
+            out.extend(self._check_traced_branches(mod, fn, call))
+        return out
+
+    # ------------------------------------------------------------- body
+
+    def _scan_body(self, mod: ModuleInfo,
+                   fn: ast.FunctionDef) -> Iterable[Finding]:
+        # The whole subtree is traced — nested defs included (closures
+        # traced inline), so do NOT skip nested scopes here.
+        for node in walk_scope(fn, skip_nested=False):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _IMPURE_EXACT or \
+                        name.startswith(_IMPURE_PREFIX):
+                    yield mod.finding(
+                        "jit-impure-call", node,
+                        f"call to {name}() inside jitted "
+                        f"{fn.name}(): runs at trace time only — its "
+                        f"value is frozen into the compiled program "
+                        f"(use jax.debug.* or hoist it out)")
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(node, ast.Global) \
+                    else "nonlocal"
+                yield mod.finding(
+                    "jit-global-mutation", node,
+                    f"{kind} statement inside jitted {fn.name}(): "
+                    f"trace-time mutation escapes the compiled program")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        yield mod.finding(
+                            "jit-global-mutation", node,
+                            f"attribute mutation "
+                            f"'{ast.unparse(t)} = ...' inside jitted "
+                            f"{fn.name}(): runs once per trace, not "
+                            f"per call — return the value instead")
+
+    # ----------------------------------------------------- static args
+
+    def _check_static_hashable(self, mod: ModuleInfo,
+                               fn: Optional[ast.FunctionDef],
+                               call: Optional[ast.Call]):
+        if call is None:
+            return
+        # Unhashable literals directly in static_argnums/static_argnames
+        # defaults of the wrapped fn: every call re-hashes the static
+        # args, and an unhashable one raises — while a *mutable but
+        # hashed-by-id* object silently recompiles per instance.
+        if fn is None:
+            return
+        static = _static_params(fn, call)
+        if not static:
+            return
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        defaults = args.defaults
+        first_default = len(pos) - len(defaults)
+        for i, a in enumerate(pos):
+            if a.arg not in static or i < first_default:
+                continue
+            d = defaults[i - first_default]
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                yield mod.finding(
+                    "jit-unhashable-static", d,
+                    f"static arg {a.arg!r} of jitted {fn.name}() "
+                    f"defaults to an unhashable "
+                    f"{type(d).__name__.lower()} literal — jit hashes "
+                    f"static args per call; use a tuple/frozen value")
+
+    # -------------------------------------------------- traced branches
+
+    def _check_traced_branches(self, mod: ModuleInfo, fn: ast.FunctionDef,
+                               call: Optional[ast.Call]):
+        """``if x > 0:`` on a traced parameter is a ConcretizationError
+        at best and a per-value recompile (via forced static arg) at
+        worst. Heuristic kept tight: bare non-static parameters, with
+        scalar-annotated / scalar-defaulted params (static Python
+        config) excluded, compared against literals with an ordering
+        op."""
+        static = _static_params(fn, call)
+        traced: Set[str] = set()
+        pos = fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+        defaults = list(fn.args.defaults) + list(fn.args.kw_defaults)
+        first_default = len(pos) - len(defaults)
+        for i, a in enumerate(pos):
+            if a.arg in static or a.arg in ("self", "cls"):
+                continue
+            ann = dotted(a.annotation) if a.annotation is not None else ""
+            if ann.rsplit(".", 1)[-1] in _SCALAR_ANNOTATIONS:
+                continue
+            d = defaults[i - first_default] if i >= first_default else None
+            if d is not None and isinstance(d, ast.Constant):
+                continue  # scalar-config default => static Python value
+            traced.add(a.arg)
+        if not traced:
+            return
+        for node in walk_scope(fn, skip_nested=False):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            test = node.test
+            if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+                continue
+            if not isinstance(test.ops[0],
+                              (ast.Lt, ast.LtE, ast.Gt, ast.GtE)):
+                continue
+            sides = (test.left, test.comparators[0])
+            names = [s.id for s in sides if isinstance(s, ast.Name)]
+            lits = [s for s in sides if isinstance(s, ast.Constant)]
+            hit = [n for n in names if n in traced]
+            if hit and lits:
+                yield mod.finding(
+                    "jit-traced-branch", node,
+                    f"Python branch on traced argument {hit[0]!r} "
+                    f"inside jitted {fn.name}(): concretizes the "
+                    f"tracer (or forces a per-value recompile) — use "
+                    f"lax.cond/jnp.where, or mark the arg static")

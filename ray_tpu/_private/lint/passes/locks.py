@@ -1,0 +1,208 @@
+"""lock-discipline: the ``with self._lock`` acquisition graph.
+
+Builds a cross-module graph of lock acquisitions (``with``/``async
+with`` on any lock-named context manager) and flags:
+
+- ``lock-cycle``: lock A held while acquiring B somewhere, and B held
+  while acquiring A somewhere else — the classic two-thread deadlock
+  that only fires under production interleavings. Edges also follow
+  one level of ``self.method()`` calls, so a helper that grabs a lock
+  is charged to its holding caller.
+- ``lock-blocking-call``: a blocking call (sleep, sync subprocess,
+  sync socket/RPC) while holding a lock. Everything else queueing on
+  that lock — often the metrics flusher or a heartbeat — stalls for
+  the call's full duration.
+
+Lock identity is ``ClassName.attr`` for ``self.X`` locks (every
+instance of the class shares the ordering discipline) and
+``module:NAME`` for module-level locks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu._private.lint._ast_util import call_name, walk_scope
+from ray_tpu._private.lint.core import Finding, LintPass, ModuleInfo, register
+
+_BLOCKING_EXACT = {
+    "time.sleep", "os.system", "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+}
+_BLOCKING_SUFFIX = (".sendall", ".recv", ".accept", ".call")
+
+
+def _lockish(expr: ast.expr) -> Optional[str]:
+    """Unparse of a lock-looking context expr, else None."""
+    try:
+        text = ast.unparse(expr)
+    except Exception:
+        return None
+    base = text.split("(")[0]
+    if "lock" in base.lower() or "mutex" in base.lower():
+        return base
+    return None
+
+
+@register
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+    rules = ("lock-cycle", "lock-blocking-call")
+    description = ("cycles in the lock-acquisition graph and blocking "
+                   "calls made while holding a lock")
+
+    def __init__(self):
+        # (holder, acquired) -> first observed (mod, line)
+        self._edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    # ------------------------------------------------------------ names
+
+    def _lock_id(self, text: str, cls: str, mod: ModuleInfo) -> str:
+        if text.startswith("self."):
+            owner = cls or mod.relpath
+            return f"{owner}.{text[5:]}"
+        if "." not in text:
+            return f"{mod.relpath}:{text}"
+        return text
+
+    # ------------------------------------------------------------- scan
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        out: List[Finding] = []
+        # method -> locks it acquires directly, per class (one level of
+        # self-call expansion below).
+        method_locks: Dict[Tuple[str, str], Set[str]] = {}
+        # (class, fn, with-node) worklist with held-lock context.
+        ctx: List[Tuple[str, ast.AST]] = []
+
+        def owner_class(path: List[ast.AST]) -> str:
+            for n in reversed(path):
+                if isinstance(n, ast.ClassDef):
+                    return n.name
+            return ""
+
+        def visit(node: ast.AST, path: List[ast.AST]):
+            for child in ast.iter_child_nodes(node):
+                visit(child, path + [node])
+
+        # Collect per-function lock info with an explicit walk that
+        # remembers the enclosing class and function.
+        def walk_fn(fn, cls: str):
+            held_stack: List[Tuple[str, ast.AST]] = []
+
+            def rec(node):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    names = []
+                    for item in node.items:
+                        text = _lockish(item.context_expr)
+                        if text is not None:
+                            names.append(
+                                self._lock_id(text, cls, mod))
+                    for name in names:
+                        for held, _ in held_stack:
+                            if held != name:
+                                self._edges.setdefault(
+                                    (held, name),
+                                    (mod.relpath, node.lineno,
+                                     mod.context_for(node.lineno)))
+                        method_locks.setdefault(
+                            (cls, fn.name), set()).add(name)
+                    for name in names:
+                        held_stack.append((name, node))
+                    for child in node.body:
+                        rec(child)
+                    for _ in names:
+                        held_stack.pop()
+                    return
+                if isinstance(node, ast.Call) and held_stack:
+                    name = call_name(node)
+                    blocking = name in _BLOCKING_EXACT or (
+                        "." in name
+                        and name.endswith(_BLOCKING_SUFFIX)
+                        and not name.endswith(".acall"))
+                    if blocking:
+                        held = held_stack[-1][0]
+                        out.append(mod.finding(
+                            "lock-blocking-call", node,
+                            f"{name}() while holding {held}: every "
+                            f"other thread queueing on the lock "
+                            f"stalls for the call's full duration"))
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    return  # nested scope: runs elsewhere/later
+                # Record self-calls made under a held lock for the
+                # one-level expansion.
+                if isinstance(node, ast.Call) and held_stack:
+                    name = call_name(node)
+                    if name.startswith("self.") and name.count(".") == 1:
+                        for held, _ in held_stack:
+                            calls_under.setdefault(
+                                (cls, name[5:]), set()).add(
+                                (held, mod.relpath, node.lineno))
+                for child in ast.iter_child_nodes(node):
+                    rec(child)
+
+            for child in fn.body:
+                rec(child)
+
+        calls_under: Dict[Tuple[str, str], Set[Tuple[str, str, int]]] = {}
+
+        def scan(node, cls: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    scan(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    walk_fn(child, cls)
+                    scan(child, cls)
+                else:
+                    scan(child, cls)
+
+        scan(mod.tree, "")
+
+        # One-level expansion: caller holds L and calls self.m();
+        # m directly acquires L' -> edge L -> L'.
+        for (cls, meth), sites in calls_under.items():
+            for acquired in method_locks.get((cls, meth), ()):
+                for held, relpath, line in sites:
+                    if held != acquired:
+                        self._edges.setdefault(
+                            (held, acquired),
+                            (relpath, line, ""))
+        return out
+
+    # --------------------------------------------------------- finalize
+
+    def finalize(self) -> Iterable[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self._edges:
+            graph.setdefault(a, set()).add(b)
+        reported: Set[Tuple[str, ...]] = set()
+        for (a, b), (path, line, context) in sorted(self._edges.items()):
+            # Cycle check: can we get from b back to a?
+            stack, seen = [b], set()
+            found = False
+            while stack:
+                n = stack.pop()
+                if n == a:
+                    found = True
+                    break
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(graph.get(n, ()))
+            if not found:
+                continue
+            key = tuple(sorted((a, b)))
+            if key in reported:
+                continue
+            reported.add(key)
+            yield Finding(
+                rule="lock-cycle", path=path, line=line,
+                message=(f"lock-order cycle: {a} is held while "
+                         f"acquiring {b} here, and {b} is (transitively) "
+                         f"held while acquiring {a} elsewhere — two "
+                         f"threads taking opposite orders deadlock"),
+                context=context)

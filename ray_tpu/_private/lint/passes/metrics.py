@@ -1,0 +1,261 @@
+"""metric-declarations: the metric-registry contract, as a graftlint
+pass.
+
+Grown across PRs 2–5 as ``scripts/check_metrics.py`` and migrated here
+verbatim in behavior; the script remains as a thin shim over
+:func:`check_paths`. See that module's history for the rationale of
+each rule:
+
+- names are snake_case and don't pre-carry the ``rtpu_`` prefix;
+- framework metrics belong to a registered family prefix;
+- histograms end in ``_seconds``/``_bytes``;
+- gauges must not declare a ``pid`` tag key;
+- redeclarations agree on type/tag_keys/boundaries (cross-file — the
+  runtime registry only catches collisions that co-execute in one
+  process);
+- hand-rolled Prometheus exposition (``# TYPE`` lines inside string
+  literals) reserves ``_total`` for counters and requires it of them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.lint.core import (
+    Finding, LintPass, ModuleInfo, register, run_lint,
+)
+
+_METRIC_CLASSES = ("Counter", "Gauge", "Histogram")
+_METRICS_MODULE = "ray_tpu.util.metrics"
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# Registered metric families: every metric the framework itself declares
+# must start with one of these (exported as rtpu_<family>...). New
+# subsystems add their prefix here — one reviewable place instead of
+# ad-hoc names scattered over /metrics.
+_FAMILIES = (
+    "data_",          # Dataset pipeline stages (stats.py / executors)
+    "device_",        # accelerator HBM / device-count gauges
+    "jit_",           # tracked_jit compile/trace telemetry
+    "learner_",       # RLlib learner update metrics
+    "node_",          # raylet reporter node gauges
+    "object_store_",  # per-node store pressure (spill/evict/pin)
+    "sched_",         # scheduling-latency phase breakdown (profiling.py)
+    "serve_",         # LLM serving latency/queue metrics
+    "train_",         # train-session report metrics
+    "worker_",        # per-worker process gauges
+)
+
+_EXPOSITION_TYPE_RE = re.compile(
+    r"#\s*TYPE\s+([a-zA-Z_:][a-zA-Z0-9_:]*)\s+"
+    r"(counter|gauge|histogram|summary)\b")
+
+
+def _metric_bindings(tree: ast.Module) -> Dict[str, str]:
+    """local name -> metric class, for names imported from the metrics
+    module (``from ray_tpu.util.metrics import Counter [as C]``)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and \
+                node.module == _METRICS_MODULE:
+            for alias in node.names:
+                if alias.name in _METRIC_CLASSES:
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _module_aliases(tree: ast.Module) -> List[str]:
+    """Names the metrics *module* is bound to (``import
+    ray_tpu.util.metrics [as m]`` / ``from ray_tpu.util import
+    metrics``) — calls like ``m.Counter(...)`` count too."""
+    out: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == _METRICS_MODULE:
+                    out.append(alias.asname or "ray_tpu")
+        elif isinstance(node, ast.ImportFrom) and \
+                node.module == "ray_tpu.util":
+            for alias in node.names:
+                if alias.name == "metrics":
+                    out.append(alias.asname or "metrics")
+    return out
+
+
+def _call_metric_class(call: ast.Call, bindings: Dict[str, str],
+                       mod_aliases: List[str]) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return bindings.get(f.id)
+    if isinstance(f, ast.Attribute) and f.attr in _METRIC_CLASSES:
+        # metrics.Counter(...) / ray_tpu.util.metrics.Counter(...)
+        base = f.value
+        if isinstance(base, ast.Name) and base.id in mod_aliases:
+            return f.attr
+        if (isinstance(base, ast.Attribute)
+                and ast.unparse(base).endswith("util.metrics")):
+            return f.attr
+    return None
+
+
+def _literal(node: Optional[ast.expr]) -> Any:
+    """Literal value or None for dynamic expressions (dynamic names are
+    reported as unlintable rather than guessed at)."""
+    if node is None:
+        return None
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _norm(v: Any) -> Any:
+    return tuple(v) if isinstance(v, (list, tuple)) else v
+
+
+@register
+class MetricsPass(LintPass):
+    name = "metric-declarations"
+    rules = ("metric-unlintable-name", "metric-name", "metric-family",
+             "metric-histogram-suffix", "metric-gauge-pid-tag",
+             "metric-redeclared", "metric-exposition")
+    description = ("metric naming/family/unit/tag contract + cross-file "
+                   "redeclaration consistency + Prometheus exposition "
+                   "suffix discipline (ex scripts/check_metrics.py)")
+
+    def __init__(self):
+        self._decls: List[Dict[str, Any]] = []
+
+    def check_module(self, mod: ModuleInfo):
+        out: List[Finding] = []
+        bindings = _metric_bindings(mod.tree)
+        mod_aliases = _module_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls = _call_metric_class(node, bindings, mod_aliases)
+            if cls is None:
+                continue
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            name_node = node.args[0] if node.args else kw.get("name")
+            name = _literal(name_node)
+            if not isinstance(name, str):
+                out.append(mod.finding(
+                    "metric-unlintable-name", node,
+                    f"{cls} name is not a string literal — cannot lint"))
+                continue
+            self._decls.append({
+                "mod": mod, "line": node.lineno,
+                "where": f"{mod.relpath}:{node.lineno}",
+                "class": cls, "name": name,
+                "tag_keys": _literal(kw.get("tag_keys")),
+                "boundaries": _literal(kw.get("boundaries")),
+            })
+            out.extend(self._check_decl(self._decls[-1]))
+        out.extend(self._check_exposition(mod))
+        return out
+
+    def _check_decl(self, d: Dict[str, Any]):
+        mod, line, name = d["mod"], d["line"], d["name"]
+        if not _NAME_RE.match(name):
+            yield mod.finding(
+                "metric-name", line,
+                f"metric name {name!r} is not snake_case "
+                f"([a-z][a-z0-9_]*) — it would export badly as "
+                f"rtpu_{name}")
+        if name.startswith("rtpu_"):
+            yield mod.finding(
+                "metric-name", line,
+                f"metric name {name!r} already carries the "
+                f"rtpu_ prefix; the exporter adds it (would become "
+                f"rtpu_rtpu_...)")
+        if not name.startswith(_FAMILIES):
+            yield mod.finding(
+                "metric-family", line,
+                f"metric name {name!r} is outside the "
+                f"registered families {sorted(set(_FAMILIES))}; prefix it "
+                f"with its subsystem family (or extend _FAMILIES in "
+                f"ray_tpu/_private/lint/passes/metrics.py)")
+        if d["class"] == "Histogram" and \
+                not name.endswith(("_seconds", "_bytes")):
+            yield mod.finding(
+                "metric-histogram-suffix", line,
+                f"histogram {name!r} must end in _seconds "
+                f"or _bytes — the unit suffix is how dashboards and "
+                f"histogram_quantile() users know what the buckets "
+                f"measure (https://prometheus.io/docs/practices/naming/)")
+        tag_keys = d.get("tag_keys")
+        if d["class"] == "Gauge" and tag_keys and "pid" in tag_keys:
+            yield mod.finding(
+                "metric-gauge-pid-tag", line,
+                f"gauge {name!r} declares tag key 'pid' — "
+                f"the exporter appends its own pid=<source> label to "
+                f"every gauge and duplicate label names break the "
+                f"Prometheus scrape")
+
+    def _check_exposition(self, mod: ModuleInfo):
+        for m in _EXPOSITION_TYPE_RE.finditer(mod.src):
+            name, kind = m.group(1), m.group(2)
+            line = mod.src.count("\n", 0, m.start()) + 1
+            if name.endswith("_total") and kind != "counter":
+                yield mod.finding(
+                    "metric-exposition", line,
+                    f"exposition declares '# TYPE {name} "
+                    f"{kind}' but the _total suffix is reserved for "
+                    f"counters — clients rate() it into garbage")
+            if kind == "counter" and not name.endswith("_total"):
+                yield mod.finding(
+                    "metric-exposition", line,
+                    f"exposition declares counter {name!r} "
+                    f"without the conventional _total suffix")
+
+    def finalize(self):
+        by_name: Dict[str, List[Dict[str, Any]]] = {}
+        for d in self._decls:
+            by_name.setdefault(d["name"], []).append(d)
+        for name, group in sorted(by_name.items()):
+            first = group[0]
+            for other in group[1:]:
+                for field in ("class", "tag_keys", "boundaries"):
+                    a = first.get(field)
+                    b = other.get(field)
+                    if _norm(a) != _norm(b):
+                        yield other["mod"].finding(
+                            "metric-redeclared", other["line"],
+                            f"metric {name!r} redeclared "
+                            f"with different {field} ({_norm(b)!r}) than "
+                            f"{first['where']} ({_norm(a)!r}) — the "
+                            f"runtime registry raises on this collision")
+
+
+# ------------------------------------------------------- script-shim API
+
+def check_exposition_text(src: str, where: str) -> List[str]:
+    """Lint hand-rolled Prometheus exposition blocks in raw source text:
+    the ``_total`` suffix is reserved for counters and required of them
+    (https://prometheus.io/docs/practices/naming/)."""
+    problems: List[str] = []
+    for m in _EXPOSITION_TYPE_RE.finditer(src):
+        name, kind = m.group(1), m.group(2)
+        line = src.count("\n", 0, m.start()) + 1
+        if name.endswith("_total") and kind != "counter":
+            problems.append(
+                f"{where}:{line}: exposition declares '# TYPE {name} "
+                f"{kind}' but the _total suffix is reserved for "
+                f"counters — clients rate() it into garbage")
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"{where}:{line}: exposition declares counter {name!r} "
+                f"without the conventional _total suffix")
+    return problems
+
+
+def check_paths(root: str) -> List[str]:
+    """Historical ``scripts/check_metrics.py`` entry point: lint every
+    .py under ``root`` with the metrics pass only; returns violation
+    strings formatted ``path:line: message``."""
+    result = run_lint([root], rel_to=None, passes=[MetricsPass()])
+    return [f"{f.path}:{f.line}: {f.message}"
+            for f in result.findings + result.baselined]

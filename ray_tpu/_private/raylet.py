@@ -397,17 +397,27 @@ class Raylet:
                                       runtime_env: Optional[Dict[str, Any]],
                                       pool_key: bytes) -> None:
         log_dir = os.path.join(self.session_dir, "logs")
-        os.makedirs(log_dir, exist_ok=True)
         worker_id = WorkerID.from_random()
         out_path = os.path.join(
             log_dir, f"worker-{worker_id.hex()[:12]}.out")
         err_path = os.path.join(
             log_dir, f"worker-{worker_id.hex()[:12]}.err")
-        out = open(out_path, "wb")
-        # Separate stderr stream: tracebacks must reach the driver tagged
-        # as stderr (and survive for exit forensics) instead of being
-        # interleaved into stdout.
-        err = open(err_path, "wb")
+
+        def _open_logs():
+            # Sync file I/O belongs off the loop: on a loaded node (or a
+            # network-backed session dir) mkdir/open stall for ms-class
+            # latencies, and this coroutine shares its loop with lease
+            # dispatch and heartbeats.
+            os.makedirs(log_dir, exist_ok=True)
+            out = open(out_path, "wb")
+            # Separate stderr stream: tracebacks must reach the driver
+            # tagged as stderr (and survive for exit forensics) instead
+            # of being interleaved into stdout.
+            err = open(err_path, "wb")
+            return out, err
+
+        out, err = await asyncio.get_running_loop().run_in_executor(
+            None, _open_logs)
         env = self._worker_env()
         env_uris = []
         python_exe = sys.executable

@@ -111,19 +111,41 @@ class JobSupervisor:
                 if self._stopped:
                     # stop_job() beat us here: never spawn.
                     return -1
-                # Own session/process group: stop() kills the whole tree.
-                proc = subprocess.Popen(
-                    entrypoint, shell=True, stdout=log,
-                    stderr=subprocess.STDOUT, env=child_env,
-                    cwd=working_dir or None, start_new_session=True)
-                self._proc = proc
+            # Spawn OUTSIDE the lock: fork+exec of a shell can take tens
+            # of ms and stop() queues on the same lock — holding it here
+            # stalls every concurrent stop/status call for the spawn's
+            # duration. The stop-before-spawn race stays closed below: a
+            # stop() landing mid-spawn either sees _proc once published,
+            # or we see _stopped and apply its verdict to the fresh
+            # child ourselves.
+            # Own session/process group: stop() kills the whole tree.
+            proc = subprocess.Popen(
+                entrypoint, shell=True, stdout=log,
+                stderr=subprocess.STDOUT, env=child_env,
+                cwd=working_dir or None, start_new_session=True)
             with self._lock:
+                self._proc = proc
                 stopped_now = self._stopped
-            if not stopped_now:
-                put_status(status="RUNNING", log_path=log_path,
-                           start_time=time.time(), pid=os.getpid(),
-                           child_pid=proc.pid)  # same-node stop fallback
-            rc = proc.wait()
+            if stopped_now:
+                # stop() raced the spawn before _proc was visible: kill
+                # the process group it could not see.
+                import signal
+
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+                proc.wait()  # graftlint: disable=deadlock-unbounded-wait
+                return -1
+            put_status(status="RUNNING", log_path=log_path,
+                       start_time=time.time(), pid=os.getpid(),
+                       child_pid=proc.pid)  # same-node stop fallback
+            # Unbounded by design: a job's entrypoint runs for as long
+            # as the user's workload does; stop_job() is the bound.
+            rc = proc.wait()  # graftlint: disable=deadlock-unbounded-wait
         record = json.loads(
             w.gcs.call("kv_get", namespace=_KV_NS,
                        key=submission_id) or b"{}")

@@ -34,6 +34,17 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
 
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+
+    def _inventory() -> str:
+        # "what did JAX actually discover" — the first question every
+        # mesh-shape mismatch report needs answered.
+        platforms = sorted({getattr(d, "platform", "?") for d in devices})
+        listing = ", ".join(str(d) for d in devices[:8])
+        if n > 8:
+            listing += f", ... ({n - 8} more)"
+        return (f"discovered {n} device(s) on platform "
+                f"{'/'.join(platforms) or 'none'}: [{listing}]")
+
     sizes = dict(axes)
     wild = [k for k, v in sizes.items() if v == -1]
     if len(wild) > 1:
@@ -41,12 +52,16 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
     fixed = int(np.prod([v for v in sizes.values() if v != -1]))
     if wild:
         if n % fixed != 0:
-            raise ValueError(f"{n} devices not divisible by {fixed}")
+            raise ValueError(
+                f"cannot infer axis {wild[0]!r}: {n} devices not "
+                f"divisible by the fixed-axis product {fixed} "
+                f"(requested {axes}); {_inventory()}")
         sizes[wild[0]] = n // fixed
     total = int(np.prod(list(sizes.values())))
     if total != n:
         raise ValueError(
-            f"mesh {sizes} needs {total} devices but {n} are available")
+            f"mesh {sizes} needs {total} devices but {n} are available; "
+            f"{_inventory()}")
     names = [a for a in AXIS_ORDER if a in sizes]
     names += [a for a in sizes if a not in names]
     shape = [sizes[a] for a in names]

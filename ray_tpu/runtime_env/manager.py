@@ -215,10 +215,18 @@ class RuntimeEnvManager:
             except Exception:
                 shutil.rmtree(venv_dir, ignore_errors=True)
                 raise
-            with open(marker, "w") as f:
-                f.write(spec)
+
+            def _finish():
+                # Marker write + recursive size walk are sync disk I/O:
+                # keep them in the executor with the venv build, not on
+                # the event loop this setup shares with the raylet.
+                with open(marker, "w") as f:
+                    f.write(spec)
+                return _du(venv_dir)
+
+            size = await loop.run_in_executor(None, _finish)
             self.creations += 1
-            self._sizes[f"pip:{key}"] = _du(venv_dir)
+            self._sizes[f"pip:{key}"] = size
             return venv_dir
 
     def _create_venv(self, venv_dir: str, packages: List[str]) -> None:

@@ -132,9 +132,15 @@ class HTTPEventProvider:
                                req.match_info["key"])
             if not os.path.exists(path):
                 return web.json_response({"delivered": False}, status=404)
-            with open(path) as f:
-                return web.json_response({"delivered": True,
-                                          "payload": json.load(f)})
+
+            def _read():
+                with open(path) as f:
+                    return json.load(f)
+
+            payload = await asyncio.get_running_loop().run_in_executor(
+                None, _read)
+            return web.json_response({"delivered": True,
+                                      "payload": payload})
 
         app = web.Application()
         app.router.add_post("/event/{wf}/{key}", post_event)

@@ -1,255 +1,34 @@
 #!/usr/bin/env python
-"""Static lint of metric declarations (CI gate, also run as a unit test).
+"""Static lint of metric declarations — thin shim over graftlint.
 
-Walks the package AST for every ``Counter(...)`` / ``Gauge(...)`` /
-``Histogram(...)`` call whose binding provably comes from
-``ray_tpu.util.metrics`` (import-provenance filtering keeps e.g.
-``collections.Counter`` out) and enforces the registry contract the
-runtime can only check per-process:
+The metric rules grown here across PRs 2–5 migrated to
+``ray_tpu/_private/lint/passes/metrics.py`` (the ``metric-declarations``
+graftlint pass), so the repo has ONE lint entry point
+(``scripts/graftlint.py``). This script stays so existing invocations
+and tests keep working:
 
-- names are snake_case identifiers that export cleanly with the
-  ``rtpu_`` prefix (``^[a-z][a-z0-9_]*$``, no double prefix);
-- a name declared in two places must agree on metric type, tag_keys
-  and (histograms) boundaries — the runtime raises on such collisions,
-  but only when both declarations happen to run in one process, so the
-  lint catches what tests might never co-execute;
-- framework metrics belong to a registered family prefix (``data_``,
-  ``object_store_``, ``serve_``, ...) so the ``rtpu_*`` exposition
-  stays grouped — a new subsystem extends ``_FAMILIES`` once, in one
-  reviewable place;
-- histogram families must end in ``_seconds`` or ``_bytes``: the unit
-  suffix is the only machine-readable statement of what the buckets
-  measure, and every boundary table in the repo is one of the two;
-- gauges must not declare a ``pid`` tag key: the exporter appends its
-  own ``pid=<source>`` label to every gauge and duplicate label names
-  break the whole Prometheus scrape;
-- hand-rolled Prometheus exposition blocks (``# TYPE name kind`` lines
-  inside string literals, e.g. the GCS ``metrics_text`` builder) obey
-  the naming convention: a ``_total`` suffix is reserved for counters,
-  and counters must carry it — Prometheus clients infer semantics from
-  the suffix, so a gauge named ``*_total`` reads as a counter and gets
-  rate()'d into garbage.
+- ``python scripts/check_metrics.py [root]`` — exits nonzero and prints
+  one line per violation, exactly as before;
+- ``check_paths(root)`` / ``check_exposition_text(src, where)`` — the
+  library entry points used by tests/test_observability.py,
+  tests/test_profiling.py and tests/test_failure_forensics.py.
 
-Usage: ``python scripts/check_metrics.py [root]`` — exits nonzero and
-prints one line per violation. ``check_paths()`` is the library entry
-point used by tests/test_observability.py.
+New rules belong in the graftlint pass, not here.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import Any, Dict, List, Optional, Tuple
+from typing import List, Optional
 
-_METRIC_CLASSES = ("Counter", "Gauge", "Histogram")
-_METRICS_MODULE = "ray_tpu.util.metrics"
-_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Registered metric families: every metric the framework itself declares
-# must start with one of these (exported as rtpu_<family>...). New
-# subsystems add their prefix here — one reviewable place instead of
-# ad-hoc names scattered over /metrics.
-_FAMILIES = (
-    "data_",          # Dataset pipeline stages (stats.py / executors)
-    "device_",        # accelerator HBM / device-count gauges
-    "jit_",           # tracked_jit compile/trace telemetry
-    "learner_",       # RLlib learner update metrics
-    "node_",          # raylet reporter node gauges
-    "object_store_",  # per-node store pressure (spill/evict/pin)
-    "sched_",         # scheduling-latency phase breakdown (profiling.py)
-    "serve_",         # LLM serving latency/queue metrics
-    "train_",         # train-session report metrics
-    "worker_",        # per-worker process gauges
+from ray_tpu._private.lint.passes.metrics import (  # noqa: E402,F401
+    check_exposition_text,
+    check_paths,
 )
-
-
-def _metric_bindings(tree: ast.Module) -> Dict[str, str]:
-    """local name -> metric class, for names imported from the metrics
-    module (``from ray_tpu.util.metrics import Counter [as C]``)."""
-    out: Dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and \
-                node.module == _METRICS_MODULE:
-            for alias in node.names:
-                if alias.name in _METRIC_CLASSES:
-                    out[alias.asname or alias.name] = alias.name
-    return out
-
-
-def _module_aliases(tree: ast.Module) -> List[str]:
-    """Names the metrics *module* is bound to (``import
-    ray_tpu.util.metrics [as m]`` / ``from ray_tpu.util import
-    metrics``) — calls like ``m.Counter(...)`` count too."""
-    out: List[str] = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name == _METRICS_MODULE:
-                    out.append(alias.asname or "ray_tpu")
-        elif isinstance(node, ast.ImportFrom) and \
-                node.module == "ray_tpu.util":
-            for alias in node.names:
-                if alias.name == "metrics":
-                    out.append(alias.asname or "metrics")
-    return out
-
-
-def _call_metric_class(call: ast.Call, bindings: Dict[str, str],
-                       mod_aliases: List[str]) -> Optional[str]:
-    f = call.func
-    if isinstance(f, ast.Name):
-        return bindings.get(f.id)
-    if isinstance(f, ast.Attribute) and f.attr in _METRIC_CLASSES:
-        # metrics.Counter(...) / ray_tpu.util.metrics.Counter(...)
-        base = f.value
-        if isinstance(base, ast.Name) and base.id in mod_aliases:
-            return f.attr
-        if (isinstance(base, ast.Attribute)
-                and ast.unparse(base).endswith("util.metrics")):
-            return f.attr
-    return None
-
-
-def _literal(node: Optional[ast.expr]) -> Any:
-    """Literal value or None for dynamic expressions (dynamic names are
-    reported as unlintable rather than guessed at)."""
-    if node is None:
-        return None
-    try:
-        return ast.literal_eval(node)
-    except (ValueError, SyntaxError):
-        return None
-
-
-def _collect_file(path: str) -> Tuple[List[Dict[str, Any]], List[str]]:
-    with open(path, "r", encoding="utf-8") as f:
-        src = f.read()
-    tree = ast.parse(src, filename=path)
-    bindings = _metric_bindings(tree)
-    mod_aliases = _module_aliases(tree)
-    decls: List[Dict[str, Any]] = []
-    problems: List[str] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        cls = _call_metric_class(node, bindings, mod_aliases)
-        if cls is None:
-            continue
-        where = f"{path}:{node.lineno}"
-        kw = {k.arg: k.value for k in node.keywords if k.arg}
-        name_node = node.args[0] if node.args else kw.get("name")
-        name = _literal(name_node)
-        if not isinstance(name, str):
-            problems.append(f"{where}: {cls} name is not a string "
-                            f"literal — cannot lint")
-            continue
-        decls.append({
-            "where": where, "class": cls, "name": name,
-            "tag_keys": _literal(kw.get("tag_keys")),
-            "boundaries": _literal(kw.get("boundaries")),
-        })
-    return decls, problems
-
-
-# ``# TYPE <name> <kind>`` lines as they appear inside f-string/str
-# literals that hand-roll Prometheus exposition text (gcs_server's
-# metrics_text builder). Scanned over raw file text: the lines live
-# inside string literals, so the AST walk above never sees them.
-_EXPOSITION_TYPE_RE = re.compile(
-    r"#\s*TYPE\s+([a-zA-Z_:][a-zA-Z0-9_:]*)\s+"
-    r"(counter|gauge|histogram|summary)\b")
-
-
-def check_exposition_text(src: str, where: str) -> List[str]:
-    """Lint hand-rolled Prometheus exposition blocks in raw source text:
-    the ``_total`` suffix is reserved for counters and required of them
-    (https://prometheus.io/docs/practices/naming/)."""
-    problems: List[str] = []
-    for m in _EXPOSITION_TYPE_RE.finditer(src):
-        name, kind = m.group(1), m.group(2)
-        line = src.count("\n", 0, m.start()) + 1
-        if name.endswith("_total") and kind != "counter":
-            problems.append(
-                f"{where}:{line}: exposition declares '# TYPE {name} "
-                f"{kind}' but the _total suffix is reserved for "
-                f"counters — clients rate() it into garbage")
-        if kind == "counter" and not name.endswith("_total"):
-            problems.append(
-                f"{where}:{line}: exposition declares counter {name!r} "
-                f"without the conventional _total suffix")
-    return problems
-
-
-def check_paths(root: str) -> List[str]:
-    """Lint every .py under ``root``; returns violation strings."""
-    decls: List[Dict[str, Any]] = []
-    problems: List[str] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                path = os.path.join(dirpath, fn)
-                d, p = _collect_file(path)
-                decls.extend(d)
-                problems.extend(p)
-                with open(path, "r", encoding="utf-8") as f:
-                    problems.extend(check_exposition_text(f.read(), path))
-
-    for d in decls:
-        name = d["name"]
-        if not _NAME_RE.match(name):
-            problems.append(
-                f"{d['where']}: metric name {name!r} is not snake_case "
-                f"([a-z][a-z0-9_]*) — it would export badly as "
-                f"rtpu_{name}")
-        if name.startswith("rtpu_"):
-            problems.append(
-                f"{d['where']}: metric name {name!r} already carries the "
-                f"rtpu_ prefix; the exporter adds it (would become "
-                f"rtpu_rtpu_...)")
-        if not name.startswith(_FAMILIES):
-            problems.append(
-                f"{d['where']}: metric name {name!r} is outside the "
-                f"registered families {sorted(set(_FAMILIES))}; prefix it "
-                f"with its subsystem family (or extend _FAMILIES in "
-                f"scripts/check_metrics.py)")
-        if d["class"] == "Histogram" and \
-                not name.endswith(("_seconds", "_bytes")):
-            problems.append(
-                f"{d['where']}: histogram {name!r} must end in _seconds "
-                f"or _bytes — the unit suffix is how dashboards and "
-                f"histogram_quantile() users know what the buckets "
-                f"measure (https://prometheus.io/docs/practices/naming/)")
-        tag_keys = d.get("tag_keys")
-        if d["class"] == "Gauge" and tag_keys and "pid" in tag_keys:
-            problems.append(
-                f"{d['where']}: gauge {name!r} declares tag key 'pid' — "
-                f"the exporter appends its own pid=<source> label to "
-                f"every gauge and duplicate label names break the "
-                f"Prometheus scrape")
-
-    by_name: Dict[str, List[Dict[str, Any]]] = {}
-    for d in decls:
-        by_name.setdefault(d["name"], []).append(d)
-    for name, group in sorted(by_name.items()):
-        first = group[0]
-        for other in group[1:]:
-            for field in ("class", "tag_keys", "boundaries"):
-                a = first.get(field)
-                b = other.get(field)
-                if _norm(a) != _norm(b):
-                    problems.append(
-                        f"{other['where']}: metric {name!r} redeclared "
-                        f"with different {field} ({_norm(b)!r}) than "
-                        f"{first['where']} ({_norm(a)!r}) — the runtime "
-                        f"registry raises on this collision")
-    return problems
-
-
-def _norm(v: Any) -> Any:
-    return tuple(v) if isinstance(v, (list, tuple)) else v
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""graftlint entry point — the repo's single static-analysis gate.
+
+Thin launcher for :mod:`ray_tpu._private.lint` (equivalent to
+``python -m ray_tpu._private.lint``); also wired into tier-1 as a unit
+test (tests/test_graftlint.py::test_repo_is_clean). Usage:
+
+    python scripts/graftlint.py                  # lint ray_tpu/, gate
+    python scripts/graftlint.py --list-passes
+    python scripts/graftlint.py --baseline-update  # re-grandfather
+    python scripts/graftlint.py --select jit-hygiene path/to/file.py
+
+See README "Static analysis" for suppression comments and how to add
+a pass.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

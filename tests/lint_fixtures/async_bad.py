@@ -1,0 +1,21 @@
+"""async-blocking violations: blocking work directly on the event loop."""
+
+import subprocess
+import time
+
+
+class Handler:
+    async def handle(self, req):
+        time.sleep(0.5)                       # async-blocking-call
+        with open("/tmp/state.json") as f:    # async-blocking-call
+            data = f.read()
+        return data
+
+    async def shell(self):
+        subprocess.run(["ls"])                # async-blocking-call
+
+    async def rpc(self, client):
+        return client.call("get_all_nodes")   # async-blocking-call (sync RPC)
+
+    async def wait_forever(self, ev):
+        ev.wait()                             # async-unawaited-wait

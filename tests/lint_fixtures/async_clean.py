@@ -1,0 +1,29 @@
+"""async-blocking clean twin: the same work, off the loop."""
+
+import asyncio
+
+
+class Handler:
+    async def handle(self, req):
+        await asyncio.sleep(0.5)
+
+        def _read():
+            # Blocking I/O lives in the executor payload — the fix the
+            # pass must never punish.
+            with open("/tmp/state.json") as f:
+                return f.read()
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, _read)
+
+    async def shell(self):
+        proc = await asyncio.create_subprocess_exec("ls")
+        await proc.wait()                      # awaited: fine
+
+    async def rpc(self, client):
+        return await client.acall("get_all_nodes")
+
+    async def wait_bounded(self, ev):
+        # ev.wait() here builds the awaitable consumed by wait_for — it
+        # does not block the loop.
+        await asyncio.wait_for(ev.wait(), timeout=5)

@@ -1,0 +1,24 @@
+"""collective-consistency violations."""
+
+import jax
+from jax import lax
+
+
+def grad_sync(grads):
+    # collective-unknown-axis: "dat" is a typo for the repo-wide "data"
+    # axis and nothing in this module declares it.
+    return lax.psum(grads, "dat")
+
+
+def gather(x):
+    return lax.all_gather(x, axis_name="model_par")   # collective-unknown-axis
+
+
+def divergent(x, use_mean):
+    # collective-divergent-branches: replicas disagreeing on use_mean
+    # enter different collective schedules and the mesh hangs.
+    if use_mean:
+        y = lax.pmean(x, "data")
+    else:
+        y = lax.psum(x, "data")
+    return y

@@ -1,0 +1,31 @@
+"""collective-consistency clean twin."""
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+
+def grad_sync(grads):
+    return lax.psum(grads, "data")    # repo-wide axis: always declared
+
+
+def gather(x, mesh_devices):
+    # Locally declared axis: Mesh(...) binds "model_par" for this module.
+    mesh = Mesh(mesh_devices, axis_names=("model_par",))
+    with mesh:
+        return lax.all_gather(x, axis_name="model_par")
+
+
+def static_fallback(x, n):
+    # One-sided branch is the sanctioned static-fallback shape.
+    if n == 1:
+        return x
+    return lax.psum(x, "data")
+
+
+def same_both_arms(x, flag):
+    if flag:
+        y = lax.psum(x, "data") * 2
+    else:
+        y = lax.psum(x, "data")
+    return y

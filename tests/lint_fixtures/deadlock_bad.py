@@ -1,0 +1,26 @@
+"""distributed-deadlock violations inside @remote bodies."""
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Aggregator:
+    def rollup(self):
+        # deadlock-self-get: waits on a method of THIS actor, which can
+        # only run after rollup() returns.
+        return ray_tpu.get(self.partial.remote())
+
+    def rollup_via_ref(self):
+        ref = self.partial.remote()
+        return ray_tpu.get(ref)        # deadlock-self-get (ref-through-local)
+
+    def partial(self):
+        return 1
+
+    def wedge(self, ev):
+        ev.wait()                      # deadlock-unbounded-wait
+
+
+@ray_tpu.remote(num_cpus=1)
+def join_forever(worker_thread):
+    worker_thread.join()               # deadlock-unbounded-wait

@@ -1,0 +1,21 @@
+"""distributed-deadlock clean twin."""
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Aggregator:
+    def rollup(self, other):
+        # Getting ANOTHER actor's result is the normal pattern.
+        return ray_tpu.get(other.partial.remote(), timeout=30)
+
+    def partial(self):
+        return 1
+
+    def wait_bounded(self, ev):
+        ev.wait(timeout=10)            # bounded: fine
+
+
+@ray_tpu.remote(num_cpus=1)
+def join_bounded(worker_thread):
+    worker_thread.join(timeout=10)

@@ -1,0 +1,8 @@
+"""Fixture dashboard head.
+
+GET /api/events rows:
+
+    WORKER_CRASH — a worker process exited abnormally
+
+(The second registered type is deliberately missing from this table.)
+"""

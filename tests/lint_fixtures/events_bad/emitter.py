@@ -1,0 +1,6 @@
+"""Fixture emitter: one registered emit, one unregistered emit."""
+
+
+def report(sink, detail):
+    sink._record_event("WORKER_CRASH", detail=detail)
+    sink._record_event("TOTALLY_UNREGISTERED", detail=detail)

@@ -1,0 +1,6 @@
+"""Fixture registry: GHOST_REBOOT is registered but never emitted."""
+
+EVENT_TYPES = {
+    "WORKER_CRASH": "a worker process exited abnormally",
+    "GHOST_REBOOT": "registered, never emitted, undocumented",
+}

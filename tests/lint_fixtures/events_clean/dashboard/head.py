@@ -1,0 +1,6 @@
+"""Fixture dashboard head.
+
+GET /api/events rows:
+
+    WORKER_CRASH — a worker process exited abnormally
+"""

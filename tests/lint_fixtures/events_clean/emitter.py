@@ -1,0 +1,5 @@
+"""Fixture emitter: emits only registered types."""
+
+
+def report(sink, detail):
+    sink._record_event("WORKER_CRASH", detail=detail)

@@ -1,0 +1,5 @@
+"""Fixture registry: every type is emitted and documented."""
+
+EVENT_TYPES = {
+    "WORKER_CRASH": "a worker process exited abnormally",
+}

@@ -1,0 +1,45 @@
+"""jit-hygiene violations: every shape the pass must flag."""
+
+import time
+
+import jax
+import numpy as np
+
+from ray_tpu.observability.jit import tracked_jit
+
+
+@jax.jit
+def impure_step(x):
+    print("tracing", x)          # jit-impure-call
+    noise = np.random.normal()   # jit-impure-call
+    t0 = time.time()             # jit-impure-call
+    return x + noise + t0
+
+
+class Model:
+    @jax.jit
+    def update(self, x):
+        self.calls = self.calls + 1   # jit-global-mutation
+        return x
+
+
+_COUNT = 0
+
+
+@tracked_jit
+def global_step(x):
+    global _COUNT                # jit-global-mutation
+    _COUNT += 1
+    return x
+
+
+@jax.jit(static_argnames="cfg")
+def unhashable_static(x, cfg=[1, 2, 3]):   # jit-unhashable-static
+    return x * len(cfg)
+
+
+@jax.jit
+def traced_branch(x):
+    if x > 0:                    # jit-traced-branch
+        return x
+    return -x

@@ -1,0 +1,38 @@
+"""jit-hygiene clean twin: the same jobs done the sanctioned way."""
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.observability.jit import tracked_jit
+
+
+@jax.jit
+def pure_step(x, noise):
+    # Randomness and timestamps enter as arguments, not trace-time calls.
+    jax.debug.print("step {x}", x=x)   # sanctioned escape hatch
+    return x + noise
+
+
+@tracked_jit
+def accumulate(state, x):
+    # Mutation becomes a returned value.
+    return state + 1, x * 2
+
+
+@jax.jit(static_argnames="cfg")
+def hashable_static(x, cfg=(1, 2, 3)):   # tuple: hashable
+    return x * len(cfg)
+
+
+@jax.jit
+def branchless(x):
+    return jnp.where(x > 0, x, -x)   # lax-level select, no Python branch
+
+
+@jax.jit
+def python_config_branch(x, threshold: float = 0.5):
+    # Scalar-annotated/defaulted param == static Python config; a branch
+    # on it is fine.
+    if threshold > 0:
+        return x * threshold
+    return x

@@ -1,0 +1,31 @@
+"""lock-discipline violations: an A->B / B->A cycle and blocking under
+a held lock."""
+
+import subprocess
+import threading
+import time
+
+_STATE_LOCK = threading.Lock()
+_FLUSH_LOCK = threading.Lock()
+
+
+def writer():
+    with _STATE_LOCK:
+        with _FLUSH_LOCK:          # edge: STATE -> FLUSH
+            pass
+
+
+def flusher():
+    with _FLUSH_LOCK:
+        with _STATE_LOCK:          # edge: FLUSH -> STATE  => lock-cycle
+            pass
+
+
+class Reporter:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def report(self):
+        with self._lock:
+            time.sleep(1.0)                  # lock-blocking-call
+            subprocess.run(["uptime"])       # lock-blocking-call

@@ -1,0 +1,32 @@
+"""lock-discipline clean twin: one global order, work outside the lock."""
+
+import subprocess
+import threading
+import time
+
+_STATE_LOCK = threading.Lock()
+_FLUSH_LOCK = threading.Lock()
+
+
+def writer():
+    with _STATE_LOCK:
+        with _FLUSH_LOCK:          # every path takes STATE before FLUSH
+            pass
+
+
+def flusher():
+    with _STATE_LOCK:
+        with _FLUSH_LOCK:
+            pass
+
+
+class Reporter:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def report(self):
+        with self._lock:
+            snapshot = dict(x=1)   # copy under the lock ...
+        time.sleep(1.0)            # ... block outside it
+        subprocess.run(["uptime"])
+        return snapshot

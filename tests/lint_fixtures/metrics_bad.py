@@ -1,0 +1,21 @@
+"""metric-declarations violations."""
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+BAD_CASE = Counter("ServeRequests")                 # metric-name + family
+PREFIXED = Counter("rtpu_serve_requests")           # metric-name (rtpu_ prefix)
+ORPHAN = Counter("frobnicator_calls")               # metric-family
+NO_UNIT = Histogram("serve_latency",                # metric-histogram-suffix
+                    boundaries=[0.1, 1.0, 10.0])
+PID_GAUGE = Gauge("worker_rss_bytes",               # metric-gauge-pid-tag
+                  tag_keys=("pid", "node"))
+
+FIRST = Counter("serve_handled", tag_keys=("route",))
+SECOND = Counter("serve_handled", tag_keys=("route", "code"))  # redeclared
+
+EXPOSITION = """
+# TYPE serve_queue_total gauge
+serve_queue_total 3
+# TYPE serve_handled counter
+serve_handled 9
+"""

@@ -1,0 +1,18 @@
+"""metric-declarations clean twin."""
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+REQUESTS = Counter("serve_requests")
+LATENCY = Histogram("serve_latency_seconds",
+                    boundaries=[0.1, 1.0, 10.0])
+RSS = Gauge("worker_rss_bytes", tag_keys=("node",))
+
+FIRST = Counter("serve_handled", tag_keys=("route",))
+SECOND = Counter("serve_handled", tag_keys=("route",))   # identical: fine
+
+EXPOSITION = """
+# TYPE serve_queue gauge
+serve_queue 3
+# TYPE serve_handled_total counter
+serve_handled_total 9
+"""

@@ -1,0 +1,19 @@
+"""Suppression-grammar fixture.
+
+Three time.sleep-on-the-loop violations: one suppressed by rule id, one
+by pass name, one left live so the test can prove suppression is
+per-line, not per-file.
+"""
+
+import time
+
+
+class Handler:
+    async def by_rule(self):
+        time.sleep(1)  # graftlint: disable=async-blocking-call
+
+    async def by_pass_name(self):
+        time.sleep(1)  # graftlint: disable=async-blocking
+
+    async def live(self):
+        time.sleep(1)   # NOT suppressed

@@ -122,3 +122,24 @@ class TestShardedTraining:
         mesh = make_mesh({"tensor": 8})
         with pytest.raises(ValueError, match="n_kv_heads"):
             llama_param_shardings(LlamaConfig.tiny(n_kv_heads=2), mesh)
+
+
+class TestMakeMeshErrors:
+    """Mesh-shape mismatches must say what JAX actually discovered."""
+
+    def test_mismatch_lists_devices_and_platform(self):
+        with pytest.raises(ValueError) as e:
+            make_mesh({"data": 3, "tensor": 5})   # 15 != 8
+        msg = str(e.value)
+        assert "needs 15 devices but 8 are available" in msg
+        assert "discovered 8 device(s)" in msg
+        assert "platform cpu" in msg
+        assert "TFRT_CPU_0" in msg   # the actual device listing
+
+    def test_indivisible_wildcard_names_the_axis(self):
+        with pytest.raises(ValueError) as e:
+            make_mesh({"data": -1, "tensor": 3})  # 8 % 3 != 0
+        msg = str(e.value)
+        assert "cannot infer axis 'data'" in msg
+        assert "not divisible by the fixed-axis product 3" in msg
+        assert "discovered 8 device(s)" in msg
