@@ -428,6 +428,181 @@ def _bench_sched_phase_overhead() -> dict:
     }
 
 
+def _bench_llama_serve_autoscale() -> dict:
+    """Closed-loop serve autoscaling under a stepped Poisson load: a
+    `num_replicas="auto"` deployment rides 1 -> N replicas through the
+    burst and back down to 1 when it drains, with zero failed requests.
+
+    The reported value is the post-scale-up p99 latency over the
+    steady-state p99 (the acceptance bar is <= 2.0 once the extra
+    replicas absorb the backlog); `detail` carries the replica path and
+    the observability trail every scale action must leave — AUTOSCALE_UP
+    / AUTOSCALE_DOWN cluster events, serve_autoscaler entries in the GCS
+    decision ring, and the rtpu_ctrl_decisions_total counter."""
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util import state
+
+    ray_tpu.init(num_cpus=8, num_tpus=0,
+                 object_store_memory=128 * 1024 * 1024)
+    try:
+        @serve.deployment(
+            num_replicas="auto", num_cpus=0.1, max_ongoing_requests=2,
+            autoscaling_config={
+                "min_replicas": 1, "max_replicas": 3,
+                "target_ongoing_requests": 2,
+                "upscale_delay_s": 1.0, "downscale_delay_s": 3.0})
+        class Step:
+            def __call__(self, x):
+                time.sleep(0.25)
+                return x
+
+        handle = serve.run(Step.bind(), name="autoscale_bench")
+        assert handle.remote(0).result(timeout=60) == 0
+
+        def replica_count() -> int:
+            for d in serve.status("autoscale_bench"):
+                if d["name"] == "Step":
+                    return d["live_replicas"]
+            return 0
+
+        # Replica-path watcher: when did the second replica go live?
+        path = {"max": replica_count(), "scale_up_t": None}
+        t_zero = time.monotonic()
+        stop_watch = threading.Event()
+
+        def watch():
+            while not stop_watch.is_set():
+                n = replica_count()
+                if n > path["max"]:
+                    path["max"] = n
+                if n >= 2 and path["scale_up_t"] is None:
+                    path["scale_up_t"] = time.monotonic() - t_zero
+                stop_watch.wait(0.25)
+
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+
+        lock = threading.Lock()
+        samples = []  # (submit_t_rel, latency_s, ok)
+        threads = []
+
+        def fire(i: int, t_rel: float):
+            t0 = time.monotonic()
+            ok = True
+            try:
+                handle.remote(i).result(timeout=120)
+            except Exception:
+                ok = False
+            with lock:
+                samples.append((t_rel, time.monotonic() - t0, ok))
+
+        rng = np.random.RandomState(11)
+
+        def run_phase(rate: float, duration: float) -> None:
+            arrivals = np.cumsum(
+                rng.exponential(1.0 / rate, int(rate * duration * 3)))
+            arrivals = arrivals[arrivals < duration]
+            start = time.monotonic()
+            for a in arrivals:
+                dt = float(a) - (time.monotonic() - start)
+                if dt > 0:
+                    time.sleep(dt)
+                t = threading.Thread(
+                    target=fire,
+                    args=(len(threads), (time.monotonic() - t_zero)))
+                t.start()
+                threads.append(t)
+
+        # Stepped load: steady (inside one replica's capacity), burst
+        # (beyond it — the policy must add replicas), then silence (it
+        # must take them away again).
+        steady_rate, steady_s = 2.0, 8.0
+        burst_rate, burst_s = 14.0, 12.0
+        run_phase(steady_rate, steady_s)
+        burst_started = time.monotonic() - t_zero
+        run_phase(burst_rate, burst_s)
+        for t in threads:
+            t.join(180)
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and replica_count() > 1:
+            time.sleep(0.5)
+        final_replicas = replica_count()
+        stop_watch.set()
+        watcher.join(5)
+
+        with lock:
+            rows = list(samples)
+        failed = sum(1 for _, _, ok in rows if not ok)
+        steady = [lat for t, lat, ok in rows if ok and t < burst_started]
+        up_t = path["scale_up_t"]
+        # "Post-scale-up": submitted once the new replicas have had 2s
+        # to absorb the backlog the scale decision was reacting to.
+        post = [lat for t, lat, ok in rows
+                if ok and up_t is not None and t >= up_t + 2.0]
+        steady_p99 = float(np.percentile(steady, 99)) if steady else None
+        post_p99 = float(np.percentile(post, 99)) if post else None
+        ratio = (post_p99 / steady_p99
+                 if steady_p99 and post_p99 else None)
+
+        # The observability trail: every scale action is a typed event,
+        # a decision-ring entry, and a counter increment (the counter
+        # rides the controller's metrics flush — poll past one interval).
+        ups = state.list_cluster_events(event_type="AUTOSCALE_UP")
+        downs = state.list_cluster_events(event_type="AUTOSCALE_DOWN")
+        decisions = global_worker().gcs.call(
+            "list_ctrl_decisions", controller="serve_autoscaler")
+        counter_seen = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not counter_seen:
+            text = global_worker().gcs.call("metrics_text")
+            counter_seen = 'controller="serve_autoscaler"' in text
+            if not counter_seen:
+                time.sleep(1.0)
+
+        serve.delete("autoscale_bench")
+        passed = (path["max"] >= 2 and final_replicas == 1
+                  and failed == 0 and ratio is not None and ratio <= 2.0
+                  and ups and downs and decisions and counter_seen)
+        return {
+            "metric": "llama_serve_autoscale",
+            "value": round(ratio, 3) if ratio is not None else None,
+            "unit": "p99_ratio",
+            "vs_baseline": None,
+            "detail": {
+                "passed": bool(passed),
+                "max_replicas_seen": path["max"],
+                "final_replicas": final_replicas,
+                "scale_up_after_s": round(up_t, 2) if up_t else None,
+                "requests": len(rows), "failed_requests": failed,
+                "steady_p99_ms": round(steady_p99 * 1000, 1)
+                if steady_p99 else None,
+                "post_scale_up_p99_ms": round(post_p99 * 1000, 1)
+                if post_p99 else None,
+                "autoscale_up_events": len(ups),
+                "autoscale_down_events": len(downs),
+                "ctrl_decisions": len(decisions),
+                "decision_counter_exported": counter_seen,
+                "load": {"steady_req_s": steady_rate,
+                         "steady_s": steady_s,
+                         "burst_req_s": burst_rate, "burst_s": burst_s},
+                "note": "num_replicas='auto' deployment under stepped "
+                        "Poisson load on a local cluster; value is "
+                        "post-scale-up p99 latency / steady-state p99 "
+                        "(bar: <= 2.0), with the decision trail "
+                        "(events, ring, counter) verified",
+            },
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
 def main() -> None:
     import sys
 
@@ -539,6 +714,15 @@ def main() -> None:
     except Exception as e:
         print(json.dumps({"metric": "sched_phase_overhead_ms",
                           "value": None, "unit": "ms",
+                          "vs_baseline": None, "error": repr(e)[:300]}))
+
+    # Closed-loop serve autoscaling under a stepped Poisson load (the
+    # metrics-driven control plane end to end, on a local cluster).
+    try:
+        print(json.dumps(_bench_llama_serve_autoscale()))
+    except Exception as e:
+        print(json.dumps({"metric": "llama_serve_autoscale",
+                          "value": None, "unit": "p99_ratio",
                           "vs_baseline": None, "error": repr(e)[:300]}))
 
     vs_baseline = (mfu / REFERENCE_MFU) if mfu is not None else None

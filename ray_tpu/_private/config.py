@@ -129,6 +129,40 @@ _define("memory_monitor_refresh_ms", int, 250,
 _define("memory_monitor_test_usage_path", str, "",
         "Test hook: read the usage fraction from this file instead of "
         "psutil/cgroup.")
+_define("memory_preempt_threshold", float, 0.85,
+        "Node memory fraction above which the raylet preemptively "
+        "retires the largest leased task worker (PREEMPT_RESCHEDULE; "
+        "the task retries via the normal lease-return path) before the "
+        "kill threshold is reached. Must sit below "
+        "memory_usage_threshold; 0 disables preemption.")
+_define("memory_preempt_cooldown_s", float, 5.0,
+        "Minimum spacing between memory preemptions on one node — one "
+        "retirement must get a chance to free memory before the next "
+        "verdict.")
+
+# --- metrics-driven control plane ---
+_define("ctrl_metrics_staleness_s", float, 10.0,
+        "A controller reading whose newest source push is older than "
+        "this holds (no action) instead of acting — 'the gauge is low' "
+        "and 'the gauge stopped updating' must never be conflated.")
+_define("ctrl_decisions_buffer_size", int, 2_000,
+        "Ring buffer capacity of the GCS control-decision log "
+        "(GET /api/controller).")
+_define("serve_autoscale_interval_s", float, 2.0,
+        "Period of the serve controller's autoscale policy loop (each "
+        "tick refreshes the MetricsHub and re-evaluates desired "
+        "replicas; jittered ±20% to avoid thundering herds).")
+_define("serve_autoscale_cooldown_s", float, 5.0,
+        "Minimum spacing between scale actions on one deployment, on "
+        "top of the up/downscale hold delays.")
+_define("data_backpressure_interval_s", float, 1.0,
+        "Minimum spacing between backpressure re-evaluations per "
+        "executor (the tuner is pulled from the launch loop; this "
+        "bounds its decision rate).")
+_define("data_backpressure_max_scale", float, 4.0,
+        "Upper bound on the backpressure tuner's multiplier over an "
+        "executor's base inflight/queued limits (lower bound is the "
+        "reciprocal).")
 
 # --- logging / events ---
 _define("event_stats", bool, True,

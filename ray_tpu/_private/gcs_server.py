@@ -137,6 +137,14 @@ class GcsServer:
         # here so cluster totals never go backwards when a worker exits.
         self._metric_tombstones: Dict[str, Dict[str, Any]] = {}
 
+        # Control-plane decision ring: every autoscale / backpressure /
+        # preemption action with the metric reading that triggered it,
+        # so "why did it scale?" is answerable from the dashboard
+        # (GET /api/controller) without scraping logs.
+        self.ctrl_decisions: deque = deque(
+            maxlen=GlobalConfig.ctrl_decisions_buffer_size)
+        self._ctrl_decision_seq = 0
+
         self._reschedule_on_start: List[bytes] = []
         self._register_handlers()
         # Actor/PG lifecycle transitions all publish; piggyback snapshot
@@ -277,6 +285,7 @@ class GcsServer:
             "user_metrics_summary",
             "report_cluster_event", "list_cluster_events",
             "summary_cluster_events",
+            "report_ctrl_decision", "list_ctrl_decisions",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
 
@@ -336,6 +345,33 @@ class GcsServer:
         return {"total_recorded": self._event_seq,
                 "in_buffer": len(self.cluster_events),
                 "by_type": {t: dict(v) for t, v in by_type.items()}}
+
+    # ------------------------------------------------- control-plane decisions
+    async def _h_report_ctrl_decision(self, controller: str, action: str,
+                                      reason: str = "", reading=None,
+                                      node_id=None):
+        """One control-plane decision (autoscale, backpressure adjust,
+        memory preemption) with the metric reading that triggered it."""
+        self._ctrl_decision_seq += 1
+        self.ctrl_decisions.append({
+            "seq": self._ctrl_decision_seq, "ts": time.time(),
+            "controller": str(controller), "action": str(action),
+            "reason": str(reason), "reading": dict(reading or {}),
+            "node_id": node_id,
+        })
+        return True
+
+    async def _h_list_ctrl_decisions(self, controller=None, action=None,
+                                     limit=100):
+        """Newest-last slice of the decision ring, optionally filtered."""
+        out = []
+        for d in self.ctrl_decisions:
+            if controller is not None and d["controller"] != controller:
+                continue
+            if action is not None and d["action"] != action:
+                continue
+            out.append(d)
+        return out[-max(int(limit), 0):]
 
     # --------------------------------------------------------------- metrics
     async def _h_metrics_text(self) -> str:
@@ -417,14 +453,21 @@ class GcsServer:
     async def _h_user_metrics_summary(self, prefixes=None):
         """Aggregated user metrics as plain dicts (dashboard /api/serve).
         ``prefixes``: optional list of metric-name prefixes to keep."""
-        metas, counters, gauges, hists = self._aggregate_user_metrics()
+        metas, counters, gauges, hists, fresh = \
+            self._aggregate_user_metrics()
+        now = time.time()
         out: Dict[str, Any] = {}
         for name, meta in metas.items():
             if prefixes and not any(name.startswith(p) for p in prefixes):
                 continue
             typ = meta["type"]
             entry: Dict[str, Any] = {
-                "type": typ, "description": meta.get("description", "")}
+                "type": typ, "description": meta.get("description", ""),
+                # Age of the freshest live push carrying this metric —
+                # the MetricsHub staleness signal. None means only
+                # tombstones of exited sources remain.
+                "age_s": (max(0.0, now - fresh[name])
+                          if name in fresh else None)}
             if typ == "counter":
                 entry["data"] = dict(counters[name])
             elif typ == "gauge":
@@ -509,13 +552,17 @@ class GcsServer:
             lambda: defaultdict(float))
         gauges: Dict[str, Dict[str, float]] = defaultdict(dict)
         hists: Dict[str, Dict[str, List[float]]] = defaultdict(dict)
+        # name -> newest push ts among live sources carrying it.
+        fresh: Dict[str, float] = {}
         sources = list(self.user_metrics.items())
         if self._metric_tombstones:
             sources.append(
                 ("(exited)", (0.0, list(self._metric_tombstones.values()))))
-        for source, (_, records) in sources:
+        for source, (push_ts, records) in sources:
             for rec in records:
                 name, typ = rec["name"], rec["type"]
+                if push_ts:  # tombstone pseudo-source pushes at ts 0.0
+                    fresh[name] = max(fresh.get(name, 0.0), push_ts)
                 meta = metas.setdefault(name, rec)
                 if meta.get("type") != typ or (
                         typ == "histogram"
@@ -542,11 +589,11 @@ class GcsServer:
                         else:
                             for i, v in enumerate(cell):
                                 acc[i] += v
-        return metas, counters, gauges, hists
+        return metas, counters, gauges, hists, fresh
 
     def _render_user_metrics(self) -> List[str]:
         """User metrics as Prometheus exposition lines."""
-        metas, counters, gauges, hists = self._aggregate_user_metrics()
+        metas, counters, gauges, hists, _ = self._aggregate_user_metrics()
         out: List[str] = []
         for name, meta in metas.items():
             typ = meta["type"]

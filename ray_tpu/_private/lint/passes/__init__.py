@@ -3,6 +3,7 @@
 from ray_tpu._private.lint.passes import (  # noqa: F401
     async_blocking,
     collectives,
+    control_loop,
     deadlock,
     events,
     jit_hygiene,

@@ -1,0 +1,136 @@
+"""control-loop: hygiene for metrics-driven control loops.
+
+PR 7's control plane (serve autoscaler, data backpressure tuner, memory
+preemption) is a set of periodic policy loops. The failure modes are
+quiet and fleet-wide: a loop with no sleep pegs a core; a constant
+period synchronizes every process in the cluster into thundering-herd
+metric fetches; a policy coroutine called without ``await`` (or without
+handing it to a task spawner) silently never runs, and the cluster just
+stops adapting.
+
+Scope: only functions whose NAME says they are control-plane code
+(``policy`` / ``autoscal`` / ``backpressure`` / ``preempt`` / ``ctrl``
+/ ``control``). General-purpose loops (heartbeats, reapers, reconcile)
+have their own conventions and stay out of scope.
+
+Three rules:
+
+- ``ctrl-busy-spin``: an unbounded ``while`` loop in a control function
+  with no sleep/wait anywhere in its test or body.
+- ``ctrl-unjittered-period``: the loop's sleep/wait period is a bare
+  numeric literal — every process wakes on the same beat; multiply by a
+  jitter term (e.g. ``random.uniform(0.8, 1.2)``).
+- ``ctrl-unawaited-policy``: a call to a module-local ``async def``
+  control function that is neither awaited nor consumed by another call
+  (``spawn_task(...)`` / ``create_task(...)``) — the coroutine object
+  is dropped and the policy never executes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from ray_tpu._private.lint._ast_util import (
+    awaited_calls, call_name, consumed_calls, walk_scope,
+)
+from ray_tpu._private.lint.core import Finding, LintPass, ModuleInfo, register
+
+_CTRL_NAME = re.compile(
+    r"policy|autoscal|backpressure|preempt|ctrl|control")
+
+# Callable suffixes that bound a loop iteration in time. ``.wait`` covers
+# both threading.Event.wait(timeout) (the sync-loop idiom) and
+# asyncio waits; ``.get``/``.join`` cover queue-driven loops.
+_SLEEPISH_EXACT = ("time.sleep", "asyncio.sleep")
+_SLEEPISH_SUFFIX = (".sleep", ".wait", ".wait_for", ".get", ".join",
+                    ".select", ".poll")
+
+
+def _is_sleepish(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return name in _SLEEPISH_EXACT or name.endswith(_SLEEPISH_SUFFIX)
+
+
+def _is_unbounded(loop: ast.While) -> bool:
+    """while True / while not <flag>: the shapes daemon loops take."""
+    test = loop.test
+    if isinstance(test, ast.Constant) and test.value is True:
+        return True
+    return isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not)
+
+
+def _constant_period(call: ast.Call) -> bool:
+    """First positional arg (or timeout= kwarg) is a bare number —
+    a fixed, fleet-synchronized period."""
+    args = list(call.args)
+    for kw in call.keywords:
+        if kw.arg in ("timeout", "delay"):
+            args.append(kw.value)
+    return bool(args) and isinstance(args[0], ast.Constant) \
+        and isinstance(args[0].value, (int, float))
+
+
+@register
+class ControlLoopPass(LintPass):
+    name = "control-loop"
+    rules = ("ctrl-busy-spin", "ctrl-unjittered-period",
+             "ctrl-unawaited-policy")
+    description = ("control-plane loop hygiene: bounded jittered "
+                   "periods, no dropped policy coroutines")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        out: List[Finding] = []
+        awaited = awaited_calls(mod.tree)
+        consumed = consumed_calls(mod.tree)
+        async_ctrl = {
+            n.name for n in ast.walk(mod.tree)
+            if isinstance(n, ast.AsyncFunctionDef)
+            and _CTRL_NAME.search(n.name)
+        }
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            in_ctrl = bool(_CTRL_NAME.search(fn.name))
+            for sub in walk_scope(fn, skip_nested=True):
+                if in_ctrl and isinstance(sub, ast.While) \
+                        and _is_unbounded(sub):
+                    out.extend(self._check_loop(mod, fn, sub))
+                # Dropped policy coroutine: module-local async control
+                # fn called bare — not awaited, not fed to a spawner.
+                if isinstance(sub, ast.Call) and id(sub) not in awaited \
+                        and id(sub) not in consumed:
+                    name = call_name(sub)
+                    leaf = (name or "").rsplit(".", 1)[-1]
+                    if leaf in async_ctrl:
+                        out.append(mod.finding(
+                            "ctrl-unawaited-policy", sub,
+                            f"{name}() builds a coroutine and drops it "
+                            f"— the policy never runs; 'await' it or "
+                            f"hand it to spawn_task()/create_task()"))
+        return out
+
+    def _check_loop(self, mod: ModuleInfo, fn, loop: ast.While
+                    ) -> Iterable[Finding]:
+        sleeps: List[ast.Call] = []
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call) and \
+                    _is_sleepish(call_name(node)):
+                sleeps.append(node)
+        if not sleeps:
+            yield mod.finding(
+                "ctrl-busy-spin", loop,
+                f"unbounded control loop in '{fn.name}' with no sleep/"
+                f"wait — pegs a core and hammers the metrics plane; "
+                f"bound the period")
+            return
+        for call in sleeps:
+            if _constant_period(call):
+                yield mod.finding(
+                    "ctrl-unjittered-period", call,
+                    f"constant period in control loop '{fn.name}' "
+                    f"synchronizes every process onto the same beat — "
+                    f"multiply by a jitter term (random.uniform)")

@@ -139,6 +139,13 @@ class Raylet:
         # worker_id -> True for workers the memory monitor shot; owners ask
         # via get_worker_exit_info to turn the crash into OutOfMemoryError.
         self._oom_killed: Set[bytes] = set()
+        # Workers preemptively rescheduled by the memory monitor BELOW
+        # the kill threshold: classified PREEMPT_RESCHEDULE (retriable —
+        # the owner's normal crash-retry path reruns the task), never
+        # OOM_KILLED, so the user sees a reschedule, not an error.
+        self._preempts = 0
+        self._preempted: Set[bytes] = set()
+        self._last_preempt_ts = 0.0
         # Workers whose death THIS raylet caused on purpose (pool cap,
         # idle TTL, lease return, kill_worker, graceful worker_exiting):
         # the reaper classifies them INTENDED_EXIT instead of reading the
@@ -691,7 +698,8 @@ class Raylet:
 
         exit_type = _events.classify_worker_exit(
             code, oom_killed=worker_id in self._oom_killed,
-            intended=worker_id in self._intended_exit)
+            intended=worker_id in self._intended_exit,
+            preempted=worker_id in self._preempted)
         self._intended_exit.discard(worker_id)
         # Marks for workers retired outside the reaper's view (popped
         # from self.workers before the kill) are never consumed; bound
@@ -703,6 +711,7 @@ class Raylet:
             "exit_type": exit_type,
             "exit_code": code,
             "oom_killed": exit_type == "OOM_KILLED",
+            "preempted": exit_type == "PREEMPT_RESCHEDULE",
             "pid": handle.proc.pid,
             "node_id": self.node_id.hex(),
             "last_lines": tail_file(handle.out_path, k)
@@ -844,7 +853,17 @@ class Raylet:
         while not self._dead:
             await asyncio.sleep(period)
             usage = memory_monitor.usage_fraction(test_path)
-            if usage is None or usage <= threshold:
+            if usage is None:
+                continue
+            if usage <= threshold:
+                # Below the kill threshold but above the preempt
+                # threshold: reschedule the largest leased task worker
+                # NOW, while there is still headroom, instead of waiting
+                # to shoot it with OOM_KILLED semantics.
+                preempt_thr = GlobalConfig.memory_preempt_threshold
+                if preempt_thr and preempt_thr < usage and \
+                        self._preempt_for_memory(usage, preempt_thr):
+                    await asyncio.sleep(max(period, 1.0))
                 continue
             victim = await self._pick_oom_victim()
             if victim is None:
@@ -864,6 +883,92 @@ class Raylet:
             # Let the reaper pick up the death before re-sampling, so one
             # spike doesn't massacre the whole pool.
             await asyncio.sleep(max(period, 1.0))
+
+    def _pick_preempt_victim(self):
+        """Largest-RSS leased TASK worker. Preemption exists to avoid
+        OOM kills, and tasks reschedule for free via the owner's crash
+        retry; actors lose state, so they are never preempted — the
+        hard kill path still considers them as a last resort."""
+        leased = [h for h in self.workers.values()
+                  if h.lease is not None and not h.is_actor]
+        if not leased:
+            return None
+        rss: Dict[bytes, float] = {}
+        try:
+            import psutil
+
+            for h in leased:
+                try:
+                    rss[h.worker_id] = float(
+                        psutil.Process(h.proc.pid).memory_info().rss)
+                except Exception:
+                    pass
+        except Exception:
+            pass
+        if rss:
+            return max(leased, key=lambda h: rss.get(h.worker_id, -1.0))
+        return leased[-1]  # no RSS signal: newest lease loses least work
+
+    def _preempt_for_memory(self, usage: float, threshold: float) -> bool:
+        """PREEMPT_RESCHEDULE: retire the victim so its lease returns
+        through the normal death path (reaper -> _release_lease) and the
+        owner's retry loop reruns the task elsewhere. Returns True when
+        a victim was actually preempted. Rate-limited by
+        memory_preempt_cooldown_s; if usage keeps climbing past the kill
+        threshold anyway, the next monitor tick falls back to the
+        OOM-kill branch."""
+        now = time.monotonic()
+        if now - self._last_preempt_ts < \
+                GlobalConfig.memory_preempt_cooldown_s:
+            return False
+        victim = self._pick_preempt_victim()
+        if victim is None:
+            return False
+        self._last_preempt_ts = now
+        self._preempts += 1
+        self._preempted.add(victim.worker_id)
+        if len(self._preempted) > 1024:
+            self._preempted.pop()
+        sys.stderr.write(
+            f"[raylet {self.node_id.hex()[:8]}] memory usage "
+            f"{usage:.2f} > preempt threshold {threshold:.2f}: "
+            f"rescheduling worker pid={victim.proc.pid}\n")
+        try:
+            from ray_tpu.observability.control import record_decision
+
+            # No global worker in a raylet: record_decision increments
+            # the local counter (shipped with the next reporter-loop
+            # metrics push) and we forward the decision record ourselves.
+            payload = record_decision(
+                "memory_preempt", "preempt_reschedule",
+                "memory usage above preempt threshold",
+                {"usage": round(usage, 3), "threshold": threshold,
+                 "pid": victim.proc.pid,
+                 "worker_id": victim.worker_id.hex()[:12]},
+                node_id=self.node_id.hex(), emit=False)
+
+            async def _send():
+                try:
+                    await self.gcs.acall("report_ctrl_decision",
+                                         timeout=10, **payload)
+                except Exception:
+                    pass
+
+            spawn_task(_send())
+        except Exception:
+            pass
+        self._report_event(
+            "PREEMPT_RESCHEDULE",
+            f"memory usage {usage:.2f} above preempt threshold "
+            f"{threshold:.2f}: rescheduling worker "
+            f"{victim.worker_id.hex()[:12]} (pid {victim.proc.pid})",
+            usage=round(usage, 3), threshold=threshold,
+            pid=victim.proc.pid, worker_id=victim.worker_id.hex())
+        try:
+            self._retire_proc(victim.proc)
+        except Exception:
+            pass
+        return True
 
     async def _reporter_loop(self):
         """Per-node resource reporter (reference: `dashboard/modules/
@@ -1623,6 +1728,7 @@ class Raylet:
             "store": self.store.stats(),
             "event_stats": self.server.stats.snapshot(),
             "oom_kills": self._oom_kills,
+            "memory_preempts": self._preempts,
         }
 
     async def _h_get_worker_exit_info(self, worker_id):
@@ -1633,6 +1739,8 @@ class Raylet:
         info = dict(self._exit_info.get(worker_id) or {})
         info["oom_killed"] = (info.get("oom_killed", False)
                               or worker_id in self._oom_killed)
+        info["preempted"] = (info.get("preempted", False)
+                             or worker_id in self._preempted)
         return info
 
     async def _h_get_log(self, worker_id=None, task_id=None, tail=100):

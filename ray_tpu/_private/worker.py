@@ -1321,6 +1321,11 @@ class Worker:
             return
 
         reexported = False
+        # Memory-monitor preemptions get their own small retry budget:
+        # the raylet rescheduled the task on purpose (PREEMPT_RESCHEDULE),
+        # so even a max_retries=0 task reruns instead of failing for an
+        # infra decision it didn't cause.
+        preempt_retries = 0
         while True:
             if spec.task_id.binary() in self._cancelled_tasks:
                 self._fail_task(spec, serialize_error(
@@ -1355,8 +1360,16 @@ class Worker:
                                             "worker crashed")
                     await asyncio.sleep(min(0.05 * (2 ** attempt), 2.0))
                     continue
-                err_cls, detail = await self._describe_worker_death(
+                err_cls, detail, info = await self._describe_worker_death(
                     outcome)
+                if info.get("preempted") and preempt_retries < 3:
+                    preempt_retries += 1
+                    self._report_task_retry(
+                        spec, attempt, "worker preempted by the memory "
+                        "monitor (PREEMPT_RESCHEDULE)")
+                    await asyncio.sleep(
+                        min(0.05 * (2 ** preempt_retries), 2.0))
+                    continue
                 self._fail_task(spec, serialize_error(err_cls(
                     f"worker died while executing task {spec.name} "
                     f"(after {attempt} retries){detail}")))
@@ -1414,7 +1427,9 @@ class Worker:
         classification + last log lines from the lessor raylet, recent
         same-node cluster events from the GCS. The lessor being
         unreachable while the GCS says its node is DEAD classifies as
-        NODE_DEATH. Returns (exception_class, message_suffix)."""
+        NODE_DEATH. Returns (exception_class, message_suffix, info) —
+        the retry loop reads info["preempted"] to rerun memory-monitor
+        preemptions instead of failing them."""
         from ray_tpu.observability import events as _events
 
         err_cls = exc.WorkerCrashedError
@@ -1449,6 +1464,10 @@ class Worker:
             err_cls = exc.OutOfMemoryError
             detail = " (OOM-killed by the node memory monitor)"
             info.setdefault("exit_type", "OOM_KILLED")
+        elif info.get("preempted"):
+            detail = (" (preemptively rescheduled by the node memory "
+                      "monitor)")
+            info.setdefault("exit_type", "PREEMPT_RESCHEDULE")
         elif info.get("exit_type") == "NODE_DEATH":
             detail = " (the node hosting the worker died)"
         recent = None
@@ -1459,7 +1478,8 @@ class Worker:
                     timeout=5)
             except Exception:
                 recent = None
-        return err_cls, detail + _events.format_exit_detail(info, recent)
+        return (err_cls, detail + _events.format_exit_detail(info, recent),
+                info)
 
     def _should_retry_app_error(self, spec: TaskSpec, payload: bytes,
                                 attempt: int) -> bool:

@@ -90,6 +90,13 @@ _PANELS: List[Dict[str, str]] = [
      "expr": "rtpu_data_inflight_tasks",
      "expr_b": "rtpu_data_queued_blocks",
      "legend": "{{stage}}", "unit": "short"},
+    # --- metrics-driven control plane ---
+    {"title": "Serve replicas (autoscaler)",
+     "expr": "rtpu_serve_replicas",
+     "legend": "{{deployment}}", "unit": "short"},
+    {"title": "Control decisions rate",
+     "expr": "rate(rtpu_ctrl_decisions_total[5m])",
+     "legend": "{{controller}}/{{action}}", "unit": "short"},
 ]
 
 

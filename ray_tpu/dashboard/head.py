@@ -25,7 +25,13 @@ Endpoints:
                       filters. Registered event types: WORKER_EXIT,
                       ACTOR_DEATH, ACTOR_RESTART, NODE_ADDED,
                       NODE_REMOVED, LEASE_RECLAIMED, TASK_RETRY,
-                      SPILL_PRESSURE, JOB_STARTED, JOB_FINISHED.
+                      SPILL_PRESSURE, JOB_STARTED, JOB_FINISHED,
+                      AUTOSCALE_UP, AUTOSCALE_DOWN, PREEMPT_RESCHEDULE,
+                      BACKPRESSURE_ADJUST.
+  GET /api/controller control-plane decision log (serve autoscaler,
+                      data backpressure, memory preemption) with
+                      ?controller=, ?action=, ?limit= filters; each row
+                      carries the metric reading that triggered it
   GET /api/logs       per-task/actor/worker log retrieval: exactly one
                       of ?task_id=, ?actor_id=, ?worker_id= (hex), plus
                       ?tail=N (default 100)
@@ -219,6 +225,21 @@ class DashboardHead:
             event_type=req.query.get("type"),
             severity=req.query.get("severity"),
             node_id=req.query.get("node"),
+            limit=limit, timeout=10)
+        return web.json_response(rows or [])
+
+    async def controller(self, req) -> web.Response:
+        """Why did the control plane act? The GCS decision ring, newest
+        last — every autoscale/backpressure/preempt action with the
+        triggering metric reading attached."""
+        try:
+            limit = int(req.query.get("limit", 100))
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        rows = await self._gcs.acall(
+            "list_ctrl_decisions",
+            controller=req.query.get("controller"),
+            action=req.query.get("action"),
             limit=limit, timeout=10)
         return web.json_response(rows or [])
 
@@ -450,6 +471,7 @@ class DashboardHead:
         app.router.add_get("/api/memory", self.memory)
         app.router.add_get("/api/data", self.data_stats)
         app.router.add_get("/api/events", self.events)
+        app.router.add_get("/api/controller", self.controller)
         app.router.add_get("/api/logs", self.logs)
         app.router.add_get("/api/profile", self.profile)
         app.router.add_get("/api/profile/stacks", self.profile)

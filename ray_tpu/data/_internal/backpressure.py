@@ -1,0 +1,139 @@
+"""Metrics-driven backpressure tuning for the Dataset executors.
+
+The executors publish their scheduler state as gauges
+(``rtpu_data_inflight_tasks{stage}`` / ``rtpu_data_queued_blocks
+{stage}``, set by the launch loops); this module closes the loop by
+reading those same gauges back — through the MetricsHub, with the
+zero-RPC :func:`~ray_tpu.util.metrics.local_summary` fetch, since the
+gauges live in the executor's own process — and scaling the static
+inflight/queued limits:
+
+- deep queued output (consumer behind) -> step the producing stage's
+  limits DOWN, so blocks stop piling into the object store;
+- in-flight pinned at the cap with an empty output queue (pipeline
+  starving) -> step the limits UP, bounded by
+  ``data_backpressure_max_scale``;
+- neither -> decay back toward the configured base.
+
+Steps are discrete (×1.5 per level) and pass the shared
+:class:`~ray_tpu.observability.control.Hysteresis` gate, so one noisy
+sample never moves a limit and oscillating load cannot flap it. Every
+granted adjustment is a recorded control decision
+(``rtpu_ctrl_decisions_total{controller="data_backpressure"}`` + a
+``BACKPRESSURE_ADJUST`` cluster event carrying the gauge readings).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ray_tpu.observability.control import Hysteresis, record_decision
+
+_STEP = 1.5
+
+
+class BackpressureTuner:
+    """Per-stage limit multipliers driven by the backpressure gauges.
+
+    Pull-based: the executors call :meth:`cap` / :meth:`limit` from
+    their launch loops (cheap — dict lookups) and
+    :meth:`maybe_evaluate` once per loop iteration, which re-reads the
+    gauges at most every ``data_backpressure_interval_s`` seconds.
+    """
+
+    def __init__(self, hub=None, interval_s: Optional[float] = None,
+                 max_scale: Optional[float] = None,
+                 queue_limit: int = 16):
+        from ray_tpu._private.config import GlobalConfig
+
+        if interval_s is None:
+            interval_s = GlobalConfig.data_backpressure_interval_s
+        if max_scale is None:
+            max_scale = GlobalConfig.data_backpressure_max_scale
+        self.interval_s = float(interval_s)
+        self.enabled = self.interval_s > 0
+        self.queue_limit = queue_limit
+        self.max_level = 0
+        while _STEP ** (self.max_level + 1) <= max(max_scale, 1.0):
+            self.max_level += 1
+        if hub is None and self.enabled:
+            from ray_tpu.util.metrics import MetricsHub, local_summary
+
+            hub = MetricsHub(fetch=local_summary,
+                             min_refresh_s=self.interval_s / 2)
+        self.hub = hub
+        self._levels: Dict[str, int] = {}
+        self._gates: Dict[str, Hysteresis] = {}
+        self._cap_bases: Dict[str, int] = {}
+        self._last_eval = 0.0
+
+    def _scaled(self, stage: str, base: int) -> int:
+        lvl = self._levels.get(stage, 0)
+        return max(1, int(round(base * (_STEP ** lvl))))
+
+    def cap(self, stage: str, base: int) -> int:
+        """Tuned in-flight task cap for ``stage`` (records ``base`` so
+        evaluation knows what "pinned at the cap" means)."""
+        if not self.enabled:
+            return base
+        self._cap_bases[stage] = base
+        return self._scaled(stage, base)
+
+    def limit(self, stage: str, base: int) -> int:
+        """Tuned queued-output limit for ``stage`` (same level as the
+        cap: a throttled stage runs fewer tasks AND buffers less)."""
+        if not self.enabled:
+            return base
+        return self._scaled(stage, base)
+
+    def maybe_evaluate(self, now: Optional[float] = None) -> None:
+        if not self.enabled or self.hub is None:
+            return
+        now = time.time() if now is None else now
+        if now - self._last_eval < self.interval_s:
+            return
+        self._last_eval = now
+        self.hub.refresh(prefixes=["data_"])
+        for stage, base in list(self._cap_bases.items()):
+            inflight_s = self.hub.query("data_inflight_tasks",
+                                        labels={"stage": stage})
+            queued_s = self.hub.query("data_queued_blocks",
+                                      labels={"stage": stage})
+            if not inflight_s and not queued_s:
+                continue  # gauges not wired for this stage yet
+            if (inflight_s and inflight_s.stale()) or \
+                    (queued_s and queued_s.stale()):
+                continue  # hold: a frozen gauge is not a low gauge
+            inflight = int(inflight_s.latest or 0)
+            queued = int(queued_s.latest or 0)
+            lvl = self._levels.get(stage, 0)
+            cap = self._scaled(stage, base)
+            desired = lvl
+            if queued >= max(2, self._scaled(stage, self.queue_limit) // 2):
+                desired = max(lvl - 1, -self.max_level)
+            elif inflight >= cap and queued <= 1:
+                desired = min(lvl + 1, self.max_level)
+            elif lvl != 0 and queued <= 1 and inflight < max(1, cap // 2):
+                desired = lvl + (1 if lvl < 0 else -1)
+            gate = self._gates.setdefault(stage, Hysteresis(
+                self.interval_s, self.interval_s, self.interval_s))
+            granted = gate.propose(lvl, desired, now)
+            if granted == lvl:
+                continue
+            self._levels[stage] = granted
+            new_cap = self._scaled(stage, base)
+            reading = {"stage": stage, "inflight": inflight,
+                       "queued": queued, "cap_from": cap,
+                       "cap_to": new_cap, "level": granted}
+            try:
+                record_decision(
+                    "data_backpressure",
+                    "raise_limits" if granted > lvl else "lower_limits",
+                    "queued-block depth vs in-flight cap", reading,
+                    event_type="BACKPRESSURE_ADJUST",
+                    message=f"stage {stage}: inflight cap {cap} -> "
+                            f"{new_cap} (inflight={inflight}, "
+                            f"queued={queued})")
+            except Exception:
+                pass

@@ -50,10 +50,16 @@ class BackpressurePolicy:
 
 class ConcurrencyCapPolicy(BackpressurePolicy):
     """Cap each op's in-flight tasks at its resource budget (reference:
-    ConcurrencyCapBackpressurePolicy)."""
+    ConcurrencyCapBackpressurePolicy). The budget is the *base*: the
+    executor's BackpressureTuner scales it up or down from the live
+    ``rtpu_data_inflight_tasks`` / ``rtpu_data_queued_blocks`` gauges."""
 
     def can_launch(self, op, execr):
-        return len(op.pending) < op.budget_slots
+        cap = op.budget_slots
+        tuner = getattr(execr, "tuner", None)
+        if tuner is not None:
+            cap = tuner.cap(op.name, cap)
+        return len(op.pending) < cap
 
 
 class OutputBufferPolicy(BackpressurePolicy):
@@ -75,7 +81,11 @@ class OutputBufferPolicy(BackpressurePolicy):
         nxt = execr.op_after(op)
         if nxt is None:
             return True
-        return len(nxt.inputs) + len(op.pending) < self.max_queued
+        limit = self.max_queued
+        tuner = getattr(execr, "tuner", None)
+        if tuner is not None:
+            limit = tuner.limit(op.name, limit)
+        return len(nxt.inputs) + len(op.pending) < limit
 
 
 DEFAULT_POLICIES = (ConcurrencyCapPolicy(), OutputBufferPolicy())
@@ -202,7 +212,7 @@ class ConcurrentExecutor:
     """
 
     def __init__(self, source: _OpState, map_states: List[_OpState],
-                 policies=DEFAULT_POLICIES, stats=None):
+                 policies=DEFAULT_POLICIES, stats=None, tuner=None):
         self.ops: List[_OpState] = [source] + list(map_states)
         self.policies = list(policies)
         self.outputs: Dict[int, Any] = {}  # seq -> final ref
@@ -211,6 +221,13 @@ class ConcurrentExecutor:
         # Submission counts / backpressure samples land here; the owning
         # StreamingExecutor finalizes (spans + counter export).
         self.stats = stats
+        if tuner is None:
+            from ray_tpu.data._internal.backpressure import (
+                BackpressureTuner,
+            )
+
+            tuner = BackpressureTuner()
+        self.tuner = tuner
 
     def op_after(self, op: _OpState) -> Optional[_OpState]:
         i = self.ops.index(op)
@@ -260,6 +277,8 @@ class ConcurrentExecutor:
                     op.close()
 
     def _launch_all(self) -> None:
+        if self.tuner is not None:
+            self.tuner.maybe_evaluate()
         for op in self.ops:
             launched = 0
             while op.inputs and all(p.can_launch(op, self)

@@ -84,6 +84,12 @@ class StreamingExecutor:
         # coordinator's aggregate) when the stream closes.
         self.stats = DatasetStats()
         self._stats_parent = stats_parent
+        # Closes the loop on the backpressure gauges: in-flight windows
+        # below start from the static config but get scaled by the tuner
+        # reading rtpu_data_* back through the MetricsHub.
+        from ray_tpu.data._internal.backpressure import BackpressureTuner
+
+        self._tuner = BackpressureTuner()
 
     # ------------------------------------------------------------- public
     def stream_blocks(self) -> Iterator[Any]:
@@ -348,7 +354,9 @@ class StreamingExecutor:
             rr = 0
             per_actor_window = 2
             for block in source:
-                while len(pending) >= size * per_actor_window:
+                self._tuner.maybe_evaluate()
+                while len(pending) >= self._tuner.cap(
+                        name, size * per_actor_window):
                     yield ray_tpu.get(pending.popleft(), timeout=600)
                     _set_inflight(name, len(pending))
                 pending.append(pool[rr % size].apply.remote(block))
@@ -392,6 +400,12 @@ class StreamingExecutor:
                 else:
                     budget = self._in_flight
                 window = min(max(2, budget), 4 * self._in_flight)
+                # Gauge-driven scaling on top of the byte budget: the
+                # tuner widens the window when reads are pinned at the
+                # cap with nothing queued, narrows it when the consumer
+                # falls behind.
+                self._tuner.maybe_evaluate()
+                window = self._tuner.cap(name or "source", window)
                 while not exhausted and len(pending) < window:
                     try:
                         t = next(it)

@@ -39,6 +39,12 @@ shared instrumentation layer every hot path reports through:
   actor death/restart, node membership, lease reclaim, OOM) recorded
   in the GCS ClusterEventLog and queried via
   ``ray_tpu.util.state.list_cluster_events`` / ``GET /api/events``.
+- ``control``: the decision side of the loop — the
+  ``rtpu_ctrl_decisions_total{controller,action}`` counter, the
+  :func:`record_decision` fan-out (counter + timeline span + typed
+  cluster event + GCS decision ring / ``GET /api/controller``), and
+  the :class:`Hysteresis` hold-delay/cooldown gate shared by the serve
+  autoscaler and the data backpressure tuner.
 
 Everything exports through the existing plane: metric objects are
 ``ray_tpu.util.metrics`` Counters/Gauges/Histograms (flushed to the GCS
@@ -54,6 +60,11 @@ from ray_tpu.observability.jit import (  # noqa: F401
 )
 from ray_tpu.observability.device import (  # noqa: F401
     sample_device_metrics,
+)
+from ray_tpu.observability.control import (  # noqa: F401
+    Hysteresis,
+    control_metrics,
+    record_decision,
 )
 from ray_tpu.observability.data import data_metrics  # noqa: F401
 from ray_tpu.observability.events import (  # noqa: F401
@@ -93,6 +104,7 @@ __all__ = [
     "data_metrics", "object_store_metrics", "register_store_sampler",
     "EVENT_TYPES", "SEVERITIES", "WORKER_EXIT_TYPES",
     "classify_worker_exit", "make_event",
+    "Hysteresis", "control_metrics", "record_decision",
     "SCHED_PHASES", "SCHED_SEGMENT_LABELS", "StackSampler",
     "capture_thread_stacks", "collapse", "format_thread_stacks",
     "merge_counts", "observe_sched_phases", "render_speedscope",

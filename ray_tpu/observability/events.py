@@ -39,6 +39,19 @@ EVENT_TYPES: Dict[str, str] = {
     "SPILL_PRESSURE": "An object store spilled under memory pressure.",
     "JOB_STARTED": "A driver registered a job.",
     "JOB_FINISHED": "A job was marked finished.",
+    # Control-plane decisions (the metrics-driven controllers): each
+    # carries the triggering metric reading in its extra fields so the
+    # event log alone answers "why did it scale / preempt / throttle".
+    "AUTOSCALE_UP": "The serve autoscaler added replicas to a "
+                    "deployment.",
+    "AUTOSCALE_DOWN": "The serve autoscaler removed replicas from a "
+                      "deployment.",
+    "PREEMPT_RESCHEDULE": "The memory monitor preemptively retired the "
+                          "largest leased worker before the OOM-kill "
+                          "threshold; its task reschedules via the "
+                          "normal retry path.",
+    "BACKPRESSURE_ADJUST": "A data executor retuned its inflight/queued "
+                           "limits from the backpressure gauges.",
 }
 
 # Worker exit taxonomy (reference: `WorkerExitType`). The raylet picks
@@ -50,6 +63,8 @@ WORKER_EXIT_TYPES = (
     "USER_ERROR",      # nonzero exit code (uncaught exception, sys.exit)
     "SYSTEM_ERROR",    # killed by a signal the framework didn't send
     "OOM_KILLED",      # shot by the node memory monitor
+    "PREEMPT_RESCHEDULE",  # proactively retired below the kill
+                           # threshold; task retries elsewhere
     "NODE_DEATH",      # the whole node went away
 )
 
@@ -66,6 +81,10 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "SPILL_PRESSURE": "WARNING",
     "JOB_STARTED": "INFO",
     "JOB_FINISHED": "INFO",
+    "AUTOSCALE_UP": "INFO",
+    "AUTOSCALE_DOWN": "INFO",
+    "PREEMPT_RESCHEDULE": "WARNING",
+    "BACKPRESSURE_ADJUST": "INFO",
 }
 
 _EXIT_SEVERITY = {
@@ -73,6 +92,9 @@ _EXIT_SEVERITY = {
     "USER_ERROR": "WARNING",
     "SYSTEM_ERROR": "ERROR",
     "OOM_KILLED": "ERROR",
+    # Deliberate, recoverable: the task retries — an ERROR here would
+    # page on the controller doing its job.
+    "PREEMPT_RESCHEDULE": "WARNING",
     "NODE_DEATH": "ERROR",
 }
 
@@ -99,15 +121,21 @@ def make_event(event_type: str, message: str,
 
 def classify_worker_exit(returncode: Optional[int], *,
                          oom_killed: bool = False,
-                         intended: bool = False) -> str:
+                         intended: bool = False,
+                         preempted: bool = False) -> str:
     """Map a reaped worker's waitpid status to the exit taxonomy.
 
     Popen semantics: negative returncode = killed by that signal,
-    0 = clean exit, positive = abnormal interpreter exit. The two
+    0 = clean exit, positive = abnormal interpreter exit. The
     raylet-caused deaths override the raw status — the raylet SIGKILLs
-    both retired pool workers (intended) and OOM victims."""
+    retired pool workers (intended), OOM victims, and memory-pressure
+    preemptions. OOM wins over preemption: if the kill threshold fired
+    on a worker already marked for preemption, the stronger verdict is
+    the true one."""
     if oom_killed:
         return "OOM_KILLED"
+    if preempted:
+        return "PREEMPT_RESCHEDULE"
     if intended:
         return "INTENDED_EXIT"
     if returncode is None or returncode == 0:

@@ -69,6 +69,13 @@ class ServeMetrics:
             "serve_request_timeouts_total",
             description="Server-side waits that gave up before the "
                         "engine finished the request.")
+        # Set by the serve controller (one process), so the per-pid
+        # gauge split still yields one authoritative series per
+        # deployment — the grafana replica-count panel reads this.
+        self.replicas = Gauge(
+            "serve_replicas", tag_keys=("deployment",),
+            description="Live replicas per deployment, as reconciled "
+                        "by the serve controller.")
 
 
 def serve_metrics() -> ServeMetrics:

@@ -31,9 +31,16 @@ class ServeController:
         # (app, deployment) -> router_id -> (inflight, ts): handle-side
         # load reports driving the autoscaler.
         self._handle_metrics: Dict[tuple, Dict[str, tuple]] = {}
-        # (app, deployment) -> {"desired", "since"}: scale-decision
-        # hysteresis state.
-        self._scale_state: Dict[tuple, Dict[str, Any]] = {}
+        # (app, deployment) -> AutoscalePolicy (hysteresis + cooldown
+        # state lives inside; rebuilt when the config changes).
+        self._policies: Dict[tuple, Any] = {}
+        self._policy_cfgs: Dict[tuple, Any] = {}
+        # (app, deployment) -> the metric reading behind the latest
+        # desired-replica verdict (attached to scale decisions/events).
+        self._last_reading: Dict[tuple, Dict[str, Any]] = {}
+        # MetricsHub over the serve_* gauges, refreshed by the
+        # bounded-period autoscale policy loop (None until first fetch).
+        self._hub = None
         # (app, deployment) -> hash of the spec its replicas were built
         # from; a mismatch triggers a rolling replacement.
         self._replica_hash: Dict[tuple, str] = {}
@@ -45,6 +52,8 @@ class ServeController:
         self._stop = threading.Event()
         threading.Thread(target=self._reconcile_loop, daemon=True,
                          name="serve-reconcile").start()
+        threading.Thread(target=self._autoscale_policy_loop, daemon=True,
+                         name="serve-autoscale-policy").start()
 
     # ------------------------------------------------------------- deploy
     def deploy_application(self, app_name: str,
@@ -64,7 +73,9 @@ class ServeController:
                     except Exception:
                         pass
                 self._handle_metrics.pop((app_name, name), None)
-                self._scale_state.pop((app_name, name), None)
+                self._policies.pop((app_name, name), None)
+                self._policy_cfgs.pop((app_name, name), None)
+                self._last_reading.pop((app_name, name), None)
             self._version += 1
             self._version_cond.notify_all()
         return True
@@ -111,6 +122,9 @@ class ServeController:
                     changed = True
             replicas[:] = live
             want = self._desired_replicas(key, spec, len(live))
+            if spec.get("autoscaling_config") and len(live) > 0 \
+                    and want != len(live):
+                self._record_scale_decision(key, len(live), want)
             while len(replicas) < want:
                 options: Dict[str, Any] = dict(
                     num_cpus=spec.get("num_cpus", 1),
@@ -140,6 +154,13 @@ class ServeController:
                     self._version_cond.notify_all()
                 for doomed in doomed_list:
                     self._drain_and_kill(doomed)
+            try:
+                from ray_tpu.observability.serve import serve_metrics
+                serve_metrics().replicas.set(
+                    len(replicas),
+                    tags={"deployment": f"{app}/{spec['name']}"})
+            except Exception:
+                pass
         if changed:
             with self._lock:
                 self._version += 1
@@ -211,28 +232,68 @@ class ServeController:
         cfg = spec.get("autoscaling_config")
         if not cfg:
             return spec.get("num_replicas", 1)
-        import math
+        from ray_tpu.serve._private.autoscale import AutoscalePolicy
 
-        lo, hi = cfg["min_replicas"], cfg["max_replicas"]
-        target = max(cfg["target_ongoing_requests"], 1e-9)
-        raw = math.ceil(self._total_inflight(key) / target)
-        desired = max(lo, min(hi, max(raw, 0)))
-        if desired == current:
-            self._scale_state.pop(key, None)
-            return current
-        # Hysteresis: the desire must hold for upscale/downscale_delay_s
-        # before acting (reference: autoscaling_policy delays).
-        now = time.monotonic()
-        st = self._scale_state.get(key)
-        if st is None or st["desired"] != desired:
-            self._scale_state[key] = {"desired": desired, "since": now}
-            return current
-        delay = (cfg["upscale_delay_s"] if desired > current
-                 else cfg["downscale_delay_s"])
-        if now - st["since"] < delay:
-            return current
-        self._scale_state.pop(key, None)
-        return desired
+        policy = self._policies.get(key)
+        if policy is None or self._policy_cfgs.get(key) != cfg:
+            policy = AutoscalePolicy(cfg)
+            self._policies[key] = policy
+            self._policy_cfgs[key] = dict(cfg)
+        want, reading = policy.desired(
+            current, self._total_inflight(key), hub=self._hub)
+        self._last_reading[key] = reading
+        return want
+
+    def _autoscale_policy_loop(self):
+        """Bounded-period metrics side of the autoscaler: refresh the
+        MetricsHub view of the serve_* gauges that `_desired_replicas`
+        reads on the next reconcile tick. Jittered so a fleet of
+        controllers never thunders the GCS in phase; separate from the
+        reconcile loop so a slow GCS fetch cannot stall replica health
+        probes."""
+        import random
+
+        from ray_tpu._private.config import GlobalConfig
+        from ray_tpu.util.metrics import MetricsHub
+
+        while not self._stop.is_set():
+            period = max(0.25, GlobalConfig.serve_autoscale_interval_s)
+            self._stop.wait(period * random.uniform(0.8, 1.2))
+            if self._stop.is_set():
+                return
+            try:
+                if self._hub is None:
+                    self._hub = MetricsHub()
+                self._hub.refresh(prefixes=["serve_"], force=True)
+            except Exception:
+                pass
+
+    def _record_scale_decision(self, key: tuple, current: int,
+                               want: int) -> None:
+        """Every granted scale action is observable: decision counter,
+        timeline span, typed cluster event with the triggering reading,
+        and the GCS decision ring (GET /api/controller)."""
+        from ray_tpu.observability.control import record_decision
+
+        app, name = key
+        reading = dict(self._last_reading.get(key, {}))
+        reading.update({"app": app, "deployment": name,
+                        "from": current, "to": want})
+        message = (f"{app}/{name}: {current} -> {want} replicas "
+                   f"(inflight={reading.get('inflight')}, "
+                   f"queue_wait_p95_s={reading.get('queue_wait_p95_s')}, "
+                   f"slot_utilization={reading.get('slot_utilization')})")
+        try:
+            if want > current:
+                record_decision(
+                    "serve_autoscaler", "scale_up", "load above target",
+                    reading, event_type="AUTOSCALE_UP", message=message)
+            else:
+                record_decision(
+                    "serve_autoscaler", "scale_down", "load below target",
+                    reading, event_type="AUTOSCALE_DOWN", message=message)
+        except Exception:
+            pass
 
     # -------------------------------------------------------------- query
     def get_replicas(self, app_name: str, deployment_name: str):

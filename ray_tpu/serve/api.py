@@ -30,8 +30,10 @@ class Deployment:
     # the replica actor's max_concurrency; also what @serve.batch needs to
     # see concurrent requests at all.
     max_ongoing_requests: int = 8
+    # Keys (see serve/_private/autoscale.py AUTOSCALING_DEFAULTS):
     # {"min_replicas", "max_replicas", "target_ongoing_requests",
-    #  "upscale_delay_s", "downscale_delay_s"}
+    #  "upscale_delay_s", "downscale_delay_s", "queue_wait_target_s",
+    #  "slot_utilization_target"}
     autoscaling_config: Optional[Dict[str, Any]] = None
     # Generator deployments: HTTP responses stream chunk-by-chunk and
     # handles default to DeploymentResponseGenerator (reference:
@@ -70,18 +72,23 @@ class Application:
             for k, v in self.init_kwargs.items()
         }
         d = self.deployment
+        from ray_tpu.serve._private.autoscale import (
+            AUTOSCALING_DEFAULTS, validate_autoscaling_config)
+
         autoscaling = d.autoscaling_config
         num_replicas = d.num_replicas
         if num_replicas == "auto":
-            autoscaling = autoscaling or {}
-            num_replicas = autoscaling.get("min_replicas", 1)
+            # "auto" routes through the controller's AutoscalePolicy:
+            # the deployment *starts* at min_replicas but scales between
+            # min/max on the metrics plane (it used to pin to min and
+            # never move when no autoscaling_config was given).
+            autoscaling = dict(autoscaling or {})
+            autoscaling.setdefault("mode", "metrics")
         if autoscaling is not None:
-            autoscaling = {
-                "min_replicas": 1, "max_replicas": 4,
-                "target_ongoing_requests": 2,
-                "upscale_delay_s": 2.0, "downscale_delay_s": 10.0,
-                **autoscaling,
-            }
+            autoscaling = {**AUTOSCALING_DEFAULTS, **autoscaling}
+            validate_autoscaling_config(autoscaling)
+        if num_replicas == "auto":
+            num_replicas = autoscaling["min_replicas"]
         if not any(spec["name"] == d.name for spec in out):
             out.append({
                 "name": d.name,
@@ -259,13 +266,17 @@ def run(app: Application, *, name: str = "default",
                 # Same defaults merge _collect applies to code-defined
                 # configs — a partial config dict must never reach the
                 # controller (reconcile KeyErrors on missing knobs).
+                from ray_tpu.serve._private.autoscale import (
+                    AUTOSCALING_DEFAULTS, validate_autoscaling_config)
+
                 auto = {
-                    "min_replicas": 1, "max_replicas": 4,
-                    "target_ongoing_requests": 2,
-                    "upscale_delay_s": 2.0, "downscale_delay_s": 10.0,
+                    **AUTOSCALING_DEFAULTS,
                     **(spec.get("autoscaling_config") or {}),
                     **(ov.get("autoscaling_config") or {}),
                 }
+                if wants_auto:
+                    auto.setdefault("mode", "metrics")
+                validate_autoscaling_config(auto)
                 ov["autoscaling_config"] = auto
                 if wants_auto:
                     ov["num_replicas"] = auto["min_replicas"]

@@ -60,11 +60,23 @@ class DeploymentOverride:
             raise SchemaError(
                 f"app {app!r} deployment {raw['name']!r}: num_replicas "
                 f"must be a non-negative int or 'auto'")
-        if "autoscaling_config" in ov and not isinstance(
-                ov["autoscaling_config"], dict):
-            raise SchemaError(
-                f"app {app!r} deployment {raw['name']!r}: "
-                f"autoscaling_config must be a mapping")
+        if "autoscaling_config" in ov:
+            if not isinstance(ov["autoscaling_config"], dict):
+                raise SchemaError(
+                    f"app {app!r} deployment {raw['name']!r}: "
+                    f"autoscaling_config must be a mapping")
+            from ray_tpu.serve._private.autoscale import (
+                validate_autoscaling_config)
+
+            # Reject impossible bounds HERE, with the app/deployment in
+            # the message — not at reconcile time deep in the controller.
+            try:
+                validate_autoscaling_config(ov["autoscaling_config"],
+                                            error_cls=SchemaError)
+            except SchemaError as e:
+                raise SchemaError(
+                    f"app {app!r} deployment {raw['name']!r}: {e}") \
+                    from None
         return cls(name=raw["name"], overrides=ov)
 
 

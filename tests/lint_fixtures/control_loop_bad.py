@@ -1,0 +1,19 @@
+"""control-loop violations: the quiet ways a control plane fails."""
+
+import asyncio
+
+
+class Tuner:
+    async def backpressure_policy_loop(self, state):
+        while True:                 # ctrl-busy-spin: no sleep anywhere
+            state.evaluate()
+
+    async def autoscale_control_loop(self, state):
+        while True:
+            state.evaluate()
+            await asyncio.sleep(2.0)   # ctrl-unjittered-period
+
+    def start(self, state):
+        # ctrl-unawaited-policy: builds the coroutine, drops it — the
+        # policy loop silently never runs.
+        self.autoscale_control_loop(state)

@@ -1,0 +1,21 @@
+"""control-loop clean twin: bounded jittered loops, spawned policies."""
+
+import asyncio
+import random
+
+
+class Tuner:
+    async def backpressure_policy_loop(self, state):
+        while True:
+            state.evaluate()
+            # Jittered period: a fleet of tuners never fetches metrics
+            # in phase.
+            await asyncio.sleep(2.0 * random.uniform(0.8, 1.2))
+
+    async def autoscale_control_loop(self, state):
+        while not state.stopped:
+            state.evaluate()
+            await asyncio.sleep(state.period * random.uniform(0.8, 1.2))
+
+    def start(self, state, loop):
+        loop.create_task(self.autoscale_control_loop(state))

@@ -1,0 +1,544 @@
+"""Metrics-driven control plane: MetricsHub query surface, the shared
+Hysteresis gate, the serve AutoscalePolicy, the data BackpressureTuner,
+serve config validation, the GCS decision ring + dashboard surface, and
+the end-to-end memory-preemption path (PREEMPT_RESCHEDULE, not
+OOM_KILLED)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ray_tpu.observability.control import Hysteresis
+from ray_tpu.util.metrics import MetricsHub
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ helpers
+
+def _hist_entry(count, buckets, *, age_s=0.0,
+                boundaries=(0.1, 1.0, 10.0), total=None,
+                label='pid="1@aa"'):
+    """A user_metrics_summary histogram entry (cumulative state)."""
+    return {
+        "type": "histogram", "age_s": age_s,
+        "boundaries": list(boundaries),
+        "data": {label: {"count": float(count),
+                         "sum": float(total if total is not None
+                                      else count),
+                         "buckets": {str(b): float(v)
+                                     for b, v in buckets.items()}}},
+    }
+
+
+def _gauge_entry(values, *, age_s=0.0):
+    """values: {label_str: float}."""
+    return {"type": "gauge", "age_s": age_s,
+            "data": {k: float(v) for k, v in values.items()}}
+
+
+# ----------------------------------------------------------- MetricsHub unit
+
+class TestMetricsHub:
+    def test_counter_window_delta_and_rate(self):
+        hub = MetricsHub(fetch=lambda p: None)
+        now = time.time()
+        hub.ingest({"data_blocks_total": {
+            "type": "counter", "age_s": 0.0,
+            "data": {'stage="map"': 5.0}}}, ts=now - 20)
+        hub.ingest({"data_blocks_total": {
+            "type": "counter", "age_s": 0.0,
+            "data": {'stage="map"': 9.0}}}, ts=now - 1)
+        s = hub.query("data_blocks_total", window=30)
+        assert len(s.samples) == 2
+        assert s.delta() == 4.0
+        assert s.rate() == pytest.approx(4.0 / 19.0, rel=0.05)
+        # A window that excludes the old sample has nothing to diff.
+        assert hub.query("data_blocks_total", window=10).delta() == 0.0
+
+    def test_gauge_label_filter_sums_across_series(self):
+        hub = MetricsHub(fetch=lambda p: None)
+        hub.ingest({"data_inflight_tasks": _gauge_entry({
+            'stage="a",pid="1@aa"': 3.0,
+            'stage="b",pid="1@aa"': 7.0})}, ts=time.time())
+        total = hub.query("data_inflight_tasks")
+        assert total.latest == 10.0 and total.n_series == 2
+        only_a = hub.query("data_inflight_tasks", labels={"stage": "a"})
+        assert only_a.latest == 3.0 and only_a.n_series == 1
+        assert not hub.query("data_inflight_tasks", labels={"stage": "z"})
+
+    def test_histogram_quantile_windowed_delta(self):
+        hub = MetricsHub(fetch=lambda p: None)
+        now = time.time()
+        # Lifetime: 10 fast observations (<=0.1s)...
+        hub.ingest({"serve_queue_wait_seconds": _hist_entry(
+            10, {0.1: 10, 1.0: 10, 10.0: 10})}, ts=now - 20)
+        # ...then 10 slow ones (1.0 < t <= 10.0) land in the window.
+        hub.ingest({"serve_queue_wait_seconds": _hist_entry(
+            20, {0.1: 10, 1.0: 10, 10.0: 20})}, ts=now - 1)
+        s = hub.query("serve_queue_wait_seconds", window=30)
+        # Windowed delta is all-slow: p50 sits in the 10.0 bucket.
+        assert s.quantile(0.5) == 10.0
+        # A single-snapshot series falls back to lifetime cumulative
+        # state, where half the observations were fast.
+        s_one = hub.query("serve_queue_wait_seconds", window=10)
+        assert len(s_one.samples) == 1
+        assert s_one.quantile(0.5) == 0.1
+
+    def test_rtpu_prefix_is_stripped(self):
+        hub = MetricsHub(fetch=lambda p: None)
+        hub.ingest({"node_cpu_percent": _gauge_entry({'pid="1@aa"': 50.0})},
+                   ts=time.time())
+        assert hub.query("rtpu_node_cpu_percent").latest == 50.0
+
+    def test_absent_vs_stale(self):
+        hub = MetricsHub(fetch=lambda p: {
+            "serve_queue_wait_seconds": _hist_entry(
+                5, {0.1: 5, 1.0: 5, 10.0: 5}, age_s=999.0)})
+        # Absent: falsy and NOT stale (controllers treat it as unwired).
+        missing = hub.query("serve_batch_utilization")
+        assert not missing and not missing.stale()
+        assert hub.refresh(force=True)
+        s = hub.query("serve_queue_wait_seconds")
+        assert s and s.stale(ttl=10.0)
+        assert s.age_s >= 999.0
+
+    def test_fresh_fetch_is_not_stale(self):
+        hub = MetricsHub(fetch=lambda p: {
+            "data_inflight_tasks": _gauge_entry({'stage="m"': 4.0})})
+        assert hub.refresh(force=True)
+        s = hub.query("data_inflight_tasks")
+        assert s and not s.stale(ttl=10.0)
+
+    def test_ingest_only_hub_reads_stale(self):
+        # age_s counts from the last *refresh*; a hub that was only ever
+        # hand-fed via ingest() never refreshed, so its readings are
+        # stale by construction — the safe default for controllers.
+        hub = MetricsHub(fetch=lambda p: None)
+        hub.ingest({"data_inflight_tasks": _gauge_entry({'stage="m"': 1.0})},
+                   ts=time.time())
+        assert hub.query("data_inflight_tasks").stale(ttl=10.0)
+
+
+# ------------------------------------------------------------ Hysteresis unit
+
+class TestHysteresis:
+    def test_oscillating_proposal_never_granted(self):
+        gate = Hysteresis(up_delay_s=1.0, down_delay_s=3.0, cooldown_s=5.0)
+        t = 100.0
+        for _ in range(100):
+            assert gate.propose(1, 2, t) == 1
+            t += 0.2
+            # The metric dipped: proposal returns to current, clearing
+            # the pending clock — oscillation never accumulates.
+            assert gate.propose(1, 1, t) == 1
+            t += 0.2
+
+    def test_steady_proposal_granted_after_delay(self):
+        gate = Hysteresis(up_delay_s=1.0, down_delay_s=3.0, cooldown_s=5.0)
+        assert gate.propose(1, 2, 100.0) == 1
+        assert gate.propose(1, 2, 100.5) == 1
+        assert gate.propose(1, 2, 101.1) == 2
+
+    def test_cooldown_spaces_consecutive_actions(self):
+        gate = Hysteresis(up_delay_s=1.0, down_delay_s=1.0, cooldown_s=5.0)
+        assert gate.propose(1, 2, 100.0) == 1
+        assert gate.propose(1, 2, 101.1) == 2  # granted; cooldown starts
+        # Next change held past its delay but inside the cooldown.
+        assert gate.propose(2, 3, 101.2) == 2
+        assert gate.propose(2, 3, 102.5) == 2
+        assert gate.propose(2, 3, 106.3) == 3  # cooldown elapsed
+
+    def test_down_delay_is_direction_specific(self):
+        gate = Hysteresis(up_delay_s=0.5, down_delay_s=3.0, cooldown_s=0.0)
+        assert gate.propose(3, 2, 100.0) == 3
+        assert gate.propose(3, 2, 101.0) == 3  # up_delay passed, not down
+        assert gate.propose(3, 2, 103.1) == 2
+
+    def test_note_external_change_starts_cooldown(self):
+        gate = Hysteresis(up_delay_s=0.0, down_delay_s=0.0, cooldown_s=5.0)
+        gate.note_external_change(100.0)
+        assert gate.propose(1, 2, 101.0) == 1
+        assert gate.propose(1, 2, 105.1) == 2
+
+
+# -------------------------------------------------------- AutoscalePolicy unit
+
+class TestAutoscalePolicy:
+    def _policy(self, **cfg):
+        from ray_tpu.serve._private.autoscale import AutoscalePolicy
+        cfg.setdefault("upscale_delay_s", 1.0)
+        cfg.setdefault("downscale_delay_s", 3.0)
+        return AutoscalePolicy(cfg, cooldown_s=cfg.pop("cooldown_s", 0.0))
+
+    def test_bootstrap_goes_straight_to_min(self):
+        p = self._policy(min_replicas=2)
+        want, reading = p.desired(0, 0, now=100.0)
+        assert want == 2 and reading["desired"] == 2
+
+    def test_inflight_law_with_hold_delay(self):
+        p = self._policy(target_ongoing_requests=2)
+        # ceil(6/2)=3, but the proposal must hold for upscale_delay_s.
+        want, _ = p.desired(1, 6, now=100.0)
+        assert want == 1
+        want, reading = p.desired(1, 6, now=101.1)
+        assert want == 3 and reading["desired"] == 3
+
+    def test_clamped_to_max_replicas(self):
+        p = self._policy(max_replicas=4, upscale_delay_s=0.0)
+        want, reading = p.desired(1, 100, now=100.0)
+        assert want == 4 and reading["desired"] == 4
+
+    def test_stale_metrics_hold_decision(self):
+        p = self._policy(upscale_delay_s=0.0)
+        hub = MetricsHub(fetch=lambda pre: {
+            "serve_queue_wait_seconds": _hist_entry(
+                50, {0.1: 0, 1.0: 0, 10.0: 50}, age_s=999.0)})
+        assert hub.refresh(force=True)
+        # Inflight alone says scale to 5; the stale queue gauge vetoes.
+        want, reading = p.desired(1, 10, hub=hub, now=100.0)
+        assert want == 1
+        assert reading["held"] == "stale_metrics"
+        assert reading["metric"] == "serve_queue_wait_seconds"
+
+    def test_queue_wait_p95_proposes_extra_replica(self):
+        p = self._policy(upscale_delay_s=0.0, queue_wait_target_s=0.5)
+        state = {"count": 5}
+        hub = MetricsHub(fetch=lambda pre: {
+            "serve_queue_wait_seconds": _hist_entry(
+                state["count"], {0.1: 0, 1.0: 0, 10.0: state["count"]})})
+        assert hub.refresh(force=True)
+        time.sleep(0.02)  # distinct sample timestamps
+        state["count"] = 15
+        assert hub.refresh(force=True)
+        # Inflight is zero, but requests are aging inside replicas:
+        # the p95 signal proposes current+1.
+        want, reading = p.desired(2, 0, hub=hub, now=100.0)
+        assert want == 3
+        assert reading["queue_wait_p95_s"] == 10.0
+
+    def test_slot_utilization_proposes_extra_replica(self):
+        p = self._policy(upscale_delay_s=0.0, slot_utilization_target=0.9)
+        hub = MetricsHub(fetch=lambda pre: {
+            "serve_batch_utilization": _gauge_entry({
+                'pid="1@aa"': 0.95, 'pid="2@aa"': 0.97})})
+        assert hub.refresh(force=True)
+        want, reading = p.desired(2, 0, hub=hub, now=100.0)
+        assert want == 3
+        assert reading["slot_utilization"] == pytest.approx(0.96)
+
+    def test_oscillating_inflight_never_flaps(self):
+        p = self._policy(target_ongoing_requests=2, upscale_delay_s=2.0,
+                         downscale_delay_s=5.0)
+        t = 100.0
+        for _ in range(50):
+            for inflight in (6, 2):  # desired flips 3 <-> 1 every tick
+                want, _ = p.desired(1, inflight, now=t)
+                assert want == 1
+                t += 0.5
+
+    def test_min_above_max_rejected(self):
+        from ray_tpu.serve._private.autoscale import AutoscalePolicy
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscalePolicy({"min_replicas": 5, "max_replicas": 2})
+
+
+# ------------------------------------------------------ BackpressureTuner unit
+
+class TestBackpressureTuner:
+    def _tuner(self, state, *, age_s=0.0, interval_s=1.0, max_scale=4.0):
+        from ray_tpu.data._internal.backpressure import BackpressureTuner
+
+        def fetch(prefixes):
+            return {
+                "data_inflight_tasks": _gauge_entry(
+                    {'stage="map",pid="1@aa"': state["inflight"]},
+                    age_s=age_s),
+                "data_queued_blocks": _gauge_entry(
+                    {'stage="map",pid="1@aa"': state["queued"]},
+                    age_s=age_s),
+            }
+
+        hub = MetricsHub(fetch=fetch, min_refresh_s=0.0)
+        return BackpressureTuner(hub=hub, interval_s=interval_s,
+                                 max_scale=max_scale)
+
+    def _evaluate_rounds(self, tuner, state, base, rounds, start=1000.0):
+        now = start
+        for _ in range(rounds):
+            state["inflight"] = tuner.cap("map", base)  # pinned at cap
+            tuner.maybe_evaluate(now)
+            now += tuner.interval_s * 1.1
+            time.sleep(0.01)  # distinct hub sample timestamps
+        return now
+
+    def test_starving_stage_raises_cap_bounded(self):
+        state = {"inflight": 8, "queued": 0}
+        tuner = self._tuner(state, max_scale=4.0)
+        base = 8
+        assert tuner.cap("map", base) == base
+        self._evaluate_rounds(tuner, state, base, rounds=12)
+        cap = tuner.cap("map", base)
+        assert cap > base
+        assert cap <= base * 4.0
+        # max_scale=4.0 admits three x1.5 steps: 8 * 1.5^3 = 27.
+        assert cap == 27
+
+    def test_deep_queue_lowers_cap_bounded(self):
+        state = {"inflight": 0, "queued": 64}
+        tuner = self._tuner(state)
+        base = 8
+        now = 1000.0
+        for _ in range(12):
+            tuner.cap("map", base)
+            tuner.maybe_evaluate(now)
+            now += tuner.interval_s * 1.1
+            time.sleep(0.01)
+        cap = tuner.cap("map", base)
+        assert 1 <= cap < base
+        assert cap == max(1, int(round(base * 1.5 ** -3)))
+
+    def test_stale_gauges_hold(self):
+        state = {"inflight": 8, "queued": 0}
+        tuner = self._tuner(state, age_s=999.0)
+        base = 8
+        self._evaluate_rounds(tuner, state, base, rounds=6)
+        assert tuner.cap("map", base) == base  # frozen gauge != low gauge
+
+    def test_recovery_decays_back_to_base(self):
+        state = {"inflight": 8, "queued": 0}
+        tuner = self._tuner(state)
+        base = 8
+        now = self._evaluate_rounds(tuner, state, base, rounds=4)
+        assert tuner.cap("map", base) > base
+        # Load drained: nearly idle, queue empty -> decay toward 0.
+        for _ in range(12):
+            state["inflight"] = 1
+            state["queued"] = 0
+            tuner.cap("map", base)
+            tuner.maybe_evaluate(now)
+            now += tuner.interval_s * 1.1
+            time.sleep(0.01)
+        assert tuner.cap("map", base) == base
+
+    def test_disabled_by_zero_interval(self):
+        from ray_tpu.data._internal.backpressure import BackpressureTuner
+        tuner = BackpressureTuner(interval_s=0)
+        assert not tuner.enabled
+        assert tuner.cap("map", 8) == 8
+        assert tuner.limit("map", 16) == 16
+        tuner.maybe_evaluate()  # no-op, no hub
+
+
+# ------------------------------------------------- serve config validation
+
+class TestServeConfigValidation:
+    def _specs(self, **dep_kwargs):
+        from ray_tpu import serve
+
+        @serve.deployment(**dep_kwargs)
+        def f(x):
+            return x
+
+        out = []
+        f.bind()._collect("app", out, True)
+        return out
+
+    def test_auto_resolves_to_min_with_policy_attached(self):
+        (spec,) = self._specs(num_replicas="auto")
+        assert spec["num_replicas"] == 1
+        cfg = spec["autoscaling_config"]
+        assert cfg is not None
+        assert cfg["mode"] == "metrics"
+        assert cfg["min_replicas"] == 1 and cfg["max_replicas"] == 4
+
+    def test_auto_starts_at_configured_min(self):
+        (spec,) = self._specs(num_replicas="auto",
+                              autoscaling_config={"min_replicas": 2,
+                                                  "max_replicas": 6})
+        assert spec["num_replicas"] == 2
+        assert spec["autoscaling_config"]["max_replicas"] == 6
+
+    def test_min_above_max_rejected_at_build(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            self._specs(num_replicas="auto",
+                        autoscaling_config={"min_replicas": 5,
+                                            "max_replicas": 2})
+
+    def test_schema_override_rejects_min_above_max(self):
+        from ray_tpu.serve.schema import DeploymentOverride, SchemaError
+        with pytest.raises(SchemaError) as ei:
+            DeploymentOverride.parse(
+                {"name": "d", "autoscaling_config": {"min_replicas": 5,
+                                                     "max_replicas": 2}},
+                app="myapp")
+        msg = str(ei.value)
+        assert "myapp" in msg and "'d'" in msg and "min_replicas" in msg
+
+    def test_schema_override_accepts_auto(self):
+        from ray_tpu.serve.schema import DeploymentOverride
+        ov = DeploymentOverride.parse(
+            {"name": "d", "num_replicas": "auto"}, app="myapp")
+        assert ov.overrides["num_replicas"] == "auto"
+
+
+# ---------------------------------------------- decision ring + dashboard
+
+@pytest.fixture(scope="module")
+def ctrl_cluster():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, num_tpus=0,
+                        object_store_memory=128 * 1024 * 1024,
+                        include_dashboard=True,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=15) as resp:
+        return resp.status, resp.read()
+
+
+def test_decision_ring_event_and_dashboard(ctrl_cluster):
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.observability.control import record_decision
+    from ray_tpu.util import metrics, state
+    from ray_tpu import _local_node
+
+    record_decision("unit_test_ctrl", "poke", "exercising the ring",
+                    {"x": 1}, event_type="AUTOSCALE_UP",
+                    message="unit test decision")
+
+    w = global_worker()
+    rows = w.gcs.call("list_ctrl_decisions", controller="unit_test_ctrl")
+    assert len(rows) == 1
+    d = rows[0]
+    assert d["action"] == "poke" and d["reading"] == {"x": 1}
+    assert d["seq"] >= 1 and d["ts"] > 0
+    # Filters exclude.
+    assert w.gcs.call("list_ctrl_decisions", controller="unit_test_ctrl",
+                      action="nope") == []
+
+    # The cluster event carries the reading.
+    events = state.list_cluster_events(event_type="AUTOSCALE_UP")
+    assert any(e["message"] == "unit test decision" and
+               e.get("controller") == "unit_test_ctrl"
+               for e in events), events
+
+    # Dashboard surface.
+    base = _local_node.dashboard_url
+    status, body = _get(base + "/api/controller?controller=unit_test_ctrl")
+    assert status == 200
+    api_rows = json.loads(body)
+    assert len(api_rows) == 1 and api_rows[0]["action"] == "poke"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base + "/api/controller?limit=bogus")
+    assert ei.value.code == 400
+
+    # The decision counter reaches the exported metrics after a flush.
+    assert metrics.flush()
+    text = w.gcs.call("metrics_text")
+    assert "rtpu_ctrl_decisions_total" in text
+    assert 'controller="unit_test_ctrl"' in text
+
+    # And the module-level query surface reads it back.
+    s = metrics.query("ctrl_decisions_total",
+                      labels={"controller": "unit_test_ctrl"})
+    assert s and s.latest >= 1.0
+
+
+# ------------------------------------------------- preemption end-to-end
+
+def test_memory_preemption_reschedules_not_kills(tmp_path):
+    """Usage between the preempt and kill thresholds: the monitor
+    preemptively reschedules the hog, the retry does NOT consume the
+    user retry budget (max_retries=0 still survives), the exit is
+    classified PREEMPT_RESCHEDULE (not OOM_KILLED), and the decision
+    lands in the GCS ring as controller=memory_preempt."""
+    usage = tmp_path / "usage"
+    usage.write_text("0.10")
+    attempts = tmp_path / "attempts"
+    script = tmp_path / "driver.py"
+    script.write_text(f"""
+import json, os, time
+import ray_tpu
+from ray_tpu.util import state
+ray_tpu.init(num_cpus=2, _system_config={{
+    "memory_monitor_test_usage_path": {str(usage)!r},
+    "memory_usage_threshold": 0.95,
+    "memory_preempt_threshold": 0.7,
+    "memory_preempt_cooldown_s": 0.5,
+    "memory_monitor_refresh_ms": 100,
+}})
+
+@ray_tpu.remote(max_retries=0)
+def hog():
+    path = {str(attempts)!r}
+    n = 0
+    if os.path.exists(path):
+        with open(path) as f:
+            n = int(f.read() or 0)
+    with open(path, "w") as f:
+        f.write(str(n + 1))
+    if n == 0:
+        time.sleep(30.0)  # first attempt camps until preempted
+    return "survived:" + str(n)
+
+ref = hog.remote()
+while not os.path.exists({str(attempts)!r}):
+    time.sleep(0.05)
+# Between preempt (0.7) and kill (0.95): reschedule, don't kill.
+with open({str(usage)!r}, "w") as f:
+    f.write("0.80")
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    with open({str(attempts)!r}) as f:
+        if f.read().strip() == "2":
+            break
+    time.sleep(0.1)
+with open({str(usage)!r}, "w") as f:
+    f.write("0.10")
+try:
+    print("VERDICT:result:" + ray_tpu.get(ref, timeout=60))
+except Exception as e:
+    print("VERDICT:error:" + type(e).__name__ + ":" + repr(str(e)))
+
+events = state.list_cluster_events(event_type="PREEMPT_RESCHEDULE")
+print("VERDICT:events:" + str(len(events)))
+
+from ray_tpu._private.worker import global_worker
+rows = []
+deadline = time.monotonic() + 20
+while time.monotonic() < deadline and not rows:
+    rows = global_worker().gcs.call("list_ctrl_decisions",
+                                    controller="memory_preempt")
+    time.sleep(0.25)
+print("VERDICT:decisions:" + json.dumps(rows[-1:]))
+ray_tpu.shutdown()
+""")
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=180, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                          "PYTHONPATH": _repo_root()})
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    verdicts = {ln.split(":", 2)[1]: ln.split(":", 2)[2]
+                for ln in proc.stdout.splitlines()
+                if ln.startswith("VERDICT:")}
+    # The task survived its preemption on a free retry budget.
+    assert verdicts.get("result") == "survived:1", out
+    assert "OOM" not in verdicts.get("result", ""), out
+    assert int(verdicts.get("events", "0")) >= 1, out
+    rows = json.loads(verdicts.get("decisions", "[]"))
+    assert rows and rows[-1]["action"] == "preempt_reschedule", out
+    assert rows[-1]["reading"].get("usage") is not None, out

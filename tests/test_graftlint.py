@@ -46,6 +46,9 @@ PASS_CASES = [
     ("event-schema", "events_bad", "events_clean",
      {"event-unregistered-emit", "event-dead-type",
       "event-undocumented-type"}),
+    ("control-loop", "control_loop_bad.py", "control_loop_clean.py",
+     {"ctrl-busy-spin", "ctrl-unjittered-period",
+      "ctrl-unawaited-policy"}),
 ]
 
 
@@ -192,7 +195,7 @@ class TestRepoGate:
         for name in ("jit-hygiene", "async-blocking",
                      "distributed-deadlock", "collective-consistency",
                      "lock-discipline", "metric-declarations",
-                     "event-schema"):
+                     "event-schema", "control-loop"):
             assert name in out
 
 
