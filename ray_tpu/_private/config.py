@@ -155,6 +155,12 @@ _define("serve_autoscale_interval_s", float, 2.0,
 _define("serve_autoscale_cooldown_s", float, 5.0,
         "Minimum spacing between scale actions on one deployment, on "
         "top of the up/downscale hold delays.")
+_define("serve_kv_block_size", int, 16,
+        "Default paged-KV block size (rows per HBM block) for serve "
+        "LLM engines built without an explicit kv_block_size.")
+_define("serve_router_probe_interval_s", float, 1.0,
+        "Period of the LLM router's per-replica queue-depth probe; a "
+        "stalled replica sheds traffic within about one period.")
 _define("data_backpressure_interval_s", float, 1.0,
         "Minimum spacing between backpressure re-evaluations per "
         "executor (the tuner is pulled from the launch loop; this "

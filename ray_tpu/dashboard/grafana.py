@@ -90,6 +90,28 @@ _PANELS: List[Dict[str, str]] = [
      "expr": "rtpu_data_inflight_tasks",
      "expr_b": "rtpu_data_queued_blocks",
      "legend": "{{stage}}", "unit": "short"},
+    # --- paged KV cache & LLM router (serve/llm/kv_cache.py, router.py) ---
+    {"title": "KV pool utilization",
+     "expr": "rtpu_serve_kv_blocks_used / "
+             "(rtpu_serve_kv_blocks_used + rtpu_serve_kv_blocks_free)",
+     "unit": "percentunit"},
+    {"title": "KV blocks used vs free",
+     "expr": "rtpu_serve_kv_blocks_used",
+     "expr_b": "rtpu_serve_kv_blocks_free", "unit": "short"},
+    {"title": "Prefix-cache hit rate",
+     "expr": "rate(rtpu_serve_prefix_cache_hits_total[5m]) / "
+             "(rate(rtpu_serve_prefix_cache_hits_total[5m]) + "
+             "rate(rtpu_serve_prefix_cache_misses_total[5m]))",
+     "unit": "percentunit"},
+    {"title": "Prefill tokens skipped via prefix cache",
+     "expr": "rate(rtpu_serve_prefix_cache_hit_tokens_total[1m])",
+     "unit": "short"},
+    {"title": "Router queue depth per replica",
+     "expr": "rtpu_serve_router_queue_depth",
+     "legend": "{{replica}}", "unit": "short"},
+    {"title": "Router requests per replica",
+     "expr": "rate(rtpu_serve_router_requests_total[5m])",
+     "legend": "{{replica}}", "unit": "short"},
     # --- metrics-driven control plane ---
     {"title": "Serve replicas (autoscaler)",
      "expr": "rtpu_serve_replicas",

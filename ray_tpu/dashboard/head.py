@@ -179,11 +179,43 @@ class DashboardHead:
 
     async def serve_stats(self, _req) -> web.Response:
         """Live serving/JIT telemetry aggregated on the GCS (engine
-        latency histograms, queue gauges, compile counters)."""
+        latency histograms, queue gauges, compile counters), plus an
+        explicit paged-KV / prefix-cache / router rollup so the "is HBM
+        or prefill the bottleneck?" question is one fetch away."""
         summary = await self._gcs.acall(
             "user_metrics_summary",
             prefixes=["serve_", "jit_", "device_"], timeout=10)
-        return web.json_response(summary or {})
+        summary = summary or {}
+
+        def _total(name):
+            entry = summary.get(name)
+            if not entry or not entry.get("data"):
+                return None
+            return sum(float(v) for v in entry["data"].values())
+
+        used, free = (_total("serve_kv_blocks_used"),
+                      _total("serve_kv_blocks_free"))
+        hits, misses = (_total("serve_prefix_cache_hits_total"),
+                        _total("serve_prefix_cache_misses_total"))
+        kv: Dict[str, Any] = {"blocks_used": used, "blocks_free": free}
+        if used is not None and free is not None and (used + free):
+            kv["utilization"] = used / (used + free)
+        prefix: Dict[str, Any] = {
+            "hits": hits, "misses": misses,
+            "hit_tokens": _total("serve_prefix_cache_hit_tokens_total"),
+            "evictions": _total("serve_prefix_cache_evictions_total"),
+        }
+        if hits is not None and misses is not None and (hits + misses):
+            prefix["hit_rate"] = hits / (hits + misses)
+        router_depth = summary.get("serve_router_queue_depth", {})
+        summary["kv_cache"] = kv
+        summary["prefix_cache"] = prefix
+        summary["router"] = {
+            "queue_depth": dict(router_depth.get("data", {})),
+            "requests": dict(summary.get(
+                "serve_router_requests_total", {}).get("data", {})),
+        }
+        return web.json_response(summary)
 
     async def memory(self, req) -> web.Response:
         """Object-store memory introspection: live per-node snapshots

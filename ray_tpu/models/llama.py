@@ -541,6 +541,148 @@ def decode_step(params: Dict[str, Any], cache: Dict[str, jax.Array],
     return logits, {"k": new_k, "v": new_v}
 
 
+def init_paged_kv_cache(config: LlamaConfig, num_blocks: int,
+                        block_size: int) -> Dict[str, jax.Array]:
+    """Paged cache: a fixed POOL of KV blocks shared by all sequences,
+    [L, num_blocks, block_size, n_kv, head_dim] (bf16). A sequence owns
+    a *block table* — the list of physical block ids covering its
+    logical positions — instead of a dense [S] stripe, so short and long
+    requests share HBM instead of each reserving max_seq rows
+    (PagedAttention, arXiv:2309.06180)."""
+    c = config
+    shape = (c.n_layers, num_blocks, block_size, c.n_kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def decode_step_paged(params: Dict[str, Any], pools: Dict[str, jax.Array],
+                      block_tables: jax.Array, tokens: jax.Array,
+                      positions: jax.Array, config: LlamaConfig,
+                      active: Optional[jax.Array] = None):
+    """One incremental token against the paged pool: tokens [B] at
+    `positions` [B], block_tables [B, max_blocks] int32 mapping each
+    sequence's logical block index -> physical pool block. Returns
+    (logits [B, V], updated pools).
+
+    Token-exact with `decode_step` on a dense cache holding the same
+    logical contents: the gather assembles each sequence's dense
+    [S_pad] view (S_pad = max_blocks * block_size), the write lands at
+    (table[pos // bs], pos % bs), and the same masked softmax drops
+    padding/stale rows to exact zeros. ``active`` masks the pool write
+    by pushing the physical block index out of bounds (scatter drops
+    it), mirroring the dense path's out-of-bounds position trick.
+    """
+    if config.n_experts:
+        raise NotImplementedError(
+            "paged KV-cache decode for MoE configs is not implemented")
+    c = config
+    NB, bs = pools["k"].shape[1], pools["k"].shape[2]
+    max_blocks = block_tables.shape[1]
+    S_pad = max_blocks * bs
+    cos, sin = rope_freqs(c.head_dim, S_pad, c.rope_theta)
+    x = embed_lookup(params["embed"].astype(c.dtype), tokens[:, None])
+    B = tokens.shape[0]
+    kd = c.head_dim
+    pos_cos = cos[positions][:, None, :]
+    pos_sin = sin[positions][:, None, :]
+
+    def rope1(t):  # [B, 1, H, D]
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        pc = pos_cos[:, :, None, :]
+        ps = pos_sin[:, :, None, :]
+        return jnp.concatenate(
+            [t1 * pc - t2 * ps, t2 * pc + t1 * ps], axis=-1).astype(t.dtype)
+
+    bidx = jnp.arange(B)
+    phys = block_tables[bidx, positions // bs]
+    if active is not None:
+        phys = jnp.where(active, phys, NB)     # OOB scatter -> dropped
+    off = positions % bs
+
+    def layer(carry, inputs):
+        x = carry
+        p, k_pool, v_pool = inputs
+        h = rms_norm(x, p["attn_norm"], c.norm_eps)
+        q = (h @ _weight(p, "wq", c.dtype)).reshape(B, 1, c.n_heads, kd)
+        k = (h @ _weight(p, "wk", c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
+        v = (h @ _weight(p, "wv", c.dtype)).reshape(B, 1, c.n_kv_heads, kd)
+        q, k = rope1(q), rope1(k)
+        k_pool = k_pool.at[phys, off].set(k[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[phys, off].set(v[:, 0].astype(v_pool.dtype))
+        # Per-sequence dense view via the block table (gather AFTER the
+        # write so this token's own row is attendable at `positions`).
+        k_dense = k_pool[block_tables].reshape(B, S_pad, c.n_kv_heads, kd)
+        v_dense = v_pool[block_tables].reshape(B, S_pad, c.n_kv_heads, kd)
+        attn = _decode_attention(q, k_dense, v_dense, positions)
+        x = x + attn.reshape(B, 1, -1) @ _weight(p, "wo", c.dtype)
+        h = rms_norm(x, p["ffn_norm"], c.norm_eps)
+        gate = jax.nn.silu(h @ _weight(p, "w_gate", c.dtype))
+        up = h @ _weight(p, "w_up", c.dtype)
+        x = x + (gate * up) @ _weight(p, "w_down", c.dtype)
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = lax.scan(
+        layer, x, (params["layers"], pools["k"], pools["v"]))
+    x = rms_norm(x, params["norm_f"], c.norm_eps)
+    head = lm_head_weight(params, c)
+    logits = jax.lax.dot_general(
+        x[:, 0], head, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def prefill_kv_paged(params: Dict[str, Any], tokens: jax.Array,
+                     start: jax.Array, hist_k: jax.Array,
+                     hist_v: jax.Array, config: LlamaConfig):
+    """Suffix prefill with history: the prefix-cache hit path. tokens
+    [1, Pb] sit at absolute positions start..start+Pb-1; hist_k/hist_v
+    [L, S_pad, n_kv, head_dim] hold the cached prefix KV (rows >= start
+    are don't-care — masked, then overwritten by the suffix). Returns
+    (normed hidden [1, Pb, D], suffix ks/vs [L, 1, Pb, n_kv, head_dim]).
+
+    With start=0 and zero history this reduces exactly to `prefill_kv`
+    over a padded bucket: real queries attend only real keys (mask
+    key_pos <= start + i), so bit-identical KV and logits — the engine
+    uses ONE program family for both fresh and prefix-hit admission.
+    """
+    c = config
+    B, Pb = tokens.shape
+    S_pad = hist_k.shape[1]
+    cos, sin = rope_freqs(c.head_dim, S_pad, c.rope_theta)
+    qpos = start + jnp.arange(Pb)
+    kd = c.head_dim
+
+    x = embed_lookup(params["embed"].astype(c.dtype), tokens)
+
+    def scan_body(x, inputs):
+        p, hk, hv = inputs
+        h = rms_norm(x, p["attn_norm"], c.norm_eps)
+        q = (h @ _weight(p, "wq", c.dtype)).reshape(B, Pb, c.n_heads, kd)
+        k = (h @ _weight(p, "wk", c.dtype)).reshape(B, Pb, c.n_kv_heads, kd)
+        v = (h @ _weight(p, "wv", c.dtype)).reshape(B, Pb, c.n_kv_heads, kd)
+        q = apply_rope(q, cos[qpos], sin[qpos])
+        k = apply_rope(k, cos[qpos], sin[qpos])
+        keys = lax.dynamic_update_slice(hk, k[0].astype(hk.dtype),
+                                        (start, 0, 0))
+        vals = lax.dynamic_update_slice(hv, v[0].astype(hv.dtype),
+                                        (start, 0, 0))
+        rep = c.n_heads // c.n_kv_heads
+        attn = xla_attention(
+            q, _repeat_kv(keys[None].astype(c.dtype), rep),
+            _repeat_kv(vals[None].astype(c.dtype), rep),
+            causal=True, positions=qpos)
+        x = x + attn.reshape(B, Pb, -1) @ _weight(p, "wo", c.dtype)
+        h = rms_norm(x, p["ffn_norm"], c.norm_eps)
+        gate = jax.nn.silu(h @ _weight(p, "w_gate", c.dtype))
+        up = h @ _weight(p, "w_up", c.dtype)
+        x = x + (gate * up) @ _weight(p, "w_down", c.dtype)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(scan_body, x, (params["layers"],
+                                          hist_k, hist_v))
+    x = rms_norm(x, params["norm_f"], c.norm_eps)
+    return x, ks, vs
+
+
 def lm_head_weight(params: Dict[str, Any], config: LlamaConfig) -> jax.Array:
     """Output-projection matrix [D, V] in compute dtype (tied or not)."""
     if config.tie_embeddings:

@@ -76,6 +76,41 @@ class ServeMetrics:
             "serve_replicas", tag_keys=("deployment",),
             description="Live replicas per deployment, as reconciled "
                         "by the serve controller.")
+        # Paged KV cache (serve/llm/kv_cache.py): pool occupancy and
+        # prefix reuse. used + free == the engine's num_kv_blocks, so
+        # used / (used + free) is the HBM-side KV utilization panel.
+        self.kv_blocks_used = Gauge(
+            "serve_kv_blocks_used",
+            description="Paged-KV pool blocks currently referenced by a "
+                        "live sequence or the prefix cache.")
+        self.kv_blocks_free = Gauge(
+            "serve_kv_blocks_free",
+            description="Paged-KV pool blocks on the free list.")
+        self.prefix_hits = Counter(
+            "serve_prefix_cache_hits_total",
+            description="Admissions that reused >= 1 cached prompt "
+                        "block (their prefill was skipped).")
+        self.prefix_misses = Counter(
+            "serve_prefix_cache_misses_total",
+            description="Admissions that found no cached prompt prefix.")
+        self.prefix_hit_tokens = Counter(
+            "serve_prefix_cache_hit_tokens_total",
+            description="Prompt positions whose prefill was skipped via "
+                        "the prefix cache.")
+        self.prefix_evictions = Counter(
+            "serve_prefix_cache_evictions_total",
+            description="Prefix-cache entries evicted under pool "
+                        "pressure (LRU).")
+        # LLM router (serve/llm/router.py): per-replica load as seen by
+        # the queue-depth probe, and where requests actually went.
+        self.router_queue_depth = Gauge(
+            "serve_router_queue_depth", tag_keys=("replica",),
+            description="Engine queue depth per LLM replica as last "
+                        "probed by the router.")
+        self.router_requests = Counter(
+            "serve_router_requests_total", tag_keys=("replica",),
+            description="Requests forwarded per LLM replica by the "
+                        "router's power-of-two-choices pick.")
 
 
 def serve_metrics() -> ServeMetrics:

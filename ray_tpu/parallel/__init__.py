@@ -1,5 +1,6 @@
 from ray_tpu.parallel.mesh import (
-    data_parallel_mesh, fsdp_mesh, make_mesh, mesh_axis_size,
+    data_parallel_mesh, discover_devices, fsdp_mesh, make_mesh,
+    mesh_axis_size,
 )
 from ray_tpu.parallel.sharding import (
     batch_sharding, batch_spec, context_parallel_attention,
@@ -10,7 +11,8 @@ from ray_tpu.parallel.train_step import (
 )
 
 __all__ = [
-    "make_mesh", "data_parallel_mesh", "fsdp_mesh", "mesh_axis_size",
+    "make_mesh", "data_parallel_mesh", "discover_devices",
+    "fsdp_mesh", "mesh_axis_size",
     "context_parallel_attention",
     "llama_param_specs", "llama_param_shardings", "batch_spec",
     "batch_sharding", "shard_params", "replicated", "TrainState",

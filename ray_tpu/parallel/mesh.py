@@ -17,11 +17,75 @@ map to contiguous ICI neighbours; data/pipe tolerate DCN.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 AXIS_ORDER = ("data", "fsdp", "pipe", "seq", "tensor")
+
+# Env vars whose presence marks a multi-host launch (TPU pod slice /
+# multi-process GPU): a coordinator exists, so the GLOBAL device list is
+# only visible after joining jax.distributed.
+_COORDINATOR_VARS = (
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+)
+
+_distributed_join_attempted = False
+
+
+def _multihost_env() -> bool:
+    return any(os.environ.get(v) for v in _COORDINATOR_VARS)
+
+
+def _maybe_join_distributed() -> None:
+    """Join the jax.distributed service once, and only when the
+    environment says there is one to join.
+
+    Under a multi-host launch, the local backend alone discovers only
+    this process's chips — `jax.devices()` then reports e.g. 1 of 8
+    devices and every multi-axis mesh request fails its divisibility
+    check (MULTICHIP_r05: `1 devices not divisible by 4`). The fix is
+    ordering: `jax.distributed.initialize()` must run before the first
+    backend touch, after which `jax.devices()` is the global list. On
+    single-host setups (no coordinator vars) this is a no-op — tests
+    and laptops never pay for or hang on an unreachable coordinator.
+    """
+    global _distributed_join_attempted
+    if _distributed_join_attempted:
+        return
+    _distributed_join_attempted = True
+    if not _multihost_env():
+        return
+    import jax
+
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return                      # someone already joined
+    except Exception:
+        pass
+    try:
+        # Coordinator address / process id / num_processes all come from
+        # the environment (jax reads the standard vars itself).
+        jax.distributed.initialize()
+    except Exception:
+        # Best effort: a failed join leaves local-only discovery, and
+        # make_mesh's inventory message reports the process topology so
+        # the failure is diagnosable rather than a bare count mismatch.
+        pass
+
+
+def discover_devices() -> List:
+    """The global accelerator inventory: joins `jax.distributed` first
+    under multi-host launches so the list spans every process's chips,
+    not just the local backend's."""
+    import jax
+
+    _maybe_join_distributed()
+    return list(jax.devices())
 
 
 def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
@@ -32,7 +96,7 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
     """
     import jax
 
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else discover_devices())
     n = len(devices)
 
     def _inventory() -> str:
@@ -42,8 +106,13 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
         listing = ", ".join(str(d) for d in devices[:8])
         if n > 8:
             listing += f", ... ({n - 8} more)"
+        try:
+            topo = (f"; process {jax.process_index()} of "
+                    f"{jax.process_count()}")
+        except Exception:
+            topo = ""
         return (f"discovered {n} device(s) on platform "
-                f"{'/'.join(platforms) or 'none'}: [{listing}]")
+                f"{'/'.join(platforms) or 'none'}: [{listing}]{topo}")
 
     sizes = dict(axes)
     wild = [k for k, v in sizes.items() if v == -1]

@@ -66,6 +66,21 @@ class EngineConfig:
     # happens at the same stop condition single-stepping would hit) and
     # admission latency of one block.
     decode_block: int = 1
+    # KV layout. "dense": one [S] stripe per slot (the PR-1 layout).
+    # "paged": a fixed pool of [kv_block_size]-row blocks shared by all
+    # slots through per-slot block tables (serve/llm/kv_cache.py) —
+    # short requests stop reserving max_seq rows, and the prefix cache
+    # can skip prefill for shared prompt prefixes. Both layouts are
+    # token-exact for greedy decoding and trace the same number of
+    # programs (block tables are data, not shape).
+    kv_layout: str = "dense"
+    # None -> GlobalConfig.serve_kv_block_size (RAY_TPU_-overridable).
+    kv_block_size: Optional[int] = None
+    # Pool size; None -> num_slots * (max_seq_len / kv_block_size), the
+    # dense equivalent (no memory saving, full parity). Undersize it to
+    # oversubscribe HBM: admission queues on exhaustion, never crashes.
+    num_kv_blocks: Optional[int] = None
+    prefix_cache: bool = True       # paged only: prompt-prefix reuse
 
     def __post_init__(self):
         if self.decode_block < 1:
@@ -78,6 +93,42 @@ class EngineConfig:
             raise ValueError(
                 f"largest prefill bucket {b[-1]} exceeds max_seq_len "
                 f"{self.max_seq_len}")
+        if self.kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got "
+                f"{self.kv_layout!r}")
+        if self.kv_block_size is None:
+            from ray_tpu._private.config import GlobalConfig
+
+            object.__setattr__(self, "kv_block_size",
+                               int(GlobalConfig.serve_kv_block_size))
+        if self.kv_layout == "paged":
+            bs = self.kv_block_size
+            if bs < 1:
+                raise ValueError("kv_block_size must be >= 1")
+            if self.max_seq_len % bs:
+                raise ValueError(
+                    f"max_seq_len {self.max_seq_len} must be a multiple "
+                    f"of kv_block_size {bs} (block tables tile the "
+                    f"sequence exactly)")
+            bad = [x for x in b if x % bs]
+            if bad:
+                raise ValueError(
+                    f"prefill buckets {bad} must be multiples of "
+                    f"kv_block_size {bs} (suffix KV scatters whole "
+                    f"blocks)")
+            if self.num_kv_blocks is not None and self.num_kv_blocks < 1:
+                raise ValueError("num_kv_blocks must be >= 1")
+
+    @property
+    def max_blocks_per_slot(self) -> int:
+        return self.max_seq_len // self.kv_block_size
+
+    @property
+    def pool_blocks(self) -> int:
+        if self.num_kv_blocks is not None:
+            return self.num_kv_blocks
+        return self.num_slots * self.max_blocks_per_slot
 
 
 @dataclasses.dataclass
@@ -164,7 +215,7 @@ class LLMEngine:
         import jax.numpy as jnp
         import numpy as np
 
-        from ray_tpu.models.llama import init_kv_cache
+        from ray_tpu.models.llama import init_kv_cache, init_paged_kv_cache
 
         self.params = params
         self.model_config = model_config
@@ -173,7 +224,28 @@ class LLMEngine:
         B = c.num_slots
 
         # Device state (fixed shapes for the engine's whole lifetime).
-        self._cache = init_kv_cache(model_config, B, c.max_seq_len)
+        self._paged = c.kv_layout == "paged"
+        if self._paged:
+            from ray_tpu.serve.llm.kv_cache import (BlockAllocator,
+                                                    PrefixCache)
+
+            self._cache = init_paged_kv_cache(
+                model_config, c.pool_blocks, c.kv_block_size)
+            self._allocator = BlockAllocator(c.pool_blocks,
+                                             c.kv_block_size)
+            self._prefix = (PrefixCache(self._allocator)
+                            if c.prefix_cache else None)
+            # Per-slot block tables (host copy is the truth; the device
+            # sees it as a plain [B, max_blocks] int32 argument — data,
+            # not shape, so tables never retrace anything).
+            self._tables = np.zeros((B, c.max_blocks_per_slot), np.int32)
+            self._slot_blocks: List[List[int]] = [[] for _ in range(B)]
+            self._prefix_seen = {"hits": 0, "misses": 0,
+                                 "hit_tokens": 0, "evictions": 0}
+        else:
+            self._cache = init_kv_cache(model_config, B, c.max_seq_len)
+            self._allocator = None
+            self._prefix = None
         self._tok = jnp.zeros((B,), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
         self._key = jax.random.key(rng_seed)
@@ -200,12 +272,22 @@ class LLMEngine:
         from ray_tpu.observability import serve_metrics, tracked_jit
         from ray_tpu.observability.device import ensure_sampler_registered
 
-        self._jit_tick = tracked_jit(
-            self._tick_fn, name="llm_engine_tick", trace_budget=1,
-            donate_argnums=(1, 2, 3))
-        self._jit_insert = tracked_jit(
-            self._insert_fn, name="llm_engine_insert",
-            trace_budget=len(c.prefill_buckets), donate_argnums=(1, 2, 3))
+        if self._paged:
+            self._jit_tick = tracked_jit(
+                self._tick_fn_paged, name="llm_engine_tick",
+                trace_budget=1, donate_argnums=(1, 3, 4))
+            self._jit_insert = tracked_jit(
+                self._insert_fn_paged, name="llm_engine_insert",
+                trace_budget=len(c.prefill_buckets),
+                donate_argnums=(1, 2, 3))
+        else:
+            self._jit_tick = tracked_jit(
+                self._tick_fn, name="llm_engine_tick", trace_budget=1,
+                donate_argnums=(1, 2, 3))
+            self._jit_insert = tracked_jit(
+                self._insert_fn, name="llm_engine_insert",
+                trace_budget=len(c.prefill_buckets),
+                donate_argnums=(1, 2, 3))
         self._metrics = serve_metrics()
         ensure_sampler_registered()
 
@@ -273,6 +355,86 @@ class LLMEngine:
         pos = pos.at[slot].set(prompt_len)
         return cache, tok, pos, key
 
+    def _tick_fn_paged(self, params, pools, tables, tok, pos, active,
+                       temp, key):
+        """Paged twin of `_tick_fn`: same scan, same sampling, but the
+        KV write/read goes through the block tables (data, so still ONE
+        compiled program regardless of who owns which block)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import decode_step_paged
+
+        S = self.config.max_seq_len
+
+        def body(carry, _):
+            pools, tok, pos, key = carry
+            logits, pools = decode_step_paged(
+                params, pools, tables, tok, pos, self.model_config,
+                active=active)
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, temp, sub)
+            tok = jnp.where(active, nxt, tok)
+            pos = jnp.where(active, jnp.minimum(pos + 1, S - 1), pos)
+            return (pools, tok, pos, key), tok
+
+        (pools, tok, pos, key), toks = jax.lax.scan(
+            body, (pools, tok, pos, key), None,
+            length=self.config.decode_block)
+        return pools, tok, pos, key, toks          # toks: [K, B]
+
+    def _insert_fn_paged(self, params, pools, tok, pos, table_row,
+                         hist_len, padded_suffix, suffix_len,
+                         new_block_ids, slot, temperature, key):
+        """Prefill the (possibly prefix-truncated) suffix of one prompt
+        and scatter its KV into the slot's freshly-allocated blocks.
+
+        The prefix-hit path IS the miss path: ``hist_len`` (dynamic
+        data) tells `prefill_kv_paged` where the suffix starts; a miss
+        is just hist_len = 0 over an all-zero history. One trace per
+        suffix bucket — the only static shapes are ``padded_suffix``
+        [Pb] and ``new_block_ids`` [Pb / block_size], both functions of
+        the bucket — so compile count stays <= len(prefill_buckets).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import lm_head_weight, prefill_kv_paged
+
+        c = self.model_config
+        bs = self.config.kv_block_size
+        L = pools["k"].shape[0]
+        n_kv, hd = pools["k"].shape[3], pools["k"].shape[4]
+        S_pad = self.config.max_blocks_per_slot * bs
+        Pb = padded_suffix.shape[0]
+        # History view: this slot's dense [S_pad] gather. Rows at and
+        # past hist_len are stale — masked inside prefill_kv_paged.
+        hist_k = pools["k"][:, table_row].reshape(L, S_pad, n_kv, hd)
+        hist_v = pools["v"][:, table_row].reshape(L, S_pad, n_kv, hd)
+        hidden, ks, vs = prefill_kv_paged(
+            params, padded_suffix[None], hist_len, hist_k, hist_v, c)
+        # ks/vs: [L, 1, Pb, n_kv, hd] -> whole blocks into the pool at
+        # the slot's new physical ids (padding rows ride along; decode
+        # overwrites each before attending, exactly like the dense path
+        # tolerates stale rows).
+        kb = ks[:, 0].astype(c.dtype).reshape(L, Pb // bs, bs, n_kv, hd)
+        vb = vs[:, 0].astype(c.dtype).reshape(L, Pb // bs, bs, n_kv, hd)
+        pools = {
+            "k": pools["k"].at[:, new_block_ids].set(kb),
+            "v": pools["v"].at[:, new_block_ids].set(vb),
+        }
+        x_last = jax.lax.dynamic_index_in_dim(
+            hidden[0], suffix_len - 1, axis=0, keepdims=False)
+        logits = jax.lax.dot_general(
+            x_last[None], lm_head_weight(params, c),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [1, V]
+        key, sub = jax.random.split(key)
+        first = _sample(logits, temperature[None], sub)[0]
+        tok = tok.at[slot].set(first)
+        pos = pos.at[slot].set(hist_len + suffix_len)
+        return pools, tok, pos, key
+
     # ----------------------------------------------------------- submission
 
     def submit(self, request: Request) -> RequestHandle:
@@ -284,6 +446,19 @@ class LLMEngine:
                 f"prefill bucket {self.config.prefill_buckets[-1]}")
         if request.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
+        if self._paged:
+            # A request the pool can never hold must fail loudly at
+            # submit — queuing it would deadlock admission forever.
+            worst = self._blocks_needed(len(request.prompt),
+                                        request.max_tokens)
+            worst = max(worst,
+                        self._bucket_for(len(request.prompt))
+                        // self.config.kv_block_size)
+            if worst > self.config.pool_blocks:
+                raise ValueError(
+                    f"request needs up to {worst} KV blocks but the "
+                    f"pool only has {self.config.pool_blocks}; raise "
+                    f"num_kv_blocks or lower max_tokens")
         handle = RequestHandle(next(self._ids), request)
         with self._lock:
             self._queue.append(handle)
@@ -301,9 +476,22 @@ class LLMEngine:
                 return b
         raise ValueError(n)  # pre-checked in submit()
 
+    def _blocks_needed(self, prompt_len: int, max_tokens: int) -> int:
+        """Blocks covering every position this request can ever write:
+        prompt + generated tokens + up to decode_block - 1 speculative
+        writes after the stop condition, capped at the sequence limit
+        (positions clamp at S - 1)."""
+        c = self.config
+        top = min(prompt_len + max_tokens + c.decode_block - 1,
+                  c.max_seq_len)
+        return -(-top // c.kv_block_size)
+
     def _admit(self) -> List[int]:
         """Move queued requests into free slots (one prefill each);
-        returns the slots inserted this step."""
+        returns the slots inserted this step. Paged layout: admission
+        additionally needs blocks — on pool exhaustion the request goes
+        BACK to the queue head and admission stops (requests queue,
+        never crash; blocks free as running sequences finish)."""
         import numpy as np
 
         inserted = []
@@ -314,18 +502,24 @@ class LLMEngine:
                 handle = self._queue.popleft()
             slot = self._free.popleft()
             req = handle.request
+            if self._paged and not self._admit_paged(handle, slot):
+                self._free.appendleft(slot)
+                with self._lock:
+                    self._queue.appendleft(handle)
+                break
+            if not self._paged:
+                P = len(req.prompt)
+                bucket = self._bucket_for(P)
+                padded = np.zeros((bucket,), np.int32)
+                padded[:P] = np.asarray(req.prompt, np.int32)
+                self._cache, self._tok, self._pos, self._key = \
+                    self._jit_insert(
+                        self.params, self._cache, self._tok, self._pos,
+                        padded, np.int32(P), np.int32(slot),
+                        np.float32(req.temperature), self._key)
             handle.admitted_at = time.monotonic()
             self._metrics.queue_wait.observe(
                 handle.admitted_at - handle.submitted_at)
-            P = len(req.prompt)
-            bucket = self._bucket_for(P)
-            padded = np.zeros((bucket,), np.int32)
-            padded[:P] = np.asarray(req.prompt, np.int32)
-            self._cache, self._tok, self._pos, self._key = \
-                self._jit_insert(
-                    self.params, self._cache, self._tok, self._pos,
-                    padded, np.int32(P), np.int32(slot),
-                    np.float32(req.temperature), self._key)
             st = self._slots[slot]
             if st.uses:
                 self._slot_reuses += 1
@@ -336,6 +530,72 @@ class LLMEngine:
             self._temp[slot] = req.temperature
             inserted.append(slot)
         return inserted
+
+    def _admit_paged(self, handle: RequestHandle, slot: int) -> bool:
+        """Block accounting + paged insert for one request. Returns
+        False (nothing allocated, nothing inserted) when the pool can't
+        cover it even after evicting cold prefix entries."""
+        import numpy as np
+
+        req = handle.request
+        c = self.config
+        bs = c.kv_block_size
+        P = len(req.prompt)
+        need_total = self._blocks_needed(P, req.max_tokens)
+
+        # Longest cached prefix, capped so the LAST prompt token is
+        # always prefilled (its logits seed the first sampled token).
+        hit_blocks: List[int] = []
+        if self._prefix is not None:
+            hit_blocks = self._prefix.match(req.prompt,
+                                            max_blocks=(P - 1) // bs)
+        # Trim the hit so history + the padded suffix bucket still fit
+        # in the slot's table (a shallow hit on a near-max prompt can
+        # otherwise push the bucket's whole-block scatter past S).
+        while hit_blocks:
+            hl = len(hit_blocks) * bs
+            if hl + self._bucket_for(P - hl) <= c.max_seq_len:
+                break
+            self._allocator.free([hit_blocks.pop()])
+        n_hit = len(hit_blocks)
+        hist_len = n_hit * bs
+        suffix_len = P - hist_len
+        bucket = self._bucket_for(suffix_len)
+        # Fresh blocks: the rest of the sequence, but at least the
+        # whole suffix bucket — its scatter writes full blocks, and
+        # every written block must be owned by this slot.
+        n_new = max(need_total - n_hit, bucket // bs)
+        new_blocks = self._allocator.alloc(n_new)
+        if new_blocks is None and self._prefix is not None:
+            self._prefix.evict(n_new - self._allocator.free_blocks)
+            new_blocks = self._allocator.alloc(n_new)
+        if new_blocks is None:
+            if hit_blocks:
+                self._allocator.free(hit_blocks)
+            return False
+
+        blocks = hit_blocks + new_blocks
+        row = np.zeros((c.max_blocks_per_slot,), np.int32)
+        row[:len(blocks)] = blocks
+        self._tables[slot] = row
+        self._slot_blocks[slot] = blocks
+
+        padded = np.zeros((bucket,), np.int32)
+        padded[:suffix_len] = np.asarray(req.prompt[hist_len:], np.int32)
+        scatter_ids = np.asarray(new_blocks[:bucket // bs], np.int32)
+        self._cache, self._tok, self._pos, self._key = \
+            self._jit_insert(
+                self.params, self._cache, self._tok, self._pos,
+                row, np.int32(hist_len), padded, np.int32(suffix_len),
+                scatter_ids, np.int32(slot),
+                np.float32(req.temperature), self._key)
+        if self._prefix is not None:
+            # Register the prompt's FULL blocks (all rows real) so the
+            # next request sharing this prefix skips their prefill.
+            full = P // bs
+            if full:
+                self._prefix.insert(req.prompt, blocks[:full])
+        return True
 
     def _emit(self, slot: int, token: int) -> None:
         """Record one generated token for `slot`; free the slot when the
@@ -373,6 +633,11 @@ class LLMEngine:
             st.handle = None
             self._active[slot] = False
             self._temp[slot] = 0.0
+            if self._paged and self._slot_blocks[slot]:
+                # Drop this sequence's refs; blocks shared with the
+                # prefix cache (or other sequences) stay resident.
+                self._allocator.free(self._slot_blocks[slot])
+                self._slot_blocks[slot] = []
             self._free.append(slot)
             self._completed += 1
             self._record_finished(handle)
@@ -435,10 +700,17 @@ class LLMEngine:
             self._update_gauges()
             return bool(inserted)
         live = np.nonzero(self._active)[0]
-        self._cache, self._tok, self._pos, self._key, toks = \
-            self._jit_tick(
-                self.params, self._cache, self._tok, self._pos,
-                self._active.copy(), self._temp.copy(), self._key)
+        if self._paged:
+            self._cache, self._tok, self._pos, self._key, toks = \
+                self._jit_tick(
+                    self.params, self._cache, self._tables.copy(),
+                    self._tok, self._pos, self._active.copy(),
+                    self._temp.copy(), self._key)
+        else:
+            self._cache, self._tok, self._pos, self._key, toks = \
+                self._jit_tick(
+                    self.params, self._cache, self._tok, self._pos,
+                    self._active.copy(), self._temp.copy(), self._key)
         toks_host = np.asarray(toks)                # [K, B]
         for slot in live:
             s = int(slot)
@@ -456,6 +728,20 @@ class LLMEngine:
         m.queue_depth.set(float(len(self._queue)))
         m.active_slots.set(float(active))
         m.batch_utilization.set(active / self.config.num_slots)
+        if self._paged:
+            m.kv_blocks_used.set(float(self._allocator.used_blocks))
+            m.kv_blocks_free.set(float(self._allocator.free_blocks))
+            if self._prefix is not None:
+                cur = self._prefix.stats()
+                seen = self._prefix_seen
+                for field, ctr in (("hits", m.prefix_hits),
+                                   ("misses", m.prefix_misses),
+                                   ("hit_tokens", m.prefix_hit_tokens),
+                                   ("evictions", m.prefix_evictions)):
+                    d = cur[field] - seen[field]
+                    if d > 0:
+                        ctr.inc(float(d))
+                        seen[field] = cur[field]
 
     def run(self, stop_event: threading.Event,
             idle_wait_s: float = 0.02) -> None:
@@ -475,6 +761,25 @@ class LLMEngine:
                 raise TimeoutError("engine did not drain")
             self.step()
 
+    def warmup(self) -> None:
+        """Compile every program the engine can run — the decode tick
+        plus one insert per prefill bucket — before real traffic. The
+        paged layout bypasses the prefix cache while warming: a warm
+        hit shrinks the padded suffix to a SMALLER bucket, leaving the
+        larger bucket's insert uncompiled until a cache-miss request
+        pays the compile inside its own latency. Synchronous; call
+        before starting a run() thread."""
+        prefix, self._prefix = self._prefix, None
+        try:
+            # max_tokens=2: a 1-token request finishes AT insert and the
+            # decode tick would never trace.
+            handles = [self.submit(Request(prompt=[1] * b, max_tokens=2))
+                       for b in self.config.prefill_buckets]
+            while any(h.finished_at is None for h in handles):
+                self.step()
+        finally:
+            self._prefix = prefix
+
     # ------------------------------------------------------------ inspection
 
     @property
@@ -484,16 +789,27 @@ class LLMEngine:
         return self._jit_tick.traces + self._jit_insert.traces
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "num_slots": self.config.num_slots,
             "active_slots": int(self._active.sum()),
             "queued": len(self._queue),
             "completed": self._completed,
             "slot_reuses": self._slot_reuses,
+            "kv_layout": self.config.kv_layout,
             "traces": {"tick": self._jit_tick.traces,
                        "insert": self._jit_insert.traces},
             "trace_count": self.trace_count,
         }
+        if self._paged:
+            out["kv"] = {
+                "num_blocks": self.config.pool_blocks,
+                "block_size": self.config.kv_block_size,
+                "used_blocks": self._allocator.used_blocks,
+                "free_blocks": self._allocator.free_blocks,
+            }
+            if self._prefix is not None:
+                out["prefix_cache"] = self._prefix.stats()
+        return out
 
 
 def _sample(logits, temp, key):

@@ -1,0 +1,269 @@
+"""Paged KV-cache bookkeeping: block allocator + token-prefix cache.
+
+The HBM side lives in :mod:`ray_tpu.models.llama` (`init_paged_kv_cache`
+allocates one fixed pool of ``[block_size]``-row KV blocks per layer;
+`decode_step_paged` / `prefill_kv_paged` read and write it through
+per-sequence *block tables*). This module is the host side: which
+physical block belongs to whom, and which prompt prefixes are already
+resident so admission can skip their prefill entirely.
+
+Two pieces, both pure host-Python (no jax imports — unit-testable
+without a device):
+
+- :class:`BlockAllocator` — a fixed pool of ``num_blocks`` block ids
+  with per-block reference counts. ``alloc`` hands out free ids or
+  reports exhaustion (the engine *queues* the request — never crashes);
+  ``incref``/``free`` implement copy-on-write sharing: a block reaching
+  refcount 0 returns to the free list, a shared block stays resident
+  until its last reader releases it. ``copy_on_write`` gives a private
+  copy id for a shared block about to be mutated (the engine's sharing
+  is block-aligned — only *full* prompt blocks are ever shared, and
+  sequences write strictly past them — so the engine never triggers the
+  copy; the primitive is here, and tested, for sub-block sharing).
+
+- :class:`PrefixCache` — RadixAttention-style reuse keyed on the hash
+  of the token prefix at every block boundary (a hash chain rather than
+  a radix tree: block-granular lookups need only exact block-boundary
+  matches). ``match`` walks the chain and increfs every hit block for
+  the caller; ``insert`` registers a finished prompt's full blocks,
+  taking cache-owned refs so blocks outlive the sequence that produced
+  them; LRU eviction frees the coldest tails when the allocator runs
+  dry (vLLM: "Efficient Memory Management for LLM Serving with
+  PagedAttention"; SGLang: RadixAttention).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["BlockAllocator", "PrefixCache", "hash_prefix"]
+
+
+def hash_prefix(tokens: Sequence[int]) -> int:
+    """Stable key for a token prefix. Python's tuple hash is salted per
+    process (PYTHONHASHSEED) which is fine — keys never cross processes;
+    each replica owns its pool, so its cache is process-local too."""
+    return hash(tuple(tokens))
+
+
+class BlockAllocator:
+    """Fixed pool of ``num_blocks`` KV blocks with refcounts.
+
+    Thread-safe: the engine's scheduler thread allocates while the
+    dashboard thread reads stats. All ops are O(1) amortized.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError(
+                f"need positive num_blocks/block_size, got "
+                f"{num_blocks}/{block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: deque = deque(range(num_blocks))
+        self._refs: List[int] = [0] * num_blocks
+        self._lock = threading.Lock()
+
+    # -- core ------------------------------------------------------------
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh blocks at refcount 1, or None if the pool can't cover
+        it (caller queues / evicts; nothing is partially allocated)."""
+        with self._lock:
+            if n > len(self._free):
+                return None
+            out = [self._free.popleft() for _ in range(n)]
+            for b in out:
+                self._refs[b] = 1
+            return out
+
+    def incref(self, blocks: Sequence[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                if self._refs[b] <= 0:
+                    raise ValueError(f"incref on free block {b}")
+                self._refs[b] += 1
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per id; blocks hitting 0 rejoin the pool."""
+        with self._lock:
+            for b in blocks:
+                r = self._refs[b] - 1
+                if r < 0:
+                    raise ValueError(f"double free of block {b}")
+                self._refs[b] = r
+                if r == 0:
+                    self._free.append(b)
+
+    def fork(self, blocks: Sequence[int]) -> List[int]:
+        """Share an existing table (copy-on-write fork): the child holds
+        the same physical ids, each with one more reference."""
+        self.incref(blocks)
+        return list(blocks)
+
+    def copy_on_write(self, block: int) -> Tuple[int, bool]:
+        """Prepare ``block`` for mutation. Uniquely-owned blocks are
+        returned as-is; shared ones release one ref and return a fresh
+        private id (returns (id, needs_copy) — the caller must copy the
+        HBM rows when needs_copy). None is never returned: raises on
+        exhaustion so callers treat COW pressure as a hard signal."""
+        with self._lock:
+            if self._refs[block] <= 0:
+                raise ValueError(f"copy_on_write on free block {block}")
+            if self._refs[block] == 1:
+                return block, False
+            if not self._free:
+                raise MemoryError(
+                    "copy_on_write: pool exhausted (free a sequence or "
+                    "evict prefix-cache entries first)")
+            new = self._free.popleft()
+            self._refs[new] = 1
+            self._refs[block] -= 1
+            return new, True
+
+    # -- introspection ---------------------------------------------------
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._refs[block]
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - self.free_blocks
+
+
+@dataclass
+class _Entry:
+    """One full block of one cached prefix: the chain link at block
+    boundary ``depth`` (prefix length = depth * block_size)."""
+    block: int
+    depth: int
+
+
+class PrefixCache:
+    """Block-granular prompt-prefix reuse over a :class:`BlockAllocator`.
+
+    Entries are keyed ``hash(tokens[: j * block_size])`` for j = 1..;
+    each holds exactly one cache-owned reference on one block. ``match``
+    walks j upward until the first miss — the hit blocks cover positions
+    ``[0, hits * block_size)`` and arrive *increffed for the caller*
+    (the engine later frees them with the rest of the sequence's table,
+    no special-casing). Eviction pops least-recently-matched entries;
+    an entry's block only truly returns to the pool once every sequence
+    still reading it has also released it — refcounts make eviction safe
+    mid-flight.
+    """
+
+    def __init__(self, allocator: BlockAllocator,
+                 max_blocks: Optional[int] = None):
+        self.allocator = allocator
+        self.max_blocks = (allocator.num_blocks if max_blocks is None
+                           else max_blocks)
+        self._entries: "OrderedDict[int, _Entry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0            # match() calls that found >= 1 block
+        self.misses = 0
+        self.hit_tokens = 0      # positions whose prefill was skipped
+        self.evictions = 0       # entries evicted (≈ blocks released)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # -- lookup ----------------------------------------------------------
+    def match(self, tokens: Sequence[int],
+              max_blocks: Optional[int] = None) -> List[int]:
+        """Longest cached block-chain covering a prefix of ``tokens``.
+
+        Returns the physical block ids (may be empty), each increffed on
+        behalf of the caller. ``max_blocks`` caps the hit (the engine
+        passes ``(len(prompt) - 1) // block_size`` so at least the last
+        prompt token is always prefilled — its logits seed sampling)."""
+        bs = self.allocator.block_size
+        limit = len(tokens) // bs
+        if max_blocks is not None:
+            limit = min(limit, max_blocks)
+        out: List[int] = []
+        with self._lock:
+            for j in range(1, limit + 1):
+                e = self._entries.get(hash_prefix(tokens[: j * bs]))
+                if e is None or e.depth != j:
+                    break
+                out.append(e.block)
+                self._entries.move_to_end(hash_prefix(tokens[: j * bs]))
+            if out:
+                self.hits += 1
+                self.hit_tokens += len(out) * bs
+            else:
+                self.misses += 1
+        if out:
+            self.allocator.incref(out)
+        return out
+
+    # -- registration ----------------------------------------------------
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> None:
+        """Register a prompt's resident full blocks. ``blocks[j]`` must
+        hold the KV rows for positions ``[j*bs, (j+1)*bs)`` of
+        ``tokens``. Already-cached depths are skipped (the shared block
+        is already registered); new depths take one cache-owned ref."""
+        bs = self.allocator.block_size
+        n = min(len(tokens) // bs, len(blocks))
+        fresh: List[Tuple[int, _Entry]] = []
+        with self._lock:
+            for j in range(1, n + 1):
+                key = hash_prefix(tokens[: j * bs])
+                if key in self._entries:
+                    self._entries.move_to_end(key)
+                    continue
+                fresh.append((key, _Entry(block=blocks[j - 1], depth=j)))
+        if not fresh:
+            return
+        self.allocator.incref([e.block for _, e in fresh])
+        with self._lock:
+            for key, e in fresh:
+                if key in self._entries:       # lost a race: drop our ref
+                    self.allocator.free([e.block])
+                    continue
+                self._entries[key] = e
+            overflow = len(self._entries) - self.max_blocks
+        if overflow > 0:
+            self.evict(overflow)
+
+    # -- eviction --------------------------------------------------------
+    def evict(self, n_blocks: int) -> int:
+        """Release the ``n_blocks`` least-recently-matched entries'
+        cache refs (deepest-first within equal recency, so a chain's
+        tail goes before its root and surviving prefixes stay usable).
+        Returns how many refs were dropped; the pool only grows by the
+        blocks nobody else still reads."""
+        victims: List[int] = []
+        with self._lock:
+            # LRU order with chain-tail preference: scan from coldest,
+            # take deepest entries first among the same prefix family.
+            while len(victims) < n_blocks and self._entries:
+                # coldest key
+                key = next(iter(self._entries))
+                e = self._entries.pop(key)
+                victims.append(e.block)
+                self.evictions += 1
+        if victims:
+            self.allocator.free(victims)
+        return len(victims)
+
+    def clear(self) -> None:
+        self.evict(len(self._entries))
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_tokens": self.hit_tokens,
+                "evictions": self.evictions,
+            }

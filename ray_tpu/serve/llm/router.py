@@ -1,0 +1,261 @@
+"""LLM request router — queue-depth-aware spreading across replicas.
+
+The generic handle router (serve/_private/router.py) balances on its own
+*local* in-flight counts: enough when every caller owns a private view
+of load, blind when load hides inside the replicas — an LLM replica
+admits requests into its engine queue, so two replicas can report equal
+in-flight counts while one sits on a deep prefill backlog. This router
+is a *deployment* in front of N ``LLMServer`` replicas that closes that
+gap:
+
+- a probe thread samples every replica's engine (`LLMServer.load`) on a
+  short period, capturing queued + active work the handle layer cannot
+  see (exported as ``rtpu_serve_router_queue_depth{replica=...}``);
+- request assignment is power-of-two-choices over (local in-flight +
+  probed engine depth), so a stalled or backlogged replica sheds
+  traffic within one probe period instead of one long-poll;
+- the router pushes its total in-flight to the controller
+  (`record_handle_metrics`) exactly like a handle does, so the PR-7
+  ``AutoscalePolicy`` inflight law — and its queue-wait/utilization
+  signals from the replicas' own gauges — keep steering replica count
+  with no new plumbing.
+
+``build_routed_llm_app`` composes Router(LLM): the inner LLM deployment
+scales (fixed N or ``num_replicas="auto"`` via autoscaling_config), the
+router stays a single cheap replica.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["LLMRouter", "build_routed_llm_app", "p2c_pick"]
+
+
+def p2c_pick(replicas: Sequence[Any], load: Dict[Any, float],
+             rng: Optional[random.Random] = None) -> Any:
+    """Power-of-two-choices over an explicit load view: sample two
+    distinct replicas, keep the lighter one. Pure — the routing policy
+    under test, separated from the actor plumbing."""
+    if not replicas:
+        raise RuntimeError("no replicas to pick from")
+    if len(replicas) == 1:
+        return replicas[0]
+    rng = rng or random
+    a, b = rng.sample(list(replicas), 2)
+    return a if load.get(a, 0.0) <= load.get(b, 0.0) else b
+
+
+class LLMRouter:
+    """Deployment callable fronting the ``LLMServer`` deployment.
+
+    Constructed via composition — ``build_routed_llm_app`` binds the
+    inner LLM app as an init argument, which Serve rehydrates into a
+    :class:`~ray_tpu.serve.handle.DeploymentHandle` inside the router
+    replica. The router reads the handle's target coordinates and talks
+    to the replica set directly (same controller surface the generic
+    router uses), because per-replica probing needs replica identity,
+    which the handle layer abstracts away.
+    """
+
+    def __init__(self, llm_handle: Any,
+                 probe_interval_s: Optional[float] = None):
+        from ray_tpu._private.config import GlobalConfig
+        from ray_tpu.observability import serve_metrics
+
+        self._app = llm_handle._app
+        self._deployment = llm_handle._deployment
+        self._probe_interval = (
+            GlobalConfig.serve_router_probe_interval_s
+            if probe_interval_s is None else probe_interval_s)
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._inflight: Dict[Any, int] = {}
+        self._depth: Dict[Any, float] = {}     # probed engine depth
+        self._routed: Dict[str, int] = {}      # per-replica forward count
+        self._lock = threading.Lock()
+        self._closed = False
+        self._metrics = serve_metrics()
+        import uuid
+
+        self._router_id = uuid.uuid4().hex[:12]
+
+        from ray_tpu.serve._private.controller import (
+            get_or_create_controller,
+        )
+        import ray_tpu
+
+        self._controller = get_or_create_controller()
+        version, replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self._app,
+                                                 self._deployment),
+            timeout=60)
+        self._apply(version, replicas)
+        for target, name in ((self._poll_loop, "llm-router-poll"),
+                             (self._probe_loop, "llm-router-probe"),
+                             (self._push_loop, "llm-router-push")):
+            threading.Thread(target=target, daemon=True,
+                             name=name).start()
+
+    # ------------------------------------------------------------- replica set
+    def _apply(self, version: int, replicas: List[Any]) -> None:
+        with self._lock:
+            if version != self._version:
+                self._version = version
+                self._replicas = replicas
+                self._inflight = {r: self._inflight.get(r, 0)
+                                  for r in replicas}
+                self._depth = {r: self._depth.get(r, 0.0)
+                               for r in replicas}
+
+    def _poll_loop(self) -> None:
+        import ray_tpu
+
+        while not self._closed:
+            try:
+                version, replicas = ray_tpu.get(
+                    self._controller.poll_replicas.remote(
+                        self._app, self._deployment, self._version, 25.0),
+                    timeout=60)
+                self._apply(version, replicas)
+            except Exception:
+                if self._closed:
+                    return
+                time.sleep(1.0)
+
+    # ------------------------------------------------------------- probing
+    def _probe_loop(self) -> None:
+        import ray_tpu
+
+        while not self._closed:
+            with self._lock:
+                replicas = list(self._replicas)
+            for r in replicas:
+                try:
+                    load = ray_tpu.get(
+                        r.handle_request.remote("load", (), {}),
+                        timeout=min(5.0, self._probe_interval * 5))
+                    depth = float(load.get("queued", 0)
+                                  + load.get("active_slots", 0))
+                except Exception:
+                    # Unreachable/stalled replica: poison its score so
+                    # traffic shifts away until it answers again.
+                    depth = float("inf")
+                with self._lock:
+                    if r in self._depth:
+                        self._depth[r] = depth
+                rid = getattr(r, "_actor_id", id(r))
+                if depth != float("inf"):
+                    self._metrics.router_queue_depth.set(
+                        depth, tags={"replica": str(rid)})
+            time.sleep(self._probe_interval)
+
+    def _push_loop(self) -> None:
+        """Handle-metrics push: the autoscaler's inflight law sees the
+        router's total exactly as it would a plain handle's."""
+        while not self._closed:
+            time.sleep(2.0)
+            with self._lock:
+                total = sum(self._inflight.values())
+            try:
+                self._controller.record_handle_metrics.remote(
+                    self._app, self._deployment, self._router_id, total)
+            except Exception:
+                return
+
+    # ------------------------------------------------------------- routing
+    def _score(self) -> Tuple[List[Any], Dict[Any, float]]:
+        with self._lock:
+            replicas = list(self._replicas)
+            load = {r: self._inflight.get(r, 0) + self._depth.get(r, 0.0)
+                    for r in replicas}
+        return replicas, load
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        import ray_tpu
+
+        deadline = time.monotonic() + 30.0
+        replicas, load = self._score()
+        while not replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no live replicas for {self._app}/{self._deployment}")
+            time.sleep(0.05)
+            replicas, load = self._score()
+        chosen = p2c_pick(replicas, load)
+        rid = str(getattr(chosen, "_actor_id", id(chosen)))
+        with self._lock:
+            self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
+            self._routed[rid] = self._routed.get(rid, 0) + 1
+        self._metrics.router_requests.inc(tags={"replica": rid})
+        try:
+            return ray_tpu.get(
+                chosen.handle_request.remote("__call__", (request,), {}),
+                timeout=float(request.get("timeout_s", 300.0)))
+        finally:
+            with self._lock:
+                if chosen in self._inflight:
+                    self._inflight[chosen] -= 1
+
+    # ------------------------------------------------------------- inspection
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "replicas": len(self._replicas),
+                "inflight": sum(self._inflight.values()),
+                "routed": dict(self._routed),
+                "depth": {str(getattr(r, "_actor_id", id(r))): d
+                          for r, d in self._depth.items()},
+            }
+
+    def check_health(self) -> None:
+        if self._closed:
+            raise RuntimeError("router closed")
+
+    def __del__(self):
+        try:
+            self._closed = True
+        except Exception:
+            pass
+
+
+def build_routed_llm_app(model_config: Any = None,
+                         engine_config: Any = None, *,
+                         name: str = "llm",
+                         num_replicas: Any = 2,
+                         autoscaling_config: Optional[Dict[str, Any]] = None,
+                         num_tpus: float = 0,
+                         max_ongoing_requests: int = 32,
+                         init_seed: int = 0,
+                         quantize: Optional[str] = None,
+                         params_loader: Optional[Any] = None,
+                         probe_interval_s: Optional[float] = None):
+    """Router(LLM) composition: N engine replicas behind one
+    queue-depth-aware router. ``num_replicas`` may be an int or
+    ``"auto"`` (with ``autoscaling_config``) — the PR-7 autoscaler then
+    drives the inner deployment while the router re-discovers the
+    replica set through its controller poll."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm.deployment import LLMServer, _plain
+
+    llm_kwargs: Dict[str, Any] = dict(
+        name=name, num_tpus=num_tpus,
+        max_ongoing_requests=max_ongoing_requests)
+    if num_replicas == "auto" or autoscaling_config is not None:
+        llm_kwargs["num_replicas"] = num_replicas
+        if autoscaling_config is not None:
+            llm_kwargs["autoscaling_config"] = autoscaling_config
+    else:
+        llm_kwargs["num_replicas"] = int(num_replicas)
+    llm_dep = serve.deployment(LLMServer, **llm_kwargs)
+    llm_app = llm_dep.bind(model_config=_plain(model_config),
+                           engine_config=_plain(engine_config),
+                           init_seed=init_seed, quantize=quantize,
+                           params_loader=params_loader)
+    router_dep = serve.deployment(
+        LLMRouter, name=f"{name}-router", num_replicas=1,
+        max_ongoing_requests=max(64, max_ongoing_requests * 4))
+    return router_dep.bind(llm_app, probe_interval_s=probe_interval_s)

@@ -1,0 +1,144 @@
+"""Paged KV-cache bookkeeping (serve/llm/kv_cache.py): the fixed-pool
+block allocator (alloc/free, copy-on-write refcounts, exhaustion) and
+the prefix cache (hit/miss accounting, LRU eviction, block ownership).
+
+Pure host-side data structures — no JAX, no model; everything here runs
+in milliseconds.
+"""
+
+import pytest
+
+from ray_tpu.serve.llm.kv_cache import (
+    BlockAllocator, PrefixCache, hash_prefix,
+)
+
+
+class TestBlockAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = BlockAllocator(num_blocks=8, block_size=4)
+        assert a.free_blocks == 8 and a.used_blocks == 0
+        blocks = a.alloc(3)
+        assert blocks is not None and len(set(blocks)) == 3
+        assert all(0 <= b < 8 for b in blocks)
+        assert a.free_blocks == 5 and a.used_blocks == 3
+        assert all(a.refcount(b) == 1 for b in blocks)
+        a.free(blocks)
+        assert a.free_blocks == 8 and a.used_blocks == 0
+
+    def test_alloc_is_all_or_nothing(self):
+        a = BlockAllocator(num_blocks=4, block_size=4)
+        held = a.alloc(3)
+        assert a.alloc(2) is None            # only 1 left: nothing taken
+        assert a.free_blocks == 1
+        assert a.alloc(1) is not None        # the remainder still works
+        a.free(held)
+
+    def test_refcount_free_decrements_before_releasing(self):
+        a = BlockAllocator(num_blocks=4, block_size=4)
+        (b,) = a.alloc(1)
+        a.incref([b])
+        assert a.refcount(b) == 2
+        a.free([b])                          # 2 -> 1: still allocated
+        assert a.refcount(b) == 1 and a.used_blocks == 1
+        a.free([b])                          # 1 -> 0: back in the pool
+        assert a.used_blocks == 0
+
+    def test_double_free_raises(self):
+        a = BlockAllocator(num_blocks=4, block_size=4)
+        (b,) = a.alloc(1)
+        a.free([b])
+        with pytest.raises(ValueError):
+            a.free([b])
+
+    def test_fork_shares_blocks(self):
+        a = BlockAllocator(num_blocks=8, block_size=4)
+        blocks = a.alloc(3)
+        child = a.fork(blocks)
+        assert child == blocks               # same physical blocks
+        assert all(a.refcount(b) == 2 for b in blocks)
+        a.free(child)
+        assert all(a.refcount(b) == 1 for b in blocks)
+
+    def test_copy_on_write(self):
+        a = BlockAllocator(num_blocks=8, block_size=4)
+        (b,) = a.alloc(1)
+        # Sole owner: write in place, no copy.
+        nb, needs_copy = a.copy_on_write(b)
+        assert nb == b and not needs_copy
+        # Shared: writer gets a fresh block, sharer keeps the old one.
+        a.incref([b])
+        nb, needs_copy = a.copy_on_write(b)
+        assert nb != b and needs_copy
+        assert a.refcount(b) == 1 and a.refcount(nb) == 1
+
+    def test_copy_on_write_exhaustion_raises(self):
+        a = BlockAllocator(num_blocks=1, block_size=4)
+        (b,) = a.alloc(1)
+        a.incref([b])
+        with pytest.raises(MemoryError):
+            a.copy_on_write(b)               # shared, but pool is empty
+
+
+class TestPrefixCache:
+    def _setup(self, num_blocks=16, bs=4, max_blocks=None):
+        a = BlockAllocator(num_blocks=num_blocks, block_size=bs)
+        return a, PrefixCache(a, max_blocks=max_blocks)
+
+    def test_hash_prefix_is_deterministic(self):
+        assert hash_prefix([1, 2, 3]) == hash_prefix((1, 2, 3))
+        assert hash_prefix([1, 2, 3]) != hash_prefix([1, 2, 4])
+
+    def test_miss_then_hit(self):
+        a, pc = self._setup()
+        tokens = list(range(12))             # 3 full blocks
+        assert pc.match(tokens) == []
+        blocks = a.alloc(3)
+        pc.insert(tokens, blocks)
+        hit = pc.match(tokens)
+        assert hit == blocks                 # deepest chain, in order
+        st = pc.stats()
+        assert st["hits"] >= 1 and st["misses"] >= 1
+        assert st["hit_tokens"] == 12
+        # The hit incref'd for the caller: cache ref + caller ref.
+        assert all(a.refcount(b) == 3 for b in blocks)
+
+    def test_partial_prefix_hit_and_cap(self):
+        a, pc = self._setup()
+        tokens = list(range(12))
+        blocks = a.alloc(3)
+        pc.insert(tokens, blocks)
+        # A longer prompt sharing the first 8 tokens hits 2 blocks.
+        hit = pc.match(tokens[:8] + [99, 98, 97, 96])
+        assert hit == blocks[:2]
+        a.free(hit)
+        # max_blocks caps the walk depth.
+        hit = pc.match(tokens, max_blocks=1)
+        assert hit == blocks[:1]
+        a.free(hit)
+
+    def test_lru_eviction_frees_blocks(self):
+        a, pc = self._setup(num_blocks=16, max_blocks=2)
+        t1, t2 = list(range(8)), list(range(100, 108))
+        b1, b2 = a.alloc(2), a.alloc(2)
+        pc.insert(t1, b1)
+        pc.insert(t2, b2)                    # overflow: t1 is coldest
+        assert pc.stats()["evictions"] == 2
+        assert pc.match(t1) == []            # evicted
+        hit = pc.match(t2)
+        assert hit == b2                     # survivor intact
+        a.free(hit)
+        # Engine refs remain: eviction dropped only the CACHE's refs.
+        assert all(a.refcount(b) == 1 for b in b1)
+
+    def test_explicit_evict_and_clear(self):
+        a, pc = self._setup()
+        used_before = a.used_blocks
+        blocks = a.alloc(2)
+        pc.insert(list(range(8)), blocks)
+        a.free(blocks)                       # engine done; cache holds on
+        assert a.used_blocks == used_before + 2
+        pc.evict(1)
+        assert a.used_blocks == used_before + 1
+        pc.clear()
+        assert a.used_blocks == used_before
+        assert pc.stats()["entries"] == 0

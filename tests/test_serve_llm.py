@@ -222,10 +222,175 @@ def test_submit_validation():
         engine.submit(Request(prompt=[1], max_tokens=0))
 
 
+# --------------------------------------------------------------- paged KV
+
+
+def _shared_paged():
+    """Paged-layout engine shared by the paged parity/prefix tests
+    (every extra engine instance re-jits its tick + insert buckets)."""
+    if "engine_paged" not in _CACHE:
+        _CACHE["engine_paged"] = _engine(
+            slots=3, kv_layout="paged", kv_block_size=4)
+    return _CACHE["engine_paged"]
+
+
+def test_paged_greedy_parity_and_compile_count():
+    """Paged attention (block tables + pool gather) is token-exact
+    against the dense static reference for mixed lengths, inside the
+    same compile budget: n_prefill_buckets + 1 programs."""
+    from ray_tpu.serve.llm.engine import Request
+
+    engine = _shared_paged()
+    specs = _specs(0, _PARITY_PAIRS)
+    handles = [engine.submit(Request(prompt=p, max_tokens=n))
+               for p, n in specs]
+    engine.drain()
+    for (p, n), h in zip(specs, handles):
+        assert h.finish_reason == "length"
+        assert h.tokens == _reference(p, n), (p, n)
+    assert engine.trace_count <= len(engine.config.prefill_buckets) + 1, \
+        engine.stats()
+
+
+def test_paged_prefix_hit_skips_prefill_and_keeps_parity():
+    """A second request sharing a block-aligned prompt prefix hits the
+    prefix cache — its cached blocks skip prefill — and the output is
+    still token-identical to the full static path."""
+    from ray_tpu.serve.llm.engine import Request
+
+    config, _ = _model()
+    engine = _shared_paged()
+    rng = np.random.RandomState(7)
+    sys_p = rng.randint(0, config.vocab_size, 8).tolist()
+    p1 = sys_p + rng.randint(0, config.vocab_size, 4).tolist()
+    p2 = sys_p + rng.randint(0, config.vocab_size, 5).tolist()
+    before = engine.stats()["prefix_cache"]
+    h1 = engine.submit(Request(prompt=p1, max_tokens=4))
+    engine.drain()                           # p1's blocks now cached
+    h2 = engine.submit(Request(prompt=p2, max_tokens=4))
+    engine.drain()
+    after = engine.stats()["prefix_cache"]
+    assert h1.tokens == _reference(p1, 4)
+    assert h2.tokens == _reference(p2, 4)
+    assert after["hits"] >= before["hits"] + 1
+    assert after["hit_tokens"] >= before["hit_tokens"] + len(sys_p)
+
+
+def test_paged_pool_exhaustion_queues_not_crash():
+    """Block demand beyond the pool: admission parks requests in the
+    queue and completes them as finishing sequences free blocks; only a
+    request that can NEVER fit is rejected, at submit time."""
+    from ray_tpu.serve.llm.engine import Request
+
+    config, _ = _model()
+    engine = _engine(slots=4, buckets=(8,), S=32, kv_layout="paged",
+                     kv_block_size=4, num_kv_blocks=6,
+                     prefix_cache=False)
+    with pytest.raises(ValueError):          # worst case 8 blocks > 6
+        engine.submit(Request(prompt=[1] * 8, max_tokens=32))
+    rng = np.random.RandomState(5)
+    handles = [engine.submit(Request(
+        prompt=rng.randint(0, config.vocab_size, 8).tolist(),
+        max_tokens=4)) for _ in range(5)]    # 3 blocks each, pool of 6
+    engine.step()
+    st = engine.stats()
+    assert st["queued"] >= 1                 # exhaustion queued, no crash
+    assert st["kv"]["used_blocks"] <= 6
+    engine.drain()
+    assert all(h.done() and len(h.tokens) == 4 for h in handles)
+    assert engine.stats()["kv"]["used_blocks"] == 0
+
+
+def test_llm_server_quantize_default_and_optout():
+    """The serve config defaults to weight-only int8 decode (BENCH_r05:
+    1.28x decode throughput); "bf16" opts out; anything else is
+    rejected before weights load."""
+    from ray_tpu.serve.llm.deployment import LLMServer
+
+    config, _ = _model()
+    econf = {"num_slots": 2, "max_seq_len": 32, "prefill_buckets": (8,)}
+    srv = LLMServer(model_config=config, engine_config=econf)
+    assert srv.quantize == "int8"
+    assert srv.stats()["quantize"] == "int8"
+    assert set(srv.load()) == {"queued", "active_slots", "free_slots"}
+    srv_bf16 = LLMServer(model_config=config, engine_config=econf,
+                         quantize="bf16")
+    assert srv_bf16.quantize == "bf16"
+    with pytest.raises(ValueError):
+        LLMServer(model_config=config, engine_config=econf,
+                  quantize="fp4")
+
+
+# ----------------------------------------------------------------- router
+
+
+def test_p2c_pick_prefers_light_replicas():
+    import random as _random
+
+    from ray_tpu.serve.llm.router import p2c_pick
+
+    rng = _random.Random(0)
+    load = {"light": 0.0, "heavy": 5.0}
+    picks = [p2c_pick(["light", "heavy"], load, rng) for _ in range(40)]
+    assert picks.count("light") == 40        # 2 replicas: always compared
+
+
+def test_router_stalled_replica_sheds_traffic():
+    """A replica whose load probe fails scores float('inf'), so p2c
+    assignment shifts all traffic to the live replica."""
+    import random as _random
+    import threading
+
+    from ray_tpu.serve.llm.router import LLMRouter, p2c_pick
+
+    r = LLMRouter.__new__(LLMRouter)         # policy only: no controller
+    r._lock = threading.Lock()
+    r._replicas = ["live", "stalled"]
+    r._inflight = {"live": 3, "stalled": 0}
+    r._depth = {"live": 2.0, "stalled": float("inf")}
+    replicas, load = r._score()
+    assert load["stalled"] == float("inf")
+    rng = _random.Random(1)
+    assert all(p2c_pick(replicas, load, rng) == "live"
+               for _ in range(25))
+
+
+def test_routed_llm_two_replicas_smoke(ray_start_regular):
+    """Router over two LLM replicas: results match the static
+    reference and traffic spreads across both replicas."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_routed_llm_app
+
+    config, _ = _model()
+    try:
+        handle = serve.run(build_routed_llm_app(
+            model_config=config,
+            engine_config={"num_slots": 2, "max_seq_len": 64,
+                           "prefill_buckets": (8, 16)},
+            num_replicas=2, quantize="bf16", max_ongoing_requests=8,
+            probe_interval_s=0.1), name="llm-routed")
+        rng = np.random.RandomState(4)       # same trace as the plain
+        prompts = [rng.randint(0, config.vocab_size,  # smoke: refs cached
+                               rng.randint(2, 16)).tolist()
+                   for _ in range(6)]
+        resps = [handle.remote({"prompt": p, "max_tokens": 4})
+                 for p in prompts]
+        for p, r in zip(prompts, resps):
+            out = r.result(timeout=120)
+            assert out["tokens"] == _reference(p, 4)
+        st = handle.stats.remote().result(timeout=60)
+        assert st["replicas"] == 2
+        assert sum(st["routed"].values()) == len(prompts)
+        assert len(st["routed"]) == 2        # both replicas took traffic
+    finally:
+        serve.shutdown()
+
+
 def test_serve_llm_deployment_smoke(ray_start_regular):
     """Fast tier-1 smoke: the engine behind a Serve deployment (tiny
     config, 4 slots, 2 buckets); concurrent handle calls return the
-    same tokens as the static reference."""
+    same tokens as the static reference. quantize="bf16" keeps
+    bit-parity with the bf16 reference (int8 is the serve default)."""
     from ray_tpu import serve
     from ray_tpu.serve.llm import build_llm_app
 
@@ -235,7 +400,8 @@ def test_serve_llm_deployment_smoke(ray_start_regular):
             model_config=config,
             engine_config={"num_slots": 4, "max_seq_len": 64,
                            "prefill_buckets": (8, 16)},
-            init_seed=0, max_ongoing_requests=8), name="llm")
+            init_seed=0, quantize="bf16", max_ongoing_requests=8),
+            name="llm")
         rng = np.random.RandomState(4)
         prompts = [rng.randint(0, config.vocab_size,
                                rng.randint(2, 16)).tolist()
@@ -264,3 +430,20 @@ def test_serve_throughput_bench_smoke():
     assert d["static_tokens_per_sec"] > 0
     assert d["ttft_p50_ms"] >= 0 and d["ttft_p99_ms"] >= d["ttft_p50_ms"]
     assert d["requests"] == d["completed"]
+
+
+@pytest.mark.slow
+def test_serve_paged_bench_smoke():
+    """The bench.py paged/router workload end to end on CPU (slow tier:
+    dense-vs-paged parity load, prefix TTFT, simulated-device replica
+    scaling)."""
+    from bench import _bench_serve_paged
+
+    result = _bench_serve_paged(False, "cpu")
+    assert result["metric"] == "llama_serve_paged"
+    assert result["value"] is not None and result["value"] > 0
+    d = result["detail"]
+    assert d["engine_traces"] <= len(d["prefill_buckets"]) + 1
+    assert d["two_vs_one_p99"] < 1.0      # second replica relieves p99
+    assert d["prefix_hit_rate"] > 0.3     # 60%-shared trace must hit
+    assert d["kv_blocks"]["num_blocks"] > 0
