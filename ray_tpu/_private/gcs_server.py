@@ -268,7 +268,8 @@ class GcsServer:
         for name in [
             "register_node", "heartbeat", "get_all_nodes", "drain_node",
             "kv_put", "kv_get", "kv_del", "kv_keys", "kv_exists",
-            "register_actor", "get_actor_info", "get_named_actor",
+            "register_actor", "register_actors", "get_actor_info",
+            "get_named_actor",
             "list_named_actors", "kill_actor", "gc_actor",
             "report_actor_death",
             "wait_actor_ready", "list_actors",
@@ -919,6 +920,15 @@ class GcsServer:
         self._snapshot_dirty = True
         spawn_task(self._schedule_actor(actor_id))
         return {"ok": True}
+
+    async def _h_register_actors(self, specs):
+        """Batched registration: one round-trip for a whole fleet/gang
+        bring-up.  A 500-actor storm previously paid 500 serialized RPC
+        round-trips before the first worker lease went out; here every
+        spec is admitted (and its scheduling task spawned) in one call.
+        Replies are positional — one dict per spec, same contract as
+        ``register_actor``."""
+        return [await self._h_register_actor(spec) for spec in specs]
 
     async def _schedule_actor(self, actor_id):
         from ray_tpu._private.rpc import debug_log

@@ -19,6 +19,25 @@ Two SPMD invariants no unit test on a 1-device CPU backend can check:
   sensitive consumer). Branches where only one side has collectives
   are the common static fallback shape (``if axis_size == 1``) and are
   not flagged.
+
+Three more from the Pallas collective backend (these invariants are
+checked at runtime too, but only on a live group — the lint catches
+them at review time):
+
+- ``collective-member-mismatch``: ``create_collective_group`` /
+  ``init_collective_group`` with literal world_size/ranks that cannot
+  form a group (rank out of ``[0, world_size)``, rank-list length or
+  duplicates disagreeing with world_size). A mismatched membership
+  declaration hangs rendezvous until the timeout.
+- ``collective-dtype-drift``: an ``if``/``else`` whose branches issue
+  the SAME collective sequence but cast the payload to *different*
+  explicit dtypes (``.astype(bf16)`` vs ``.astype(f32)``) — ranks
+  disagreeing on the predicate put different wire formats on the ring
+  and the reduction is garbage (or deadlocks on size mismatch).
+- ``collective-quantized-nonfloat``: a quantized allreduce whose
+  payload is visibly integer (``.astype(int32)`` / ``dtype=int8``).
+  Quantizing integer gradients silently corrupts them; the runtime
+  raises TypeError, the lint says so before the job is launched.
 """
 
 from __future__ import annotations
@@ -38,6 +57,41 @@ _COLLECTIVES = {
     "all_to_all", "ppermute", "pshuffle", "pbroadcast", "axis_index",
     "axis_size", "pcast", "pvary",
 }
+
+
+_INT_DTYPES = {
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool", "bool_",
+}
+
+# Calls that quantize their payload before the ring reduction.
+_QUANTIZED_CALLS = {"quantized_ring_allreduce"}
+
+
+def _dtype_name(node: Optional[ast.expr]) -> Optional[str]:
+    """Literal dtype spelled by an expression: ``jnp.bfloat16`` →
+    "bfloat16", ``"int32"`` → "int32", ``np.int8`` → "int8"."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _payload_dtype(node: Optional[ast.expr]) -> Optional[str]:
+    """Explicit dtype of a collective's payload expression, when visible:
+    ``x.astype(jnp.bfloat16)``, ``jnp.zeros(..., dtype=jnp.int32)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype":
+        if node.args:
+            return _dtype_name(node.args[0])
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            return _dtype_name(kw.value)
+    return None
 
 
 def _axis_strings(node: Optional[ast.expr]) -> List[str]:
@@ -123,9 +177,14 @@ def _declared_axes(mod: ModuleInfo) -> Set[str]:
 @register
 class CollectivesPass(LintPass):
     name = "collective-consistency"
-    rules = ("collective-unknown-axis", "collective-divergent-branches")
+    rules = ("collective-unknown-axis", "collective-divergent-branches",
+             "collective-member-mismatch", "collective-dtype-drift",
+             "collective-quantized-nonfloat")
     description = ("collective axis names must be declared; conditional "
-                   "branches must issue identical collective sequences")
+                   "branches must issue identical collective sequences "
+                   "with consistent wire dtypes; group membership "
+                   "declarations must be coherent; quantized allreduce "
+                   "takes float payloads only")
 
     def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
         out: List[Finding] = []
@@ -133,23 +192,90 @@ class CollectivesPass(LintPass):
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Call):
                 op, axes = _collective_axis(node)
-                if op is None:
-                    continue
-                for axis in axes:
-                    if axis not in declared:
-                        out.append(mod.finding(
-                            "collective-unknown-axis", node,
-                            f"{op}(..., {axis!r}): axis {axis!r} is not "
-                            f"declared by any mesh/PartitionSpec/"
-                            f"axis_name binding in this module (known "
-                            f"here: {sorted(declared)}) — a typo'd "
-                            f"axis only fails at pod bring-up"))
+                if op is not None:
+                    for axis in axes:
+                        if axis not in declared:
+                            out.append(mod.finding(
+                                "collective-unknown-axis", node,
+                                f"{op}(..., {axis!r}): axis {axis!r} is "
+                                f"not declared by any mesh/PartitionSpec/"
+                                f"axis_name binding in this module (known "
+                                f"here: {sorted(declared)}) — a typo'd "
+                                f"axis only fails at pod bring-up"))
+                out.extend(self._check_membership(mod, node))
+                out.extend(self._check_quantized(mod, node))
         for node in ast.walk(mod.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 out.extend(self._check_branches(mod, node))
         return out
 
-    def _branch_sig(self, stmts) -> List[Tuple[str, Tuple[str, ...]]]:
+    def _check_membership(self, mod: ModuleInfo,
+                          call: ast.Call) -> Iterable[Finding]:
+        name = call_name(call).rsplit(".", 1)[-1]
+
+        def _int(node) -> Optional[int]:
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             int):
+                return node.value
+            return None
+
+        def _arg(pos: int, kw_name: str):
+            for kw in call.keywords:
+                if kw.arg == kw_name:
+                    return kw.value
+            return call.args[pos] if len(call.args) > pos else None
+
+        if name == "init_collective_group":
+            world = _int(_arg(0, "world_size"))
+            rank = _int(_arg(1, "rank"))
+            if world is not None and rank is not None and \
+                    not (0 <= rank < world):
+                yield mod.finding(
+                    "collective-member-mismatch", call,
+                    f"init_collective_group(world_size={world}, "
+                    f"rank={rank}): rank outside [0, {world}) — this "
+                    f"member can never join and rendezvous hangs until "
+                    f"the timeout")
+        elif name == "create_collective_group":
+            world = _int(_arg(1, "world_size"))
+            ranks_node = _arg(2, "ranks")
+            if world is None or not isinstance(ranks_node,
+                                               (ast.List, ast.Tuple)):
+                return
+            ranks = [_int(e) for e in ranks_node.elts]
+            if any(r is None for r in ranks):
+                return
+            if len(ranks) != world or sorted(ranks) != list(range(world)):
+                yield mod.finding(
+                    "collective-member-mismatch", call,
+                    f"create_collective_group(world_size={world}, "
+                    f"ranks={ranks}): ranks must be exactly "
+                    f"0..{world - 1} once each — a mismatched "
+                    f"membership declaration leaves the group waiting "
+                    f"for members that never come")
+
+    def _check_quantized(self, mod: ModuleInfo,
+                         call: ast.Call) -> Iterable[Finding]:
+        name = call_name(call).rsplit(".", 1)[-1]
+        quantized = name in _QUANTIZED_CALLS
+        if not quantized and name in ("allreduce", "device_allreduce"):
+            quantized = any(
+                kw.arg == "quantized" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in call.keywords)
+        if not quantized or not call.args:
+            return
+        dtype = _payload_dtype(call.args[0])
+        if dtype in _INT_DTYPES:
+            yield mod.finding(
+                "collective-quantized-nonfloat", call,
+                f"{name}(<{dtype} payload>): int8 quantization of "
+                f"integer data silently corrupts it (scale/round is "
+                f"only meaningful for floats) — the runtime raises "
+                f"TypeError; reduce with op='sum' unquantized instead")
+
+    def _branch_sig(self, stmts):
+        """Per-branch collective signature: [(op, axes, payload_dtype)].
+        op/axes feed the divergence check; dtype feeds the drift check."""
         sig = []
         for stmt in stmts:
             for sub in ast.walk(stmt):
@@ -160,7 +286,9 @@ class CollectivesPass(LintPass):
                     op, axes = _collective_axis(sub)
                     if op is not None and op not in ("axis_index",
                                                      "axis_size"):
-                        sig.append((op, tuple(sorted(axes))))
+                        dtype = (_payload_dtype(sub.args[0])
+                                 if sub.args else None)
+                        sig.append((op, tuple(sorted(axes)), dtype))
         return sig
 
     def _check_branches(self, mod: ModuleInfo, fn) -> Iterable[Finding]:
@@ -172,12 +300,29 @@ class CollectivesPass(LintPass):
             # One-sided collectives are the static-fallback shape
             # ("if n == 1: no ring"); only flag when BOTH branches
             # issue collectives and disagree.
-            if body_sig and else_sig and body_sig != else_sig:
+            if not body_sig or not else_sig:
+                continue
+            body_ops = [(op, axes) for op, axes, _ in body_sig]
+            else_ops = [(op, axes) for op, axes, _ in else_sig]
+            if body_ops != else_ops:
                 yield mod.finding(
                     "collective-divergent-branches", node,
                     f"'if' branches inside {fn.name}() issue different "
-                    f"collective sequences ({body_sig} vs {else_sig}): "
+                    f"collective sequences ({body_ops} vs {else_ops}): "
                     f"replicas disagreeing on the predicate enter "
                     f"different collective schedules and the mesh "
                     f"hangs — hoist the collectives out of the branch "
                     f"or make both arms issue the same sequence")
+                continue
+            # Same schedule: do the two arms put the same wire format on
+            # it? Only flag EXPLICIT disagreements (both arms cast).
+            for (op, axes, bd), (_, _, ed) in zip(body_sig, else_sig):
+                if bd is not None and ed is not None and bd != ed:
+                    yield mod.finding(
+                        "collective-dtype-drift", node,
+                        f"'if' branches inside {fn.name}() issue the "
+                        f"same {op} over {list(axes)} but cast the "
+                        f"payload to {bd!r} vs {ed!r}: ranks that "
+                        f"disagree on the predicate reduce mixed wire "
+                        f"formats — pick one dtype before the branch")
+                    break

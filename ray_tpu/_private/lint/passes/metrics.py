@@ -36,6 +36,7 @@ _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 # subsystems add their prefix here — one reviewable place instead of
 # ad-hoc names scattered over /metrics.
 _FAMILIES = (
+    "collective_",    # util.collective op/bytes/latency (collective.py)
     "ctrl_",          # control-plane decision counters (control.py)
     "data_",          # Dataset pipeline stages (stats.py / executors)
     "device_",        # accelerator HBM / device-count gauges

@@ -1930,16 +1930,13 @@ class Worker:
     # ======================================================================
     # Actor submission (owner side)
     # ======================================================================
-    def create_actor(self, cls_payload: bytes, cls_name: str, args, kwargs,
-                     options: Dict[str, Any]) -> "Any":
-        from ray_tpu.actor import ActorHandle
-
-        fn_hash = self.export_function(cls_payload)
+    def _actor_creation_spec(self, cls_name: str, fn_hash, args, kwargs,
+                             options: Dict[str, Any]) -> TaskSpec:
         actor_id = ActorID.of(self.job_id)
         task_id = TaskID.for_actor_creation(actor_id)
         arg_specs, kw_keys = self._serialize_args(args, kwargs)
         resources = _resources_from_options(options)
-        spec = TaskSpec(
+        return TaskSpec(
             task_id=task_id, job_id=self.job_id,
             task_type=TaskType.ACTOR_CREATION_TASK,
             function=FunctionDescriptor("", cls_name, fn_hash),
@@ -1960,6 +1957,14 @@ class Worker:
             runtime_env=self._prepare_runtime_env(
                 options.get("runtime_env")),
         )
+
+    def create_actor(self, cls_payload: bytes, cls_name: str, args, kwargs,
+                     options: Dict[str, Any]) -> "Any":
+        from ray_tpu.actor import ActorHandle
+
+        fn_hash = self.export_function(cls_payload)
+        spec = self._actor_creation_spec(cls_name, fn_hash, args, kwargs,
+                                         options)
         reply = self.gcs.call("register_actor", spec=spec)
         if reply.get("error"):
             if options.get("get_if_exists") and reply.get("existing_actor_id"):
@@ -1968,9 +1973,33 @@ class Worker:
             raise ValueError(reply["error"])
         if not spec.is_detached:
             # Non-detached actors die when all local handles go out of scope.
-            self.actor_handles.mark_created(actor_id.binary())
-        return ActorHandle(actor_id.binary(), cls_name,
+            self.actor_handles.mark_created(spec.actor_id.binary())
+        return ActorHandle(spec.actor_id.binary(), cls_name,
                            options.get("max_task_retries", 0))
+
+    def create_actors(self, cls_payload: bytes, cls_name: str, count: int,
+                      args, kwargs, options: Dict[str, Any]) -> List["Any"]:
+        """Create `count` identical actors with ONE batched GCS
+        registration RPC (the per-member round-trip was the dominant
+        serialized cost of a large gang/fleet bring-up)."""
+        from ray_tpu.actor import ActorHandle
+
+        fn_hash = self.export_function(cls_payload)  # exported once
+        specs = [
+            self._actor_creation_spec(cls_name, fn_hash, args, kwargs,
+                                      options)
+            for _ in range(count)
+        ]
+        replies = self.gcs.call("register_actors", specs=specs)
+        handles = []
+        for spec, reply in zip(specs, replies):
+            if reply.get("error"):
+                raise ValueError(reply["error"])
+            if not spec.is_detached:
+                self.actor_handles.mark_created(spec.actor_id.binary())
+            handles.append(ActorHandle(spec.actor_id.binary(), cls_name,
+                                       options.get("max_task_retries", 0)))
+        return handles
 
     def get_actor(self, name: str, namespace: str = "default"):
         from ray_tpu.actor import ActorHandle

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import cloudpickle
 
@@ -173,6 +173,32 @@ class ActorClass:
         handle._max_task_retries = options.get("max_task_retries", 0)
         handle._method_options = self._collect_method_options()
         return handle
+
+    def remote_many(self, count: int, *args, **kwargs) -> List[ActorHandle]:
+        """Create ``count`` identical actors via ONE batched GCS
+        registration round-trip — the fleet-bring-up path (a collective
+        group's members, a serve deployment's replicas).  Named actors
+        cannot be batched: names must be unique."""
+        from ray_tpu._private.worker import global_worker
+
+        if self._options.get("name"):
+            raise ValueError(
+                "remote_many cannot create named actors (names must be "
+                "unique); use .options(name=...).remote() per actor")
+        if count < 1:
+            raise ValueError(f"count must be >= 1, got {count}")
+        w = global_worker()
+        if self._pickled is None:
+            self._pickled = cloudpickle.dumps(self._cls)
+        options = dict(self._options)
+        options["is_async"] = self._is_async()
+        handles = w.create_actors(self._pickled, self.__name__, count,
+                                  args, kwargs, options)
+        method_options = self._collect_method_options()
+        for handle in handles:
+            handle._max_task_retries = options.get("max_task_retries", 0)
+            handle._method_options = method_options
+        return handles
 
     def options(self, **options) -> "ActorClass":
         clone = ActorClass(self._cls, {**self._options, **options})

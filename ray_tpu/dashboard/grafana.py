@@ -112,6 +112,19 @@ _PANELS: List[Dict[str, str]] = [
     {"title": "Router requests per replica",
      "expr": "rate(rtpu_serve_router_requests_total[5m])",
      "legend": "{{replica}}", "unit": "short"},
+    # --- collectives (Pallas ICI backend + util.collective API) ---
+    {"title": "Collective ops rate",
+     "expr": "rate(rtpu_collective_ops_total[5m])",
+     "legend": "{{op}}/{{backend}}", "unit": "short"},
+    {"title": "Collective bytes/sec",
+     "expr": "rate(rtpu_collective_bytes_total[5m])",
+     "legend": "{{op}}/{{backend}}/{{dtype}}", "unit": "Bps"},
+    {"title": "Collective op latency p50/p99",
+     "expr": 'histogram_quantile(0.5, '
+             'rate(rtpu_collective_op_seconds_bucket[5m]))',
+     "expr_b": 'histogram_quantile(0.99, '
+               'rate(rtpu_collective_op_seconds_bucket[5m]))',
+     "legend": "{{op}}/{{backend}}", "unit": "s"},
     # --- metrics-driven control plane ---
     {"title": "Serve replicas (autoscaler)",
      "expr": "rtpu_serve_replicas",

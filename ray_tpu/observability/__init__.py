@@ -16,6 +16,10 @@ shared instrumentation layer every hot path reports through:
   for the continuous-batching LLM engine.
 - ``train``: step-duration / samples-per-sec / loss reporting for
   ``train`` sessions and RLlib learners.
+- ``collective``: op/bytes counters and latency histograms for every
+  ``util.collective`` op (``rtpu_collective_*{op,backend,dtype}``),
+  plus ``collective:<op>`` timeline spans — the interconnect side of
+  the idle-device question.
 - ``data``: the Dataset executors' metric set — per-stage throughput
   counters finalized by ``DatasetStats`` plus live backpressure gauges
   (in-flight tasks, queued blocks) from the scheduler loops.
@@ -66,6 +70,10 @@ from ray_tpu.observability.control import (  # noqa: F401
     control_metrics,
     record_decision,
 )
+from ray_tpu.observability.collective import (  # noqa: F401
+    collective_metrics,
+    observe_collective,
+)
 from ray_tpu.observability.data import data_metrics  # noqa: F401
 from ray_tpu.observability.events import (  # noqa: F401
     EVENT_TYPES,
@@ -105,6 +113,7 @@ __all__ = [
     "EVENT_TYPES", "SEVERITIES", "WORKER_EXIT_TYPES",
     "classify_worker_exit", "make_event",
     "Hysteresis", "control_metrics", "record_decision",
+    "collective_metrics", "observe_collective",
     "SCHED_PHASES", "SCHED_SEGMENT_LABELS", "StackSampler",
     "capture_thread_stacks", "collapse", "format_thread_stacks",
     "merge_counts", "observe_sched_phases", "render_speedscope",

@@ -1,0 +1,96 @@
+"""Collective-op instrumentation.
+
+One singleton feeding the shared metric registry: every op that goes
+through the `ray_tpu.util.collective` API (and the device-side ring
+kernels when invoked via a group) records
+
+- ``rtpu_collective_ops_total{op,backend,dtype}`` — op count,
+- ``rtpu_collective_bytes_total{op,backend,dtype}`` — payload bytes moved
+  (the *input* tensor bytes: what the interconnect actually carries scales
+  with this times the ring's ``2(n-1)/n`` factor),
+- ``rtpu_collective_op_seconds{op,backend}`` — wall-time histogram, and
+- a ``collective:<op>`` timeline span per call,
+
+which is exactly what the PERF.md "is the interconnect the bottleneck?"
+playbook reads: bytes/sec vs the ICI envelope, and op latency vs compute
+time between ops.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+_collective = None
+_lock = threading.Lock()
+
+# Collective latencies straddle microseconds (small psum over ICI) to
+# seconds (pod-scale gather on a cold link).
+_OP_BOUNDARIES = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                  1.0, 5.0, 30.0)
+
+
+class CollectiveMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        tag_keys = ("op", "backend", "dtype")
+        self.ops = Counter(
+            "collective_ops_total", tag_keys=tag_keys,
+            description="Collective ops executed via the "
+                        "util.collective API.")
+        self.bytes = Counter(
+            "collective_bytes_total", tag_keys=tag_keys,
+            description="Input payload bytes handed to collective ops "
+                        "(wire bytes ≈ this × 2(n-1)/n for ring "
+                        "allreduce, ×1/4 under int8 quantization).")
+        self.op_seconds = Histogram(
+            "collective_op_seconds", boundaries=_OP_BOUNDARIES,
+            tag_keys=("op", "backend"),
+            description="Wall time of one collective op, host round-trip "
+                        "included.")
+
+
+def collective_metrics() -> CollectiveMetrics:
+    global _collective
+    with _lock:
+        if _collective is None:
+            _collective = CollectiveMetrics()
+        return _collective
+
+
+def _tensor_stats(tensor):
+    try:
+        import numpy as np
+
+        arr = np.asarray(tensor)
+        return str(arr.dtype), int(arr.nbytes)
+    except Exception:
+        return "unknown", 0
+
+
+@contextmanager
+def observe_collective(op: str, backend: str, tensor=None):
+    """Time one collective op: counters + latency histogram + a
+    ``collective:<op>`` timeline span."""
+    from ray_tpu.util.tracing import record_span
+
+    dtype, nbytes = _tensor_stats(tensor)
+    m = collective_metrics()
+    start = time.time()
+    try:
+        yield
+    finally:
+        dur = time.time() - start
+        tags = {"op": op, "backend": backend, "dtype": dtype}
+        m.ops.inc(1, tags)
+        if nbytes:
+            m.bytes.inc(nbytes, tags)
+        m.op_seconds.observe(dur, {"op": op, "backend": backend})
+        try:
+            record_span(f"collective:{op}", start, dur,
+                        {"backend": backend, "dtype": dtype,
+                         "bytes": nbytes})
+        except Exception:
+            pass

@@ -44,7 +44,9 @@ def _axis_size(axis_name: str) -> Optional[int]:
     """Static size of a bound mesh axis, or None when unbound."""
     try:
         return lax.axis_size(axis_name)
-    except (NameError, KeyError, ValueError, TypeError):
+    except (NameError, KeyError, ValueError, TypeError, AttributeError):
+        # AttributeError: lax.axis_size itself is absent on older jax
+        # (0.4.x spells it lax.psum(1, axis) / axis_env lookup below).
         pass
     try:  # older spellings
         frame = jax.core.get_axis_env().axis_frame(axis_name)  # type: ignore

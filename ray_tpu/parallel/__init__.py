@@ -8,6 +8,11 @@ from ray_tpu.parallel.sharding import (
 )
 from ray_tpu.parallel.train_step import (
     TrainState, build_eval_step, build_train_step, create_train_state,
+    state_shardings,
+)
+from ray_tpu.parallel.zero import (
+    ZeroTrainState, build_zero_train_step, constrain_opt_state,
+    create_zero_state, zero_moment_shardings,
 )
 
 __all__ = [
@@ -17,4 +22,7 @@ __all__ = [
     "llama_param_specs", "llama_param_shardings", "batch_spec",
     "batch_sharding", "shard_params", "replicated", "TrainState",
     "create_train_state", "build_train_step", "build_eval_step",
+    "state_shardings",
+    "ZeroTrainState", "build_zero_train_step", "create_zero_state",
+    "zero_moment_shardings", "constrain_opt_state",
 ]

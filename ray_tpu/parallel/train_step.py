@@ -27,11 +27,16 @@ def create_train_state(params, optimizer) -> TrainState:
                       step=jnp.zeros((), jnp.int32))
 
 
-def state_shardings(param_shardings, optimizer, params_shape, mesh
-                    ) -> TrainState:
+def state_shardings(param_shardings, optimizer, params_shape, mesh,
+                    weight_update: str = "replicated") -> TrainState:
     """Shardings for the full TrainState: opt-state mirrors params (moments
     inherit each param's sharding — automatic ZeRO partitioning of optimizer
     state when fsdp is on).
+
+    ``weight_update="sharded"`` additionally folds the ``data`` axis into
+    each moment's dim 0 where divisible (`parallel.zero`), so placement
+    matches the sharded-update constraint inside the step and the donated
+    buffers never reshard.
 
     The mapping is STRUCTURAL: any subtree of the optimizer state whose
     pytree structure (and leaf shapes) mirrors the param tree — e.g. Adam's
@@ -58,6 +63,16 @@ def state_shardings(param_shardings, optimizer, params_shape, mesh
         opt_shape,
         is_leaf=lambda n: mirrors_params(n) or jax.tree.structure(
             n).num_leaves <= 1)
+    if weight_update == "sharded":
+        from ray_tpu.parallel.zero import zero_moment_shardings
+
+        param_specs = jax.tree.map(lambda s: s.spec, param_shardings)
+        zsh = zero_moment_shardings(param_specs, optimizer, params_shape,
+                                    mesh)
+        opt_sh = jax.tree.map(
+            lambda default, z: z if isinstance(z, NamedSharding)
+            else default,
+            opt_sh, zsh)
     return TrainState(params=param_shardings, opt_state=opt_sh, step=repl)
 
 
@@ -68,8 +83,32 @@ def build_train_step(
     param_shardings,
     batch_shardings,
     grad_accum: int = 1,
+    weight_update: str = "replicated",
+    params_shape=None,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict]]:
-    """Returns jitted (state, batch) -> (state, metrics)."""
+    """Returns jitted (state, batch) -> (state, metrics).
+
+    ``weight_update="sharded"`` turns on the ZeRO-style partitioned
+    optimizer update (`parallel.zero` GSPMD route): sharding constraints
+    over the ``data`` axis on the optimizer moments make XLA rewrite
+    allreduce(grads)+full-update into reduce-scatter + 1/n-update +
+    allgather.  Needs ``params_shape`` (a `jax.eval_shape` of the param
+    tree) to size the moment shardings."""
+    if weight_update not in ("replicated", "sharded"):
+        raise ValueError(
+            f"weight_update must be 'replicated'|'sharded', got "
+            f"{weight_update!r}")
+    moment_sh = None
+    if weight_update == "sharded":
+        if params_shape is None:
+            raise ValueError(
+                "weight_update='sharded' needs params_shape "
+                "(jax.eval_shape of the param tree)")
+        from ray_tpu.parallel.zero import zero_moment_shardings
+
+        param_specs = jax.tree.map(lambda s: s.spec, param_shardings)
+        moment_sh = zero_moment_shardings(param_specs, optimizer,
+                                          params_shape, mesh)
 
     def _loss_and_grads(params, batch):
         if grad_accum <= 1:
@@ -95,6 +134,10 @@ def build_train_step(
         grad_norm = optax.global_norm(grads)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
+        if moment_sh is not None:
+            from ray_tpu.parallel.zero import constrain_opt_state
+
+            new_opt = constrain_opt_state(new_opt, moment_sh)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(new_params, new_opt, state.step + 1)
         return new_state, {"loss": loss, "grad_norm": grad_norm,

@@ -31,6 +31,13 @@ class JaxConfig(BackendConfig):
     # CPU tier only: per-process virtual device count
     # (jax.config jax_num_cpu_devices).
     num_cpu_devices: Optional[int] = None
+    # Default mesh axes for workers that call `pod_train_loop` /
+    # `run_pod_training` without an explicit mesh: data absorbs whatever
+    # the fsdp/tensor factors leave over (parallel.make_mesh semantics).
+    mesh_axes: Optional[dict] = None
+    # "replicated" | "sharded" — ZeRO-style cross-replica sharding of the
+    # optimizer update (parallel.zero) for loops driven via this config.
+    weight_update: str = "replicated"
 
     @property
     def backend_cls(self):
@@ -102,3 +109,125 @@ def _free_port_on_worker() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+# ---------------------------------------------------------------------------
+# Pod-scale sharded training loop.  One canonical path from "workers joined
+# the gang" to "tokens/sec/chip": build the multi-host data×fsdp×tensor
+# mesh, shard a Llama model over it, and run the pjit train step with the
+# ZeRO weight-update knob.  `JaxTrainer(pod_train_loop, ...)` uses it as a
+# train_loop_per_worker; the multichip dryrun calls `run_pod_training`
+# directly so both exercise the identical code path.
+# ---------------------------------------------------------------------------
+
+def run_pod_training(model_config=None, mesh_axes=None, steps: int = 4,
+                     batch_size: Optional[int] = None, seq_len: int = 33,
+                     weight_update: str = "replicated",
+                     learning_rate: float = 1e-3, seed: int = 0,
+                     report=None) -> dict:
+    """Run `steps` sharded Llama train steps; returns throughput metrics.
+
+    The returned dict carries ``tokens_per_sec`` / ``tokens_per_sec_per_chip``
+    measured over the post-compile steps (step 0 is the compile+warmup step
+    and is excluded), which is what MULTICHIP_rXX.json and ROADMAP item 1
+    compare against the single-chip figure.
+    """
+    import time
+
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn
+    from ray_tpu.parallel import (
+        batch_sharding, build_train_step, create_train_state,
+        llama_param_shardings, make_mesh, shard_params,
+    )
+
+    if model_config is None:
+        model_config = LlamaConfig(
+            vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=4,
+            hidden_dim=256, max_seq_len=128)
+    mesh = make_mesh(dict(mesh_axes) if mesh_axes else {"data": -1})
+    n_devices = int(np.prod(mesh.devices.shape))
+
+    params = init_params(model_config, jax.random.key(seed))
+    shardings = llama_param_shardings(model_config, mesh)
+    bsh = batch_sharding(mesh)
+    optimizer = optax.adamw(learning_rate)
+    params_shape = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
+
+    step = build_train_step(
+        lambda p, b: loss_fn(p, b, model_config), optimizer, mesh,
+        shardings, bsh, weight_update=weight_update,
+        params_shape=params_shape)
+    state = create_train_state(shard_params(params, shardings), optimizer)
+
+    # Batch must divide evenly over the data-like axes.
+    data_shards = 1
+    for ax in ("data", "fsdp"):
+        if ax in mesh.axis_names:
+            data_shards *= mesh.shape[ax]
+    if batch_size is None:
+        batch_size = max(8, n_devices)
+    if batch_size % data_shards:
+        batch_size = ((batch_size + data_shards - 1)
+                      // data_shards) * data_shards
+    rng = np.random.RandomState(seed)
+    batch = {"tokens": jax.device_put(
+        rng.randint(0, model_config.vocab_size,
+                    (batch_size, seq_len)).astype("int32"), bsh)}
+    tokens_per_step = batch_size * (seq_len - 1)  # next-token targets
+
+    state, metrics = step(state, batch)  # compile + warmup
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+        if report is not None:
+            report({"loss": float(metrics["loss"]),
+                    "step": int(metrics["step"])})
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+    loss = float(metrics["loss"])
+    tokens_per_sec = tokens_per_step * steps / max(elapsed, 1e-9)
+    return {
+        "n_devices": n_devices,
+        "mesh": {name: int(size) for name, size
+                 in zip(mesh.axis_names, mesh.devices.shape)},
+        "weight_update": weight_update,
+        "steps": steps,
+        "batch_size": batch_size,
+        "seq_len": seq_len,
+        "loss": loss,
+        "train_seconds": elapsed,
+        "tokens_per_sec": tokens_per_sec,
+        "tokens_per_sec_per_chip": tokens_per_sec / max(n_devices, 1),
+    }
+
+
+def pod_train_loop(config: Optional[dict] = None) -> None:
+    """`train_loop_per_worker` for `JaxTrainer`: pod-scale sharded Llama
+    training over the multi-host mesh, reporting throughput per step.
+
+    Config keys (all optional): ``mesh_axes``, ``weight_update``,
+    ``steps``, ``batch_size``, ``seq_len``, ``learning_rate``, ``seed``,
+    ``model_config`` (a LlamaConfig).  Mesh/weight-update defaults come
+    from the backend's `JaxConfig` when driven through `JaxTrainer`.
+    """
+    from ray_tpu import train
+
+    config = dict(config or {})
+    summary = run_pod_training(
+        model_config=config.get("model_config"),
+        mesh_axes=config.get("mesh_axes"),
+        steps=int(config.get("steps", 4)),
+        batch_size=config.get("batch_size"),
+        seq_len=int(config.get("seq_len", 33)),
+        weight_update=config.get("weight_update", "replicated"),
+        learning_rate=float(config.get("learning_rate", 1e-3)),
+        seed=int(config.get("seed", 0)),
+        report=None,
+    )
+    train.report(summary)

@@ -36,6 +36,11 @@ class GroupManager:
                 import XLAGroup
 
             group = XLAGroup(world_size, rank, group_name, **options)
+        elif backend == Backend.PALLAS:
+            from ray_tpu.util.collective.collective_group \
+                .pallas_collective_group import PallasGroup
+
+            group = PallasGroup(world_size, rank, group_name, **options)
         else:
             from ray_tpu.util.collective.collective_group.shm_collective_group \
                 import SHMGroup
@@ -122,39 +127,68 @@ def get_group_mesh(group_name: str = "default", axis_name: str = "x"):
 
 # ---------------------------------------------------------------------------
 # Collective ops (value-returning: functional style fits jax; the reference
-# mutates torch tensors in place, which has no jax analogue).
+# mutates torch tensors in place, which has no jax analogue).  Every op is
+# metered: rtpu_collective_{ops,bytes}_total{op,backend,dtype}, an
+# op-latency histogram and a `collective:<op>` timeline span.
 # ---------------------------------------------------------------------------
+
+def _backend_name(group) -> str:
+    return getattr(group, "backend_name", type(group).__name__.lower()
+                   .replace("group", ""))
+
+
+def _observed(op_name: str, group, tensor=None):
+    from ray_tpu.observability.collective import observe_collective
+
+    return observe_collective(op_name, _backend_name(group), tensor)
+
 
 def allreduce(tensor, group_name: str = "default",
               op: ReduceOp = ReduceOp.SUM):
-    return _group_mgr.get_group(group_name).allreduce(tensor, op)
+    group = _group_mgr.get_group(group_name)
+    with _observed("allreduce", group, tensor):
+        return group.allreduce(tensor, op)
 
 
 def barrier(group_name: str = "default") -> None:
-    _group_mgr.get_group(group_name).barrier()
+    group = _group_mgr.get_group(group_name)
+    with _observed("barrier", group):
+        group.barrier()
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: ReduceOp = ReduceOp.SUM):
-    return _group_mgr.get_group(group_name).reduce(tensor, dst_rank, op)
+    group = _group_mgr.get_group(group_name)
+    with _observed("reduce", group, tensor):
+        return group.reduce(tensor, dst_rank, op)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return _group_mgr.get_group(group_name).broadcast(tensor, src_rank)
+    group = _group_mgr.get_group(group_name)
+    with _observed("broadcast", group, tensor):
+        return group.broadcast(tensor, src_rank)
 
 
 def allgather(tensor, group_name: str = "default") -> List[Any]:
-    return _group_mgr.get_group(group_name).allgather(tensor)
+    group = _group_mgr.get_group(group_name)
+    with _observed("allgather", group, tensor):
+        return group.allgather(tensor)
 
 
 def reducescatter(tensor, group_name: str = "default",
                   op: ReduceOp = ReduceOp.SUM):
-    return _group_mgr.get_group(group_name).reducescatter(tensor, op)
+    group = _group_mgr.get_group(group_name)
+    with _observed("reducescatter", group, tensor):
+        return group.reducescatter(tensor, op)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
-    _group_mgr.get_group(group_name).send(tensor, dst_rank)
+    group = _group_mgr.get_group(group_name)
+    with _observed("send", group, tensor):
+        group.send(tensor, dst_rank)
 
 
 def recv(src_rank: int, group_name: str = "default"):
-    return _group_mgr.get_group(group_name).recv(src_rank)
+    group = _group_mgr.get_group(group_name)
+    with _observed("recv", group):
+        return group.recv(src_rank)

@@ -22,6 +22,8 @@ from ray_tpu.util.collective.util import _reduce, get_or_create_coordinator
 
 
 class SHMGroup(BaseGroup):
+    backend_name = "shm"
+
     def __init__(self, world_size: int, rank: int, group_name: str):
         super().__init__(world_size, rank, group_name)
         self._hub = get_or_create_coordinator(group_name, world_size)

@@ -45,6 +45,8 @@ def _free_port() -> int:
 
 
 class XLAGroup(BaseGroup):
+    backend_name = "xla"
+
     def __init__(self, world_size: int, rank: int, group_name: str,
                  platform: Optional[str] = None,
                  local_device_count: Optional[int] = None):

@@ -1,0 +1,29 @@
+"""Pallas ICI ring collectives.
+
+Hand-written TPU collective kernels built on `pltpu.make_async_remote_copy`
+double-buffered rings, runnable under `shard_map` on a mesh axis.  Every
+kernel has an `interpret=True` path so the exact same code is testable on
+CPU virtual devices, and every public entry point degrades to the
+corresponding `jax.lax` collective when Pallas is not viable (non-TPU
+backend with interpret disabled).
+
+Public API::
+
+    ring_allreduce(x, axis_name, ...)       # psum-shaped
+    ring_allgather(x, axis_name, ...)       # all_gather(tiled=True)-shaped
+    ring_reduce_scatter(x, axis_name, ...)  # psum_scatter-shaped
+    quantized_ring_allreduce(x, axis_name, ...)  # EQuARX-style int8 ring
+    select_impl(...)                        # backend/fallback resolution
+"""
+
+from ray_tpu.util.collective.pallas.ring import (
+    ring_allgather, ring_allreduce, ring_reduce_scatter, select_impl,
+)
+from ray_tpu.util.collective.pallas.quantized import (
+    quantized_ring_allreduce,
+)
+
+__all__ = [
+    "ring_allreduce", "ring_allgather", "ring_reduce_scatter",
+    "quantized_ring_allreduce", "select_impl",
+]

@@ -1,0 +1,158 @@
+"""EQuARX-style fused quantized ring allreduce.
+
+PAPERS.md ("EQuARX: Efficient Quantized AllReduce in XLA") shows that on
+slow links an int8 allreduce with per-block scales buys ~2x wire time for a
+small accuracy cost.  This kernel fuses the whole thing: at every ring hop
+the outgoing chunk (a running f32 partial sum) is re-quantized to int8 with
+one f32 scale, the wire carries `chunk/4` the bytes, and the receiver
+dequantizes into its f32 accumulator.  Error therefore grows with hop
+count, not ring size squared — each hop contributes at most
+``max|chunk| / 254`` per element (symmetric round-to-nearest, 8 bits).
+
+Fallback ladder (mirrors `ring.select_impl`):
+
+- non-float input → `TypeError` (quantizing integer grads is a bug; the
+  graftlint `collective-consistency` pass flags call sites that try);
+- f64 input, tiny tensors, or ``precision="bf16"`` → bf16-compressed
+  allreduce (cast → ring/lax allreduce → cast back);
+- off-TPU with interpret disabled → bf16 cast around `lax.psum`.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.util.collective.pallas import ring
+from ray_tpu.util.collective.pallas.ring import (
+    _cap_signal, _cap_wait, _from_block, _to_block, select_impl,
+)
+
+# Below this many elements the scale traffic dominates any wire savings.
+_MIN_QUANT_ELEMS = int(os.environ.get("RAY_TPU_QAR_MIN_ELEMS", "1024"))
+_QMAX = 127.0
+
+
+def _quantize(chunk):
+    scale = jnp.maximum(jnp.max(jnp.abs(chunk)) / _QMAX, 1e-30)
+    q = jnp.clip(jnp.round(chunk / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def _qar_kernel(n, axis_name, interpret,
+                in_ref, out_ref,
+                qcomm_ref, scomm_ref, qstage_ref, sstage_ref,
+                qsend_sems, qrecv_sems, ssend_sems, srecv_sems, cap_sems):
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, n)
+    left = lax.rem(my + n - 1, n)
+    chunk = out_ref.shape[0] // n
+    total = 2 * (n - 1)
+
+    out_ref[...] = in_ref[...]
+
+    def hop(t, send_idx, recv_idx, accumulate):
+        slot = t % 2
+        q, scale = _quantize(out_ref[pl.ds(send_idx * chunk, chunk)])
+        qstage_ref[...] = q
+        sstage_ref[0, 0] = scale
+        _cap_wait(cap_sems, slot, t, interpret)
+        qrdma = pltpu.make_async_remote_copy(
+            src_ref=qstage_ref, dst_ref=qcomm_ref.at[slot],
+            send_sem=qsend_sems.at[slot], recv_sem=qrecv_sems.at[slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        srdma = pltpu.make_async_remote_copy(
+            src_ref=sstage_ref, dst_ref=scomm_ref.at[slot],
+            send_sem=ssend_sems.at[slot], recv_sem=srecv_sems.at[slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        qrdma.start()
+        srdma.start()
+        qrdma.wait()
+        srdma.wait()
+        deq = qcomm_ref[slot].astype(out_ref.dtype) * scomm_ref[slot, 0, 0]
+        if accumulate:
+            out_ref[pl.ds(recv_idx * chunk, chunk)] = (
+                out_ref[pl.ds(recv_idx * chunk, chunk)] + deq)
+        else:
+            out_ref[pl.ds(recv_idx * chunk, chunk)] = deq
+        _cap_signal(cap_sems, slot, t, total, left, interpret)
+
+    t = 0
+    for s in range(n - 1):  # reduce-scatter sweep over quantized partials
+        hop(t, lax.rem(my - s + n, n), lax.rem(my - s - 1 + n, n),
+            accumulate=True)
+        t += 1
+    for s in range(n - 1):  # allgather sweep of the reduced chunks
+        hop(t, lax.rem(my - s + 1 + n, n), lax.rem(my - s + n, n),
+            accumulate=False)
+        t += 1
+
+
+def _qar_block(x, axis_name, n, interpret):
+    chunk = x.shape[0] // n
+    kernel = functools.partial(_qar_kernel, n, axis_name, interpret)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk) + x.shape[1:], jnp.int8),   # qcomm
+            pltpu.VMEM((2, 1, 1), jnp.float32),               # scomm
+            pltpu.VMEM((chunk,) + x.shape[1:], jnp.int8),     # qstage
+            pltpu.VMEM((1, 1), jnp.float32),                  # sstage
+            pltpu.SemaphoreType.DMA((2,)),                    # q send
+            pltpu.SemaphoreType.DMA((2,)),                    # q recv
+            pltpu.SemaphoreType.DMA((2,)),                    # s send
+            pltpu.SemaphoreType.DMA((2,)),                    # s recv
+            pltpu.SemaphoreType.REGULAR((2,)),                # capacity
+        ],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.TPUCompilerParams(
+            collective_id=3),
+    )(x)
+
+
+def _bf16_fallback(x, axis_name, n, op, impl):
+    out = ring.ring_allreduce(x.astype(jnp.bfloat16), axis_name, n=n,
+                              op=op, impl=impl)
+    return out.astype(x.dtype)
+
+
+def quantized_ring_allreduce(x, axis_name: str, *, n: int, op: str = "sum",
+                             precision: str = "int8", impl: str = "auto"):
+    """int8 quantize→ring-allreduce→dequantize over mesh axis `axis_name`.
+
+    Sum/avg only (quantized max/min/prod have no sane error story).  Raises
+    `TypeError` on non-float input; falls back to a bf16-compressed
+    allreduce for f64, tiny tensors, ``precision="bf16"``, or when the
+    resolved impl is the off-TPU `lax` path.
+    """
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        raise TypeError(
+            "quantized allreduce requires floating-point input, got "
+            f"{jnp.asarray(x).dtype} — quantizing integer gradients "
+            "silently corrupts them (use ring_allreduce instead)")
+    if op.lower() not in ("sum", "avg", "mean"):
+        raise ValueError(f"quantized allreduce supports sum/avg, got {op!r}")
+    if precision not in ("int8", "bf16"):
+        raise ValueError(f"precision must be int8|bf16, got {precision!r}")
+    impl = select_impl(impl)
+    wants_bf16 = (
+        precision == "bf16"
+        or jnp.asarray(x).dtype == jnp.float64
+        or x.size < _MIN_QUANT_ELEMS
+    )
+    if impl == "lax" or n == 1 or wants_bf16:
+        return _bf16_fallback(x, axis_name, n, op, impl)
+    block, shape, size = _to_block(x.astype(jnp.float32), n)
+    out = _qar_block(block, axis_name, n,
+                     interpret=(impl == "pallas_interpret"))
+    result = _from_block(out, shape, size).astype(x.dtype)
+    if op.lower() in ("avg", "mean"):
+        result = result / n
+    return result

@@ -7,6 +7,7 @@ import enum
 
 class Backend:
     XLA = "xla"      # jax.distributed + XLA collectives over ICI/DCN (TPU path)
+    PALLAS = "pallas"  # hand-written Pallas ring kernels over ICI RDMA
     SHM = "shm"      # hub-actor CPU backend (gloo-equivalent for host tensors)
     # Alias kept for API familiarity with the reference ("gloo" on CPU).
     GLOO = "shm"
@@ -15,11 +16,14 @@ class Backend:
     def validate(name: str) -> str:
         if name in (Backend.XLA,):
             return Backend.XLA
+        if name in (Backend.PALLAS,):
+            return Backend.PALLAS
         if name in ("shm", "gloo", "cpu"):
             return Backend.SHM
         raise ValueError(
             f"unknown collective backend {name!r}; ray_tpu supports 'xla' "
-            "(TPU/ICI via jax) and 'shm'/'gloo' (CPU host tensors)")
+            "(TPU/ICI via jax), 'pallas' (Pallas ring kernels over ICI, "
+            "lax fallback off-TPU) and 'shm'/'gloo' (CPU host tensors)")
 
 
 class ReduceOp(enum.Enum):

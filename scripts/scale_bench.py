@@ -101,12 +101,18 @@ def main():
         def ping(self, x=0):
             return x
 
+    # Bring-up is batched + parallel: ONE register_actors GCS RPC admits
+    # the whole fleet, then every ping is in flight before the first get
+    # (the r5 regression was this barrier run sequentially: submit, get,
+    # submit, get — 500 serialized round-trips on top of worker spawns).
     t0 = time.monotonic()
-    actors = [Echo.remote() for _ in range(args.actors)]
+    actors = Echo.remote_many(args.actors)
+    results["actors_register_s"] = round(time.monotonic() - t0, 2)
+    pings = [a.ping.remote() for a in actors]
     ready, deaths = 0, 0
-    for a in actors:
+    for ref in pings:
         try:
-            ray_tpu.get(a.ping.remote(), timeout=3600)
+            ray_tpu.get(ref, timeout=3600)
             ready += 1
         except Exception:
             deaths += 1
@@ -118,7 +124,8 @@ def main():
     results["actors_ready_s"] = round(dt, 1)
     results["actors_per_s"] = round(ready / dt, 1)
     print(f"[scale] {ready}/{args.actors} actors ready in {dt:.1f}s "
-          f"({results['actors_per_s']}/s, {deaths} deaths)", flush=True)
+          f"({results['actors_per_s']}/s, {deaths} deaths, register "
+          f"{results['actors_register_s']}s)", flush=True)
 
     t0 = time.monotonic()
     calls = [actors[i % len(actors)].ping.remote(i)
@@ -162,28 +169,30 @@ def main():
     )
 
     mb = args.broadcast_mb
-    blob = ray_tpu.put(np.ones((mb, 1024, 128), dtype=np.float64))  # mb MiB
+    if mb:  # --broadcast-mb 0 disables the phase like the other knobs
+        blob = ray_tpu.put(
+            np.ones((mb, 1024, 128), dtype=np.float64))  # mb MiB
 
-    @ray_tpu.remote
-    def digest(arr):
-        return float(arr[0, 0, 0]) + arr.shape[0]
+        @ray_tpu.remote
+        def digest(arr):
+            return float(arr[0, 0, 0]) + arr.shape[0]
 
-    t0 = time.monotonic()
-    node_ids = [n["NodeID"] for n in ray_tpu.nodes() if n.get("Alive")]
-    refs = [digest.options(
-        scheduling_strategy=NodeAffinitySchedulingStrategy(
-            node_id=bytes.fromhex(nid), soft=False)).remote(blob)
-        for nid in node_ids]
-    out = ray_tpu.get(refs, timeout=1200)
-    dt = time.monotonic() - t0
-    assert all(v == 1.0 + mb for v in out)
-    results["broadcast_mb"] = mb
-    results["broadcast_nodes"] = len(node_ids)
-    results["broadcast_s"] = round(dt, 2)
-    results["broadcast_mb_per_s"] = round(mb * len(node_ids) / dt, 1)
-    print(f"[scale] {mb}MiB broadcast to {len(node_ids)} nodes in "
-          f"{dt:.2f}s ({results['broadcast_mb_per_s']} MiB/s aggregate)",
-          flush=True)
+        t0 = time.monotonic()
+        node_ids = [n["NodeID"] for n in ray_tpu.nodes() if n.get("Alive")]
+        refs = [digest.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=bytes.fromhex(nid), soft=False)).remote(blob)
+            for nid in node_ids]
+        out = ray_tpu.get(refs, timeout=1200)
+        dt = time.monotonic() - t0
+        assert all(v == 1.0 + mb for v in out)
+        results["broadcast_mb"] = mb
+        results["broadcast_nodes"] = len(node_ids)
+        results["broadcast_s"] = round(dt, 2)
+        results["broadcast_mb_per_s"] = round(mb * len(node_ids) / dt, 1)
+        print(f"[scale] {mb}MiB broadcast to {len(node_ids)} nodes in "
+              f"{dt:.2f}s ({results['broadcast_mb_per_s']} MiB/s "
+              f"aggregate)", flush=True)
 
     # ---- phase 6: per-node object envelope -------------------------------
     # Reference rows (release/benchmarks/README.md:22-31): 10k+ object

@@ -22,3 +22,29 @@ def divergent(x, use_mean):
     else:
         y = lax.psum(x, "data")
     return y
+
+
+def quantized_int_grads(grads):
+    # collective-quantized-nonfloat: int8-quantizing integer data
+    # silently corrupts it.
+    from ray_tpu.util.collective.pallas import quantized_ring_allreduce
+    return quantized_ring_allreduce(grads.astype(jnp.int32), "data", n=4)
+
+
+def bad_membership(actors, collective):
+    # collective-member-mismatch: 3 ranks declared for a world of 4.
+    collective.create_collective_group(actors, 4, [0, 1, 2])
+
+
+def rank_out_of_range(collective):
+    # collective-member-mismatch: rank == world_size can never join.
+    collective.init_collective_group(2, 2, backend="xla")
+
+
+def dtype_drift(x, half):
+    # collective-dtype-drift: same psum schedule, different wire dtypes.
+    if half:
+        y = lax.psum(x.astype(jnp.bfloat16), "data")
+    else:
+        y = lax.psum(x.astype(jnp.float32), "data")
+    return y

@@ -29,3 +29,22 @@ def same_both_arms(x, flag):
     else:
         y = lax.psum(x, "data")
     return y
+
+
+def quantized_float_grads(grads):
+    # Float payload: exactly what the quantized ring is for.
+    from ray_tpu.util.collective.pallas import quantized_ring_allreduce
+    return quantized_ring_allreduce(grads.astype(jnp.float32), "data", n=4)
+
+
+def good_membership(actors, collective):
+    collective.create_collective_group(actors, 4, [0, 1, 2, 3])
+    collective.init_collective_group(4, 3, backend="xla")
+
+
+def same_dtype_both_arms(x, flag):
+    if flag:
+        y = lax.psum(x.astype(jnp.bfloat16), "data") * 2
+    else:
+        y = lax.psum(x.astype(jnp.bfloat16), "data")
+    return y

@@ -50,6 +50,18 @@ class TestActorBasics:
         refs = [c.increment.remote() for _ in range(50)]
         assert ray_tpu.get(refs, timeout=60) == list(range(1, 51))
 
+    def test_remote_many_batched_creation(self, ray_start_regular):
+        # One register_actors GCS RPC admits the whole batch; every
+        # handle is independently callable with its own state.
+        actors = Counter.options(num_cpus=0).remote_many(4, start=10)
+        assert len(actors) == 4
+        assert len({a._actor_id for a in actors}) == 4
+        vals = ray_tpu.get([a.increment.remote() for a in actors],
+                           timeout=60)
+        assert vals == [11, 11, 11, 11]
+        with pytest.raises(ValueError, match="named"):
+            Counter.options(name="dup").remote_many(2)
+
     def test_method_error(self, ray_start_regular):
         c = Counter.remote()
         with pytest.raises(RuntimeError, match="actor method failed"):

@@ -37,7 +37,9 @@ PASS_CASES = [
      {"deadlock-self-get", "deadlock-unbounded-wait"}),
     ("collective-consistency", "collectives_bad.py",
      "collectives_clean.py",
-     {"collective-unknown-axis", "collective-divergent-branches"}),
+     {"collective-unknown-axis", "collective-divergent-branches",
+      "collective-member-mismatch", "collective-dtype-drift",
+      "collective-quantized-nonfloat"}),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      {"lock-cycle", "lock-blocking-call"}),
     ("metric-declarations", "metrics_bad.py", "metrics_clean.py",
