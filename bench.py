@@ -758,6 +758,81 @@ def _bench_sched_phase_overhead() -> dict:
     }
 
 
+def _bench_ppo_env_steps() -> dict:
+    """Decoupled (Podracer) vs colocated PPO acting throughput on the
+    CPU-virtual-device path. The config is deliberately learning-heavy
+    (wide MLP, many epochs) so the synchronous mode pays the learner
+    wall-clock inline while the decoupled mode overlaps it with acting
+    through the bounded queue + versioned WeightStore channel. Reports
+    env-steps/sec for both modes plus the staleness histogram the
+    learner pool observed — the bound must hold (staleness <= clip for
+    every applied batch)."""
+    import ray_tpu
+
+    iters, warmup = 4, 1
+
+    def _env_steps_rate(execution):
+        from ray_tpu.rllib import PPOConfig
+
+        config = (
+            PPOConfig()
+            .environment("CartPole-v1")
+            .training(execution=execution, lr=3e-4,
+                      train_batch_size=2048, minibatch_size=256,
+                      num_epochs=8, staleness_clip=4)
+            .env_runners(num_env_runners=2, num_envs_per_runner=32)
+            .rl_module(hidden=(256, 256))
+            .learners(num_learners=1, jax_platform="cpu")
+        )
+        algo = config.build()
+        try:
+            steps = 0
+            for _ in range(warmup):
+                algo.train()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                m = algo.train()
+                steps += int(m.get("num_env_steps_sampled", 0))
+            elapsed = time.perf_counter() - t0
+            pool_stats = (algo.learner_pool.stats()
+                          if execution == "decoupled" else {})
+        finally:
+            algo.stop()
+        return steps / elapsed, pool_stats
+
+    ray_tpu.init(num_cpus=8, num_tpus=0,
+                 object_store_memory=256 * 1024 * 1024)
+    try:
+        colocated, _ = _env_steps_rate("colocated")
+        decoupled, pool = _env_steps_rate("decoupled")
+    finally:
+        ray_tpu.shutdown()
+
+    clip = 4
+    hist = {int(k): v for k, v in pool.get("staleness_hist", {}).items()}
+    applied_staleness = [s for s in hist if hist[s] > 0 and s <= clip]
+    return {
+        "metric": "ppo_env_steps_per_sec",
+        "value": round(decoupled, 1),
+        "unit": "env-steps/s",
+        "vs_baseline": round(decoupled / colocated, 4),
+        "detail": {
+            "decoupled_steps_per_sec": round(decoupled, 1),
+            "colocated_steps_per_sec": round(colocated, 1),
+            "staleness_hist": hist,
+            "staleness_clip": clip,
+            "staleness_bounded": bool(
+                applied_staleness and max(applied_staleness) <= clip),
+            "dropped_stale": pool.get("dropped_stale_total", 0),
+            "iters_per_mode": iters,
+            "note": "vs_baseline = decoupled/colocated acting "
+                    "throughput; same learning-heavy PPO config, the "
+                    "decoupled mode overlaps learner updates with "
+                    "acting via the bounded queue + WeightStore",
+        },
+    }
+
+
 def _bench_llama_serve_autoscale() -> dict:
     """Closed-loop serve autoscaling under a stepped Poisson load: a
     `num_replicas="auto"` deployment rides 1 -> N replicas through the
@@ -1071,6 +1146,15 @@ def main() -> None:
     except Exception as e:
         print(json.dumps({"metric": "llama_serve_autoscale",
                           "value": None, "unit": "p99_ratio",
+                          "vs_baseline": None, "error": repr(e)[:300]}))
+
+    # Podracer decoupled vs colocated PPO acting throughput (local
+    # cluster, CPU virtual devices).
+    try:
+        print(json.dumps(_bench_ppo_env_steps()))
+    except Exception as e:
+        print(json.dumps({"metric": "ppo_env_steps_per_sec",
+                          "value": None, "unit": "env-steps/s",
                           "vs_baseline": None, "error": repr(e)[:300]}))
 
     vs_baseline = (mfu / REFERENCE_MFU) if mfu is not None else None

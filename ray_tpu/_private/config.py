@@ -170,6 +170,27 @@ _define("data_backpressure_max_scale", float, 4.0,
         "executor's base inflight/queued limits (lower bound is the "
         "reciprocal).")
 
+# --- decoupled RL (podracer) ---
+_define("rl_weight_history", int, 4,
+        "Versions the WeightStore registry retains; older wrapped refs "
+        "are dropped (subscribers more than this many versions behind "
+        "must fall forward to latest).")
+_define("rl_infer_batch_wait_s", float, 0.003,
+        "Inference-server gather window: how long a batch collects "
+        "concurrent infer() submissions before the jitted forward "
+        "runs.")
+_define("rl_weight_poll_interval_s", float, 0.1,
+        "Base period of an inference server's weight-channel poll "
+        "(jittered ±20% so a server fleet does not stampede the "
+        "registry).")
+_define("rl_sample_queue_maxsize", int, 8,
+        "Bound of the sample queue between acting and learning; a "
+        "full queue throttles producers (backpressure) instead of "
+        "buffering without limit.")
+_define("rl_staleness_clip", int, 4,
+        "Max published-minus-behavior weight versions before a sample "
+        "batch is dropped by the learner pool instead of applied.")
+
 # --- logging / events ---
 _define("event_stats", bool, True,
         "Track per-handler latency stats on runtime event loops.")

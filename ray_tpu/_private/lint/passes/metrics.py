@@ -44,6 +44,7 @@ _FAMILIES = (
     "learner_",       # RLlib learner update metrics
     "node_",          # raylet reporter node gauges
     "object_store_",  # per-node store pressure (spill/evict/pin)
+    "rl_",            # decoupled-RL podracer plane (observability/rl.py)
     "sched_",         # scheduling-latency phase breakdown (profiling.py)
     "serve_",         # LLM serving latency/queue metrics
     "train_",         # train-session report metrics

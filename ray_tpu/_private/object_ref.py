@@ -86,9 +86,15 @@ class ObjectRef:
 
     # -- awaitable ----------------------------------------------------------
     def __await__(self):
+        """``await ref`` resolves to the object's VALUE (reference
+        semantics), not the one-element list ``async_get`` returns."""
         from ray_tpu._private import worker as worker_mod
 
-        return worker_mod.global_worker().async_get([self]).__await__()
+        async def _resolve():
+            values = await worker_mod.global_worker().async_get([self])
+            return values[0]
+
+        return _resolve().__await__()
 
     def future(self):
         """A concurrent.futures.Future resolving to the object's value."""

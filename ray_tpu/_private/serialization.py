@@ -23,6 +23,7 @@ import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import cloudpickle
+import numpy as np
 
 _MAGIC = 0x52545055  # "RTPU"
 _ALIGN = 64
@@ -129,7 +130,7 @@ class SerializationContext:
         for size in sizes:
             view = data[off:off + size]
             if keepalive is not None:
-                view = _KeepaliveView(view, keepalive)
+                view = _keepalive_buffer(view, keepalive)
             bufs.append(view)
             off = _aligned(off + size)
         from ray_tpu._private import object_ref as _oref
@@ -147,25 +148,23 @@ class SerializationContext:
         return value
 
 
-class _KeepaliveView:
-    """memoryview proxy that pins a backing resource (e.g. SharedMemory)."""
+class _KeepaliveArray(np.ndarray):
+    """uint8 view of a store buffer that pins the backing mapping.
 
-    def __init__(self, view: memoryview, keepalive: Any):
-        self._view = view
-        self._keepalive = keepalive
+    pickle's out-of-band loads do ``memoryview(buffer)`` internally, so
+    the buffer must support the C buffer protocol — a pure-Python proxy
+    (PEP 688 ``__buffer__``) only exists from 3.12. An ndarray subclass
+    exports the protocol natively on every version, values rebuilt from
+    the buffer keep it alive through ``.base``, and the extra attribute
+    keeps the MappedObject (the raylet reader ref) alive with it."""
 
-    def __buffer__(self, flags):
-        return self._view.__buffer__(flags)
+    _keepalive: Any = None
 
-    def __len__(self):
-        return len(self._view)
 
-    def __getitem__(self, item):
-        return self._view[item]
-
-    @property
-    def nbytes(self):
-        return self._view.nbytes
+def _keepalive_buffer(view: memoryview, keepalive: Any) -> np.ndarray:
+    arr = np.frombuffer(view, np.uint8).view(_KeepaliveArray)
+    arr._keepalive = keepalive
+    return arr
 
 
 def serialize_error(exc: BaseException) -> bytes:

@@ -132,6 +132,21 @@ _PANELS: List[Dict[str, str]] = [
     {"title": "Control decisions rate",
      "expr": "rate(rtpu_ctrl_decisions_total[5m])",
      "legend": "{{controller}}/{{action}}", "unit": "short"},
+    # --- decoupled RL (podracer plane) ---
+    {"title": "RL acting vs learning throughput",
+     "expr": "rate(rtpu_rl_env_steps_total[1m])",
+     "expr_b": "rate(rtpu_rl_samples_total[1m])", "unit": "short"},
+    {"title": "RL weight version / staleness",
+     "expr": "rtpu_rl_weight_version",
+     "expr_b": "rtpu_rl_weight_staleness", "unit": "short"},
+    {"title": "RL sample queue depth / backpressure",
+     "expr": "rtpu_rl_sample_queue_depth",
+     "expr_b": "rate(rtpu_rl_backpressure_waits_total[5m])",
+     "unit": "short"},
+    {"title": "RL inference batching factor",
+     "expr": "rate(rtpu_rl_infer_requests_total[5m]) / "
+             "rate(rtpu_rl_infer_batches_total[5m])",
+     "unit": "short"},
 ]
 
 

@@ -18,6 +18,9 @@ Endpoints:
   GET /api/tasks      recent task lifecycle events
   GET /api/timeline   Chrome-trace JSON download (chrome://tracing)
   GET /api/serve      live serving/JIT telemetry summary
+  GET /api/rl         decoupled-RL rollup: acting vs learning
+                      throughput, weight version/staleness, sample
+                      queue depth, inference batching factor
   GET /api/memory     per-node object-store introspection + spill metrics
   GET /api/data       data-pipeline (DatasetStats) metric summary
   GET /api/events     ClusterEventLog (failure forensics) with ?type=,
@@ -215,6 +218,44 @@ class DashboardHead:
             "requests": dict(summary.get(
                 "serve_router_requests_total", {}).get("data", {})),
         }
+        return web.json_response(summary)
+
+    async def rl_stats(self, _req) -> web.Response:
+        """Decoupled-RL rollup: the "is acting or learning the
+        bottleneck?" numbers in one fetch — env-step vs learner-sample
+        throughput counters, the versioned weight channel's
+        version/staleness gauges, sample-queue depth and backpressure,
+        and the inference servers' achieved batching factor."""
+        summary = await self._gcs.acall(
+            "user_metrics_summary", prefixes=["rl_"], timeout=10)
+        summary = summary or {}
+
+        def _total(name):
+            entry = summary.get(name)
+            if not entry or not entry.get("data"):
+                return None
+            return sum(float(v) for v in entry["data"].values())
+
+        def _max(name):
+            entry = summary.get(name)
+            if not entry or not entry.get("data"):
+                return None
+            return max(float(v) for v in entry["data"].values())
+
+        requests, batches = (_total("rl_infer_requests_total"),
+                             _total("rl_infer_batches_total"))
+        rollup: Dict[str, Any] = {
+            "env_steps": _total("rl_env_steps_total"),
+            "samples": _total("rl_samples_total"),
+            "weight_version": _max("rl_weight_version"),
+            "weight_staleness": _max("rl_weight_staleness"),
+            "sample_queue_depth": _total("rl_sample_queue_depth"),
+            "backpressure_waits": _total("rl_backpressure_waits_total"),
+            "dropped_stale": _total("rl_dropped_stale_total"),
+        }
+        if requests is not None and batches:
+            rollup["infer_batching_factor"] = requests / batches
+        summary["rollup"] = rollup
         return web.json_response(summary)
 
     async def memory(self, req) -> web.Response:
@@ -500,6 +541,7 @@ class DashboardHead:
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/api/timeline", self.timeline)
         app.router.add_get("/api/serve", self.serve_stats)
+        app.router.add_get("/api/rl", self.rl_stats)
         app.router.add_get("/api/memory", self.memory)
         app.router.add_get("/api/data", self.data_stats)
         app.router.add_get("/api/events", self.events)

@@ -16,6 +16,10 @@ shared instrumentation layer every hot path reports through:
   for the continuous-batching LLM engine.
 - ``train``: step-duration / samples-per-sec / loss reporting for
   ``train`` sessions and RLlib learners.
+- ``rl``: the decoupled-RL (podracer) plane — env-step vs
+  learner-sample throughput counters, weight version/staleness gauges
+  for the versioned WeightStore channel, sample-queue depth and
+  backpressure counters, inference-server batching factors.
 - ``collective``: op/bytes counters and latency histograms for every
   ``util.collective`` op (``rtpu_collective_*{op,backend,dtype}``),
   plus ``collective:<op>`` timeline spans — the interconnect side of
@@ -97,6 +101,7 @@ from ray_tpu.observability.profiling import (  # noqa: F401
     observe_sched_phases,
     render_speedscope,
 )
+from ray_tpu.observability.rl import rl_metrics  # noqa: F401
 from ray_tpu.observability.serve import serve_metrics  # noqa: F401
 from ray_tpu.observability.timeline import build_chrome_trace  # noqa: F401
 from ray_tpu.observability.train import (  # noqa: F401
@@ -107,7 +112,8 @@ from ray_tpu.observability.train import (  # noqa: F401
 
 __all__ = [
     "RecompileWarning", "TrackedJit", "tracked_jit", "jit_stats",
-    "sample_device_metrics", "serve_metrics", "train_metrics",
+    "sample_device_metrics", "serve_metrics", "rl_metrics",
+    "train_metrics",
     "learner_metrics", "batch_num_samples", "build_chrome_trace",
     "data_metrics", "object_store_metrics", "register_store_sampler",
     "EVENT_TYPES", "SEVERITIES", "WORKER_EXIT_TYPES",

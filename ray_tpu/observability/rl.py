@@ -1,0 +1,90 @@
+"""Decoupled-RL (podracer) instrumentation.
+
+The Podracer/Sebulba split (arXiv:2104.06272) turns one question into
+the whole performance story: is acting or learning the bottleneck?
+This metric set carries exactly the signals that answer it:
+
+- throughput on both sides of the queue (``rl_env_steps_total`` from
+  env runners vs ``rl_samples_total`` consumed by learner updates);
+- the versioned weight channel (``rl_weight_version`` published by the
+  learner pool, ``rl_weight_staleness`` = published-minus-behavior
+  version observed at each update, ``rl_weight_publish_seconds``);
+- the bounded sample queue (``rl_sample_queue_depth``,
+  ``rl_backpressure_waits_total`` — acting throttled instead of
+  OOMing, ``rl_dropped_stale_total`` — batches past the staleness
+  clip);
+- inference-server batching efficiency (``rl_infer_requests_total`` vs
+  ``rl_infer_batches_total``; their ratio is the achieved batching
+  factor, ``rl_infer_batch_rows`` the latest batch's row count).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_rl = None
+_lock = threading.Lock()
+
+# Weight publication is an object-store put of a full pytree: 10ms..s.
+_PUBLISH_BOUNDARIES = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 15.0, 60.0)
+
+
+class RLMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        self.env_steps = Counter(
+            "rl_env_steps_total",
+            description="Environment steps sampled by env runners.")
+        self.samples = Counter(
+            "rl_samples_total",
+            description="Sample rows consumed by learner-pool updates.")
+        self.infer_requests = Counter(
+            "rl_infer_requests_total",
+            description="infer() requests handled by inference "
+                        "servers.")
+        self.infer_batches = Counter(
+            "rl_infer_batches_total",
+            description="Batched policy forwards run by inference "
+                        "servers (requests/batches = achieved "
+                        "batching factor).")
+        self.dropped_stale = Counter(
+            "rl_dropped_stale_total",
+            description="Sample batches dropped because their behavior "
+                        "weight version fell behind the staleness "
+                        "clip.")
+        self.backpressure_waits = Counter(
+            "rl_backpressure_waits_total",
+            description="Full-queue waits endured by the acting side "
+                        "(throttling instead of unbounded buffering).")
+        self.weight_version = Gauge(
+            "rl_weight_version",
+            description="Latest weight version published to the "
+                        "WeightStore channel.")
+        self.weight_staleness = Gauge(
+            "rl_weight_staleness",
+            description="Published-minus-behavior weight version of "
+                        "the most recent learner-pool update.")
+        self.queue_depth = Gauge(
+            "rl_sample_queue_depth",
+            description="Depth of the bounded sample queue between "
+                        "acting and learning.")
+        self.infer_batch_rows = Gauge(
+            "rl_infer_batch_rows",
+            description="Rows in the most recent inference-server "
+                        "batch (after request coalescing, before "
+                        "bucket padding).")
+        self.publish_seconds = Histogram(
+            "rl_weight_publish_seconds",
+            boundaries=_PUBLISH_BOUNDARIES,
+            description="Wall time of one WeightStore publish (object "
+                        "store put + registry update).")
+
+
+def rl_metrics() -> RLMetrics:
+    global _rl
+    with _lock:
+        if _rl is None:
+            _rl = RLMetrics()
+        return _rl

@@ -23,6 +23,8 @@ from ray_tpu.rllib.core.rl_module import MLPModule, RLModuleSpec
 from ray_tpu.rllib.env.multi_agent_env import (MultiAgentCartPole,
                                                MultiAgentEnv,
                                                RockPaperScissors)
+from ray_tpu.rllib.podracer import (InferenceServer, LearnerPool,
+                                    WeightStore)
 
 __all__ = ["APPO", "APPOConfig", "ARS", "ARSConfig", "BC", "BCConfig",
            "DQN", "DQNConfig", "ES", "ESConfig",
@@ -32,7 +34,8 @@ __all__ = ["APPO", "APPOConfig", "ARS", "ARSConfig", "BC", "BCConfig",
            "TD3", "TD3Config", "DDPG", "DDPGConfig",
            "LearnerGroup", "MLPModule", "RLModuleSpec",
            "MultiRLModule", "MultiRLModuleSpec", "MultiAgentEnv",
-           "MultiAgentCartPole", "RockPaperScissors"]
+           "MultiAgentCartPole", "RockPaperScissors",
+           "InferenceServer", "LearnerPool", "WeightStore"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 

@@ -58,6 +58,22 @@ class AlgorithmConfig:
         self.evaluation_num_env_runners = 1
         self.evaluation_duration = 5          # episodes per evaluation
         self.evaluation_explore = False
+        # Decoupled (Podracer/Sebulba) execution — "colocated" keeps
+        # the classic per-runner forward + synchronous LearnerGroup;
+        # "decoupled" splits acting onto InferenceServers and learning
+        # onto a queue-fed LearnerPool joined by the versioned
+        # WeightStore channel. None-valued knobs fall back to the
+        # GlobalConfig rl_* entries at build time.
+        self.execution = "colocated"
+        self.num_inference_servers = 1
+        self.inference_max_batch_rows = 256
+        self.inference_batch_wait_s = None
+        self.weight_poll_interval_s = None
+        self.sample_queue_maxsize = None
+        self.staleness_clip = None
+        self.weight_publish_interval = 0      # 0 = once per learner kick
+        self.learner_update_delay_s = 0.0     # test hook: slow learner
+        self.weight_history = None
 
     # fluent builder sections (reference algorithm_config.py style)
     def environment(self, env) -> "AlgorithmConfig":
@@ -145,12 +161,40 @@ class Algorithm:
     learner_class = None
     ma_learner_class = None   # multi-agent learner (None -> unsupported)
     rl_module_class = None    # None -> default actor-critic MLP
+    # ES/ARS publish theta through the versioned channel even when
+    # colocated; they flip this on to get a WeightStore regardless of
+    # config.execution.
+    needs_weight_channel = False
 
     def __init__(self, config: AlgorithmConfig):
+        from ray_tpu._private.config import GlobalConfig
         from ray_tpu.rllib.core.learner_group import LearnerGroup
 
         self.config = config
         self.multi_agent = config.policies is not None
+        self.execution = getattr(config, "execution", "colocated")
+        if self.execution not in ("colocated", "decoupled"):
+            raise ValueError(
+                f"execution must be 'colocated' or 'decoupled', got "
+                f"{self.execution!r}")
+        decoupled = self.execution == "decoupled"
+        if decoupled and self.multi_agent:
+            raise NotImplementedError(
+                "execution='decoupled' supports single-agent algorithms")
+        self._staleness_clip = int(
+            GlobalConfig.rl_staleness_clip
+            if getattr(config, "staleness_clip", None) is None
+            else config.staleness_clip)
+        self.weight_store = None
+        self.inference_servers: List[Any] = []
+        self.sample_queue = None
+        self.learner_pool = None
+        self._inflight_samples: Dict[Any, Any] = {}
+        if decoupled or self.needs_weight_channel:
+            from ray_tpu.rllib.podracer import WeightStore
+
+            self.weight_store = WeightStore(
+                history=getattr(config, "weight_history", None))
         probe_env = make_env(config.env)
         learner_class = self.learner_class
         if self.multi_agent:
@@ -207,11 +251,31 @@ class Algorithm:
                         obs_space)
             self.module_spec = self._default_module_spec(
                 obs_space, probe_env.action_space)
+            if decoupled:
+                from ray_tpu.rllib.podracer import InferenceServer
+
+                self.inference_servers = [
+                    InferenceServer.remote(
+                        self.module_spec,
+                        weight_store=self.weight_store,
+                        max_batch_rows=config.inference_max_batch_rows,
+                        batch_wait_s=config.inference_batch_wait_s,
+                        weight_poll_interval_s=(
+                            config.weight_poll_interval_s),
+                        seed=config.seed + 90_000 + i)
+                    for i in range(max(1, config.num_inference_servers))
+                ]
             self.env_runners = [
-                EnvRunner.remote(config.env, self.module_spec,
-                                 num_envs=config.num_envs_per_runner,
-                                 seed=config.seed + i,
-                                 connectors=config.connectors)
+                EnvRunner.remote(
+                    config.env, self.module_spec,
+                    num_envs=config.num_envs_per_runner,
+                    seed=config.seed + i,
+                    connectors=config.connectors,
+                    inference_server=(
+                        self.inference_servers[
+                            i % len(self.inference_servers)]
+                        if decoupled else None),
+                    weight_store=self.weight_store)
                 for i in range(config.num_env_runners)
             ]
         self.eval_runners: List[Any] = []
@@ -228,15 +292,47 @@ class Algorithm:
                                  connectors=config.connectors)
                 for i in range(config.evaluation_num_env_runners)
             ]
-        self.learner_group = LearnerGroup(
-            learner_class, self.module_spec,
-            learner_config=self._learner_config(),
-            scaling_config=ScalingConfig(num_workers=config.num_learners),
-            jax_config=JaxConfig(platform=config.jax_platform))
+        if decoupled:
+            from ray_tpu._private.config import GlobalConfig
+            from ray_tpu.rllib.podracer import LearnerPool
+            from ray_tpu.util.queue import Queue
+
+            maxsize = int(
+                GlobalConfig.rl_sample_queue_maxsize
+                if config.sample_queue_maxsize is None
+                else config.sample_queue_maxsize)
+            # The queue actor must serve a blocked get() and a put()
+            # concurrently; the default concurrency of 1 would make
+            # every get(timeout) stall puts for its full timeout.
+            self.sample_queue = Queue(
+                maxsize=maxsize,
+                actor_options={"max_concurrency": 8})
+            self.learner_group = None
+            self.learner_pool = LearnerPool(
+                learner_class, self.module_spec,
+                learner_config=self._learner_config(),
+                queue=self.sample_queue,
+                weight_store=self.weight_store,
+                num_workers=config.num_learners,
+                staleness_clip=self._staleness_clip,
+                publish_interval=config.weight_publish_interval,
+                update_delay_s=config.learner_update_delay_s,
+                seed=config.seed)
+        else:
+            self.learner_group = LearnerGroup(
+                learner_class, self.module_spec,
+                learner_config=self._learner_config(),
+                scaling_config=ScalingConfig(
+                    num_workers=config.num_learners),
+                jax_config=JaxConfig(platform=config.jax_platform))
         self._iteration = 0
         self._recent_returns: List[float] = []
         self._agent_returns: Dict[str, List[float]] = {}
-        self._sync_weights()
+        if not decoupled:
+            # Decoupled runners have no local policy to sync: version 1
+            # is already in the WeightStore channel (published by the
+            # learner pool) and the servers pull it.
+            self._sync_weights()
 
     def _default_module_spec(self, obs_space, act_space) -> RLModuleSpec:
         """Algorithms with a fixed module keep it (DQN's QModule, SAC's
@@ -299,7 +395,7 @@ class Algorithm:
             raise ValueError(
                 "no evaluation workers; set config.evaluation("
                 "evaluation_interval=...) before build()")
-        weights = self._eval_weights(self.learner_group.get_weights())
+        weights = self._eval_weights(self.get_policy_weights())
         ref = ray_tpu.put(weights)
         syncs = [r.set_weights.remote(ref) for r in self.eval_runners]
         if self.config.connectors:
@@ -334,6 +430,12 @@ class Algorithm:
         raise NotImplementedError
 
     # ------------------------------------------------------------------ utils
+    def get_policy_weights(self):
+        """Current policy weights, wherever learning happens."""
+        if self.learner_pool is not None:
+            return self.learner_pool.get_weights()
+        return self.learner_group.get_weights()
+
     def sample_batch(self, num_steps_per_runner: int
                      ) -> List[Dict[str, np.ndarray]]:
         """Parallel rollouts from all runners, time-major fragments."""
@@ -346,6 +448,24 @@ class Algorithm:
                 self._agent_returns.setdefault(agent, []).extend(rets)
         return rollouts
 
+    def sample_batch_decoupled(self, num_steps_per_runner: int
+                               ) -> List[Dict[str, np.ndarray]]:
+        """Continuous sampling for decoupled execution: keep one
+        sample() outstanding per runner, harvest the completed round,
+        and resubmit BEFORE processing — so iteration i+1's acting
+        overlaps iteration i's learning (the Podracer overlap)."""
+        if not self._inflight_samples:
+            self._inflight_samples = {
+                r.sample.remote(num_steps_per_runner): r
+                for r in self.env_runners}
+        rollouts = ray_tpu.get(list(self._inflight_samples), timeout=600)
+        self._inflight_samples = {
+            r.sample.remote(num_steps_per_runner): r
+            for r in self.env_runners}
+        for ro in rollouts:
+            self._recent_returns.extend(ro.pop("episode_returns"))
+        return rollouts
+
     def _sync_weights(self, weights=None) -> None:
         if weights is None:
             weights = self.learner_group.get_weights()
@@ -354,10 +474,29 @@ class Algorithm:
                     timeout=600)
 
     def stop(self) -> None:
-        self.learner_group.shutdown()
-        for r in self.env_runners + self.eval_runners:
+        if self.learner_group is not None:
+            self.learner_group.shutdown()
+        if self.learner_pool is not None:
+            self.learner_pool.shutdown()
+        for s in self.inference_servers:
+            try:
+                ray_tpu.get(s.shutdown.remote(), timeout=30)
+            except Exception:
+                pass
+        for r in self.env_runners + self.eval_runners \
+                + self.inference_servers:
             try:
                 ray_tpu.kill(r)
+            except Exception:
+                pass
+        if self.sample_queue is not None:
+            try:
+                self.sample_queue.shutdown()
+            except Exception:
+                pass
+        if self.weight_store is not None:
+            try:
+                self.weight_store.shutdown()
             except Exception:
                 pass
 

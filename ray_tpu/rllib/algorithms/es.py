@@ -80,6 +80,10 @@ class ARSConfig(ESConfig):
 
 class ES(Algorithm):
     learner_class = _WeightHolderLearner
+    # Theta rides the versioned WeightStore channel (one publish per
+    # iteration, one fetch per runner per version) instead of a bespoke
+    # put-once ObjectRef broadcast.
+    needs_weight_channel = True
 
     def __init__(self, config: ESConfig):
         super().__init__(config)
@@ -116,18 +120,19 @@ class ES(Algorithm):
         eps = np.stack([np.random.RandomState(int(s)).randn(dim)
                         .astype(np.float32) for s in seeds])
 
-        # Ship theta ONCE: a top-level ObjectRef arg resolves on the
-        # runner from the object store, so the 2*P actor calls carry
-        # (ref, seed, sigma, sign) instead of 2*P full perturbed
-        # pytrees. Antithetic twins share the noise seed.
-        theta_ref = ray_tpu.put(self._unravel(self._flat))
+        # Publish theta ONCE into the versioned WeightStore channel:
+        # each runner fetches it once per version (cached across this
+        # iteration's perturbations), so the 2*P actor calls carry only
+        # (version, seed, sigma, sign) scalars instead of 2*P full
+        # perturbed pytrees. Antithetic twins share the noise seed.
+        version = self.weight_store.publish(self._unravel(self._flat))
         refs: List[Any] = []
         n_runners = len(self.env_runners)
         for i in range(P):
             for s, signed in ((0, 1.0), (1, -1.0)):
                 runner = self.env_runners[(2 * i + s) % n_runners]
                 runner.set_perturbed_weights.remote(
-                    theta_ref, int(seeds[i]), float(sigma), signed)
+                    version, int(seeds[i]), float(sigma), signed)
                 refs.append(runner.sample_episodes.remote(
                     cfg.episodes_per_perturbation, explore=False))
         results = ray_tpu.get(refs, timeout=600)

@@ -147,8 +147,19 @@ class IMPALA(Algorithm):
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
+        decoupled = self.execution == "decoupled"
         metrics: Dict[str, Any] = {}
         consumed = 0
+        throttled = 0
+        behavior = 0
+        kick = None
+        if decoupled:
+            # Kick consumers first; groups formed this iteration can
+            # never undershoot this (the carried partial group only
+            # adds), and any extra stays queued for the next kick.
+            expected = max(1, cfg.num_rollouts_per_iteration
+                           // cfg.num_rollouts_per_update)
+            kick = self.learner_pool.kick(expected)
         pending = self._pending
         while consumed < cfg.num_rollouts_per_iteration:
             ready, _ = ray_tpu.wait(list(self._inflight),
@@ -159,6 +170,8 @@ class IMPALA(Algorithm):
             runner = self._inflight.pop(ref)
             rollout = ray_tpu.get(ref, timeout=60)
             self._recent_returns.extend(rollout.pop("episode_returns"))
+            behavior = max(behavior,
+                           int(rollout.pop("weight_version", 0)))
             # Immediately resubmit — sampling never waits on learning.
             self._inflight[runner.sample.remote(
                 cfg.rollout_fragment_length)] = runner
@@ -177,10 +190,28 @@ class IMPALA(Algorithm):
                 batch = {k: np.concatenate([p[k] for p in pending])
                          for k in pending[0]}
                 pending.clear()
-                metrics.update(self.learner_group.update(batch))
-        # Weight sync once per iteration: the gap IS the off-policyness
-        # V-trace corrects.
-        self._sync_weights()
+                if decoupled:
+                    from ray_tpu.rllib.podracer import feed_queue
+
+                    batch["weight_version"] = behavior
+                    throttled += feed_queue(self.sample_queue, batch,
+                                            timeout_s=5.0)
+                else:
+                    metrics.update(self.learner_group.update(batch))
+        if decoupled:
+            stats = self.learner_pool.join(kick)
+            metrics.update(stats.get("last_metrics", {}))
+            metrics.update(
+                weight_version=stats["weight_version"],
+                weight_staleness_max=stats["max_staleness"],
+                dropped_stale=stats.get("dropped", 0),
+                backpressure_waits=throttled)
+        else:
+            # Weight sync once per iteration: the gap IS the
+            # off-policyness V-trace corrects. (Decoupled: the
+            # WeightStore channel carries it instead, and the learner
+            # pool's staleness clip bounds it.)
+            self._sync_weights()
         metrics["num_rollouts"] = consumed
         return metrics
 

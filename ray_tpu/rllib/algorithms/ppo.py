@@ -148,6 +148,8 @@ class PPO(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         if self.multi_agent:
             return self._multi_agent_step()
+        if self.execution == "decoupled":
+            return self._decoupled_step()
         c = self.config
         lanes = c.num_env_runners * c.num_envs_per_runner
         steps_per_runner = max(1, c.train_batch_size // lanes)
@@ -171,6 +173,65 @@ class PPO(Algorithm):
                     {k: v[idx] for k, v in batch.items()})
         self._sync_weights()
         metrics["num_env_steps_sampled"] = n
+        return metrics
+
+    def _decoupled_step(self) -> Dict[str, Any]:
+        """Podracer execution: runners act through inference servers
+        while the learner pool consumes stamped minibatches from the
+        bounded queue; weights return via the WeightStore channel.
+
+        Every minibatch is the SAME fixed size (last partial slice of
+        each epoch dropped, exactly like the colocated path), so the
+        pool's zero-sharded step compiles once."""
+        from ray_tpu.rllib.podracer import feed_queue
+
+        c = self.config
+        lanes = c.num_env_runners * c.num_envs_per_runner
+        steps_per_runner = max(1, c.train_batch_size // lanes)
+
+        rollouts = self.sample_batch_decoupled(steps_per_runner)
+        # Behavior version: the freshest weights any rollout acted with
+        # (per-step versions differ only around a publish boundary).
+        behavior = max(int(ro.pop("weight_version", 0))
+                       for ro in rollouts)
+        batch = _build_ppo_batch(rollouts, c.gamma, c.gae_lambda)
+
+        n = len(batch["obs"])
+        mb = max(1, min(c.minibatch_size, n))
+        rng = np.random.RandomState(self._iteration)
+        planned = []
+        for _ in range(c.num_epochs):
+            perm = rng.permutation(n)
+            for lo in range(0, n - mb + 1, mb):
+                idx = perm[lo:lo + mb]
+                planned.append({k: v[idx] for k, v in batch.items()})
+        # Kick consumers BEFORE feeding: producers may block on the
+        # queue bound, and that backpressure must drain somewhere.
+        kick = self.learner_pool.kick(len(planned))
+        throttled = 0
+        for mbatch in planned:
+            mbatch["weight_version"] = behavior
+        # One queue item (chunk of minibatches) per learner worker: the
+        # round trip to the queue actor costs more than a minibatch
+        # update, so feeding singly would serialize the pool on RPC
+        # latency instead of compute — and more chunks than consumers
+        # just buys extra round trips.
+        n_chunks = max(1, len(self.learner_pool.workers))
+        per_chunk = max(1, -(-len(planned) // n_chunks))
+        for lo in range(0, len(planned), per_chunk):
+            throttled += feed_queue(self.sample_queue,
+                                    planned[lo:lo + per_chunk],
+                                    timeout_s=5.0)
+        stats = self.learner_pool.join(kick)
+        metrics = dict(stats.get("last_metrics", {}))
+        metrics.update(
+            num_env_steps_sampled=n,
+            weight_version=stats["weight_version"],
+            weight_staleness_max=stats["max_staleness"],
+            dropped_stale=stats.get("dropped", 0),
+            backpressure_waits=throttled,
+            num_updates_applied=stats.get("applied", 0),
+        )
         return metrics
 
     def _multi_agent_step(self) -> Dict[str, Any]:

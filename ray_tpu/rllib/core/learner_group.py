@@ -121,16 +121,27 @@ class LearnerGroup:
 
 def _split_batch(batch: Dict[str, Any], n: int) -> List[Dict[str, Any]]:
     """Even split along axis 0 of every leaf (handles nested multi-agent
-    batches {module_id: {k: array}} the same as flat ones)."""
+    batches {module_id: {k: array}} the same as flat ones).
+
+    Row counts not divisible by `n` distribute the remainder
+    deterministically — one extra row to each of the first
+    ``len(v) % n`` shards — and every row is conserved (the old
+    floor-division split silently dropped the remainder)."""
     if n == 1:
         return [batch]
 
     def _shard(v, i):
         v = np.asarray(v)
-        per = len(v) // n
-        return v[i * per:(i + 1) * per]
+        per, rem = divmod(len(v), n)
+        start = i * per + min(i, rem)
+        return v[start:start + per + (1 if i < rem else 0)]
 
     import jax
 
-    return [jax.tree.map(lambda v, i=i: _shard(v, i), batch)
-            for i in range(n)]
+    shards = [jax.tree.map(lambda v, i=i: _shard(v, i), batch)
+              for i in range(n)]
+    first = jax.tree.leaves(batch)[0]
+    got = sum(len(jax.tree.leaves(s)[0]) for s in shards)
+    assert got == len(np.asarray(first)), \
+        f"_split_batch dropped rows: {got} != {len(np.asarray(first))}"
+    return shards

@@ -7,6 +7,20 @@ Here the runner steps N env copies in lockstep with a batched CPU forward
 (jax pinned to the host CPU device so a TPU-holding driver never contends
 for the chip); preprocessing lives in the configured connector pipeline,
 never hard-coded in the loop.
+
+Two weight paths exist beyond the plain `set_weights` push:
+
+- **Thin-client mode** (Sebulba, `execution="decoupled"`): constructed
+  with an `inference_server`, the runner holds no current policy at
+  all — `_forward` ships observations to the server's batched jitted
+  forward and receives actions plus the weight version that produced
+  them, which the runner stamps onto every rollout for downstream
+  staleness accounting.
+- **Versioned perturbations** (ES/ARS): constructed with a
+  `weight_store`, `set_perturbed_weights` pulls the canonical theta
+  for a published version from the channel (cached per version, so P
+  perturbations cost one fetch) and regenerates its noise row locally
+  from the integer seed.
 """
 
 from __future__ import annotations
@@ -24,10 +38,16 @@ from ray_tpu.rllib.env.cartpole import make_env
 @ray_tpu.remote(num_cpus=1)
 class EnvRunner:
     def __init__(self, env_spec, module_spec: RLModuleSpec,
-                 num_envs: int = 1, seed: int = 0, connectors=None):
+                 num_envs: int = 1, seed: int = 0, connectors=None,
+                 inference_server=None, weight_store=None):
         import jax
 
         self._cpu = jax.devices("cpu")[0]
+        self._server = inference_server
+        self._weight_store = weight_store
+        self._weight_version = 0
+        self._theta_cache = None
+        self._theta_version = -1
         self._envs = [make_env(env_spec, seed=seed * 10007 + i)
                       for i in range(num_envs)]
         with jax.default_device(self._cpu):
@@ -45,6 +65,11 @@ class EnvRunner:
             self._pipeline.reset(num_envs)
         self._recurrent = (self._pipeline.recurrent_stage
                            if self._pipeline is not None else None)
+        if self._server is not None and self._recurrent is not None:
+            raise ValueError(
+                "thin-client mode cannot carry recurrent state through "
+                "a shared inference server; use colocated execution "
+                "for recurrent modules")
         # Lanes reset after the PREVIOUS step (carried across fragments
         # so stage state resets line up with episode boundaries).
         self._resets = np.zeros(num_envs, bool)
@@ -58,20 +83,32 @@ class EnvRunner:
             self._params = jax.device_put(weights, self._cpu)
         return True
 
-    def set_perturbed_weights(self, theta, seed: int, sigma: float,
+    def set_perturbed_weights(self, version: int, seed: int, sigma: float,
                               sign: float) -> bool:
-        """ES/ARS fast path: install theta + sign*sigma*eps(seed).
+        """ES/ARS fast path: install theta(version) + sign*sigma*eps(seed).
 
-        The driver ships the canonical theta ONCE per iteration as an
-        ObjectRef (top-level args resolve from the object store by
-        reference) and each runner regenerates its noise row locally
-        from the integer seed — so per perturbation only three scalars
-        travel, instead of a full perturbed pytree 2*P times."""
+        The driver publishes the canonical theta ONCE per iteration
+        into the versioned WeightStore channel; each runner fetches it
+        once per VERSION (cached across the iteration's perturbations)
+        and regenerates its noise row locally from the integer seed —
+        so per perturbation only four scalars travel, instead of a
+        full perturbed pytree 2*P times."""
         import jax
         from jax.flatten_util import ravel_pytree
 
+        if self._weight_store is None:
+            raise ValueError(
+                "set_perturbed_weights needs the versioned weight "
+                "channel; construct the runner with weight_store=...")
+        if int(version) != self._theta_version:
+            got, theta = self._weight_store.fetch(int(version))
+            if theta is None:
+                raise RuntimeError(
+                    f"weight version {version} expired from the "
+                    f"channel (latest {got})")
+            self._theta_cache, self._theta_version = theta, int(version)
         with jax.default_device(self._cpu):
-            flat, unravel = ravel_pytree(theta)
+            flat, unravel = ravel_pytree(self._theta_cache)
             flat = np.asarray(flat, np.float32)
             eps = np.random.RandomState(seed).randn(
                 flat.size).astype(np.float32)
@@ -173,7 +210,19 @@ class EnvRunner:
         return self._pipeline.env_to_module(
             raw_obs.astype(np.float32), self._resets)
 
+    def _remote_forward(self, proc_obs: np.ndarray) -> Dict[str, Any]:
+        """Thin-client step: one blocking round trip to the inference
+        server, which coalesces concurrent runners into one batched
+        jitted forward. The reply's weight_version is remembered and
+        stamped onto the rollout."""
+        server = self._server  # peer actor, not this runner (no self-wait)
+        out = ray_tpu.get(server.infer.remote(proc_obs), timeout=300)
+        self._weight_version = int(out.get("weight_version", 0))
+        return out
+
     def _forward(self, proc_obs: np.ndarray, key):
+        if self._server is not None:
+            return self._remote_forward(proc_obs)
         if self._recurrent is not None and getattr(
                 self._module, "is_recurrent", False):
             state_in = self._recurrent.state_for_step(
@@ -242,7 +291,9 @@ class EnvRunner:
                          if self._pipeline is None
                          else self._pipeline.peek(
                              self._obs.astype(np.float32)))
-            if self._recurrent is not None and getattr(
+            if self._server is not None:
+                last_out = self._remote_forward(last_proc)
+            elif self._recurrent is not None and getattr(
                     self._module, "is_recurrent", False):
                 # Current state, WITHOUT advancing the recorded trace.
                 last_out = self._fwd(self._params, last_proc, key,
@@ -274,4 +325,10 @@ class EnvRunner:
         }
         if self._pipeline is not None:
             batch = self._pipeline.module_to_learner(batch)
+        if self._server is not None:
+            from ray_tpu.observability.rl import rl_metrics
+
+            # Behavior version for downstream staleness accounting.
+            batch["weight_version"] = int(self._weight_version)
+            rl_metrics().env_steps.inc(num_steps * n_envs)
         return batch

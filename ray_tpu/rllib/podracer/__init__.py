@@ -1,0 +1,37 @@
+"""Podracer-style decoupled RL execution (arXiv:2104.06272).
+
+Three pieces, connected only through the object store:
+
+- :class:`InferenceServer` — batches observations from many env
+  runners into one jitted policy forward (Sebulba's actor split).
+- :class:`WeightStore` — the versioned weight-publication channel:
+  learners put weights once per version, subscribers pull at their own
+  cadence with bounded, *measured* staleness.
+- :class:`LearnerPool` — queue-fed ``build_zero_train_step`` updates
+  (gradient collectives via ``util.collective``: Backend.PALLAS on
+  TPU, lax/interpret on the tier-1 CPU path), decoupled from acting
+  with an IMPALA/APPO-style staleness clip.
+
+``AlgorithmConfig.training(execution="decoupled")`` wires PPO/IMPALA
+onto this path; ``podracer.rlhf`` runs the same plumbing with an LLM
+policy (the RLHF shape).
+"""
+
+from ray_tpu.rllib.podracer.inference_server import (  # noqa: F401
+    InferenceServer,
+)
+from ray_tpu.rllib.podracer.learner_pool import (  # noqa: F401
+    LearnerPool,
+    feed_queue,
+)
+from ray_tpu.rllib.podracer.rlhf import (  # noqa: F401
+    LLMPolicyModule,
+    RLHFLearner,
+    run_rlhf_smoke,
+)
+from ray_tpu.rllib.podracer.weight_store import WeightStore  # noqa: F401
+
+__all__ = [
+    "InferenceServer", "LearnerPool", "WeightStore", "feed_queue",
+    "LLMPolicyModule", "RLHFLearner", "run_rlhf_smoke",
+]

@@ -1,0 +1,271 @@
+"""InferenceServer: batched policy forwards for many env runners.
+
+Sebulba (Podracer, arXiv:2104.06272) splits the actor half of RL into
+cheap environment steppers and a dedicated inference server that owns
+accelerator devices: runners ship observations, the server coalesces
+them into one jitted ``forward_exploration`` and scatters actions
+back. One compiled program amortized over every runner replaces N
+per-runner forwards — the same economics as the serve/llm engine's
+continuous batching, in miniature.
+
+Batching borrows the engine's two tricks directly: a short gather
+window so concurrent submitters land in the same batch, and
+power-of-two row buckets so the jit cache stays bounded (the engine
+buckets batch slots for the same reason). Because the server only sees
+an observation array and an RLModule, an LLM policy module
+(``podracer.rlhf.LLMPolicyModule``) drops in unchanged — observations
+become token contexts, which is the RLHF shape.
+
+Weights arrive through the versioned WeightStore channel: a jittered
+poll loop installs new versions at the server's own cadence and stamps
+every reply with the version that produced it, so downstream staleness
+accounting is exact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+# max_concurrency must allow many concurrent infer() awaiters; the
+# default of 1 would serialize submissions and nothing would ever
+# batch.
+@ray_tpu.remote(num_cpus=1, max_concurrency=256)
+class InferenceServer:
+    def __init__(self, module_spec, weight_store=None,
+                 max_batch_rows: int = 256,
+                 batch_wait_s: Optional[float] = None,
+                 weight_poll_interval_s: Optional[float] = None,
+                 seed: int = 0):
+        import jax
+
+        from ray_tpu._private.config import GlobalConfig
+
+        self._module = module_spec.build()
+        self._params = self._module.init(jax.random.key(seed))
+        self._fwd = jax.jit(self._module.forward_exploration)
+        self._rng = jax.random.key(seed + 1)
+
+        self._store = weight_store
+        self._version = 0
+        if weight_store is not None:
+            v, weights = weight_store.fetch()
+            if weights is not None:
+                self._params, self._version = weights, v
+
+        self._batch_wait = (GlobalConfig.rl_infer_batch_wait_s
+                            if batch_wait_s is None else float(batch_wait_s))
+        self._poll_interval = (
+            GlobalConfig.rl_weight_poll_interval_s
+            if weight_poll_interval_s is None
+            else float(weight_poll_interval_s))
+        self._max_rows = max(1, int(max_batch_rows))
+        buckets, b = [], 1
+        while b < self._max_rows:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self._max_rows)
+        self._buckets = buckets
+
+        self._pending: list = []
+        self._last_take = 1  # adaptive gather target (see _batcher_loop)
+        self._wake = None  # asyncio.Event; created on the actor loop
+        self._tasks: list = []
+        self._started = False
+        self._stopped = False
+        self._stats = {
+            "requests": 0, "rows": 0, "batches": 0, "padded_rows": 0,
+            "max_requests_per_batch": 0, "max_rows_per_batch": 0,
+            "bucket_counts": {}, "weight_pulls": 0,
+            "poll_errors": 0, "last_poll_error": None,
+        }
+
+    # ------------------------------------------------------------------
+    # Request path
+    # ------------------------------------------------------------------
+
+    async def infer(self, obs) -> dict:
+        """Submit one observation batch [n, ...]; resolves to numpy
+        {"actions", "logp", "vf", "weight_version"} slices of the
+        coalesced forward."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        self._ensure_started(loop)
+        fut = loop.create_future()
+        self._pending.append((np.asarray(obs), fut))
+        self._wake.set()
+        return await fut
+
+    def _ensure_started(self, loop):
+        import asyncio
+
+        if self._started:
+            return
+        self._started = True
+        self._wake = asyncio.Event()
+        self._tasks.append(loop.create_task(self._batcher_loop()))
+        if self._store is not None:
+            self._tasks.append(
+                loop.create_task(self._weight_poll_control_loop()))
+
+    async def _batcher_loop(self):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        while not self._stopped:
+            self._wake.clear()
+            if not self._pending:
+                try:
+                    await asyncio.wait_for(self._wake.wait(), 0.5)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            # Gather window: let concurrent submitters join this batch,
+            # but stop as soon as as many requests as the previous batch
+            # coalesced have arrived — the steady-state submitter count.
+            # A fixed sleep would tax every acting round the full window
+            # even after everyone is already here.
+            deadline = loop.time() + self._batch_wait
+            while len(self._pending) < self._last_take:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), remaining)
+                except asyncio.TimeoutError:
+                    break
+            take, rows = [], 0
+            while self._pending:
+                n = len(self._pending[0][0])
+                if take and rows + n > self._max_rows:
+                    break
+                o, f = self._pending.pop(0)
+                take.append((o, f))
+                rows += n
+            self._last_take = len(take)
+            try:
+                outs = await loop.run_in_executor(
+                    None, self._forward_batch, [o for o, _ in take])
+            except Exception as exc:  # surface to every waiter
+                for _, fut in take:
+                    if not fut.done():
+                        fut.set_exception(RuntimeError(str(exc)))
+                continue
+            for out, (_, fut) in zip(outs, take):
+                if not fut.done():
+                    fut.set_result(out)
+
+    def _forward_batch(self, obs_list):
+        import jax
+
+        from ray_tpu.observability.rl import rl_metrics
+
+        rows = np.concatenate(obs_list, axis=0)
+        n = rows.shape[0]
+        bucket = next((b for b in self._buckets if b >= n), n)
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + rows.shape[1:], rows.dtype)
+            rows = np.concatenate([rows, pad], axis=0)
+        self._rng, key = jax.random.split(self._rng)
+        out = self._fwd(self._params, rows, key)
+        actions = np.asarray(out["actions"])[:n]
+        logp = np.asarray(out["logp"])[:n]
+        vf = np.asarray(out["vf"])[:n]
+
+        s = self._stats
+        s["requests"] += len(obs_list)
+        s["rows"] += n
+        s["batches"] += 1
+        s["padded_rows"] += bucket - n
+        s["max_requests_per_batch"] = max(s["max_requests_per_batch"],
+                                          len(obs_list))
+        s["max_rows_per_batch"] = max(s["max_rows_per_batch"], n)
+        s["bucket_counts"][bucket] = s["bucket_counts"].get(bucket, 0) + 1
+        m = rl_metrics()
+        m.infer_requests.inc(len(obs_list))
+        m.infer_batches.inc()
+        m.infer_batch_rows.set(n)
+
+        outs, lo = [], 0
+        version = self._version
+        for o in obs_list:
+            k = len(o)
+            outs.append({
+                "actions": actions[lo:lo + k],
+                "logp": logp[lo:lo + k],
+                "vf": vf[lo:lo + k],
+                "weight_version": version,
+            })
+            lo += k
+        return outs
+
+    # ------------------------------------------------------------------
+    # Weight channel
+    # ------------------------------------------------------------------
+
+    async def _weight_poll_control_loop(self):
+        import asyncio
+        import random
+
+        while not self._stopped:
+            await asyncio.sleep(
+                self._poll_interval * random.uniform(0.8, 1.2))
+            try:
+                latest = await self._store.actor.latest_version.remote()
+                if latest <= self._version:
+                    continue
+                v, wrapped = await self._store.actor.fetch.remote(None)
+                if not wrapped:
+                    continue
+                # Nested refs are shipped unresolved; awaiting one
+                # resolves it through the in-loop async get path.
+                weights = await wrapped[0]
+                self._install(weights, v)
+            except Exception as exc:
+                # Registry restart or transient RPC failure: the next
+                # jittered tick retries. Kept visible in stats() so a
+                # wedged channel is diagnosable, not silent.
+                self._stats["poll_errors"] += 1
+                self._stats["last_poll_error"] = repr(exc)
+                continue
+
+    def _install(self, weights, version: int):
+        import jax
+
+        self._params = jax.device_put(weights)
+        self._version = int(version)
+        self._stats["weight_pulls"] += 1
+
+    async def set_weights(self, weights, version: Optional[int] = None):
+        """Direct push path for store-less setups (tests, eval)."""
+        self._install(weights, self._version + 1 if version is None
+                      else version)
+        return self._version
+
+    # ------------------------------------------------------------------
+    # Introspection / lifecycle
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        out = dict(self._stats)
+        out["bucket_counts"] = dict(self._stats["bucket_counts"])
+        out["weight_version"] = self._version
+        out["pending"] = len(self._pending)
+        return out
+
+    def weight_version(self) -> int:
+        return self._version
+
+    async def shutdown(self) -> bool:
+        self._stopped = True
+        if self._wake is not None:
+            self._wake.set()
+        for t in self._tasks:
+            t.cancel()
+        return True

@@ -1,0 +1,272 @@
+"""LearnerPool: queue-fed pjit updates decoupled from acting cadence.
+
+The learning half of the Podracer split. Workers pull sample batches
+from a bounded queue and run a ``build_zero_train_step`` update —
+gradients ring-reduce-scattered through ``util.collective`` primitives
+(Backend.PALLAS on real TPU ICI, lax/interpret on the tier-1 CPU
+path) — then publish fresh params into the versioned WeightStore
+channel. Acting never waits for learning and vice versa; the queue's
+bound is the only coupling (backpressure instead of OOM).
+
+Off-policyness is explicit, IMPALA/APPO-style: each batch is stamped
+with the weight version that produced its actions, the worker computes
+``staleness = published_version - behavior_version``, and batches past
+the configured clip are dropped and counted rather than silently
+blended in.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+def feed_queue(queue, item, timeout_s: float = 5.0,
+               max_retries: int = 60) -> int:
+    """Bounded blocking put: when the learner falls behind, acting
+    throttles here instead of buffering without limit. Returns the
+    number of Full waits endured (0 = no backpressure)."""
+    from ray_tpu.observability.rl import rl_metrics
+    from ray_tpu.util.queue import Full
+
+    waits = 0
+    while True:
+        try:
+            queue.put(item, timeout=timeout_s)
+            return waits
+        except Full:
+            waits += 1
+            rl_metrics().backpressure_waits.inc()
+            if waits >= max_retries:
+                raise
+
+
+@ray_tpu.remote(num_cpus=1)
+class _LearnerWorker:
+    """One pool member: local device mesh + zero-sharded train step."""
+
+    def __init__(self, learner_cls, module_spec, learner_config=None,
+                 queue=None, weight_store=None, rank: int = 0,
+                 staleness_clip: int = 4, publish_interval: int = 0,
+                 update_delay_s: float = 0.0, seed: int = 0,
+                 collective: str = "auto"):
+        import jax
+
+        from ray_tpu.parallel.zero import (build_zero_train_step,
+                                           create_zero_state)
+
+        learner = learner_cls(module_spec, dict(learner_config or {}))
+        learner.module = module_spec.build()
+        self._learner = learner
+        self._queue = queue
+        self._store = weight_store
+        self._rank = int(rank)
+        self._clip = int(staleness_clip)
+        self._publish_interval = int(publish_interval)
+        self._delay = float(update_delay_s)
+
+        self._mesh = jax.make_mesh((jax.device_count(),), ("data",))
+        self._n_dev = jax.device_count()
+        params = learner.module.init(jax.random.key(int(seed)))
+        optimizer = learner._make_optimizer()
+        self._state = create_zero_state(params, optimizer, self._mesh)
+        loss_rng = jax.random.key(int(seed) + 1)
+
+        def loss_fn(p, batch):
+            loss, _ = learner.compute_loss(p, batch, loss_rng)
+            return loss
+
+        self._step = build_zero_train_step(
+            loss_fn, optimizer, self._mesh, collective=collective)
+
+        self._version = 0
+        if self._rank == 0 and weight_store is not None:
+            self._version = weight_store.publish(self.get_weights())
+
+        self._applied = 0
+        self._dropped = 0
+        self._consumed = 0
+        self._max_staleness = 0
+        self._staleness_hist: Dict[int, int] = {}
+        self._last_metrics: Dict[str, float] = {}
+
+    def ready(self) -> int:
+        return self._version
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self._state.params)
+
+    def run_updates(self, max_updates: int,
+                    idle_timeout_s: float = 10.0) -> dict:
+        """Consume up to `max_updates` batches from the queue; returns
+        this kick's stats. Ends early after `idle_timeout_s` with no
+        work (the producer stopped or fell behind)."""
+        import time
+
+        from ray_tpu.observability.rl import rl_metrics
+        from ray_tpu.util.queue import Empty
+
+        m = rl_metrics()
+        consumed = applied = dropped = 0
+        pending: List[Any] = []
+        while consumed < max_updates:
+            if not pending:
+                try:
+                    got = self._queue.get(timeout=idle_timeout_s)
+                except Empty:
+                    break
+                # A list item is a chunk of minibatches (producers
+                # amortize the queue round trip); a dict is one batch.
+                pending = list(got) if isinstance(got, list) else [got]
+            item = pending.pop(0)
+            consumed += 1
+            behavior = int(item.pop("weight_version", self._version))
+            staleness = max(0, self._version - behavior)
+            self._max_staleness = max(self._max_staleness, staleness)
+            self._staleness_hist[staleness] = \
+                self._staleness_hist.get(staleness, 0) + 1
+            m.weight_staleness.set(staleness)
+            if staleness > self._clip:
+                dropped += 1
+                m.dropped_stale.inc()
+                continue
+            if self._delay > 0:
+                time.sleep(self._delay)
+            batch = self._pad_rows(item)
+            rows = len(next(iter(batch.values())))
+            self._state, metrics = self._step(self._state, batch)
+            applied += 1
+            m.samples.inc(rows)
+            self._last_metrics = {
+                k: float(np.asarray(v)) for k, v in metrics.items()}
+            if (self._store is not None and self._publish_interval > 0
+                    and applied % self._publish_interval == 0):
+                self._version = self._store.publish(self.get_weights())
+        if self._store is not None and applied > 0:
+            # End-of-kick publish: one version per kick by default, so
+            # staleness counts kicks-behind, not minibatches-behind.
+            self._version = self._store.publish(self.get_weights())
+        self._consumed += consumed
+        self._applied += applied
+        self._dropped += dropped
+        try:
+            m.queue_depth.set(self._queue.qsize())
+        except Exception:
+            pass
+        return self.stats(consumed=consumed, applied=applied,
+                          dropped=dropped)
+
+    def _pad_rows(self, batch: Dict[str, np.ndarray]):
+        """Pad every leading dim up to a multiple of the local device
+        count by wrapping rows — the zero step shards the batch over
+        the mesh and needs an even split; wrapping keeps every real
+        row in the loss."""
+        rows = len(next(iter(batch.values())))
+        target = int(math.ceil(rows / self._n_dev)) * self._n_dev
+        if target == rows:
+            return {k: np.asarray(v) for k, v in batch.items()}
+        idx = np.arange(target) % rows
+        return {k: np.asarray(v)[idx] for k, v in batch.items()}
+
+    def stats(self, **kick) -> dict:
+        out = {
+            "worker": self._rank,
+            "weight_version": self._version,
+            "consumed_total": self._consumed,
+            "applied_total": self._applied,
+            "dropped_stale_total": self._dropped,
+            "max_staleness": self._max_staleness,
+            "staleness_hist": dict(self._staleness_hist),
+            "last_metrics": dict(self._last_metrics),
+        }
+        out.update(kick)
+        return out
+
+
+class LearnerPool:
+    """Driver-side handle on the learner workers.
+
+    The driver kicks a pool run *before* feeding the queue (so
+    consumers exist while producers block on the bound), then joins the
+    kick for merged stats: kick → feed → join.
+    """
+
+    def __init__(self, learner_cls, module_spec, learner_config=None,
+                 queue=None, weight_store=None, num_workers: int = 1,
+                 staleness_clip: int = 4, publish_interval: int = 0,
+                 update_delay_s: float = 0.0, seed: int = 0,
+                 collective: str = "auto", idle_timeout_s: float = 10.0):
+        if queue is None:
+            raise ValueError("LearnerPool needs the bounded sample queue")
+        self._idle_timeout = float(idle_timeout_s)
+        self._workers = [
+            _LearnerWorker.remote(
+                learner_cls, module_spec, learner_config=learner_config,
+                queue=queue, weight_store=weight_store, rank=i,
+                staleness_clip=staleness_clip,
+                publish_interval=publish_interval,
+                update_delay_s=update_delay_s, seed=seed,
+                collective=collective)
+            for i in range(max(1, int(num_workers)))
+        ]
+        ray_tpu.get([w.ready.remote() for w in self._workers], timeout=600)
+
+    @property
+    def workers(self) -> List[Any]:
+        return list(self._workers)
+
+    def kick(self, num_updates: int) -> List[Any]:
+        """Start consuming: each worker takes an even share of
+        `num_updates` (stragglers end on idle timeout)."""
+        per = int(math.ceil(num_updates / len(self._workers)))
+        return [w.run_updates.remote(per, self._idle_timeout)
+                for w in self._workers]
+
+    def join(self, refs: List[Any], timeout: float = 600.0) -> dict:
+        return self._merge(ray_tpu.get(refs, timeout=timeout))
+
+    def run(self, num_updates: int, timeout: float = 600.0) -> dict:
+        return self.join(self.kick(num_updates), timeout=timeout)
+
+    def get_weights(self):
+        return ray_tpu.get(self._workers[0].get_weights.remote(),
+                           timeout=120)
+
+    def stats(self) -> dict:
+        return self._merge(
+            ray_tpu.get([w.stats.remote() for w in self._workers],
+                        timeout=60))
+
+    @staticmethod
+    def _merge(per_worker: List[dict]) -> dict:
+        merged = {
+            "weight_version": max(s["weight_version"] for s in per_worker),
+            "max_staleness": max(s["max_staleness"] for s in per_worker),
+            "last_metrics": per_worker[0].get("last_metrics", {}),
+            "staleness_hist": {},
+            "workers": per_worker,
+        }
+        for key in ("consumed", "applied", "dropped",
+                    "consumed_total", "applied_total",
+                    "dropped_stale_total"):
+            if any(key in s for s in per_worker):
+                merged[key] = sum(s.get(key, 0) for s in per_worker)
+        for s in per_worker:
+            for k, v in s.get("staleness_hist", {}).items():
+                k = int(k)
+                merged["staleness_hist"][k] = \
+                    merged["staleness_hist"].get(k, 0) + v
+        return merged
+
+    def shutdown(self) -> None:
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

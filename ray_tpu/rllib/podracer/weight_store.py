@@ -1,0 +1,173 @@
+"""WeightStore: the versioned weight-publication channel.
+
+Podracer (arXiv:2104.06272) decouples acting from learning by letting
+weights flow through the object store instead of synchronous
+``set_weights`` fan-outs: the learner puts a weight pytree ONCE per
+version, registers the (version, ref) pair with a tiny registry actor,
+and every subscriber — inference servers, env runners, evaluators —
+pulls at its own cadence. Off-policyness stops being implicit: every
+consumer knows exactly which version produced its behavior, and the
+learner pool can clip on it.
+
+The registry never touches weight bytes. Publishers ``ray_tpu.put``
+the pytree and ship the ref wrapped in a list — nested ObjectRefs
+serialize portably *without* being resolved (only top-level task args
+resolve), so the actor stores a pointer, not a copy. Subscribers fetch
+the wrapped ref and resolve it from the object store themselves: the
+put-once broadcast ES/ARS used ad hoc, generalized and versioned.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Optional, Tuple
+
+import ray_tpu
+
+
+# max_concurrency matters: the default of 1 would let a single blocked
+# wait_version() hold the actor's only concurrency slot and deadlock
+# the publisher it is waiting for.
+@ray_tpu.remote(num_cpus=0, max_concurrency=64)
+class _WeightStoreActor:
+    """Version registry. Stores wrapped ObjectRefs, never weight bytes."""
+
+    def __init__(self, history: int = 4):
+        import asyncio
+
+        self._history = max(1, int(history))
+        self._wrapped: "collections.OrderedDict[int, Any]" = \
+            collections.OrderedDict()
+        self._latest = 0
+        self._published_total = 0
+        self._new_version = asyncio.Event()
+
+    async def publish(self, wrapped, version: Optional[int] = None) -> int:
+        import asyncio
+
+        if version is None:
+            version = self._latest + 1
+        if version <= self._latest:
+            # Late publisher lost a race; versions stay monotonic.
+            return self._latest
+        self._wrapped[version] = wrapped
+        self._latest = version
+        self._published_total += 1
+        while len(self._wrapped) > self._history:
+            self._wrapped.popitem(last=False)
+        ev, self._new_version = self._new_version, asyncio.Event()
+        ev.set()
+        return version
+
+    async def wait_version(self, min_version: int,
+                           timeout: Optional[float] = None) -> int:
+        """Block until latest >= min_version (or timeout); returns the
+        latest version either way."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + (3600.0 if timeout is None else timeout)
+        while self._latest < int(min_version):
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            try:
+                await asyncio.wait_for(self._new_version.wait(), remaining)
+            except asyncio.TimeoutError:
+                break
+        return self._latest
+
+    async def fetch(self, version: Optional[int] = None):
+        """(version, wrapped_ref) for an exact version, or the latest
+        when version is None. (0, None) if absent/expired."""
+        v = self._latest if version is None else int(version)
+        wrapped = self._wrapped.get(v)
+        if wrapped is None:
+            return 0, None
+        return v, wrapped
+
+    async def latest_version(self) -> int:
+        return self._latest
+
+    async def stats(self) -> dict:
+        return {
+            "latest_version": self._latest,
+            "published_total": self._published_total,
+            "history": self._history,
+            "versions_held": list(self._wrapped.keys()),
+        }
+
+
+class WeightStore:
+    """Client for the versioned weight channel; picklable, so one
+    instance can be handed to runners, servers and learners alike.
+
+    Publishers pin their most recent refs locally: the registry holds
+    refs it received by value, so the originals here keep the objects
+    alive for consumers mid-fetch even after the registry trims its
+    history window.
+    """
+
+    def __init__(self, history: Optional[int] = None, _actor=None):
+        if _actor is not None:
+            self._actor = _actor
+        else:
+            if history is None:
+                from ray_tpu._private.config import GlobalConfig
+
+                history = GlobalConfig.rl_weight_history
+            self._actor = _WeightStoreActor.remote(int(history))
+        self._pinned: collections.deque = collections.deque(maxlen=8)
+
+    @property
+    def actor(self):
+        return self._actor
+
+    def publish(self, weights: Any, version: Optional[int] = None) -> int:
+        """Put `weights` once and advance the channel; returns the
+        assigned version."""
+        from ray_tpu.observability.rl import rl_metrics
+
+        t0 = time.perf_counter()
+        ref = ray_tpu.put(weights)
+        self._pinned.append(ref)
+        v = ray_tpu.get(self._actor.publish.remote([ref], version),
+                        timeout=60)
+        m = rl_metrics()
+        m.weight_version.set(v)
+        m.publish_seconds.observe(time.perf_counter() - t0)
+        return int(v)
+
+    def latest_version(self) -> int:
+        return int(ray_tpu.get(self._actor.latest_version.remote(),
+                               timeout=60))
+
+    def fetch(self, version: Optional[int] = None
+              ) -> Tuple[int, Optional[Any]]:
+        """(version, weights) — latest when version is None; (0, None)
+        when nothing is published or the version expired."""
+        v, wrapped = ray_tpu.get(self._actor.fetch.remote(version),
+                                 timeout=60)
+        if not wrapped:
+            return 0, None
+        return int(v), ray_tpu.get(wrapped[0], timeout=60)
+
+    def poll(self, have_version: int = 0,
+             timeout: Optional[float] = None
+             ) -> Tuple[int, Optional[Any]]:
+        """Block until a version newer than `have_version` exists (or
+        timeout). Returns (new_version, weights), or
+        (have_version, None) on timeout."""
+        v = ray_tpu.get(
+            self._actor.wait_version.remote(int(have_version) + 1, timeout),
+            timeout=(timeout or 3600) + 30)
+        if v <= have_version:
+            return have_version, None
+        return self.fetch()
+
+    def stats(self) -> dict:
+        return ray_tpu.get(self._actor.stats.remote(), timeout=60)
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self._actor)

@@ -17,3 +17,17 @@ class Tuner:
         # ctrl-unawaited-policy: builds the coroutine, drops it — the
         # policy loop silently never runs.
         self.autoscale_control_loop(state)
+
+
+class Subscriber:
+    """Podracer-style weight-channel poller, both ways it goes wrong."""
+
+    async def weight_poll_control_loop(self, store):
+        while True:
+            store.fetch_latest()
+            await asyncio.sleep(0.1)   # ctrl-unjittered-period: every
+            # subscriber in the fleet hits the registry in phase
+
+    async def staleness_policy_loop(self, store):
+        while True:                 # ctrl-busy-spin: polls the version
+            store.latest_version()  # counter with no sleep at all

@@ -19,3 +19,16 @@ class Tuner:
 
     def start(self, state, loop):
         loop.create_task(self.autoscale_control_loop(state))
+
+
+class Subscriber:
+    """Podracer-style weight-channel poller, done right: jittered
+    period, loop handed to the event loop instead of dropped."""
+
+    async def weight_poll_control_loop(self, store):
+        while not store.closed:
+            store.fetch_latest()
+            await asyncio.sleep(0.1 * random.uniform(0.8, 1.2))
+
+    def start(self, store, loop):
+        loop.create_task(self.weight_poll_control_loop(store))

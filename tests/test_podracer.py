@@ -1,0 +1,314 @@
+"""Podracer decoupled RL: WeightStore channel semantics, inference-server
+batching, queue backpressure, decoupled-vs-colocated PPO parity, bounded
+staleness under a slow learner, and the RLHF sample→score→update smoke
+(reference: Podracer architectures, arXiv:2104.06272)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+from ray_tpu.rllib.env import CartPoleEnv
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.podracer import (
+    InferenceServer,
+    LearnerPool,
+    WeightStore,
+    feed_queue,
+)
+
+
+# ---------------------------------------------------------------- channel
+
+def test_weight_store_versions_and_history(ray_start_regular):
+    store = WeightStore(history=2)
+    try:
+        assert store.latest_version() == 0
+        assert store.fetch() == (0, None)
+
+        v1 = store.publish({"w": np.ones(3, np.float32)})
+        v2 = store.publish({"w": np.full(3, 2.0, np.float32)})
+        v3 = store.publish({"w": np.full(3, 3.0, np.float32)})
+        assert (v1, v2, v3) == (1, 2, 3)
+        assert store.latest_version() == 3
+
+        v, weights = store.fetch()
+        assert v == 3 and np.allclose(weights["w"], 3.0)
+        v, weights = store.fetch(2)
+        assert v == 2 and np.allclose(weights["w"], 2.0)
+
+        # history=2 trims version 1 out of the registry window
+        v, weights = store.fetch(1)
+        assert v == 0 and weights is None
+        stats = store.stats()
+        assert stats["versions_held"] == [2, 3]
+        assert stats["published_total"] == 3
+    finally:
+        store.shutdown()
+
+
+def test_weight_store_poll_blocks_until_new_version(ray_start_regular):
+    store = WeightStore(history=4)
+    try:
+        store.publish({"w": np.zeros(1)})
+        # Nothing newer than version 1 within the timeout: no weights.
+        v, weights = store.poll(have_version=1, timeout=0.2)
+        assert v == 1 and weights is None
+
+        # A publisher racing the poll wakes the waiter.
+        @ray_tpu.remote
+        def _publish_later(store):
+            import time
+
+            time.sleep(0.3)
+            return store.publish({"w": np.ones(1)})
+
+        ref = _publish_later.remote(store)
+        v, weights = store.poll(have_version=1, timeout=10.0)
+        assert v == 2 and np.allclose(weights["w"], 1.0)
+        assert ray_tpu.get(ref, timeout=30) == 2
+    finally:
+        store.shutdown()
+
+
+# ----------------------------------------------------------- inference
+
+def test_inference_server_batches_concurrent_requests(ray_start_regular):
+    env = CartPoleEnv()
+    spec = RLModuleSpec(env.observation_space, env.action_space,
+                        hidden=(16,))
+    server = InferenceServer.remote(spec, max_batch_rows=128,
+                                    batch_wait_s=0.05)
+    try:
+        rng = np.random.RandomState(0)
+        sizes = [4] * 24 + [1, 7]
+        refs = [server.infer.remote(
+                    rng.randn(n, 4).astype(np.float32))
+                for n in sizes]
+        outs = ray_tpu.get(refs, timeout=120)
+        for n, out in zip(sizes, outs):
+            assert out["actions"].shape == (n,)
+            assert out["logp"].shape == (n,)
+            assert out["vf"].shape == (n,)
+            assert np.all(np.asarray(out["logp"]) <= 0)
+            assert out["weight_version"] == 0  # no store attached
+
+        stats = ray_tpu.get(server.stats.remote(), timeout=30)
+        assert stats["requests"] == len(sizes)
+        assert stats["rows"] == sum(sizes)
+        # The 0.05s gather window must have coalesced concurrent
+        # submitters: strictly fewer forwards than requests.
+        assert stats["batches"] < len(sizes)
+        assert stats["max_requests_per_batch"] >= 2
+        # Rows pad up to power-of-two buckets for jit-cache reuse.
+        assert all(b & (b - 1) == 0 or b == 128
+                   for b in stats["bucket_counts"])
+    finally:
+        ray_tpu.get(server.shutdown.remote(), timeout=30)
+        ray_tpu.kill(server)
+
+
+def test_inference_server_set_weights_stamps_version(ray_start_regular):
+    import jax
+
+    env = CartPoleEnv()
+    spec = RLModuleSpec(env.observation_space, env.action_space,
+                        hidden=(8,))
+    module = spec.build()
+    params = jax.device_get(module.init(jax.random.key(7)))
+    server = InferenceServer.remote(spec, batch_wait_s=0.001)
+    try:
+        v = ray_tpu.get(server.set_weights.remote(params), timeout=60)
+        assert v == 1
+        out = ray_tpu.get(
+            server.infer.remote(np.zeros((2, 4), np.float32)),
+            timeout=60)
+        assert out["weight_version"] == 1
+    finally:
+        ray_tpu.get(server.shutdown.remote(), timeout=30)
+        ray_tpu.kill(server)
+
+
+# --------------------------------------------------------- backpressure
+
+def test_feed_queue_backpressure(ray_start_regular):
+    from ray_tpu.util.queue import Full, Queue
+
+    queue = Queue(maxsize=2)
+    try:
+        assert feed_queue(queue, {"i": 0}) == 0
+        assert feed_queue(queue, {"i": 1}) == 0
+        # Queue full, nobody draining: bounded retries then Full.
+        with pytest.raises(Full):
+            feed_queue(queue, {"i": 2}, timeout_s=0.05, max_retries=3)
+        assert queue.qsize() == 2
+
+        # Drain one; the retried put now lands and reports its waits.
+        assert queue.get(timeout=5)["i"] == 0
+        waits = feed_queue(queue, {"i": 2}, timeout_s=0.05,
+                           max_retries=100)
+        assert waits == 0
+        assert queue.qsize() == 2
+    finally:
+        queue.shutdown()
+
+
+# ------------------------------------------------------------- learning
+
+def _cartpole_config(execution, **training):
+    base = dict(execution=execution, train_batch_size=256,
+                minibatch_size=64, num_epochs=2, lr=1e-3)
+    base.update(training)
+    return (PPOConfig()
+            .environment("CartPole-v1")
+            .training(**base)
+            .env_runners(num_env_runners=2, num_envs_per_runner=4))
+
+
+def _best_return(algo, iters, target=None):
+    best = 0.0
+    for _ in range(iters):
+        result = algo.train()
+        best = max(best, result.get("episode_return_mean", 0.0))
+        if target is not None and best >= target:
+            break
+    return best
+
+
+def test_decoupled_ppo_learns_like_colocated(ray_start_regular):
+    """Parity: the decoupled path must actually learn CartPole, not
+    just shuffle versions — both execution modes clear the same bar."""
+    returns = {}
+    for mode in ("colocated", "decoupled"):
+        config = _cartpole_config(
+            mode, train_batch_size=1024, minibatch_size=128,
+            num_epochs=4).learners(num_learners=1, jax_platform="cpu")
+        algo = config.build()
+        try:
+            returns[mode] = _best_return(algo, 12, target=60)
+        finally:
+            algo.stop()
+    assert returns["colocated"] >= 60, returns
+    assert returns["decoupled"] >= 60, returns
+
+
+def test_decoupled_ppo_reports_staleness_and_versions(ray_start_regular):
+    algo = _cartpole_config("decoupled").build()
+    try:
+        versions = []
+        for _ in range(2):
+            m = algo.train()
+            versions.append(m["weight_version"])
+            assert m["weight_staleness_max"] <= algo._staleness_clip
+            assert m["num_updates_applied"] > 0
+            assert np.isfinite(m["loss"])
+        # One publish per learner kick: versions strictly advance.
+        assert versions == sorted(versions)
+        assert versions[-1] > versions[0]
+    finally:
+        algo.stop()
+
+
+def test_staleness_bounded_under_slow_learner(ray_start_regular):
+    """A learner throttled by update_delay_s falls behind acting; the
+    applied updates must still respect the configured clip."""
+    clip = 2
+    algo = _cartpole_config(
+        "decoupled", staleness_clip=clip,
+        learner_update_delay_s=0.02).build()
+    try:
+        for _ in range(3):
+            algo.train()
+        stats = algo.learner_pool.stats()
+        applied_staleness = [s for s, n in stats["staleness_hist"].items()
+                             if n > 0]
+        # Observed staleness may exceed the clip — those batches are
+        # dropped and counted, never applied.
+        dropped = stats["dropped_stale_total"]
+        over = sum(n for s, n in stats["staleness_hist"].items()
+                   if s > clip)
+        assert dropped == over
+        assert stats["applied_total"] + dropped == stats["consumed_total"]
+        assert min(applied_staleness) <= clip
+    finally:
+        algo.stop()
+
+
+def test_learner_pool_drops_batches_past_clip(ray_start_regular):
+    """Deterministic clip check: advance the learner several versions,
+    then feed a batch stamped with the stale behavior version."""
+    from ray_tpu.rllib.algorithms.ppo import PPOLearner
+    from ray_tpu.util.queue import Queue
+
+    env = CartPoleEnv()
+    spec = RLModuleSpec(env.observation_space, env.action_space,
+                        hidden=(8,))
+    store = WeightStore(history=8)
+    queue = Queue(maxsize=8, actor_options={"max_concurrency": 8})
+    pool = LearnerPool(
+        PPOLearner, spec, learner_config={"lr": 1e-3}, queue=queue,
+        weight_store=store, num_workers=1, staleness_clip=1,
+        idle_timeout_s=1.0)
+    try:
+        rng = np.random.RandomState(0)
+
+        def batch(version):
+            return {
+                "obs": rng.randn(16, 4).astype(np.float32),
+                "actions": rng.randint(0, 2, 16).astype(np.int32),
+                "logp_old": np.full(16, -0.7, np.float32),
+                "advantages": rng.randn(16).astype(np.float32),
+                "value_targets": rng.randn(16).astype(np.float32),
+                "weight_version": version,
+            }
+
+        # Three kicks with fresh batches: version advances 1 -> 4.
+        for _ in range(3):
+            kick = pool.kick(1)
+            feed_queue(queue, batch(store.latest_version()))
+            pool.join(kick)
+        version = store.latest_version()
+        assert version == 4
+
+        # A batch 4 versions behind is past clip=1: dropped, no update.
+        kick = pool.kick(1)
+        feed_queue(queue, batch(0))
+        stats = pool.join(kick)
+        assert stats["dropped"] == 1
+        assert stats["applied"] == 0
+        assert stats["max_staleness"] == version
+        assert store.latest_version() == version  # no publish either
+    finally:
+        pool.shutdown()
+        queue.shutdown()
+        store.shutdown()
+
+
+# ------------------------------------------------------------ es / rlhf
+
+def test_es_publishes_through_weight_store(ray_start_regular):
+    from ray_tpu.rllib.algorithms.es import ESConfig
+
+    config = (ESConfig()
+              .environment("CartPole-v1")
+              .training(num_perturbations=4, noise_stdev=0.1, lr=0.05,
+                        episodes_per_perturbation=1)
+              .env_runners(num_env_runners=2, num_envs_per_runner=1))
+    algo = config.build()
+    try:
+        assert algo.weight_store is not None
+        for i in range(2):
+            algo.train()
+            assert algo.weight_store.latest_version() == i + 1
+    finally:
+        algo.stop()
+
+
+def test_rlhf_smoke_llm_policy(ray_start_regular):
+    from ray_tpu.rllib.podracer import run_rlhf_smoke
+
+    summary = run_rlhf_smoke(num_rounds=2, batch_size=4, ctx_len=8)
+    assert summary["rounds"] == 2
+    assert summary["weight_version"] >= 3  # init + one per round
+    assert all(np.isfinite(loss) for loss in summary["losses"])
+    assert summary["max_staleness"] <= summary["staleness_clip"]

@@ -59,6 +59,26 @@ def test_rl_module_forward_shapes():
     assert np.all(np.asarray(expl["logp"]) <= 0)
 
 
+@pytest.mark.parametrize("rows,n", [(7, 2), (10, 3), (5, 5), (9, 4)])
+def test_split_batch_conserves_remainder_rows(rows, n):
+    """Uneven splits distribute the remainder instead of dropping it —
+    every row lands in exactly one shard, larger shards first."""
+    from ray_tpu.rllib.core.learner_group import _split_batch
+
+    batch = {"obs": np.arange(rows * 2, dtype=np.float32).reshape(rows, 2),
+             "actions": np.arange(rows, dtype=np.int32)}
+    shards = _split_batch(batch, n)
+    assert len(shards) == n
+    sizes = [len(s["actions"]) for s in shards]
+    assert sum(sizes) == rows
+    assert max(sizes) - min(sizes) <= 1
+    assert sizes == sorted(sizes, reverse=True)
+    merged = np.concatenate([s["actions"] for s in shards])
+    np.testing.assert_array_equal(merged, batch["actions"])
+    merged_obs = np.concatenate([s["obs"] for s in shards])
+    np.testing.assert_array_equal(merged_obs, batch["obs"])
+
+
 @pytest.mark.parametrize("num_learners", [1, 2])
 def test_learner_group_update_improves_loss(ray_start_regular, num_learners):
     from ray_tpu.rllib.algorithms.ppo import PPOLearner
