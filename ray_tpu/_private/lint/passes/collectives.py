@@ -38,6 +38,21 @@ them at review time):
   payload is visibly integer (``.astype(int32)`` / ``dtype=int8``).
   Quantizing integer gradients silently corrupts them; the runtime
   raises TypeError, the lint says so before the job is launched.
+
+Two from the split-phase (start/wait) overlap machinery:
+
+- ``collective-splitphase-unbalanced``: a function scope that issues a
+  ``start_ring_*`` / ``start_quantized_ring_*`` call must also issue the
+  matching ``wait_*`` call (and vice versa).  An unwaited start leaves
+  hops 1..n-1 of the ring un-run — every peer blocks in its own wait and
+  the mesh hangs; a wait with no start is a stale-handle bug.  Nested
+  ``def``s are merged into their outermost enclosing function before
+  checking, because the idiomatic overlap schedule wraps the two phases
+  in separate closures (``_start_rs`` / ``_wait_rs``) of one builder.
+- ``collective-ef-nonfloat``: an error-feedback buffer assigned an
+  explicitly integer dtype.  EF accumulates the quantizer's *residual*
+  (sub-quantum values by construction); an int EF rounds every residual
+  to zero and silently degenerates to plain quantization.
 """
 
 from __future__ import annotations
@@ -65,7 +80,49 @@ _INT_DTYPES = {
 }
 
 # Calls that quantize their payload before the ring reduction.
-_QUANTIZED_CALLS = {"quantized_ring_allreduce"}
+_QUANTIZED_CALLS = {"quantized_ring_allreduce",
+                    "start_quantized_ring_reduce_scatter"}
+
+# Error-feedback buffer names (collective-ef-nonfloat targets).
+_EF_EXACT = {"ef", "error_feedback"}
+
+
+def _split_phase_key(name: str) -> Tuple[Optional[str], Optional[str]]:
+    """("start"|"wait", op-key) for a split-phase ring call, else
+    (None, None).  The op-key is the name with the phase prefix
+    stripped, so ``start_ring_allgather`` and ``wait_ring_allgather``
+    share the key ``ring_allgather``."""
+    tail = name.rsplit(".", 1)[-1]
+    for side in ("start", "wait"):
+        prefix = side + "_"
+        if tail.startswith(prefix):
+            op = tail[len(prefix):]
+            if op.startswith("ring_") or op.startswith("quantized_ring_"):
+                return side, op
+    return None, None
+
+
+def _is_ef_name(name: str) -> bool:
+    low = name.lower()
+    return (low in _EF_EXACT or "error_feedback" in low
+            or low.endswith("_ef") or low.startswith("ef_"))
+
+
+def _assigned_dtype(value: ast.expr) -> Optional[str]:
+    """Explicit dtype of an assignment's RHS, when visible: the
+    ``astype``/``dtype=`` forms of `_payload_dtype` plus the positional
+    dtype of the array constructors (``jnp.zeros(shape, jnp.int8)``)."""
+    dtype = _payload_dtype(value)
+    if dtype is not None:
+        return dtype
+    if isinstance(value, ast.Call):
+        ctor = call_name(value).rsplit(".", 1)[-1]
+        if ctor in ("zeros", "ones", "empty", "zeros_like", "ones_like",
+                    "empty_like") and len(value.args) > 1:
+            return _dtype_name(value.args[1])
+        if ctor == "full" and len(value.args) > 2:
+            return _dtype_name(value.args[2])
+    return None
 
 
 def _dtype_name(node: Optional[ast.expr]) -> Optional[str]:
@@ -179,12 +236,15 @@ class CollectivesPass(LintPass):
     name = "collective-consistency"
     rules = ("collective-unknown-axis", "collective-divergent-branches",
              "collective-member-mismatch", "collective-dtype-drift",
-             "collective-quantized-nonfloat")
+             "collective-quantized-nonfloat",
+             "collective-splitphase-unbalanced", "collective-ef-nonfloat")
     description = ("collective axis names must be declared; conditional "
                    "branches must issue identical collective sequences "
                    "with consistent wire dtypes; group membership "
                    "declarations must be coherent; quantized allreduce "
-                   "takes float payloads only")
+                   "takes float payloads only; every start_* split-phase "
+                   "ring call needs its matching wait_*; error-feedback "
+                   "buffers must be float")
 
     def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
         out: List[Finding] = []
@@ -204,9 +264,12 @@ class CollectivesPass(LintPass):
                                 f"axis only fails at pod bring-up"))
                 out.extend(self._check_membership(mod, node))
                 out.extend(self._check_quantized(mod, node))
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                out.extend(self._check_ef_dtype(mod, node))
         for node in ast.walk(mod.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 out.extend(self._check_branches(mod, node))
+        out.extend(self._check_split_phase(mod))
         return out
 
     def _check_membership(self, mod: ModuleInfo,
@@ -272,6 +335,76 @@ class CollectivesPass(LintPass):
                 f"integer data silently corrupts it (scale/round is "
                 f"only meaningful for floats) — the runtime raises "
                 f"TypeError; reduce with op='sum' unquantized instead")
+
+    def _check_ef_dtype(self, mod: ModuleInfo, node) -> Iterable[Finding]:
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not any(_is_ef_name(n) for n in names) or node.value is None:
+            return
+        dtype = _assigned_dtype(node.value)
+        if dtype in _INT_DTYPES:
+            name = next(n for n in names if _is_ef_name(n))
+            yield mod.finding(
+                "collective-ef-nonfloat", node,
+                f"error-feedback buffer {name!r} assigned dtype "
+                f"{dtype!r}: EF accumulates the quantizer's sub-quantum "
+                f"residual, which an integer buffer rounds to zero — "
+                f"keep EF in float32")
+
+    def _split_phase_scopes(self, tree: ast.Module):
+        """(scope-label, nodes) pairs: one per OUTERMOST function (its
+        whole subtree, nested defs merged in — the overlap schedule puts
+        start/wait in sibling closures of one builder) plus one for
+        module-level statements outside any function."""
+        funcs = []
+        module_level: List[ast.AST] = []
+        stack: List[Tuple[ast.AST, bool]] = [
+            (c, False) for c in ast.iter_child_nodes(tree)]
+        while stack:
+            node, in_func = stack.pop()
+            if not in_func:
+                module_level.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not in_func:
+                    funcs.append(node)
+                in_func = True
+            stack.extend((c, in_func) for c in ast.iter_child_nodes(node))
+        yield "<module>", module_level
+        for fn in funcs:
+            yield f"{fn.name}()", list(ast.walk(fn))
+
+    def _check_split_phase(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for label, nodes in self._split_phase_scopes(mod.tree):
+            starts = {}
+            waits = {}
+            for sub in nodes:
+                if not isinstance(sub, ast.Call):
+                    continue
+                side, op = _split_phase_key(call_name(sub))
+                if side == "start":
+                    starts.setdefault(op, sub)
+                elif side == "wait":
+                    waits.setdefault(op, sub)
+            for op, call in starts.items():
+                if op not in waits:
+                    yield mod.finding(
+                        "collective-splitphase-unbalanced", call,
+                        f"start_{op} in {label} has no matching "
+                        f"wait_{op} in the same (outermost) function "
+                        f"scope: hops 1..n-1 never run, every peer "
+                        f"blocks in its own wait, and the ring hangs — "
+                        f"thread the handle to a wait_{op} on every "
+                        f"path")
+            for op, call in waits.items():
+                if op not in starts:
+                    yield mod.finding(
+                        "collective-splitphase-unbalanced", call,
+                        f"wait_{op} in {label} has no start_{op} in the "
+                        f"same (outermost) function scope: the handle "
+                        f"must come from a start issued by dead or "
+                        f"distant code — issue the start in the same "
+                        f"schedule that waits on it")
 
     def _branch_sig(self, stmts):
         """Per-branch collective signature: [(op, axes, payload_dtype)].

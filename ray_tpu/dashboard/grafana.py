@@ -125,6 +125,11 @@ _PANELS: List[Dict[str, str]] = [
      "expr_b": 'histogram_quantile(0.99, '
                'rate(rtpu_collective_op_seconds_bucket[5m]))',
      "legend": "{{op}}/{{backend}}", "unit": "s"},
+    {"title": "Exposed comm fraction (split-phase overlap)",
+     "expr": "rate(rtpu_collective_exposed_seconds_sum[5m]) / "
+             "(rate(rtpu_collective_exposed_seconds_sum[5m]) + "
+             "rate(rtpu_collective_hidden_seconds_sum[5m]))",
+     "legend": "{{op}}/{{backend}}", "unit": "percentunit"},
     # --- metrics-driven control plane ---
     {"title": "Serve replicas (autoscaler)",
      "expr": "rtpu_serve_replicas",

@@ -8,12 +8,18 @@ kernels when invoked via a group) records
 - ``rtpu_collective_bytes_total{op,backend,dtype}`` — payload bytes moved
   (the *input* tensor bytes: what the interconnect actually carries scales
   with this times the ring's ``2(n-1)/n`` factor),
-- ``rtpu_collective_op_seconds{op,backend}`` — wall-time histogram, and
-- a ``collective:<op>`` timeline span per call,
+- ``rtpu_collective_op_seconds{op,backend}`` — wall-time histogram,
+- ``rtpu_collective_exposed_seconds{op,backend}`` /
+  ``rtpu_collective_hidden_seconds{op,backend}`` — for split-phase
+  (start/wait) collectives, how much of the issued-to-awaited span was
+  NOT covered by compute (exposed) vs covered (hidden), and
+- a ``collective:<op>`` timeline span per call (split-phase calls carry
+  an ``overlapped`` attribute),
 
 which is exactly what the PERF.md "is the interconnect the bottleneck?"
-playbook reads: bytes/sec vs the ICI envelope, and op latency vs compute
-time between ops.
+and "is communication hidden?" playbooks read: bytes/sec vs the ICI
+envelope, op latency vs compute time between ops, and the exposed-comm
+fraction ``exposed / (exposed + hidden)``.
 """
 
 from __future__ import annotations
@@ -50,6 +56,17 @@ class CollectiveMetrics:
             tag_keys=("op", "backend"),
             description="Wall time of one collective op, host round-trip "
                         "included.")
+        self.exposed_seconds = Histogram(
+            "collective_exposed_seconds", boundaries=_OP_BOUNDARIES,
+            tag_keys=("op", "backend"),
+            description="Split-phase collective wall time NOT covered by "
+                        "overlapped compute (the part the step actually "
+                        "waits on).")
+        self.hidden_seconds = Histogram(
+            "collective_hidden_seconds", boundaries=_OP_BOUNDARIES,
+            tag_keys=("op", "backend"),
+            description="Split-phase collective wall time hidden under "
+                        "compute between start_* and wait_*.")
 
 
 def collective_metrics() -> CollectiveMetrics:
@@ -71,9 +88,12 @@ def _tensor_stats(tensor):
 
 
 @contextmanager
-def observe_collective(op: str, backend: str, tensor=None):
+def observe_collective(op: str, backend: str, tensor=None,
+                       overlapped=None):
     """Time one collective op: counters + latency histogram + a
-    ``collective:<op>`` timeline span."""
+    ``collective:<op>`` timeline span.  Pass ``overlapped=True|False``
+    for split-phase calls so the span records whether the op ran under
+    compute (the timeline then shows hidden vs exposed hops directly)."""
     from ray_tpu.util.tracing import record_span
 
     dtype, nbytes = _tensor_stats(tensor)
@@ -89,8 +109,36 @@ def observe_collective(op: str, backend: str, tensor=None):
             m.bytes.inc(nbytes, tags)
         m.op_seconds.observe(dur, {"op": op, "backend": backend})
         try:
-            record_span(f"collective:{op}", start, dur,
-                        {"backend": backend, "dtype": dtype,
-                         "bytes": nbytes})
+            attrs = {"backend": backend, "dtype": dtype, "bytes": nbytes}
+            if overlapped is not None:
+                attrs["overlapped"] = bool(overlapped)
+            record_span(f"collective:{op}", start, dur, attrs)
         except Exception:
             pass
+
+
+def record_overlap(op: str, backend: str, issued_to_awaited_s: float,
+                   compute_covered_s: float) -> dict:
+    """Book a split-phase collective's wall time into the exposed/hidden
+    histograms.
+
+    ``issued_to_awaited_s`` is the span between ``start_*`` returning and
+    ``wait_*`` completing; ``compute_covered_s`` is how much of that span
+    was busy with overlapped compute.  What compute did not cover, the
+    step serialized on: ``exposed = max(0, span - covered)``.  Returns
+    ``{"exposed_s", "hidden_s", "exposed_fraction"}`` for callers (bench)
+    that also report the numbers directly.
+    """
+    span = max(float(issued_to_awaited_s), 0.0)
+    covered = max(float(compute_covered_s), 0.0)
+    exposed = max(0.0, span - covered)
+    hidden = span - exposed
+    m = collective_metrics()
+    tags = {"op": op, "backend": backend}
+    m.exposed_seconds.observe(exposed, tags)
+    m.hidden_seconds.observe(hidden, tags)
+    return {
+        "exposed_s": exposed,
+        "hidden_s": hidden,
+        "exposed_fraction": exposed / span if span > 0 else 0.0,
+    }

@@ -37,6 +37,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.util.collective.pallas import (
+    select_impl, start_ring_permute, wait_ring_permute,
+)
+
 _NEG = -1e30
 
 
@@ -46,9 +50,24 @@ def _axis_size(axis_name: str) -> Optional[int]:
         return lax.axis_size(axis_name)
     except (NameError, KeyError, ValueError, TypeError, AttributeError):
         # AttributeError: lax.axis_size itself is absent on older jax
-        # (0.4.x spells it lax.psum(1, axis) / axis_env lookup below).
+        # (0.4.x spellings handled below).
+        pass
+    try:
+        # psum of a python scalar folds to a static int when the axis is
+        # bound and raises NameError when it is not — works on every jax
+        # this repo supports (0.4.x included, where the lookups below
+        # return ints or are missing entirely).
+        size = lax.psum(1, axis_name)
+        if isinstance(size, int):
+            return size
+    except Exception:
         pass
     try:  # older spellings
+        frame = jax.core.axis_frame(axis_name)  # type: ignore
+        return frame if isinstance(frame, int) else frame.size
+    except Exception:
+        pass
+    try:
         frame = jax.core.get_axis_env().axis_frame(axis_name)  # type: ignore
         return frame.size
     except Exception:
@@ -56,17 +75,30 @@ def _axis_size(axis_name: str) -> Optional[int]:
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   causal: bool = True, axis_name: str = "sp") -> jax.Array:
+                   causal: bool = True, axis_name: str = "sp",
+                   impl: str = "lax") -> jax.Array:
     """Per-shard ring attention. q, k, v: [B, S_local, H, D].
 
     Inside ``shard_map`` (axis bound): the full-sequence result for the
     local query shard. Outside: falls back to exact local attention.
+
+    ``impl`` selects the KV-exchange backend.  ``"lax"`` (default) is the
+    ``ppermute`` rotation — differentiable, so it is what training uses.
+    ``"pallas"``/``"pallas_interpret"``/``"auto"`` route the rotation
+    through the split-phase Pallas ring (`start_ring_permute` before the
+    block compute, `wait_ring_permute` after), putting the hop's DMA
+    explicitly under the attention matmuls — the overlap the serving path
+    wants for long-context KV exchange.  `pallas_call` has no autodiff
+    rule, so the Pallas path is forward-only (inference/serving).
     """
     n = _axis_size(axis_name)
     if n is None or n == 1:
         from ray_tpu.models.llama import xla_attention
 
         return xla_attention(q, k, v, causal=causal)
+
+    resolved = select_impl(impl)
+    use_split = resolved in ("pallas", "pallas_interpret")
 
     B, Sl, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
@@ -100,11 +132,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def body(carry, step):
         m, l, acc, k_cur, v_cur = carry
         src = (my - step) % n
-        m, l, acc = _block(q, k_cur, v_cur, src, m, l, acc)
-        # Rotate kv one hop; XLA overlaps the transfer with the next
-        # iteration's compute where dependencies allow.
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        if use_split:
+            # Split-phase: the next shard's hop is in flight while this
+            # shard's attention block computes — explicit overlap rather
+            # than hoping the scheduler finds it.
+            kh = start_ring_permute(k_cur, axis_name, n=n, impl=resolved)
+            vh = start_ring_permute(v_cur, axis_name, n=n, impl=resolved)
+            m, l, acc = _block(q, k_cur, v_cur, src, m, l, acc)
+            k_nxt = wait_ring_permute(kh)
+            v_nxt = wait_ring_permute(vh)
+        else:
+            m, l, acc = _block(q, k_cur, v_cur, src, m, l, acc)
+            # Rotate kv one hop; XLA overlaps the transfer with the next
+            # iteration's compute where dependencies allow.
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (m, l, acc, k_nxt, v_nxt), None
 
     def _vary(x):
@@ -130,7 +172,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ring_attention_global(q: jax.Array, k: jax.Array, v: jax.Array,
                           mesh, causal: bool = True,
-                          seq_axis: str = "sp") -> jax.Array:
+                          seq_axis: str = "sp",
+                          impl: str = "lax") -> jax.Array:
     """Global-view convenience wrapper: q, k, v are full [B, S, H, D]
     arrays; the sequence dim is sharded over ``mesh[seq_axis]`` and the
     ring runs under ``shard_map``."""
@@ -142,7 +185,13 @@ def ring_attention_global(q: jax.Array, k: jax.Array, v: jax.Array,
         from jax.experimental.shard_map import shard_map  # type: ignore
 
     spec = P(None, seq_axis, None, None)
+    # check_rep off: Pallas kernels are opaque to the replication checker,
+    # and on jax 0.4.x even the lax ring trips its scan-carry vma typing
+    # (the axis_index-derived carries).  Correctness is covered by the
+    # parity tests, not the static checker.
     fn = shard_map(
-        partial(ring_attention, causal=causal, axis_name=seq_axis),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        partial(ring_attention, causal=causal, axis_name=seq_axis,
+                impl=impl),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
     return fn(q, k, v)

@@ -93,7 +93,13 @@ def build_train_step(
     over the ``data`` axis on the optimizer moments make XLA rewrite
     allreduce(grads)+full-update into reduce-scatter + 1/n-update +
     allgather.  Needs ``params_shape`` (a `jax.eval_shape` of the param
-    tree) to size the moment shardings."""
+    tree) to size the moment shardings.
+
+    Whether XLA *overlaps* that rewrite's collectives with the update
+    math is up to its scheduler; for explicit chunked split-phase
+    overlap (and int8/error-feedback gradient exchange) use
+    `parallel.zero.build_zero_train_step(..., overlap=True)` on a pure
+    data mesh instead."""
     if weight_update not in ("replicated", "sharded"):
         raise ValueError(
             f"weight_update must be 'replicated'|'sharded', got "

@@ -45,7 +45,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ray_tpu.parallel.mesh import mesh_axis_size
 from ray_tpu.util.collective.pallas import (
-    quantized_ring_allreduce, ring_allgather, ring_reduce_scatter,
+    local_quantization_residual, quantized_ring_allreduce, ring_allgather,
+    ring_reduce_scatter, start_quantized_ring_reduce_scatter,
+    start_ring_allgather, start_ring_reduce_scatter,
+    wait_quantized_ring_reduce_scatter, wait_ring_allgather,
+    wait_ring_reduce_scatter,
 )
 from ray_tpu.util.collective.pallas.ring import LANES
 
@@ -55,10 +59,15 @@ class ZeroTrainState(NamedTuple):
 
     ``opt_state`` is the optax state over this replica's 1/n shard of the
     flattened parameter vector (moments are (shard_len,) per device).
+    ``ef`` is the optional error-feedback accumulator for compressed
+    gradient exchange: per-device f32 residual of the last quantization,
+    global shape ``(n, padded)`` sharded over the data axis (row i is
+    device i's buffer), or None when compression runs without feedback.
     """
     params: Any
     opt_state: Any
     step: jax.Array
+    ef: Any = None
 
 
 def _padded_len(size: int, n: int) -> int:
@@ -84,12 +93,15 @@ def _my_shard(flat_padded, n: int, axis_name: str):
     return lax.dynamic_slice(flat_padded, (my * shard,), (shard,))
 
 
-def create_zero_state(params, optimizer, mesh, axis_name: str = "data"
-                      ) -> ZeroTrainState:
+def create_zero_state(params, optimizer, mesh, axis_name: str = "data",
+                      error_feedback: bool = False) -> ZeroTrainState:
     """Initialize a ZeRO state: params replicated, moments sharded.
 
     Runs a tiny shard_map so each device initializes the optax state for
     *its* shard only (1/n moment memory from step zero, the whole point).
+    With ``error_feedback`` the state also carries a zeroed per-device f32
+    residual buffer for compressed-gradient error feedback (always float:
+    an int EF buffer would re-quantize the correction itself).
     """
     n = mesh_axis_size(mesh, axis_name)
     shard = _flat_shard_len(params, n)
@@ -108,8 +120,13 @@ def create_zero_state(params, optimizer, mesh, axis_name: str = "data"
     opt_state = jax.jit(shard_map(
         init_shard, mesh=mesh, in_specs=P(),
         out_specs=out_specs, check_rep=False))(flat)
+    ef = None
+    if error_feedback:
+        ef = jax.device_put(
+            jnp.zeros((n, shard * n), jnp.float32),
+            NamedSharding(mesh, P(axis_name, None)))
     return ZeroTrainState(params=params, opt_state=opt_state,
-                          step=jnp.zeros((), jnp.int32))
+                          step=jnp.zeros((), jnp.int32), ef=ef)
 
 
 def build_zero_train_step(
@@ -120,54 +137,170 @@ def build_zero_train_step(
     batch_spec: Optional[P] = None,
     collective: str = "auto",
     quantized_grads: bool = False,
+    overlap: bool = False,
+    n_chunks: int = 4,
+    error_feedback: bool = False,
 ) -> Callable[[ZeroTrainState, Any], Tuple[ZeroTrainState, Dict]]:
     """Jitted DP step with a partitioned weight update over `axis_name`.
 
     Per device: local grads → ring reduce-scatter (sum) → optax update on
     this replica's flat shard → ring allgather of updated params.  With
-    ``quantized_grads`` the gradient exchange rides the int8 EQuARX ring
-    (full allreduce + local slice: same shard semantics, quarter the wire
-    bytes); the weight allgather stays exact.
+    ``quantized_grads`` the gradient exchange rides the int8 EQuARX ring;
+    the weight allgather stays exact.
+
+    ``overlap=True`` replaces the monolithic exchange with a chunked
+    split-phase schedule: the flat vector is cut into ``n_chunks`` chunks
+    (boundaries on n*LANES multiples) and pipelined so chunk i+1's
+    reduce-scatter hops and chunk i-1's param allgather hops run while
+    chunk i's optimizer math executes — communication hides under compute
+    instead of serializing with it.  Numerics match the monolithic step to
+    float tolerance (per-chunk ring order differs, so not bitwise), and
+    the optimizer-state vector uses a chunk-major element order: do not
+    toggle ``overlap`` mid-run on the same state.  Requires an elementwise
+    optimizer (adam/sgd/etc) since moment vectors are updated per chunk.
+
+    ``error_feedback=True`` (requires ``quantized_grads`` and a state from
+    ``create_zero_state(..., error_feedback=True)``) accumulates the local
+    quantization residual and re-injects it into the next step's gradient,
+    so compressed exchange stops biasing long runs.
     """
     n = mesh_axis_size(mesh, axis_name)
     if batch_spec is None:
         batch_spec = P(axis_name)
+    if error_feedback and not quantized_grads:
+        raise ValueError(
+            "error_feedback corrects compression error and needs "
+            "quantized_grads=True (the exact exchange has no residual)")
+    if n_chunks < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+
+    def _start_rs(vec):
+        c2d = vec.reshape(-1, LANES)
+        if quantized_grads:
+            return start_quantized_ring_reduce_scatter(
+                c2d, axis_name, n=n, impl=collective)
+        return start_ring_reduce_scatter(
+            c2d, axis_name, n=n, op="sum", impl=collective)
+
+    def _wait_rs(handle):
+        if quantized_grads:
+            return wait_quantized_ring_reduce_scatter(handle).reshape(-1)
+        return wait_ring_reduce_scatter(handle).reshape(-1)
+
+    def _overlap_update(carry, pflat_p, opt_state):
+        """The pipelined schedule.  Chunk boundaries sit on n*LANES
+        multiples so every chunk reduce-scatters to equal per-device
+        slices and the concatenated shards exactly tile the padded
+        vector."""
+        my = lax.axis_index(axis_name)
+        groups = pflat_p.size // (n * LANES)
+        n_c = max(1, min(n_chunks, groups))
+        base, rem = divmod(groups, n_c)
+        sizes = [(base + (1 if i < rem else 0)) * n * LANES
+                 for i in range(n_c)]
+        offs = [sum(sizes[:i]) for i in range(n_c)]
+
+        leaves, treedef = jax.tree.flatten(opt_state)
+        is_vec = [getattr(l, "ndim", 0) == 1 for l in leaves]
+
+        handles = [None] * n_c
+        handles[0] = _start_rs(carry[offs[0]:offs[0] + sizes[0]])
+        ag_handles = []
+        new_chunk_leaves = []
+        ef_chunks = []
+        opt_off = 0
+        for c in range(n_c):
+            cs = sizes[c] // n
+            if c + 1 < n_c:
+                # Issue the next chunk's reduce-scatter before consuming
+                # this one: its hops hide under this chunk's update math.
+                handles[c + 1] = _start_rs(
+                    carry[offs[c + 1]:offs[c + 1] + sizes[c + 1]])
+            gshard_c = _wait_rs(handles[c])
+            pshard_c = lax.dynamic_slice(
+                pflat_p, (offs[c] + my * cs,), (cs,))
+            opt_c = jax.tree.unflatten(treedef, [
+                l[opt_off:opt_off + cs] if isv else l
+                for l, isv in zip(leaves, is_vec)])
+            updates_c, new_opt_c = optimizer.update(
+                gshard_c, opt_c, pshard_c)
+            new_pshard_c = optax.apply_updates(pshard_c, updates_c)
+            new_chunk_leaves.append(jax.tree.leaves(new_opt_c))
+            # The updated shard leaves immediately: its allgather hops
+            # hide under the next chunk's wait + optimizer math.
+            ag_handles.append(start_ring_allgather(
+                new_pshard_c, axis_name, n=n, impl=collective))
+            if error_feedback:
+                ef_chunks.append(local_quantization_residual(
+                    carry[offs[c]:offs[c] + sizes[c]].reshape(-1, LANES),
+                    n).reshape(-1))
+            opt_off += cs
+        # Scalar leaves (e.g. adam's count) increment identically in every
+        # chunk update; keep chunk 0's copy.  Vector leaves concatenate in
+        # chunk-major order — the overlap state layout.
+        merged = [
+            jnp.concatenate([new_chunk_leaves[c][i] for c in range(n_c)])
+            if is_vec[i] else new_chunk_leaves[0][i]
+            for i in range(len(leaves))]
+        new_opt = jax.tree.unflatten(treedef, merged)
+        gathered = [wait_ring_allgather(h).reshape(-1)
+                    for h in ag_handles]
+        new_flat_p = jnp.concatenate(gathered)
+        new_ef = (jnp.concatenate(ef_chunks)[None, :]
+                  if error_feedback else None)
+        return new_flat_p, new_opt, new_ef
 
     def step_fn(state: ZeroTrainState, batch):
-        params, opt_state, step = state
+        params, opt_state, step, ef = state
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         gflat, _ = ravel_pytree(grads)
         pflat, unravel = ravel_pytree(params)
         gflat = _pad_flat(gflat, n)
         pflat_p = _pad_flat(pflat, n)
-        g2d = gflat.reshape(-1, LANES)
+        # Error feedback: re-inject the residual the wire dropped last
+        # step, then remember what this step's compression will drop.
+        carry = gflat + ef[0] if error_feedback else gflat
 
-        if quantized_grads:
-            gfull = quantized_ring_allreduce(
-                g2d, axis_name, n=n, impl=collective).reshape(-1)
-            gshard = _my_shard(gfull, n, axis_name)
+        if overlap:
+            new_flat_p, new_opt, new_ef = _overlap_update(
+                carry, pflat_p, opt_state)
         else:
-            gshard = ring_reduce_scatter(
-                g2d, axis_name, n=n, op="sum",
-                impl=collective).reshape(-1)
+            c2d = carry.reshape(-1, LANES)
+            if quantized_grads:
+                gfull = quantized_ring_allreduce(
+                    c2d, axis_name, n=n, impl=collective).reshape(-1)
+                gshard = _my_shard(gfull, n, axis_name)
+            else:
+                gshard = ring_reduce_scatter(
+                    c2d, axis_name, n=n, op="sum",
+                    impl=collective).reshape(-1)
+            pshard = _my_shard(pflat_p, n, axis_name)
+            updates, new_opt = optimizer.update(gshard, opt_state, pshard)
+            new_pshard = optax.apply_updates(pshard, updates)
+            gathered = ring_allgather(
+                new_pshard.reshape(-1, LANES), axis_name, n=n,
+                impl=collective)
+            new_flat_p = gathered.reshape(-1)
+            new_ef = (local_quantization_residual(c2d, n)
+                      .reshape(-1)[None, :] if error_feedback else None)
 
-        pshard = _my_shard(pflat_p, n, axis_name)
-        updates, new_opt = optimizer.update(gshard, opt_state, pshard)
-        new_pshard = optax.apply_updates(pshard, updates)
-
-        gathered = ring_allgather(
-            new_pshard.reshape(-1, LANES), axis_name, n=n, impl=collective)
-        new_flat = gathered.reshape(-1)[:pflat.size]
-        new_params = unravel(new_flat)
-
+        if not error_feedback:
+            new_ef = ef  # pass any existing buffer through untouched
+        new_params = unravel(new_flat_p[:pflat.size])
         grad_norm = jnp.sqrt(lax.psum(jnp.sum(gflat * gflat), axis_name))
         metrics = {"loss": lax.pmean(loss, axis_name),
                    "grad_norm": grad_norm, "step": step + 1}
-        return ZeroTrainState(new_params, new_opt, step + 1), metrics
+        return ZeroTrainState(new_params, new_opt, step + 1,
+                              new_ef), metrics
 
     jitted_cache: Dict[Any, Callable] = {}
 
     def wrapped(state: ZeroTrainState, batch):
+        if error_feedback and state.ef is None:
+            raise ValueError(
+                "error_feedback=True needs a state carrying an ef buffer;"
+                " build it with create_zero_state(..., "
+                "error_feedback=True)")
         cache_key = (jax.tree.structure(state), jax.tree.structure(batch))
         fn = jitted_cache.get(cache_key)
         if fn is None:
@@ -178,7 +311,8 @@ def build_zero_train_step(
             state_specs = ZeroTrainState(
                 params=jax.tree.map(lambda _: P(), state.params),
                 opt_state=opt_specs,
-                step=P())
+                step=P(),
+                ef=None if state.ef is None else P(axis_name, None))
             metric_specs = {"loss": P(), "grad_norm": P(), "step": P()}
             batch_specs = jax.tree.map(lambda _: batch_spec, batch)
             fn = jax.jit(shard_map(

@@ -38,6 +38,10 @@ class JaxConfig(BackendConfig):
     # "replicated" | "sharded" — ZeRO-style cross-replica sharding of the
     # optimizer update (parallel.zero) for loops driven via this config.
     weight_update: str = "replicated"
+    # Chunked split-phase overlap of grad reduce-scatter / param allgather
+    # with optimizer math (parallel.zero overlap schedule).  Only valid
+    # with a pure data mesh; implies the explicit sharded update route.
+    overlap: bool = False
 
     @property
     def backend_cls(self):
@@ -52,7 +56,19 @@ def _setup_jax_distributed(coordinator: Optional[str], world_size: int,
     if platform:
         jax.config.update("jax_platforms", platform)
     if num_cpu_devices and (platform == "cpu"):
-        jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+        except AttributeError:
+            # jax < 0.5 has no jax_num_cpu_devices; the XLA flag is the
+            # same knob but is only read at backend init, so it must land
+            # in the environment before the first device query.
+            import os
+
+            flag = ("--xla_force_host_platform_device_count="
+                    f"{num_cpu_devices}")
+            existing = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in existing:
+                os.environ["XLA_FLAGS"] = f"{existing} {flag}".strip()
     if world_size > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator,
@@ -124,13 +140,21 @@ def run_pod_training(model_config=None, mesh_axes=None, steps: int = 4,
                      batch_size: Optional[int] = None, seq_len: int = 33,
                      weight_update: str = "replicated",
                      learning_rate: float = 1e-3, seed: int = 0,
-                     report=None) -> dict:
+                     overlap: bool = False, n_chunks: int = 4,
+                     collective: str = "auto", report=None) -> dict:
     """Run `steps` sharded Llama train steps; returns throughput metrics.
 
     The returned dict carries ``tokens_per_sec`` / ``tokens_per_sec_per_chip``
     measured over the post-compile steps (step 0 is the compile+warmup step
     and is excluded), which is what MULTICHIP_rXX.json and ROADMAP item 1
     compare against the single-chip figure.
+
+    ``overlap=True`` routes the loop through the explicit chunked
+    split-phase ZeRO step (`parallel.zero.build_zero_train_step` with
+    ``overlap=True``): grad reduce-scatter and param allgather hops are
+    pipelined chunk-by-chunk under the optimizer math instead of running
+    as one exposed collective.  Requires a pure data mesh (the chunk
+    schedule owns the whole flat parameter vector).
     """
     import time
 
@@ -140,8 +164,9 @@ def run_pod_training(model_config=None, mesh_axes=None, steps: int = 4,
 
     from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn
     from ray_tpu.parallel import (
-        batch_sharding, build_train_step, create_train_state,
-        llama_param_shardings, make_mesh, shard_params,
+        batch_sharding, build_train_step, build_zero_train_step,
+        create_train_state, create_zero_state, llama_param_shardings,
+        make_mesh, shard_params,
     )
 
     if model_config is None:
@@ -151,6 +176,16 @@ def run_pod_training(model_config=None, mesh_axes=None, steps: int = 4,
     mesh = make_mesh(dict(mesh_axes) if mesh_axes else {"data": -1})
     n_devices = int(np.prod(mesh.devices.shape))
 
+    if overlap:
+        non_data = [ax for ax in mesh.axis_names
+                    if ax != "data" and mesh.shape[ax] > 1]
+        if non_data:
+            raise ValueError(
+                f"overlap=True needs a pure data mesh, got non-trivial "
+                f"axes {non_data} — the chunked schedule shards the whole "
+                "flat parameter vector over 'data'")
+        weight_update = "sharded"
+
     params = init_params(model_config, jax.random.key(seed))
     shardings = llama_param_shardings(model_config, mesh)
     bsh = batch_sharding(mesh)
@@ -158,11 +193,19 @@ def run_pod_training(model_config=None, mesh_axes=None, steps: int = 4,
     params_shape = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
 
-    step = build_train_step(
-        lambda p, b: loss_fn(p, b, model_config), optimizer, mesh,
-        shardings, bsh, weight_update=weight_update,
-        params_shape=params_shape)
-    state = create_train_state(shard_params(params, shardings), optimizer)
+    if overlap:
+        step = build_zero_train_step(
+            lambda p, b: loss_fn(p, b, model_config), optimizer, mesh,
+            axis_name="data", collective=collective, overlap=True,
+            n_chunks=n_chunks)
+        state = create_zero_state(params, optimizer, mesh)
+    else:
+        step = build_train_step(
+            lambda p, b: loss_fn(p, b, model_config), optimizer, mesh,
+            shardings, bsh, weight_update=weight_update,
+            params_shape=params_shape)
+        state = create_train_state(shard_params(params, shardings),
+                                   optimizer)
 
     # Batch must divide evenly over the data-like axes.
     data_shards = 1
@@ -197,6 +240,7 @@ def run_pod_training(model_config=None, mesh_axes=None, steps: int = 4,
         "mesh": {name: int(size) for name, size
                  in zip(mesh.axis_names, mesh.devices.shape)},
         "weight_update": weight_update,
+        "overlap": overlap,
         "steps": steps,
         "batch_size": batch_size,
         "seq_len": seq_len,
@@ -228,6 +272,9 @@ def pod_train_loop(config: Optional[dict] = None) -> None:
         weight_update=config.get("weight_update", "replicated"),
         learning_rate=float(config.get("learning_rate", 1e-3)),
         seed=int(config.get("seed", 0)),
+        overlap=bool(config.get("overlap", False)),
+        n_chunks=int(config.get("n_chunks", 4)),
+        collective=config.get("collective", "auto"),
         report=None,
     )
     train.report(summary)

@@ -31,7 +31,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ray_tpu.util.collective.pallas import ring
 from ray_tpu.util.collective.pallas.ring import (
-    _cap_signal, _cap_wait, _from_block, _to_block, select_impl,
+    LANES, SplitPhaseHandle, _cap_signal, _cap_wait, _from_block,
+    _numel, _to_block, select_impl,
 )
 
 # Below this many elements the scale traffic dominates any wire savings.
@@ -115,6 +116,172 @@ def _qar_block(x, axis_name, n, interpret):
         compiler_params=None if interpret else pltpu.TPUCompilerParams(
             collective_id=3),
     )(x)
+
+
+def _qhop_kernel(n, axis_name, in_ref, out_ref,
+                 qstage_ref, sstage_ref, qcomm_ref, scomm_ref,
+                 qsend, qrecv, ssend, srecv):
+    """One fused quantized ring hop: quantize the outgoing f32 block to
+    int8 *inside the kernel*, DMA payload+scale to the right neighbour,
+    dequantize the incoming pair into f32.  The requantization of running
+    partial sums lives in the DMA loop (EQuARX), not as a host pre-pass —
+    the wire only ever carries int8."""
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, n)
+    q, scale = _quantize(in_ref[...])
+    qstage_ref[...] = q
+    sstage_ref[0, 0] = scale
+    qrdma = pltpu.make_async_remote_copy(
+        src_ref=qstage_ref, dst_ref=qcomm_ref,
+        send_sem=qsend, recv_sem=qrecv,
+        device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+    srdma = pltpu.make_async_remote_copy(
+        src_ref=sstage_ref, dst_ref=scomm_ref,
+        send_sem=ssend, recv_sem=srecv,
+        device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+    qrdma.start()
+    srdma.start()
+    qrdma.wait()
+    srdma.wait()
+    out_ref[...] = qcomm_ref[...].astype(out_ref.dtype) * scomm_ref[0, 0]
+
+
+def _qhop_block(x, axis_name, n, interpret):
+    kernel = functools.partial(_qhop_kernel, n, axis_name)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM(x.shape, jnp.int8),       # qstage
+            pltpu.VMEM((1, 1), jnp.float32),     # sstage
+            pltpu.VMEM(x.shape, jnp.int8),       # qcomm
+            pltpu.VMEM((1, 1), jnp.float32),     # scomm
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.TPUCompilerParams(
+            collective_id=5),
+    )(x)
+
+
+def _qrs_hop(block, t, n, axis_name, interpret):
+    """One host-level quantized reduce-scatter hop: same index schedule as
+    `ring._reduce_scatter_kernel` step `t`, with the wire leg replaced by
+    the fused quantize→DMA→dequantize kernel."""
+    my = lax.axis_index(axis_name)
+    chunk = block.shape[0] // n
+    send_idx = lax.rem(my - t - 1 + n, n)
+    recv_idx = lax.rem(my - t - 2 + 2 * n, n)
+    sent = lax.dynamic_slice(
+        block, (send_idx * chunk, 0), (chunk,) + block.shape[1:])
+    deq = _qhop_block(sent, axis_name, n, interpret)
+    cur = lax.dynamic_slice(
+        block, (recv_idx * chunk, 0), (chunk,) + block.shape[1:])
+    return lax.dynamic_update_slice(block, cur + deq, (recv_idx * chunk, 0))
+
+
+def start_quantized_ring_reduce_scatter(x, axis_name: str, *, n: int,
+                                        op: str = "sum",
+                                        impl: str = "auto"
+                                        ) -> SplitPhaseHandle:
+    """Split-phase int8 reduce-scatter (sum/avg): hop 0's fused
+    quantize→DMA→dequantize is issued now, the rest at the wait.  Same
+    slab contract as `ring.ring_reduce_scatter`; same fallback ladder as
+    `quantized_ring_allreduce` (bf16 compression when int8 cannot pay)."""
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        raise TypeError(
+            "quantized reduce-scatter requires floating-point input, got "
+            f"{jnp.asarray(x).dtype} — quantizing integer gradients "
+            "silently corrupts them (use ring_reduce_scatter instead)")
+    if op.lower() not in ("sum", "avg", "mean"):
+        raise ValueError(
+            f"quantized reduce-scatter supports sum/avg, got {op!r}")
+    if x.shape[0] % n:
+        raise ValueError(
+            f"reduce_scatter leading dim {x.shape[0]} not divisible by "
+            f"ring size {n}")
+    impl = select_impl(impl)
+    op = "avg" if op.lower() in ("avg", "mean") else "sum"
+    wants_bf16 = (
+        jnp.asarray(x).dtype == jnp.float64
+        or x.size < _MIN_QUANT_ELEMS
+    )
+    h = SplitPhaseHandle("quantized_reduce_scatter", axis_name, n, op, impl)
+    if impl == "lax" or n == 1 or wants_bf16:
+        # bf16-compressed fallback: the cast is the (lossy) compression;
+        # the wait performs the actual collective.
+        h.impl = "lax" if impl == "lax" or n == 1 else impl
+        h.meta = ("bf16", x.dtype)
+        h.buf = x.astype(jnp.bfloat16)
+        return h
+    shard_shape = (x.shape[0] // n,) + x.shape[1:]
+    per_shard = _numel(shard_shape)
+    slabs = x.astype(jnp.float32).reshape(n, per_shard)
+    padded = ((per_shard + LANES - 1) // LANES) * LANES
+    if padded != per_shard:
+        slabs = jnp.pad(slabs, ((0, 0), (0, padded - per_shard)))
+    block = slabs.reshape(n * (padded // LANES), LANES)
+    interpret = impl == "pallas_interpret"
+    h.meta = ("int8", x.dtype, shard_shape, per_shard)
+    h.buf = _qrs_hop(block, 0, n, axis_name, interpret)
+    h.hops_done = 1
+    return h
+
+
+def wait_quantized_ring_reduce_scatter(h: SplitPhaseHandle):
+    """Await a `start_quantized_ring_reduce_scatter`."""
+    n, op, axis_name = h.n, h.op, h.axis_name
+    if h.meta and h.meta[0] == "bf16":
+        _, orig_dtype = h.meta
+        out = ring.ring_reduce_scatter(h.buf, axis_name, n=n, op=op,
+                                       impl=h.impl)
+        return out.astype(orig_dtype)
+    interpret = h.impl == "pallas_interpret"
+    block = h.buf
+    for t in range(h.hops_done, n - 1):
+        block = _qrs_hop(block, t, n, axis_name, interpret)
+    my = lax.axis_index(axis_name)
+    chunk = block.shape[0] // n
+    mine = lax.dynamic_slice(
+        block, (my * chunk, 0), (chunk,) + block.shape[1:])
+    _, orig_dtype, shard_shape, per_shard = h.meta
+    result = mine.reshape(-1)[:per_shard].reshape(shard_shape)
+    if op == "avg":
+        result = result / n
+    return result.astype(orig_dtype)
+
+
+def local_quantization_residual(block, n: int):
+    """What this rank's data loses to the FIRST int8 compression on the
+    wire: ``block - dequant(quant(block))`` with one f32 scale per ring
+    chunk (the kernel's scale rule).  This is the increment an
+    error-feedback accumulator keeps so systematic round-off is re-sent
+    on the next step instead of silently dropped.
+
+    `block` must be 2-D ``(rows, LANES)`` with ``rows % n == 0`` — the
+    packed layout both the monolithic and split-phase quantized paths use.
+    Always f32 (graftlint's ef-dtype rule: never keep EF state in int).
+    """
+    if block.ndim != 2 or block.shape[0] % n:
+        raise ValueError(
+            f"expected (rows, LANES) block with rows divisible by {n}, "
+            f"got shape {block.shape}")
+    if block.size < _MIN_QUANT_ELEMS:
+        # Below the quantization threshold the wire carries bf16, whose
+        # round-off is what EF should track there.
+        b16 = block.astype(jnp.bfloat16).astype(jnp.float32)
+        return block.astype(jnp.float32) - b16
+    chunks = block.astype(jnp.float32).reshape(n, block.shape[0] // n,
+                                               block.shape[1])
+    scales = jnp.maximum(
+        jnp.max(jnp.abs(chunks), axis=(1, 2), keepdims=True) / _QMAX,
+        1e-30)
+    q = jnp.clip(jnp.round(chunks / scales), -_QMAX, _QMAX)
+    deq = (q * scales).reshape(block.shape)
+    return block.astype(jnp.float32) - deq
 
 
 def _bf16_fallback(x, axis_name, n, op, impl):

@@ -361,6 +361,233 @@ def _numel(shape) -> int:
     return size
 
 
+# ---------------------------------------------------------------------------
+# Split-phase entry points: one ring hop per kernel call, so a collective
+# can be ISSUED early (``start_*``: places hop 0 in the graph depending
+# only on its payload) and AWAITED late (``wait_*``: runs the remaining
+# hops and materializes the result).  Compute traced between the two calls
+# has no data dependency on the in-flight hops, which is exactly the
+# freedom XLA's latency-hiding scheduler needs to run DMA under compute —
+# the monolithic kernels above are one opaque op and expose their whole
+# wire time.  Hop schedules mirror the monolithic kernels element-for-
+# element, so start+wait is numerically identical to the single call
+# (tier-1 asserts it).  Handles are trace-scoped Python objects, not
+# pytrees: start and wait must happen inside the same traced function.
+# ---------------------------------------------------------------------------
+
+def _permute_kernel(n, axis_name, in_ref, out_ref, send_sem, recv_sem):
+    """One ring hop: send the whole block to the right neighbour, return
+    what the left neighbour sent (the SNIPPETS [2] right-permute shape)."""
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, n)
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=in_ref,
+        dst_ref=out_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=right,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma.start()
+    rdma.wait()
+
+
+def _permute_block(x, axis_name, n, interpret):
+    kernel = functools.partial(_permute_kernel, n, axis_name)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.TPUCompilerParams(
+            collective_id=4),
+    )(x)
+
+
+class SplitPhaseHandle:
+    """An in-flight split-phase ring collective.
+
+    Plain Python object, deliberately NOT a pytree: it holds traced
+    arrays, so it is only valid between a ``start_*`` and the matching
+    ``wait_*`` inside the same traced function.  Every ``start_*`` MUST
+    be balanced by a ``wait_*`` (graftlint's ``collective-split-phase``
+    rule enforces this statically).
+    """
+
+    __slots__ = ("kind", "axis_name", "n", "op", "impl", "buf",
+                 "hops_done", "meta")
+
+    def __init__(self, kind, axis_name, n, op, impl):
+        self.kind = kind
+        self.axis_name = axis_name
+        self.n = n
+        self.op = op
+        self.impl = impl
+        self.buf = None
+        self.hops_done = 0
+        self.meta = None
+
+
+def _rs_hop(block, t, n, axis_name, op, interpret):
+    """One host-level reduce-scatter hop: identical index schedule to
+    `_reduce_scatter_kernel` step `t`, so the float-add order (and hence
+    the bits) match the monolithic kernel."""
+    my = lax.axis_index(axis_name)
+    chunk = block.shape[0] // n
+    combine = _COMBINE[op]
+    send_idx = lax.rem(my - t - 1 + n, n)
+    recv_idx = lax.rem(my - t - 2 + 2 * n, n)
+    sent = lax.dynamic_slice(
+        block, (send_idx * chunk, 0), (chunk,) + block.shape[1:])
+    received = _permute_block(sent, axis_name, n, interpret)
+    cur = lax.dynamic_slice(
+        block, (recv_idx * chunk, 0), (chunk,) + block.shape[1:])
+    return lax.dynamic_update_slice(
+        block, combine(cur, received), (recv_idx * chunk, 0))
+
+
+def start_ring_reduce_scatter(x, axis_name: str, *, n: int,
+                              op: str = "sum", impl: str = "auto"
+                              ) -> SplitPhaseHandle:
+    """Issue a reduce-scatter (same contract as `ring_reduce_scatter`:
+    leading dim divisible by `n`, rank `i` receives slab `i`).  Hop 0 is
+    placed in the graph now; the rest run at `wait_ring_reduce_scatter`."""
+    op = _norm_op(op)
+    if x.shape[0] % n:
+        raise ValueError(
+            f"reduce_scatter leading dim {x.shape[0]} not divisible by "
+            f"ring size {n}")
+    impl = select_impl(impl)
+    h = SplitPhaseHandle("reduce_scatter", axis_name, n, op, impl)
+    if impl == "lax" or n == 1:
+        h.buf = x
+        return h
+    shard_shape = (x.shape[0] // n,) + x.shape[1:]
+    per_shard = _numel(shard_shape)
+    slabs = x.reshape(n, per_shard)
+    padded = ((per_shard + LANES - 1) // LANES) * LANES
+    if padded != per_shard:
+        slabs = jnp.pad(slabs, ((0, 0), (0, padded - per_shard)))
+    block = slabs.reshape(n * (padded // LANES), LANES)
+    interpret = impl == "pallas_interpret"
+    kernel_op = "sum" if op == "avg" else op
+    h.meta = (shard_shape, per_shard)
+    h.buf = _rs_hop(block, 0, n, axis_name, kernel_op, interpret)
+    h.hops_done = 1
+    return h
+
+
+def wait_ring_reduce_scatter(h: SplitPhaseHandle):
+    """Await a `start_ring_reduce_scatter`: run the remaining hops and
+    return this rank's reduced slab."""
+    n, op, axis_name = h.n, h.op, h.axis_name
+    if h.impl == "lax" or n == 1:
+        out = lax.psum_scatter(h.buf, axis_name, scatter_dimension=0,
+                               tiled=True)
+        if op == "avg":
+            out = out / n
+        return out
+    interpret = h.impl == "pallas_interpret"
+    kernel_op = "sum" if op == "avg" else op
+    block = h.buf
+    for t in range(h.hops_done, n - 1):
+        block = _rs_hop(block, t, n, axis_name, kernel_op, interpret)
+    my = lax.axis_index(axis_name)
+    chunk = block.shape[0] // n
+    mine = lax.dynamic_slice(
+        block, (my * chunk, 0), (chunk,) + block.shape[1:])
+    shard_shape, per_shard = h.meta
+    result = mine.reshape(-1)[:per_shard].reshape(shard_shape)
+    if op == "avg":
+        result = result / n
+    return result
+
+
+def _ag_hop(out, t, n, axis_name, interpret):
+    """One host-level allgather hop mirroring `_allgather_kernel` step `t`."""
+    my = lax.axis_index(axis_name)
+    rows = out.shape[0] // n
+    send_idx = lax.rem(my - t + n, n)
+    recv_idx = lax.rem(my - t - 1 + n, n)
+    sent = lax.dynamic_slice(
+        out, (send_idx * rows, 0), (rows,) + out.shape[1:])
+    received = _permute_block(sent, axis_name, n, interpret)
+    return lax.dynamic_update_slice(out, received, (recv_idx * rows, 0))
+
+
+def start_ring_allgather(x, axis_name: str, *, n: int,
+                         impl: str = "auto") -> SplitPhaseHandle:
+    """Issue an allgather of this rank's shard `x` (same contract as
+    `ring_allgather`: result stacks shards on a new leading axis)."""
+    impl = select_impl(impl)
+    h = SplitPhaseHandle("allgather", axis_name, n, "sum", impl)
+    if impl == "lax" or n == 1:
+        h.buf = x
+        return h
+    block, shape, size = _to_block(x, 1)
+    rows = block.shape[0]
+    interpret = impl == "pallas_interpret"
+    my = lax.axis_index(axis_name)
+    out = jnp.zeros((n * rows,) + block.shape[1:], block.dtype)
+    out = lax.dynamic_update_slice(out, block, (my * rows, 0))
+    h.meta = (shape, size, rows)
+    h.buf = _ag_hop(out, 0, n, axis_name, interpret)
+    h.hops_done = 1
+    return h
+
+
+def wait_ring_allgather(h: SplitPhaseHandle):
+    """Await a `start_ring_allgather`: remaining hops + restack shards."""
+    n, axis_name = h.n, h.axis_name
+    if h.impl == "lax" or n == 1:
+        return lax.all_gather(h.buf, axis_name, tiled=False)
+    interpret = h.impl == "pallas_interpret"
+    out = h.buf
+    for t in range(h.hops_done, n - 1):
+        out = _ag_hop(out, t, n, axis_name, interpret)
+    shape, size, rows = h.meta
+    pieces = [
+        _from_block(out[i * rows:(i + 1) * rows], shape, size)
+        for i in range(n)
+    ]
+    return jnp.stack(pieces, axis=0)
+
+
+def start_ring_permute(x, axis_name: str, *, n: int,
+                       impl: str = "auto") -> SplitPhaseHandle:
+    """Issue a right-rotation: rank `i` sends `x` to rank `(i+1) % n` and
+    will receive rank `(i-1) % n`'s payload at the wait.  This is the KV
+    block exchange of ring attention: issue before the attention block
+    compute, await after, and the hop rides under the matmuls."""
+    impl = select_impl(impl)
+    h = SplitPhaseHandle("permute", axis_name, n, "sum", impl)
+    if n == 1:
+        h.buf = x
+        h.impl = "lax"  # identity; wait returns buf as-is
+        h.meta = None
+        return h
+    if impl == "lax":
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        h.buf = lax.ppermute(x, axis_name, perm)
+        return h
+    block, shape, size = _to_block(x, 1)
+    h.meta = (shape, size)
+    h.buf = _permute_block(block, axis_name, n,
+                           interpret=(impl == "pallas_interpret"))
+    return h
+
+
+def wait_ring_permute(h: SplitPhaseHandle):
+    """Await a `start_ring_permute`: return the left neighbour's payload."""
+    if h.impl == "lax" or h.n == 1:
+        return h.buf
+    shape, size = h.meta
+    return _from_block(h.buf, shape, size)
+
+
 def _lax_allreduce(x, axis_name, op):
     if op == "sum":
         return lax.psum(x, axis_name)

@@ -8,6 +8,15 @@ import ray_tpu
 from ray_tpu.util.collective.types import ReduceOp
 
 
+def _jax_cpu_multiprocess_supported() -> bool:
+    """jax < 0.5 raises INVALID_ARGUMENT on any cross-process CPU
+    computation (no gloo transport); the jax_num_cpu_devices config option
+    landed in the same release line and is a cheap capability probe."""
+    import jax
+
+    return hasattr(jax.config, "jax_num_cpu_devices")
+
+
 @ray_tpu.remote
 class CollectiveWorker:
     """Test actor implementing the _init_collective protocol used by
@@ -102,6 +111,9 @@ class TestSHMBackend:
                                       np.zeros(4))
 
 
+@pytest.mark.skipif(
+    not _jax_cpu_multiprocess_supported(),
+    reason="installed jax lacks multiprocess CPU collectives (gloo)")
 class TestXLABackend:
     def test_allreduce_multiprocess(self, ray_start_regular):
         """Two actor processes rendezvous via jax.distributed (gloo CPU) —

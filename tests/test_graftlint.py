@@ -39,7 +39,8 @@ PASS_CASES = [
      "collectives_clean.py",
      {"collective-unknown-axis", "collective-divergent-branches",
       "collective-member-mismatch", "collective-dtype-drift",
-      "collective-quantized-nonfloat"}),
+      "collective-quantized-nonfloat",
+      "collective-splitphase-unbalanced", "collective-ef-nonfloat"}),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      {"lock-cycle", "lock-blocking-call"}),
     ("metric-declarations", "metrics_bad.py", "metrics_clean.py",

@@ -81,6 +81,13 @@ def test_split_batch_conserves_remainder_rows(rows, n):
 
 @pytest.mark.parametrize("num_learners", [1, 2])
 def test_learner_group_update_improves_loss(ray_start_regular, num_learners):
+    if num_learners > 1:
+        import jax
+
+        if not hasattr(jax.config, "jax_num_cpu_devices"):
+            pytest.skip("installed jax lacks multiprocess CPU collectives "
+                        "(gloo); the 2-learner group needs cross-process "
+                        "allreduce")
     from ray_tpu.rllib.algorithms.ppo import PPOLearner
     from ray_tpu.rllib.core.learner_group import LearnerGroup
     from ray_tpu.train.config import ScalingConfig

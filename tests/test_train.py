@@ -15,6 +15,20 @@ from ray_tpu.train import (
 )
 
 
+def _jax_cpu_multiprocess_supported() -> bool:
+    """jax < 0.5 raises INVALID_ARGUMENT on any cross-process CPU
+    computation (no gloo transport); the jax_num_cpu_devices config option
+    landed in the same release line and is a cheap capability probe."""
+    import jax
+
+    return hasattr(jax.config, "jax_num_cpu_devices")
+
+
+_needs_cpu_multiprocess = pytest.mark.skipif(
+    not _jax_cpu_multiprocess_supported(),
+    reason="installed jax lacks multiprocess CPU collectives (gloo)")
+
+
 def mlp_train_loop(config):
     """Data-parallel MLP regression with a pjit'd step over the global mesh.
     Runs inside each train worker (2 processes x 4 virtual CPU devices)."""
@@ -101,6 +115,7 @@ def train_cluster():
 
 
 class TestJaxTrainer:
+    @_needs_cpu_multiprocess
     def test_dp_training_2workers(self, train_cluster, tmp_path):
         trainer = JaxTrainer(
             mlp_train_loop,
@@ -118,6 +133,7 @@ class TestJaxTrainer:
         state = result.checkpoint.to_pytree()
         assert state["epoch"] == 3
 
+    @_needs_cpu_multiprocess
     def test_resume_from_checkpoint(self, train_cluster, tmp_path):
         trainer = JaxTrainer(
             mlp_train_loop,
