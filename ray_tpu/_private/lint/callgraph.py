@@ -1,0 +1,250 @@
+"""Package-wide call graph for graftlint's interprocedural summaries.
+
+One level of "what does the callee do" is enough for every consumer in
+this suite: does the callee *block* (async-blocking-transitive), does it
+*consume* its argument (a ``_wait_rs`` closure waiting a split-phase
+handle, a wrapper whose param flows into a donated jit position), does
+it *produce* an obligation (a ``_start_rs`` closure returning a ring
+handle).  The graph therefore only needs call-site → function-def
+resolution, not a sound points-to analysis; anything ambiguous resolves
+to nothing and the client pass stays silent (precision over recall —
+a lint that cries wolf gets deleted).
+
+Resolution covers the shapes this codebase actually uses:
+
+- bare names: lexically enclosing defs first (closures), then
+  module-level defs, then ``from x import y`` (chased through up to 4
+  re-export hops for package ``__init__`` files);
+- ``self.m()`` / ``cls.m()``: methods of the lexically enclosing class;
+- ``ClassName.m()`` and ``alias.m()`` for imported modules.
+
+The graph is cached per ``run_lint`` module set: several passes share
+one build.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ray_tpu._private.lint.core import ModuleInfo
+
+__all__ = ["FuncInfo", "CallGraph", "get_call_graph"]
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class FuncInfo:
+    """One function/method definition."""
+
+    __slots__ = ("node", "mod", "name", "cls", "parent", "depth")
+
+    def __init__(self, node, mod: ModuleInfo, cls: str,
+                 parent: Optional["FuncInfo"], depth: int):
+        self.node = node
+        self.mod = mod
+        self.name = node.name
+        self.cls = cls              # enclosing class name, "" if none
+        self.parent = parent        # enclosing function, None at top
+        self.depth = depth
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+    @property
+    def qualname(self) -> str:
+        parts = []
+        f: Optional[FuncInfo] = self
+        while f is not None:
+            parts.append(f.name)
+            f = f.parent
+        if self.cls:
+            parts.append(self.cls)
+        return ".".join(reversed(parts))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<func {self.mod.relpath}:{self.qualname}>"
+
+
+def _module_name(relpath: str) -> str:
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[:-len(".__init__")]
+    return name
+
+
+class CallGraph:
+    def __init__(self, mods: Sequence[ModuleInfo]):
+        self.funcs: List[FuncInfo] = []
+        self.by_node: Dict[int, FuncInfo] = {}
+        self._mod_by_name: Dict[str, ModuleInfo] = {}
+        # per module: visible defs, class methods, import aliases
+        self._defs: Dict[str, Dict[str, List[FuncInfo]]] = {}
+        self._methods: Dict[str, Dict[str, Dict[str, FuncInfo]]] = {}
+        self._imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        for mod in mods:
+            self._mod_by_name[_module_name(mod.relpath)] = mod
+        for mod in mods:
+            self._index_module(mod)
+
+    # ------------------------------------------------------------ build
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        defs: Dict[str, List[FuncInfo]] = {}
+        methods: Dict[str, Dict[str, FuncInfo]] = {}
+        imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        modname = _module_name(mod.relpath)
+
+        def visit(node, cls: str, parent: Optional[FuncInfo],
+                  depth: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    methods.setdefault(child.name, {})
+                    visit(child, child.name, parent, depth)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    fi = FuncInfo(child, mod, cls, parent, depth)
+                    self.funcs.append(fi)
+                    self.by_node[id(child)] = fi
+                    defs.setdefault(child.name, []).append(fi)
+                    if cls:
+                        methods.setdefault(cls, {}).setdefault(
+                            child.name, fi)
+                    visit(child, "", fi, depth + 1)
+                else:
+                    visit(child, cls, parent, depth)
+
+        visit(mod.tree, "", None, 0)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name, None)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg = modname.split(".")
+                    # level 1 = this module's package, 2 = its parent...
+                    pkg = pkg[:len(pkg) - node.level]
+                    base = ".".join(pkg + ([node.module]
+                                           if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    imports[alias.asname or alias.name] = (base,
+                                                           alias.name)
+        self._defs[mod.relpath] = defs
+        self._methods[mod.relpath] = methods
+        self._imports[mod.relpath] = imports
+
+    # ---------------------------------------------------------- resolve
+
+    def resolve(self, func_expr: ast.expr,
+                caller: Optional[FuncInfo],
+                mod: ModuleInfo,
+                _depth: int = 0) -> Optional[FuncInfo]:
+        """The FuncInfo a call target refers to, or None when ambiguous
+        or out of view."""
+        if _depth > 4:
+            return None
+        if isinstance(func_expr, ast.Name):
+            return self._resolve_name(func_expr.id, caller, mod, _depth)
+        if isinstance(func_expr, ast.Attribute):
+            base = func_expr.value
+            attr = func_expr.attr
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls") and caller is not None \
+                        and caller.cls:
+                    return self._methods[mod.relpath].get(
+                        caller.cls, {}).get(attr)
+                # ClassName.m() on a locally defined class.
+                local = self._methods[mod.relpath].get(base.id)
+                if local is not None:
+                    return local.get(attr)
+                # module-alias.f()
+                imp = self._imports[mod.relpath].get(base.id)
+                if imp is not None:
+                    target = imp[0] if imp[1] is None else \
+                        f"{imp[0]}.{imp[1]}"
+                    return self._resolve_in_module(target, attr, _depth)
+        return None
+
+    def _resolve_name(self, name: str, caller: Optional[FuncInfo],
+                      mod: ModuleInfo, _depth: int) -> Optional[FuncInfo]:
+        cands = self._defs.get(mod.relpath, {}).get(name, [])
+        if caller is not None and len(cands) > 1:
+            # Prefer the def lexically closest to the caller: one whose
+            # enclosing-function chain is a prefix of the caller's.
+            chain = set()
+            f: Optional[FuncInfo] = caller
+            while f is not None:
+                chain.add(id(f.node))
+                f = f.parent
+            near = [c for c in cands
+                    if c.parent is None or id(c.parent.node) in chain
+                    or (caller.parent is not None and c.parent is
+                        caller.parent)]
+            if len(near) == 1:
+                return near[0]
+            cands = near or cands
+        if len(cands) == 1:
+            return cands[0]
+        if cands:
+            return None   # ambiguous: stay silent
+        imp = self._imports.get(mod.relpath, {}).get(name)
+        if imp is not None and imp[1] is not None:
+            return self._resolve_in_module(imp[0], imp[1], _depth)
+        return None
+
+    def _resolve_in_module(self, modname: str, attr: str,
+                           _depth: int) -> Optional[FuncInfo]:
+        target = self._mod_by_name.get(modname)
+        if target is None:
+            return None
+        cands = [c for c in
+                 self._defs.get(target.relpath, {}).get(attr, [])
+                 if c.parent is None and not c.cls]
+        if len(cands) == 1:
+            return cands[0]
+        if cands:
+            return None
+        # Chase one re-export hop (package __init__ files).
+        imp = self._imports.get(target.relpath, {}).get(attr)
+        if imp is not None and imp[1] is not None:
+            return self._resolve_in_module(imp[0], imp[1], _depth + 1)
+        return None
+
+    # ---------------------------------------------------------- queries
+
+    def direct_calls(self, func: FuncInfo
+                     ) -> Iterable[Tuple[ast.Call, Optional[FuncInfo]]]:
+        """(call node, resolved callee) for every call in the function's
+        own scope (nested defs/lambdas excluded — they run elsewhere)."""
+        stack = list(ast.iter_child_nodes(func.node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _SCOPE_NODES):
+                continue
+            if isinstance(n, ast.Call):
+                yield n, self.resolve(n.func, func, func.mod)
+            stack.extend(ast.iter_child_nodes(n))
+
+
+_graph_cache: List[Tuple[Tuple[int, ...], CallGraph]] = []
+
+
+def get_call_graph(mods: Sequence[ModuleInfo]) -> CallGraph:
+    """Build (or reuse) the call graph for this run's module set.  Keyed
+    by object identity: within one ``run_lint`` every pass sees the same
+    ModuleInfo instances."""
+    key = tuple(id(m) for m in mods)
+    for k, g in _graph_cache:
+        if k == key:
+            return g
+    g = CallGraph(mods)
+    _graph_cache.append((key, g))
+    del _graph_cache[:-4]
+    return g

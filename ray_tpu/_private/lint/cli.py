@@ -11,7 +11,9 @@ finding set, preserving justifications of entries that still match.
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -25,6 +27,36 @@ def repo_root() -> str:
 
 def default_baseline_path() -> str:
     return os.path.join(repo_root(), ".graftlint-baseline.json")
+
+
+def changed_files(base: str, root: str) -> Optional[List[str]]:
+    """Python files changed vs ``base`` (plus untracked ones), absolute
+    paths; None when git can't answer (not a repo, bad ref)."""
+    names = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", base, "--"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            print(f"graftlint: --changed-only: {' '.join(cmd[3:])} "
+                  f"failed: {proc.stderr.strip()}", file=sys.stderr)
+            return None
+        names.update(proc.stdout.splitlines())
+    return [os.path.join(root, n) for n in sorted(names)
+            if n.endswith(".py")]
+
+
+def _under(path: str, roots: List[str]) -> bool:
+    path = os.path.abspath(path)
+    for r in roots:
+        r = os.path.abspath(r)
+        if path == r or path.startswith(r.rstrip(os.sep) + os.sep):
+            return True
+    return False
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -57,6 +89,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--list-passes", action="store_true",
         help="list registered passes and their rules")
     parser.add_argument(
+        "--changed-only", nargs="?", const="HEAD", default=None,
+        metavar="BASE",
+        help="lint only .py files changed vs BASE (git diff "
+             "--name-only; default base: HEAD) plus untracked files")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json: one machine-readable object on "
+             "stdout)")
+    parser.add_argument(
         "-q", "--quiet", action="store_true",
         help="print findings only (no summary)")
     args = parser.parse_args(argv)
@@ -73,8 +114,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline_path = None if args.no_baseline else (
         args.baseline or default_baseline_path())
 
+    if args.changed_only is not None:
+        changed = changed_files(args.changed_only, root)
+        if changed is None:
+            return 2
+        roots = [f for f in changed
+                 if _under(f, roots) and os.path.exists(f)]
+
     result = run_lint(roots, select=args.select,
                       baseline=baseline_path, rel_to=root)
+    if args.changed_only is not None:
+        # A partial run can't tell fixed-elsewhere from out-of-scope.
+        result.stale_baseline = []
 
     if args.baseline_update:
         path = args.baseline or default_baseline_path()
@@ -85,6 +136,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"graftlint: baseline written to {path} "
               f"({len(new_base.entries)} entries)")
         return 0
+
+    if args.format == "json":
+        def _row(f):
+            return {"rule": f.rule, "path": f.path, "line": f.line,
+                    "message": f.message, "context": f.context}
+        print(json.dumps({
+            "ok": not result.findings,
+            "files": len(result.modules),
+            "findings": [_row(f) for f in result.findings],
+            "baselined": len(result.baselined),
+            "suppressed": len(result.suppressed),
+            "stale_baseline": result.stale_baseline,
+        }, indent=2, sort_keys=True))
+        return 1 if result.findings else 0
 
     for f in result.findings:
         print(f.render())

@@ -1,0 +1,956 @@
+"""Per-function dataflow for graftlint: CFGs + obligation tracking.
+
+The PR-6/PR-12 passes were structural — they matched call shapes inside
+a scope and could not see *paths*.  The bug classes that motivated this
+engine are all path problems:
+
+- a binding read *after* it flowed into a donated ``jit`` position on
+  some path (use-after-donate reads freed HBM);
+- a split-phase ``start_*`` handle that misses its ``wait_*`` on an
+  early return or exception edge (the mesh hangs);
+- an ``ObjectRef`` dropped or overwritten before anything consumed it
+  (the object stays pinned in plasma forever).
+
+Three layers live here:
+
+1. :func:`build_cfg` — a per-function control-flow graph.  Branches,
+   loops (with ``else``), ``try``/``except``/``finally`` (exceptional
+   edges are tagged so passes can opt in or out), ``with``, early
+   ``return``/``raise``/``break``/``continue``.  Inside a ``try`` body
+   with handlers every statement gets its own block, so the state
+   flowing into a handler is the union of the states after *each*
+   statement the exception could interrupt.
+2. :func:`solve` — a worklist fixpoint over block states.  States are
+   joined by union (may-analysis): a finding means "there EXISTS a path
+   on which the obligation goes wrong", which is exactly the split-phase
+   / ObjectRef contract ("on every path").
+3. :class:`ObligationEngine` — the shared abstract interpretation both
+   value-obligation passes (split-phase handles, ObjectRefs) configure:
+   values created by calls, bound to names (including containers via
+   ``append``/subscript stores), discharged by matching consumers or by
+   escaping (return / passed to a call), violated by drop, overwrite,
+   ``del``, or reaching function exit still live.
+
+Everything is pure stdlib ``ast``; no code under analysis ever runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import (
+    Callable, Dict, FrozenSet, Iterable, Iterator, List, Optional,
+    Sequence, Set, Tuple,
+)
+
+__all__ = [
+    "Block", "CFG", "build_cfg", "cfgs_for_module", "solve",
+    "walk_no_scope", "load_names", "ObligationEngine", "Violation",
+]
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# ------------------------------------------------------------------ CFG
+
+
+class Block:
+    """A straight-line run of statements.
+
+    ``stmts`` holds the AST nodes *evaluated at this point*: simple
+    statements as-is, branch/loop tests as bare expression nodes, and
+    ``For``/``With``/``ExceptHandler`` nodes standing in for their
+    binding effect (helpers know to read only the parts that execute
+    at the construct's head, never the body).
+    """
+
+    __slots__ = ("id", "stmts", "succs", "preds")
+
+    def __init__(self, bid: int):
+        self.id = bid
+        self.stmts: List[ast.AST] = []
+        self.succs: List[Tuple["Block", bool]] = []   # (target, is_exc)
+        self.preds: List[Tuple["Block", bool]] = []
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"B{self.id}({len(self.stmts)} stmts)"
+
+
+class CFG:
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.blocks: List[Block] = []
+        self.entry: Block = None  # type: ignore[assignment]
+        self.exit: Block = None   # type: ignore[assignment]
+
+    def block_at(self, lineno: int) -> Optional[Block]:
+        """First block holding a statement that starts on ``lineno``
+        (test helper)."""
+        for b in self.blocks:
+            for s in b.stmts:
+                if getattr(s, "lineno", None) == lineno:
+                    return b
+        return None
+
+    def reachable(self) -> Set[Block]:
+        seen = {self.entry}
+        stack = [self.entry]
+        while stack:
+            for succ, _ in stack.pop().succs:
+                if succ not in seen:
+                    seen.add(succ)
+                    stack.append(succ)
+        return seen
+
+
+class _Builder:
+    def __init__(self, func: ast.AST):
+        self.cfg = CFG(func)
+        # (break target, continue target, finally-stack depth at entry)
+        self.loops: List[Tuple[Block, Block, int]] = []
+        # innermost-last: handler entry blocks of enclosing try's
+        self.handlers: List[List[Block]] = []
+        self.finallies: List[List[ast.stmt]] = []
+        # >0 → one statement per block (inside a try body with handlers)
+        self.split = 0
+
+    def new_block(self) -> Block:
+        b = Block(len(self.cfg.blocks))
+        self.cfg.blocks.append(b)
+        return b
+
+    @staticmethod
+    def connect(a: Optional[Block], b: Optional[Block],
+                exc: bool = False) -> None:
+        if a is None or b is None:
+            return
+        a.succs.append((b, exc))
+        b.preds.append((a, exc))
+
+    def build(self) -> CFG:
+        self.cfg.entry = self.new_block()
+        self.cfg.exit = self.new_block()
+        end = self.seq(getattr(self.cfg.func, "body", []), self.cfg.entry)
+        self.connect(end, self.cfg.exit)
+        return self.cfg
+
+    # ---------------------------------------------------------- helpers
+
+    def append(self, stmt: ast.AST, cur: Block) -> Block:
+        cur.stmts.append(stmt)
+        if self.split:
+            nxt = self.new_block()
+            self.connect(cur, nxt)
+            return nxt
+        return cur
+
+    def seq(self, stmts: Sequence[ast.stmt],
+            cur: Optional[Block]) -> Optional[Block]:
+        for s in stmts:
+            if cur is None:
+                # Dead code after return/raise/break: keep building so
+                # nested defs are still discovered, but nothing flows in.
+                cur = self.new_block()
+            cur = self.stmt(s, cur)
+        return cur
+
+    def run_finallies(self, cur: Block, down_to: int = 0) -> Block:
+        """Inline fresh copies of the active ``finally`` bodies (innermost
+        first) onto an abrupt exit path (return/break/continue)."""
+        for fin in reversed(self.finallies[down_to:]):
+            nxt = self.seq(fin, cur)
+            cur = nxt if nxt is not None else self.new_block()
+        return cur
+
+    # ------------------------------------------------------- statements
+
+    def stmt(self, node: ast.stmt, cur: Block) -> Optional[Block]:
+        if isinstance(node, ast.If):
+            return self._if(node, cur)
+        if isinstance(node, (ast.While,)):
+            return self._while(node, cur)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            return self._for(node, cur)
+        if isinstance(node, ast.Try):
+            return self._try(node, cur)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # Linear: the context exprs/bindings happen at the head, the
+            # body runs inline. __exit__ cleanup is invisible to the AST.
+            cur = self.append(node, cur)
+            return self.seq(node.body, cur)
+        if isinstance(node, ast.Return):
+            cur = self.append(node, cur)
+            cur = self.run_finallies(cur)
+            self.connect(cur, self.cfg.exit)
+            return None
+        if isinstance(node, ast.Raise):
+            cur = self.append(node, cur)
+            if self.handlers:
+                for h in self.handlers[-1]:
+                    self.connect(cur, h, exc=True)
+            else:
+                cur = self.run_finallies(cur)
+                self.connect(cur, self.cfg.exit, exc=True)
+            return None
+        if isinstance(node, ast.Break):
+            target, _, depth = self.loops[-1]
+            cur = self.run_finallies(cur, depth)
+            self.connect(cur, target)
+            return None
+        if isinstance(node, ast.Continue):
+            _, target, depth = self.loops[-1]
+            cur = self.run_finallies(cur, depth)
+            self.connect(cur, target)
+            return None
+        if isinstance(node, ast.Match):
+            return self._match(node, cur)
+        # Simple statement (incl. nested def/class: a plain binding).
+        return self.append(node, cur)
+
+    def _if(self, node: ast.If, cur: Block) -> Optional[Block]:
+        cur = self.append(node.test, cur)
+        then_start = self.new_block()
+        self.connect(cur, then_start)
+        then_end = self.seq(node.body, then_start)
+        if node.orelse:
+            else_start = self.new_block()
+            self.connect(cur, else_start)
+            else_end = self.seq(node.orelse, else_start)
+        else:
+            else_end = cur
+        if then_end is None and else_end is None:
+            return None
+        join = self.new_block()
+        self.connect(then_end, join)
+        self.connect(else_end, join)
+        return join
+
+    @staticmethod
+    def _const_true(test: ast.expr) -> bool:
+        return isinstance(test, ast.Constant) and bool(test.value) is True
+
+    def _while(self, node: ast.While, cur: Block) -> Optional[Block]:
+        head = self.new_block()
+        self.connect(cur, head)
+        head.stmts.append(node.test)
+        after = self.new_block()
+        self.loops.append((after, head, len(self.finallies)))
+        body_start = self.new_block()
+        self.connect(head, body_start)
+        body_end = self.seq(node.body, body_start)
+        self.connect(body_end, head)
+        self.loops.pop()
+        if not self._const_true(node.test):
+            # Normal loop exit (test false): through else, or straight out.
+            if node.orelse:
+                else_start = self.new_block()
+                self.connect(head, else_start)
+                else_end = self.seq(node.orelse, else_start)
+                self.connect(else_end, after)
+            else:
+                self.connect(head, after)
+        return after if after.preds else None
+
+    def _for(self, node, cur: Block) -> Optional[Block]:
+        # ``for`` bodies are modeled as executing AT LEAST once: the
+        # loop exit flows from the end of an iteration, never straight
+        # from the head.  The overlap idiom starts chunk 0's collective
+        # before a ``for c in range(n_chunks)`` that always runs — a
+        # zero-trip edge would flag every such schedule on an
+        # infeasible path.  The cost is a missed finding when a
+        # genuinely-empty iterable skips the body's discharge
+        # (precision over recall, as everywhere in this engine).
+        head = self.new_block()
+        self.connect(cur, head)
+        head.stmts.append(node)   # helpers read .iter (load) + .target (bind)
+        after = self.new_block()
+        self.loops.append((after, head, len(self.finallies)))
+        body_start = self.new_block()
+        self.connect(head, body_start)
+        body_end = self.seq(node.body, body_start)
+        self.connect(body_end, head)
+        self.loops.pop()
+        if node.orelse:
+            else_start = self.new_block()
+            self.connect(body_end, else_start)
+            else_end = self.seq(node.orelse, else_start)
+            self.connect(else_end, after)
+        else:
+            self.connect(body_end, after)
+        return after if after.preds else None
+
+    def _try(self, node: ast.Try, cur: Block) -> Optional[Block]:
+        if node.finalbody:
+            self.finallies.append(node.finalbody)
+        handler_entries: List[Block] = []
+        if node.handlers:
+            for h in node.handlers:
+                he = self.new_block()
+                he.stmts.append(h)   # binds ``except E as name``
+                handler_entries.append(he)
+            self.handlers.append(handler_entries)
+            self.split += 1
+        body_start = self.new_block()
+        self.connect(cur, body_start)
+        lo = body_start.id
+        body_end = self.seq(node.body, body_start)
+        hi = len(self.cfg.blocks)
+        if node.handlers:
+            self.split -= 1
+            self.handlers.pop()
+            # The exception can interrupt the body anywhere: the state
+            # after each body statement may flow into every handler.
+            for b in self.cfg.blocks[lo:hi]:
+                if b in handler_entries:
+                    continue
+                for he in handler_entries:
+                    self.connect(b, he, exc=True)
+        if node.orelse and body_end is not None:
+            body_end = self.seq(node.orelse, body_end)
+        handler_ends = [self.seq(h.body, he)
+                        for h, he in zip(node.handlers, handler_entries)]
+        ends = [e for e in [body_end] + handler_ends if e is not None]
+        if node.finalbody:
+            self.finallies.pop()
+            fstart = self.new_block()
+            for e in ends:
+                self.connect(e, fstart)
+            # Unhandled-exception path: finally runs, then re-raises.
+            for b in self.cfg.blocks[lo:hi]:
+                if b is not fstart and b not in handler_entries:
+                    self.connect(b, fstart, exc=True)
+            fend = self.seq(node.finalbody, fstart)
+            if fend is not None and not ends:
+                # Only abrupt exits reach the finally: it never falls out.
+                self.connect(fend, self.cfg.exit, exc=True)
+                return None
+            return fend
+        if not ends:
+            return None
+        join = self.new_block()
+        for e in ends:
+            self.connect(e, join)
+        return join
+
+    def _match(self, node, cur: Block) -> Optional[Block]:
+        cur = self.append(node.subject, cur)
+        ends = []
+        exhaustive = False
+        for case in node.cases:
+            start = self.new_block()
+            self.connect(cur, start)
+            if case.guard is not None:
+                start.stmts.append(case.guard)
+            ends.append(self.seq(case.body, start))
+            if (isinstance(case.pattern, ast.MatchAs)
+                    and case.pattern.pattern is None
+                    and case.guard is None):
+                exhaustive = True   # bare ``case _:``
+        ends = [e for e in ends if e is not None]
+        if not exhaustive:
+            ends.append(cur)
+        if not ends:
+            return None
+        join = self.new_block()
+        for e in ends:
+            self.connect(e, join)
+        return join
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """CFG for one ``def``/``async def`` (body only; nested defs are
+    opaque single statements)."""
+    return _Builder(func).build()
+
+
+def cfgs_for_module(mod) -> Dict[ast.AST, CFG]:
+    """Every function's CFG, cached on the ModuleInfo (several passes
+    walk the same functions in one run)."""
+    cache = getattr(mod, "_graftlint_cfgs", None)
+    if cache is None:
+        cache = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cache[node] = build_cfg(node)
+        mod._graftlint_cfgs = cache
+    return cache
+
+
+# --------------------------------------------------------------- solver
+
+
+def solve(cfg: CFG,
+          transfer: Callable[[Block, object], object],
+          initial: object,
+          join: Callable[[object, object], object],
+          follow_exc: bool = True,
+          max_iter: int = 4000) -> Dict[Block, object]:
+    """Worklist fixpoint: returns the IN state of every reached block.
+
+    ``transfer(block, in_state) -> out_state`` must be monotone w.r.t.
+    ``join`` (set-union states are). ``follow_exc=False`` ignores
+    exceptional edges (passes where a raise path is not the bug)."""
+    in_states: Dict[Block, object] = {cfg.entry: initial}
+    work = [cfg.entry]
+    iters = 0
+    while work:
+        iters += 1
+        if iters > max_iter:   # pathological CFG: bail, report nothing new
+            break
+        b = work.pop()
+        out = transfer(b, in_states[b])
+        for succ, exc in b.succs:
+            if exc and not follow_exc:
+                continue
+            cur = in_states.get(succ)
+            joined = out if cur is None else join(cur, out)
+            if cur is None or joined != cur:
+                in_states[succ] = joined
+                if succ not in work:
+                    work.append(succ)
+    return in_states
+
+
+# ------------------------------------------------------- AST utilities
+
+
+def walk_no_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node``'s subtree without entering nested function/lambda
+    bodies (comprehensions are entered: they evaluate here)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if n is not node and isinstance(n, _SCOPE_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def effective_exprs(stmt: ast.AST) -> List[ast.expr]:
+    """The expressions a CFG block statement actually evaluates *at this
+    program point* (a ``For`` head evaluates its iterable, not its
+    body)."""
+    if isinstance(stmt, ast.expr):               # branch/loop test
+        return [stmt]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.ExceptHandler):
+        return [stmt.type] if stmt.type is not None else []
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value]
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value]
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test] + ([stmt.msg] if stmt.msg else [])
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    return []
+
+
+def bound_names(stmt: ast.AST) -> List[str]:
+    """Plain names (re)bound by this block statement."""
+    out: List[str] = []
+
+    def targets(t: ast.expr) -> None:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, ast.Starred):
+            targets(t.value)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets(e)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            targets(t)
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                targets(item.optional_vars)
+    elif isinstance(stmt, ast.ExceptHandler):
+        if stmt.name:
+            out.append(stmt.name)
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        out.append(stmt.name)
+    return out
+
+
+def deleted_names(stmt: ast.AST) -> List[str]:
+    if not isinstance(stmt, ast.Delete):
+        return []
+    return [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+
+
+def load_names(expr: ast.expr) -> List[ast.Name]:
+    """Name nodes in Load context under ``expr`` (nested scopes
+    excluded)."""
+    return [n for n in walk_no_scope(expr)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+
+
+def base_name(expr: ast.expr) -> Optional[str]:
+    """The tracked name an argument expression refers to: a plain Name,
+    or the container behind a subscript/star (``handles[c]`` → handles)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Subscript) and isinstance(expr.value, ast.Name):
+        return expr.value.id
+    if isinstance(expr, ast.Starred):
+        return base_name(expr.value)
+    return None
+
+
+# Receiver methods that stash a value into the receiver container (the
+# obligation transfers to the container binding rather than escaping).
+_CONTAINER_METHODS = {"append", "add", "insert", "extend", "appendleft"}
+
+_LIVE = "live"
+_DONE = "done"
+
+
+class _State:
+    """obligs: obligation id -> possible statuses; binds: name ->
+    obligation ids the name may hold."""
+
+    __slots__ = ("obligs", "binds")
+
+    def __init__(self,
+                 obligs: Optional[Dict[int, FrozenSet[str]]] = None,
+                 binds: Optional[Dict[str, FrozenSet[int]]] = None):
+        self.obligs = obligs or {}
+        self.binds = binds or {}
+
+    def copy(self) -> "_State":
+        return _State(dict(self.obligs), dict(self.binds))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, _State) and self.obligs == other.obligs
+                and self.binds == other.binds)
+
+    def __hash__(self):  # pragma: no cover - states are not dict keys
+        raise TypeError("unhashable")
+
+    @staticmethod
+    def join(a: "_State", b: "_State") -> "_State":
+        obligs = dict(a.obligs)
+        for oid, st in b.obligs.items():
+            obligs[oid] = obligs.get(oid, frozenset()) | st
+        binds = dict(a.binds)
+        for name, ids in b.binds.items():
+            binds[name] = binds.get(name, frozenset()) | ids
+        return _State(obligs, binds)
+
+
+class Violation:
+    """A raw engine violation, turned into a Finding by the pass."""
+
+    __slots__ = ("kind", "origin", "node", "detail")
+
+    def __init__(self, kind: str, origin: ast.AST, node: ast.AST,
+                 detail: str = ""):
+        self.kind = kind       # dropped|overwritten|deleted|exit|double|
+        self.origin = origin   # the creating call      # mismatch
+        self.node = node       # where it went wrong
+        self.detail = detail
+
+
+class ObligationEngine:
+    """Shared value-obligation analysis.  Subclasses configure:
+
+    - :meth:`creation_key` — a call that creates an obligation (returns
+      an opaque key used for matching, or None);
+    - :meth:`discharge_key` — a call that explicitly discharges
+      obligations flowing into its arguments (split-phase ``wait_*``);
+      return None when any use discharges (ObjectRefs);
+    - ``follow_exc`` — whether exception edges count as paths;
+    - ``report_double`` / ``report_mismatch`` — emit those kinds.
+
+    Escape = discharge: a value returned, yielded, awaited, stored into
+    an attribute, or passed to any call we can't see through is assumed
+    consumed — the engine is tuned to flag only what it can prove is
+    dropped on some path, never to second-guess an escape.
+    """
+
+    follow_exc = True
+    report_double = False
+    report_mismatch = False
+    # True → ANY Load of a bound name discharges (ObjectRefs: any read
+    # may store/consume the ref). False → only escapes discharge
+    # (split-phase: reading a handle does not wait it).
+    loads_consume = False
+
+    # -- hooks ---------------------------------------------------------
+    def creation_key(self, call: ast.Call) -> Optional[str]:
+        raise NotImplementedError
+
+    def discharge_key(self, call: ast.Call) -> Optional[str]:
+        return None
+
+    def keys_match(self, creation: str, discharge: str) -> bool:
+        return creation == discharge
+
+    # -- driver --------------------------------------------------------
+    def analyze(self, cfg: CFG) -> List[Violation]:
+        self._violations: Dict[Tuple[str, int, int], Violation] = {}
+        self._origins: Dict[int, ast.AST] = {}
+        self._keys: Dict[int, str] = {}
+        # Storing into a PARAMETER container escapes to the caller —
+        # only locally-created containers are tracked stashes.
+        args = getattr(cfg.func, "args", None)
+        self._params: Set[str] = set()
+        if args is not None:
+            self._params = {a.arg for a in (args.posonlyargs + args.args
+                                            + args.kwonlyargs)}
+            for va in (args.vararg, args.kwarg):
+                if va is not None:
+                    self._params.add(va.arg)
+        # Names a nested def/lambda reads are closure captures: a value
+        # bound to one stays reachable through the closure, so it is
+        # never "dropped" here no matter what this frame does with the
+        # binding afterwards.
+        self._captured: Set[str] = set()
+        for n in ast.walk(cfg.func):
+            if n is cfg.func or not isinstance(n, _SCOPE_NODES):
+                continue
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                            ast.Load):
+                    self._captured.add(sub.id)
+        self._pre_status: Dict[int, FrozenSet[str]] = {}
+
+        def transfer(block: Block, st: _State) -> _State:
+            st = st.copy()
+            for stmt in block.stmts:
+                self._transfer_stmt(stmt, st)
+            return st
+
+        in_states = solve(cfg, transfer, _State(), _State.join,
+                          follow_exc=self.follow_exc)
+        exit_state = in_states.get(cfg.exit)
+        if exit_state is not None:
+            for oid, statuses in exit_state.obligs.items():
+                if _LIVE in statuses:
+                    origin = self._origins[oid]
+                    self._emit("exit", origin, origin)
+        return list(self._violations.values())
+
+    def _emit(self, kind: str, origin: ast.AST, node: ast.AST,
+              detail: str = "") -> None:
+        key = (kind, getattr(origin, "lineno", 0),
+               getattr(node, "lineno", 0))
+        if key not in self._violations:
+            self._violations[key] = Violation(kind, origin, node, detail)
+
+    # -- per-statement transfer ---------------------------------------
+    def _new_oblig(self, call: ast.Call, key: str, st: _State) -> int:
+        oid = id(call)
+        if oid in st.obligs and oid not in self._pre_status:
+            # Same creation site re-executed (loop back edge): remember
+            # the PREVIOUS iteration's status so a same-statement rebind
+            # judges the old value, not the one just created.
+            self._pre_status[oid] = st.obligs[oid]
+        self._origins[oid] = call
+        self._keys[oid] = key
+        st.obligs[oid] = frozenset([_LIVE])
+        return oid
+
+    def _discharge_ids(self, ids: Iterable[int], dkey: str, st: _State,
+                       at: ast.AST, precise: bool = True) -> None:
+        """``precise=False`` → the discharge went through a container
+        (``wait(handles[i])``, comprehension over a stash): we can't
+        tell WHICH element it hit, so discharge everything but never
+        call it a double-wait."""
+        for oid in ids:
+            statuses = st.obligs.get(oid)
+            if statuses is None:
+                continue
+            ck = self._keys[oid]
+            if not self.keys_match(ck, dkey):
+                if self.report_mismatch:
+                    self._emit("mismatch", self._origins[oid], at,
+                               detail=f"{ck} vs {dkey}")
+                continue
+            if self.report_double and precise \
+                    and statuses == frozenset([_DONE]):
+                self._emit("double", self._origins[oid], at)
+            st.obligs[oid] = frozenset([_DONE])
+
+    def _consume_ids(self, ids: Iterable[int], st: _State) -> None:
+        for oid in ids:
+            if oid in st.obligs:
+                st.obligs[oid] = frozenset([_DONE])
+
+    def _kill_binding(self, name: str, st: _State, node: ast.AST,
+                      kind: str) -> None:
+        """Rebind/del of ``name``: obligations only it still holds and
+        that may still be live are lost on this path."""
+        old = st.binds.pop(name, frozenset())
+        if name in self._captured:
+            return   # a closure still reaches it: losing OUR binding is fine
+        for oid in old:
+            statuses = self._pre_status.get(
+                oid, st.obligs.get(oid, frozenset()))
+            if _LIVE not in statuses:
+                continue
+            aliased = any(oid in ids for n, ids in st.binds.items())
+            if not aliased:
+                self._emit(kind, self._origins[oid], node)
+                st.obligs[oid] = frozenset([_DONE])   # report once
+
+    def _transfer_stmt(self, stmt: ast.AST, st: _State) -> None:
+        self._pre_status = {}
+        # Pure alias (``h2 = h``): copy the binding, consume nothing.
+        if (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Name)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            src = st.binds.get(stmt.value.id)
+            tgt = stmt.targets[0].id
+            if tgt != stmt.value.id:
+                self._kill_binding(tgt, st, stmt, "overwritten")
+            if src:
+                st.binds[tgt] = src
+            return
+
+        created_binds: Dict[str, Set[int]] = {}
+        for expr in effective_exprs(stmt):
+            self._process_expr(expr, stmt, st, created_binds)
+
+        # Rebinds: overwrite-while-live, then install fresh bindings.
+        for name in bound_names(stmt):
+            self._kill_binding(name, st, stmt, "overwritten")
+            if name in created_binds:
+                st.binds[name] = frozenset(created_binds[name])
+        # Creations routed into a container (``handles[i] = start(...)``,
+        # ``refs.append(...)``) extend that container's binding.
+        for name, ids in created_binds.items():
+            if name not in bound_names(stmt):
+                st.binds[name] = st.binds.get(name, frozenset()) \
+                    | frozenset(ids)
+
+        for name in deleted_names(stmt):
+            self._kill_binding(name, st, stmt, "deleted")
+
+    # Fates for a creation found inside an expression tree.
+    def _process_expr(self, expr: ast.expr, stmt: ast.AST, st: _State,
+                      created_binds: Dict[str, Set[int]]) -> None:
+        parents: Dict[int, ast.AST] = {}
+        for n in walk_no_scope(expr):
+            for c in ast.iter_child_nodes(n):
+                parents.setdefault(id(c), n)
+
+        calls = [n for n in walk_no_scope(expr) if isinstance(n, ast.Call)]
+
+        # 1. Explicit dischargers (wait_*): discharge what their args hold.
+        immediately_discharged: Set[int] = set()
+        comp_targets = self._comprehension_iters(expr)
+        for call in calls:
+            dkey = self.discharge_key(call)
+            if dkey is None:
+                continue
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                inner = arg.value if isinstance(arg, ast.Starred) else arg
+                if isinstance(inner, ast.Call):
+                    ck = self.creation_key(inner)
+                    if ck is not None:
+                        # wait_x(start_x(...)): created and discharged
+                        # in place; still key-checked.
+                        oid = self._new_oblig(inner, ck, st)
+                        self._discharge_ids([oid], dkey, st, call)
+                        immediately_discharged.add(id(inner))
+                        continue
+                name = base_name(inner)
+                if name is None and isinstance(inner, ast.Name):
+                    name = inner.id
+                if name is not None:
+                    # A comprehension variable stands for elements of the
+                    # iterated container: discharge the container.
+                    precise = isinstance(inner, ast.Name) \
+                        and inner.id not in comp_targets
+                    name = comp_targets.get(name, name)
+                    self._discharge_ids(st.binds.get(name, ()), dkey, st,
+                                        call, precise=precise)
+
+        # 2. Creations and their fate.
+        for call in calls:
+            if id(call) in immediately_discharged:
+                continue
+            ck = self.creation_key(call)
+            if ck is None:
+                continue
+            fate, container = self._fate(call, expr, stmt, parents)
+            if fate == "bind":
+                if container in self._captured:
+                    continue   # closure-reachable binding: escapes
+                oid = self._new_oblig(call, ck, st)
+                created_binds.setdefault(container, set()).add(oid)
+            elif fate == "dropped":
+                self._emit("dropped", call, call)
+            # "escaped": consumed by a call/return/await/attr — no oblig.
+
+        # 3. Generic consumption: every name (or container) flowing into
+        # any call escapes to that callee; returns/yields escape to the
+        # caller.  AugAssign reads its target.
+        consumed: Set[str] = set()
+        for call in calls:
+            for sub in walk_no_scope(call):
+                if sub is call:
+                    continue
+                if isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                            ast.Load):
+                    consumed.add(comp_targets.get(sub.id, sub.id))
+        if isinstance(stmt, ast.Return) or isinstance(
+                getattr(stmt, "value", None), (ast.Yield, ast.YieldFrom)):
+            for n in load_names(expr):
+                consumed.add(n.id)
+        for n in walk_no_scope(expr):
+            if isinstance(n, ast.Await):
+                for ln in load_names(n.value):
+                    consumed.add(ln.id)
+            elif isinstance(n, ast.Attribute) and isinstance(
+                    n.value, ast.Name) and isinstance(
+                        n.value.ctx, ast.Load) and not isinstance(
+                            parents.get(id(n)), ast.Call):
+                # ``obj.attr = h`` / reading a field: treat the base as
+                # used (attribute escapes are untrackable).
+                consumed.add(n.value.id)
+        if isinstance(stmt, ast.AugAssign) and isinstance(stmt.target,
+                                                          ast.Name):
+            consumed.add(stmt.target.id)
+        if self.loads_consume:
+            for ln in load_names(expr):
+                consumed.add(comp_targets.get(ln.id, ln.id))
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    # ``self.h = ref`` / ``d[k] = ref``: escapes into the
+                    # structure unless the structure is a tracked local
+                    # container (then the binding transfer below holds it).
+                    tgt_container = base_name(t)
+                    for ln in load_names(stmt.value):
+                        if tgt_container is not None and isinstance(
+                                t, ast.Subscript) and \
+                                tgt_container not in self._params:
+                            ids = st.binds.get(ln.id)
+                            if ids:
+                                created_binds.setdefault(
+                                    tgt_container, set()).update(ids)
+                        else:
+                            consumed.add(ln.id)
+        # ``lst.append(h)`` routes h into lst instead of escaping.
+        for call in calls:
+            f = call.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in _CONTAINER_METHODS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id not in self._params):
+                recv = f.value.id
+                for arg in call.args:
+                    nm = base_name(arg)
+                    if nm is None:
+                        continue
+                    ids = st.binds.get(nm)
+                    if ids:
+                        created_binds.setdefault(recv, set()).update(ids)
+                        consumed.discard(nm)
+
+        for name in consumed:
+            self._consume_ids(st.binds.get(name, ()), st)
+
+    @staticmethod
+    def _comprehension_iters(expr: ast.expr) -> Dict[str, str]:
+        """comprehension target name -> iterated container name, for
+        ``[wait(h) for h in handles]``-style discharges."""
+        out: Dict[str, str] = {}
+        for n in walk_no_scope(expr):
+            if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+                for gen in n.generators:
+                    if isinstance(gen.target, ast.Name) and isinstance(
+                            gen.iter, ast.Name):
+                        out[gen.target.id] = gen.iter.id
+        return out
+
+    def _fate(self, call: ast.Call, root: ast.expr, stmt: ast.AST,
+              parents: Dict[int, ast.AST]) -> Tuple[str, str]:
+        """("bind", name) | ("dropped", "") | ("escaped", "")."""
+        # Walk up: inside another call → escapes to it; inside await /
+        # yield → consumed; wrapped only in container displays → binds
+        # to the assignment target.
+        n: ast.AST = call
+        while True:
+            p = parents.get(id(n))
+            if p is None:
+                break
+            if isinstance(p, ast.Call):
+                # ``handles.append(start(...))``: the fresh obligation is
+                # stashed in the receiver container, not consumed.
+                f = p.func
+                if (n is not f and isinstance(f, ast.Attribute)
+                        and f.attr in _CONTAINER_METHODS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id not in self._params):
+                    return "bind", f.value.id
+                return "escaped", ""
+            if isinstance(p, (ast.Await, ast.Yield, ast.YieldFrom,
+                              ast.Return, ast.comprehension)):
+                return "escaped", ""
+            if isinstance(p, (ast.Tuple, ast.List, ast.Set, ast.Dict,
+                              ast.Starred, ast.ListComp, ast.SetComp,
+                              ast.GeneratorExp, ast.DictComp,
+                              ast.IfExp)):
+                n = p
+                continue
+            # Arbitrary expression context (h + 1, not isinstance-able):
+            # treat as escaped — we cannot track it.
+            if not isinstance(p, (ast.Expr, ast.Assign, ast.AnnAssign,
+                                  ast.AugAssign, ast.Return)):
+                return "escaped", ""
+            n = p
+            break
+
+        if isinstance(stmt, ast.Return):
+            return "escaped", ""
+        if isinstance(stmt, ast.Assign):
+            # Tuple-to-tuple: bind elementwise when alignment is obvious.
+            if (len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Tuple)
+                    and isinstance(stmt.value, ast.Tuple)
+                    and len(stmt.targets[0].elts)
+                    == len(stmt.value.elts)):
+                for t, v in zip(stmt.targets[0].elts, stmt.value.elts):
+                    if v is call and isinstance(t, ast.Name):
+                        return "bind", t.id
+            bound = []
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    bound.append(t.id)
+                elif isinstance(t, ast.Subscript):
+                    cont = base_name(t)
+                    if cont is not None and cont not in self._params:
+                        bound.append(cont)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    # start() under a tuple target without alignment:
+                    # every Name target may hold it.
+                    bound.extend(e.id for e in t.elts
+                                 if isinstance(e, ast.Name))
+            if bound:
+                return "bind", bound[0]
+            return "escaped", ""
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                          ast.Name):
+            return "bind", stmt.target.id
+        if isinstance(stmt, ast.Expr):
+            return "dropped", ""
+        # Condition / iterable / with-item position: not trackable.
+        return "escaped", ""

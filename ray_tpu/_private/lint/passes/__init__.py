@@ -5,8 +5,12 @@ from ray_tpu._private.lint.passes import (  # noqa: F401
     collectives,
     control_loop,
     deadlock,
+    donation,
     events,
     jit_hygiene,
     locks,
     metrics,
+    objectref,
+    sharding_axis,
+    splitphase,
 )

@@ -11,7 +11,7 @@ Only the coroutine's *direct* scope is scanned: nested ``def``/
 move the blocking call into a ``run_in_executor`` payload, and flagging
 the payload would punish the fix. Awaited calls are never flagged.
 
-Two rules:
+Three rules:
 
 - ``async-blocking-call``: a known-blocking API (``time.sleep``, sync
   ``subprocess``, sync socket ops, ``open``/file I/O, the sync
@@ -20,16 +20,25 @@ Two rules:
   ``x.join()`` with no arguments and no await — either a blocking
   ``threading`` primitive on the loop or a forgotten ``await`` on an
   asyncio one; both wedge.
+- ``async-blocking-transitive``: the same wedge one hop (or more)
+  removed — a coroutine calling a *sync helper* that blocks somewhere
+  down its call chain.  Summaries propagate "this sync function may
+  block" up the package call graph to a fixpoint, so wrapping
+  ``time.sleep`` in ``def _backoff():`` no longer hides it from
+  review.  Handing the helper to an executor (``run_in_executor(None,
+  helper)`` / ``asyncio.to_thread(helper)``) passes it un-called and
+  is, as before, the sanctioned fix.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from ray_tpu._private.lint._ast_util import (
     awaited_calls, call_name, consumed_calls, has_timeout, walk_scope,
 )
+from ray_tpu._private.lint.callgraph import get_call_graph
 from ray_tpu._private.lint.core import Finding, LintPass, ModuleInfo, register
 
 _BLOCKING_EXACT = {
@@ -74,14 +83,40 @@ _BLOCKING_SUFFIX = {
 _WAITISH = (".wait", ".join")
 
 
+def blocking_reason(call: ast.Call) -> Optional[str]:
+    """Why this call blocks the event loop, or None if it doesn't
+    (shared by the direct rule and the transitive summaries)."""
+    name = call_name(call)
+    if not name:
+        return None
+    why = _BLOCKING_EXACT.get(name)
+    if name == "os.waitpid":
+        flags = " ".join(ast.unparse(a) for a in call.args[1:])
+        why = (None if "WNOHANG" in flags
+               else "blocks the loop until the child exits — pass "
+                    "os.WNOHANG or poll in an executor")
+    if why is None and "." in name:
+        for suffix, reason in _BLOCKING_SUFFIX.items():
+            if name.endswith(suffix) and not name.endswith(".acall"):
+                why = reason
+                break
+    return why
+
+
 @register
 class AsyncBlockingPass(LintPass):
     name = "async-blocking"
-    rules = ("async-blocking-call", "async-unawaited-wait")
+    rules = ("async-blocking-call", "async-unawaited-wait",
+             "async-blocking-transitive")
     description = ("blocking calls and unawaited waits inside async "
-                   "event-loop coroutines")
+                   "event-loop coroutines, including blocking buried "
+                   "in sync helpers reached through the call graph")
+
+    def __init__(self):
+        self._mods: List[ModuleInfo] = []
 
     def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        self._mods.append(mod)
         out: List[Finding] = []
         awaited = awaited_calls(mod.tree)
         consumed = consumed_calls(mod.tree)
@@ -94,20 +129,7 @@ class AsyncBlockingPass(LintPass):
                 name = call_name(sub)
                 if not name:
                     continue
-                why = _BLOCKING_EXACT.get(name)
-                if name == "os.waitpid":
-                    flags = " ".join(
-                        ast.unparse(a) for a in sub.args[1:])
-                    why = (None if "WNOHANG" in flags
-                           else "blocks the loop until the child exits "
-                                "— pass os.WNOHANG or poll in an "
-                                "executor")
-                if why is None and "." in name:
-                    for suffix, reason in _BLOCKING_SUFFIX.items():
-                        if name.endswith(suffix) and \
-                                not name.endswith(".acall"):
-                            why = reason
-                            break
+                why = blocking_reason(sub)
                 if why is not None:
                     out.append(mod.finding(
                         "async-blocking-call", sub,
@@ -126,4 +148,58 @@ class AsyncBlockingPass(LintPass):
                         f"def {node.name}': a threading primitive here "
                         f"blocks the loop forever; an asyncio one "
                         f"needs 'await'"))
+        return out
+
+    # ------------------------------------------- transitive detection
+
+    def finalize(self) -> Iterable[Finding]:
+        graph = get_call_graph(self._mods)
+        # summary: id(sync func node) → (why, call chain to the block)
+        summaries: Dict[int, Tuple[str, List[str]]] = {}
+        for fi in graph.funcs:
+            if fi.is_async:
+                continue
+            for sub in walk_scope(fi.node, skip_nested=True):
+                if isinstance(sub, ast.Call):
+                    why = blocking_reason(sub)
+                    if why is not None:
+                        summaries[id(fi.node)] = (
+                            why, [fi.qualname, call_name(sub)])
+                        break
+        # Propagate "may block" up through sync callers to a fixpoint.
+        changed = True
+        while changed:
+            changed = False
+            for fi in graph.funcs:
+                if fi.is_async or id(fi.node) in summaries:
+                    continue
+                for call, callee in graph.direct_calls(fi):
+                    if callee is None or callee.is_async:
+                        continue
+                    hit = summaries.get(id(callee.node))
+                    if hit is not None:
+                        why, chain = hit
+                        summaries[id(fi.node)] = (
+                            why, [fi.qualname] + chain)
+                        changed = True
+                        break
+        out: List[Finding] = []
+        for fi in graph.funcs:
+            if not fi.is_async:
+                continue
+            awaited = awaited_calls(fi.mod.tree)
+            for call, callee in graph.direct_calls(fi):
+                if callee is None or callee.is_async or \
+                        id(call) in awaited:
+                    continue
+                hit = summaries.get(id(callee.node))
+                if hit is None:
+                    continue
+                why, chain = hit
+                out.append(fi.mod.finding(
+                    "async-blocking-transitive", call,
+                    f"{call_name(call)}() inside 'async def {fi.name}' "
+                    f"blocks the event loop through its call chain "
+                    f"{' -> '.join(chain)}: {why} — await an async "
+                    f"variant or move the helper into an executor"))
         return out

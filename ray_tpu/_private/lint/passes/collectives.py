@@ -39,20 +39,17 @@ them at review time):
   Quantizing integer gradients silently corrupts them; the runtime
   raises TypeError, the lint says so before the job is launched.
 
-Two from the split-phase (start/wait) overlap machinery:
+One from the quantized overlap machinery:
 
-- ``collective-splitphase-unbalanced``: a function scope that issues a
-  ``start_ring_*`` / ``start_quantized_ring_*`` call must also issue the
-  matching ``wait_*`` call (and vice versa).  An unwaited start leaves
-  hops 1..n-1 of the ring un-run — every peer blocks in its own wait and
-  the mesh hangs; a wait with no start is a stale-handle bug.  Nested
-  ``def``s are merged into their outermost enclosing function before
-  checking, because the idiomatic overlap schedule wraps the two phases
-  in separate closures (``_start_rs`` / ``_wait_rs``) of one builder.
 - ``collective-ef-nonfloat``: an error-feedback buffer assigned an
   explicitly integer dtype.  EF accumulates the quantizer's *residual*
   (sub-quantum values by construction); an int EF rounds every residual
   to zero and silently degenerates to plain quantization.
+
+Split-phase start/wait balance used to live here as a per-scope count
+(``collective-splitphase-unbalanced``); it is now the path-sensitive
+``splitphase-dataflow`` pass, which sees early returns, exception
+edges, and container stashes the count never could.
 """
 
 from __future__ import annotations
@@ -85,21 +82,6 @@ _QUANTIZED_CALLS = {"quantized_ring_allreduce",
 
 # Error-feedback buffer names (collective-ef-nonfloat targets).
 _EF_EXACT = {"ef", "error_feedback"}
-
-
-def _split_phase_key(name: str) -> Tuple[Optional[str], Optional[str]]:
-    """("start"|"wait", op-key) for a split-phase ring call, else
-    (None, None).  The op-key is the name with the phase prefix
-    stripped, so ``start_ring_allgather`` and ``wait_ring_allgather``
-    share the key ``ring_allgather``."""
-    tail = name.rsplit(".", 1)[-1]
-    for side in ("start", "wait"):
-        prefix = side + "_"
-        if tail.startswith(prefix):
-            op = tail[len(prefix):]
-            if op.startswith("ring_") or op.startswith("quantized_ring_"):
-                return side, op
-    return None, None
 
 
 def _is_ef_name(name: str) -> bool:
@@ -236,15 +218,13 @@ class CollectivesPass(LintPass):
     name = "collective-consistency"
     rules = ("collective-unknown-axis", "collective-divergent-branches",
              "collective-member-mismatch", "collective-dtype-drift",
-             "collective-quantized-nonfloat",
-             "collective-splitphase-unbalanced", "collective-ef-nonfloat")
+             "collective-quantized-nonfloat", "collective-ef-nonfloat")
     description = ("collective axis names must be declared; conditional "
                    "branches must issue identical collective sequences "
                    "with consistent wire dtypes; group membership "
                    "declarations must be coherent; quantized allreduce "
-                   "takes float payloads only; every start_* split-phase "
-                   "ring call needs its matching wait_*; error-feedback "
-                   "buffers must be float")
+                   "takes float payloads only; error-feedback buffers "
+                   "must be float")
 
     def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
         out: List[Finding] = []
@@ -269,7 +249,6 @@ class CollectivesPass(LintPass):
         for node in ast.walk(mod.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 out.extend(self._check_branches(mod, node))
-        out.extend(self._check_split_phase(mod))
         return out
 
     def _check_membership(self, mod: ModuleInfo,
@@ -351,60 +330,6 @@ class CollectivesPass(LintPass):
                 f"{dtype!r}: EF accumulates the quantizer's sub-quantum "
                 f"residual, which an integer buffer rounds to zero — "
                 f"keep EF in float32")
-
-    def _split_phase_scopes(self, tree: ast.Module):
-        """(scope-label, nodes) pairs: one per OUTERMOST function (its
-        whole subtree, nested defs merged in — the overlap schedule puts
-        start/wait in sibling closures of one builder) plus one for
-        module-level statements outside any function."""
-        funcs = []
-        module_level: List[ast.AST] = []
-        stack: List[Tuple[ast.AST, bool]] = [
-            (c, False) for c in ast.iter_child_nodes(tree)]
-        while stack:
-            node, in_func = stack.pop()
-            if not in_func:
-                module_level.append(node)
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if not in_func:
-                    funcs.append(node)
-                in_func = True
-            stack.extend((c, in_func) for c in ast.iter_child_nodes(node))
-        yield "<module>", module_level
-        for fn in funcs:
-            yield f"{fn.name}()", list(ast.walk(fn))
-
-    def _check_split_phase(self, mod: ModuleInfo) -> Iterable[Finding]:
-        for label, nodes in self._split_phase_scopes(mod.tree):
-            starts = {}
-            waits = {}
-            for sub in nodes:
-                if not isinstance(sub, ast.Call):
-                    continue
-                side, op = _split_phase_key(call_name(sub))
-                if side == "start":
-                    starts.setdefault(op, sub)
-                elif side == "wait":
-                    waits.setdefault(op, sub)
-            for op, call in starts.items():
-                if op not in waits:
-                    yield mod.finding(
-                        "collective-splitphase-unbalanced", call,
-                        f"start_{op} in {label} has no matching "
-                        f"wait_{op} in the same (outermost) function "
-                        f"scope: hops 1..n-1 never run, every peer "
-                        f"blocks in its own wait, and the ring hangs — "
-                        f"thread the handle to a wait_{op} on every "
-                        f"path")
-            for op, call in waits.items():
-                if op not in starts:
-                    yield mod.finding(
-                        "collective-splitphase-unbalanced", call,
-                        f"wait_{op} in {label} has no start_{op} in the "
-                        f"same (outermost) function scope: the handle "
-                        f"must come from a start issued by dead or "
-                        f"distant code — issue the start in the same "
-                        f"schedule that waits on it")
 
     def _branch_sig(self, stmts):
         """Per-branch collective signature: [(op, axes, payload_dtype)].

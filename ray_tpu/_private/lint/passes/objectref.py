@@ -1,0 +1,107 @@
+"""objectref-leak: dropped or leaked ObjectRefs pin plasma forever.
+
+An ``ObjectRef`` is a distributed refcount: as long as the driver-side
+handle is reachable the owner pins the value in its object store.  Two
+ways to get that wrong, one per rule:
+
+- ``objectref-dropped``: a ``.remote()`` / ``put()`` result discarded
+  where it stands (bare expression statement).  Fire-and-forget hides
+  the task's exceptions *and* — because the ref is dropped before the
+  task finishes — races lineage cleanup; the PR-3 lease-orphan fix
+  chased exactly this shape at runtime.
+- ``objectref-leak``: a ref bound to a local that is then overwritten,
+  deleted, or falls out of scope on some path with no ``get`` /
+  ``wait`` / ``await`` and no escape (returned, yielded, stored into a
+  structure, passed to another call).  The binding dies, the
+  distributed refcount does not drop until GC gets around to it, and
+  under churn the object store fills with orphans.
+
+Tracking is deliberately generous about discharge: *any* read of the
+ref counts (``loads_consume`` — passing it to ``get``, sticking it in
+a list, formatting it into a log line all keep it visible), so a
+finding means the ref provably went nowhere.  Exception edges are not
+followed (a raise unwinding past a ref is GC's job, not a bug), which
+keeps ``try: ref = f.remote(); ...`` patterns quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ray_tpu._private.lint._ast_util import call_name
+from ray_tpu._private.lint.core import (
+    Finding, LintPass, ModuleInfo, register,
+)
+from ray_tpu._private.lint.dataflow import (
+    ObligationEngine, Violation, cfgs_for_module,
+)
+
+_PUT_NAMES = {"ray.put", "ray_tpu.put"}
+
+
+def _ref_creation(call: ast.Call) -> Optional[str]:
+    name = call_name(call)
+    if name in ("ray.remote", "ray_tpu.remote"):
+        return None   # the decorator builds a RemoteFunction, not a ref
+    if name.endswith(".remote"):
+        return "ref"
+    if name in _PUT_NAMES or name.endswith(".put") and \
+            name.rsplit(".", 2)[-2] in ("ray", "ray_tpu"):
+        return "ref"
+    return None
+
+
+class _Engine(ObligationEngine):
+    loads_consume = True
+    follow_exc = False
+    report_double = False
+    report_mismatch = False
+
+    def creation_key(self, call: ast.Call) -> Optional[str]:
+        return _ref_creation(call)
+
+    def discharge_key(self, call: ast.Call) -> Optional[str]:
+        return None
+
+
+@register
+class ObjectRefLeakPass(LintPass):
+    name = "objectref-leak"
+    rules = ("objectref-dropped", "objectref-leak")
+    description = ("ObjectRefs must be kept and resolved: a dropped or "
+                   "overwritten .remote()/put() result pins the object "
+                   "store and hides task failures")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if ".remote" not in mod.src and ".put" not in mod.src:
+            return ()
+        engine = _Engine()
+        out: List[Finding] = []
+        for fn, cfg in cfgs_for_module(mod).items():
+            for v in engine.analyze(cfg):
+                out.append(self._finding(mod, fn, v))
+        return out
+
+    def _finding(self, mod: ModuleInfo, fn, v: Violation) -> Finding:
+        call = call_name(v.origin) if isinstance(v.origin, ast.Call) \
+            else "remote call"
+        if v.kind == "dropped":
+            return mod.finding(
+                "objectref-dropped", v.node,
+                f"result of {call}(...) in {fn.name}() is discarded: "
+                f"fire-and-forget hides the task's exceptions and "
+                f"races lineage cleanup — keep the ref and get() it, "
+                f"or suppress with a justification if detaching is "
+                f"intentional")
+        how = {
+            "overwritten": "is overwritten",
+            "deleted": "is deleted",
+            "exit": "goes out of scope on some path",
+        }.get(v.kind, "is lost")
+        return mod.finding(
+            "objectref-leak", v.node,
+            f"ObjectRef from {call}(...) in {fn.name}() {how} without "
+            f"get/wait/await or escaping to a caller: the distributed "
+            f"refcount outlives the binding and pins plasma until GC — "
+            f"resolve or return every ref on every path")

@@ -1,0 +1,245 @@
+"""sharding-axis-consistency: axis names used under a shard_map/pmap
+must exist on the mesh that wraps them.
+
+``collective-unknown-axis`` checks a *module-wide* axis vocabulary —
+good enough to catch outright typos, blind to context: a module that
+declares meshes ``("data", "model")`` and ``("stage",)`` will happily
+accept a ``psum(x, "model")`` inside a function shard_mapped over the
+``("stage",)`` mesh.  That program is well-formed to every unit test
+(CPU backends trace with a 1-device mesh that never resolves axes) and
+dies at trace time on the pod, inside a 30-minute compile.
+
+This pass checks the *binding* instead: for every ``shard_map`` /
+``pmap`` wrap whose mesh resolves to a literal axis declaration in the
+same module, the wrapped function's collectives and the wrap's own
+PartitionSpecs must only name axes that mesh has.
+
+- ``sharding-axis-undeclared``: a collective inside the wrapped
+  function (resolved by name, or an inline lambda) names an axis the
+  enclosing mesh does not declare.
+- ``sharding-spec-axis-undeclared``: a ``P(...)``/``PartitionSpec``
+  entry in the wrap's ``in_specs``/``out_specs`` — or in a
+  ``NamedSharding(mesh, ...)`` over a resolvable mesh — names an axis
+  the mesh does not declare (the spec silently falls back to
+  replication or fails at trace time, depending on version: both are
+  wrong).
+
+Unresolvable meshes (parameters, attributes, anything not assigned a
+literal ``Mesh``/``make_mesh`` in this module) skip the check entirely:
+precision over recall.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set
+
+from ray_tpu._private.lint._ast_util import call_name, kwarg
+from ray_tpu._private.lint.core import (
+    Finding, LintPass, ModuleInfo, register,
+)
+from ray_tpu._private.lint.dataflow import walk_no_scope
+from ray_tpu._private.lint.passes.collectives import (
+    _axis_strings, _collective_axis,
+)
+
+_MESH_CTORS = {"Mesh", "make_mesh", "device_mesh"}
+_SPEC_CTORS = {"P", "PartitionSpec"}
+
+
+def _mesh_axes_from_ctor(call: ast.Call) -> Optional[FrozenSet[str]]:
+    """Axis names a mesh constructor declares, when literal."""
+    tail = call_name(call).rsplit(".", 1)[-1]
+    if tail not in _MESH_CTORS:
+        return None
+    axes: Set[str] = set()
+    cands: List[ast.expr] = []
+    if len(call.args) > 1:
+        cands.append(call.args[1])
+    for kw in call.keywords:
+        if kw.arg in ("axis_names", "axes", "mesh_shape"):
+            cands.append(kw.value)
+    for c in cands:
+        if isinstance(c, ast.Dict):
+            for k in c.keys:
+                if isinstance(k, ast.Constant) and isinstance(
+                        k.value, str):
+                    axes.add(k.value)
+        else:
+            axes.update(_axis_strings(c))
+    return frozenset(axes) if axes else None
+
+
+def _spec_axes(expr: ast.expr) -> Iterable[ast.Constant]:
+    """String constants inside P(...)/PartitionSpec(...) calls under
+    ``expr`` (nested tuples included: P(("dp", "fsdp"), None))."""
+    for n in walk_no_scope(expr):
+        if isinstance(n, ast.Call) and \
+                call_name(n).rsplit(".", 1)[-1] in _SPEC_CTORS:
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str):
+                    yield sub
+
+
+@register
+class ShardingAxisPass(LintPass):
+    name = "sharding-axis-consistency"
+    rules = ("sharding-axis-undeclared", "sharding-spec-axis-undeclared")
+    description = ("collectives and PartitionSpecs under a "
+                   "shard_map/pmap may only name axes the wrapping "
+                   "mesh declares — a context mismatch passes every "
+                   "CPU test and fails at trace time on the pod")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if "shard_map" not in mod.src and "pmap" not in mod.src and \
+                "NamedSharding" not in mod.src:
+            return ()
+        meshes = self._mesh_bindings(mod)
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = call_name(node).rsplit(".", 1)[-1]
+            if tail == "shard_map":
+                out.extend(self._check_shard_map(mod, node, meshes))
+            elif tail == "pmap":
+                out.extend(self._check_pmap(mod, node))
+            elif tail == "NamedSharding":
+                out.extend(self._check_named_sharding(mod, node, meshes))
+        return out
+
+    # -------------------------------------------------- mesh resolution
+
+    @staticmethod
+    def _mesh_bindings(mod: ModuleInfo) -> Dict[str, FrozenSet[str]]:
+        """name → declared axes, for every name assigned a literal mesh
+        constructor anywhere in the module.  Reassignments union (a name
+        holding either mesh may use either vocabulary — no FPs)."""
+        out: Dict[str, FrozenSet[str]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                axes = _mesh_axes_from_ctor(node.value)
+                if axes is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = out.get(t.id, frozenset()) | axes
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call) and \
+                            isinstance(item.optional_vars, ast.Name):
+                        axes = _mesh_axes_from_ctor(item.context_expr)
+                        if axes is not None:
+                            name = item.optional_vars.id
+                            out[name] = out.get(name, frozenset()) | axes
+        return out
+
+    def _resolve_mesh(self, expr: Optional[ast.expr],
+                      meshes: Dict[str, FrozenSet[str]]
+                      ) -> Optional[FrozenSet[str]]:
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Call):
+            return _mesh_axes_from_ctor(expr)
+        if isinstance(expr, ast.Name):
+            return meshes.get(expr.id)
+        return None
+
+    # ------------------------------------------------------------ checks
+
+    def _check_shard_map(self, mod: ModuleInfo, call: ast.Call,
+                         meshes: Dict[str, FrozenSet[str]]
+                         ) -> Iterable[Finding]:
+        mesh_expr = kwarg(call, "mesh")
+        if mesh_expr is None and len(call.args) > 1:
+            mesh_expr = call.args[1]
+        axes = self._resolve_mesh(mesh_expr, meshes)
+        if axes is None:
+            return
+        # Specs named on the wrap itself.
+        spec_exprs = [kw.value for kw in call.keywords
+                      if kw.arg in ("in_specs", "out_specs")]
+        spec_exprs += call.args[2:4]
+        for se in spec_exprs:
+            for const in _spec_axes(se):
+                if const.value not in axes:
+                    yield mod.finding(
+                        "sharding-spec-axis-undeclared", const,
+                        f"P({const.value!r}) in a shard_map spec, but "
+                        f"the mesh only declares {sorted(axes)}: the "
+                        f"spec axis resolves to nothing and the "
+                        f"dimension is silently replicated (or trace "
+                        f"fails, version-dependent) — use a declared "
+                        f"axis")
+        fn_node = self._wrapped_fn(call, mod)
+        if fn_node is None:
+            return
+        yield from self._check_body_axes(mod, fn_node, axes, "shard_map")
+
+    def _check_pmap(self, mod: ModuleInfo,
+                    call: ast.Call) -> Iterable[Finding]:
+        axis_expr = kwarg(call, "axis_name")
+        names = _axis_strings(axis_expr) if axis_expr is not None else []
+        if not names:
+            return
+        fn_node = self._wrapped_fn(call, mod)
+        if fn_node is None:
+            return
+        yield from self._check_body_axes(mod, fn_node, frozenset(names),
+                                         "pmap")
+
+    def _check_named_sharding(self, mod: ModuleInfo, call: ast.Call,
+                              meshes: Dict[str, FrozenSet[str]]
+                              ) -> Iterable[Finding]:
+        mesh_expr = call.args[0] if call.args else kwarg(call, "mesh")
+        axes = self._resolve_mesh(mesh_expr, meshes)
+        if axes is None:
+            return
+        for arg in call.args[1:] + [kw.value for kw in call.keywords
+                                    if kw.arg == "spec"]:
+            for const in _spec_axes(arg):
+                if const.value not in axes:
+                    yield mod.finding(
+                        "sharding-spec-axis-undeclared", const,
+                        f"NamedSharding over a mesh declaring "
+                        f"{sorted(axes)} uses P({const.value!r}): the "
+                        f"axis does not exist on that mesh — the array "
+                        f"lands replicated where you meant sharded")
+
+    def _check_body_axes(self, mod: ModuleInfo, fn_node: ast.AST,
+                         axes: FrozenSet[str],
+                         wrap: str) -> Iterable[Finding]:
+        for sub in ast.walk(fn_node):
+            if not isinstance(sub, ast.Call):
+                continue
+            op, used = _collective_axis(sub)
+            if op is None:
+                continue
+            for axis in used:
+                if axis not in axes:
+                    yield mod.finding(
+                        "sharding-axis-undeclared", sub,
+                        f"{op}(..., {axis!r}) inside a function "
+                        f"wrapped by {wrap} over mesh axes "
+                        f"{sorted(axes)}: the axis is not bound in "
+                        f"this context, so tracing fails on the pod "
+                        f"(CPU tests never resolve it) — psum over an "
+                        f"axis the mesh declares")
+
+    @staticmethod
+    def _wrapped_fn(call: ast.Call, mod: ModuleInfo) -> Optional[ast.AST]:
+        """The function a shard_map/pmap wraps, when resolvable: an
+        inline lambda, or a unique same-module def by name."""
+        if not call.args:
+            return None
+        target = call.args[0]
+        if isinstance(target, ast.Lambda):
+            return target
+        if not isinstance(target, ast.Name):
+            return None
+        cands = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and n.name == target.id]
+        return cands[0] if len(cands) == 1 else None

@@ -1,0 +1,221 @@
+"""splitphase-dataflow: every start_* handle reaches its wait_* on
+every path.
+
+PR 12's ``collective-splitphase-unbalanced`` counted start/wait calls
+per outermost function scope — good enough to catch a start with no
+wait anywhere, structurally blind to *paths*: a handle dropped on an
+early return, leaked through an ``except`` that swallows, stashed in a
+container nobody drains, or waited twice.  An unwaited start is not a
+leak but a hang: hop 0's DMA is in flight and hops 1..n-1 live in the
+wait, so every peer blocks forever — the worst possible failure mode
+at pod scale.  This pass replaces the heuristic with obligation
+dataflow over the per-function CFG:
+
+- ``splitphase-unwaited``: a path exists from a ``start_ring_*`` /
+  ``start_quantized_ring_*`` call to function exit (including early
+  returns and exception edges), an overwrite, or a ``del`` on which no
+  matching ``wait_*`` consumed the handle.  Handles stashed in local
+  containers stay tracked (``handles[i] = start(...)``,
+  ``hs.append(start(...))``) and are discharged by waits over the
+  container (``wait(handles[c])``, ``[wait(h) for h in hs]``).
+- ``splitphase-double-wait``: a handle waited again after it was
+  already waited on every path reaching the second wait — the second
+  wait replays hops against a retired buffer.
+- ``splitphase-mismatched-wait``: a ``wait_Y`` applied to a handle a
+  ``start_X`` produced (allgather handle into a reduce-scatter wait).
+
+One level of interprocedural summary keeps the idiomatic overlap
+schedule clean: a local function that *returns* a start's handle is
+itself a producer (``_start_rs``), one whose parameter flows into a
+wait is a consumer (``_wait_rs``) — the zero.py chunked pipeline
+typechecks without special cases.  Escapes out of view (returned to
+the caller, passed to an unresolvable call, stored on an object)
+discharge the obligation: the pass only flags what it can prove is
+dropped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu._private.lint._ast_util import call_name
+from ray_tpu._private.lint.callgraph import get_call_graph
+from ray_tpu._private.lint.core import (
+    Finding, LintPass, ModuleInfo, register,
+)
+from ray_tpu._private.lint.dataflow import (
+    ObligationEngine, Violation, cfgs_for_module, walk_no_scope,
+)
+
+
+def split_phase_key(name: str) -> Tuple[Optional[str], Optional[str]]:
+    """("start"|"wait", op-key) for a split-phase ring call, else
+    (None, None): ``start_ring_allgather`` and ``wait_ring_allgather``
+    share the key ``ring_allgather``."""
+    tail = name.rsplit(".", 1)[-1]
+    for side in ("start", "wait"):
+        prefix = side + "_"
+        if tail.startswith(prefix):
+            op = tail[len(prefix):]
+            if op.startswith("ring_") or op.startswith("quantized_ring_"):
+                return side, op
+    return None, None
+
+
+def _join_keys(keys: Set[str]) -> Optional[str]:
+    return "|".join(sorted(keys)) if keys else None
+
+
+class _Engine(ObligationEngine):
+    report_double = True
+    report_mismatch = True
+    follow_exc = True
+
+    def __init__(self, producers: Dict[str, Set[str]],
+                 consumers: Dict[str, Set[str]]):
+        # local-name → op keys, from the one-level callee summaries
+        self._producers = producers
+        self._consumers = consumers
+
+    def creation_key(self, call: ast.Call) -> Optional[str]:
+        name = call_name(call)
+        side, op = split_phase_key(name)
+        if side == "start":
+            return op
+        keys = self._producers.get(name.rsplit(".", 1)[-1])
+        return _join_keys(keys) if keys else None
+
+    def discharge_key(self, call: ast.Call) -> Optional[str]:
+        name = call_name(call)
+        side, op = split_phase_key(name)
+        if side == "wait":
+            return op
+        keys = self._consumers.get(name.rsplit(".", 1)[-1])
+        return _join_keys(keys) if keys else None
+
+    def keys_match(self, creation: str, discharge: str) -> bool:
+        return bool(set(creation.split("|")) & set(discharge.split("|")))
+
+
+@register
+class SplitPhasePass(LintPass):
+    name = "splitphase-dataflow"
+    rules = ("splitphase-unwaited", "splitphase-double-wait",
+             "splitphase-mismatched-wait")
+    description = ("dataflow tracking of split-phase collective handles: "
+                   "every start_* must reach exactly one matching wait_* "
+                   "on every path (early returns, exception edges, and "
+                   "container stashes included)")
+
+    def __init__(self):
+        self._mods: List[ModuleInfo] = []
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        graph = get_call_graph(self._mods)
+        out: List[Finding] = []
+        for mod in self._mods:
+            out.extend(self._check(mod, graph))
+        return out
+
+    # ------------------------------------------------------- summaries
+
+    def _summaries(self, mod: ModuleInfo
+                   ) -> Tuple[Dict[str, Set[str]], Dict[str, Set[str]]]:
+        """Local one-level summaries: function name → op keys it
+        produces (returns a fresh start handle) / consumes (a param
+        flows into a wait)."""
+        producers: Dict[str, Set[str]] = {}
+        consumers: Dict[str, Set[str]] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            params = {a.arg for a in (node.args.posonlyargs
+                                      + node.args.args
+                                      + node.args.kwonlyargs)}
+            # Names assigned from a start call inside this function.
+            started_names: Dict[str, str] = {}
+            for sub in walk_no_scope(node):
+                if isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Call):
+                    side, op = split_phase_key(call_name(sub.value))
+                    if side == "start":
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name):
+                                started_names[t.id] = op
+            for sub in walk_no_scope(node):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    for c in walk_no_scope(sub.value):
+                        if isinstance(c, ast.Call):
+                            side, op = split_phase_key(call_name(c))
+                            if side == "start":
+                                producers.setdefault(node.name,
+                                                     set()).add(op)
+                    if isinstance(sub.value, ast.Name) and \
+                            sub.value.id in started_names:
+                        producers.setdefault(node.name, set()).add(
+                            started_names[sub.value.id])
+                elif isinstance(sub, ast.Call):
+                    side, op = split_phase_key(call_name(sub))
+                    if side == "wait":
+                        for arg in sub.args:
+                            if isinstance(arg, ast.Name) and \
+                                    arg.id in params:
+                                consumers.setdefault(node.name,
+                                                     set()).add(op)
+        return producers, consumers
+
+    # ----------------------------------------------------------- check
+
+    def _check(self, mod: ModuleInfo, graph) -> Iterable[Finding]:
+        producers, consumers = self._summaries(mod)
+        if not producers and not self._has_split_phase(mod):
+            return
+        engine = _Engine(producers, consumers)
+        for fn, cfg in cfgs_for_module(mod).items():
+            for v in engine.analyze(cfg):
+                yield self._finding(mod, fn, v)
+
+    @staticmethod
+    def _has_split_phase(mod: ModuleInfo) -> bool:
+        return "start_ring_" in mod.src or "start_quantized_ring_" \
+            in mod.src or "wait_ring_" in mod.src \
+            or "wait_quantized_ring_" in mod.src
+
+    def _finding(self, mod: ModuleInfo, fn, v: Violation) -> Finding:
+        op = call_name(v.origin).rsplit(".", 1)[-1] \
+            if isinstance(v.origin, ast.Call) else "start"
+        where = f"in {fn.name}()"
+        if v.kind == "double":
+            return mod.finding(
+                "splitphase-double-wait", v.node,
+                f"{op} handle {where} is waited again on a path where "
+                f"it was already waited: the second wait replays ring "
+                f"hops against a retired buffer — thread each handle "
+                f"to exactly one wait")
+        if v.kind == "mismatch":
+            return mod.finding(
+                "splitphase-mismatched-wait", v.node,
+                f"handle from {op} {where} flows into a wait for a "
+                f"different op ({v.detail}): the wait replays the "
+                f"wrong hop schedule and the ring deadlocks or "
+                f"corrupts — match start_X with wait_X")
+        how = {
+            "dropped": "is discarded where it stands",
+            "overwritten": "is overwritten while still unwaited",
+            "deleted": "is deleted while still unwaited",
+            "exit": "misses its wait on some path to function exit "
+                    "(early return, exception edge, or a container "
+                    "nothing drains)",
+        }[v.kind]
+        return mod.finding(
+            "splitphase-unwaited", v.node,
+            f"{op} handle {where} {how}: hops 1..n-1 of the ring live "
+            f"in the wait, so every peer blocks in its own wait and "
+            f"the mesh hangs — thread the handle to a matching wait_* "
+            f"on every path")

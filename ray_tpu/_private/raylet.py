@@ -787,7 +787,10 @@ class Raylet:
                 if handle.lease is not None:
                     self._release_lease(handle)
                 self._release_orphaned_leases(worker_id)
-                info = self._observe_worker_death(worker_id, handle, code)
+                # Classification mutates loop-confined death bookkeeping
+                # and must land before the actor-death report below; the
+                # blocking leaf is a bounded tail of a local log file.
+                info = self._observe_worker_death(worker_id, handle, code)  # graftlint: disable=async-blocking-transitive
                 exit_type = info["exit_type"]
                 if handle.is_actor and handle.actor_id is not None:
                     cause = (f"worker process exited with code {code} "
@@ -852,7 +855,8 @@ class Raylet:
         test_path = GlobalConfig.memory_monitor_test_usage_path
         while not self._dead:
             await asyncio.sleep(period)
-            usage = memory_monitor.usage_fraction(test_path)
+            usage = await asyncio.get_running_loop().run_in_executor(
+                None, memory_monitor.usage_fraction, test_path)
             if usage is None:
                 continue
             if usage <= threshold:
@@ -861,8 +865,12 @@ class Raylet:
                 # NOW, while there is still headroom, instead of waiting
                 # to shoot it with OOM_KILLED semantics.
                 preempt_thr = GlobalConfig.memory_preempt_threshold
+                # _preempt_for_memory calls record_decision(emit=False):
+                # the sync-RPC branch the linter sees through the chain
+                # is dead here — the decision record is forwarded via
+                # acall below it.
                 if preempt_thr and preempt_thr < usage and \
-                        self._preempt_for_memory(usage, preempt_thr):
+                        self._preempt_for_memory(usage, preempt_thr):  # graftlint: disable=async-blocking-transitive
                     await asyncio.sleep(max(period, 1.0))
                 continue
             victim = await self._pick_oom_victim()
@@ -1456,6 +1464,16 @@ class Raylet:
             return False
         self._release_lease(handle)
         code = handle.proc.poll()
+        if code is None and (worker_id in self._oom_killed
+                             or worker_id in self._preempted):
+            # The memory monitor shot this worker and its SIGKILL is
+            # still in flight: the owner's ConnectionLost discard beat
+            # waitpid. Taking the kill branch below would mark the death
+            # INTENDED_EXIT and pop the handle before anyone classified
+            # it — the OOM would vanish from the event log. Leave the
+            # corpse-to-be in self.workers; the reaper's poll classifies
+            # and reports it within a tick.
+            return True
         if kill or code is not None:
             self.workers.pop(worker_id, None)
             self._release_worker_env(handle)
@@ -1466,8 +1484,9 @@ class Raylet:
                 # The worker is already a corpse: the owner noticed the
                 # crash and returned the lease before the reaper's poll.
                 # Classify + report here or the death never hits the
-                # event log.
-                self._observe_worker_death(worker_id, handle, code)
+                # event log. Loop-confined bookkeeping; the blocking leaf
+                # is a bounded tail of a local log file.
+                self._observe_worker_death(worker_id, handle, code)  # graftlint: disable=async-blocking-transitive
         else:
             self._offer_worker(handle)
         return True
@@ -1754,37 +1773,44 @@ class Raylet:
         tail = max(int(tail), 0)
         log_dir = os.path.join(self.session_dir, "logs") \
             if self.session_dir else ""
-        lines: List[str] = []
-        if worker_id is not None:
-            wid_hex = worker_id.hex() if isinstance(worker_id, bytes) \
-                else str(worker_id)
-            prefix = wid_hex[:12]
-            for suffix in (".out", ".err"):
-                path = os.path.join(log_dir, f"worker-{prefix}{suffix}")
-                got = log_monitor.read_task_lines(
-                    path, task_id_hex=None, max_lines=tail)
-                if got and suffix == ".err":
-                    lines.extend(f"[stderr] {ln}" for ln in got)
-                else:
-                    lines.extend(got)
-        elif task_id is not None:
-            tid_hex = task_id.hex() if isinstance(task_id, bytes) \
-                else str(task_id)
-            try:
-                names = sorted(os.listdir(log_dir))
-            except OSError:
-                names = []
-            for name in names:
-                if not (name.startswith("worker-")
-                        and name.endswith((".out", ".err"))):
-                    continue
-                got = log_monitor.read_task_lines(
-                    os.path.join(log_dir, name), task_id_hex=tid_hex,
-                    max_lines=tail)
-                if got and name.endswith(".err"):
-                    lines.extend(f"[stderr] {ln}" for ln in got)
-                else:
-                    lines.extend(got)
+
+        def _scan() -> List[str]:
+            # Pure file reads over an arbitrary number of worker logs:
+            # runs in the executor so a fat log can't stall the raylet.
+            lines: List[str] = []
+            if worker_id is not None:
+                wid_hex = worker_id.hex() if isinstance(worker_id, bytes) \
+                    else str(worker_id)
+                prefix = wid_hex[:12]
+                for suffix in (".out", ".err"):
+                    path = os.path.join(log_dir, f"worker-{prefix}{suffix}")
+                    got = log_monitor.read_task_lines(
+                        path, task_id_hex=None, max_lines=tail)
+                    if got and suffix == ".err":
+                        lines.extend(f"[stderr] {ln}" for ln in got)
+                    else:
+                        lines.extend(got)
+            elif task_id is not None:
+                tid_hex = task_id.hex() if isinstance(task_id, bytes) \
+                    else str(task_id)
+                try:
+                    names = sorted(os.listdir(log_dir))
+                except OSError:
+                    names = []
+                for name in names:
+                    if not (name.startswith("worker-")
+                            and name.endswith((".out", ".err"))):
+                        continue
+                    got = log_monitor.read_task_lines(
+                        os.path.join(log_dir, name), task_id_hex=tid_hex,
+                        max_lines=tail)
+                    if got and name.endswith(".err"):
+                        lines.extend(f"[stderr] {ln}" for ln in got)
+                    else:
+                        lines.extend(got)
+            return lines
+
+        lines = await asyncio.get_running_loop().run_in_executor(None, _scan)
         if tail:
             lines = lines[-tail:]
         return {"lines": lines}

@@ -1396,7 +1396,12 @@ class Worker:
                 self._fail_task(spec, reply["app_error"])
                 self._release_deps(spec)
                 return
-            self._accept_results(spec, reply)
+            # Result installation is transactional with dep release and
+            # the FINISHED event; results above rpc_put_max_bytes take
+            # the sync plasma path (everything smaller is pipelined via
+            # _async_plasma_put), a local-socket RPC to the co-located
+            # raylet.
+            self._accept_results(spec, reply)  # graftlint: disable=async-blocking-transitive
             self._release_deps(spec)
             self._record_task_event(spec, "FINISHED")
             return
@@ -2315,7 +2320,10 @@ class Worker:
             if reply.get("app_error") is not None:
                 self._fail_task(spec, reply["app_error"])
             else:
-                self._accept_results(spec, reply)
+                # Same contract as the normal-task path: install results
+                # before releasing deps; only >rpc_put_max_bytes results
+                # hit the sync plasma leaf.
+                self._accept_results(spec, reply)  # graftlint: disable=async-blocking-transitive
             self._release_deps(spec)
             return
 

@@ -127,14 +127,19 @@ class ES(Algorithm):
         # perturbed pytrees. Antithetic twins share the noise seed.
         version = self.weight_store.publish(self._unravel(self._flat))
         refs: List[Any] = []
+        set_refs: List[Any] = []
         n_runners = len(self.env_runners)
         for i in range(P):
             for s, signed in ((0, 1.0), (1, -1.0)):
                 runner = self.env_runners[(2 * i + s) % n_runners]
-                runner.set_perturbed_weights.remote(
-                    version, int(seeds[i]), float(sigma), signed)
+                set_refs.append(runner.set_perturbed_weights.remote(
+                    version, int(seeds[i]), float(sigma), signed))
                 refs.append(runner.sample_episodes.remote(
                     cfg.episodes_per_perturbation, explore=False))
+        # Per-actor ordering already serializes install-then-sample, but
+        # a dropped install ref would swallow its exception and the
+        # rollout would silently sample stale weights — resolve them.
+        ray_tpu.get(set_refs, timeout=600)
         results = ray_tpu.get(refs, timeout=600)
         # Guard: a rollout can return ZERO completed episodes (hard
         # max_env_steps truncation) — np.mean([]) is NaN, and one NaN

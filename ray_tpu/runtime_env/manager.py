@@ -68,7 +68,10 @@ class RuntimeEnvManager:
         call concurrently; each URI is created once (per-URI lock)."""
         from ray_tpu.runtime_env import validate_runtime_env
 
-        runtime_env = validate_runtime_env(runtime_env)
+        # Validation reads requirements files off disk: keep it (and the
+        # packaging below) off the event loop.
+        runtime_env = await asyncio.get_running_loop().run_in_executor(
+            None, validate_runtime_env, runtime_env)
         ctx = RuntimeEnvContext()
         timeout = (runtime_env.get("config") or {}).get(
             "setup_timeout_seconds", 600)
@@ -187,12 +190,13 @@ class RuntimeEnvManager:
 
     async def _ensure_wheel_unpacked(self, path: str) -> str:
         """Local .whl in py_modules: unpack (wheels are importable trees)."""
-        uri, payload = packaging.package_wheel(path)
+        loop = asyncio.get_running_loop()
+        uri, payload = await loop.run_in_executor(
+            None, packaging.package_wheel, path)
         key = hashlib.sha256(uri.encode()).hexdigest()[:24]
         dest = os.path.join(self._base, "pkg", key)
         async with self._lock(uri):
             if not os.path.exists(os.path.join(dest, ".rtpu_pkg_ready")):
-                loop = asyncio.get_running_loop()
                 await loop.run_in_executor(
                     None, self._unpack_wheel_bytes, payload, dest)
                 self.creations += 1

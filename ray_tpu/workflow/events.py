@@ -124,7 +124,8 @@ class HTTPEventProvider:
                 payload = await req.json()
             except Exception:
                 payload = (await req.read()).decode("utf-8", "replace")
-            path = deliver_event(self._storage, wf, key, payload)
+            path = await asyncio.get_running_loop().run_in_executor(
+                None, deliver_event, self._storage, wf, key, payload)
             return web.json_response({"delivered": True, "path": path})
 
         async def get_event(req):

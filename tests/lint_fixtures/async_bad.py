@@ -19,3 +19,19 @@ class Handler:
 
     async def wait_forever(self, ev):
         ev.wait()                             # async-unawaited-wait
+
+
+def _backoff(attempt):
+    # Sync helper: blocking buried one hop from the coroutine.
+    time.sleep(2 ** attempt)
+
+
+def _retry_shell(cmd):
+    # Two hops: _retry_shell -> _backoff -> time.sleep.
+    _backoff(1)
+    return cmd
+
+
+async def poll(client):
+    _backoff(3)                               # async-blocking-transitive
+    _retry_shell("ls")                        # async-blocking-transitive

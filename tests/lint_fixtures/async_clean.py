@@ -27,3 +27,25 @@ class Handler:
         # ev.wait() here builds the awaitable consumed by wait_for — it
         # does not block the loop.
         await asyncio.wait_for(ev.wait(), timeout=5)
+
+
+def _backoff(attempt):
+    import time
+    time.sleep(2 ** attempt)
+
+
+async def poll(client):
+    # The blocking helper is handed to the executor UN-CALLED: the
+    # sanctioned fix for a transitively-blocking chain.
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, _backoff, 3)
+    await asyncio.to_thread(_backoff, 1)
+
+
+def _pure_math(x):
+    return x * x
+
+
+async def compute(x):
+    # Sync helper that never blocks: calling it inline is fine.
+    return _pure_math(x)

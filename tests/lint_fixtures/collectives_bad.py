@@ -50,21 +50,6 @@ def dtype_drift(x, half):
     return y
 
 
-def leaked_start(x):
-    # collective-splitphase-unbalanced: the start's hop-0 DMA is issued
-    # but hops 1..n-1 (which live in the wait) never run — peers hang.
-    from ray_tpu.util.collective.pallas import start_ring_allgather
-    h = start_ring_allgather(x, "data", n=4)
-    del h
-    return x
-
-
-def orphan_wait(h):
-    # collective-splitphase-unbalanced: wait with no start in scope.
-    from ray_tpu.util.collective.pallas import wait_ring_reduce_scatter
-    return wait_ring_reduce_scatter(h)
-
-
 def int_error_feedback(grads):
     # collective-ef-nonfloat: an integer EF buffer rounds the quantizer
     # residual to zero — plain int8 drift with extra state.

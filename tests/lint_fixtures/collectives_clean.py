@@ -50,32 +50,6 @@ def same_dtype_both_arms(x, flag):
     return y
 
 
-def balanced_split_phase(x):
-    # start + compute + wait in one scope: the sanctioned overlap shape.
-    from ray_tpu.util.collective.pallas import (
-        start_ring_allgather, wait_ring_allgather,
-    )
-    h = start_ring_allgather(x, "data", n=4)
-    y = x * 2.0   # overlapped compute
-    return wait_ring_allgather(h) + y
-
-
-def chunked_schedule(grads):
-    # Start/wait split across sibling closures of one builder — nested
-    # defs merge into the outermost scope, so this is balanced.
-    from ray_tpu.util.collective.pallas import (
-        start_ring_reduce_scatter, wait_ring_reduce_scatter,
-    )
-
-    def _start(v):
-        return start_ring_reduce_scatter(v, "data", n=4)
-
-    def _wait(h):
-        return wait_ring_reduce_scatter(h)
-
-    return _wait(_start(grads))
-
-
 def float_error_feedback(n, shard):
     # EF buffers carry sub-quantum residuals: float32 is the contract.
     ef = jnp.zeros((n, shard * n), jnp.float32)

@@ -1,0 +1,32 @@
+"""objectref-leak violations: refs that pin plasma and hide failures."""
+
+import ray_tpu
+
+
+def fire_and_forget(actor):
+    # objectref-dropped: the task's exceptions vanish and the dropped
+    # ref races lineage cleanup.
+    actor.tick.remote()
+    return True
+
+
+def overwritten_ref(actor, x, y):
+    # objectref-leak: the first ref is overwritten before anything
+    # resolved it — its object stays pinned until GC.
+    ref = actor.compute.remote(x)
+    ref = actor.compute.remote(y)
+    return ray_tpu.get(ref)
+
+
+def never_resolved(actor, x):
+    # objectref-leak: the binding dies at function exit with the ref
+    # never read, returned, or stored.
+    ref = actor.compute.remote(x)
+    return x
+
+
+def dropped_put(value):
+    # objectref-dropped: the put's ref is the ONLY handle to the
+    # stored object; dropping it strands the value in plasma.
+    ray_tpu.put(value)
+    return value

@@ -1,0 +1,48 @@
+"""objectref-leak clean twin: every ref is resolved, returned, or
+stored."""
+
+import ray_tpu
+
+
+def resolved(actor, x):
+    ref = actor.compute.remote(x)
+    return ray_tpu.get(ref)
+
+
+def returned_to_caller(actor, x):
+    # The caller owns the obligation now.
+    return actor.compute.remote(x)
+
+
+def fanned_out(actor, xs):
+    refs = [actor.compute.remote(x) for x in xs]
+    return ray_tpu.get(refs)
+
+
+def stored_in_structure(actor, pending, key, x):
+    # Escaping into a caller-visible structure keeps the ref reachable.
+    pending[key] = actor.compute.remote(x)
+
+
+class Poller:
+    def __init__(self, actor):
+        self._actor = actor
+        self._inflight = None
+
+    def kick(self):
+        # Stored on self: resolved later by poll().
+        self._inflight = self._actor.tick.remote()
+
+    def poll(self):
+        return ray_tpu.get(self._inflight)
+
+
+def put_and_pass(value, actor):
+    ref = ray_tpu.put(value)
+    return actor.consume.remote(ref)
+
+
+def waited_then_got(actor, xs):
+    refs = [actor.compute.remote(x) for x in xs]
+    ready, rest = ray_tpu.wait(refs, num_returns=1)
+    return ray_tpu.get(ready), rest

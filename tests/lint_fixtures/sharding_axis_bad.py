@@ -1,0 +1,56 @@
+"""sharding-axis-consistency violations: axis names that don't exist
+on the wrapping mesh.
+
+Every axis used here IS declared somewhere in the module vocabulary —
+the module-wide ``collective-unknown-axis`` check passes all of it.
+The bug is contextual: the axis is not on the mesh that actually wraps
+the call.
+"""
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+stage_mesh = Mesh(jax.devices(), axis_names=("stage",))
+dp_mesh = Mesh(jax.devices(), axis_names=("data", "tensor"))
+
+
+def _pipeline_step(x):
+    # sharding-axis-undeclared: "tensor" exists on dp_mesh but NOT on
+    # stage_mesh, which is what wraps this function below.
+    return lax.psum(x, "tensor")
+
+
+stepped = shard_map(_pipeline_step, mesh=stage_mesh,
+                    in_specs=(P("stage"),), out_specs=P("stage"))
+
+
+def wrong_spec(x):
+    # sharding-spec-axis-undeclared: the spec names "data" but the
+    # wrap's mesh only has "stage".
+    return shard_map(lambda v: v, mesh=stage_mesh,
+                     in_specs=(P("data"),), out_specs=P("stage"))(x)
+
+
+def _sum_j(x):
+    return lax.psum(x, "j")
+
+
+def pmap_axis_mismatch(x, j):
+    # sharding-axis-undeclared: pmap binds axis "i"; the body reduces
+    # over "j".
+    return jax.pmap(_sum_j, axis_name="i")(x)
+
+
+def misplaced_sharding(arr):
+    # sharding-spec-axis-undeclared: NamedSharding over stage_mesh
+    # cannot shard along "data" — the array lands replicated.
+    sharding = NamedSharding(stage_mesh, P("data"))
+    return jax.device_put(arr, sharding)
+
+
+def _declares_j(x, axis_name="j"):
+    # Keeps "j" and "data" in the module vocabulary so the module-wide
+    # axis check stays quiet and only the contextual check fires.
+    return x

@@ -1,0 +1,48 @@
+"""sharding-axis-consistency clean twin: every axis exists on the mesh
+that wraps its use."""
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+stage_mesh = Mesh(jax.devices(), axis_names=("stage",))
+dp_mesh = Mesh(jax.devices(), axis_names=("data", "tensor"))
+
+
+def _pipeline_step(x):
+    return lax.ppermute(x, "stage", perm=[(0, 1)])
+
+
+stepped = shard_map(_pipeline_step, mesh=stage_mesh,
+                    in_specs=(P("stage"),), out_specs=P("stage"))
+
+
+def _tensor_sum(x):
+    return lax.psum(x, "tensor")
+
+
+def right_mesh(x):
+    return shard_map(_tensor_sum, mesh=dp_mesh,
+                     in_specs=(P("data", "tensor"),),
+                     out_specs=P("data"))(x)
+
+
+def _sum_i(x):
+    return lax.psum(x, "i")
+
+
+def pmap_matching_axis(x):
+    return jax.pmap(_sum_i, axis_name="i")(x)
+
+
+def unresolvable_mesh(x, mesh):
+    # The mesh is a parameter: the pass can't see its axes and must
+    # stay silent rather than guess.
+    return shard_map(_tensor_sum, mesh=mesh,
+                     in_specs=(P("model"),), out_specs=P("model"))(x)
+
+
+def matched_sharding(arr):
+    sharding = NamedSharding(dp_mesh, P("data", "tensor"))
+    return jax.device_put(arr, sharding)

@@ -1,0 +1,72 @@
+"""splitphase-dataflow violations: handles that miss their wait on
+some path."""
+
+from ray_tpu.util.collective.pallas import (
+    start_ring_allgather,
+    start_ring_reduce_scatter,
+    wait_ring_allgather,
+    wait_ring_reduce_scatter,
+)
+
+
+def deleted_handle(x):
+    # splitphase-unwaited: the start's hop-0 DMA is issued but hops
+    # 1..n-1 (which live in the wait) never run — peers hang.
+    h = start_ring_allgather(x, "data", n=4)
+    del h
+    return x
+
+
+def early_return_drop(x, skip):
+    # splitphase-unwaited: on the skip path the handle reaches function
+    # exit live — the scope-counting heuristic saw "one start, one
+    # wait" and passed this.
+    h = start_ring_allgather(x, "data", n=4)
+    if skip:
+        return x
+    return wait_ring_allgather(h)
+
+
+def loop_overwrite(chunks):
+    # splitphase-unwaited: each iteration overwrites the previous
+    # chunk's unwaited handle.
+    h = None
+    for c in chunks:
+        h = start_ring_reduce_scatter(c, "data", n=4)
+    return wait_ring_reduce_scatter(h)
+
+
+def stashed_never_drained(chunks, x):
+    # splitphase-unwaited: handles escape into a local container that
+    # nothing ever drains.
+    handles = []
+    for c in chunks:
+        handles.append(start_ring_reduce_scatter(c, "data", n=4))
+    return x
+
+
+def double_wait(x):
+    # splitphase-double-wait: the second wait replays ring hops against
+    # a retired buffer.
+    h = start_ring_allgather(x, "data", n=4)
+    y = wait_ring_allgather(h)
+    z = wait_ring_allgather(h)
+    return y + z
+
+
+def mismatched_wait(x):
+    # splitphase-mismatched-wait: an allgather handle fed to a
+    # reduce-scatter wait replays the wrong hop schedule.
+    h = start_ring_allgather(x, "data", n=4)
+    return wait_ring_reduce_scatter(h)
+
+
+def leaks_through_handler(x, risky):
+    # splitphase-unwaited: when risky() raises, the handler returns
+    # with the handle still live.
+    h = start_ring_allgather(x, "data", n=4)
+    try:
+        y = risky(x)
+        return wait_ring_allgather(h) + y
+    except ValueError:
+        return None

@@ -1,0 +1,88 @@
+"""splitphase-dataflow clean twin: every handle meets its wait on
+every path."""
+
+from ray_tpu.util.collective.pallas import (
+    start_ring_allgather,
+    start_ring_reduce_scatter,
+    wait_ring_allgather,
+    wait_ring_reduce_scatter,
+)
+
+
+def balanced_split_phase(x):
+    # start + compute + wait in one scope: the sanctioned overlap shape.
+    h = start_ring_allgather(x, "data", n=4)
+    y = x * 2.0   # overlapped compute
+    return wait_ring_allgather(h) + y
+
+
+def chunked_schedule(grads):
+    # Start/wait split across sibling closures of one builder: the
+    # producer/consumer summaries connect them.
+    def _start(v):
+        return start_ring_reduce_scatter(v, "data", n=4)
+
+    def _wait(h):
+        return wait_ring_reduce_scatter(h)
+
+    return _wait(_start(grads))
+
+
+def summary_across_statements(grads):
+    def _start(v):
+        return start_ring_reduce_scatter(v, "data", n=4)
+
+    def _wait(h):
+        return wait_ring_reduce_scatter(h)
+
+    h = _start(grads)
+    y = grads * 0.5
+    return _wait(h) + y
+
+
+def container_drained(chunks):
+    # Handles stashed in a list, drained by a comprehension wait.
+    handles = []
+    for c in chunks:
+        handles.append(start_ring_reduce_scatter(c, "data", n=4))
+    return [wait_ring_reduce_scatter(h) for h in handles]
+
+
+def slot_stash(x, y):
+    # Subscript stash and per-slot wait (the zero.py overlap pattern).
+    handles = [None, None]
+    handles[0] = start_ring_allgather(x, "data", n=4)
+    handles[1] = start_ring_allgather(y, "data", n=4)
+    a = wait_ring_allgather(handles[0])
+    b = wait_ring_allgather(handles[1])
+    return a + b
+
+
+def early_return_before_start(x, n):
+    # The early return happens before any start: nothing is owed.
+    if n == 1:
+        return x
+    h = start_ring_allgather(x, "data", n=n)
+    return wait_ring_allgather(h)
+
+
+def consumer(h):
+    # Waiting a handle received as a parameter: the caller's
+    # obligation, not ours.
+    return wait_ring_allgather(h)
+
+
+def producer(x):
+    # Returning a fresh handle hands the obligation to the caller.
+    return start_ring_allgather(x, "data", n=4)
+
+
+def waited_in_finally(x, risky):
+    # The finally runs on both the normal and exceptional path: the
+    # handle is always waited.
+    h = start_ring_allgather(x, "data", n=4)
+    try:
+        y = risky(x)
+    finally:
+        g = wait_ring_allgather(h)
+    return g + y

@@ -5,17 +5,21 @@ The gate test IS the tier-1 enforcement: it fails the suite whenever
 ``python scripts/graftlint.py`` would exit non-zero at HEAD.
 """
 
+import ast
 import importlib.util
 import json
 import os
+import subprocess
 import textwrap
+import time
 
 import pytest
 
 from ray_tpu._private.lint import (
     Baseline, registered_passes, run_lint,
 )
-from ray_tpu._private.lint.cli import main as lint_main
+from ray_tpu._private.lint.cli import changed_files, main as lint_main
+from ray_tpu._private.lint.dataflow import build_cfg
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(FIXTURES)))
@@ -32,15 +36,25 @@ PASS_CASES = [
      {"jit-impure-call", "jit-global-mutation",
       "jit-unhashable-static", "jit-traced-branch"}),
     ("async-blocking", "async_bad.py", "async_clean.py",
-     {"async-blocking-call", "async-unawaited-wait"}),
+     {"async-blocking-call", "async-unawaited-wait",
+      "async-blocking-transitive"}),
     ("distributed-deadlock", "deadlock_bad.py", "deadlock_clean.py",
      {"deadlock-self-get", "deadlock-unbounded-wait"}),
     ("collective-consistency", "collectives_bad.py",
      "collectives_clean.py",
      {"collective-unknown-axis", "collective-divergent-branches",
       "collective-member-mismatch", "collective-dtype-drift",
-      "collective-quantized-nonfloat",
-      "collective-splitphase-unbalanced", "collective-ef-nonfloat"}),
+      "collective-quantized-nonfloat", "collective-ef-nonfloat"}),
+    ("splitphase-dataflow", "splitphase_bad.py", "splitphase_clean.py",
+     {"splitphase-unwaited", "splitphase-double-wait",
+      "splitphase-mismatched-wait"}),
+    ("donation-use-after", "donation_bad.py", "donation_clean.py",
+     {"donation-use-after"}),
+    ("sharding-axis-consistency", "sharding_axis_bad.py",
+     "sharding_axis_clean.py",
+     {"sharding-axis-undeclared", "sharding-spec-axis-undeclared"}),
+    ("objectref-leak", "objectref_bad.py", "objectref_clean.py",
+     {"objectref-dropped", "objectref-leak"}),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      {"lock-cycle", "lock-blocking-call"}),
     ("metric-declarations", "metrics_bad.py", "metrics_clean.py",
@@ -198,8 +212,265 @@ class TestRepoGate:
         for name in ("jit-hygiene", "async-blocking",
                      "distributed-deadlock", "collective-consistency",
                      "lock-discipline", "metric-declarations",
-                     "event-schema", "control-loop"):
+                     "event-schema", "control-loop",
+                     "splitphase-dataflow", "donation-use-after",
+                     "sharding-axis-consistency", "objectref-leak"):
             assert name in out
+
+
+def _cfg(src, name="f"):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and n.name == name)
+    return build_cfg(fn)
+
+
+def _reaches(cfg, src_block, dst_block):
+    seen, stack = {src_block}, [src_block]
+    while stack:
+        for succ, _ in stack.pop().succs:
+            if succ not in seen:
+                seen.add(succ)
+                stack.append(succ)
+    return dst_block in seen
+
+
+class TestCFG:
+    """Shape checks for the dataflow engine's control-flow graphs."""
+
+    def test_if_elif_else_branches_are_distinct_and_join(self):
+        cfg = _cfg("""\
+            def f(x):
+                if x == 1:
+                    a = 1
+                elif x == 2:
+                    b = 2
+                else:
+                    c = 3
+                d = 4
+        """)
+        blocks = [cfg.block_at(n) for n in (3, 5, 7, 8)]
+        assert all(b is not None for b in blocks)
+        a, b, c, d = blocks
+        assert len({id(a), id(b), id(c), id(d)}) == 4
+        for branch in (a, b, c):
+            assert _reaches(cfg, branch, d)
+        # No branch flows into a sibling branch.
+        assert not _reaches(cfg, a, b) and not _reaches(cfg, b, c)
+
+    def test_while_else_runs_on_normal_exit_only(self):
+        cfg = _cfg("""\
+            def f(xs):
+                while xs:
+                    if xs.pop():
+                        break
+                else:
+                    cleanup = 1
+                done = 2
+        """)
+        head = cfg.block_at(2)
+        els = cfg.block_at(6)
+        done = cfg.block_at(7)
+        assert els is not None
+        # else hangs off the loop test, break bypasses it.
+        assert els in [s for s, _ in head.succs]
+        brk = cfg.block_at(3)   # the if-test block; break follows it
+        assert _reaches(cfg, brk, done)
+        assert _reaches(cfg, els, done)
+
+    def test_try_finally_runs_on_both_exits(self):
+        cfg = _cfg("""\
+            def f(x):
+                try:
+                    if x:
+                        return 1
+                    y = 2
+                finally:
+                    release = 3
+                return y
+        """)
+        # Both the early return and the fall-through reach exit, and
+        # every such path passes a copy of the finally body.
+        assert cfg.exit.preds
+        for path_start in (cfg.block_at(4), cfg.block_at(5)):
+            assert path_start is not None
+            seen, stack = {path_start}, [path_start]
+            hit_finally = False
+            while stack:
+                blk = stack.pop()
+                if any(getattr(s, "lineno", 0) == 7 for s in blk.stmts):
+                    hit_finally = True
+                for succ, _ in blk.succs:
+                    if succ not in seen:
+                        seen.add(succ)
+                        stack.append(succ)
+            assert hit_finally
+            assert cfg.exit in seen
+
+    def test_early_return_skips_the_rest(self):
+        cfg = _cfg("""\
+            def f(x):
+                if x:
+                    return 0
+                tail = 1
+        """)
+        ret = cfg.block_at(3)
+        tail = cfg.block_at(4)
+        assert not _reaches(cfg, ret, tail)
+        assert _reaches(cfg, ret, cfg.exit)
+        assert _reaches(cfg, tail, cfg.exit)
+
+    def test_with_statement_is_linear(self):
+        cfg = _cfg("""\
+            def f(lock):
+                with lock:
+                    a = 1
+                b = 2
+        """)
+        assert cfg.block_at(2) is cfg.block_at(3)
+        assert _reaches(cfg, cfg.block_at(3), cfg.block_at(4))
+
+    def test_for_body_runs_at_least_once(self):
+        # The overlap idiom starts chunk 0 before the loop; a zero-trip
+        # edge from the head would flag it on an infeasible path, so
+        # loop exit flows only from iteration end.
+        cfg = _cfg("""\
+            def f(xs):
+                for x in xs:
+                    body = 1
+                after = 2
+        """)
+        head = cfg.block_at(2)
+        after = cfg.block_at(4)
+        assert head not in [p for p, _ in after.preds]
+        assert _reaches(cfg, cfg.block_at(3), after)
+
+
+class TestObligationTracking:
+    """The engine follows values across aliasing and rebinds."""
+
+    def _split(self, tmp_path, body):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(body))
+        return run_lint([str(p)], select=["splitphase-dataflow"])
+
+    def test_rebind_while_live_is_flagged(self, tmp_path):
+        r = self._split(tmp_path, """\
+            def f(x, y):
+                h = start_ring_allgather(x)
+                h = start_ring_allgather(y)
+                wait_ring_allgather(h)
+        """)
+        assert [f.rule for f in r.findings] == ["splitphase-unwaited"]
+        assert "overwritten" in r.findings[0].message
+
+    def test_alias_keeps_the_obligation_alive(self, tmp_path):
+        r = self._split(tmp_path, """\
+            def f(x):
+                h = start_ring_allgather(x)
+                h2 = h
+                h = None
+                wait_ring_allgather(h2)
+        """)
+        assert r.findings == [], [f.render() for f in r.findings]
+
+    def test_del_of_last_binding_is_flagged(self, tmp_path):
+        r = self._split(tmp_path, """\
+            def f(x):
+                h = start_ring_allgather(x)
+                del h
+        """)
+        assert [f.rule for f in r.findings] == ["splitphase-unwaited"]
+        assert "deleted" in r.findings[0].message
+
+    def test_loop_rebind_after_consume_is_clean(self, tmp_path):
+        # Regression: a creation site re-executed on a loop back edge
+        # must not see its own fresh value when judging the rebind.
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent("""\
+            import ray_tpu
+
+            def f(actor, xs):
+                outs = []
+                for x in xs:
+                    out = actor.f.remote(x)
+                    outs.append(out)
+                return ray_tpu.get(outs)
+        """))
+        r = run_lint([str(p)], select=["objectref-leak"])
+        assert r.findings == [], [f.render() for f in r.findings]
+
+    def test_closure_capture_is_an_escape(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent("""\
+            import ray_tpu
+
+            def f(actor, xs):
+                refs = [actor.f.remote(x) for x in xs]
+
+                def drain():
+                    return ray_tpu.get(refs)
+                return drain
+        """))
+        r = run_lint([str(p)], select=["objectref-leak"])
+        assert r.findings == [], [f.render() for f in r.findings]
+
+
+class TestCLI:
+    def test_json_format_reports_findings(self, capsys):
+        rc = lint_main([os.path.join(FIXTURES, "objectref_bad.py"),
+                        "--select", "objectref-leak", "--no-baseline",
+                        "--format", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert out["ok"] is False
+        assert out["files"] == 1
+        rules = {f["rule"] for f in out["findings"]}
+        assert rules == {"objectref-dropped", "objectref-leak"}
+        for f in out["findings"]:
+            assert set(f) == {"rule", "path", "line", "message",
+                              "context"}
+
+    def test_json_format_clean(self, capsys):
+        rc = lint_main([os.path.join(FIXTURES, "objectref_clean.py"),
+                        "--select", "objectref-leak", "--no-baseline",
+                        "--format", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["ok"] is True and out["findings"] == []
+
+    def _git(self, cwd, *args):
+        return subprocess.run(["git", "-C", str(cwd), *args],
+                              capture_output=True, text=True, check=True)
+
+    def test_changed_files_diff_plus_untracked(self, tmp_path):
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "config", "user.email", "t@t")
+        self._git(tmp_path, "config", "user.name", "t")
+        (tmp_path / "a.py").write_text("x = 1\n")
+        (tmp_path / "keep.txt").write_text("not python\n")
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        (tmp_path / "a.py").write_text("x = 2\n")      # modified
+        (tmp_path / "b.py").write_text("y = 1\n")      # untracked
+        got = changed_files("HEAD", str(tmp_path))
+        assert got is not None
+        assert {os.path.basename(p) for p in got} == {"a.py", "b.py"}
+
+    def test_changed_files_outside_a_repo_is_none(self, tmp_path):
+        assert changed_files("HEAD", str(tmp_path / "norepo")) is None
+
+
+class TestLintBudget:
+    def test_full_package_run_under_30s(self):
+        # CPU time, not wall clock: the suite runs tests in parallel
+        # and a contended box would fail a wall-clock budget for
+        # reasons that have nothing to do with the lint.
+        t0 = time.process_time()
+        run_lint([os.path.join(REPO, "ray_tpu")], rel_to=REPO)
+        elapsed = time.process_time() - t0
+        assert elapsed < 30.0, f"lint took {elapsed:.1f}s CPU"
 
 
 class TestCheckMetricsShim:
