@@ -1,0 +1,30 @@
+"""trace-hygiene violations (metric-declarations pass, PR 11).
+
+Metric naming here is deliberately clean (registered family, unit
+suffix) so ONLY the trace rules fire — the fixture rows assert exact
+rule sets.
+"""
+
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import Histogram
+from ray_tpu.util.tracing import record_span, span
+
+
+def handle(request, op):
+    with span(f"serve:{op}"):                       # trace-span-name
+        pass
+    with span("serve.handle",
+              attrs={"prompt": request["prompt"],   # trace-attr-cardinality
+                     "prompt_len": len(request["prompt"])}):
+        pass
+    record_span("serve.phase", 0.0, 1.0,
+                {"body": request["body"]})          # trace-attr-cardinality
+    name = "serve." + op
+    tracing.record_span(name, 0.0, 1.0)             # trace-span-name
+
+
+PER_REQUEST = Histogram(
+    "serve_handle_seconds",
+    tag_keys=("request_id",),                       # trace-attr-cardinality
+    boundaries=[0.1, 1.0],
+    description="Per-request series: unbounded cardinality.")

@@ -1,0 +1,18 @@
+"""event-unbounded-extra violations (event-schema pass, PR 11).
+
+Single-file fixture: no ``observability/events.py`` in this tree, so
+the registry/docs rules are exempt and only the payload rule fires.
+``make_event`` is called with a *positional* event type on purpose —
+the emission regex only scans ``_record_event``/``_report_event``/
+``event_type=`` sites.
+"""
+
+from ray_tpu.observability.events import make_event
+
+
+def on_worker_exit(request, gcs):
+    ev = make_event("WORKER_EXIT", "worker died mid-request",
+                    prompt=request["prompt"])      # event-unbounded-extra
+    gcs._record_event("WORKER_EXIT", "worker died mid-request",
+                      body=request["body"])        # event-unbounded-extra
+    return ev

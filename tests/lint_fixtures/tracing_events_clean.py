@@ -1,0 +1,14 @@
+"""event-unbounded-extra clean twin: events link to request data via
+the auto-stamped trace_id and derived scalars, never by value."""
+
+from ray_tpu.observability.events import make_event
+
+
+def on_worker_exit(request, gcs):
+    # make_event stamps trace_id from the ambient TraceContext; the
+    # forensics consumer joins on it instead of carrying the payload.
+    ev = make_event("WORKER_EXIT", "worker died mid-request",
+                    exit_type="OOM_KILLED",
+                    prompt_len=len(request["prompt"]))
+    gcs.call("report_cluster_event", **ev)
+    return ev
