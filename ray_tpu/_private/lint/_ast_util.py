@@ -72,6 +72,22 @@ def consumed_calls(tree: ast.AST) -> Set[int]:
     return out
 
 
+def lockish(expr: ast.expr) -> Optional[str]:
+    """The dotted text of a lock-looking expression (``self._lock``,
+    ``module._STATE_MUTEX``, a ``Condition``) or None. One definition of
+    "what counts as a lock" shared by the race passes, so a finding from
+    one pass and a protection claim from another never disagree."""
+    try:
+        text = ast.unparse(expr)
+    except Exception:
+        return None
+    base = text.split("(")[0].strip()
+    low = base.lower()
+    if "lock" in low or "mutex" in low or "cond" in low:
+        return base
+    return None
+
+
 def literal(node: Optional[ast.expr]):
     """ast.literal_eval or None for dynamic expressions."""
     if node is None:

@@ -14,8 +14,11 @@ Resolution covers the shapes this codebase actually uses:
 
 - bare names: lexically enclosing defs first (closures), then
   module-level defs, then ``from x import y`` (chased through up to 4
-  re-export hops for package ``__init__`` files);
-- ``self.m()`` / ``cls.m()``: methods of the lexically enclosing class;
+  re-export hops for package ``__init__`` files — the bound also breaks
+  re-export *cycles*, which would otherwise recurse forever);
+- ``self.m()`` / ``cls.m()``: methods of the lexically enclosing class,
+  walking the base-class chain (local and imported bases) when the
+  class itself does not define the method;
 - ``ClassName.m()`` and ``alias.m()`` for imported modules.
 
 The graph is cached per ``run_lint`` module set: several passes share
@@ -27,6 +30,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from ray_tpu._private.lint._ast_util import dotted
 from ray_tpu._private.lint.core import ModuleInfo
 
 __all__ = ["FuncInfo", "CallGraph", "get_call_graph"]
@@ -83,6 +87,7 @@ class CallGraph:
         # per module: visible defs, class methods, import aliases
         self._defs: Dict[str, Dict[str, List[FuncInfo]]] = {}
         self._methods: Dict[str, Dict[str, Dict[str, FuncInfo]]] = {}
+        self._bases: Dict[str, Dict[str, List[str]]] = {}
         self._imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
         for mod in mods:
             self._mod_by_name[_module_name(mod.relpath)] = mod
@@ -94,6 +99,7 @@ class CallGraph:
     def _index_module(self, mod: ModuleInfo) -> None:
         defs: Dict[str, List[FuncInfo]] = {}
         methods: Dict[str, Dict[str, FuncInfo]] = {}
+        bases: Dict[str, List[str]] = {}
         imports: Dict[str, Tuple[str, Optional[str]]] = {}
         modname = _module_name(mod.relpath)
 
@@ -102,6 +108,8 @@ class CallGraph:
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, ast.ClassDef):
                     methods.setdefault(child.name, {})
+                    bases[child.name] = [
+                        d for d in (dotted(b) for b in child.bases) if d]
                     visit(child, child.name, parent, depth)
                 elif isinstance(child, (ast.FunctionDef,
                                         ast.AsyncFunctionDef)):
@@ -138,6 +146,7 @@ class CallGraph:
                                                            alias.name)
         self._defs[mod.relpath] = defs
         self._methods[mod.relpath] = methods
+        self._bases[mod.relpath] = bases
         self._imports[mod.relpath] = imports
 
     # ---------------------------------------------------------- resolve
@@ -158,12 +167,12 @@ class CallGraph:
             if isinstance(base, ast.Name):
                 if base.id in ("self", "cls") and caller is not None \
                         and caller.cls:
-                    return self._methods[mod.relpath].get(
-                        caller.cls, {}).get(attr)
+                    return self._method_in_class(mod.relpath, caller.cls,
+                                                 attr)
                 # ClassName.m() on a locally defined class.
-                local = self._methods[mod.relpath].get(base.id)
-                if local is not None:
-                    return local.get(attr)
+                if base.id in self._methods.get(mod.relpath, {}):
+                    return self._method_in_class(mod.relpath, base.id,
+                                                 attr)
                 # module-alias.f()
                 imp = self._imports[mod.relpath].get(base.id)
                 if imp is not None:
@@ -199,8 +208,47 @@ class CallGraph:
             return self._resolve_in_module(imp[0], imp[1], _depth)
         return None
 
+    def _method_in_class(self, relpath: str, cls: str, attr: str,
+                         _seen: Optional[set] = None) -> Optional[FuncInfo]:
+        """``cls.attr`` in the class itself, else MRO-style through its
+        base classes (local first, then imported), cycle-safe."""
+        if _seen is None:
+            _seen = set()
+        if (relpath, cls) in _seen or len(_seen) > 8:
+            return None
+        _seen.add((relpath, cls))
+        hit = self._methods.get(relpath, {}).get(cls, {}).get(attr)
+        if hit is not None:
+            return hit
+        for base in self._bases.get(relpath, {}).get(cls, []):
+            head, _, tail = base.partition(".")
+            if not tail and head in self._methods.get(relpath, {}):
+                hit = self._method_in_class(relpath, head, attr, _seen)
+            else:
+                # Imported base: ``from x import Base`` or ``mod.Base``.
+                imp = self._imports.get(relpath, {}).get(head)
+                if imp is None:
+                    continue
+                if tail:          # module alias . ClassName
+                    modname, clsname = (imp[0] if imp[1] is None
+                                        else f"{imp[0]}.{imp[1]}"), tail
+                else:             # from module import ClassName
+                    if imp[1] is None:
+                        continue
+                    modname, clsname = imp
+                target = self._mod_by_name.get(modname)
+                if target is None:
+                    continue
+                hit = self._method_in_class(target.relpath, clsname,
+                                            attr, _seen)
+            if hit is not None:
+                return hit
+        return None
+
     def _resolve_in_module(self, modname: str, attr: str,
                            _depth: int) -> Optional[FuncInfo]:
+        if _depth > 4:            # re-export chain too deep (or a cycle)
+            return None
         target = self._mod_by_name.get(modname)
         if target is None:
             return None

@@ -2,9 +2,9 @@
 ``scripts/graftlint.py``.
 
 Exit status is 0 iff there are zero unbaselined, unsuppressed findings
-(stale baseline entries are reported but don't fail — prune them with
-``--baseline-update``). Run with ``--baseline-update`` after fixing or
-justifying findings; it rewrites the baseline to exactly the current
+(stale baseline entries are reported but don't fail — drop just those
+with ``--prune-baseline``). Run with ``--baseline-update`` after fixing
+or justifying findings; it rewrites the baseline to exactly the current
 finding set, preserving justifications of entries that still match.
 """
 
@@ -59,6 +59,47 @@ def _under(path: str, roots: List[str]) -> bool:
     return False
 
 
+def _sarif(result) -> dict:
+    """The finding list as a SARIF 2.1.0 log — same records as
+    ``--format=json``, reshaped for code-scanning UIs."""
+    from ray_tpu._private.lint import all_passes
+
+    rule_to_pass = {r: p for p in all_passes() for r in p.rules}
+    results = []
+    for f in result.findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/"),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": f.line},
+                },
+            }],
+        })
+    rules = [{
+        "id": rule,
+        "shortDescription": {
+            "text": rule_to_pass[rule].description
+            if rule in rule_to_pass else rule},
+    } for rule in sorted({f.rule for f in result.findings})]
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     from ray_tpu._private.lint import (
         Baseline, all_passes, registered_passes, run_lint,
@@ -86,6 +127,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="rewrite the baseline to the current finding set "
              "(keeps justifications of entries that still match)")
     parser.add_argument(
+        "--prune-baseline", action="store_true",
+        help="drop baseline entries nothing matches anymore (fixed or "
+             "moved code) without grandfathering any new findings")
+    parser.add_argument(
         "--list-passes", action="store_true",
         help="list registered passes and their rules")
     parser.add_argument(
@@ -94,9 +139,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="lint only .py files changed vs BASE (git diff "
              "--name-only; default base: HEAD) plus untracked files")
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="output format (json: one machine-readable object on "
-             "stdout)")
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="output format (json: one machine-readable object; sarif: "
+             "SARIF 2.1.0 for code-scanning UIs)")
     parser.add_argument(
         "-q", "--quiet", action="store_true",
         help="print findings only (no summary)")
@@ -114,18 +159,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline_path = None if args.no_baseline else (
         args.baseline or default_baseline_path())
 
+    partial = False
     if args.changed_only is not None:
         changed = changed_files(args.changed_only, root)
         if changed is None:
-            return 2
-        roots = [f for f in changed
-                 if _under(f, roots) and os.path.exists(f)]
+            # Outside a work tree (tarball checkout, exported CI dir)
+            # there is no diff to narrow by: lint everything instead of
+            # failing a gate that has nothing to do with git.
+            print("graftlint: --changed-only: git can't answer here; "
+                  "falling back to a full scan", file=sys.stderr)
+        else:
+            partial = True
+            roots = [f for f in changed
+                     if _under(f, roots) and os.path.exists(f)]
 
     result = run_lint(roots, select=args.select,
                       baseline=baseline_path, rel_to=root)
-    if args.changed_only is not None:
+    if partial:
         # A partial run can't tell fixed-elsewhere from out-of-scope.
         result.stale_baseline = []
+
+    if args.prune_baseline:
+        if partial or args.no_baseline or args.select or args.roots:
+            print("graftlint: --prune-baseline needs a full unfiltered "
+                  "run (no roots, --changed-only, --no-baseline or "
+                  "--select): a partial run can't tell a fixed finding "
+                  "from an unscanned one", file=sys.stderr)
+            return 2
+        path = args.baseline or default_baseline_path()
+        prev = Baseline.load(path if os.path.exists(path) else None)
+        new_base = Baseline.from_findings(result.baselined, previous=prev)
+        new_base.save(path)
+        pruned = len(prev.entries) - len(new_base.entries)
+        print(f"graftlint: baseline pruned: {pruned} stale entries "
+              f"removed, {len(new_base.entries)} kept ({path})")
+        return 0
 
     if args.baseline_update:
         path = args.baseline or default_baseline_path()
@@ -136,6 +204,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"graftlint: baseline written to {path} "
               f"({len(new_base.entries)} entries)")
         return 0
+
+    if args.format == "sarif":
+        print(json.dumps(_sarif(result), indent=2, sort_keys=True))
+        return 1 if result.findings else 0
 
     if args.format == "json":
         def _row(f):

@@ -45,6 +45,7 @@ from typing import (
 __all__ = [
     "Block", "CFG", "build_cfg", "cfgs_for_module", "solve",
     "walk_no_scope", "load_names", "ObligationEngine", "Violation",
+    "yield_points", "effective_roots", "lexical_locks", "held_locksets",
 ]
 
 _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
@@ -509,6 +510,112 @@ def base_name(expr: ast.expr) -> Optional[str]:
     if isinstance(expr, ast.Starred):
         return base_name(expr.value)
     return None
+
+
+# ------------------------------------------------- concurrency helpers
+#
+# Shared substrate for the graftrace race passes (await-atomicity,
+# lockset-consistency): where a coroutine can be suspended, and which
+# locks guard a given program point.
+
+_HEAD_ONLY = (ast.For, ast.AsyncFor, ast.With, ast.AsyncWith,
+              ast.ExceptHandler)
+
+
+def effective_roots(stmt: ast.AST) -> List[ast.AST]:
+    """The subtrees a CFG block statement actually evaluates: head-only
+    nodes (``For``/``With``/``ExceptHandler``) contribute just their
+    head expressions, nested def/class statements contribute nothing
+    (their bodies run elsewhere), everything else is itself."""
+    if isinstance(stmt, _HEAD_ONLY):
+        return list(effective_exprs(stmt))
+    if isinstance(stmt, _SCOPE_NODES + (ast.ClassDef,)):
+        return []
+    return [stmt]
+
+
+def yield_points(stmt: ast.AST) -> List[ast.AST]:
+    """The suspension points this block statement evaluates: every
+    ``await`` in its effective extent, plus the statement itself for an
+    ``async for`` head (``__anext__`` awaits each iteration) and an
+    ``async with`` entry (``__aenter__`` awaits). At each of these the
+    event loop may run other coroutines of the same object."""
+    pts: List[ast.AST] = []
+    if isinstance(stmt, (ast.AsyncFor, ast.AsyncWith)):
+        pts.append(stmt)
+    for root in effective_roots(stmt):
+        pts.extend(n for n in walk_no_scope(root)
+                   if isinstance(n, ast.Await))
+    return pts
+
+
+def lexical_locks(fn: ast.AST) -> Dict[int, FrozenSet[str]]:
+    """``id(node) -> lock names held lexically at that node`` for every
+    node under ``fn``, from ``with``/``async with`` on lock-like context
+    managers (:func:`_ast_util.lockish`). Lexical, not CFG-based: the
+    CFG inlines ``with`` bodies, so the extent of the critical section
+    is only visible in the source tree. Nested scopes are not entered —
+    their bodies run under their own discipline."""
+    from ray_tpu._private.lint._ast_util import lockish
+
+    out: Dict[int, FrozenSet[str]] = {}
+
+    def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+        out[id(node)] = held
+        if node is not fn and isinstance(node, _SCOPE_NODES):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            names = frozenset(
+                t for t in (lockish(i.context_expr) for i in node.items)
+                if t is not None)
+            for item in node.items:
+                visit(item, held)
+            for child in node.body:
+                visit(child, held | names)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    visit(fn, frozenset())
+    return out
+
+
+def held_locksets(cfg: CFG) -> Dict[int, FrozenSet[str]]:
+    """``id(stmt) -> locks acquired via .acquire() and not yet released``
+    at each block statement: a must-lockset worklist analysis (join =
+    intersection, so a lock counts only when held on *every* path in).
+    Complements :func:`lexical_locks` for the explicit acquire/release
+    style."""
+    from ray_tpu._private.lint._ast_util import lockish
+
+    def stmt_effect(stmt: ast.AST,
+                    held: FrozenSet[str]) -> FrozenSet[str]:
+        for root in effective_roots(stmt):
+            for n in walk_no_scope(root):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("acquire", "release")):
+                    continue
+                name = lockish(n.func.value)
+                if name is None:
+                    continue
+                held = (held | {name} if n.func.attr == "acquire"
+                        else held - {name})
+        return held
+
+    def transfer(block: Block, state: FrozenSet[str]) -> FrozenSet[str]:
+        for stmt in block.stmts:
+            state = stmt_effect(stmt, state)
+        return state
+
+    in_states = solve(cfg, transfer, frozenset(),
+                      lambda a, b: a & b)
+    out: Dict[int, FrozenSet[str]] = {}
+    for block, state in in_states.items():
+        for stmt in block.stmts:
+            out[id(stmt)] = state
+            state = stmt_effect(stmt, state)
+    return out
 
 
 # Receiver methods that stash a value into the receiver container (the

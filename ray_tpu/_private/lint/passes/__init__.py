@@ -2,6 +2,7 @@
 
 from ray_tpu._private.lint.passes import (  # noqa: F401
     async_blocking,
+    atomicity,
     collectives,
     control_loop,
     deadlock,
@@ -9,8 +10,10 @@ from ray_tpu._private.lint.passes import (  # noqa: F401
     events,
     jit_hygiene,
     locks,
+    lockset,
     metrics,
     objectref,
+    reentrancy,
     sharding_axis,
     splitphase,
 )

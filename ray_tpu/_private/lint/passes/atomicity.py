@@ -1,0 +1,187 @@
+"""await-atomicity: a check of ``self`` state invalidated across an await.
+
+Every ``await`` (and each ``async for`` iteration / ``async with``
+entry) is a point where the event loop may run *other* coroutines of
+the same object — an async actor with ``max_concurrency > 1``, a
+controller serving several RPCs, a background task beside a request
+path. The async TOCTOU this pass hunts:
+
+    if latest <= self._version:      # check
+        return
+    weights = await store.fetch()    # yield point: anyone can run
+    self._version = latest           # act on a stale check
+
+Between the check and the act another coroutine may have moved
+``self._version`` forward; the act then clobbers newer state. The fix
+is either an ``asyncio.Lock`` held across both sides or re-checking
+after the await (``while self._pending: self._pending.pop(0)`` is the
+clean idiom — each loop-head test is a *fresh* check).
+
+Mechanics: a worklist analysis over the function CFG. Branch tests
+and asserts reading ``self.<attr>`` open a check record carrying the
+lockset held at the test (lexical ``with``/``async with`` plus
+explicit ``.acquire()`` tracked through the CFG). Any yield point
+marks live records crossed. A statement that may modify the attr —
+direct store, subscript/field store, mutating container method, or a
+one-hop ``self.m()`` call whose body writes it — fires when a crossed
+record exists and no lock is shared between check and act. Two
+precision guards keep the pass quiet on healthy code: re-reading the
+attr in a later test replaces the record (strong update), so
+re-check-after-await never fires; and a check only pairs with acts it
+*controls* — inside its construct, or anywhere after it when the
+guarded branch exits early (``if stale: return`` / ``continue``) or
+the test heads a spin-wait loop. Only attrs touched by more than one
+method of the class are tracked: an attr private to one coroutine
+body cannot be invalidated behind its back (precision over recall).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Set, Tuple
+
+from ray_tpu._private.lint._ast_util import enclosing_class_map
+from ray_tpu._private.lint.core import (
+    Finding, LintPass, ModuleInfo, register,
+)
+from ray_tpu._private.lint.dataflow import (
+    cfgs_for_module, held_locksets, lexical_locks, solve, yield_points,
+)
+from ray_tpu._private.lint.race import (
+    fn_self_accesses, fn_self_writes, stmt_self_calls, stmt_self_reads,
+    stmt_self_writes,
+)
+
+# One check record: (lockset at the check, crossed a yield point yet,
+# line of the check, last line the check still guards).
+_Rec = Tuple[FrozenSet[str], bool, int, int]
+
+_INITISH = {"__init__", "__new__", "__post_init__"}
+
+_EXITS = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+
+def _guard_ranges(fn) -> Dict[int, Tuple[int, int]]:
+    """id(test node) -> (first, last) line the test *guards*: a check
+    only pairs with acts it actually controls. For an ``if``/``while``
+    that is its construct's extent; when a branch ends in
+    return/raise/break/continue (the early-exit guard idiom) or the
+    statement is an ``assert``, everything to the end of the function
+    is control-dependent on the test having passed."""
+    out: Dict[int, Tuple[int, int]] = {}
+    fn_end = getattr(fn, "end_lineno", 10 ** 9) or 10 ** 9
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.If, ast.While)):
+            exits = any(
+                branch and isinstance(branch[-1], _EXITS)
+                for branch in (n.body, n.orelse))
+            hi = fn_end if (exits or isinstance(n, ast.While)) \
+                else (getattr(n, "end_lineno", fn_end) or fn_end)
+            out[id(n.test)] = (n.test.lineno, hi)
+        elif isinstance(n, ast.Assert):
+            out[id(n)] = (n.lineno, fn_end)
+    return out
+
+
+def _join(a: Dict[str, FrozenSet[_Rec]],
+          b: Dict[str, FrozenSet[_Rec]]) -> Dict[str, FrozenSet[_Rec]]:
+    out = dict(a)
+    for attr, recs in b.items():
+        out[attr] = out.get(attr, frozenset()) | recs
+    return out
+
+
+@register
+class AwaitAtomicityPass(LintPass):
+    name = "await-atomicity"
+    rules = ("await-atomicity",)
+    description = ("self.<attr> check-then-act spanning an await in "
+                   "async methods with no lock held across both sides")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        out: List[Finding] = []
+        owner, _classes = enclosing_class_map(mod.tree)
+        cfgs = cfgs_for_module(mod)
+
+        # Per class: what each method writes (one-hop call expansion)
+        # and which attrs more than one method touches.
+        writes_by_cls: Dict[str, Dict[str, Set[str]]] = {}
+        touchers: Dict[str, Dict[str, Set[str]]] = {}
+        for fn, cls in owner.items():
+            if not cls:
+                continue
+            writes_by_cls.setdefault(cls, {}).setdefault(
+                fn.name, set()).update(fn_self_writes(fn))
+            if fn.name not in _INITISH:
+                for attr in fn_self_accesses(fn):
+                    touchers.setdefault(cls, {}).setdefault(
+                        attr, set()).add(fn.name)
+
+        for fn, cls in owner.items():
+            if not cls or not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            cfg = cfgs.get(fn)
+            if cfg is None:
+                continue
+            shared = {attr for attr, who in
+                      touchers.get(cls, {}).items()
+                      if who - {fn.name}}
+            if not shared:
+                continue
+            out.extend(self._check_fn(
+                mod, fn, cfg, writes_by_cls.get(cls, {}), shared))
+        return out
+
+    def _check_fn(self, mod: ModuleInfo, fn, cfg, cls_writes, shared):
+        lex = lexical_locks(fn)
+        held = held_locksets(cfg)
+        guards = _guard_ranges(fn)
+
+        def locks_at(stmt) -> FrozenSet[str]:
+            return (lex.get(id(stmt), frozenset())
+                    | held.get(id(stmt), frozenset()))
+
+        hits: Dict[Tuple[str, int, int], ast.AST] = {}
+
+        def transfer(block, state):
+            st = dict(state)
+            for stmt in block.stmts:
+                # Awaits evaluate before the statement's store takes
+                # effect (``self.x = await f()``), so mark first.
+                if yield_points(stmt):
+                    for attr, recs in list(st.items()):
+                        st[attr] = frozenset(
+                            (lk, True, ln, hi) for lk, _c, ln, hi in recs)
+                written = stmt_self_writes(stmt) & shared
+                for m in stmt_self_calls(stmt):
+                    written |= cls_writes.get(m, set()) & shared
+                if written:
+                    wlocks = locks_at(stmt)
+                    wline = getattr(stmt, "lineno", 0)
+                    for attr in written:
+                        for lk, crossed, ln, hi in st.pop(
+                                attr, frozenset()):
+                            if crossed and not (lk & wlocks) \
+                                    and ln <= wline <= hi:
+                                hits.setdefault((attr, ln, wline), stmt)
+                if isinstance(stmt, (ast.expr, ast.Assert)):
+                    ln = getattr(stmt, "lineno", 0)
+                    _lo, hi = guards.get(
+                        id(stmt), (ln, 10 ** 9))
+                    for attr in stmt_self_reads(stmt) & shared:
+                        st[attr] = frozenset({
+                            (locks_at(stmt), False, ln, hi)})
+            return st
+
+        solve(cfg, transfer, {}, _join)
+
+        for (attr, check_ln, _act_ln), stmt in sorted(
+                hits.items(), key=lambda kv: kv[0]):
+            yield mod.finding(
+                "await-atomicity", stmt,
+                f"self.{attr} checked at line {check_ln} and modified "
+                f"here, with an await in between and no common lock: "
+                f"another coroutine of {fn.name}()'s object can run at "
+                f"the yield point and invalidate the check — hold an "
+                f"asyncio.Lock across check-and-act, or re-check after "
+                f"the await")

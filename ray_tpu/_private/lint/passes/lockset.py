@@ -1,0 +1,239 @@
+"""lockset-consistency: attrs guarded sometimes, bare other times.
+
+RacerD's core observation, scaled to this codebase: you don't need a
+full happens-before proof to catch most data races — it is enough to
+see that ``self._replicas`` is read under ``self._lock`` in one method
+and written with no lock in another, *and* that the two methods run on
+different strands of execution. The lock discipline the class itself
+claims (by locking the attr anywhere at all) is the spec; a bare write
+is the violation.
+
+Per class, three ingredients:
+
+- **locksets** — for every ``self.<attr>`` access, the locks held at
+  that statement: lexical ``with``/``async with`` blocks on lock-like
+  objects plus explicit ``.acquire()``/``.release()`` pairs tracked as
+  a must-analysis through the function CFG (intersection join: a lock
+  counts only if held on every path in).
+- **origin inference** — which strand each method runs on: ``async
+  def`` methods run on the event loop; ``run`` on a Thread subclass,
+  ``threading.Thread(target=self.m)`` / ``Timer`` targets,
+  ``executor.submit(self.m)`` / ``run_in_executor(.., self.m)``
+  callbacks, and ``__del__`` (GC finalizes on an arbitrary thread) run
+  on their own threads; everything else is an API method called from
+  whoever holds the object. Origins propagate through ``self.m()``
+  call edges to a fixpoint; methods reachable only from ``__init__``
+  are single-threaded by construction and ignored.
+- **evidence** — an attr is reported only when its accesses span more
+  than one origin (or two distinct thread entry points): a value
+  touched from one strand only cannot race, locked or not.
+
+Two rules, ranked: ``lockset-cross-origin-write`` — the bare write
+itself runs on a background thread or the event loop (a poll loop
+scribbling over state the request path reads under the lock: the worst
+shape); ``lockset-inconsistent-write`` — the bare write is in an API
+method while locked accesses exist elsewhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Set, Tuple
+
+from ray_tpu._private.lint._ast_util import (
+    call_name, enclosing_class_map, kwarg, lockish,
+)
+from ray_tpu._private.lint.core import (
+    Finding, LintPass, ModuleInfo, register,
+)
+from ray_tpu._private.lint.dataflow import (
+    cfgs_for_module, held_locksets, lexical_locks,
+)
+from ray_tpu._private.lint.race import (
+    stmt_self_reads, stmt_self_writes,
+)
+
+_INITISH = {"__init__", "__new__", "__post_init__"}
+
+# Spawn shapes whose self-method argument becomes a thread entry point.
+_THREAD_CTORS = ("Thread", "Timer")
+_THREAD_DISPATCH = ("submit", "run_in_executor", "call_soon_threadsafe")
+
+
+class _Access:
+    __slots__ = ("attr", "kind", "locks", "origins", "method", "line")
+
+    def __init__(self, attr, kind, locks, origins, method, line):
+        self.attr = attr
+        self.kind = kind          # "read" | "write"
+        self.locks = locks        # frozenset of lock names held
+        self.origins = origins    # frozenset of origin tags
+        self.method = method
+        self.line = line
+
+
+def _self_method_arg(call: ast.Call) -> List[str]:
+    """Names m for every ``self.m`` passed as an argument."""
+    out = []
+    args = list(call.args) + [kw.value for kw in call.keywords]
+    for a in args:
+        if isinstance(a, ast.Attribute) \
+                and isinstance(a.value, ast.Name) and a.value.id == "self":
+            out.append(a.attr)
+    return out
+
+
+@register
+class LocksetConsistencyPass(LintPass):
+    name = "lockset-consistency"
+    rules = ("lockset-cross-origin-write", "lockset-inconsistent-write")
+    description = ("self.<attr> written bare in one method but accessed "
+                   "under a lock in another, across thread/event-loop "
+                   "origins")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        out: List[Finding] = []
+        _owner, classes = enclosing_class_map(mod.tree)
+        cfgs = cfgs_for_module(mod)
+        for clsname, clsnode in classes.items():
+            out.extend(self._check_class(mod, clsname, clsnode, cfgs))
+        return out
+
+    # -------------------------------------------------------- origins
+
+    def _infer_origins(self, clsnode, methods) -> Dict[str, FrozenSet[str]]:
+        seeds: Dict[str, Set[str]] = {m: set() for m in methods}
+        thread_entries: Set[str] = set()
+        is_thread_subclass = any(
+            "Thread" in ast.unparse(b) for b in clsnode.bases)
+        for name, fn in methods.items():
+            if isinstance(fn, ast.AsyncFunctionDef):
+                seeds[name].add("loop")
+            if name == "run" and is_thread_subclass:
+                seeds[name].add("thread")
+                thread_entries.add(name)
+            if name == "__del__":
+                seeds[name].add("thread")
+                thread_entries.add(name)
+        # Spawn sites anywhere in the class body.
+        for fn in methods.values():
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                cname = call_name(n)
+                tail = cname.rsplit(".", 1)[-1]
+                targets: List[str] = []
+                if tail in _THREAD_CTORS:
+                    t = kwarg(n, "target")
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        targets.append(t.attr)
+                    targets.extend(a for a in _self_method_arg(n)
+                                   if a not in targets)
+                elif tail in _THREAD_DISPATCH:
+                    targets.extend(_self_method_arg(n))
+                for t in targets:
+                    if t in seeds:
+                        seeds[t].add("thread")
+                        thread_entries.add(t)
+        for init in _INITISH:
+            if init in seeds:
+                seeds[init].add("init")
+
+        # Propagate through self.m() edges to a fixpoint.
+        edges: Dict[str, Set[str]] = {m: set() for m in methods}
+        for name, fn in methods.items():
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == "self" \
+                        and n.func.attr in methods:
+                    edges[name].add(n.func.attr)
+        origins = {m: frozenset(s) for m, s in seeds.items()}
+        changed = True
+        while changed:
+            changed = False
+            for name, callees in edges.items():
+                for callee in callees:
+                    merged = origins[callee] | origins[name]
+                    if merged != origins[callee]:
+                        origins[callee] = merged
+                        changed = True
+        self._thread_entries = thread_entries
+        return {m: (o if o else frozenset({"api"}))
+                for m, o in origins.items()}
+
+    # -------------------------------------------------------- analysis
+
+    def _check_class(self, mod, clsname, clsnode, cfgs):
+        methods = {c.name: c for c in clsnode.body
+                   if isinstance(c, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        if not methods:
+            return
+        origins = self._infer_origins(clsnode, methods)
+
+        accesses: Dict[str, List[_Access]] = {}
+        for name, fn in methods.items():
+            if name in _INITISH:
+                continue
+            org = origins[name]
+            if org == frozenset({"init"}):
+                continue   # reachable from __init__ only: single strand
+            cfg = cfgs.get(fn)
+            if cfg is None:
+                continue
+            lex = lexical_locks(fn)
+            held = held_locksets(cfg)
+            for block in cfg.blocks:
+                for stmt in block.stmts:
+                    locks = (lex.get(id(stmt), frozenset())
+                             | held.get(id(stmt), frozenset()))
+                    line = getattr(stmt, "lineno", 0)
+                    writes = stmt_self_writes(stmt)
+                    for attr in writes:
+                        accesses.setdefault(attr, []).append(_Access(
+                            attr, "write", locks, org, name, line))
+                    for attr in stmt_self_reads(stmt) - writes:
+                        accesses.setdefault(attr, []).append(_Access(
+                            attr, "read", locks, org, name, line))
+
+        for attr, accs in sorted(accesses.items()):
+            if lockish(ast.Name(id=attr, ctx=ast.Load())):
+                continue   # the lock itself
+            locked = [a for a in accs if a.locks]
+            if not locked:
+                continue   # no discipline claimed anywhere
+            bare_writes = [a for a in accs
+                           if a.kind == "write" and not a.locks]
+            if not bare_writes:
+                continue
+            cats = frozenset().union(*(a.origins for a in accs))
+            entry_methods = {a.method for a in accs
+                             if a.method in self._thread_entries}
+            if len(cats - {"init"}) < 2 and len(entry_methods) < 2:
+                continue   # single strand: cannot race
+            example = locked[0]
+            locks_txt = ", ".join(sorted(example.locks))
+            seen: Set[Tuple[str, int]] = set()
+            for w in bare_writes:
+                key = (w.attr, w.line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                cross = bool(w.origins & {"thread", "loop"})
+                rule = ("lockset-cross-origin-write" if cross
+                        else "lockset-inconsistent-write")
+                worg = "/".join(sorted(w.origins - {"init"})) or "api"
+                eorg = "/".join(sorted(example.origins - {"init"})) \
+                    or "api"
+                yield mod.finding(
+                    rule, w.line,
+                    f"{clsname}.{w.method}() writes self.{attr} with no "
+                    f"lock, but {clsname}.{example.method}() "
+                    f"({example.kind}s it at line {example.line}) holds "
+                    f"{locks_txt}; this write runs on {worg} while the "
+                    f"locked access runs on {eorg} — take the lock here "
+                    f"or document why the race is benign")

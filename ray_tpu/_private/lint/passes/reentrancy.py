@@ -1,0 +1,205 @@
+"""actor-reentrancy: awaiting a call chain back into the same actor.
+
+An actor with the default ``max_concurrency=1`` executes one method at
+a time. A method that *waits* on a ``.remote()`` call to its own
+handle therefore waits on work that can only start after the current
+method returns:
+
+    @ray_tpu.remote
+    class Pipeline:
+        async def step(self):
+            return await self.compute.remote(1)   # never completes
+
+``deadlock-self-get`` already catches the synchronous
+``ray_tpu.get(self.m.remote())`` shape. This pass adds the two shapes
+it cannot see: the *await* form (``await self.m.remote()``, directly
+or through a local ref), and the *chain* form — an entry method whose
+transitive self-call chain (resolved through the package call graph,
+so helpers defined on a base class count) reaches a self-wait buried
+in a helper. The chain finding points at the entry call site and
+prints the path, because that is the frame a wedged-actor stack dump
+will show.
+
+Classes that *declare* ``max_concurrency > 1`` are skipped: their
+event loop can interleave the awaited call back in, so reentrant
+awaits are legal there (and the await-atomicity pass polices what they
+do to shared state instead).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu._private.lint._ast_util import call_name, kwarg, literal
+from ray_tpu._private.lint.callgraph import get_call_graph
+from ray_tpu._private.lint.core import (
+    Finding, LintPass, ModuleInfo, register,
+)
+from ray_tpu._private.lint.passes.deadlock import (
+    _is_get_call, _is_remote_decorated,
+)
+
+
+def _max_concurrency(clsnode: ast.ClassDef) -> int:
+    for dec in clsnode.decorator_list:
+        if isinstance(dec, ast.Call):
+            v = literal(kwarg(dec, "max_concurrency"))
+            if isinstance(v, int):
+                return v
+    return 1
+
+
+def _self_remote_target(call: ast.Call) -> Optional[str]:
+    """``self.<m>.remote(...)`` -> m (exactly that shape: a call on a
+    *stored handle* like ``self._worker.f.remote`` is a different
+    actor)."""
+    parts = call_name(call).split(".")
+    if len(parts) == 3 and parts[0] == "self" and parts[2] == "remote":
+        return parts[1]
+    return None
+
+
+def _walk_own(fn) -> Iterable[ast.AST]:
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _self_waits(fn) -> List[Tuple[ast.AST, str, str]]:
+    """(site, target method, form) for every point where ``fn``
+    synchronously waits on a .remote() call into its own actor. Form is
+    "await" or "get"."""
+    refs: Dict[str, str] = {}     # local name -> target method
+    for n in _walk_own(fn):
+        if isinstance(n, ast.Assign):
+            found = [t for sub in ast.walk(n.value)
+                     if isinstance(sub, ast.Call)
+                     for t in [_self_remote_target(sub)] if t]
+            if found:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        refs[t.id] = found[0]
+    out: List[Tuple[ast.AST, str, str]] = []
+    for n in _walk_own(fn):
+        if isinstance(n, ast.Await):
+            v = n.value
+            for sub in ast.walk(v):
+                if isinstance(sub, ast.Call):
+                    t = _self_remote_target(sub)
+                    if t:
+                        out.append((n, t, "await"))
+                        break
+            else:
+                base = v
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id in refs:
+                    out.append((n, refs[base.id], "await"))
+        elif isinstance(n, ast.Call) and _is_get_call(n):
+            for a in n.args:
+                hit = None
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Call):
+                        hit = _self_remote_target(sub)
+                        if hit:
+                            break
+                if hit is None and isinstance(a, ast.Name) \
+                        and a.id in refs:
+                    hit = refs[a.id]
+                if hit:
+                    out.append((n, hit, "get"))
+                    break
+    return out
+
+
+@register
+class ActorReentrancyPass(LintPass):
+    name = "actor-reentrancy"
+    rules = ("actor-reentrant-await", "actor-reentrant-chain")
+    description = ("awaits on the actor's own .remote() calls — direct "
+                   "or through a helper chain — in max_concurrency=1 "
+                   "actors")
+
+    def __init__(self):
+        self._mods: List[ModuleInfo] = []
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        graph = get_call_graph(self._mods)
+        for mod in self._mods:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) \
+                        and _is_remote_decorated(node) \
+                        and _max_concurrency(node) <= 1:
+                    yield from self._check_class(mod, node, graph)
+
+    def _check_class(self, mod, clsnode, graph):
+        methods = {c.name: c for c in clsnode.body
+                   if isinstance(c, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        waits = {name: _self_waits(fn) for name, fn in methods.items()}
+
+        # Direct await-form findings (the get form is deadlock-self-get
+        # territory already).
+        for name, sites in waits.items():
+            for site, target, form in sites:
+                if form != "await":
+                    continue
+                yield mod.finding(
+                    "actor-reentrant-await", site,
+                    f"{clsnode.name}.{name}() awaits "
+                    f"self.{target}.remote(): this actor runs one "
+                    f"method at a time, so the awaited call can only "
+                    f"start after {name}() returns — guaranteed "
+                    f"deadlock (call the method directly, or raise "
+                    f"max_concurrency and guard the shared state)")
+
+        # Chain form: entry -> self.g() -> ... -> a self-wait, resolved
+        # through the call graph so base-class helpers count.
+        has_wait: Dict[str, List[str]] = {
+            name: [name] for name, sites in waits.items() if sites}
+        edges: Dict[str, List[Tuple[ast.Call, str]]] = {}
+        for name, fn in methods.items():
+            fi = graph.by_node.get(id(fn))
+            if fi is None:
+                continue
+            for call, callee in graph.direct_calls(fi):
+                if callee is None or callee.node is fn:
+                    continue
+                if isinstance(call.func, ast.Attribute) \
+                        and isinstance(call.func.value, ast.Name) \
+                        and call.func.value.id in ("self", "cls"):
+                    edges.setdefault(name, []).append(
+                        (call, callee.name))
+        changed = True
+        while changed:
+            changed = False
+            for name, outs in edges.items():
+                if name in has_wait:
+                    continue
+                for _call, callee in outs:
+                    if callee in has_wait and callee != name:
+                        has_wait[name] = [name] + has_wait[callee]
+                        changed = True
+                        break
+        for name, outs in sorted(edges.items()):
+            for call, callee in outs:
+                if callee not in has_wait or callee == name:
+                    continue
+                chain = [name] + has_wait[callee]
+                yield mod.finding(
+                    "actor-reentrant-chain", call,
+                    f"{clsnode.name}.{name}() calls "
+                    f"self.{callee}(), whose call chain "
+                    f"({' -> '.join(chain)}) waits on this actor's own "
+                    f".remote() result — the actor is still busy "
+                    f"running {name}(), so the chain deadlocks")

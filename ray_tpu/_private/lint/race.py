@@ -1,0 +1,149 @@
+"""Shared self-attribute access model for the graftrace race passes.
+
+The await-atomicity and lockset-consistency passes both reason about
+the same primitive events: "this statement reads ``self.<attr>``",
+"this statement may modify ``self.<attr>``" (directly, through a
+subscript/field store, or via a mutating container method), and "this
+statement calls ``self.<m>()``".  One definition lives here so both
+passes agree on what an access *is* — a write the atomicity pass acts
+on is exactly a write the lockset pass would classify.
+
+Everything operates on a CFG block statement's *effective extent*
+(:func:`dataflow.effective_roots`): a ``for`` head contributes its
+iterable, never its body, so per-statement events line up with the
+program points the solver visits.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from ray_tpu._private.lint.dataflow import effective_roots, walk_no_scope
+
+__all__ = [
+    "MUTATORS", "self_base_attr", "stmt_self_writes", "stmt_self_reads",
+    "stmt_self_calls", "fn_self_writes", "fn_self_accesses",
+]
+
+# Receiver methods that modify the receiver in place. A call
+# ``self._pending.append(x)`` is a *write* to ``_pending`` for race
+# purposes even though no store node exists.
+MUTATORS = {
+    "append", "appendleft", "add", "insert", "extend", "remove",
+    "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "rotate",
+}
+
+
+def self_base_attr(node: ast.AST) -> Optional[str]:
+    """The ``self`` attribute an lvalue-ish expression is rooted in:
+    ``self._depth[r]`` -> ``_depth``, ``self._state.params`` ->
+    ``_state``, plain ``x[k]`` -> None."""
+    while isinstance(node, (ast.Subscript, ast.Starred)):
+        node = node.value
+    last = None
+    while isinstance(node, ast.Attribute):
+        last = node
+        node = node.value
+        while isinstance(node, ast.Subscript):
+            node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" \
+            and last is not None:
+        return last.attr
+    return None
+
+
+def _write_targets(n: ast.AST):
+    if isinstance(n, ast.Assign):
+        return n.targets
+    if isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+        return [n.target]
+    if isinstance(n, ast.Delete):
+        return n.targets
+    return []
+
+
+_SCOPE_ROOTS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def _writes_under(roots) -> Set[str]:
+    out: Set[str] = set()
+    for root in roots:
+        if isinstance(root, _SCOPE_ROOTS):
+            continue
+        for n in walk_no_scope(root):
+            for t in _write_targets(n):
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        a = self_base_attr(e)
+                        if a:
+                            out.add(a)
+                else:
+                    a = self_base_attr(t)
+                    if a:
+                        out.add(a)
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in MUTATORS:
+                a = self_base_attr(n.func.value)
+                if a:
+                    out.add(a)
+    return out
+
+
+def stmt_self_writes(stmt: ast.AST) -> Set[str]:
+    """``self`` attrs this block statement may modify at its own
+    program point (head-only nodes contribute only their heads)."""
+    return _writes_under(effective_roots(stmt))
+
+
+def stmt_self_reads(stmt: ast.AST) -> Set[str]:
+    """``self`` attrs loaded in this block statement's effective
+    extent."""
+    out: Set[str] = set()
+    for root in effective_roots(stmt):
+        for n in walk_no_scope(root):
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.ctx, ast.Load) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self":
+                out.add(n.attr)
+    return out
+
+
+def stmt_self_calls(stmt: ast.AST) -> Set[str]:
+    """Names of ``self.<m>(...)`` method calls in this statement's
+    effective extent (one-hop expansion hook: the caller looks up what
+    ``m`` writes)."""
+    out: Set[str] = set()
+    for root in effective_roots(stmt):
+        for n in walk_no_scope(root):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id == "self" \
+                    and n.func.attr not in MUTATORS:
+                out.add(n.func.attr)
+    return out
+
+
+def fn_self_writes(fn: ast.AST) -> Set[str]:
+    """Every ``self`` attr the function may modify anywhere in its own
+    scope (whole-body summary for one-hop call expansion)."""
+    return _writes_under(ast.iter_child_nodes(fn))
+
+
+def fn_self_accesses(fn: ast.AST) -> Set[str]:
+    """Every ``self`` attr the function touches (read or write)."""
+    out = fn_self_writes(fn)
+    for child in ast.iter_child_nodes(fn):
+        if isinstance(child, _SCOPE_ROOTS):
+            continue
+        for n in walk_no_scope(child):
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self":
+                out.add(n.attr)
+    return out

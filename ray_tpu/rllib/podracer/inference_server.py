@@ -80,7 +80,7 @@ class InferenceServer:
         self._stats = {
             "requests": 0, "rows": 0, "batches": 0, "padded_rows": 0,
             "max_requests_per_batch": 0, "max_rows_per_batch": 0,
-            "bucket_counts": {}, "weight_pulls": 0,
+            "bucket_counts": {}, "weight_pulls": 0, "stale_pulls": 0,
             "poll_errors": 0, "last_poll_error": None,
         }
 
@@ -226,6 +226,10 @@ class InferenceServer:
                 # Nested refs are shipped unresolved; awaiting one
                 # resolves it through the in-loop async get path.
                 weights = await wrapped[0]
+                if v <= self._version:
+                    # A direct set_weights() push landed during the
+                    # two awaits above: the fetch is stale, drop it.
+                    continue
                 self._install(weights, v)
             except Exception as exc:
                 # Registry restart or transient RPC failure: the next
@@ -235,12 +239,21 @@ class InferenceServer:
                 self._stats["last_poll_error"] = repr(exc)
                 continue
 
-    def _install(self, weights, version: int):
+    def _install(self, weights, version: int) -> bool:
         import jax
 
+        version = int(version)
+        if version <= self._version:
+            # Versions only move forward: an install racing a newer
+            # push (out-of-order RPCs, a poll fetch that lost the race
+            # to set_weights) must not roll the server back to stale
+            # params stamped with a lower version.
+            self._stats["stale_pulls"] += 1
+            return False
         self._params = jax.device_put(weights)
-        self._version = int(version)
+        self._version = version
         self._stats["weight_pulls"] += 1
+        return True
 
     async def set_weights(self, weights, version: Optional[int] = None):
         """Direct push path for store-less setups (tests, eval)."""

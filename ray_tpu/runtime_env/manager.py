@@ -81,16 +81,20 @@ class RuntimeEnvManager:
         except asyncio.TimeoutError:
             raise RuntimeEnvSetupError(
                 f"runtime_env setup exceeded {timeout}s") from None
+        # The bare ref/recency writes here and in release()/_maybe_gc()
+        # are safe: everything runs on the raylet's one event loop with
+        # no await between read and write. The per-URI asyncio.Lock in
+        # _ensure_package dedups *creation work*, it is not a data lock.
         for uri in ctx.uris:
             self._refs[uri] = self._refs.get(uri, 0) + 1
-            self._last_used[uri] = time.monotonic()
+            self._last_used[uri] = time.monotonic()  # graftlint: disable=lockset-consistency (single event loop; see above)
         return ctx
 
     def release(self, uris: List[str]) -> None:
         """A worker using these URIs exited."""
         for uri in uris:
             self._refs[uri] = max(0, self._refs.get(uri, 0) - 1)
-            self._last_used[uri] = time.monotonic()
+            self._last_used[uri] = time.monotonic()  # graftlint: disable=lockset-consistency (single event loop; see setup)
         self._maybe_gc()
 
     def stats(self) -> Dict[str, Any]:
@@ -171,6 +175,11 @@ class RuntimeEnvManager:
                     None, packaging.unpack_package, payload, dest)
             self.creations += 1
             self._sizes[uri] = len(payload)
+            # Stamp recency at creation. Without this a just-built
+            # package has no _last_used entry, sorts as oldest in the
+            # LRU, and _maybe_gc can delete it during the awaits between
+            # here and setup() taking the ref.
+            self._last_used[uri] = time.monotonic()
             return self._package_root(dest)
 
     @staticmethod
@@ -270,17 +279,26 @@ class RuntimeEnvManager:
         total = sum(self._sizes.values())
         if total <= self._cache_cap:
             return
-        # Evict least-recently-used unreferenced entries.
+        # Evict least-recently-used unreferenced entries. A URI whose
+        # creation lock is held is mid-_ensure_package: its files are
+        # about to be returned to a worker, so it is not a candidate
+        # even though no ref exists yet.
         victims = sorted(
-            (u for u in self._sizes if self._refs.get(u, 0) == 0),
+            (u for u in self._sizes
+             if self._refs.get(u, 0) == 0
+             and not self._creation_in_flight(u)),
             key=lambda u: self._last_used.get(u, 0))
         for uri in victims:
             if total <= self._cache_cap:
                 break
-            total -= self._sizes.pop(uri, 0)
+            total -= self._sizes.pop(uri, 0)  # graftlint: disable=lockset-consistency (single event loop; see setup)
             self._refs.pop(uri, None)
-            self._last_used.pop(uri, None)
+            self._last_used.pop(uri, None)  # graftlint: disable=lockset-consistency (single event loop; see setup)
             self._delete_entry(uri)
+
+    def _creation_in_flight(self, uri: str) -> bool:
+        lock = self._locks.get(uri)
+        return lock is not None and lock.locked()
 
     def _delete_entry(self, uri: str) -> None:
         if uri.startswith("pip:"):

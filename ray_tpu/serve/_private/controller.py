@@ -97,67 +97,87 @@ class ServeController:
         changed = False
         for app, spec in goal:
             key = (app, spec["name"])
-            replicas = self._replicas.setdefault(key, [])
-            # Rolling code update (reference: deployment_state version
-            # rollout): a redeploy with different code/config retires
-            # every replica built from the old spec — matching replica
-            # count alone would keep serving stale code.
             spec_hash = self._spec_hash(spec)
-            retiring = []
-            if replicas and self._replica_hash.get(key) != spec_hash:
-                # Old-spec replicas keep serving until the new ones exist;
-                # they drain only after the spawn loop below has filled
-                # the replica set (no empty-routing window on redeploy).
-                retiring = list(replicas)
-                replicas.clear()
-                changed = True
-            self._replica_hash[key] = spec_hash
+            # This method runs on the reconcile thread while RPC threads
+            # read and delete the same maps under self._lock, so every
+            # touch of shared state below happens under the lock too; the
+            # slow work (health probes, spawns, drains) runs outside it
+            # on local snapshots.
+            with self._lock:
+                if spec["name"] not in self._apps.get(app, {}):
+                    continue  # deleted since the goal snapshot
+                replicas = self._replicas.setdefault(key, [])
+                # Rolling code update (reference: deployment_state version
+                # rollout): a redeploy with different code/config retires
+                # every replica built from the old spec — matching replica
+                # count alone would keep serving stale code.
+                retiring = []
+                if replicas and self._replica_hash.get(key) != spec_hash:
+                    # Old-spec replicas keep serving until the new ones
+                    # exist; they drain only after the spawn loop below
+                    # has refilled the replica set (no empty-routing
+                    # window on redeploy).
+                    retiring = list(replicas)
+                    replicas.clear()
+                    changed = True
+                self._replica_hash[key] = spec_hash
+                probe = list(replicas)
             # Drop dead replicas (health probe).
             live = []
-            for r in replicas:
+            for r in probe:
                 try:
                     ray_tpu.get(r.check_health.remote(), timeout=30)
                     live.append(r)
                 except Exception:
                     changed = True
-            replicas[:] = live
             want = self._desired_replicas(key, spec, len(live))
             if spec.get("autoscaling_config") and len(live) > 0 \
                     and want != len(live):
                 self._record_scale_decision(key, len(live), want)
-            while len(replicas) < want:
+            spawned = []
+            while len(live) + len(spawned) < want:
                 options: Dict[str, Any] = dict(
                     num_cpus=spec.get("num_cpus", 1),
                     max_concurrency=spec.get("max_ongoing_requests", 8))
                 if spec.get("num_tpus"):
                     options["num_tpus"] = spec["num_tpus"]
-                replicas.append(self._replica_cls.options(**options).remote(
+                spawned.append(self._replica_cls.options(**options).remote(
                     spec["name"], spec["serialized_callable"],
                     tuple(spec.get("init_args", ())),
                     dict(spec.get("init_kwargs", {}))))
                 changed = True
-            if retiring:
-                with self._lock:
-                    self._version += 1
-                    self._version_cond.notify_all()
-                for doomed in retiring:
-                    self._drain_and_kill(doomed)
-            if len(replicas) > want:
-                doomed_list = replicas[want:]
-                del replicas[want:]
-                changed = True
-                # Remove from routing first, then drain before killing —
-                # autoscaling makes downscale routine; in-flight requests
-                # must finish (reference: graceful replica shutdown).
-                with self._lock:
-                    self._version += 1
-                    self._version_cond.notify_all()
-                for doomed in doomed_list:
-                    self._drain_and_kill(doomed)
+            with self._lock:
+                if self._replicas.get(key) is not replicas:
+                    # delete_application() removed this deployment while
+                    # we were probing/spawning. Nothing may be
+                    # resurrected: the survivors were already killed by
+                    # the delete, the fresh spawns were never routed —
+                    # tear them all down and walk away.
+                    retiring, doomed_list, count = [], live + spawned, None
+                else:
+                    replicas[:] = live + spawned
+                    # Remove downscaled replicas from routing first, then
+                    # drain before killing — autoscaling makes downscale
+                    # routine; in-flight requests must finish (reference:
+                    # graceful replica shutdown).
+                    doomed_list = replicas[want:]
+                    del replicas[want:]
+                    if doomed_list:
+                        changed = True
+                    if retiring or doomed_list:
+                        self._version += 1
+                        self._version_cond.notify_all()
+                    count = len(replicas)
+            for doomed in retiring:
+                self._drain_and_kill(doomed)
+            for doomed in doomed_list:
+                self._drain_and_kill(doomed)
+            if count is None:
+                continue
             try:
                 from ray_tpu.observability.serve import serve_metrics
                 serve_metrics().replicas.set(
-                    len(replicas),
+                    count,
                     tags={"deployment": f"{app}/{spec['name']}"})
             except Exception:
                 pass
@@ -234,14 +254,20 @@ class ServeController:
             return spec.get("num_replicas", 1)
         from ray_tpu.serve._private.autoscale import AutoscalePolicy
 
-        policy = self._policies.get(key)
-        if policy is None or self._policy_cfgs.get(key) != cfg:
-            policy = AutoscalePolicy(cfg)
-            self._policies[key] = policy
-            self._policy_cfgs[key] = dict(cfg)
+        # The policy maps are shared with delete_application() on the RPC
+        # threads; mutate them only under the lock. policy.desired() runs
+        # outside it (_total_inflight re-acquires, and the lock must stay
+        # cheap for the long-pollers parked on its condition).
+        with self._lock:
+            policy = self._policies.get(key)
+            if policy is None or self._policy_cfgs.get(key) != cfg:
+                policy = AutoscalePolicy(cfg)
+                self._policies[key] = policy
+                self._policy_cfgs[key] = dict(cfg)
         want, reading = policy.desired(
             current, self._total_inflight(key), hub=self._hub)
-        self._last_reading[key] = reading
+        with self._lock:
+            self._last_reading[key] = reading
         return want
 
     def _autoscale_policy_loop(self):
@@ -276,7 +302,8 @@ class ServeController:
         from ray_tpu.observability.control import record_decision
 
         app, name = key
-        reading = dict(self._last_reading.get(key, {}))
+        with self._lock:
+            reading = dict(self._last_reading.get(key, {}))
         reading.update({"app": app, "deployment": name,
                         "from": current, "to": want})
         message = (f"{app}/{name}: {current} -> {want} replicas "
@@ -299,9 +326,8 @@ class ServeController:
     def get_replicas(self, app_name: str, deployment_name: str):
         """Returns (version, [replica handles]) for router refresh."""
         with self._lock:
-            version = self._version
-        return version, list(self._replicas.get((app_name, deployment_name),
-                                                []))
+            return self._version, list(
+                self._replicas.get((app_name, deployment_name), []))
 
     def routing_version(self) -> int:
         with self._lock:
@@ -372,14 +398,20 @@ class ServeController:
 
     def graceful_shutdown(self) -> bool:
         self._stop.set()
-        for key, replicas in list(self._replicas.items()):
-            for r in replicas:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
-        self._replicas.clear()
-        self._apps.clear()
+        with self._lock:
+            doomed = [r for replicas in self._replicas.values()
+                      for r in replicas]
+            self._replicas.clear()
+            self._apps.clear()
+            # Wake parked long-pollers so they observe the empty tables
+            # now instead of sleeping out their window.
+            self._version += 1
+            self._version_cond.notify_all()
+        for r in doomed:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
         return True
 
 

@@ -1,0 +1,23 @@
+#!/bin/sh
+# Pre-push graftlint: lint the .py files changed vs origin/main (plus
+# untracked ones) and refuse the push on any new finding.
+#
+# Install:  ln -s ../../scripts/lint.sh .git/hooks/pre-push
+# Run by hand:  scripts/lint.sh [BASE]       (default base: origin/main)
+#
+# Outside a git work tree the CLI degrades to a full scan by itself, so
+# this stays usable from exported checkouts too.
+
+set -eu
+
+base="${1:-origin/main}"
+repo="$(cd "$(dirname "$0")/.." && pwd)"
+
+# A fresh clone may not have the remote-tracking ref yet; fall back to
+# HEAD so the hook still guards something rather than erroring.
+if ! git -C "$repo" rev-parse --verify --quiet "$base" >/dev/null; then
+    echo "lint.sh: $base not found, diffing against HEAD" >&2
+    base="HEAD"
+fi
+
+exec python "$repo/scripts/graftlint.py" --changed-only "$base"

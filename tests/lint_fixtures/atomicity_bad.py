@@ -1,0 +1,48 @@
+"""await-atomicity violations: check-then-act torn by a yield point."""
+
+import asyncio
+
+
+async def dial():
+    await asyncio.sleep(0)
+    return object()
+
+
+class Connector:
+    """Classic async TOCTOU: two concurrent connect()s both see None,
+    both dial, one connection leaks."""
+
+    def __init__(self):
+        self._conn = None
+
+    async def connect(self):
+        if self._conn is None:
+            self._conn = await dial()          # await-atomicity
+        return self._conn
+
+    async def close(self):
+        self._conn = None
+
+
+class Poller:
+    """The act hides one hop away in a sync helper: the version guard
+    is stale by the time the fetched weights install."""
+
+    def __init__(self):
+        self._version = 0
+        self._params = None
+
+    def _install(self, params, version):
+        self._params = params
+        self._version = version
+
+    async def poll(self, store):
+        latest = await store.latest_version()
+        if latest <= self._version:
+            return
+        params = await store.fetch(latest)
+        self._install(params, latest)          # await-atomicity
+
+    async def set_weights(self, params, version):
+        self._params = params
+        self._version = version

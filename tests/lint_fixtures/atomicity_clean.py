@@ -1,0 +1,61 @@
+"""await-atomicity clean twins: every check-act pair is either locked
+across the yield point, re-checked after it, or unshared."""
+
+import asyncio
+
+
+async def dial():
+    await asyncio.sleep(0)
+    return object()
+
+
+class LockedConnector:
+    """Check and act both under the asyncio.Lock: the async-with entry
+    is a yield point, but the check happens after it."""
+
+    def __init__(self):
+        self._conn = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self):
+        async with self._lock:
+            if self._conn is None:
+                self._conn = await dial()
+        return self._conn
+
+    async def close(self):
+        async with self._lock:
+            self._conn = None
+
+
+class Batcher:
+    """The re-check idiom: each loop-head test is a fresh look at
+    self._pending, so the pops act on current state."""
+
+    def __init__(self):
+        self._pending = []
+
+    async def put(self, item):
+        self._pending.append(item)
+
+    async def drain(self):
+        if not self._pending:
+            await asyncio.sleep(0.01)
+        out = []
+        while self._pending:
+            out.append(self._pending.pop(0))
+        return out
+
+
+class Private:
+    """_cursor is touched by this coroutine only — nothing can
+    invalidate the check behind its back."""
+
+    def __init__(self):
+        self._cursor = 0
+
+    async def scan(self, src):
+        if self._cursor == 0:
+            await src.seek(0)
+            self._cursor += 1
+        return self._cursor

@@ -1,0 +1,60 @@
+"""lockset-consistency violations: guarded in one method, bare in
+another, across thread/loop/API origins."""
+
+import threading
+
+
+class Registry:
+    """A daemon refresh thread scribbles over state the API path reads
+    under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._version = 0
+        threading.Thread(target=self._refresh_loop, daemon=True).start()
+
+    def _refresh_loop(self):
+        while True:
+            self._version += 1            # lockset-cross-origin-write
+            self._items["beat"] = 1       # lockset-cross-origin-write
+
+    def get(self, key):
+        with self._lock:
+            return self._items.get(key), self._version
+
+
+class Cache:
+    """The API-side bare write: drop() skips the lock put() and the
+    flush thread both take."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = {}
+        self._flusher = threading.Thread(target=self._flush)
+
+    def _flush(self):
+        with self._lock:
+            self._data.clear()
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = value
+
+    def drop(self, key):
+        self._data.pop(key, None)         # lockset-inconsistent-write
+
+
+class AsyncCounter:
+    """Event-loop coroutine vs locked API reader."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    async def bump(self):
+        self._n += 1                      # lockset-cross-origin-write
+
+    def read(self):
+        with self._lock:
+            return self._n

@@ -1,0 +1,76 @@
+"""lockset-consistency clean twins: consistent discipline, init-only
+writes, single-strand attrs, and attrs with no claimed discipline."""
+
+import threading
+
+
+class Consistent:
+    """Every access takes the lock — including the daemon thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self._items["beat"] = 1
+
+    def get(self, key):
+        with self._lock:
+            return self._items.get(key)
+
+
+class InitOnly:
+    """_setup is reachable from __init__ only: single strand by
+    construction, its bare writes cannot race the locked readers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+        self._setup()
+        threading.Thread(target=self._poll, daemon=True).start()
+
+    def _setup(self):
+        self._table["k"] = 0
+
+    def _poll(self):
+        with self._lock:
+            self._table["k"] = self._table.get("k", 0) + 1
+
+
+class NoDiscipline:
+    """_hits is never locked anywhere — the class claims no discipline
+    for it, so bare writes are not inconsistent (async-blocking and
+    atomicity rules own that territory)."""
+
+    def __init__(self):
+        self._hits = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self._hits += 1
+
+    def read(self):
+        return self._hits
+
+
+class AcquireRelease:
+    """Explicit acquire/release tracked through the CFG counts as
+    holding the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        self._lock.acquire()
+        try:
+            self._rows.clear()
+        finally:
+            self._lock.release()
+
+    def add(self, row):
+        with self._lock:
+            self._rows.append(row)

@@ -1,0 +1,35 @@
+"""actor-reentrancy violations: awaiting this actor's own .remote()."""
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Pipeline:
+    async def step(self):
+        return await self.compute.remote(1)      # actor-reentrant-await
+
+    async def staged(self):
+        ref = self.compute.remote(2)
+        return await ref                          # actor-reentrant-await
+
+    async def run(self):
+        return await self._helper()               # actor-reentrant-chain
+
+    async def _helper(self):
+        return await self.compute.remote(3)      # actor-reentrant-await
+
+    async def compute(self, x):
+        return x
+
+
+@ray_tpu.remote(num_cpus=1)
+class Collector:
+    def gather(self):
+        return self._merge()                      # actor-reentrant-chain
+
+    def _merge(self):
+        return ray_tpu.get(self.part.remote())   # deadlock-self-get owns
+                                                  # the direct site
+
+    def part(self):
+        return 1

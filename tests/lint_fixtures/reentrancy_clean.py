@@ -1,0 +1,33 @@
+"""actor-reentrancy clean twins: other-actor awaits, direct coroutine
+calls, and declared max_concurrency."""
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Orchestrator:
+    def __init__(self, worker):
+        self._worker = worker
+
+    async def step(self):
+        # Waiting on a *different* actor's handle is the normal case.
+        return await self._worker.compute.remote(1)
+
+    async def run(self):
+        # A direct coroutine call runs inline in this task: no task
+        # queued behind the running method, nothing to deadlock.
+        return await self._helper()
+
+    async def _helper(self):
+        return await self._worker.compute.remote(2)
+
+
+@ray_tpu.remote(max_concurrency=8)
+class Reentrant:
+    async def outer(self):
+        # Legal: the declared concurrency lets the event loop admit
+        # the inner call while outer() awaits.
+        return await self.inner.remote()
+
+    async def inner(self):
+        return 1

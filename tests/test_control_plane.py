@@ -542,3 +542,118 @@ ray_tpu.shutdown()
     rows = json.loads(verdicts.get("decisions", "[]"))
     assert rows and rows[-1]["action"] == "preempt_reschedule", out
     assert rows[-1]["reading"].get("usage") is not None, out
+
+
+# ------------------------------------- controller reconcile vs RPC races
+
+class _FakeReplicaCls:
+    """Mimics ActorClass.options(...).remote(...) without a cluster."""
+
+    def __init__(self):
+        self.spawned = []
+
+    def options(self, **_kw):
+        outer = self
+
+        class _Opts:
+            def remote(self, *_a, **_k):
+                handle = object()
+                outer.spawned.append(handle)
+                return handle
+
+        return _Opts()
+
+
+def _bare_controller():
+    """A ServeController with the background threads never started, so
+    the reconcile/RPC interleavings under test are deterministic."""
+    import threading
+
+    from ray_tpu.serve._private.controller import ServeController
+
+    c = object.__new__(ServeController._cls)
+    c._replica_cls = _FakeReplicaCls()
+    c._apps = {}
+    c._replicas = {}
+    c._handle_metrics = {}
+    c._policies = {}
+    c._policy_cfgs = {}
+    c._last_reading = {}
+    c._hub = None
+    c._replica_hash = {}
+    c._version = 0
+    c._lock = threading.Lock()
+    c._version_cond = threading.Condition(c._lock)
+    c._stop = threading.Event()
+    return c
+
+
+class TestControllerReconcileRaces:
+    def test_reconcile_spawns_to_goal(self):
+        c = _bare_controller()
+        c._apps["app"] = {"d": {"name": "d", "serialized_callable": b"",
+                                "num_replicas": 2}}
+        c._reconcile_once()
+        assert len(c._replicas[("app", "d")]) == 2
+        version, handles = c.get_replicas("app", "d")
+        assert version == 1
+        assert handles == c._replicas[("app", "d")]
+
+    def test_delete_mid_reconcile_is_not_resurrected(self):
+        """delete_application() landing between the reconcile thread's
+        locked sections must win: the deployment stays gone and every
+        replica the reconciler spawned meanwhile is torn down, not
+        leaked into an orphaned list."""
+        c = _bare_controller()
+        c._apps["app"] = {"d": {"name": "d", "serialized_callable": b"",
+                                "num_replicas": 2}}
+        killed = []
+        c._drain_and_kill = killed.append
+
+        real_desired = type(c)._desired_replicas
+
+        def deleting_desired(key, spec, current):
+            # The RPC thread wins the race while the reconciler is
+            # outside its locked sections.
+            c.delete_application("app")
+            return real_desired(c, key, spec, current)
+
+        c._desired_replicas = deleting_desired
+        c._reconcile_once()
+
+        assert c._apps == {}
+        assert c._replicas == {}
+        assert len(c._replica_cls.spawned) == 2
+        assert killed == c._replica_cls.spawned
+
+    def test_delete_before_loop_body_is_skipped(self):
+        """An app deleted between the goal snapshot and the per-key
+        locked section must not get a zombie _replicas entry back."""
+        c = _bare_controller()
+        c._apps["app"] = {"d": {"name": "d", "serialized_callable": b"",
+                                "num_replicas": 1}}
+
+        def deleting_hash(_spec):
+            c.delete_application("app")
+            return "h"
+
+        c._spec_hash = deleting_hash
+        c._reconcile_once()
+        assert c._replicas == {}
+        assert c._replica_cls.spawned == []
+
+    def test_graceful_shutdown_wakes_long_pollers(self):
+        import threading as _threading
+
+        c = _bare_controller()
+        out = []
+        t = _threading.Thread(
+            target=lambda: out.append(
+                c.poll_replicas("app", "d", known_version=0,
+                                timeout_s=30.0)))
+        t.start()
+        time.sleep(0.2)  # let the poller park on the condition
+        c.graceful_shutdown()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert out and out[0] == (1, [])

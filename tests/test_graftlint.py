@@ -19,7 +19,9 @@ from ray_tpu._private.lint import (
     Baseline, registered_passes, run_lint,
 )
 from ray_tpu._private.lint.cli import changed_files, main as lint_main
-from ray_tpu._private.lint.dataflow import build_cfg
+from ray_tpu._private.lint.dataflow import (
+    build_cfg, held_locksets, lexical_locks, yield_points,
+)
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(FIXTURES)))
@@ -66,6 +68,12 @@ PASS_CASES = [
     ("control-loop", "control_loop_bad.py", "control_loop_clean.py",
      {"ctrl-busy-spin", "ctrl-unjittered-period",
       "ctrl-unawaited-policy"}),
+    ("await-atomicity", "atomicity_bad.py", "atomicity_clean.py",
+     {"await-atomicity"}),
+    ("lockset-consistency", "lockset_bad.py", "lockset_clean.py",
+     {"lockset-cross-origin-write", "lockset-inconsistent-write"}),
+    ("actor-reentrancy", "reentrancy_bad.py", "reentrancy_clean.py",
+     {"actor-reentrant-await", "actor-reentrant-chain"}),
 ]
 
 
@@ -214,7 +222,9 @@ class TestRepoGate:
                      "lock-discipline", "metric-declarations",
                      "event-schema", "control-loop",
                      "splitphase-dataflow", "donation-use-after",
-                     "sharding-axis-consistency", "objectref-leak"):
+                     "sharding-axis-consistency", "objectref-leak",
+                     "await-atomicity", "lockset-consistency",
+                     "actor-reentrancy"):
             assert name in out
 
 
@@ -347,6 +357,85 @@ class TestCFG:
         assert _reaches(cfg, cfg.block_at(3), after)
 
 
+class TestConcurrencyHelpers:
+    """Yield points, lexical lock extents, and acquire/release
+    locksets — the engine pieces under the race passes."""
+
+    def _fn(self, src, name="f"):
+        tree = ast.parse(textwrap.dedent(src))
+        return next(n for n in ast.walk(tree)
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                    and n.name == name)
+
+    def test_yield_points_awaits_and_async_blocks(self):
+        fn = self._fn("""\
+            async def f(self):
+                x = await g()
+                async with h():
+                    pass
+                y = 1
+        """)
+        assign, awith, plain = fn.body
+        assert len(yield_points(assign)) == 1
+        assert awith in yield_points(awith)
+        assert yield_points(plain) == []
+
+    def test_yield_points_skip_nested_defs(self):
+        fn = self._fn("""\
+            async def f(self):
+                async def inner():
+                    await g()
+                return inner
+        """)
+        inner, ret = fn.body
+        assert yield_points(inner) == []
+        assert yield_points(ret) == []
+
+    def test_lexical_locks_cover_with_bodies_only(self):
+        fn = self._fn("""\
+            async def f(self):
+                async with self._lock:
+                    a = 1
+                with open("p") as fh:
+                    b = 2
+                c = 3
+        """)
+        lex = lexical_locks(fn)
+        a = fn.body[0].body[0]
+        b = fn.body[1].body[0]
+        c = fn.body[2]
+        assert lex[id(a)] == frozenset({"self._lock"})
+        assert lex.get(id(b), frozenset()) == frozenset()
+        assert lex.get(id(c), frozenset()) == frozenset()
+
+    def test_held_locksets_track_acquire_release(self):
+        fn = self._fn("""\
+            def f(self):
+                self._lock.acquire()
+                a = 1
+                self._lock.release()
+                b = 2
+        """)
+        held = held_locksets(build_cfg(fn))
+        by_line = {stmt.lineno: held.get(id(stmt), frozenset())
+                   for stmt in fn.body}
+        assert by_line[3] == frozenset({"self._lock"})
+        assert by_line[5] == frozenset()
+
+    def test_held_locksets_are_must_not_may(self):
+        fn = self._fn("""\
+            def f(self, x):
+                if x:
+                    self._lock.acquire()
+                c = 3
+        """)
+        held = held_locksets(build_cfg(fn))
+        c = fn.body[1]
+        # Only one branch acquires: the join must drop the lock.
+        assert held.get(id(c), frozenset()) == frozenset()
+
+
 class TestObligationTracking:
     """The engine follows values across aliasing and rebinds."""
 
@@ -417,6 +506,99 @@ class TestObligationTracking:
         assert r.findings == [], [f.render() for f in r.findings]
 
 
+class TestCallGraph:
+    """Resolution edge cases: bounded re-export chains, re-export
+    cycles, ambiguity, and methods inherited through base classes."""
+
+    def _graph(self, tmp_path, files):
+        from ray_tpu._private.lint.callgraph import get_call_graph
+
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        r = run_lint([str(tmp_path)], select=["jit-hygiene"],
+                     rel_to=str(tmp_path))
+        return get_call_graph(r.modules)
+
+    def _resolved(self, graph, relpath, fname):
+        caller = next(f for f in graph.funcs
+                      if f.mod.relpath == relpath and f.name == fname)
+        return [callee for _call, callee in graph.direct_calls(caller)]
+
+    def test_reexport_chain_resolves_up_to_four_hops(self, tmp_path):
+        files = {"r5.py": "def f():\n    pass\n"}
+        for i in range(5):
+            files[f"r{i}.py"] = f"from r{i + 1} import f\n"
+        files["ok.py"] = "from r1 import f\n\ndef caller():\n    f()\n"
+        files["deep.py"] = "from r0 import f\n\ndef caller():\n    f()\n"
+        g = self._graph(tmp_path, files)
+        (ok,) = self._resolved(g, "ok.py", "caller")
+        assert ok is not None and ok.mod.relpath == "r5.py"
+        # One hop past the bound: unresolved, not wrong.
+        (deep,) = self._resolved(g, "deep.py", "caller")
+        assert deep is None
+
+    def test_reexport_cycle_resolves_to_none(self, tmp_path):
+        g = self._graph(tmp_path, {
+            "a.py": "from b import g\n",
+            "b.py": "from a import g\n",
+            "use.py": "from a import g\n\ndef caller():\n    g()\n",
+        })
+        (got,) = self._resolved(g, "use.py", "caller")
+        assert got is None  # bounded — and it terminated
+
+    def test_ambiguous_duplicate_defs_resolve_to_none(self, tmp_path):
+        g = self._graph(tmp_path, {"m.py": """\
+            def f():
+                pass
+
+            def f():
+                pass
+
+            def caller():
+                f()
+        """})
+        (got,) = self._resolved(g, "m.py", "caller")
+        assert got is None  # precision over recall
+
+    def test_self_method_resolves_through_imported_base(self, tmp_path):
+        g = self._graph(tmp_path, {
+            "base.py": """\
+                class Base:
+                    def ping(self):
+                        return 1
+            """,
+            "child.py": """\
+                from base import Base
+
+                class Child(Base):
+                    def caller(self):
+                        return self.ping()
+            """,
+        })
+        (got,) = self._resolved(g, "child.py", "caller")
+        assert got is not None
+        assert got.qualname == "Base.ping"
+        assert got.mod.relpath == "base.py"
+
+    def test_classname_method_resolves_through_local_subclass(
+            self, tmp_path):
+        g = self._graph(tmp_path, {"m.py": """\
+            class A:
+                def m(self):
+                    return 1
+
+            class B(A):
+                pass
+
+            def caller():
+                return B.m()
+        """})
+        (got,) = self._resolved(g, "m.py", "caller")
+        assert got is not None and got.qualname == "A.m"
+
+
 class TestCLI:
     def test_json_format_reports_findings(self, capsys):
         rc = lint_main([os.path.join(FIXTURES, "objectref_bad.py"),
@@ -460,6 +642,59 @@ class TestCLI:
 
     def test_changed_files_outside_a_repo_is_none(self, tmp_path):
         assert changed_files("HEAD", str(tmp_path / "norepo")) is None
+
+    def test_changed_only_without_git_degrades_to_full_scan(
+            self, capsys, monkeypatch):
+        import ray_tpu._private.lint.cli as cli_mod
+
+        monkeypatch.setattr(cli_mod, "changed_files",
+                            lambda base, root: None)
+        rc = lint_main([os.path.join(FIXTURES, "objectref_clean.py"),
+                        "--select", "objectref-leak", "--no-baseline",
+                        "--changed-only"])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "falling back to a full scan" in captured.err
+        assert "1 files" in captured.out  # the root was linted anyway
+
+    def test_sarif_format_matches_golden(self, capsys):
+        rc = lint_main([os.path.join(FIXTURES, "reentrancy_bad.py"),
+                        "--select", "actor-reentrancy", "--no-baseline",
+                        "--format", "sarif"])
+        got = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        with open(os.path.join(FIXTURES, "sarif_golden.json")) as f:
+            assert got == json.load(f)
+
+    def test_sarif_format_clean(self, capsys):
+        rc = lint_main([os.path.join(FIXTURES, "reentrancy_clean.py"),
+                        "--select", "actor-reentrancy", "--no-baseline",
+                        "--format", "sarif"])
+        got = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert got["version"] == "2.1.0"
+        assert got["runs"][0]["results"] == []
+
+    def test_prune_baseline_drops_stale_entries_only(self, tmp_path,
+                                                     capsys):
+        # Nothing in the repo matches the ghost entry, so a full run
+        # prunes it; the write goes to the temp path, not the real
+        # baseline.
+        path = tmp_path / "base.json"
+        path.write_text(json.dumps({"version": 1, "findings": [
+            {"rule": "ghost-rule", "path": "ray_tpu/nope.py",
+             "context": "x = 1", "justification": "long gone"}]}))
+        rc = lint_main(["--baseline", str(path), "--prune-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "1 stale entries removed, 0 kept" in out
+        assert json.loads(path.read_text())["findings"] == []
+
+    def test_prune_baseline_refuses_partial_runs(self, capsys):
+        rc = lint_main([os.path.join(FIXTURES, "objectref_clean.py"),
+                        "--prune-baseline"])
+        assert rc == 2
+        assert "full unfiltered run" in capsys.readouterr().err
 
 
 class TestLintBudget:

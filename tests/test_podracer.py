@@ -129,6 +129,32 @@ def test_inference_server_set_weights_stamps_version(ray_start_regular):
         ray_tpu.kill(server)
 
 
+def test_inference_server_rejects_stale_weight_install(ray_start_regular):
+    """A poll fetch stamped with an older version than a push that
+    landed during its awaits must be dropped — versions never move
+    backwards."""
+    import jax
+
+    env = CartPoleEnv()
+    spec = RLModuleSpec(env.observation_space, env.action_space,
+                        hidden=(8,))
+    module = spec.build()
+    params = jax.device_get(module.init(jax.random.key(7)))
+    server = InferenceServer.remote(spec, batch_wait_s=0.001)
+    try:
+        v = ray_tpu.get(server.set_weights.remote(params, 5), timeout=60)
+        assert v == 5
+        v = ray_tpu.get(server.set_weights.remote(params, 3), timeout=60)
+        assert v == 5  # stale install ignored, version unchanged
+        stats = ray_tpu.get(server.stats.remote(), timeout=30)
+        assert stats["weight_version"] == 5
+        assert stats["weight_pulls"] == 1
+        assert stats["stale_pulls"] == 1
+    finally:
+        ray_tpu.get(server.shutdown.remote(), timeout=30)
+        ray_tpu.kill(server)
+
+
 # --------------------------------------------------------- backpressure
 
 def test_feed_queue_backpressure(ray_start_regular):

@@ -165,3 +165,60 @@ def test_packaging_deterministic(tmp_path):
     (d / "a.py").write_text("A = 2\n")
     uri3, _ = packaging.package_dir(str(d))
     assert uri3 != uri1
+
+
+# ------------------------------------------------ package cache GC races
+
+def test_gc_never_evicts_inflight_creation(tmp_path):
+    """A URI whose per-URI creation lock is held is mid-download: GC
+    must not rmtree it out from under _ensure_package even though no
+    worker holds a ref yet."""
+    import asyncio
+
+    from ray_tpu.runtime_env.manager import RuntimeEnvManager
+
+    m = RuntimeEnvManager(str(tmp_path), None, cache_size_bytes=100)
+    m._sizes = {"gcs://pkg.zip": 500}  # over cap, no refs yet
+
+    async def gc_while_creating():
+        async with m._lock("gcs://pkg.zip"):
+            m._maybe_gc()
+
+    asyncio.run(gc_while_creating())
+    assert "gcs://pkg.zip" in m._sizes  # mid-creation: not a victim
+
+    m._maybe_gc()  # lock released, still unreferenced: normal eviction
+    assert "gcs://pkg.zip" not in m._sizes
+
+
+def test_fresh_package_is_last_eviction_candidate(tmp_path, monkeypatch):
+    """Creation stamps _last_used. Without the stamp a just-built
+    package has no recency entry, sorts as oldest, and GC can delete
+    it during the awaits between _ensure_package returning and setup()
+    taking the ref."""
+    import asyncio
+
+    from ray_tpu.runtime_env import packaging
+    from ray_tpu.runtime_env.manager import RuntimeEnvManager
+
+    async def fake_download(_gcs, _uri):
+        return b"x" * 64
+
+    def fake_unpack(_payload, dest):
+        os.makedirs(dest, exist_ok=True)
+        with open(os.path.join(dest, ".rtpu_pkg_ready"), "w") as f:
+            f.write("ok")
+
+    monkeypatch.setattr(packaging, "download_package", fake_download)
+    monkeypatch.setattr(packaging, "unpack_package", fake_unpack)
+
+    m = RuntimeEnvManager(str(tmp_path), None, cache_size_bytes=10 ** 6)
+    m._sizes["gcs://old.zip"] = 64
+    m._last_used["gcs://old.zip"] = 0.0
+    asyncio.run(m._ensure_package("gcs://fresh.zip"))
+    assert "gcs://fresh.zip" in m._last_used
+
+    m._cache_cap = 100  # both unreferenced; LRU must pick the idle one
+    m._maybe_gc()
+    assert "gcs://fresh.zip" in m._sizes
+    assert "gcs://old.zip" not in m._sizes
