@@ -161,6 +161,18 @@ _define("serve_kv_block_size", int, 16,
 _define("serve_router_probe_interval_s", float, 1.0,
         "Period of the LLM router's per-replica queue-depth probe; a "
         "stalled replica sheds traffic within about one period.")
+_define("serve_preempt_hold_s", float, 0.25,
+        "How long the interactive lane must stay starved (queued "
+        "request + no admissible slot) before the engine's Hysteresis "
+        "gate lets it checkpoint a batch decode — transient pressure "
+        "from one full tick never thrashes checkpoints.")
+_define("serve_preempt_cooldown_s", float, 1.0,
+        "Minimum spacing between batch-decode preemptions on one "
+        "engine (each checkpoint costs an export + a later re-adopt).")
+_define("serve_spec_k", int, 4,
+        "Speculative decoding depth for serve LLM engines built with a "
+        "draft model: spec_k - 1 draft proposals verified per round, "
+        "so each verify step emits 1..spec_k tokens.")
 _define("data_backpressure_interval_s", float, 1.0,
         "Minimum spacing between backpressure re-evaluations per "
         "executor (the tuner is pulled from the launch loop; this "

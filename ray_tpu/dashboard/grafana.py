@@ -112,6 +112,23 @@ _PANELS: List[Dict[str, str]] = [
     {"title": "Router requests per replica",
      "expr": "rate(rtpu_serve_router_requests_total[5m])",
      "legend": "{{replica}}", "unit": "short"},
+    # --- disaggregated serving (serve/llm/disagg) ---
+    {"title": "Lane queue depth",
+     "expr": "rtpu_serve_lane_queue_depth",
+     "legend": "{{lane}}", "unit": "short"},
+    {"title": "Batch-decode preemptions",
+     "expr": "rate(rtpu_serve_preemptions_total[5m])",
+     "legend": "{{lane}}", "unit": "short"},
+    {"title": "KV migration rate (blocks, bytes/sec)",
+     "expr": "rate(rtpu_serve_kv_migrated_blocks_total[5m])",
+     "expr_b": "rate(rtpu_serve_kv_migrated_bytes_total[5m])",
+     "unit": "short"},
+    {"title": "Speculative-decode accept ratio",
+     "expr": "rtpu_serve_spec_accept_ratio",
+     "unit": "percentunit"},
+    {"title": "Router lane routing",
+     "expr": "rate(rtpu_serve_router_lane_requests_total[5m])",
+     "legend": "{{lane}}/{{pool}}", "unit": "short"},
     # --- collectives (Pallas ICI backend + util.collective API) ---
     {"title": "Collective ops rate",
      "expr": "rate(rtpu_collective_ops_total[5m])",

@@ -217,7 +217,31 @@ class DashboardHead:
             "queue_depth": dict(router_depth.get("data", {})),
             "requests": dict(summary.get(
                 "serve_router_requests_total", {}).get("data", {})),
+            # Disagg pool split: series tagged (lane, pool) — how much
+            # traffic each SLO lane sent down the two-hop path.
+            "lane_requests": dict(summary.get(
+                "serve_router_lane_requests_total", {}).get("data", {})),
         }
+        # Disaggregated-serving rollup: KV migration volume between the
+        # prefill and decode pools, per-lane queue pressure +
+        # preemptions, and the speculative-decode acceptance ratio —
+        # the "is prefill stealing decode slots?" playbook numbers
+        # (PERF.md) in one fetch.
+        spec_prop = _total("serve_spec_proposed_tokens_total")
+        spec_acc = _total("serve_spec_accepted_tokens_total")
+        disagg: Dict[str, Any] = {
+            "kv_migrated_blocks": _total("serve_kv_migrated_blocks_total"),
+            "kv_migrated_bytes": _total("serve_kv_migrated_bytes_total"),
+            "lane_queue_depth": dict(summary.get(
+                "serve_lane_queue_depth", {}).get("data", {})),
+            "preemptions": dict(summary.get(
+                "serve_preemptions_total", {}).get("data", {})),
+            "spec_proposed": spec_prop,
+            "spec_accepted": spec_acc,
+        }
+        if spec_prop:
+            disagg["spec_accept_ratio"] = (spec_acc or 0.0) / spec_prop
+        summary["disagg"] = disagg
         return web.json_response(summary)
 
     async def rl_stats(self, _req) -> web.Response:

@@ -630,6 +630,105 @@ def decode_step_paged(params: Dict[str, Any], pools: Dict[str, jax.Array],
     return logits, {"k": new_k, "v": new_v}
 
 
+def verify_kv_paged(params: Dict[str, Any], pools: Dict[str, jax.Array],
+                    block_tables: jax.Array, tokens: jax.Array,
+                    positions: jax.Array, config: LlamaConfig,
+                    active: Optional[jax.Array] = None):
+    """K-token verify step for speculative decoding: tokens [B, K] are
+    consumed in parallel, token j of row b at absolute position
+    ``positions[b] + j``. Returns (logits [B, K, V], updated pools).
+
+    Row j's logits are the target model's distribution for the token
+    FOLLOWING input j — exactly what ``decode_step_paged`` would produce
+    after consuming inputs 0..j one at a time, because every op here is
+    row-independent (per-position matmuls, per-query masked softmax):
+    running K queries through one program instead of K programs changes
+    batching, not values. The engine exploits this for draft
+    verification: accept the longest prefix where the target's argmax
+    agrees with the draft, and greedy parity holds by construction.
+
+    All K KV writes scatter before the dense gather, so input j attends
+    to inputs i < j (their positions pass the ``key_pos <= pos + j``
+    mask) and never to inputs i > j. Rejected inputs leave stale rows
+    past the accepted position — the same stale-rows-overwritten-
+    before-attended invariant every other path in this file relies on.
+    ``active`` masks writes by pushing the physical block id out of
+    bounds, mirroring ``decode_step_paged``.
+    """
+    if config.n_experts:
+        raise NotImplementedError(
+            "paged KV-cache verify for MoE configs is not implemented")
+    c = config
+    NB, bs = pools["k"].shape[1], pools["k"].shape[2]
+    max_blocks = block_tables.shape[1]
+    S_pad = max_blocks * bs
+    cos, sin = rope_freqs(c.head_dim, S_pad, c.rope_theta)
+    B, K = tokens.shape
+    kd = c.head_dim
+    # Absolute position of every query; clamped so inactive rows with
+    # garbage positions still index rope/scatter safely (their writes
+    # are dropped and their logits ignored).
+    qpos = jnp.minimum(positions[:, None] + jnp.arange(K)[None, :],
+                       S_pad - 1)                            # [B, K]
+    pos_cos = cos[qpos]                                      # [B, K, D/2]
+    pos_sin = sin[qpos]
+
+    x = embed_lookup(params["embed"].astype(c.dtype), tokens)
+
+    def ropek(t):  # [B, K, H, D] rotated by per-(row, query) position
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        pc = pos_cos[:, :, None, :]
+        ps = pos_sin[:, :, None, :]
+        return jnp.concatenate(
+            [t1 * pc - t2 * ps, t2 * pc + t1 * ps], axis=-1).astype(t.dtype)
+
+    phys = block_tables[jnp.arange(B)[:, None], qpos // bs]  # [B, K]
+    if active is not None:
+        phys = jnp.where(active[:, None], phys, NB)  # OOB scatter drop
+    off = qpos % bs
+    scale = 1.0 / math.sqrt(kd)
+
+    def layer(carry, inputs):
+        x = carry
+        p, k_pool, v_pool = inputs
+        h = rms_norm(x, p["attn_norm"], c.norm_eps)
+        q = (h @ _weight(p, "wq", c.dtype)).reshape(B, K, c.n_heads, kd)
+        k = (h @ _weight(p, "wk", c.dtype)).reshape(B, K, c.n_kv_heads, kd)
+        v = (h @ _weight(p, "wv", c.dtype)).reshape(B, K, c.n_kv_heads, kd)
+        q, k = ropek(q), ropek(k)
+        k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
+        # Dense per-sequence view gathered AFTER all K writes: query j
+        # sees queries i < j through the position mask below.
+        k_dense = k_pool[block_tables].reshape(B, S_pad, c.n_kv_heads, kd)
+        v_dense = v_pool[block_tables].reshape(B, S_pad, c.n_kv_heads, kd)
+        rep = c.n_heads // c.n_kv_heads
+        kr = _repeat_kv(k_dense.astype(c.dtype), rep)
+        vr = _repeat_kv(v_dense.astype(c.dtype), rep)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(
+            jnp.float32) * scale
+        mask = (qpos[:, None, :, None]
+                >= jnp.arange(S_pad)[None, None, None, :])
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+        x = x + attn.reshape(B, K, -1) @ _weight(p, "wo", c.dtype)
+        h = rms_norm(x, p["ffn_norm"], c.norm_eps)
+        gate = jax.nn.silu(h @ _weight(p, "w_gate", c.dtype))
+        up = h @ _weight(p, "w_up", c.dtype)
+        x = x + (gate * up) @ _weight(p, "w_down", c.dtype)
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = lax.scan(
+        layer, x, (params["layers"], pools["k"], pools["v"]))
+    x = rms_norm(x, params["norm_f"], c.norm_eps)
+    head = lm_head_weight(params, c)
+    logits = jax.lax.dot_general(
+        x, head, (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [B, K, V]
+    return logits, {"k": new_k, "v": new_v}
+
+
 def prefill_kv_paged(params: Dict[str, Any], tokens: jax.Array,
                      start: jax.Array, hist_k: jax.Array,
                      hist_v: jax.Array, config: LlamaConfig):

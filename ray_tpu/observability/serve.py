@@ -111,6 +111,46 @@ class ServeMetrics:
             "serve_router_requests_total", tag_keys=("replica",),
             description="Requests forwarded per LLM replica by the "
                         "router's power-of-two-choices pick.")
+        # Disaggregated serving (serve/llm/disagg): KV-block migration
+        # between the prefill and decode pools, SLO lanes, and
+        # speculative decoding.
+        self.kv_migrated_blocks = Counter(
+            "serve_kv_migrated_blocks_total",
+            description="Paged KV blocks adopted into an engine's pool "
+                        "from an exported checkpoint (prefill->decode "
+                        "migration or preempt->resume).")
+        self.kv_migrated_bytes = Counter(
+            "serve_kv_migrated_bytes_total",
+            description="Bytes of KV payload adopted into an engine's "
+                        "pool from exported checkpoints.")
+        self.lane_queue_depth = Gauge(
+            "serve_lane_queue_depth", tag_keys=("lane",),
+            description="Requests waiting for a decode slot, split by "
+                        "SLO lane (interactive | batch).")
+        self.preemptions = Counter(
+            "serve_preemptions_total", tag_keys=("lane",),
+            description="Live decodes checkpointed and requeued to free "
+                        "a slot for the interactive lane, by the "
+                        "victim's lane.")
+        self.spec_proposed = Counter(
+            "serve_spec_proposed_tokens_total",
+            description="Draft tokens proposed by speculative-decode "
+                        "rounds (spec_k - 1 per live slot per round).")
+        self.spec_accepted = Counter(
+            "serve_spec_accepted_tokens_total",
+            description="Draft tokens accepted by the target verify "
+                        "step (the bonus token per round is not "
+                        "counted).")
+        self.spec_accept_ratio = Gauge(
+            "serve_spec_accept_ratio",
+            description="Lifetime accepted / proposed draft tokens for "
+                        "this engine (decode speedup is about "
+                        "1 + ratio * (spec_k - 1)).")
+        self.router_lane_requests = Counter(
+            "serve_router_lane_requests_total", tag_keys=("lane", "pool"),
+            description="Requests forwarded by the LLM router, split by "
+                        "SLO lane and destination pool (monolithic | "
+                        "prefill | decode).")
 
 
 def serve_metrics() -> ServeMetrics:

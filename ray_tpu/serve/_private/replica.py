@@ -37,6 +37,21 @@ class Replica:
                        kwargs: dict, model_id: str = "") -> Any:
         from ray_tpu.serve.multiplex import _reset_model_id, _set_model_id
 
+        # The (method_name, args, kwargs) envelope hides the logical
+        # call args from the worker's task-arg resolution, so give
+        # ObjectRef elements task-arg semantics here: materialize them
+        # in THIS process. This is the disagg two-hop's transfer seam —
+        # the router forwards a prefill replica's result ref untouched
+        # and the payload moves store-to-store, never through the
+        # router.
+        if any(isinstance(a, ray_tpu.ObjectRef) for a in args):
+            args = tuple(ray_tpu.get(a)
+                         if isinstance(a, ray_tpu.ObjectRef) else a
+                         for a in args)
+        if any(isinstance(v, ray_tpu.ObjectRef) for v in kwargs.values()):
+            kwargs = {k: ray_tpu.get(v)
+                      if isinstance(v, ray_tpu.ObjectRef) else v
+                      for k, v in kwargs.items()}
         with self._stats_lock:
             self._num_ongoing += 1
         token = _set_model_id(model_id)

@@ -4,20 +4,28 @@ The engine (`engine.py`) keeps a fixed pool of decode slots inside a
 bounded set of compiled XLA programs; the deployment (`deployment.py`)
 exposes it as a Serve replica; `kv_cache.py` pages the KV pool and
 reuses shared prompt prefixes; `router.py` spreads requests across N
-replicas on probed queue depth. See PERF.md "Serving throughput" and
-README "Paged KV cache & routing" for the design narrative and bench
-numbers.
+replicas on probed queue depth and SLO lane; `disagg/` splits prefill
+and decode onto separate replica pools with KV-block migration over
+the object store and speculative decoding on the decode side. See
+PERF.md "Serving throughput" and README "Paged KV cache & routing" /
+"Disaggregated serving" for the design narrative and bench numbers.
 """
 
 from ray_tpu.serve.llm.deployment import LLMServer, build_llm_app
+from ray_tpu.serve.llm.disagg import (
+    DecodeServer, KVExporter, KVImporter, PrefillServer,
+    build_disagg_llm_app,
+)
 from ray_tpu.serve.llm.engine import (
     EngineConfig, LLMEngine, Request, RequestHandle, static_batch_generate,
 )
-from ray_tpu.serve.llm.kv_cache import BlockAllocator, PrefixCache
+from ray_tpu.serve.llm.kv_cache import BlockAllocator, KVState, PrefixCache
 from ray_tpu.serve.llm.router import LLMRouter, build_routed_llm_app
 
 __all__ = [
-    "BlockAllocator", "EngineConfig", "LLMEngine", "LLMRouter",
-    "LLMServer", "PrefixCache", "Request", "RequestHandle",
-    "build_llm_app", "build_routed_llm_app", "static_batch_generate",
+    "BlockAllocator", "DecodeServer", "EngineConfig", "KVExporter",
+    "KVImporter", "KVState", "LLMEngine", "LLMRouter", "LLMServer",
+    "PrefillServer", "PrefixCache", "Request", "RequestHandle",
+    "build_disagg_llm_app", "build_llm_app", "build_routed_llm_app",
+    "static_batch_generate",
 ]

@@ -39,7 +39,8 @@ class LLMServer:
                  init_seed: int = 0,
                  params_loader: Optional[Any] = None,
                  quantize: Optional[str] = None,
-                 quantize_int8: bool = False):
+                 quantize_int8: bool = False,
+                 speculative: Any = None):
         import jax
 
         from ray_tpu.models.llama import (
@@ -70,7 +71,33 @@ class LLMServer:
         if quantize == "int8":
             params = quantize_weights_int8(params)
 
-        self._engine = LLMEngine(params, model_config, engine_config)
+        # Speculative decoding (disagg/spec.py): ``speculative`` is
+        # True (default draft geometry), a dict of draft kwargs
+        # ({"draft_seed": .., "draft_config": {..}, "params_loader":
+        # zero-arg callable}), or None to decode plainly. Weights load
+        # in-replica like the target's.
+        draft_params = draft_config = None
+        if speculative:
+            from ray_tpu.serve.llm.disagg.spec import (
+                build_draft, draft_config_for,
+            )
+
+            spec = speculative if isinstance(speculative, dict) else {}
+            dc = spec.get("draft_config")
+            if isinstance(dc, dict):
+                dc = LlamaConfig(**dc)
+            draft_config = dc or draft_config_for(model_config)
+            loader = spec.get("params_loader")
+            if loader is not None:
+                draft_params = loader()
+            else:
+                draft_params, draft_config = build_draft(
+                    model_config, seed=int(spec.get("draft_seed", 0)),
+                    draft_config=draft_config)
+
+        self._engine = LLMEngine(params, model_config, engine_config,
+                                 draft_params=draft_params,
+                                 draft_config=draft_config)
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._engine.run, args=(self._stop,),
@@ -90,7 +117,9 @@ class LLMServer:
             prompt=list(request["prompt"]),
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
-            stop=tuple(request.get("stop", ()))))
+            stop=tuple(request.get("stop", ())),
+            slo=str(request.get("slo", "interactive")),
+            chunked_prefill=bool(request.get("chunked_prefill", False))))
         with span("llm.server_call",
                   attrs={"prompt_len": len(request["prompt"])}):
             try:
@@ -116,6 +145,7 @@ class LLMServer:
             "queued": s["queued"],
             "active_slots": s["active_slots"],
             "free_slots": s["num_slots"] - s["active_slots"],
+            "lanes": s["queued_by_lane"],
         }
 
     def stats(self) -> Dict[str, Any]:

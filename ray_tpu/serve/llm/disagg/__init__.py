@@ -1,0 +1,41 @@
+"""Disaggregated LLM serving: prefill and decode on separate pools.
+
+The monolithic tier (serve/llm/deployment.py) runs prefill and decode
+in the same engine, so one long prefill stalls every decode slot behind
+it — the continuous-batching head-of-line failure. This package splits
+the two phases across replica pools and ships the only state that ties
+them together — the request's paged KV blocks — through the object
+store:
+
+- :class:`PrefillServer` runs prefill + the first sampled token and
+  exports the sequence as a :class:`~ray_tpu.serve.llm.kv_cache.KVState`
+  (dense per-layer block slices: plain ndarrays, so the object-store
+  put is zero-copy; on real pods this hop becomes an ICI transfer).
+- :class:`DecodeServer` adopts the blocks into its own
+  ``BlockAllocator`` — all-or-nothing — and continues decoding with
+  token-for-token parity to the monolithic path.
+- The router (serve/llm/router.py) passes the prefill result between
+  the pools **by ObjectRef**: the KV bytes move store-to-store and
+  never transit the router process.
+
+Speculative decoding (disagg/spec.py) rides along as the raw
+decode-speed lever for the decode pool: a tiny draft proposes
+``spec_k - 1`` tokens, one paged verify step on the target accepts the
+longest agreeing prefix — greedy parity by construction.
+"""
+
+from ray_tpu.serve.llm.disagg.app import build_disagg_llm_app
+from ray_tpu.serve.llm.disagg.decode import DecodeServer
+from ray_tpu.serve.llm.disagg.prefill import PrefillServer
+from ray_tpu.serve.llm.disagg.spec import build_draft, draft_config_for
+from ray_tpu.serve.llm.disagg.transfer import KVExporter, KVImporter
+
+__all__ = [
+    "KVExporter",
+    "KVImporter",
+    "PrefillServer",
+    "DecodeServer",
+    "build_disagg_llm_app",
+    "build_draft",
+    "draft_config_for",
+]

@@ -1,0 +1,49 @@
+"""Draft-model helpers for speculative decoding.
+
+The engine accepts any (draft_params, draft_config) pair whose
+tokenizer/vocab matches the target; these helpers build the standard
+one: a shrunk Llama sharing the target's vocab and rope geometry. The
+draft only needs to *rank* next tokens like the target often enough to
+pay for its own forward pass — acceptance is verified, so a bad draft
+costs speed, never correctness.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["draft_config_for", "build_draft"]
+
+
+def draft_config_for(config: Any, *, n_layers: int = 2,
+                     dim: int = 64, n_heads: int = 4,
+                     n_kv_heads: int = 2, hidden_dim: int = 128):
+    """A tiny draft config compatible with ``config``: same vocab,
+    sequence limit, rope theta and dtype (the draft's cache rows must
+    cover the same positions), everything else shrunk to the floors
+    the model code supports."""
+    import dataclasses
+
+    return dataclasses.replace(
+        config,
+        n_layers=min(n_layers, config.n_layers),
+        dim=min(dim, config.dim),
+        n_heads=min(n_heads, config.n_heads),
+        n_kv_heads=min(n_kv_heads, config.n_kv_heads),
+        hidden_dim=min(hidden_dim, config.hidden_dim),
+        n_experts=0,
+    )
+
+
+def build_draft(config: Any, seed: int = 0, draft_config: Any = None):
+    """(draft_params, draft_config) for ``config``. Random init — the
+    production hook is to pass a distilled checkpoint straight to
+    ``LLMEngine(draft_params=..., draft_config=...)``; this helper
+    exists for tests/benchmarks where acceptance rate is not the
+    subject."""
+    import jax
+
+    from ray_tpu.models.llama import init_params
+
+    dc = draft_config or draft_config_for(config)
+    return init_params(dc, jax.random.key(seed)), dc

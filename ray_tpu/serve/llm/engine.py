@@ -81,12 +81,40 @@ class EngineConfig:
     # oversubscribe HBM: admission queues on exhaustion, never crashes.
     num_kv_blocks: Optional[int] = None
     prefix_cache: bool = True       # paged only: prompt-prefix reuse
+    # Speculative decoding (paged only; armed by constructing the
+    # engine with draft_params/draft_config): the draft proposes
+    # spec_k - 1 tokens per round, one paged verify step accepts the
+    # longest target-agreeing prefix — 1..spec_k tokens per round with
+    # greedy parity by construction. None -> GlobalConfig.serve_spec_k.
+    spec_k: Optional[int] = None
+    # Batch-lane preemption hysteresis: interactive pressure must hold
+    # preempt_hold_s before a batch decode is checkpointed, and grants
+    # are spaced by preempt_cooldown_s (observability/control.py gate).
+    # None -> GlobalConfig.serve_preempt_{hold,cooldown}_s.
+    preempt_hold_s: Optional[float] = None
+    preempt_cooldown_s: Optional[float] = None
 
     def __post_init__(self):
+        from ray_tpu._private.config import GlobalConfig
+
         if self.decode_block < 1:
             raise ValueError("decode_block must be >= 1")
         if not self.prefill_buckets:
             raise ValueError("need at least one prefill bucket")
+        if self.spec_k is None:
+            object.__setattr__(self, "spec_k",
+                               int(GlobalConfig.serve_spec_k))
+        if self.spec_k < 2:
+            raise ValueError("spec_k must be >= 2 (one draft proposal "
+                             "plus the bonus target token)")
+        if self.preempt_hold_s is None:
+            object.__setattr__(
+                self, "preempt_hold_s",
+                float(GlobalConfig.serve_preempt_hold_s))
+        if self.preempt_cooldown_s is None:
+            object.__setattr__(
+                self, "preempt_cooldown_s",
+                float(GlobalConfig.serve_preempt_cooldown_s))
         b = tuple(sorted(set(int(x) for x in self.prefill_buckets)))
         object.__setattr__(self, "prefill_buckets", b)
         if b[-1] > self.max_seq_len:
@@ -98,8 +126,6 @@ class EngineConfig:
                 f"kv_layout must be 'dense' or 'paged', got "
                 f"{self.kv_layout!r}")
         if self.kv_block_size is None:
-            from ray_tpu._private.config import GlobalConfig
-
             object.__setattr__(self, "kv_block_size",
                                int(GlobalConfig.serve_kv_block_size))
         if self.kv_layout == "paged":
@@ -143,6 +169,20 @@ class Request:
     # Streaming hook: called as on_token(request_id, token_id) from the
     # engine loop as each token lands.
     on_token: Optional[Callable[[int, int], None]] = None
+    # SLO lane: "interactive" requests are admitted first and, under
+    # pressure, may preempt "batch" decodes (whose checkpoints resume
+    # later — see LLMEngine.preempt).
+    slo: str = "interactive"
+    # Stop after prefill + the first sampled token and export the KV
+    # state (handle.kv_state) instead of decoding — the disaggregated
+    # prefill tier's mode (serve/llm/disagg). Paged layout only.
+    prefill_only: bool = False
+    # Paged + prefix-cache engines: admit prompts longer than the
+    # largest bucket by prefilling bucket-sized chunks through the
+    # prefix cache (each chunk's blocks are cached, the next chunk
+    # prefix-hits them), one chunk per scheduler step — so interactive
+    # admissions interleave instead of stalling behind one long prefill.
+    chunked_prefill: bool = False
 
 
 class RequestHandle:
@@ -160,11 +200,30 @@ class RequestHandle:
         # epoch timestamps (timeline rows), latency math stays
         # monotonic.
         self.submitted_wall = time.time()
-        self.finish_reason: Optional[str] = None   # "eos"|"stop"|"length"
+        # "eos" | "stop" | "length" | "prefill" | "cancelled"
+        self.finish_reason: Optional[str] = None
+        # Exported KV checkpoint (kv_cache.KVState): set by prefill_only
+        # completion and by preemption; consumed by submit_adopted /
+        # readmission.
+        self.kv_state: Optional[Any] = None
         self._done = threading.Event()
+        self._engine: Optional["LLMEngine"] = None
+        self._chunk_ends: List[int] = []   # chunked-prefill boundaries
+        self._chunk_idx = 0
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def cancel(self) -> bool:
+        """Cancel the request: queued handles finish immediately with
+        finish_reason "cancelled"; a handle live in a decode slot is
+        torn down by the scheduler thread at its next step boundary,
+        releasing the slot's paged blocks and prefix-cache refs (the
+        reclaim path for client-abandoned requests). Returns False if
+        the request already finished."""
+        if self._done.is_set() or self._engine is None:
+            return False
+        return self._engine.cancel(self)
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self._done.wait(timeout):
@@ -210,7 +269,9 @@ class LLMEngine:
 
     def __init__(self, params: Any, model_config: Any,
                  engine_config: Optional[EngineConfig] = None,
-                 rng_seed: int = 0):
+                 rng_seed: int = 0,
+                 draft_params: Any = None,
+                 draft_config: Any = None):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -253,15 +314,51 @@ class LLMEngine:
         self._active = np.zeros((B,), bool)
         self._temp = np.zeros((B,), np.float32)
 
-        # Host-side scheduler state.
+        # Host-side scheduler state. One queue per SLO lane; admission
+        # drains "interactive" before "batch" (all queue accesses under
+        # _lock — submit/cancel are cross-thread).
         self._slots = [_Slot() for _ in range(B)]
         self._free: deque = deque(range(B))
-        self._queue: deque = deque()
+        self._queues: Dict[str, deque] = {"interactive": deque(),
+                                          "batch": deque()}
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._ids = itertools.count()
         self._completed = 0
         self._slot_reuses = 0
+        self._cancelled: set = set()    # request ids, guarded by _lock
+        self._admit_blocked = False     # interactive admission starved
+        self._preempted = 0
+        self._migrated_blocks = 0       # KVStates adopted into this pool
+        self._migrated_bytes = 0
+
+        from ray_tpu.observability.control import Hysteresis
+
+        self._preempt_gate = Hysteresis(
+            up_delay_s=c.preempt_hold_s, down_delay_s=0.0,
+            cooldown_s=c.preempt_cooldown_s)
+
+        # Speculative decoding: a small draft model proposing
+        # spec_k - 1 greedy tokens per round, verified in one paged
+        # K-token target step (models/llama.py::verify_kv_paged). The
+        # draft keeps a dense per-slot cache — it is tiny, so paging it
+        # would buy nothing.
+        self._draft = draft_params
+        self.draft_config = draft_config
+        self._spec_ok = np.zeros((B,), bool)
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        if draft_params is not None:
+            if not self._paged:
+                raise ValueError(
+                    "speculative decoding requires kv_layout='paged' "
+                    "(the verify step goes through block tables)")
+            if draft_config is None:
+                raise ValueError("draft_params given without "
+                                 "draft_config")
+            self._draft_cache = init_kv_cache(draft_config, B,
+                                              c.max_seq_len)
 
         # Compile tracking through the shared telemetry plane: the
         # TrackedJit probe runs ONLY when jax traces a new program, so
@@ -280,6 +377,23 @@ class LLMEngine:
                 self._insert_fn_paged, name="llm_engine_insert",
                 trace_budget=len(c.prefill_buckets),
                 donate_argnums=(1, 2, 3))
+            # KV migration programs (ONE trace each: block counts are
+            # data — padded ids, out-of-bounds scatters dropped).
+            self._jit_export = tracked_jit(
+                self._export_fn, name="llm_engine_export",
+                trace_budget=1)
+            self._jit_adopt = tracked_jit(
+                self._adopt_fn, name="llm_engine_adopt",
+                trace_budget=1, donate_argnums=(0, 1, 2))
+            if self._draft is not None:
+                self._jit_spec = tracked_jit(
+                    self._spec_fn, name="llm_engine_spec",
+                    trace_budget=1, donate_argnums=(2, 3, 5, 6))
+                self._jit_draft_insert = tracked_jit(
+                    self._draft_insert_fn,
+                    name="llm_engine_draft_insert",
+                    trace_budget=len(c.prefill_buckets),
+                    donate_argnums=(1,))
         else:
             self._jit_tick = tracked_jit(
                 self._tick_fn, name="llm_engine_tick", trace_budget=1,
@@ -435,38 +549,235 @@ class LLMEngine:
         pos = pos.at[slot].set(hist_len + suffix_len)
         return pools, tok, pos, key
 
+    def _export_fn(self, pools, table_row):
+        """Gather one slot's blocks into dense [L, max_blocks, bs,
+        n_kv, hd] arrays (the host slices the valid prefix). Read-only
+        on the pool; ONE trace regardless of how many blocks are live
+        (the table row is data)."""
+        return pools["k"][:, table_row], pools["v"][:, table_row]
+
+    def _adopt_fn(self, pools, tok, pos, kb, vb, scatter_ids, slot,
+                  new_tok, new_pos):
+        """Scatter an imported KVState's blocks into the pool at this
+        engine's freshly-allocated ids and seed the slot's token /
+        position. ``scatter_ids`` is padded to max_blocks with the pool
+        size (out-of-bounds scatters are dropped under jit), so ONE
+        compiled program serves every valid-block count."""
+        pools = {
+            "k": pools["k"].at[:, scatter_ids].set(kb),
+            "v": pools["v"].at[:, scatter_ids].set(vb),
+        }
+        tok = tok.at[slot].set(new_tok)
+        pos = pos.at[slot].set(new_pos)
+        return pools, tok, pos
+
+    def _draft_insert_fn(self, draft_params, dcache, padded_prompt,
+                         slot):
+        """Prefill the draft model's dense cache for one admitted slot
+        (always the FULL padded prompt — the draft has no prefix cache;
+        padding rows are stale-but-masked exactly like the dense
+        insert). One trace per prompt bucket."""
+        from jax import lax
+
+        from ray_tpu.models.llama import prefill_kv
+
+        dc = self.draft_config
+        _, ks, vs = prefill_kv(draft_params, padded_prompt[None], dc)
+        return {
+            "k": lax.dynamic_update_slice(
+                dcache["k"], ks.astype(dc.dtype), (0, slot, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(
+                dcache["v"], vs.astype(dc.dtype), (0, slot, 0, 0, 0)),
+        }
+
+    def _spec_fn(self, params, draft_params, pools, dcache, tables,
+                 tok, pos, active):
+        """One speculative round (greedy lanes only): the draft
+        proposes spec_k - 1 tokens from its dense cache, ONE paged
+        verify step scores all spec_k inputs on the target, and the
+        longest draft prefix agreeing with the target argmax is
+        accepted. Every emitted token IS the target's argmax given
+        correct inputs, so a round is token-identical to 1..spec_k
+        plain ticks — a zero-accept round still emits the one token a
+        plain tick would have. Rejected inputs leave stale rows past
+        the new position in both caches; both are overwritten before
+        ever being attended (the recycled-slot invariant)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ray_tpu.models.llama import decode_step, verify_kv_paged
+
+        c = self.config
+        K = c.spec_k
+        S = c.max_seq_len
+        B = tok.shape[0]
+
+        def draft_body(carry, _):
+            dcache, dtok, dpos = carry
+            dlogits, dcache = decode_step(
+                draft_params, dcache, dtok, dpos, self.draft_config,
+                active=active)
+            nxt = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            dtok = jnp.where(active, nxt, dtok)
+            dpos = jnp.where(active, jnp.minimum(dpos + 1, S - 1), dpos)
+            return (dcache, dtok, dpos), dtok
+
+        (dcache, _, _), drafts = lax.scan(
+            draft_body, (dcache, tok, pos), None, length=K - 1)
+        # Verify inputs: the accepted stream so far ends at `tok`
+        # (sampled, unconsumed); the draft continues it. [B, K]
+        inputs = jnp.concatenate([tok[None], drafts], axis=0).T
+        logits, pools = verify_kv_paged(
+            params, pools, tables, inputs, pos, self.model_config,
+            active=active)
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [B, K]
+        # Draft token j+1 survives iff the target's argmax after input
+        # j equals it; acceptance is the leading run of agreements.
+        agree = (t[:, :-1] == drafts.T).astype(jnp.int32)    # [B, K-1]
+        acc = jnp.cumprod(agree, axis=1).sum(axis=1)         # 0..K-1
+        n_emit = jnp.where(active, acc + 1, 0)
+        new_tok = t[jnp.arange(B), jnp.maximum(n_emit, 1) - 1]
+        tok = jnp.where(active, new_tok, tok)
+        pos = jnp.where(active, jnp.minimum(pos + n_emit, S - 1), pos)
+        return pools, dcache, tok, pos, t, n_emit
+
     # ----------------------------------------------------------- submission
 
     def submit(self, request: Request) -> RequestHandle:
-        if len(request.prompt) == 0:
+        c = self.config
+        P = len(request.prompt)
+        top = c.prefill_buckets[-1]
+        if P == 0:
             raise ValueError("empty prompt")
-        if len(request.prompt) > self.config.prefill_buckets[-1]:
-            raise ValueError(
-                f"prompt length {len(request.prompt)} exceeds largest "
-                f"prefill bucket {self.config.prefill_buckets[-1]}")
         if request.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
+        if request.slo not in ("interactive", "batch"):
+            raise ValueError(
+                f"slo must be 'interactive' or 'batch', got "
+                f"{request.slo!r}")
+        if request.prefill_only and not self._paged:
+            raise ValueError(
+                "prefill_only requires kv_layout='paged' (the exported "
+                "checkpoint is a set of KV blocks)")
+        chunked = request.chunked_prefill and P > top
+        handle = RequestHandle(next(self._ids), request)
+        if chunked:
+            if not (self._paged and self._prefix is not None):
+                raise ValueError(
+                    "chunked_prefill needs kv_layout='paged' with "
+                    "prefix_cache=True (chunks hand off through the "
+                    "prefix cache)")
+            if P >= c.max_seq_len or -(-P // top) * top > c.max_seq_len:
+                raise ValueError(
+                    f"prompt length {P} cannot be chunk-prefilled: "
+                    f"ceil({P}/{top}) bucket-sized chunks exceed "
+                    f"max_seq_len {c.max_seq_len}")
+            handle._chunk_ends = list(range(top, P, top)) + [P]
+        elif P > top:
+            raise ValueError(
+                f"prompt length {P} exceeds largest prefill bucket "
+                f"{top} (set chunked_prefill=True on a paged + "
+                f"prefix-cache engine)")
         if self._paged:
             # A request the pool can never hold must fail loudly at
             # submit — queuing it would deadlock admission forever.
-            worst = self._blocks_needed(len(request.prompt),
-                                        request.max_tokens)
+            worst = self._blocks_needed(P, request.max_tokens)
             worst = max(worst,
-                        self._bucket_for(len(request.prompt))
-                        // self.config.kv_block_size)
-            if worst > self.config.pool_blocks:
+                        self._bucket_for(min(P, top))
+                        // c.kv_block_size)
+            if worst > c.pool_blocks:
                 raise ValueError(
                     f"request needs up to {worst} KV blocks but the "
-                    f"pool only has {self.config.pool_blocks}; raise "
+                    f"pool only has {c.pool_blocks}; raise "
                     f"num_kv_blocks or lower max_tokens")
-        handle = RequestHandle(next(self._ids), request)
+        handle._engine = self
         with self._lock:
-            self._queue.append(handle)
+            self._queues[request.slo].append(handle)
         self._work.set()
         return handle
 
+    def submit_adopted(self, request: Request, state: Any, *,
+                       front: bool = False) -> RequestHandle:
+        """Submit a request whose prefill already ran elsewhere: `state`
+        is the kv_cache.KVState exported by the prefill tier (or by
+        preemption). Admission imports the blocks into this engine's
+        pool and decoding continues exactly where the checkpoint
+        stopped — token-for-token what a monolithic engine would have
+        produced. `front=True` queues at the lane head (resume
+        semantics)."""
+        from ray_tpu.serve.llm.kv_cache import KVState
+
+        c = self.config
+        if not self._paged:
+            raise ValueError("submit_adopted requires kv_layout='paged'")
+        if not isinstance(state, KVState):
+            raise TypeError(f"expected KVState, got {type(state)!r}")
+        state.validate()
+        if state.block_size != c.kv_block_size:
+            raise ValueError(
+                f"KVState block_size {state.block_size} != engine "
+                f"kv_block_size {c.kv_block_size}")
+        if list(request.prompt) != list(state.prompt):
+            raise ValueError(
+                "request.prompt does not match the exported KVState "
+                "prompt (the checkpoint is prompt-specific)")
+        if request.max_tokens <= len(state.tokens):
+            raise ValueError(
+                f"max_tokens {request.max_tokens} already reached by "
+                f"the checkpoint ({len(state.tokens)} tokens)")
+        if request.slo not in ("interactive", "batch"):
+            raise ValueError(
+                f"slo must be 'interactive' or 'batch', got "
+                f"{request.slo!r}")
+        need = max(self._blocks_needed(len(request.prompt),
+                                       request.max_tokens),
+                   state.n_blocks)
+        if need > c.pool_blocks:
+            raise ValueError(
+                f"adopted request needs up to {need} KV blocks but the "
+                f"pool only has {c.pool_blocks}")
+        handle = RequestHandle(next(self._ids), request)
+        handle._engine = self
+        handle.tokens = list(state.tokens)
+        handle.kv_state = state
+        with self._lock:
+            q = self._queues[request.slo]
+            if front:
+                q.appendleft(handle)
+            else:
+                q.append(handle)
+        self._work.set()
+        return handle
+
+    def cancel(self, handle: RequestHandle) -> bool:
+        """Cancel a submitted request. Queued handles finish here;
+        live handles are marked and torn down by the scheduler thread
+        at its next step boundary (slot + blocks + prefix refs all
+        released there, on the only thread that owns device state)."""
+        with self._lock:
+            if handle._done.is_set():
+                return False
+            for q in self._queues.values():
+                if handle in q:
+                    q.remove(handle)
+                    break
+            else:
+                self._cancelled.add(handle.request_id)
+                self._work.set()
+                return True
+        self._finish_cancelled(handle)
+        return True
+
+    def _finish_cancelled(self, handle: RequestHandle) -> None:
+        handle.finish_reason = "cancelled"
+        handle.finished_at = time.monotonic()
+        self._completed += 1
+        self._record_finished(handle)
+        handle._done.set()
+
     def has_work(self) -> bool:
-        return bool(self._queue) or bool(self._active.any())
+        return (any(self._queues.values()) or bool(self._active.any())
+                or bool(self._cancelled))
 
     # ------------------------------------------------------------ scheduling
 
@@ -478,36 +789,84 @@ class LLMEngine:
 
     def _blocks_needed(self, prompt_len: int, max_tokens: int) -> int:
         """Blocks covering every position this request can ever write:
-        prompt + generated tokens + up to decode_block - 1 speculative
-        writes after the stop condition, capped at the sequence limit
-        (positions clamp at S - 1)."""
+        prompt + generated tokens + up to decode_block - 1 (or
+        spec_k - 1 when a draft model is wired — a verify step writes
+        spec_k rows) speculative writes after the stop condition,
+        capped at the sequence limit (positions clamp at S - 1)."""
         c = self.config
-        top = min(prompt_len + max_tokens + c.decode_block - 1,
-                  c.max_seq_len)
+        over = max(c.decode_block,
+                   c.spec_k if self._draft is not None else 1)
+        top = min(prompt_len + max_tokens + over - 1, c.max_seq_len)
         return -(-top // c.kv_block_size)
 
-    def _admit(self) -> List[int]:
+    def _pop_next(self) -> Optional[RequestHandle]:
+        """Next admissible handle, interactive lane first (strict
+        priority; batch only drains when interactive is empty)."""
+        with self._lock:
+            for lane in ("interactive", "batch"):
+                if self._queues[lane]:
+                    return self._queues[lane].popleft()
+        return None
+
+    def _requeue(self, handle: RequestHandle, *,
+                 front: bool = True) -> None:
+        with self._lock:
+            q = self._queues[handle.request.slo]
+            if front:
+                q.appendleft(handle)
+            else:
+                q.append(handle)
+
+    def _admit(self) -> List[Tuple[int, bool]]:
         """Move queued requests into free slots (one prefill each);
-        returns the slots inserted this step. Paged layout: admission
-        additionally needs blocks — on pool exhaustion the request goes
-        BACK to the queue head and admission stops (requests queue,
-        never crash; blocks free as running sequences finish)."""
+        returns (slot, fresh) pairs inserted this step — `fresh` is
+        False for adopted checkpoints, whose last sampled token was
+        already emitted by the exporting engine. Paged layout:
+        admission additionally needs blocks — on pool exhaustion the
+        request goes BACK to the lane head and admission stops
+        (requests queue, never crash; blocks free as running sequences
+        finish). Chunked-prefill intermediates are throwaway
+        admissions (KV lands in the prefix cache, the slot is reused
+        immediately) rate-limited to one chunk per step so interactive
+        admissions interleave with a long prefill."""
         import numpy as np
 
-        inserted = []
+        inserted: List[Tuple[int, bool]] = []
+        chunk_budget = 1
         while self._free:
-            with self._lock:
-                if not self._queue:
-                    break
-                handle = self._queue.popleft()
-            slot = self._free.popleft()
-            req = handle.request
-            if self._paged and not self._admit_paged(handle, slot):
-                self._free.appendleft(slot)
-                with self._lock:
-                    self._queue.appendleft(handle)
+            handle = self._pop_next()
+            if handle is None:
                 break
-            if not self._paged:
+            if handle._done.is_set():
+                continue   # cancelled while queued by a racing cancel()
+            req = handle.request
+            if handle._chunk_ends and \
+                    handle._chunk_idx < len(handle._chunk_ends) - 1:
+                # Intermediate chunk: prefill prompt[:end] through the
+                # prefix cache and free the slot again. Budget of one
+                # chunk per step keeps the lane responsive.
+                if chunk_budget == 0:
+                    self._requeue(handle)
+                    break
+                end = handle._chunk_ends[handle._chunk_idx]
+                slot = self._free[0]
+                if not self._admit_paged(handle, slot, upto=end,
+                                         throwaway=True):
+                    self._requeue(handle)
+                    if req.slo == "interactive":
+                        self._admit_blocked = True
+                    break
+                chunk_budget -= 1
+                handle._chunk_idx += 1
+                self._requeue(handle)
+                continue
+            slot = self._free.popleft()
+            fresh = handle.kv_state is None
+            if not fresh:
+                ok = self._admit_adopted(handle, slot)
+            elif self._paged:
+                ok = self._admit_paged(handle, slot)
+            else:
                 P = len(req.prompt)
                 bucket = self._bucket_for(P)
                 padded = np.zeros((bucket,), np.int32)
@@ -517,9 +876,19 @@ class LLMEngine:
                         self.params, self._cache, self._tok, self._pos,
                         padded, np.int32(P), np.int32(slot),
                         np.float32(req.temperature), self._key)
-            handle.admitted_at = time.monotonic()
-            self._metrics.queue_wait.observe(
-                handle.admitted_at - handle.submitted_at)
+                ok = True
+            if not ok:
+                self._free.appendleft(slot)
+                if req.slo == "interactive":
+                    self._admit_blocked = True
+                self._requeue(handle)
+                break
+            if self._draft is not None and fresh:
+                self._draft_admit(list(req.prompt), slot)
+            if handle.admitted_at is None:
+                handle.admitted_at = time.monotonic()
+                self._metrics.queue_wait.observe(
+                    handle.admitted_at - handle.submitted_at)
             st = self._slots[slot]
             if st.uses:
                 self._slot_reuses += 1
@@ -528,27 +897,53 @@ class LLMEngine:
             st.handle = handle
             self._active[slot] = True
             self._temp[slot] = req.temperature
-            inserted.append(slot)
+            inserted.append((slot, fresh))
         return inserted
 
-    def _admit_paged(self, handle: RequestHandle, slot: int) -> bool:
+    def _admit_paged(self, handle: RequestHandle, slot: int,
+                     upto: Optional[int] = None,
+                     throwaway: bool = False) -> bool:
         """Block accounting + paged insert for one request. Returns
         False (nothing allocated, nothing inserted) when the pool can't
-        cover it even after evicting cold prefix entries."""
+        cover it even after evicting cold prefix entries.
+
+        `upto` prefills only prompt[:upto] (a chunked-prefill chunk);
+        `throwaway` additionally keeps the slot free — the KV outlives
+        the admission only through the prefix-cache refs taken at
+        insert, so the next chunk (or the final admission) prefix-hits
+        it. The sampled token of a throwaway insert is garbage by
+        construction and never read: the slot stays inactive, so the
+        tick masks it and the final admission overwrites tok/pos."""
         import numpy as np
 
         req = handle.request
         c = self.config
         bs = c.kv_block_size
-        P = len(req.prompt)
-        need_total = self._blocks_needed(P, req.max_tokens)
+        prompt = req.prompt if upto is None else req.prompt[:upto]
+        P = len(prompt)
+        if throwaway:
+            # Only the chunk itself; headroom is the FINAL admission's
+            # problem (these blocks are cache-owned the moment the
+            # insert returns).
+            need_total = -(-P // bs)
+        else:
+            need_total = self._blocks_needed(P, req.max_tokens)
 
         # Longest cached prefix, capped so the LAST prompt token is
         # always prefilled (its logits seed the first sampled token).
         hit_blocks: List[int] = []
         if self._prefix is not None:
-            hit_blocks = self._prefix.match(req.prompt,
+            hit_blocks = self._prefix.match(prompt,
                                             max_blocks=(P - 1) // bs)
+        if P - len(hit_blocks) * bs > c.prefill_buckets[-1]:
+            # Chunked-prefill continuation whose earlier chunks were
+            # evicted from the prefix cache before this admission: the
+            # remaining suffix no longer fits any bucket. Rewind the
+            # chunk plan to what the cache still covers and re-chunk.
+            self._allocator.free(hit_blocks)
+            handle._chunk_idx = (len(hit_blocks) * bs) \
+                // c.prefill_buckets[-1]
+            return False
         # Trim the hit so history + the padded suffix bucket still fit
         # in the slot's table (a shallow hit on a near-max prompt can
         # otherwise push the bucket's whole-block scatter past S).
@@ -577,11 +972,12 @@ class LLMEngine:
         blocks = hit_blocks + new_blocks
         row = np.zeros((c.max_blocks_per_slot,), np.int32)
         row[:len(blocks)] = blocks
-        self._tables[slot] = row
-        self._slot_blocks[slot] = blocks
+        if not throwaway:
+            self._tables[slot] = row
+            self._slot_blocks[slot] = blocks
 
         padded = np.zeros((bucket,), np.int32)
-        padded[:suffix_len] = np.asarray(req.prompt[hist_len:], np.int32)
+        padded[:suffix_len] = np.asarray(prompt[hist_len:], np.int32)
         scatter_ids = np.asarray(new_blocks[:bucket // bs], np.int32)
         self._cache, self._tok, self._pos, self._key = \
             self._jit_insert(
@@ -594,8 +990,111 @@ class LLMEngine:
             # next request sharing this prefix skips their prefill.
             full = P // bs
             if full:
-                self._prefix.insert(req.prompt, blocks[:full])
+                self._prefix.insert(prompt, blocks[:full])
+        if throwaway:
+            # The prefix cache now owns the chunk's full blocks (insert
+            # increfed them); drop this admission's transient refs. The
+            # slot was never activated, so its garbage tok/pos rows are
+            # masked by the tick and overwritten at final admission.
+            self._allocator.free(blocks)
         return True
+
+    def _admit_adopted(self, handle: RequestHandle, slot: int) -> bool:
+        """Import a KVState checkpoint into this engine's pool and
+        resume the sequence in `slot`. All-or-nothing: either every
+        block the sequence can ever need is allocated (evicting cold
+        prefix entries if that closes the gap) and the scatter runs, or
+        nothing changes and the request stays queued. ONE adopt trace
+        serves every valid-block count — kb/vb are zero-padded to
+        max_blocks_per_slot and the scatter ids of padding rows point
+        one past the pool (out-of-bounds writes drop under jit)."""
+        import numpy as np
+
+        req = handle.request
+        st = handle.kv_state
+        c = self.config
+        bs = c.kv_block_size
+        n_valid = st.n_blocks
+        need_total = max(
+            self._blocks_needed(len(req.prompt), req.max_tokens),
+            n_valid)
+        blocks = self._allocator.adopt(need_total, self._prefix)
+        if blocks is None:
+            return False
+        row = np.zeros((c.max_blocks_per_slot,), np.int32)
+        row[:need_total] = blocks
+        self._tables[slot] = row
+        self._slot_blocks[slot] = blocks
+
+        nb = c.max_blocks_per_slot
+        # Padding rows scatter to pool_blocks (out of bounds → dropped).
+        ids = np.full((nb,), c.pool_blocks, np.int32)
+        ids[:n_valid] = blocks[:n_valid]
+        kb = np.zeros((st.k_blocks.shape[0], nb) + st.k_blocks.shape[2:],
+                      st.k_blocks.dtype)
+        vb = np.zeros_like(kb)
+        kb[:, :n_valid] = st.k_blocks
+        vb[:, :n_valid] = st.v_blocks
+        self._cache, self._tok, self._pos = self._jit_adopt(
+            self._cache, self._tok, self._pos, kb, vb, ids,
+            np.int32(slot), np.int32(st.next_tok), np.int32(st.pos))
+        if self._prefix is not None:
+            # Shared prompts stay warm across the migration: register
+            # the prompt's FULL blocks exactly like a fresh admission.
+            full = len(req.prompt) // bs
+            full = min(full, n_valid)
+            if full:
+                self._prefix.insert(req.prompt, blocks[:full])
+        self._migrated_blocks += n_valid
+        self._migrated_bytes += st.payload_bytes
+        self._metrics.kv_migrated_blocks.inc(float(n_valid))
+        self._metrics.kv_migrated_bytes.inc(float(st.payload_bytes))
+        handle.kv_state = None
+        if self._draft is not None:
+            # The draft cache never migrated: re-prefill it with
+            # everything the sequence has consumed so far.
+            self._draft_admit(
+                list(req.prompt) + list(handle.tokens[:-1]), slot)
+        return True
+
+    def _draft_admit(self, consumed: List[int], slot: int) -> None:
+        """Prefill the draft model's dense cache with a slot's consumed
+        tokens (prompt, plus prior output for adopted sequences). A
+        sequence whose consumed length exceeds the largest bucket
+        cannot seed the draft in one insert — it simply decodes without
+        speculation (spec_ok stays False; the plain tick handles it)."""
+        import numpy as np
+
+        n = len(consumed)
+        if n > self.config.prefill_buckets[-1]:
+            self._spec_ok[slot] = False
+            return
+        bucket = self._bucket_for(n)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:n] = np.asarray(consumed, np.int32)
+        self._draft_cache = self._jit_draft_insert(
+            self._draft, self._draft_cache, padded, np.int32(slot))
+        self._spec_ok[slot] = True
+
+    def _release_slot(self, slot: int, donate: bool = False) -> None:
+        """Clear a slot's scheduler state and reclaim its blocks.
+        `donate=True` hands the blocks to a pending checkpoint (export
+        already copied the data; `BlockAllocator.donate` asserts the
+        refs are live) instead of plain freeing."""
+        st = self._slots[slot]
+        st.handle = None
+        self._active[slot] = False
+        self._temp[slot] = 0.0
+        self._spec_ok[slot] = False
+        if self._paged and self._slot_blocks[slot]:
+            # Drop this sequence's refs; blocks shared with the prefix
+            # cache (or other sequences) stay resident.
+            if donate:
+                self._allocator.donate(self._slot_blocks[slot])
+            else:
+                self._allocator.free(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+        self._free.append(slot)
 
     def _emit(self, slot: int, token: int) -> None:
         """Record one generated token for `slot`; free the slot when the
@@ -630,18 +1129,155 @@ class LLMEngine:
         if reason is not None:
             handle.finish_reason = reason
             handle.finished_at = now
-            st.handle = None
-            self._active[slot] = False
-            self._temp[slot] = 0.0
-            if self._paged and self._slot_blocks[slot]:
-                # Drop this sequence's refs; blocks shared with the
-                # prefix cache (or other sequences) stay resident.
-                self._allocator.free(self._slot_blocks[slot])
-                self._slot_blocks[slot] = []
-            self._free.append(slot)
+            self._release_slot(slot)
             self._completed += 1
             self._record_finished(handle)
             handle._done.set()
+
+    def _finish_prefill(self, slot: int, token: int) -> None:
+        """Prefill-only completion: record the first sampled token,
+        export the slot's KV blocks as the handle's checkpoint, and
+        free the slot. A request that already terminates at its first
+        token (stop/eos/length) finishes with that reason instead —
+        the decode tier has nothing left to do and the router skips
+        the migration hop."""
+        st = self._slots[slot]
+        handle = st.handle
+        req = handle.request
+        now = time.monotonic()
+        reason = None
+        if token in req.stop:
+            reason = "stop"
+        else:
+            handle.tokens.append(token)
+            handle.first_token_at = now
+            if (self.config.eos_id is not None
+                    and token == self.config.eos_id):
+                reason = "eos"
+            elif req.max_tokens <= 1 or \
+                    len(req.prompt) + 1 >= self.config.max_seq_len:
+                reason = "length"
+        donate = False
+        if reason is None:
+            handle.kv_state = self._export_state(slot)
+            reason = "prefill"
+            donate = True
+        handle.finish_reason = reason
+        handle.finished_at = now
+        self._release_slot(slot, donate=donate)
+        self._completed += 1
+        self._record_finished(handle)
+        handle._done.set()
+
+    def _export_state(self, slot: int) -> Any:
+        """Snapshot a live slot's sequence as a host-side KVState:
+        dense copies of its valid KV blocks + the resume bookkeeping
+        (consumed position, pending sampled token). ONE gather trace
+        for every block count — the table row is data; the host slices
+        the valid prefix."""
+        import numpy as np
+
+        from ray_tpu.serve.llm.kv_cache import KVState
+
+        handle = self._slots[slot].handle
+        req = handle.request
+        bs = self.config.kv_block_size
+        pos = int(np.asarray(self._pos)[slot])
+        next_tok = int(np.asarray(self._tok)[slot])
+        n_valid = -(-pos // bs)
+        kb, vb = self._jit_export(self._cache,
+                                  self._tables[slot].copy())
+        state = KVState(
+            prompt=list(req.prompt),
+            tokens=list(handle.tokens),
+            next_tok=next_tok,
+            pos=pos,
+            temperature=req.temperature,
+            block_size=bs,
+            k_blocks=np.asarray(kb)[:, :n_valid].copy(),
+            v_blocks=np.asarray(vb)[:, :n_valid].copy(),
+        )
+        state.validate()
+        return state
+
+    def preempt(self, slot: int) -> None:
+        """Checkpoint a live slot and requeue it at its lane head: the
+        sequence's KV blocks are exported onto the handle
+        (handle.kv_state), the slot and blocks are released, and the
+        next admission resumes decoding through the adopt path — the
+        preempt → resume cycle is token-invisible to the client."""
+        if not self._paged:
+            raise ValueError("preempt requires kv_layout='paged'")
+        st = self._slots[slot]
+        handle = st.handle
+        if handle is None:
+            raise ValueError(f"slot {slot} is not live")
+        handle.kv_state = self._export_state(slot)
+        self._release_slot(slot, donate=True)
+        self._preempted += 1
+        self._metrics.preemptions.inc(
+            tags={"lane": handle.request.slo})
+        self._requeue(handle, front=True)
+
+    def _maybe_preempt(self) -> None:
+        """Preemption policy, gated by the PR-7 Hysteresis controller:
+        when interactive requests are waiting and admission is starved
+        (no free slot, or the pool rejected an interactive admission
+        last step), checkpoint the NEWEST-admitted batch decode — it
+        has the least sunk prefill work per token emitted. The
+        hold/cooldown gate means transient pressure (one tick of a
+        full batch) never thrashes checkpoints."""
+        if not self._paged:
+            return
+        with self._lock:
+            waiting = len(self._queues["interactive"])
+        if not waiting:
+            self._preempt_gate.propose(0, 0)
+            return
+        batch_slots = [
+            s for s in range(self.config.num_slots)
+            if self._slots[s].handle is not None
+            and self._slots[s].handle.request.slo == "batch"
+            and not self._slots[s].handle.request.prefill_only
+        ]
+        pressure = bool(batch_slots) and (
+            not self._free or self._admit_blocked)
+        if self._preempt_gate.propose(0, 1 if pressure else 0) != 1:
+            return
+        victim = max(batch_slots,
+                     key=lambda s: self._slots[s].handle.admitted_at)
+        try:
+            from ray_tpu.observability.control import record_decision
+
+            record_decision(
+                "llm_engine", "preempt",
+                "interactive lane starved; checkpointing newest batch "
+                "decode", float(waiting), slot=victim)
+        except Exception:
+            pass
+        self.preempt(victim)
+
+    def _process_cancels(self) -> None:
+        """Tear down cancelled requests on the scheduler thread (the
+        only thread allowed to touch slots/blocks): live slots are
+        released, requeued checkpoints are dropped."""
+        with self._lock:
+            if not self._cancelled:
+                return
+            ids, self._cancelled = self._cancelled, set()
+            requeued = []
+            for q in self._queues.values():
+                for h in list(q):
+                    if h.request_id in ids:
+                        q.remove(h)
+                        requeued.append(h)
+        for h in requeued:
+            self._finish_cancelled(h)
+        for slot in range(self.config.num_slots):
+            h = self._slots[slot].handle
+            if h is not None and h.request_id in ids:
+                self._release_slot(slot)
+                self._finish_cancelled(h)
 
     def _record_finished(self, handle: RequestHandle) -> None:
         """Latency histograms + per-request lifecycle spans
@@ -684,22 +1320,47 @@ class LLMEngine:
             pass  # telemetry must never break the scheduler
 
     def step(self) -> bool:
-        """One scheduler iteration: admit queued requests into free
-        slots (prefill + first token each), then one decode tick for
-        every live slot. Returns True if any work was done."""
+        """One scheduler iteration: process cancellations, apply the
+        preemption policy, admit queued requests into free slots
+        (prefill + first token each; prefill_only requests finish here
+        with their checkpoint), then one decode tick — speculative when
+        every live slot qualifies, plain otherwise — for every live
+        slot. Returns True if any work was done."""
         import numpy as np
 
+        did_cancel = bool(self._cancelled)
+        self._process_cancels()
+        self._maybe_preempt()
+        self._admit_blocked = False
         inserted = self._admit()
         if inserted:
-            # First generated token per inserted slot (before the tick
-            # below overwrites it with the second).
+            # First generated token per freshly-prefilled slot (before
+            # the tick below overwrites it with the second). Adopted
+            # slots skip this: their pending token was emitted by the
+            # exporting engine already.
             tok_host = np.asarray(self._tok)
-            for slot in inserted:
-                self._emit(slot, int(tok_host[slot]))
+            for slot, fresh in inserted:
+                if not fresh:
+                    continue
+                if self._slots[slot].handle.request.prefill_only:
+                    self._finish_prefill(slot, int(tok_host[slot]))
+                else:
+                    self._emit(slot, int(tok_host[slot]))
         if not self._active.any():
             self._update_gauges()
-            return bool(inserted)
+            return bool(inserted) or did_cancel
         live = np.nonzero(self._active)[0]
+        if self._spec_ready(live):
+            toks_host, n_emit = self._spec_tick()
+            for slot in live:
+                s = int(slot)
+                for k in range(int(n_emit[s])):
+                    if self._slots[s].handle is None:
+                        break      # finished earlier in the round —
+                        #            remaining tokens were speculative
+                    self._emit(s, int(toks_host[k, s]))
+            self._update_gauges()
+            return True
         if self._paged:
             self._cache, self._tok, self._pos, self._key, toks = \
                 self._jit_tick(
@@ -722,10 +1383,57 @@ class LLMEngine:
         self._update_gauges()
         return True
 
+    def _spec_ready(self, live) -> bool:
+        """A speculative round runs only when EVERY live slot
+        qualifies: greedy sampling (acceptance compares argmaxes),
+        draft cache seeded (spec_ok), and spec_k - 1 positions of
+        headroom before the sequence limit. Mixed batches fall back to
+        the plain tick — correctness never depends on this gate, only
+        decode speed."""
+        import numpy as np
+
+        if self._draft is None:
+            return False
+        if not bool(self._spec_ok[live].all()):
+            return False
+        if bool((self._temp[live] > 0).any()):
+            return False
+        pos_host = np.asarray(self._pos)
+        return bool((pos_host[live] <= self.config.max_seq_len
+                     - self.config.spec_k).all())
+
+    def _spec_tick(self):
+        """Run one speculative round and return (tokens [K, B] host,
+        n_emit [B] host); the caller emits tokens[0:n_emit[s], s] per
+        slot."""
+        import numpy as np
+
+        (self._cache, self._draft_cache, self._tok, self._pos,
+         t, n_emit) = self._jit_spec(
+            self.params, self._draft, self._cache, self._draft_cache,
+            self._tables.copy(), self._tok, self._pos,
+            self._active.copy())
+        n_host = np.asarray(n_emit)
+        live = int((n_host > 0).sum())
+        self._spec_rounds += 1
+        self._spec_proposed += (self.config.spec_k - 1) * live
+        self._spec_accepted += int(n_host.sum()) - live
+        self._metrics.spec_proposed.inc(
+            float((self.config.spec_k - 1) * live))
+        self._metrics.spec_accepted.inc(float(int(n_host.sum()) - live))
+        return np.asarray(t).T, n_host
+
     def _update_gauges(self) -> None:
         m = self._metrics
         active = int(self._active.sum())
-        m.queue_depth.set(float(len(self._queue)))
+        with self._lock:
+            depths = {lane: len(q) for lane, q in self._queues.items()}
+        m.queue_depth.set(float(sum(depths.values())))
+        for lane, d in depths.items():
+            m.lane_queue_depth.set(float(d), tags={"lane": lane})
+        if self._spec_proposed:
+            m.spec_accept_ratio.set(
+                self._spec_accepted / self._spec_proposed)
         m.active_slots.set(float(active))
         m.batch_utilization.set(active / self.config.num_slots)
         if self._paged:
@@ -770,34 +1478,65 @@ class LLMEngine:
         pays the compile inside its own latency. Synchronous; call
         before starting a run() thread."""
         prefix, self._prefix = self._prefix, None
+        draft, self._draft = self._draft, None
         try:
             # max_tokens=2: a 1-token request finishes AT insert and the
-            # decode tick would never trace.
+            # decode tick would never trace. Draft disabled: phase one
+            # compiles the PLAIN tick (the spec gate would otherwise
+            # route every greedy warmup batch through _jit_spec).
             handles = [self.submit(Request(prompt=[1] * b, max_tokens=2))
                        for b in self.config.prefill_buckets]
             while any(h.finished_at is None for h in handles):
                 self.step()
+            if draft is not None:
+                # Phase two: draft inserts (one per bucket) + the
+                # speculative round program.
+                self._draft = draft
+                handles = [self.submit(
+                    Request(prompt=[1] * b, max_tokens=2))
+                    for b in self.config.prefill_buckets]
+                while any(h.finished_at is None for h in handles):
+                    self.step()
         finally:
             self._prefix = prefix
+            self._draft = draft
 
     # ------------------------------------------------------------ inspection
 
     @property
     def trace_count(self) -> int:
         """Number of engine XLA programs traced so far (compile guard:
-        must stay <= len(prefill_buckets) + 1 under any workload)."""
-        return self._jit_tick.traces + self._jit_insert.traces
+        bounded by the per-family trace budgets under any workload —
+        len(buckets) inserts + 1 tick, plus at most 1 export, 1 adopt,
+        1 spec round, and len(buckets) draft inserts when wired)."""
+        n = self._jit_tick.traces + self._jit_insert.traces
+        for name in ("_jit_export", "_jit_adopt", "_jit_spec",
+                     "_jit_draft_insert"):
+            fn = getattr(self, name, None)
+            if fn is not None:
+                n += fn.traces
+        return n
 
     def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            queued_by_lane = {lane: len(q)
+                              for lane, q in self._queues.items()}
+        traces = {"tick": self._jit_tick.traces,
+                  "insert": self._jit_insert.traces}
+        for name in ("export", "adopt", "spec", "draft_insert"):
+            fn = getattr(self, f"_jit_{name}", None)
+            if fn is not None:
+                traces[name] = fn.traces
         out = {
             "num_slots": self.config.num_slots,
             "active_slots": int(self._active.sum()),
-            "queued": len(self._queue),
+            "queued": sum(queued_by_lane.values()),
+            "queued_by_lane": queued_by_lane,
             "completed": self._completed,
             "slot_reuses": self._slot_reuses,
+            "preempted": self._preempted,
             "kv_layout": self.config.kv_layout,
-            "traces": {"tick": self._jit_tick.traces,
-                       "insert": self._jit_insert.traces},
+            "traces": traces,
             "trace_count": self.trace_count,
         }
         if self._paged:
@@ -807,8 +1546,20 @@ class LLMEngine:
                 "used_blocks": self._allocator.used_blocks,
                 "free_blocks": self._allocator.free_blocks,
             }
+            out["migration"] = {
+                "blocks": self._migrated_blocks,
+                "bytes": self._migrated_bytes,
+            }
             if self._prefix is not None:
                 out["prefix_cache"] = self._prefix.stats()
+        if self._draft is not None or self._spec_rounds:
+            denom = max(self._spec_proposed, 1)
+            out["spec"] = {
+                "rounds": self._spec_rounds,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "accept_ratio": self._spec_accepted / denom,
+            }
         return out
 
 

@@ -39,7 +39,63 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["BlockAllocator", "PrefixCache", "hash_prefix"]
+__all__ = ["BlockAllocator", "KVState", "PrefixCache", "hash_prefix"]
+
+
+@dataclass
+class KVState:
+    """A sequence's paged KV checkpoint, detached from any engine.
+
+    The unit of KV migration: `LLMEngine._export_state` densifies the
+    slot's live blocks into plain ndarrays ([L, n_valid, bs, n_kv, hd],
+    zero-copy through the object store), and `LLMEngine.submit_adopted`
+    scatters them into another engine's pool. Produced by the
+    disaggregated prefill tier (serve/llm/disagg) and by batch-lane
+    preemption (the checkpoint that lets a preempted decode resume).
+
+    ``pos`` is the number of CONSUMED tokens — rows [0, pos) of the
+    dense view are valid; ``next_tok`` is the last sampled token, not
+    yet consumed (the engine's device ``tok`` at export time).
+    ``tokens`` are the tokens already emitted to the caller (the first
+    sampled token onward), so an adopting engine resumes max_tokens /
+    stop accounting exactly where the exporter left off.
+    """
+
+    prompt: List[int]
+    tokens: List[int]
+    next_tok: int
+    pos: int
+    temperature: float
+    block_size: int
+    k_blocks: object        # np [L, n_valid, bs, n_kv, head_dim]
+    v_blocks: object
+
+    @property
+    def n_blocks(self) -> int:
+        return int(self.k_blocks.shape[1])
+
+    @property
+    def payload_bytes(self) -> int:
+        return int(self.k_blocks.nbytes + self.v_blocks.nbytes)
+
+    def validate(self) -> None:
+        bs = self.block_size
+        need = -(-self.pos // bs)
+        if self.n_blocks != need:
+            raise ValueError(
+                f"KVState holds {self.n_blocks} blocks but pos="
+                f"{self.pos} at block_size={bs} needs {need}")
+        if self.k_blocks.shape != self.v_blocks.shape:
+            raise ValueError("k/v block shape mismatch")
+        if not self.tokens or self.tokens[-1] != self.next_tok:
+            raise ValueError(
+                "next_tok must be the last emitted token (sampled but "
+                "not yet consumed)")
+        if self.pos != len(self.prompt) + len(self.tokens) - 1:
+            raise ValueError(
+                f"pos={self.pos} inconsistent with prompt "
+                f"{len(self.prompt)} + emitted {len(self.tokens)} "
+                f"(expected prompt + emitted - 1 consumed tokens)")
 
 
 def hash_prefix(tokens: Sequence[int]) -> int:
@@ -122,6 +178,36 @@ class BlockAllocator:
             self._refs[new] = 1
             self._refs[block] -= 1
             return new, True
+
+    # -- migration -------------------------------------------------------
+    def adopt(self, n: int,
+              prefix_cache: Optional["PrefixCache"] = None
+              ) -> Optional[List[int]]:
+        """All-or-nothing allocation for an imported/resumed sequence:
+        like :meth:`alloc`, but under pressure it first evicts cold
+        prefix-cache entries to make room (the same fallback admission
+        uses). Returns None — nothing allocated, nothing evicted beyond
+        the attempt — when the pool still can't cover ``n``; the caller
+        requeues the import and retries as running sequences finish."""
+        blocks = self.alloc(n)
+        if blocks is None and prefix_cache is not None:
+            prefix_cache.evict(n - self.free_blocks)
+            blocks = self.alloc(n)
+        return blocks
+
+    def donate(self, blocks: Sequence[int]) -> None:
+        """Release a live sequence's block refs after its KV has been
+        exported (the ownership hand-off half of a migration: the rows
+        now live in a :class:`KVState` / another engine's pool, so this
+        engine's copies may be recycled). Identical accounting to
+        :meth:`free` — the name records intent at export sites, and the
+        liveness check catches exporting an already-freed slot."""
+        for b in blocks:
+            if self.refcount(b) <= 0:
+                raise ValueError(
+                    f"donate of free block {b}: export must happen "
+                    f"before the slot is torn down")
+        self.free(blocks)
 
     # -- introspection ---------------------------------------------------
     def refcount(self, block: int) -> int:

@@ -62,7 +62,9 @@ class LLMRouter:
     """
 
     def __init__(self, llm_handle: Any,
-                 probe_interval_s: Optional[float] = None):
+                 probe_interval_s: Optional[float] = None,
+                 prefill_handle: Any = None,
+                 prefill_threshold: int = 256):
         from ray_tpu._private.config import GlobalConfig
         from ray_tpu.observability import serve_metrics
 
@@ -76,6 +78,20 @@ class LLMRouter:
         self._inflight: Dict[Any, int] = {}
         self._depth: Dict[Any, float] = {}     # probed engine depth
         self._routed: Dict[str, int] = {}      # per-replica forward count
+        self._lane_routed: Dict[Tuple[str, str], int] = {}
+        # Optional prefill pool (serve/llm/disagg): prompts at or past
+        # `prefill_threshold` tokens take the two-hop path — prefill
+        # replica exports KV, decode replica adopts it; the prefill
+        # result moves between them by ObjectRef (store-to-store).
+        self._pre_app = self._pre_deployment = None
+        self._pre_threshold = int(prefill_threshold)
+        self._pre_replicas: List[Any] = []
+        self._pre_version = -1
+        self._pre_inflight: Dict[Any, int] = {}
+        self._pre_depth: Dict[Any, float] = {}
+        if prefill_handle is not None:
+            self._pre_app = prefill_handle._app
+            self._pre_deployment = prefill_handle._deployment
         self._lock = threading.Lock()
         self._closed = False
         self._metrics = serve_metrics()
@@ -94,6 +110,12 @@ class LLMRouter:
                                                  self._deployment),
             timeout=60)
         self._apply(version, replicas)
+        if self._pre_app is not None:
+            version, replicas = ray_tpu.get(
+                self._controller.get_replicas.remote(
+                    self._pre_app, self._pre_deployment),
+                timeout=60)
+            self._apply_prefill(version, replicas)
         for target, name in ((self._poll_loop, "llm-router-poll"),
                              (self._probe_loop, "llm-router-probe"),
                              (self._push_loop, "llm-router-push")):
@@ -111,6 +133,16 @@ class LLMRouter:
                 self._depth = {r: self._depth.get(r, 0.0)
                                for r in replicas}
 
+    def _apply_prefill(self, version: int, replicas: List[Any]) -> None:
+        with self._lock:
+            if version != self._pre_version:
+                self._pre_version = version
+                self._pre_replicas = replicas
+                self._pre_inflight = {r: self._pre_inflight.get(r, 0)
+                                      for r in replicas}
+                self._pre_depth = {r: self._pre_depth.get(r, 0.0)
+                                   for r in replicas}
+
     def _poll_loop(self) -> None:
         import ray_tpu
 
@@ -121,29 +153,40 @@ class LLMRouter:
                         self._app, self._deployment, self._version, 25.0),
                     timeout=60)
                 self._apply(version, replicas)
+                if self._pre_app is not None:
+                    version, replicas = ray_tpu.get(
+                        self._controller.poll_replicas.remote(
+                            self._pre_app, self._pre_deployment,
+                            self._pre_version, 0.5),
+                        timeout=60)
+                    self._apply_prefill(version, replicas)
             except Exception:
                 if self._closed:
                     return
                 time.sleep(1.0)
 
     # ------------------------------------------------------------- probing
-    def _probe_loop(self) -> None:
+    def _probe_one(self, r: Any) -> float:
         import ray_tpu
 
+        try:
+            load = ray_tpu.get(
+                r.handle_request.remote("load", (), {}),
+                timeout=min(5.0, self._probe_interval * 5))
+            return float(load.get("queued", 0)
+                         + load.get("active_slots", 0))
+        except Exception:
+            # Unreachable/stalled replica: poison its score so
+            # traffic shifts away until it answers again.
+            return float("inf")
+
+    def _probe_loop(self) -> None:
         while not self._closed:
             with self._lock:
                 replicas = list(self._replicas)
+                pre = list(self._pre_replicas)
             for r in replicas:
-                try:
-                    load = ray_tpu.get(
-                        r.handle_request.remote("load", (), {}),
-                        timeout=min(5.0, self._probe_interval * 5))
-                    depth = float(load.get("queued", 0)
-                                  + load.get("active_slots", 0))
-                except Exception:
-                    # Unreachable/stalled replica: poison its score so
-                    # traffic shifts away until it answers again.
-                    depth = float("inf")
+                depth = self._probe_one(r)
                 with self._lock:
                     if r in self._depth:
                         self._depth[r] = depth
@@ -151,6 +194,11 @@ class LLMRouter:
                 if depth != float("inf"):
                     self._metrics.router_queue_depth.set(
                         depth, tags={"replica": str(rid)})
+            for r in pre:
+                depth = self._probe_one(r)
+                with self._lock:
+                    if r in self._pre_depth:
+                        self._pre_depth[r] = depth
             time.sleep(self._probe_interval)
 
     def _push_loop(self) -> None:
@@ -167,49 +215,99 @@ class LLMRouter:
                 return
 
     # ------------------------------------------------------------- routing
-    def _score(self) -> Tuple[List[Any], Dict[Any, float]]:
+    def _score(self, pool: str = "decode") \
+            -> Tuple[List[Any], Dict[Any, float]]:
         with self._lock:
-            replicas = list(self._replicas)
-            load = {r: self._inflight.get(r, 0) + self._depth.get(r, 0.0)
-                    for r in replicas}
+            if pool == "prefill":
+                replicas = list(self._pre_replicas)
+                load = {r: self._pre_inflight.get(r, 0)
+                        + self._pre_depth.get(r, 0.0) for r in replicas}
+            else:
+                replicas = list(self._replicas)
+                load = {r: self._inflight.get(r, 0)
+                        + self._depth.get(r, 0.0) for r in replicas}
         return replicas, load
+
+    def _pick(self, pool: str) -> Any:
+        deadline = time.monotonic() + 30.0
+        replicas, load = self._score(pool)
+        while not replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no live {pool} replicas for "
+                    f"{self._app}/{self._deployment}")
+            time.sleep(0.05)
+            replicas, load = self._score(pool)
+        return p2c_pick(replicas, load)
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         import ray_tpu
 
-        deadline = time.monotonic() + 30.0
-        replicas, load = self._score()
-        while not replicas:
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no live replicas for {self._app}/{self._deployment}")
-            time.sleep(0.05)
-            replicas, load = self._score()
-        chosen = p2c_pick(replicas, load)
+        lane = str(request.get("slo", "interactive"))
+        two_hop = (self._pre_app is not None
+                   and len(request.get("prompt", ()))
+                   >= self._pre_threshold)
+        chosen = self._pick("decode")
         rid = str(getattr(chosen, "_actor_id", id(chosen)))
+        pre = self._pick("prefill") if two_hop else None
         with self._lock:
             self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
             self._routed[rid] = self._routed.get(rid, 0) + 1
+            key = (lane, "prefill" if two_hop else
+                   ("decode" if self._pre_app is not None
+                    else "monolithic"))
+            self._lane_routed[key] = self._lane_routed.get(key, 0) + 1
+            if pre is not None:
+                self._pre_inflight[pre] = \
+                    self._pre_inflight.get(pre, 0) + 1
         self._metrics.router_requests.inc(tags={"replica": rid})
+        self._metrics.router_lane_requests.inc(
+            tags={"lane": key[0], "pool": key[1]})
         try:
+            timeout = float(request.get("timeout_s", 300.0))
+            if two_hop:
+                # Two-hop disaggregated path. The prefill result — KV
+                # blocks included — is forwarded as an ObjectRef: the
+                # decode replica materializes it from the object store
+                # (Replica.handle_request's ObjectRef-arg resolution),
+                # so the payload never enters the router process.
+                prefill_ref = pre.handle_request.remote(
+                    "prefill", (request,), {})
+                return ray_tpu.get(
+                    chosen.handle_request.remote(
+                        "adopt", (prefill_ref, request), {}),
+                    timeout=timeout)
             return ray_tpu.get(
                 chosen.handle_request.remote("__call__", (request,), {}),
-                timeout=float(request.get("timeout_s", 300.0)))
+                timeout=timeout)
         finally:
             with self._lock:
                 if chosen in self._inflight:
                     self._inflight[chosen] -= 1
+                if pre is not None and pre in self._pre_inflight:
+                    self._pre_inflight[pre] -= 1
 
     # ------------------------------------------------------------- inspection
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "replicas": len(self._replicas),
                 "inflight": sum(self._inflight.values()),
                 "routed": dict(self._routed),
+                "lanes": {f"{lane}/{pool}": n for (lane, pool), n
+                          in self._lane_routed.items()},
                 "depth": {str(getattr(r, "_actor_id", id(r))): d
                           for r, d in self._depth.items()},
             }
+            if self._pre_app is not None:
+                out["prefill_pool"] = {
+                    "replicas": len(self._pre_replicas),
+                    "inflight": sum(self._pre_inflight.values()),
+                    "threshold": self._pre_threshold,
+                    "depth": {str(getattr(r, "_actor_id", id(r))): d
+                              for r, d in self._pre_depth.items()},
+                }
+            return out
 
     def check_health(self) -> None:
         if self._closed:

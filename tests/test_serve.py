@@ -75,6 +75,23 @@ def test_composition_with_inner_handle():
     assert handle.remote(4).result(timeout=60) == 41
 
 
+def test_objectref_args_materialized():
+    # The disagg two-hop forwards one replica's result ObjectRef
+    # straight into another replica's args (serve/llm/router.py); the
+    # worker's task-arg resolution can't see inside the handle_request
+    # envelope, so the replica itself must materialize ref args.
+    @serve.deployment
+    class Echo:
+        def __call__(self, x, tag="t"):
+            return (type(x).__name__, x, tag)
+
+    handle = serve.run(Echo.bind(), name="echo")
+    tname, val, _ = handle.remote(ray_tpu.put(123)).result(timeout=60)
+    assert (tname, val) == ("int", 123)
+    _, _, tag = handle.remote(1, tag=ray_tpu.put("hi")).result(timeout=60)
+    assert tag == "hi"
+
+
 def test_redeploy_scales_replicas():
     @serve.deployment(num_replicas=1)
     class S:

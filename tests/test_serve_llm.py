@@ -312,7 +312,8 @@ def test_llm_server_quantize_default_and_optout():
     srv = LLMServer(model_config=config, engine_config=econf)
     assert srv.quantize == "int8"
     assert srv.stats()["quantize"] == "int8"
-    assert set(srv.load()) == {"queued", "active_slots", "free_slots"}
+    assert set(srv.load()) == {"queued", "active_slots", "free_slots",
+                               "lanes"}
     srv_bf16 = LLMServer(model_config=config, engine_config=econf,
                          quantize="bf16")
     assert srv_bf16.quantize == "bf16"
