@@ -173,6 +173,46 @@ _define("serve_spec_k", int, 4,
         "Speculative decoding depth for serve LLM engines built with a "
         "draft model: spec_k - 1 draft proposals verified per round, "
         "so each verify step emits 1..spec_k tokens.")
+_define("serve_kv_host_tier_bytes", int, 256 * 1024 * 1024,
+        "Host-RAM budget of the KV memory hierarchy's middle tier "
+        "(serve/llm/kv_cache.KVTierManager): evicted prefix blocks "
+        "spill here instead of vanishing; overflow demotes to the "
+        "object store (or is dropped, counted, when no cluster is "
+        "attached).")
+_define("serve_kv_adopt_cost_fixed_ms", float, 2.0,
+        "PromoteCostModel: fixed cost of one tier->HBM promote "
+        "dispatch (host staging + the adopt scatter launch), "
+        "independent of block count.")
+_define("serve_kv_adopt_cost_per_block_ms", float, 0.1,
+        "PromoteCostModel: marginal cost per promoted KV block "
+        "(host->device transfer of one block's rows).")
+_define("serve_kv_prefill_cost_per_token_ms", float, 0.05,
+        "PromoteCostModel: prefill cost per prompt token — the "
+        "recompute side of the promote-vs-recompute crossover. Short "
+        "suffixes recompute; long ones re-adopt.")
+_define("serve_prefix_index_publish_interval_s", float, 2.0,
+        "Period of each LLM replica's prefix-index publish (hash-chain "
+        "heads + tier residency -> GCS report_prefix_index).")
+_define("serve_prefix_index_ttl_s", float, 15.0,
+        "GCS prefix-index entry lifetime: a replica that stops "
+        "publishing drops out of cache-aware routing after this long "
+        "(and the router HOLDs to plain p2c per the staleness "
+        "discipline when its whole view is older than this).")
+_define("serve_prefix_index_max_heads", int, 512,
+        "Cap on hash-chain heads one replica publishes per index "
+        "report (hottest first; the index is a routing hint, not a "
+        "directory).")
+_define("serve_router_cache_weight", float, 0.25,
+        "Cache-aware p2c: score = load - weight * expected prefix-hit "
+        "blocks. Keep < 1 so affinity breaks near-ties without "
+        "outweighing whole queued requests (BENCH llama_serve_kv_"
+        "tiering: weight 1.0 saturates the hot family's replica and "
+        "queue wait eats the prefill savings). 0 recovers plain "
+        "queue-depth p2c.")
+_define("serve_peer_pull_min_blocks", int, 4,
+        "Minimum expected-hit advantage (in blocks) a peer must hold "
+        "over the chosen replica before the router pulls KV blocks "
+        "from it instead of letting the replica recompute.")
 _define("data_backpressure_interval_s", float, 1.0,
         "Minimum spacing between backpressure re-evaluations per "
         "executor (the tuner is pulled from the launch loop; this "

@@ -145,6 +145,14 @@ class GcsServer:
             maxlen=GlobalConfig.ctrl_decisions_buffer_size)
         self._ctrl_decision_seq = 0
 
+        # Cluster-wide prefix index: replica -> its newest published
+        # set of KV hash-chain heads (stable_hash, depth) + tier
+        # residency summary. A routing HINT, not a directory: entries
+        # expire after serve_prefix_index_ttl_s without a re-publish,
+        # and every consumer re-verifies against real tokens before
+        # trusting a hash (serve/llm/kv_cache.stable_hash_prefix).
+        self.prefix_index: Dict[str, Dict[str, Any]] = {}
+
         self._reschedule_on_start: List[bytes] = []
         self._register_handlers()
         # Actor/PG lifecycle transitions all publish; piggyback snapshot
@@ -287,6 +295,7 @@ class GcsServer:
             "report_cluster_event", "list_cluster_events",
             "summary_cluster_events",
             "report_ctrl_decision", "list_ctrl_decisions",
+            "report_prefix_index", "lookup_prefix_index",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
 
@@ -373,6 +382,39 @@ class GcsServer:
                 continue
             out.append(d)
         return out[-max(int(limit), 0):]
+
+    # ------------------------------------------------- cluster prefix index
+    async def _h_report_prefix_index(self, replica, heads, tiers=None):
+        """One LLM replica's cache-aware-routing hint: the hash-chain
+        heads it can serve without prefilling (hottest first, capped at
+        serve_prefix_index_max_heads) plus a tier residency summary.
+        Last write wins per replica; the report IS the heartbeat — a
+        replica that stops publishing ages out at lookup."""
+        cap = int(GlobalConfig.serve_prefix_index_max_heads)
+        self.prefix_index[str(replica)] = {
+            "heads": [(int(h), int(d)) for h, d in list(heads)[:cap]],
+            "tiers": dict(tiers or {}),
+            "ts": time.time(),
+        }
+        return True
+
+    async def _h_lookup_prefix_index(self):
+        """TTL-filtered snapshot: {replica: {heads, tiers, age_s}}.
+        Expired entries are dropped here (lazy expiry — no sweeper
+        task to keep alive across bounces)."""
+        ttl = float(GlobalConfig.serve_prefix_index_ttl_s)
+        now = time.time()
+        out: Dict[str, Any] = {}
+        for rep in list(self.prefix_index):
+            rec = self.prefix_index[rep]
+            age = now - rec["ts"]
+            if age > ttl:
+                del self.prefix_index[rep]
+                continue
+            out[rep] = {"heads": list(rec["heads"]),
+                        "tiers": dict(rec["tiers"]),
+                        "age_s": age}
+        return out
 
     # --------------------------------------------------------------- metrics
     async def _h_metrics_text(self) -> str:

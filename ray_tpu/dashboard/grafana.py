@@ -129,6 +129,14 @@ _PANELS: List[Dict[str, str]] = [
     {"title": "Router lane routing",
      "expr": "rate(rtpu_serve_router_lane_requests_total[5m])",
      "legend": "{{lane}}/{{pool}}", "unit": "short"},
+    # --- KV memory hierarchy (kv_cache.KVTierManager + cache-aware router) ---
+    {"title": "KV tier residency (hbm/host/store)",
+     "expr": "rtpu_serve_kv_tier_bytes",
+     "legend": "{{tier}}", "unit": "bytes"},
+    {"title": "KV tier traffic: spills vs promotes",
+     "expr": "rate(rtpu_serve_prefix_tier_spills_total[5m])",
+     "expr_b": "rate(rtpu_serve_prefix_tier_promotes_total[5m])",
+     "legend": "{{tier}}", "unit": "short"},
     # --- collectives (Pallas ICI backend + util.collective API) ---
     {"title": "Collective ops rate",
      "expr": "rate(rtpu_collective_ops_total[5m])",

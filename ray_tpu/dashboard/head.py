@@ -242,6 +242,52 @@ class DashboardHead:
         if spec_prop:
             disagg["spec_accept_ratio"] = (spec_acc or 0.0) / spec_prop
         summary["disagg"] = disagg
+
+        # KV memory hierarchy rollup: per-tier traffic + residency and
+        # the cache-aware router's decision mix — the "is the cluster
+        # re-prefilling what a peer already computed?" numbers (PERF.md)
+        # in one fetch. Series are tagged; fold them per tag value.
+        def _by_tag(name, key):
+            entry = summary.get(name)
+            if not entry or not entry.get("data"):
+                return {}
+            folded: Dict[str, float] = {}
+            pat = key + '="'
+            for labels, v in entry["data"].items():
+                for part in labels.split(","):
+                    if part.startswith(pat):
+                        tag = part[len(pat):-1]
+                        folded[tag] = folded.get(tag, 0.0) + float(v)
+                        break
+            return folded
+
+        tier_hits = _by_tag("serve_prefix_tier_hits_total", "tier")
+        tier_misses = _by_tag("serve_prefix_tier_misses_total", "tier")
+        tiers: Dict[str, Any] = {
+            "hits": tier_hits,
+            "misses": tier_misses,
+            "spills": _by_tag("serve_prefix_tier_spills_total", "tier"),
+            "promotes": _by_tag(
+                "serve_prefix_tier_promotes_total", "tier"),
+            "bytes": _by_tag("serve_kv_tier_bytes", "tier"),
+            "router_decisions": _by_tag(
+                "serve_router_cache_decisions_total", "outcome"),
+        }
+        hit_rate = {}
+        for t in tier_hits:
+            n = tier_hits[t] + tier_misses.get(t, 0.0)
+            if n:
+                hit_rate[t] = tier_hits[t] / n
+        if hit_rate:
+            tiers["hit_rate"] = hit_rate
+        # One router per app: report the WORST (oldest) live view, not
+        # a meaningless sum across per-pid gauge series.
+        ages = [float(v) for v in summary.get(
+            "serve_router_index_age_seconds", {}).get(
+                "data", {}).values()]
+        if ages:
+            tiers["index_age_s"] = max(ages)
+        summary["kv_tiers"] = tiers
         return web.json_response(summary)
 
     async def rl_stats(self, _req) -> web.Response:

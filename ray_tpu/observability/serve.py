@@ -151,6 +151,43 @@ class ServeMetrics:
             description="Requests forwarded by the LLM router, split by "
                         "SLO lane and destination pool (monolithic | "
                         "prefill | decode).")
+        # KV memory hierarchy (kv_cache.KVTierManager): evicted prefix
+        # blocks spill HBM -> host RAM -> object store and are promoted
+        # back through the adopt scatter instead of re-prefilling.
+        self.prefix_tier_hits = Counter(
+            "serve_prefix_tier_hits_total", tag_keys=("tier",),
+            description="Tier lookups that found a spilled chain link "
+                        "(one count per block), by tier (host | store).")
+        self.prefix_tier_misses = Counter(
+            "serve_prefix_tier_misses_total", tag_keys=("tier",),
+            description="Tier lookups that found nothing at a depth, by "
+                        "tier — the re-prefilled side of the hierarchy.")
+        self.prefix_tier_spills = Counter(
+            "serve_prefix_tier_spills_total", tag_keys=("tier",),
+            description="KV blocks spilled INTO a tier (host: prefix "
+                        "eviction or peer pull; store: host-budget "
+                        "demotion).")
+        self.prefix_tier_promotes = Counter(
+            "serve_prefix_tier_promotes_total", tag_keys=("tier",),
+            description="KV blocks promoted OUT of a tier back into the "
+                        "HBM pool via the adopt scatter (their prefill "
+                        "was skipped).")
+        self.kv_tier_bytes = Gauge(
+            "serve_kv_tier_bytes", tag_keys=("tier",),
+            description="Resident KV bytes per tier of the memory "
+                        "hierarchy (hbm | host | store).")
+        # Cluster-wide prefix index (GCS report/lookup_prefix_index):
+        # what cache-aware routing sees and how fresh it is.
+        self.router_cache_hops = Counter(
+            "serve_router_cache_decisions_total", tag_keys=("outcome",),
+            description="Cache-aware routing decisions by outcome "
+                        "(scored: index applied; held: index stale, "
+                        "plain p2c; pulled: peer KV pull issued).")
+        self.router_index_age = Gauge(
+            "serve_router_index_age_seconds",
+            description="Age of the LLM router's newest cluster "
+                        "prefix-index view (staleness HOLD beyond "
+                        "serve_prefix_index_ttl_s).")
 
 
 def serve_metrics() -> ServeMetrics:

@@ -4,11 +4,16 @@ The engine (`engine.py`) keeps a fixed pool of decode slots inside a
 bounded set of compiled XLA programs; the deployment (`deployment.py`)
 exposes it as a Serve replica; `kv_cache.py` pages the KV pool and
 reuses shared prompt prefixes; `router.py` spreads requests across N
-replicas on probed queue depth and SLO lane; `disagg/` splits prefill
-and decode onto separate replica pools with KV-block migration over
-the object store and speculative decoding on the decode side. See
-PERF.md "Serving throughput" and README "Paged KV cache & routing" /
-"Disaggregated serving" for the design narrative and bench numbers.
+replicas on probed queue depth, SLO lane, and expected prefix-cache
+hit (cluster-wide KV index); `disagg/` splits prefill and decode onto
+separate replica pools with KV-block migration over the object store
+and speculative decoding on the decode side. Evicted prefix blocks
+spill down a memory hierarchy (HBM -> host RAM -> object store,
+`KVTierManager`) and are promoted back through the adopt scatter when
+`PromoteCostModel` says re-adopt beats re-prefill. See PERF.md
+"Serving throughput" and README "Paged KV cache & routing" /
+"Disaggregated serving" / "KV memory hierarchy" for the design
+narrative and bench numbers.
 """
 
 from ray_tpu.serve.llm.deployment import LLMServer, build_llm_app
@@ -19,13 +24,17 @@ from ray_tpu.serve.llm.disagg import (
 from ray_tpu.serve.llm.engine import (
     EngineConfig, LLMEngine, Request, RequestHandle, static_batch_generate,
 )
-from ray_tpu.serve.llm.kv_cache import BlockAllocator, KVState, PrefixCache
+from ray_tpu.serve.llm.kv_cache import (
+    BlockAllocator, KVPrefix, KVState, KVTierManager, PrefixCache,
+    PromoteCostModel, TierHit, stable_hash_prefix,
+)
 from ray_tpu.serve.llm.router import LLMRouter, build_routed_llm_app
 
 __all__ = [
     "BlockAllocator", "DecodeServer", "EngineConfig", "KVExporter",
-    "KVImporter", "KVState", "LLMEngine", "LLMRouter", "LLMServer",
-    "PrefillServer", "PrefixCache", "Request", "RequestHandle",
+    "KVImporter", "KVPrefix", "KVState", "KVTierManager", "LLMEngine",
+    "LLMRouter", "LLMServer", "PrefillServer", "PrefixCache",
+    "PromoteCostModel", "Request", "RequestHandle", "TierHit",
     "build_disagg_llm_app", "build_llm_app", "build_routed_llm_app",
-    "static_batch_generate",
+    "stable_hash_prefix", "static_batch_generate",
 ]

@@ -104,6 +104,19 @@ class LLMServer:
             name="llm-engine-scheduler", daemon=True)
         self._thread.start()
 
+        # Cluster-wide prefix index: this replica's identity in the
+        # GCS index (the router learns it from load()), plus the
+        # publisher thread pushing hash-chain heads on a fixed period.
+        # The publish IS the liveness signal — a dead replica ages out
+        # of cache-aware routing at the index TTL.
+        import uuid
+
+        self._replica_id = uuid.uuid4().hex[:12]
+        if getattr(self._engine, "_prefix", None) is not None:
+            threading.Thread(
+                target=self._publish_index_loop, daemon=True,
+                name="llm-prefix-index-publish").start()
+
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         """request: {"prompt": [token ids], "max_tokens": int,
         "temperature": float, "stop": [token ids]} -> completed tokens
@@ -136,16 +149,60 @@ class LLMServer:
             "tpot_s": handle.tpot_s,
         }
 
+    def _publish_index_loop(self) -> None:
+        from ray_tpu._private.config import GlobalConfig
+        from ray_tpu._private.worker import global_worker_or_none
+
+        interval = float(
+            GlobalConfig.serve_prefix_index_publish_interval_s)
+        while not self._stop.wait(interval):
+            w = global_worker_or_none()
+            if w is None:
+                continue        # no cluster: nothing to publish to
+            try:
+                eng = self._engine
+                tiers: Dict[str, Any] = {
+                    "block_size": eng.config.kv_block_size}
+                if eng._tiers is not None:
+                    ts = eng._tiers.stats()
+                    tiers["host_blocks"] = ts["host"]["blocks"]
+                    tiers["store_blocks"] = ts["store"]["blocks"]
+                w.gcs.call("report_prefix_index", timeout=5,
+                           replica=self._replica_id,
+                           heads=eng.prefix_index_heads(),
+                           tiers=tiers)
+            except Exception:
+                pass            # index is a hint; never crash a replica
+
+    def export_prefix(self, tokens, max_blocks=None):
+        """Donor side of a router-initiated peer pull: the longest
+        HBM + tier chain covering ``tokens`` as per-block KVPrefix
+        links. Hops to the scheduler thread — device state may only be
+        read alongside the engine's donating programs there."""
+        return self._engine.call_on_scheduler(
+            lambda: self._engine.export_prefix(tokens,
+                                               max_blocks=max_blocks),
+            timeout_s=30.0)
+
+    def import_prefix(self, prefixes) -> int:
+        """Receiver side of a peer pull: park pulled links in the host
+        tier; the pulling request's admission promotes them through
+        the cost model. Thread-safe, no scheduler hop."""
+        return self._engine.import_prefix(prefixes)
+
     def load(self) -> Dict[str, Any]:
         """Cheap load snapshot for the LLM router's queue-depth probe
         (serve/llm/router.py): engine queue + busy slots, no jit-stat
-        scan, safe to call at probe frequency."""
+        scan, safe to call at probe frequency. ``index_id`` is how the
+        router joins this replica's handle to its GCS prefix-index
+        entry."""
         s = self._engine.stats()
         return {
             "queued": s["queued"],
             "active_slots": s["active_slots"],
             "free_slots": s["num_slots"] - s["active_slots"],
             "lanes": s["queued_by_lane"],
+            "index_id": self._replica_id,
         }
 
     def stats(self) -> Dict[str, Any]:

@@ -24,7 +24,9 @@ Design — a bounded set of compiled programs, everything else is data:
   one `dynamic_update_slice` (insert-at-slot). One compiled program per
   bucket, so a mixed workload traces exactly
   ``len(prefill_buckets) + 1`` engine programs — `trace_count` exposes
-  the number for the compile-guard test.
+  the number for the compile-guard test. Workloads that adopt migrated
+  or tier-promoted KV add exactly ONE more (the fixed-shape adopt
+  scatter, shared by disagg migration and tier promotes).
 - Slot eviction/recycling is host-side bookkeeping: EOS / stop-token /
   max_tokens free the slot, the next queued request prefills into it.
   Stale KV beyond a recycled slot's new position is harmless — decode
@@ -93,6 +95,20 @@ class EngineConfig:
     # None -> GlobalConfig.serve_preempt_{hold,cooldown}_s.
     preempt_hold_s: Optional[float] = None
     preempt_cooldown_s: Optional[float] = None
+    # Tiered KV spill (kv_cache.KVTierManager): prefix-cache evictions
+    # gather their HBM rows into a host-RAM tier (object-store overflow
+    # when a cluster is attached) and re-admissions promote them back
+    # through the adopt scatter when the PromoteCostModel favors the
+    # transfer over recompute. None -> on for paged + prefix_cache
+    # engines (both migration programs already exist; spill adds no
+    # trace). Forced off otherwise.
+    kv_spill: Optional[bool] = None
+    kv_host_tier_bytes: Optional[int] = None    # None -> GlobalConfig
+    # PromoteCostModel knobs, milliseconds; None -> GlobalConfig
+    # serve_kv_adopt_cost_*/serve_kv_prefill_cost_per_token_ms.
+    kv_adopt_cost_fixed_ms: Optional[float] = None
+    kv_adopt_cost_per_block_ms: Optional[float] = None
+    kv_prefill_cost_per_token_ms: Optional[float] = None
 
     def __post_init__(self):
         from ray_tpu._private.config import GlobalConfig
@@ -125,6 +141,29 @@ class EngineConfig:
             raise ValueError(
                 f"kv_layout must be 'dense' or 'paged', got "
                 f"{self.kv_layout!r}")
+        if self.kv_spill is None:
+            object.__setattr__(
+                self, "kv_spill",
+                self.kv_layout == "paged" and self.prefix_cache)
+        elif self.kv_spill and (self.kv_layout != "paged"
+                                or not self.prefix_cache):
+            raise ValueError(
+                "kv_spill requires kv_layout='paged' with "
+                "prefix_cache=True (the spill hook rides prefix-cache "
+                "eviction)")
+        if self.kv_host_tier_bytes is None:
+            object.__setattr__(
+                self, "kv_host_tier_bytes",
+                int(GlobalConfig.serve_kv_host_tier_bytes))
+        for name, knob in (
+                ("kv_adopt_cost_fixed_ms",
+                 GlobalConfig.serve_kv_adopt_cost_fixed_ms),
+                ("kv_adopt_cost_per_block_ms",
+                 GlobalConfig.serve_kv_adopt_cost_per_block_ms),
+                ("kv_prefill_cost_per_token_ms",
+                 GlobalConfig.serve_kv_prefill_cost_per_token_ms)):
+            if getattr(self, name) is None:
+                object.__setattr__(self, name, float(knob))
         if self.kv_block_size is None:
             object.__setattr__(self, "kv_block_size",
                                int(GlobalConfig.serve_kv_block_size))
@@ -206,6 +245,11 @@ class RequestHandle:
         # completion and by preemption; consumed by submit_adopted /
         # readmission.
         self.kv_state: Optional[Any] = None
+        # Prompt positions THIS engine actually prefilled (suffix after
+        # prefix-cache hits and tier promotes; summed across chunks).
+        # len(prompt) - prefilled_tokens is the prefill work avoided —
+        # the bench's FLOPs-avoided numerator and its pacing input.
+        self.prefilled_tokens = 0
         self._done = threading.Event()
         self._engine: Optional["LLMEngine"] = None
         self._chunk_ends: List[int] = []   # chunked-prefill boundaries
@@ -288,12 +332,20 @@ class LLMEngine:
         self._paged = c.kv_layout == "paged"
         if self._paged:
             from ray_tpu.serve.llm.kv_cache import (BlockAllocator,
-                                                    PrefixCache)
+                                                    KVTierManager,
+                                                    PrefixCache,
+                                                    PromoteCostModel)
 
             self._cache = init_paged_kv_cache(
                 model_config, c.pool_blocks, c.kv_block_size)
+            # HBM bytes per block (k + v rows across all layers) — the
+            # byte-accounting basis for allocator/prefix/tier stats.
+            block_bytes = int(
+                (self._cache["k"].nbytes + self._cache["v"].nbytes)
+                // self._cache["k"].shape[1])
             self._allocator = BlockAllocator(c.pool_blocks,
-                                             c.kv_block_size)
+                                             c.kv_block_size,
+                                             block_bytes=block_bytes)
             self._prefix = (PrefixCache(self._allocator)
                             if c.prefix_cache else None)
             # Per-slot block tables (host copy is the truth; the device
@@ -302,11 +354,24 @@ class LLMEngine:
             self._tables = np.zeros((B, c.max_blocks_per_slot), np.int32)
             self._slot_blocks: List[List[int]] = [[] for _ in range(B)]
             self._prefix_seen = {"hits": 0, "misses": 0,
-                                 "hit_tokens": 0, "evictions": 0}
+                                 "hit_tokens": 0, "evictions": 0,
+                                 "spilled": 0}
+            self._cost_model = PromoteCostModel(
+                adopt_fixed_s=c.kv_adopt_cost_fixed_ms * 1e-3,
+                adopt_per_block_s=c.kv_adopt_cost_per_block_ms * 1e-3,
+                prefill_per_token_s=c.kv_prefill_cost_per_token_ms
+                * 1e-3)
+            self._tiers = None
+            if c.kv_spill and self._prefix is not None:
+                self._tiers = KVTierManager(
+                    c.kv_host_tier_bytes, c.kv_block_size,
+                    put_fn=_tier_store_put, get_fn=_tier_store_get)
+                self._prefix.spill_fn = self._spill_evicted
         else:
             self._cache = init_kv_cache(model_config, B, c.max_seq_len)
             self._allocator = None
             self._prefix = None
+            self._tiers = None
         self._tok = jnp.zeros((B,), jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
         self._key = jax.random.key(rng_seed)
@@ -331,6 +396,16 @@ class LLMEngine:
         self._preempted = 0
         self._migrated_blocks = 0       # KVStates adopted into this pool
         self._migrated_bytes = 0
+        self._promoted_blocks = 0       # tier blocks re-adopted to HBM
+        self._promote_skips = 0         # cost model chose recompute
+        self._tier_seen = {t: {"hits": 0, "misses": 0, "spills": 0,
+                               "promotes": 0}
+                           for t in ("host", "store")}
+        # Cross-thread control calls executed by step() on the
+        # scheduler thread (the only thread allowed to touch device
+        # state alongside the donating programs) — export_prefix from
+        # a replica's Serve thread goes through here.
+        self._ctrl_q: deque = deque()
 
         from ray_tpu.observability.control import Hysteresis
 
@@ -777,7 +852,7 @@ class LLMEngine:
 
     def has_work(self) -> bool:
         return (any(self._queues.values()) or bool(self._active.any())
-                or bool(self._cancelled))
+                or bool(self._cancelled) or bool(self._ctrl_q))
 
     # ------------------------------------------------------------ scheduling
 
@@ -876,6 +951,7 @@ class LLMEngine:
                         self.params, self._cache, self._tok, self._pos,
                         padded, np.int32(P), np.int32(slot),
                         np.float32(req.temperature), self._key)
+                handle.prefilled_tokens += P
                 ok = True
             if not ok:
                 self._free.appendleft(slot)
@@ -953,17 +1029,48 @@ class LLMEngine:
                 break
             self._allocator.free([hit_blocks.pop()])
         n_hit = len(hit_blocks)
-        hist_len = n_hit * bs
-        suffix_len = P - hist_len
-        bucket = self._bucket_for(suffix_len)
-        # Fresh blocks: the rest of the sequence, but at least the
-        # whole suffix bucket — its scatter writes full blocks, and
-        # every written block must be owned by this slot.
-        n_new = max(need_total - n_hit, bucket // bs)
-        new_blocks = self._allocator.alloc(n_new)
-        if new_blocks is None and self._prefix is not None:
-            self._prefix.evict(n_new - self._allocator.free_blocks)
+        # Tier continuation: extend the HBM hit with spilled chain
+        # links, re-adopted through the adopt scatter — but only when
+        # the cost model says the transfer beats recomputing those
+        # positions (short suffixes recompute; the crossover is the
+        # whole point of the hierarchy).
+        promote: List[Any] = []
+        if self._tiers is not None and self._prefix is not None:
+            cap = (P - 1) // bs - n_hit
+            if cap > 0:
+                promote = self._tiers.lookup(prompt, bs,
+                                             start_depth=n_hit,
+                                             max_blocks=cap)
+            # Same table-fit trim as the HBM hit above.
+            while promote:
+                hl = (n_hit + len(promote)) * bs
+                if hl + self._bucket_for(P - hl) <= c.max_seq_len:
+                    break
+                promote.pop()
+            if promote and not self._cost_model.should_promote(
+                    len(promote), bs):
+                self._promote_skips += len(promote)
+                promote = []
+        while True:
+            n_pro = len(promote)
+            hist_len = (n_hit + n_pro) * bs
+            suffix_len = P - hist_len
+            bucket = self._bucket_for(suffix_len)
+            # Fresh blocks: the rest of the sequence, but at least the
+            # promoted links plus the whole suffix bucket — the adopt
+            # and insert scatters write full blocks, and every written
+            # block must be owned by this slot.
+            n_new = max(need_total - n_hit, n_pro + bucket // bs)
             new_blocks = self._allocator.alloc(n_new)
+            if new_blocks is None and self._prefix is not None:
+                self._prefix.evict(n_new - self._allocator.free_blocks)
+                new_blocks = self._allocator.alloc(n_new)
+            if new_blocks is not None or not promote:
+                break
+            # All-or-nothing promote: the pool cannot cover the full
+            # run even after eviction — drop the promote entirely
+            # (tier entries untouched) and retry as a plain recompute.
+            promote = []
         if new_blocks is None:
             if hit_blocks:
                 self._allocator.free(hit_blocks)
@@ -976,15 +1083,21 @@ class LLMEngine:
             self._tables[slot] = row
             self._slot_blocks[slot] = blocks
 
+        if promote:
+            # Land the tier links in new_blocks[:n_pro] BEFORE the
+            # insert below reads them as history.
+            self._promote_tier_hits(promote, new_blocks[:n_pro], slot)
         padded = np.zeros((bucket,), np.int32)
         padded[:suffix_len] = np.asarray(prompt[hist_len:], np.int32)
-        scatter_ids = np.asarray(new_blocks[:bucket // bs], np.int32)
+        scatter_ids = np.asarray(new_blocks[n_pro:n_pro + bucket // bs],
+                                 np.int32)
         self._cache, self._tok, self._pos, self._key = \
             self._jit_insert(
                 self.params, self._cache, self._tok, self._pos,
                 row, np.int32(hist_len), padded, np.int32(suffix_len),
                 scatter_ids, np.int32(slot),
                 np.float32(req.temperature), self._key)
+        handle.prefilled_tokens += suffix_len
         if self._prefix is not None:
             # Register the prompt's FULL blocks (all rows real) so the
             # next request sharing this prefix skips their prefill.
@@ -1200,6 +1313,183 @@ class LLMEngine:
         state.validate()
         return state
 
+    # ------------------------------------------------------- KV tiering
+
+    def _spill_evicted(self, victims: List[Any]) -> int:
+        """PrefixCache eviction hook: gather the victims' HBM rows
+        (still cache-owned at this point — the free happens after we
+        return) and park them in the tier manager as one single-block
+        KVPrefix per chain link. Batched through the existing export
+        program — the padded id row is data, so a spill adds ZERO new
+        traces. Runs on the scheduler thread (eviction only happens
+        there)."""
+        import numpy as np
+
+        from ray_tpu.serve.llm.kv_cache import KVPrefix
+
+        if self._tiers is None:
+            return 0
+        c = self.config
+        bs = c.kv_block_size
+        ents = [e for e in victims if e.tokens]
+        if not ents:
+            return 0
+        nb = c.max_blocks_per_slot
+        prefixes: List[Any] = []
+        for i in range(0, len(ents), nb):
+            chunk = ents[i:i + nb]
+            row = np.zeros((nb,), np.int32)
+            row[:len(chunk)] = [e.block for e in chunk]
+            kb, vb = self._jit_export(self._cache, row)
+            kb, vb = np.asarray(kb), np.asarray(vb)
+            for j, e in enumerate(chunk):
+                prefixes.append(KVPrefix(
+                    tokens=e.tokens, block_size=bs,
+                    k_blocks=kb[:, j:j + 1].copy(),
+                    v_blocks=vb[:, j:j + 1].copy()))
+        return self._tiers.spill(prefixes)
+
+    def _promote_tier_hits(self, hits: List[Any],
+                           dst_blocks: List[int], slot: int) -> None:
+        """Scatter tier-resident chain links into freshly-allocated
+        pool blocks through the ONE adopt program (padding ids point
+        one past the pool — dropped under jit). The tok/pos writes are
+        placeholders: the insert that follows for the same slot owns
+        them (and a throwaway slot is never activated). Tier entries
+        are popped only after the scatter dispatched — the
+        all-or-nothing contract."""
+        import numpy as np
+
+        c = self.config
+        nb = c.max_blocks_per_slot
+        ids = np.full((nb,), c.pool_blocks, np.int32)
+        ids[:len(dst_blocks)] = dst_blocks
+        proto = hits[0].prefix.k_blocks
+        kb = np.zeros((proto.shape[0], nb) + proto.shape[2:],
+                      proto.dtype)
+        vb = np.zeros_like(kb)
+        for j, h in enumerate(hits):
+            kb[:, j] = h.prefix.k_blocks[:, -1]
+            vb[:, j] = h.prefix.v_blocks[:, -1]
+        self._cache, self._tok, self._pos = self._jit_adopt(
+            self._cache, self._tok, self._pos, kb, vb, ids,
+            np.int32(slot), np.int32(0), np.int32(0))
+        self._tiers.pop(hits)
+        self._promoted_blocks += len(hits)
+
+    def call_on_scheduler(self, fn: Callable[[], Any],
+                          timeout_s: float = 60.0) -> Any:
+        """Run ``fn()`` on the scheduler thread between steps and
+        return its result. Device state may only be touched alongside
+        the donating programs from that thread — a concurrent reader
+        could gather a buffer the tick just donated. Deadlocks if
+        called FROM the scheduler thread (call the target directly
+        there)."""
+        box: List[Any] = []
+        ev = threading.Event()
+        with self._lock:
+            self._ctrl_q.append((fn, box, ev))
+        self._work.set()
+        if not ev.wait(timeout_s):
+            raise TimeoutError("scheduler thread did not service the "
+                               "control call (is run() driving it?)")
+        if isinstance(box[0], BaseException):
+            raise box[0]
+        return box[0]
+
+    def _process_ctrl(self) -> bool:
+        with self._lock:
+            batch = list(self._ctrl_q)
+            self._ctrl_q.clear()
+        for fn, box, ev in batch:
+            try:
+                box.append(fn())
+            except BaseException as e:          # relayed to the caller
+                box.append(e)
+            ev.set()
+        return bool(batch)
+
+    def export_prefix(self, tokens: Sequence[int],
+                      max_blocks: Optional[int] = None) -> List[Any]:
+        """Donor side of a peer pull: the longest HBM + tier chain
+        covering a prefix of ``tokens``, as one single-block KVPrefix
+        per link (plain ndarrays — a task returning them rides the
+        object store zero-copy). Non-destructive: the donor keeps its
+        copies. Must run on the scheduler thread — wrap in
+        :meth:`call_on_scheduler` from anywhere else."""
+        import numpy as np
+
+        from ray_tpu.serve.llm.kv_cache import KVPrefix
+
+        if not self._paged or self._prefix is None:
+            return []
+        c = self.config
+        bs = c.kv_block_size
+        cap = len(tokens) // bs
+        if max_blocks is not None:
+            cap = min(cap, max_blocks)
+        if cap <= 0:
+            return []
+        out: List[Any] = []
+        hit = self._prefix.match(tokens, max_blocks=cap)
+        if hit:
+            nb = c.max_blocks_per_slot
+            n = min(len(hit), nb)
+            row = np.zeros((nb,), np.int32)
+            row[:n] = hit[:n]
+            kb, vb = self._jit_export(self._cache, row)
+            kb, vb = np.asarray(kb), np.asarray(vb)
+            for j in range(n):
+                out.append(KVPrefix(
+                    tokens=tuple(tokens[: (j + 1) * bs]),
+                    block_size=bs,
+                    k_blocks=kb[:, j:j + 1].copy(),
+                    v_blocks=vb[:, j:j + 1].copy()))
+            self._allocator.free(hit)       # match increfed for us
+        if self._tiers is not None and len(out) < cap:
+            for h in self._tiers.lookup(tokens, bs,
+                                        start_depth=len(out),
+                                        max_blocks=cap - len(out)):
+                out.append(h.prefix)
+        return out
+
+    def import_prefix(self, prefixes: Sequence[Any]) -> int:
+        """Receiver side of a peer pull: park pulled chain links in the
+        host tier; the pulling request's admission then promotes them
+        through the normal cost-model path. Thread-safe (tier manager
+        locks) — no scheduler hop needed."""
+        if self._tiers is None:
+            return 0
+        return self._tiers.spill(list(prefixes))
+
+    def prefix_index_heads(self,
+                           max_heads: Optional[int] = None
+                           ) -> List[Tuple[int, int]]:
+        """What this replica publishes to the cluster-wide prefix
+        index: ``(stable_hash, depth)`` chain links it can serve
+        without prefilling — HBM-resident first (hottest), then tier
+        residents — deduped and capped at
+        ``serve_prefix_index_max_heads``."""
+        from ray_tpu._private.config import GlobalConfig
+
+        if max_heads is None:
+            max_heads = int(GlobalConfig.serve_prefix_index_max_heads)
+        heads: List[Tuple[int, int]] = []
+        seen: set = set()
+        sources: List[List[Tuple[int, int]]] = []
+        if self._prefix is not None:
+            sources.append(self._prefix.snapshot_heads(max_heads))
+        if self._tiers is not None:
+            sources.append(self._tiers.stable_heads(max_heads))
+        for src in sources:
+            for h, d in src:
+                if len(heads) >= max_heads:
+                    return heads
+                if h not in seen:
+                    seen.add(h)
+                    heads.append((h, d))
+        return heads
+
     def preempt(self, slot: int) -> None:
         """Checkpoint a live slot and requeue it at its lane head: the
         sequence's KV blocks are exported onto the handle
@@ -1329,6 +1619,7 @@ class LLMEngine:
         import numpy as np
 
         did_cancel = bool(self._cancelled)
+        did_ctrl = self._process_ctrl()
         self._process_cancels()
         self._maybe_preempt()
         self._admit_blocked = False
@@ -1348,7 +1639,7 @@ class LLMEngine:
                     self._emit(slot, int(tok_host[slot]))
         if not self._active.any():
             self._update_gauges()
-            return bool(inserted) or did_cancel
+            return bool(inserted) or did_cancel or did_ctrl
         live = np.nonzero(self._active)[0]
         if self._spec_ready(live):
             toks_host, n_emit = self._spec_tick()
@@ -1450,6 +1741,23 @@ class LLMEngine:
                     if d > 0:
                         ctr.inc(float(d))
                         seen[field] = cur[field]
+            if self._tiers is not None:
+                ts = self._tiers.stats()
+                for tier in ("host", "store"):
+                    cur, seen = ts[tier], self._tier_seen[tier]
+                    for field, ctr in (
+                            ("hits", m.prefix_tier_hits),
+                            ("misses", m.prefix_tier_misses),
+                            ("spills", m.prefix_tier_spills),
+                            ("promotes", m.prefix_tier_promotes)):
+                        d = cur[field] - seen[field]
+                        if d > 0:
+                            ctr.inc(float(d), tags={"tier": tier})
+                            seen[field] = cur[field]
+                    m.kv_tier_bytes.set(float(cur["bytes"]),
+                                        tags={"tier": tier})
+                m.kv_tier_bytes.set(float(self._allocator.used_bytes),
+                                    tags={"tier": "hbm"})
 
     def run(self, stop_event: threading.Event,
             idle_wait_s: float = 0.02) -> None:
@@ -1540,18 +1848,19 @@ class LLMEngine:
             "trace_count": self.trace_count,
         }
         if self._paged:
-            out["kv"] = {
-                "num_blocks": self.config.pool_blocks,
-                "block_size": self.config.kv_block_size,
-                "used_blocks": self._allocator.used_blocks,
-                "free_blocks": self._allocator.free_blocks,
-            }
+            out["kv"] = dict(self._allocator.stats(),
+                             block_size=self.config.kv_block_size)
             out["migration"] = {
                 "blocks": self._migrated_blocks,
                 "bytes": self._migrated_bytes,
             }
             if self._prefix is not None:
                 out["prefix_cache"] = self._prefix.stats()
+            if self._tiers is not None:
+                out["kv_tiers"] = dict(
+                    self._tiers.stats(),
+                    promoted_blocks=self._promoted_blocks,
+                    promote_skips=self._promote_skips)
         if self._draft is not None or self._spec_rounds:
             denom = max(self._spec_proposed, 1)
             out["spec"] = {
@@ -1561,6 +1870,26 @@ class LLMEngine:
                 "accept_ratio": self._spec_accepted / denom,
             }
         return out
+
+
+def _tier_store_put(prefix):
+    """Object-store leg of the KV hierarchy: demote a KVPrefix below
+    host RAM. Raises when no cluster is attached — KVTierManager then
+    counts the drop and moves on (a dropped block is a future
+    recompute, never an error)."""
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker_or_none
+
+    if global_worker_or_none() is None:
+        raise RuntimeError(
+            "no cluster attached: object-store KV tier unavailable")
+    return ray_tpu.put(prefix)
+
+
+def _tier_store_get(ref):
+    import ray_tpu
+
+    return ray_tpu.get(ref, timeout=30.0)
 
 
 def _sample(logits, temp, key):

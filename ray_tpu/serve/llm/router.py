@@ -20,6 +20,22 @@ gap:
   signals from the replicas' own gauges — keep steering replica count
   with no new plumbing.
 
+Cache-aware routing (cluster-wide KV memory hierarchy): replicas
+publish their prefix hash-chain heads to a GCS index
+(``report_prefix_index``); an index thread here polls
+``lookup_prefix_index`` on the same period. A decode pick then scores
+``load - serve_router_cache_weight * expected_hit_blocks``, where the
+expected hit is the longest run of the prompt's block-boundary
+``stable_hash_prefix`` values present in a replica's published heads —
+p2c with a thumb on the scale for KV the replica already holds. The
+index is a hint with PR-7 staleness discipline: if the router's view is
+older than ``serve_prefix_index_ttl_s`` it HOLDs to plain p2c rather
+than chase dead cache state. When the loser of the pick holds
+``serve_peer_pull_min_blocks`` more cached blocks than the winner, the
+router pulls those blocks winner-ward first (donor ``export_prefix`` ->
+chosen ``import_prefix``, payload by ObjectRef, store-to-store) so the
+pick's admission promotes them instead of re-prefilling.
+
 ``build_routed_llm_app`` composes Router(LLM): the inner LLM deployment
 scales (fixed N or ``num_replicas="auto"`` via autoscaling_config), the
 router stays a single cheap replica.
@@ -92,6 +108,18 @@ class LLMRouter:
         if prefill_handle is not None:
             self._pre_app = prefill_handle._app
             self._pre_deployment = prefill_handle._deployment
+        # Cluster prefix index view: replica index_id (from load()) ->
+        # {"heads": [(stable_hash, depth)...], "tiers": {...},
+        #  "age_s": float}, plus when WE last fetched it (HOLD clock).
+        self._index: Dict[str, Dict[str, Any]] = {}
+        self._index_at: float = 0.0            # monotonic, 0 = never
+        self._index_id: Dict[Any, str] = {}    # handle -> index_id
+        self._cache_weight = float(GlobalConfig.serve_router_cache_weight)
+        self._index_ttl = float(GlobalConfig.serve_prefix_index_ttl_s)
+        self._pull_min = int(GlobalConfig.serve_peer_pull_min_blocks)
+        self._cache_outcomes: Dict[str, int] = {
+            "scored": 0, "held": 0, "pulled": 0}
+        self._last_expected: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._closed = False
         self._metrics = serve_metrics()
@@ -118,7 +146,8 @@ class LLMRouter:
             self._apply_prefill(version, replicas)
         for target, name in ((self._poll_loop, "llm-router-poll"),
                              (self._probe_loop, "llm-router-probe"),
-                             (self._push_loop, "llm-router-push")):
+                             (self._push_loop, "llm-router-push"),
+                             (self._index_loop, "llm-router-index")):
             threading.Thread(target=target, daemon=True,
                              name=name).start()
 
@@ -166,19 +195,20 @@ class LLMRouter:
                 time.sleep(1.0)
 
     # ------------------------------------------------------------- probing
-    def _probe_one(self, r: Any) -> float:
+    def _probe_one(self, r: Any) -> Tuple[float, Optional[str]]:
         import ray_tpu
 
         try:
             load = ray_tpu.get(
                 r.handle_request.remote("load", (), {}),
                 timeout=min(5.0, self._probe_interval * 5))
-            return float(load.get("queued", 0)
-                         + load.get("active_slots", 0))
+            return (float(load.get("queued", 0)
+                          + load.get("active_slots", 0)),
+                    load.get("index_id"))
         except Exception:
             # Unreachable/stalled replica: poison its score so
             # traffic shifts away until it answers again.
-            return float("inf")
+            return float("inf"), None
 
     def _probe_loop(self) -> None:
         while not self._closed:
@@ -186,20 +216,52 @@ class LLMRouter:
                 replicas = list(self._replicas)
                 pre = list(self._pre_replicas)
             for r in replicas:
-                depth = self._probe_one(r)
+                depth, index_id = self._probe_one(r)
                 with self._lock:
                     if r in self._depth:
                         self._depth[r] = depth
+                    if index_id:
+                        self._index_id[r] = str(index_id)
                 rid = getattr(r, "_actor_id", id(r))
                 if depth != float("inf"):
                     self._metrics.router_queue_depth.set(
                         depth, tags={"replica": str(rid)})
             for r in pre:
-                depth = self._probe_one(r)
+                depth, _ = self._probe_one(r)
                 with self._lock:
                     if r in self._pre_depth:
                         self._pre_depth[r] = depth
             time.sleep(self._probe_interval)
+
+    def _index_loop(self) -> None:
+        """Poll the GCS cluster prefix index on the publish period; a
+        fetch failure just ages the view until the TTL HOLD trips."""
+        from ray_tpu._private.config import GlobalConfig
+        from ray_tpu._private.worker import global_worker_or_none
+
+        interval = float(
+            GlobalConfig.serve_prefix_index_publish_interval_s)
+        while not self._closed:
+            w = global_worker_or_none()
+            if w is not None:
+                try:
+                    idx = w.gcs.call("lookup_prefix_index", timeout=5)
+                    with self._lock:
+                        self._index = dict(idx or {})
+                        self._index_at = time.monotonic()
+                except Exception:
+                    pass
+            with self._lock:
+                at = self._index_at
+            if at:
+                self._metrics.router_index_age.set(
+                    time.monotonic() - at)
+            time.sleep(interval)
+
+    def _index_age_s(self) -> float:
+        with self._lock:
+            at = self._index_at
+        return (time.monotonic() - at) if at else float("inf")
 
     def _push_loop(self) -> None:
         """Handle-metrics push: the autoscaler's inflight law sees the
@@ -228,6 +290,41 @@ class LLMRouter:
                         + self._depth.get(r, 0.0) for r in replicas}
         return replicas, load
 
+    def _expected_hits(self, prompt: Sequence[int]) -> Dict[str, int]:
+        """Per-replica expected prefix hit, in blocks: the longest run
+        of this prompt's block-boundary stable hashes present in the
+        replica's published heads. Pure function of the index snapshot —
+        consumers on the replica re-verify against real tokens, so a
+        stable-hash collision here only mis-scores, never corrupts."""
+        from ray_tpu.serve.llm.kv_cache import stable_hash_prefix
+
+        with self._lock:
+            index = dict(self._index)
+        out: Dict[str, int] = {}
+        bound_cache: Dict[int, List[int]] = {}  # block_size -> hashes
+        for iid, rec in index.items():
+            try:
+                bs = int(rec.get("tiers", {}).get("block_size", 0))
+            except Exception:
+                bs = 0
+            if bs <= 0:
+                continue
+            if bs not in bound_cache:
+                # Last token never lands in a cached block (it must be
+                # prefilled to produce logits) — same cap as admission.
+                n_bound = max(0, (len(prompt) - 1) // bs)
+                bound_cache[bs] = [
+                    stable_hash_prefix(prompt[:j * bs])
+                    for j in range(1, n_bound + 1)]
+            heads = {int(h) for h, _d in rec.get("heads", ())}
+            n = 0
+            for h in bound_cache[bs]:
+                if h not in heads:
+                    break
+                n += 1
+            out[iid] = n
+        return out
+
     def _pick(self, pool: str) -> Any:
         deadline = time.monotonic() + 30.0
         replicas, load = self._score(pool)
@@ -240,14 +337,80 @@ class LLMRouter:
             replicas, load = self._score(pool)
         return p2c_pick(replicas, load)
 
+    def _pick_cached(self, prompt: Sequence[int]) \
+            -> Tuple[Any, Dict[str, int], str]:
+        """Decode pick with the cluster prefix index applied. Returns
+        (chosen, expected_hits_by_index_id, outcome) where outcome is
+        "scored" (index applied) or "held" (stale/absent index -> plain
+        p2c, PR-7 staleness discipline)."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            replicas, load = self._score("decode")
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no live decode replicas for "
+                    f"{self._app}/{self._deployment}")
+            time.sleep(0.05)
+        stale = self._index_age_s() > self._index_ttl
+        if stale or self._cache_weight <= 0.0 or not prompt:
+            return p2c_pick(replicas, load), {}, "held"
+        expected = self._expected_hits(prompt)
+        if not expected:
+            return p2c_pick(replicas, load), {}, "held"
+        with self._lock:
+            ids = dict(self._index_id)
+        adj = {r: load.get(r, 0.0)
+               - self._cache_weight * expected.get(ids.get(r), 0)
+               for r in replicas}
+        return p2c_pick(replicas, adj), expected, "scored"
+
+    def _maybe_peer_pull(self, chosen: Any, prompt: Sequence[int],
+                         expected: Dict[str, int],
+                         timeout: float) -> bool:
+        """If some OTHER replica holds serve_peer_pull_min_blocks more
+        of this prompt's prefix than the chosen one, pull its chain into
+        the chosen replica's host tier before forwarding, so admission
+        promotes instead of re-prefilling. Synchronous on purpose — the
+        import must land before the request does. Best-effort: any
+        failure falls back to plain recompute on the chosen replica."""
+        import ray_tpu
+
+        with self._lock:
+            ids = dict(self._index_id)
+            replicas = list(self._replicas)
+        mine = expected.get(ids.get(chosen), 0)
+        donor, donor_hits = None, mine
+        for r in replicas:
+            if r is chosen:
+                continue
+            hits = expected.get(ids.get(r), 0)
+            if hits > donor_hits:
+                donor, donor_hits = r, hits
+        if donor is None or donor_hits - mine < self._pull_min:
+            return False
+        try:
+            # export ref flows donor -> store -> chosen; the Replica
+            # layer materializes ObjectRef args in the chosen process.
+            ref = donor.handle_request.remote(
+                "export_prefix", (list(prompt),), {})
+            n = ray_tpu.get(
+                chosen.handle_request.remote(
+                    "import_prefix", (ref,), {}),
+                timeout=min(30.0, timeout))
+            return bool(n)
+        except Exception:
+            return False
+
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         import ray_tpu
 
         lane = str(request.get("slo", "interactive"))
+        prompt = request.get("prompt", ())
         two_hop = (self._pre_app is not None
-                   and len(request.get("prompt", ()))
-                   >= self._pre_threshold)
-        chosen = self._pick("decode")
+                   and len(prompt) >= self._pre_threshold)
+        chosen, expected, outcome = self._pick_cached(prompt)
         rid = str(getattr(chosen, "_actor_id", id(chosen)))
         pre = self._pick("prefill") if two_hop else None
         with self._lock:
@@ -263,8 +426,24 @@ class LLMRouter:
         self._metrics.router_requests.inc(tags={"replica": rid})
         self._metrics.router_lane_requests.inc(
             tags={"lane": key[0], "pool": key[1]})
+        self._metrics.router_cache_hops.inc(tags={"outcome": outcome})
+        with self._lock:
+            self._cache_outcomes[outcome] = \
+                self._cache_outcomes.get(outcome, 0) + 1
+            if outcome == "scored":
+                self._last_expected = dict(expected)
         try:
             timeout = float(request.get("timeout_s", 300.0))
+            # Peer pull: only on the single-hop path (two-hop already
+            # moves KV prefill->decode) and only off a scored pick.
+            if (outcome == "scored" and not two_hop
+                    and self._maybe_peer_pull(chosen, prompt, expected,
+                                              timeout)):
+                self._metrics.router_cache_hops.inc(
+                    tags={"outcome": "pulled"})
+                with self._lock:
+                    self._cache_outcomes["pulled"] = \
+                        self._cache_outcomes.get("pulled", 0) + 1
             if two_hop:
                 # Two-hop disaggregated path. The prefill result — KV
                 # blocks included — is forwarded as an ObjectRef: the
@@ -289,8 +468,21 @@ class LLMRouter:
 
     # ------------------------------------------------------------- inspection
     def stats(self) -> Dict[str, Any]:
+        age = self._index_age_s()
         with self._lock:
             out = {
+                "cache_index": {
+                    # inf -> None so the dict stays JSON-serializable
+                    # for the dashboard rollup.
+                    "age_s": (None if age == float("inf")
+                              else round(age, 3)),
+                    "fresh": age <= self._index_ttl,
+                    "ttl_s": self._index_ttl,
+                    "weight": self._cache_weight,
+                    "replicas_indexed": len(self._index),
+                    "outcomes": dict(self._cache_outcomes),
+                    "expected_hit_blocks": dict(self._last_expected),
+                },
                 "replicas": len(self._replicas),
                 "inflight": sum(self._inflight.values()),
                 "routed": dict(self._routed),

@@ -30,3 +30,25 @@ def dropped_put(value):
     # stored object; dropping it strands the value in plasma.
     ray_tpu.put(value)
     return value
+
+
+class SpillTierBad:
+    """KV-tier demotion that strands its store refs (the pinned-spill-ref
+    anti-pattern): the put ref is the spilled payload's ONLY handle, so
+    losing it makes the blocks unpromotable AND unreclaimable."""
+
+    def __init__(self):
+        self._keys = []
+
+    def demote(self, key, payload):
+        # objectref-dropped: only the key is recorded; the ref — and
+        # with it the payload — is gone before any promote can run.
+        ray_tpu.put(payload)
+        self._keys.append(key)
+
+    def redemote(self, payload_a, payload_b):
+        # objectref-leak: re-spilling over the same binding unpins the
+        # first payload while a stale index entry still points at it.
+        ref = ray_tpu.put(payload_a)
+        ref = ray_tpu.put(payload_b)
+        return ray_tpu.get(ref)

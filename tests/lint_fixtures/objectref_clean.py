@@ -42,6 +42,22 @@ def put_and_pass(value, actor):
     return actor.consume.remote(ref)
 
 
+class SpillTierClean:
+    """Pinned-spill-ref done right: the ledger keeps every demote's ref
+    (the payload's only handle) alive until the promote consumes it."""
+
+    def __init__(self):
+        self._store = {}
+
+    def demote(self, key, payload):
+        # Stored in a self-owned ledger: the ref stays reachable.
+        self._store[key] = ray_tpu.put(payload)
+
+    def promote(self, key):
+        # pop-then-get commits consumption; the ref dies resolved.
+        return ray_tpu.get(self._store.pop(key))
+
+
 def waited_then_got(actor, xs):
     refs = [actor.compute.remote(x) for x in xs]
     ready, rest = ray_tpu.wait(refs, num_returns=1)

@@ -1,15 +1,19 @@
 """Paged KV-cache bookkeeping (serve/llm/kv_cache.py): the fixed-pool
-block allocator (alloc/free, copy-on-write refcounts, exhaustion) and
-the prefix cache (hit/miss accounting, LRU eviction, block ownership).
+block allocator (alloc/free, copy-on-write refcounts, exhaustion, byte
+accounting), the prefix cache (hit/miss accounting, LRU eviction, block
+ownership, spill hook), and the KV memory hierarchy below HBM
+(KVTierManager spill/lookup/pop, budget demotion, PromoteCostModel).
 
 Pure host-side data structures — no JAX, no model; everything here runs
 in milliseconds.
 """
 
+import numpy as np
 import pytest
 
 from ray_tpu.serve.llm.kv_cache import (
-    BlockAllocator, PrefixCache, hash_prefix,
+    BlockAllocator, KVPrefix, KVTierManager, PrefixCache,
+    PromoteCostModel, hash_prefix, stable_hash_prefix,
 )
 
 
@@ -142,3 +146,206 @@ class TestPrefixCache:
         pc.clear()
         assert a.used_blocks == used_before
         assert pc.stats()["entries"] == 0
+
+    def test_byte_accounting(self):
+        a = BlockAllocator(num_blocks=8, block_size=4, block_bytes=1024)
+        pc = PrefixCache(a)
+        assert a.free_bytes == 8 * 1024 and a.used_bytes == 0
+        blocks = a.alloc(2)
+        assert a.used_bytes == 2048
+        assert a.stats()["block_bytes"] == 1024
+        pc.insert(list(range(8)), blocks)
+        hit = pc.match(list(range(8)))
+        a.free(hit)
+        st = pc.stats()
+        assert st["hit_bytes"] == 2 * 1024
+        pc.clear()
+        assert pc.stats()["evicted_bytes"] == 2 * 1024
+        a.free(blocks)
+        assert a.used_bytes == 0
+
+    def test_spill_hook_sees_victims_before_free(self):
+        """The spill hook fires while the cache still owns the victim
+        blocks (refcount alive — HBM rows still valid), in eviction
+        order, with the covered token prefix attached; a hook that
+        raises is counted and never blocks the eviction."""
+        a = BlockAllocator(num_blocks=8, block_size=4, block_bytes=64)
+        pc = PrefixCache(a)
+        tokens = list(range(8))
+        blocks = a.alloc(2)
+        pc.insert(tokens, blocks)
+        a.free(blocks)                       # cache is the only owner
+        seen = []
+
+        def hook(victims):
+            for e in victims:
+                # cache ref still held: the block is NOT free yet
+                assert a.refcount(e.block) >= 1
+                seen.append((e.depth, tuple(e.tokens)))
+            return len(victims)
+
+        pc.spill_fn = hook
+        assert pc.evict(2) == 2
+        assert (1, tuple(tokens[:4])) in seen
+        assert (2, tuple(tokens)) in seen
+        st = pc.stats()
+        assert st["spilled"] == 2 and st["spilled_bytes"] == 2 * 64
+        assert a.used_blocks == 0            # eviction still freed them
+
+        # A raising hook: counted, eviction proceeds.
+        blocks = a.alloc(2)
+        pc.insert(list(range(100, 108)), blocks)
+        a.free(blocks)
+        pc.spill_fn = lambda victims: 1 / 0
+        assert pc.evict(2) == 2
+        assert pc.stats()["spill_errors"] == 1
+        assert a.used_blocks == 0
+
+    def test_snapshot_heads_stable_and_hot_first(self):
+        a, pc = self._setup()
+        t1, t2 = list(range(8)), list(range(50, 58))
+        b1, b2 = a.alloc(2), a.alloc(2)
+        pc.insert(t1, b1)
+        pc.insert(t2, b2)
+        a.free(pc.match(t1))                 # t1 most recently matched
+        heads = pc.snapshot_heads()
+        assert heads[0] == (stable_hash_prefix(t1), 2)
+        assert (stable_hash_prefix(t2[:4]), 1) in heads
+        assert pc.snapshot_heads(max_heads=1) == heads[:1]
+        a.free(b1), a.free(b2)
+
+
+def _prefix(tokens, bs=4, n_blocks=None, fill=1.0):
+    """A KVPrefix covering ``tokens`` whose payload is the LAST
+    ``n_blocks`` blocks (default: the final chain link only)."""
+    tokens = tuple(tokens)
+    nb = 1 if n_blocks is None else n_blocks
+    kb = np.full((2, nb, bs, 1, 2), fill, np.float32)
+    return KVPrefix(tokens=tokens, block_size=bs,
+                    k_blocks=kb, v_blocks=kb * 2)
+
+
+class TestKVTierManager:
+    def test_spill_lookup_pop_roundtrip(self):
+        tm = KVTierManager(host_budget_bytes=1 << 20, block_size=4)
+        tokens = list(range(12))             # 3 chain links
+        chain = [_prefix(tokens[: (j + 1) * 4], fill=float(j))
+                 for j in range(3)]
+        assert tm.spill(chain) == 3
+        hits = tm.lookup(tokens + [99], 4)
+        assert [h.tier for h in hits] == ["host"] * 3
+        assert [len(h.prefix.tokens) for h in hits] == [4, 8, 12]
+        # payloads come back bitwise
+        assert np.array_equal(hits[1].prefix.k_blocks,
+                              chain[1].k_blocks)
+        # lookup is non-destructive; pop commits consumption
+        assert len(tm) == 3
+        tm.pop(hits[:2])
+        assert len(tm) == 1
+        st = tm.stats()
+        assert st["host"]["spills"] == 3
+        assert st["host"]["promotes"] == 2
+        assert st["host"]["hits"] == 3
+
+    def test_lookup_continues_from_hbm_depth_and_caps(self):
+        tm = KVTierManager(host_budget_bytes=1 << 20, block_size=4)
+        tokens = list(range(16))
+        tm.spill([_prefix(tokens[: (j + 1) * 4]) for j in range(4)])
+        hits = tm.lookup(tokens, 4, start_depth=2)
+        assert [len(h.prefix.tokens) for h in hits] == [12, 16]
+        hits = tm.lookup(tokens, 4, start_depth=1, max_blocks=1)
+        assert [len(h.prefix.tokens) for h in hits] == [8]
+
+    def test_hash_collision_verified_against_tokens(self):
+        """A tier hit must match the real tokens, not just the key —
+        plant a colliding entry and the lookup rejects it."""
+        tm = KVTierManager(host_budget_bytes=1 << 20, block_size=4)
+        tokens = list(range(8))
+        evil = _prefix([7, 7, 7, 7, 7, 7, 7, 7])
+        tm._host[hash_prefix(tuple(tokens))] = evil  # forged key
+        assert tm.lookup(tokens, 4) == []
+        assert tm.stats()["host"]["misses"] >= 1
+
+    def test_budget_demotes_to_store_and_promotes_back(self):
+        store = {}
+
+        def put_fn(p):
+            ref = f"ref{len(store)}"
+            store[ref] = p
+            return ref
+
+        one = _prefix(list(range(4))).payload_bytes
+        tm = KVTierManager(host_budget_bytes=one, block_size=4,
+                           put_fn=put_fn, get_fn=store.get)
+        t1, t2 = list(range(4)), list(range(40, 44))
+        tm.spill([_prefix(t1)])
+        tm.spill([_prefix(t2)])              # over budget: t1 demotes
+        st = tm.stats()
+        assert st["host"]["blocks"] == 1 and st["store"]["blocks"] == 1
+        assert st["store"]["spills"] == 1
+        (hit,) = tm.lookup(t1, 4)
+        assert hit.tier == "store"
+        assert tuple(hit.prefix.tokens) == tuple(t1)
+        tm.pop([hit])
+        assert tm.stats()["store"]["promotes"] == 1
+
+    def test_no_store_fn_drops_and_counts(self):
+        one = _prefix(list(range(4))).payload_bytes
+        tm = KVTierManager(host_budget_bytes=one, block_size=4)
+        tm.spill([_prefix(list(range(4)))])
+        tm.spill([_prefix(list(range(40, 44)))])
+        st = tm.stats()
+        assert st["host"]["blocks"] == 1
+        assert tm.dropped_blocks == 1 and tm.dropped_bytes == one
+
+    def test_invalid_prefix_rejected(self):
+        tm = KVTierManager(host_budget_bytes=1 << 20, block_size=4)
+        bad = _prefix(list(range(6)))        # not whole blocks
+        assert tm.spill([bad]) == 0
+        assert len(tm) == 0
+
+    def test_stable_heads(self):
+        tm = KVTierManager(host_budget_bytes=1 << 20, block_size=4)
+        tokens = list(range(8))
+        tm.spill([_prefix(tokens[:4]), _prefix(tokens)])
+        heads = tm.stable_heads()
+        assert (stable_hash_prefix(tokens[:4]), 1) in heads
+        assert heads[0] == (stable_hash_prefix(tokens), 2)  # hottest
+
+
+class TestPromoteCostModel:
+    def test_default_crossover(self):
+        """With the TPU-default costs (2ms fixed adopt + 0.1ms/block vs
+        0.05ms/token prefill at bs=16), recompute wins short chains and
+        the scatter wins from 3 blocks on — and once promotion wins it
+        keeps winning (both costs are linear)."""
+        cm = PromoteCostModel()
+        assert not cm.should_promote(1, 16)
+        assert not cm.should_promote(2, 16)
+        assert cm.should_promote(3, 16)
+        assert all(cm.should_promote(n, 16) for n in range(3, 64))
+
+    def test_costs_scale(self):
+        cm = PromoteCostModel(adopt_fixed_s=1.0, adopt_per_block_s=0.1,
+                              prefill_per_token_s=0.0)
+        assert cm.promote_cost_s(5) == pytest.approx(1.5)
+        assert cm.recompute_cost_s(100) == 0.0
+        assert not cm.should_promote(50, 16)  # free recompute never loses
+
+
+def test_stable_hash_crosses_processes_and_types():
+    """The wire hash must not depend on PYTHONHASHSEED or container
+    type, and must see token VALUES (crc32 over the int64 stream)."""
+    assert stable_hash_prefix([1, 2, 3]) == stable_hash_prefix((1, 2, 3))
+    assert stable_hash_prefix(np.asarray([1, 2, 3])) \
+        == stable_hash_prefix([1, 2, 3])
+    assert stable_hash_prefix([1, 2, 3]) != stable_hash_prefix([1, 2, 4])
+
+
+def test_kv_prefix_validation():
+    good = _prefix(list(range(8)), n_blocks=2)
+    good.validate()
+    with pytest.raises(ValueError):
+        _prefix(list(range(6))).validate()          # partial block
+    with pytest.raises(ValueError):
+        _prefix(list(range(4)), n_blocks=2).validate()  # blocks > prefix

@@ -1,0 +1,308 @@
+"""Cluster-wide KV memory hierarchy (serve/llm): spill -> promote
+bitwise parity through the host tier, all-or-nothing promotes under
+pool exhaustion, the promote cost model at engine level, the GCS
+cluster prefix index (publish / lookup / head cap / TTL expiry), and
+cache-aware p2c routing beating plain queue-depth p2c on a skewed
+prefix workload.
+
+Compile budget: same (slots, buckets, S, block) geometry as the disagg
+suite, model params memoized per module; each engine re-jits only its
+touched buckets plus the shared export/adopt programs.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+_CACHE = {}
+
+_GEO = dict(num_slots=4, max_seq_len=128, prefill_buckets=(16, 32),
+            kv_layout="paged", kv_block_size=8, decode_block=1)
+
+# 28 tokens = 3 full blocks of history + a 4-token suffix, so a full
+# tier promote leaves real prefill work (the last block + logits).
+_PROMPT = [5 + (i * 11) % 190 for i in range(28)]
+
+
+def _model():
+    if "model" not in _CACHE:
+        import jax
+
+        from ray_tpu.models.llama import LlamaConfig, init_params
+
+        config = LlamaConfig.tiny()
+        _CACHE["model"] = (config, init_params(config, jax.random.key(0)))
+    return _CACHE["model"]
+
+
+def _engine(**overrides):
+    from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine
+
+    config, params = _model()
+    return LLMEngine(params, config,
+                     EngineConfig(**{**_GEO, **overrides}))
+
+
+def _reference(prompt, n):
+    key = (tuple(prompt), n)
+    if key not in _CACHE.setdefault("refs", {}):
+        if "ref_engine" not in _CACHE:
+            _CACHE["ref_engine"] = _engine()
+        from ray_tpu.serve.llm.engine import Request
+
+        e = _CACHE["ref_engine"]
+        h = e.submit(Request(prompt=list(prompt), max_tokens=n))
+        e.drain()
+        _CACHE["refs"][key] = list(h.tokens)
+    return _CACHE["refs"][key]
+
+
+def _run(eng, prompt, n):
+    from ray_tpu.serve.llm.engine import Request
+
+    h = eng.submit(Request(prompt=list(prompt), max_tokens=n))
+    eng.drain()
+    return h
+
+
+def _spill_all(eng):
+    """Evict the whole prefix cache; with kv_spill on, every evicted
+    chain link lands in the host tier (the engine is idle between
+    drains, so driving the spill gather from the test thread is the
+    single-threaded scheduler)."""
+    n = len(eng._prefix)
+    assert eng._prefix.evict(n) == n
+    return n
+
+
+class TestTieredPromote:
+    def test_spill_promote_bitwise_parity(self):
+        """The tentpole invariant: prefill once, spill the chain to the
+        host tier, re-admit the same prompt — the promote path scatters
+        the spilled rows back and the token stream is bitwise identical,
+        with only the suffix actually prefilled."""
+        ref = _reference(_PROMPT, 12)
+        # Prefill "costs" 50ms/token -> the cost model always promotes.
+        eng = _engine(kv_prefill_cost_per_token_ms=50.0)
+        h1 = _run(eng, _PROMPT, 12)
+        assert h1.tokens == ref
+        assert h1.prefilled_tokens == len(_PROMPT)
+
+        assert _spill_all(eng) == 3
+        st = eng.stats()["kv_tiers"]
+        assert st["host"]["blocks"] == 3
+        assert eng._prefix.stats()["spilled"] == 3
+
+        h2 = _run(eng, _PROMPT, 12)
+        assert h2.tokens == ref
+        st = eng.stats()["kv_tiers"]
+        assert st["promoted_blocks"] == 3
+        assert st["host"]["blocks"] == 0        # pop committed
+        # Only the 4-token suffix was prefilled the second time.
+        assert h2.prefilled_tokens == len(_PROMPT) - 3 * 8
+        # Trace budget: tick + per-bucket inserts + the two migration
+        # programs the hierarchy reuses (export gather for the spill,
+        # adopt scatter for the promote) — nothing per-request.
+        assert eng.trace_count <= len(_GEO["prefill_buckets"]) + 3
+
+    def test_promote_all_or_nothing_under_exhaustion(self):
+        """A promote the pool cannot cover is dropped ENTIRELY — tier
+        entries stay banked, no partial scatter — and the request lands
+        as a plain recompute with bitwise parity."""
+        ref = _reference(_PROMPT, 12)
+        eng = _engine(kv_prefill_cost_per_token_ms=50.0)
+        _run(eng, _PROMPT, 12)
+        _spill_all(eng)
+
+        real = eng._allocator.alloc
+        calls = {"n": 0}
+
+        def flaky(n):
+            # Starve the promote attempt (first alloc + post-evict
+            # retry); the recompute retry that follows sees the real
+            # pool.
+            calls["n"] += 1
+            return None if calls["n"] <= 2 else real(n)
+
+        eng._allocator.alloc = flaky
+        try:
+            h2 = _run(eng, _PROMPT, 12)
+        finally:
+            eng._allocator.alloc = real
+        assert calls["n"] >= 3
+        assert h2.tokens == ref
+        st = eng.stats()["kv_tiers"]
+        assert st["promoted_blocks"] == 0
+        assert st["host"]["blocks"] == 3        # lookup never commits
+        assert h2.prefilled_tokens == len(_PROMPT)  # full recompute
+
+    def test_cost_model_prefers_free_recompute(self):
+        """With recompute priced at zero the cost model must never pay
+        for the adopt scatter: tier hits are counted as skips, entries
+        stay banked, and the plain path still reaches parity."""
+        ref = _reference(_PROMPT, 12)
+        eng = _engine(kv_prefill_cost_per_token_ms=0.0)
+        _run(eng, _PROMPT, 12)
+        _spill_all(eng)
+        h2 = _run(eng, _PROMPT, 12)
+        assert h2.tokens == ref
+        st = eng.stats()["kv_tiers"]
+        assert st["promoted_blocks"] == 0
+        assert st["promote_skips"] == 3
+        assert st["host"]["blocks"] == 3
+        assert h2.prefilled_tokens == len(_PROMPT)
+
+    def test_cost_model_default_crossover_unit(self):
+        from ray_tpu.serve.llm.kv_cache import PromoteCostModel
+
+        cm = PromoteCostModel()
+        cross = next(n for n in range(1, 65) if cm.should_promote(n, 16))
+        assert cross == 3
+        assert all(cm.should_promote(n, 16) for n in range(cross, 65))
+
+
+def test_cluster_prefix_index_gcs():
+    """report_prefix_index / lookup_prefix_index: roundtrip,
+    last-write-wins per replica, the serve_prefix_index_max_heads cap,
+    and lazy TTL expiry at lookup. Own cluster: the TTL is read inside
+    the GCS daemon, so it must arrive via _system_config (the same
+    head-to-every-process propagation production overrides use)."""
+    import ray_tpu
+    from ray_tpu._private.config import GlobalConfig
+    from ray_tpu._private.worker import global_worker
+
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 object_store_memory=128 * 1024 * 1024,
+                 _system_config={"serve_prefix_index_ttl_s": 0.5})
+    try:
+        w = global_worker()
+        assert w.gcs.call(
+            "report_prefix_index", timeout=10, replica="repA",
+            heads=[(11, 1), (22, 2)],
+            tiers={"block_size": 8, "host_blocks": 3})
+        idx = w.gcs.call("lookup_prefix_index", timeout=10)
+        rec = idx["repA"]
+        assert [(int(h), int(d)) for h, d in rec["heads"]] \
+            == [(11, 1), (22, 2)]
+        assert rec["tiers"]["block_size"] == 8
+        assert rec["age_s"] >= 0.0
+
+        # Last write wins, hottest-first heads capped at the limit.
+        cap = int(GlobalConfig.serve_prefix_index_max_heads)
+        w.gcs.call("report_prefix_index", timeout=10, replica="repA",
+                   heads=[(i, i + 1) for i in range(cap + 100)],
+                   tiers={})
+        idx = w.gcs.call("lookup_prefix_index", timeout=10)
+        assert len(idx["repA"]["heads"]) == cap
+        assert idx["repA"]["tiers"] == {}
+
+        # Publish IS the heartbeat: a silent replica ages out lazily.
+        time.sleep(0.7)
+        assert "repA" not in w.gcs.call("lookup_prefix_index",
+                                        timeout=10)
+    finally:
+        ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------- routing
+_BS = 8
+
+
+def _family(seed):
+    rng = random.Random(seed)
+    return [rng.randrange(1, 200) for _ in range(3 * _BS)]
+
+
+def _heads_for(tokens):
+    from ray_tpu.serve.llm.kv_cache import stable_hash_prefix
+
+    return [(stable_hash_prefix(tokens[:j * _BS]), j)
+            for j in range(1, len(tokens) // _BS + 1)]
+
+
+def _bare_router(index, index_id, weight, ttl=60.0):
+    """An LLMRouter with only the routing-policy state populated — the
+    pure decision path (_score/_expected_hits/_pick_cached), no actor
+    plumbing, no probe threads."""
+    from ray_tpu.serve.llm.router import LLMRouter
+
+    r = object.__new__(LLMRouter)
+    r._lock = threading.Lock()
+    r._index = dict(index)
+    r._index_at = time.monotonic()
+    r._index_id = dict(index_id)
+    r._cache_weight = weight
+    r._index_ttl = ttl
+    r._replicas = list(index_id)
+    r._inflight = {h: 0 for h in index_id}
+    r._depth = {h: 0.0 for h in index_id}
+    r._pre_replicas = []
+    r._pre_inflight = {}
+    r._pre_depth = {}
+    return r
+
+
+class TestCacheAwareRouting:
+    def _setup(self):
+        fams = [_family(s) for s in range(4)]
+        index = {f"iid{i}": {"heads": _heads_for(f),
+                             "tiers": {"block_size": _BS},
+                             "age_s": 0.1}
+                 for i, f in enumerate(fams)}
+        index_id = {f"rep{i}": f"iid{i}" for i in range(4)}
+        return fams, index, index_id
+
+    def test_expected_hits_longest_boundary_run(self):
+        fams, index, index_id = self._setup()
+        router = _bare_router(index, index_id, weight=0.25)
+        # Full family + tail: every replica scores its own chain only.
+        exp = router._expected_hits(fams[1] + [7])
+        assert exp["iid1"] == 3
+        assert all(exp[f"iid{i}"] == 0 for i in (0, 2, 3))
+        # A diverging second block stops the run after one hit.
+        mutant = fams[1][:_BS] + [0] * _BS + fams[1][2 * _BS:] + [7]
+        assert router._expected_hits(mutant)["iid1"] == 1
+        # The last token is always prefilled: a prompt of exactly 3
+        # blocks can only ever hit 2 (same cap as admission).
+        assert router._expected_hits(fams[1])["iid1"] == 2
+
+    def test_cache_aware_beats_plain_p2c(self):
+        """On a Zipf-skewed family mix, scoring p2c with the published
+        index must route substantially more expected-hit blocks to
+        their owners than load-only p2c — with weight 0.25, i.e. as a
+        tie-break between idle replicas, not a load override."""
+        from ray_tpu.serve.llm.router import p2c_pick
+
+        fams, index, index_id = self._setup()
+        router = _bare_router(index, index_id, weight=0.25)
+        rng = random.Random(42)
+        random.seed(7)                       # p2c_pick's default rng
+        weights = [1.0 / (i + 1) ** 1.3 for i in range(4)]
+        plain = aware = 0
+        for _ in range(200):
+            fam = rng.choices(range(4), weights=weights)[0]
+            prompt = fams[fam] + [rng.randrange(1, 200)]
+            exp = router._expected_hits(prompt)
+            chosen, expected, outcome = router._pick_cached(prompt)
+            assert outcome == "scored" and expected == exp
+            aware += exp.get(index_id[chosen], 0)
+            load = {r: 0.0 for r in index_id}
+            plain += exp.get(index_id[p2c_pick(list(index_id), load)], 0)
+        assert aware >= plain * 1.3
+        assert aware >= 200                  # owners actually chosen
+
+    def test_stale_index_holds_to_plain_p2c(self):
+        """PR-7 staleness discipline: an index view older than the TTL
+        must NOT steer routing — outcome 'held', no expected map."""
+        _, index, index_id = self._setup()
+        router = _bare_router(index, index_id, weight=0.25, ttl=0.05)
+        router._index_at = time.monotonic() - 1.0
+        chosen, expected, outcome = router._pick_cached([1] * 25)
+        assert outcome == "held" and expected == {}
+        assert chosen in index_id
+        # weight 0 disables scoring outright, fresh index or not.
+        router = _bare_router(index, index_id, weight=0.0)
+        _, expected, outcome = router._pick_cached([1] * 25)
+        assert outcome == "held" and expected == {}

@@ -313,7 +313,7 @@ def test_llm_server_quantize_default_and_optout():
     assert srv.quantize == "int8"
     assert srv.stats()["quantize"] == "int8"
     assert set(srv.load()) == {"queued", "active_slots", "free_slots",
-                               "lanes"}
+                               "lanes", "index_id"}
     srv_bf16 = LLMServer(model_config=config, engine_config=econf,
                          quantize="bf16")
     assert srv_bf16.quantize == "bf16"
@@ -444,7 +444,10 @@ def test_serve_paged_bench_smoke():
     assert result["metric"] == "llama_serve_paged"
     assert result["value"] is not None and result["value"] > 0
     d = result["detail"]
-    assert d["engine_traces"] <= len(d["prefill_buckets"]) + 1
+    # + 2: decode tick plus the (single, bounded) adopt scatter that
+    # tier promotes share with disagg migration — still no per-request
+    # or per-shape recompiles.
+    assert d["engine_traces"] <= len(d["prefill_buckets"]) + 2
     assert d["two_vs_one_p99"] < 1.0      # second replica relieves p99
     assert d["prefix_hit_rate"] > 0.3     # 60%-shared trace must hit
     assert d["kv_blocks"]["num_blocks"] > 0
