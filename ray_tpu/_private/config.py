@@ -258,6 +258,20 @@ _define("worker_exit_tail_lines", int, 20,
 _define("metrics_report_interval_s", float, 2.0,
         "Flush cadence of user-defined ray_tpu.util.metrics to the GCS "
         "(reference: metrics_report_interval_ms).")
+_define("trace_sample_rate", float, 0.01,
+        "Tail-sampling keep probability for fast, clean traces in the "
+        "GCS TraceStore. Slow (>= trace_keep_threshold_s) and errored "
+        "traces are always kept — the decision runs at trace "
+        "completion, when the whole trace is visible.")
+_define("trace_keep_threshold_s", float, 0.5,
+        "Root-span duration at or above which a completed trace is "
+        "always kept regardless of trace_sample_rate.")
+_define("trace_store_maxlen", int, 512,
+        "LRU capacity of kept traces in the GCS TraceStore.")
+_define("trace_pending_max", int, 2048,
+        "Bound on in-flight (rootless) traces accumulating in the "
+        "TraceStore; oldest-first eviction, so a crashed hop that "
+        "never sends its root span cannot leak memory.")
 _define("sched_phase_instrumentation", bool, True,
         "Record per-task scheduling-phase timestamps (PENDING -> "
         "LEASE_GRANTED -> WORKER_STARTED -> ARGS_READY -> RUNNING) "

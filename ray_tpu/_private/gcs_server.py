@@ -137,6 +137,18 @@ class GcsServer:
         # here so cluster totals never go backwards when a worker exits.
         self._metric_tombstones: Dict[str, Dict[str, Any]] = {}
 
+        # Request-scoped traces: trace-tagged SPAN events peeled off
+        # push_task_events accumulate here until the root span arrives,
+        # then tail-sample (observability/traces.py). Single-threaded
+        # by design — this handler loop is the only caller.
+        from ray_tpu.observability.traces import TraceStore
+
+        self.trace_store = TraceStore(
+            maxlen=GlobalConfig.trace_store_maxlen,
+            keep_threshold_s=GlobalConfig.trace_keep_threshold_s,
+            sample_rate=GlobalConfig.trace_sample_rate,
+            pending_max=GlobalConfig.trace_pending_max)
+
         # Control-plane decision ring: every autoscale / backpressure /
         # preemption action with the metric reading that triggered it,
         # so "why did it scale?" is answerable from the dashboard
@@ -296,6 +308,7 @@ class GcsServer:
             "summary_cluster_events",
             "report_ctrl_decision", "list_ctrl_decisions",
             "report_prefix_index", "lookup_prefix_index",
+            "get_trace", "list_traces", "trace_stats",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
 
@@ -486,6 +499,43 @@ class GcsServer:
         for state, n in pg_states.items():
             lines.append(
                 f'rtpu_placement_groups{{state="{state}"}} {n}')
+
+        # Tail-sampled trace store health: monotone totals from
+        # TraceStore.stats() plus the two occupancy gauges.
+        ts = self.trace_store.stats()
+        lines += ["# HELP rtpu_trace_kept_total Completed traces kept "
+                  "by tail-sampling, by reason.",
+                  "# TYPE rtpu_trace_kept_total counter",
+                  f"rtpu_trace_kept_total {ts['kept']}",
+                  "# HELP rtpu_trace_sampled_out_total Completed fast, "
+                  "clean traces dropped by trace_sample_rate.",
+                  "# TYPE rtpu_trace_sampled_out_total counter",
+                  f"rtpu_trace_sampled_out_total {ts['sampled_out']}",
+                  "# HELP rtpu_trace_evicted_pending_total Rootless "
+                  "in-flight traces evicted at trace_pending_max.",
+                  "# TYPE rtpu_trace_evicted_pending_total counter",
+                  f"rtpu_trace_evicted_pending_total "
+                  f"{ts['evicted_pending']}",
+                  "# HELP rtpu_trace_evicted_kept_total Kept traces "
+                  "aged out of the trace_store_maxlen LRU ring.",
+                  "# TYPE rtpu_trace_evicted_kept_total counter",
+                  f"rtpu_trace_evicted_kept_total {ts['evicted_kept']}",
+                  "# HELP rtpu_trace_spans_seen_total Trace-tagged SPAN "
+                  "events routed into the trace store.",
+                  "# TYPE rtpu_trace_spans_seen_total counter",
+                  f"rtpu_trace_spans_seen_total {ts['spans_seen']}",
+                  "# HELP rtpu_trace_spans_dropped_total Spans dropped "
+                  "at the per-trace span cap.",
+                  "# TYPE rtpu_trace_spans_dropped_total counter",
+                  f"rtpu_trace_spans_dropped_total {ts['spans_dropped']}",
+                  "# HELP rtpu_trace_pending In-flight (rootless) "
+                  "traces accumulating in the store.",
+                  "# TYPE rtpu_trace_pending gauge",
+                  f"rtpu_trace_pending {ts['pending']}",
+                  "# HELP rtpu_trace_stored Kept traces currently "
+                  "retrievable from the store.",
+                  "# TYPE rtpu_trace_stored gauge",
+                  f"rtpu_trace_stored {ts['stored']}"]
         lines.extend(self._render_user_metrics())
         return "\n".join(lines) + "\n"
 
@@ -496,7 +546,7 @@ class GcsServer:
     async def _h_user_metrics_summary(self, prefixes=None):
         """Aggregated user metrics as plain dicts (dashboard /api/serve).
         ``prefixes``: optional list of metric-name prefixes to keep."""
-        metas, counters, gauges, hists, fresh = \
+        metas, counters, gauges, hists, fresh, exemplars = \
             self._aggregate_user_metrics()
         now = time.time()
         out: Dict[str, Any] = {}
@@ -531,6 +581,11 @@ class GcsServer:
                     }
                 entry["data"] = data
                 entry["boundaries"] = list(bounds)
+                # Max-valued exemplar per label set: the dashboard's
+                # link from a latency histogram to the slowest
+                # request's retrievable trace.
+                entry["exemplars"] = {
+                    k: dict(v) for k, v in exemplars.get(name, {}).items()}
             out[name] = entry
         return out
 
@@ -583,6 +638,16 @@ class GcsServer:
                             prior[i] += v
                 else:
                     data[tagvals] = float(prior) + float(cell)
+            # Exemplars are max-keep, not additive: a dead worker's
+            # slowest-request link stays until a live one beats it.
+            ex = rec.get("exemplars") or {}
+            if ex:
+                tex = tomb.setdefault("exemplars", {})
+                for tagvals, e in ex.items():
+                    prior_ex = tex.get(tagvals)
+                    if (prior_ex is None or float(e.get("value", 0.0))
+                            >= float(prior_ex.get("value", 0.0))):
+                        tex[tagvals] = dict(e)
 
     def _aggregate_user_metrics(self):
         """Merge pushed ray_tpu.util.metrics snapshots (live sources plus
@@ -595,6 +660,8 @@ class GcsServer:
             lambda: defaultdict(float))
         gauges: Dict[str, Dict[str, float]] = defaultdict(dict)
         hists: Dict[str, Dict[str, List[float]]] = defaultdict(dict)
+        # name -> labels -> max-valued exemplar across sources.
+        exemplars: Dict[str, Dict[str, Dict[str, Any]]] = defaultdict(dict)
         # name -> newest push ts among live sources carrying it.
         fresh: Dict[str, float] = {}
         sources = list(self.user_metrics.items())
@@ -632,11 +699,20 @@ class GcsServer:
                         else:
                             for i, v in enumerate(cell):
                                 acc[i] += v
-        return metas, counters, gauges, hists, fresh
+                for tagvals, e in (rec.get("exemplars") or {}).items():
+                    labels = ",".join(
+                        f'{k}="{self._esc_label(v)}"' for k, v in
+                        zip(keys, tagvals.split(",") if keys else ()))
+                    prior = exemplars[name].get(labels)
+                    if (prior is None or float(e.get("value", 0.0))
+                            >= float(prior.get("value", 0.0))):
+                        exemplars[name][labels] = dict(e)
+        return metas, counters, gauges, hists, fresh, exemplars
 
     def _render_user_metrics(self) -> List[str]:
         """User metrics as Prometheus exposition lines."""
-        metas, counters, gauges, hists, _ = self._aggregate_user_metrics()
+        metas, counters, gauges, hists, _, _ = \
+            self._aggregate_user_metrics()
         out: List[str] = []
         for name, meta in metas.items():
             typ = meta["type"]
@@ -1441,12 +1517,26 @@ class GcsServer:
         self.task_events.extend(events)
         for e in events:
             self._task_event_counts[e.get("state", "UNKNOWN")] += 1
+            # Trace-tagged spans additionally feed the tail-sampled
+            # trace store (they stay in the ring for the timeline too).
+            if e.get("state") == "SPAN" and e.get("trace_id"):
+                self.trace_store.add_span(e)
         return True
 
     async def _h_get_task_events(self, job_id=None, limit=1000):
         out = [e for e in self.task_events
                if job_id is None or e.get("job_id") == job_id]
         return out[-limit:]
+
+    # ------------------------------------------------------------------ traces
+    async def _h_get_trace(self, trace_id):
+        return self.trace_store.get(trace_id)
+
+    async def _h_list_traces(self, limit=100):
+        return self.trace_store.summaries(limit=limit)
+
+    async def _h_trace_stats(self):
+        return self.trace_store.stats()
 
     # ----------------------------------------------------------------- workers
     async def _h_register_worker(self, worker_id, info):

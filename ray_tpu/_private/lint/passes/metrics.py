@@ -123,7 +123,8 @@ class MetricsPass(LintPass):
     name = "metric-declarations"
     rules = ("metric-unlintable-name", "metric-name", "metric-family",
              "metric-histogram-suffix", "metric-gauge-pid-tag",
-             "metric-redeclared", "metric-exposition")
+             "metric-redeclared", "metric-exposition",
+             "metric-exemplar-tag")
     description = ("metric naming/family/unit/tag contract + cross-file "
                    "redeclaration consistency + Prometheus exposition "
                    "suffix discipline (ex scripts/check_metrics.py)")
@@ -140,6 +141,7 @@ class MetricsPass(LintPass):
                 continue
             cls = _call_metric_class(node, bindings, mod_aliases)
             if cls is None:
+                out.extend(self._check_exemplar_call(mod, node))
                 continue
             kw = {k.arg: k.value for k in node.keywords if k.arg}
             name_node = node.args[0] if node.args else kw.get("name")
@@ -190,6 +192,14 @@ class MetricsPass(LintPass):
                 f"histogram_quantile() users know what the buckets "
                 f"measure (https://prometheus.io/docs/practices/naming/)")
         tag_keys = d.get("tag_keys")
+        if tag_keys and "trace_id" in tag_keys:
+            yield mod.finding(
+                "metric-exemplar-tag", line,
+                f"metric {name!r} declares tag key 'trace_id' — "
+                f"exemplar identity rides the dedicated "
+                f"observe(..., trace_id=) kwarg and must not widen the "
+                f"declared label set (per-trace labels are unbounded "
+                f"cardinality)")
         if d["class"] == "Gauge" and tag_keys and "pid" in tag_keys:
             yield mod.finding(
                 "metric-gauge-pid-tag", line,
@@ -197,6 +207,26 @@ class MetricsPass(LintPass):
                 f"the exporter appends its own pid=<source> label to "
                 f"every gauge and duplicate label names break the "
                 f"Prometheus scrape")
+
+    def _check_exemplar_call(self, mod: ModuleInfo, call: ast.Call):
+        """``x.observe(v, tags={... "trace_id": ...})`` smuggles the
+        exemplar identity into the label set; it belongs on the
+        dedicated ``trace_id=`` kwarg (which records an exemplar
+        instead of minting a per-trace series)."""
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "observe"):
+            return
+        for k in call.keywords:
+            if k.arg != "tags":
+                continue
+            tags = _literal(k.value)
+            if isinstance(tags, dict) and "trace_id" in tags:
+                yield mod.finding(
+                    "metric-exemplar-tag", call,
+                    "observe() call passes 'trace_id' inside tags= — "
+                    "use the observe(..., trace_id=) exemplar kwarg; "
+                    "a trace_id label mints one series per request "
+                    "and must not change the declared label set")
 
     def _check_exposition(self, mod: ModuleInfo):
         for m in _EXPOSITION_TYPE_RE.finditer(mod.src):

@@ -123,6 +123,13 @@ class TaskSpec:
     # — retries reuse the same spec object and keep the original submit
     # time. Rides the wire as a small dict; executing workers ignore it.
     phase_ts: Optional[Dict[str, float]] = None
+    # Caller's request-scoped trace context (TraceContext.to_wire():
+    # {"t": trace_id, "s": span_id, "b": baggage}) — the executing
+    # worker restores it around the task body so spans recorded
+    # downstream parent under the span active at submit time. Appended
+    # last: __reduce__ tolerates missing trailing fields, so old specs
+    # deserialize with trace_ctx=None.
+    trace_ctx: Optional[Dict[str, Any]] = None
 
     def __reduce__(self):
         return (_rebuild_task_spec, tuple(

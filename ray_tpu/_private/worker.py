@@ -66,6 +66,15 @@ def set_global_worker(w: Optional["Worker"]) -> None:
     _global_worker = w
 
 
+def _current_wire_trace() -> Optional[Dict[str, Any]]:
+    """The caller's active TraceContext as a compact wire dict for the
+    TaskSpec (None when no trace is active) — the submit side of
+    request-scoped trace propagation (util/tracing.py)."""
+    from ray_tpu.util.tracing import current_wire_context
+
+    return current_wire_context()
+
+
 class _PendingObject:
     """Memory-store entry: resolves to inline bytes, a plasma copy, or error."""
 
@@ -395,6 +404,7 @@ class Worker:
         # (reference: `task_event_buffer.h:206` -> `gcs_task_manager.h:85`).
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_lock = threading.Lock()
+        self._task_events_flush_pending = False
 
         # execution state
         self._fn_cache: Dict[str, Any] = {}
@@ -1167,6 +1177,7 @@ class Worker:
                 options.get("runtime_env")),
             parent_task_id=self._ctx.task_id,
             labels=options.get("_labels") or {},
+            trace_ctx=_current_wire_trace(),
         )
         refs = []
         for rid in spec.return_ids():
@@ -1234,6 +1245,34 @@ class Worker:
             self.io.submit(_push())
         except Exception:
             pass
+
+    def flush_task_events_soon(self, delay: float = 0.5) -> None:
+        """Debounced flush: schedule one flush ``delay`` seconds out,
+        coalescing every request made while it is pending. Trace-tagged
+        spans use this so traces assemble at the GCS on a sub-second
+        cadence without a per-span RPC (the plain batch flush only
+        fires at 100 buffered events or shutdown). Thread-safe —
+        ``EventLoopThread.submit`` is."""
+        if self._dead:
+            return
+        with self._task_events_lock:
+            if self._task_events_flush_pending:
+                return
+            self._task_events_flush_pending = True
+
+        async def _later():
+            try:
+                await asyncio.sleep(delay)
+            finally:
+                with self._task_events_lock:
+                    self._task_events_flush_pending = False
+            self.flush_task_events()
+
+        try:
+            self.io.submit(_later())
+        except Exception:
+            with self._task_events_lock:
+                self._task_events_flush_pending = False
 
     def _record_reply_phases(self, spec: TaskSpec,
                              wphases: Dict[str, float],
@@ -2035,6 +2074,7 @@ class Worker:
             name=method_name, actor_id=ActorID(actor_id),
             max_task_retries=max_task_retries,
             concurrency_group=options.get("concurrency_group", ""),
+            trace_ctx=_current_wire_trace(),
         )
         refs = []
         for rid in spec.return_ids():
@@ -2673,6 +2713,11 @@ class Worker:
         tid = spec.task_id.binary()
         self._executing_tids[tid] = threading.get_ident()
         self._thread_task[threading.get_ident()] = tid
+        # Restore the caller's trace context around the task body (the
+        # executor thread is reused, so reset in the finally below).
+        from ray_tpu.util import tracing as _tracing
+
+        trace_token = _tracing.activate_wire_context(spec.trace_ctx)
         t_start = time.monotonic()
         # Scheduling-phase clocks, stamped on THIS host as execution
         # proceeds and returned in the reply: the owner lands them in
@@ -2698,6 +2743,7 @@ class Worker:
             return {"results": [], "app_error": serialize_error(e),
                     "dur": time.monotonic() - t_start, "phases": phases}
         finally:
+            _tracing.deactivate_context(trace_token)
             self._executing_tids.pop(tid, None)
             self._thread_task.pop(threading.get_ident(), None)
             self._mark_log_task(None)
@@ -2992,6 +3038,15 @@ class Worker:
             return {"results": [], "app_error": serialize_error(
                 AttributeError(f"actor has no method {method_name!r}"))}
         self._mark_log_task(spec, actor.spec.actor_id.hex())
+        # Restore the caller's trace context for the method body. Each
+        # push_actor_task dispatch runs as its own asyncio task, so the
+        # contextvar keeps concurrent requests in one max_concurrency>1
+        # actor on disjoint trace identities. Sync methods hop to a
+        # pool thread (contextvars don't cross run_in_executor), so the
+        # callable re-activates the wire context thread-side.
+        from ray_tpu.util import tracing as _tracing
+
+        trace_token = _tracing.activate_wire_context(spec.trace_ctx)
         try:
             args, kwargs = await loop.run_in_executor(
                 self._task_executor, self._resolve_args, spec)
@@ -2999,9 +3054,18 @@ class Worker:
                 async with actor.semaphore:
                     result = await method(*args, **kwargs)
             else:
+                wire = spec.trace_ctx
+
+                def _call_traced():
+                    tok = _tracing.activate_wire_context(wire)
+                    try:
+                        return method(*args, **kwargs)
+                    finally:
+                        _tracing.deactivate_context(tok)
+
                 result = await loop.run_in_executor(
                     actor.executor_for(spec.concurrency_group),
-                    lambda: method(*args, **kwargs))
+                    _call_traced)
             if spec.num_returns < 0:
                 # Actor generator methods stream like normal-task ones:
                 # each yielded item becomes an object, pushed to the owner
@@ -3016,6 +3080,7 @@ class Worker:
         except Exception as e:  # noqa: BLE001
             return {"results": [], "app_error": serialize_error(e)}
         finally:
+            _tracing.deactivate_context(trace_token)
             self._mark_log_task(None, end_tid=spec.task_id.hex())
 
     # ======================================================================

@@ -155,6 +155,15 @@ _PANELS: List[Dict[str, str]] = [
              "(rate(rtpu_collective_exposed_seconds_sum[5m]) + "
              "rate(rtpu_collective_hidden_seconds_sum[5m]))",
      "legend": "{{op}}/{{backend}}", "unit": "percentunit"},
+    # --- request-scoped tracing (util/tracing.py + TraceStore) ---
+    {"title": "Traces kept vs sampled out",
+     "expr": "rate(rtpu_trace_kept_total[5m])",
+     "expr_b": "rate(rtpu_trace_sampled_out_total[5m])",
+     "unit": "short"},
+    {"title": "Trace store pressure (pending, drops/sec)",
+     "expr": "rtpu_trace_pending",
+     "expr_b": "rate(rtpu_trace_spans_dropped_total[5m])",
+     "unit": "short"},
     # --- metrics-driven control plane ---
     {"title": "Serve replicas (autoscaler)",
      "expr": "rtpu_serve_replicas",

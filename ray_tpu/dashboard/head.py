@@ -163,6 +163,40 @@ class DashboardHead:
         text = await self._gcs.acall("metrics_text", timeout=10)
         return web.Response(text=text, content_type="text/plain")
 
+    async def traces(self, req) -> web.Response:
+        """Kept-trace summaries plus TraceStore health counters."""
+        limit = int(req.query.get("limit", 100))
+        summaries = await self._gcs.acall("list_traces", limit=limit,
+                                          timeout=10)
+        stats = await self._gcs.acall("trace_stats", timeout=10)
+        return web.json_response(
+            {"traces": summaries or [], "stats": stats or {}},
+            dumps=lambda o: json.dumps(o, default=str))
+
+    async def trace(self, req) -> web.Response:
+        """One request's assembled causal tree: /api/trace?trace_id=."""
+        trace_id = req.query.get("trace_id")
+        if not trace_id:
+            return web.json_response(
+                {"error": "trace_id query parameter required"},
+                status=400)
+        rec = await self._gcs.acall("get_trace", trace_id=trace_id,
+                                    timeout=10)
+        if rec is None:
+            return web.json_response(
+                {"error": f"no trace {trace_id}"}, status=404)
+        from ray_tpu.util.tracing import build_trace_tree, critical_path
+
+        tree = build_trace_tree(rec.get("spans") or [])
+        tree.update({"trace_id": trace_id,
+                     "complete": bool(rec.get("complete")),
+                     "dur": rec.get("dur"),
+                     "error": rec.get("error", False),
+                     "keep_reason": rec.get("keep_reason"),
+                     "critical_path": critical_path(tree)})
+        return web.json_response(
+            tree, dumps=lambda o: json.dumps(o, default=str))
+
     async def timeline(self, req) -> web.Response:
         """Chrome-trace JSON of the task-event ring buffer — load in
         chrome://tracing or https://ui.perfetto.dev."""
@@ -610,6 +644,8 @@ class DashboardHead:
         app.router.add_get("/api/tasks", self.tasks)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/api/timeline", self.timeline)
+        app.router.add_get("/api/traces", self.traces)
+        app.router.add_get("/api/trace", self.trace)
         app.router.add_get("/api/serve", self.serve_stats)
         app.router.add_get("/api/rl", self.rl_stats)
         app.router.add_get("/api/memory", self.memory)

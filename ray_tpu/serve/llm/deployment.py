@@ -126,15 +126,19 @@ class LLMServer:
         from ray_tpu.serve.llm.engine import Request
         from ray_tpu.util.tracing import span
 
-        handle = self._engine.submit(Request(
-            prompt=list(request["prompt"]),
-            max_tokens=int(request.get("max_tokens", 64)),
-            temperature=float(request.get("temperature", 0.0)),
-            stop=tuple(request.get("stop", ())),
-            slo=str(request.get("slo", "interactive")),
-            chunked_prefill=bool(request.get("chunked_prefill", False))))
+        # Submit INSIDE the span: the engine captures the submitting
+        # thread's trace context on the handle, so llm.request and its
+        # phases parent under this llm.server_call hop.
         with span("llm.server_call",
                   attrs={"prompt_len": len(request["prompt"])}):
+            handle = self._engine.submit(Request(
+                prompt=list(request["prompt"]),
+                max_tokens=int(request.get("max_tokens", 64)),
+                temperature=float(request.get("temperature", 0.0)),
+                stop=tuple(request.get("stop", ())),
+                slo=str(request.get("slo", "interactive")),
+                chunked_prefill=bool(
+                    request.get("chunked_prefill", False))))
             try:
                 tokens = handle.result(timeout=float(
                     request.get("timeout_s", 300.0)))

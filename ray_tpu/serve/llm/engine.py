@@ -250,6 +250,14 @@ class RequestHandle:
         # len(prompt) - prefilled_tokens is the prefill work avoided —
         # the bench's FLOPs-avoided numerator and its pacing input.
         self.prefilled_tokens = 0
+        # Request-scoped tracing: the TraceContext active on the
+        # submitting thread (the replica's llm.server_call span) plus a
+        # pre-allocated span id for this request's llm.request span —
+        # the scheduler thread records phases with no ambient context,
+        # so kv.promote / kv.migrate / phase spans all parent under the
+        # same explicit id.
+        self.trace: Optional[Any] = None
+        self.trace_span_id: Optional[str] = None
         self._done = threading.Event()
         self._engine: Optional["LLMEngine"] = None
         self._chunk_ends: List[int] = []   # chunked-prefill boundaries
@@ -766,6 +774,7 @@ class LLMEngine:
                     f"pool only has {c.pool_blocks}; raise "
                     f"num_kv_blocks or lower max_tokens")
         handle._engine = self
+        self._capture_trace(handle)
         with self._lock:
             self._queues[request.slo].append(handle)
         self._work.set()
@@ -813,6 +822,7 @@ class LLMEngine:
                 f"pool only has {c.pool_blocks}")
         handle = RequestHandle(next(self._ids), request)
         handle._engine = self
+        self._capture_trace(handle)
         handle.tokens = list(state.tokens)
         handle.kv_state = state
         with self._lock:
@@ -1086,7 +1096,8 @@ class LLMEngine:
         if promote:
             # Land the tier links in new_blocks[:n_pro] BEFORE the
             # insert below reads them as history.
-            self._promote_tier_hits(promote, new_blocks[:n_pro], slot)
+            self._promote_tier_hits(promote, new_blocks[:n_pro], slot,
+                                    handle=handle)
         padded = np.zeros((bucket,), np.int32)
         padded[:suffix_len] = np.asarray(prompt[hist_len:], np.int32)
         scatter_ids = np.asarray(new_blocks[n_pro:n_pro + bucket // bs],
@@ -1123,6 +1134,7 @@ class LLMEngine:
         one past the pool (out-of-bounds writes drop under jit)."""
         import numpy as np
 
+        t_mig = time.time()
         req = handle.request
         st = handle.kv_state
         c = self.config
@@ -1162,6 +1174,15 @@ class LLMEngine:
         self._migrated_bytes += st.payload_bytes
         self._metrics.kv_migrated_blocks.inc(float(n_valid))
         self._metrics.kv_migrated_bytes.inc(float(st.payload_bytes))
+        try:
+            from ray_tpu.util.tracing import record_span
+
+            record_span("kv.migrate", t_mig, time.time() - t_mig,
+                        attrs={"blocks": int(n_valid),
+                               "bytes": int(st.payload_bytes)},
+                        trace=self._phase_trace(handle))
+        except Exception:
+            pass  # telemetry must never break admission
         handle.kv_state = None
         if self._draft is not None:
             # The draft cache never migrated: re-prefill it with
@@ -1350,7 +1371,9 @@ class LLMEngine:
         return self._tiers.spill(prefixes)
 
     def _promote_tier_hits(self, hits: List[Any],
-                           dst_blocks: List[int], slot: int) -> None:
+                           dst_blocks: List[int], slot: int,
+                           handle: Optional[RequestHandle] = None
+                           ) -> None:
         """Scatter tier-resident chain links into freshly-allocated
         pool blocks through the ONE adopt program (padding ids point
         one past the pool — dropped under jit). The tok/pos writes are
@@ -1360,6 +1383,7 @@ class LLMEngine:
         all-or-nothing contract."""
         import numpy as np
 
+        t_pro = time.time()
         c = self.config
         nb = c.max_blocks_per_slot
         ids = np.full((nb,), c.pool_blocks, np.int32)
@@ -1376,6 +1400,15 @@ class LLMEngine:
             np.int32(slot), np.int32(0), np.int32(0))
         self._tiers.pop(hits)
         self._promoted_blocks += len(hits)
+        if handle is not None:
+            try:
+                from ray_tpu.util.tracing import record_span
+
+                record_span("kv.promote", t_pro, time.time() - t_pro,
+                            attrs={"blocks": len(hits)},
+                            trace=self._phase_trace(handle))
+            except Exception:
+                pass  # telemetry must never break admission
 
     def call_on_scheduler(self, fn: Callable[[], Any],
                           timeout_s: float = 60.0) -> Any:
@@ -1569,15 +1602,50 @@ class LLMEngine:
                 self._release_slot(slot)
                 self._finish_cancelled(h)
 
+    @staticmethod
+    def _capture_trace(handle: RequestHandle) -> None:
+        """Stamp the submitting thread's TraceContext onto the handle
+        and pre-allocate the llm.request span id, so scheduler-thread
+        phase reconstruction can parent spans correctly without any
+        ambient context of its own."""
+        try:
+            from ray_tpu.util.tracing import current_trace, new_span_id
+
+            tc = current_trace()
+            if tc is not None:
+                handle.trace = tc
+                handle.trace_span_id = new_span_id()
+        except Exception:
+            pass  # telemetry must never break submit
+
+    @staticmethod
+    def _phase_trace(handle: RequestHandle) -> Optional[Dict[str, Any]]:
+        """Explicit trace fields for a phase/KV span of this request:
+        fresh span id parented under the handle's llm.request span."""
+        if handle.trace is None:
+            return None
+        from ray_tpu.util.tracing import new_span_id
+
+        return {"trace_id": handle.trace.trace_id,
+                "span_id": new_span_id(),
+                "parent_span_id": handle.trace_span_id}
+
     def _record_finished(self, handle: RequestHandle) -> None:
         """Latency histograms + per-request lifecycle spans
         (queued -> prefill -> decode) so `/metrics` and
-        `ray_tpu.timeline()` both render a serve run end-to-end."""
+        `ray_tpu.timeline()` both render a serve run end-to-end. Spans
+        carry the request's captured trace identity — passed explicitly
+        (not via ambient context: this runs on the scheduler thread),
+        so the GCS assembles them under the request's causal tree. The
+        TTFT observation links its trace_id as the histogram exemplar —
+        the dashboard's jump from "p99 is bad" to the worst request's
+        actual trace."""
         m = self._metrics
         e2e = handle.finished_at - handle.submitted_at
-        m.e2e.observe(e2e)
+        trace_id = handle.trace.trace_id if handle.trace else None
+        m.e2e.observe(e2e, trace_id=trace_id)
         if handle.ttft_s is not None:
-            m.ttft.observe(handle.ttft_s)
+            m.ttft.observe(handle.ttft_s, trace_id=trace_id)
         if handle.tpot_s is not None:
             m.tpot.observe(handle.tpot_s)
         m.tokens.inc(float(len(handle.tokens)))
@@ -1591,21 +1659,32 @@ class LLMEngine:
             rid = handle.request_id
             admit = handle.admitted_at or handle.finished_at
             record_span("llm.queued", wall0,
-                        admit - handle.submitted_at, attrs={"rid": rid})
+                        admit - handle.submitted_at, attrs={"rid": rid},
+                        trace=self._phase_trace(handle))
             if handle.first_token_at is not None:
                 record_span(
                     "llm.prefill",
                     wall0 + (admit - handle.submitted_at),
-                    handle.first_token_at - admit, attrs={"rid": rid})
+                    handle.first_token_at - admit, attrs={"rid": rid},
+                    trace=self._phase_trace(handle))
                 record_span(
                     "llm.decode",
                     wall0 + (handle.first_token_at - handle.submitted_at),
                     handle.finished_at - handle.first_token_at,
                     attrs={"rid": rid,
-                           "tokens": len(handle.tokens)})
+                           "tokens": len(handle.tokens)},
+                    trace=self._phase_trace(handle))
+            req_trace = None
+            if handle.trace is not None:
+                # The llm.request span itself parents under the span
+                # active at submit (the replica's llm.server_call).
+                req_trace = {"trace_id": handle.trace.trace_id,
+                             "span_id": handle.trace_span_id,
+                             "parent_span_id": handle.trace.span_id}
             record_span("llm.request", wall0, e2e, attrs={
                 "rid": rid, "tokens": len(handle.tokens),
-                "finish_reason": handle.finish_reason})
+                "finish_reason": handle.finish_reason},
+                trace=req_trace)
         except Exception:
             pass  # telemetry must never break the scheduler
 

@@ -391,20 +391,47 @@ class LLMRouter:
         if donor is None or donor_hits - mine < self._pull_min:
             return False
         try:
+            from ray_tpu.util.tracing import record_span
+
             # export ref flows donor -> store -> chosen; the Replica
             # layer materializes ObjectRef args in the chosen process.
+            t0 = time.time()
             ref = donor.handle_request.remote(
                 "export_prefix", (list(prompt),), {})
             n = ray_tpu.get(
                 chosen.handle_request.remote(
                     "import_prefix", (ref,), {}),
                 timeout=min(30.0, timeout))
+            if n:
+                # Ambient context: the serve.request root is active on
+                # this thread, so the span parents there.
+                record_span("kv.peer_pull", t0, time.time() - t0,
+                            attrs={"blocks": int(n)})
             return bool(n)
         except Exception:
             return False
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Route one request under a fresh trace: ``serve.request`` is
+        the trace root (the tail-sampling trigger), every hop below —
+        ``serve.replica_call`` / ``serve.prefill_call`` / ``kv.*`` and
+        the replica's spans across the process boundary — parents into
+        one causal tree, and the response carries ``x-trace-id`` so the
+        caller can fetch it back via ``util.state.get_trace``."""
+        from ray_tpu.util.tracing import trace_root
+
+        lane = str(request.get("slo", "interactive"))
+        with trace_root("serve.request",
+                        attrs={"lane": lane,
+                               "prompt_len": len(request.get(
+                                   "prompt", ()))},
+                        baggage={"slo": lane}) as tc:
+            out = self._route(request)
+        return dict(out) | {"x-trace-id": tc.trace_id}
+
+    def _route(self, request: Dict[str, Any]) -> Dict[str, Any]:
         import ray_tpu
+        from ray_tpu.util.tracing import span
 
         lane = str(request.get("slo", "interactive"))
         prompt = request.get("prompt", ())
@@ -450,15 +477,22 @@ class LLMRouter:
                 # decode replica materializes it from the object store
                 # (Replica.handle_request's ObjectRef-arg resolution),
                 # so the payload never enters the router process.
-                prefill_ref = pre.handle_request.remote(
-                    "prefill", (request,), {})
+                with span("serve.prefill_call",
+                          attrs={"replica": str(getattr(
+                              pre, "_actor_id", id(pre)))}):
+                    prefill_ref = pre.handle_request.remote(
+                        "prefill", (request,), {})
+                with span("serve.replica_call",
+                          attrs={"replica": rid, "hop": "adopt"}):
+                    return ray_tpu.get(
+                        chosen.handle_request.remote(
+                            "adopt", (prefill_ref, request), {}),
+                        timeout=timeout)
+            with span("serve.replica_call", attrs={"replica": rid}):
                 return ray_tpu.get(
                     chosen.handle_request.remote(
-                        "adopt", (prefill_ref, request), {}),
+                        "__call__", (request,), {}),
                     timeout=timeout)
-            return ray_tpu.get(
-                chosen.handle_request.remote("__call__", (request,), {}),
-                timeout=timeout)
         finally:
             with self._lock:
                 if chosen in self._inflight:

@@ -60,6 +60,9 @@ class Metric:
         self._lock = threading.Lock()
         # tag-value tuple (aligned with _tag_keys) -> float / bucket list
         self._data: Dict[Tuple[str, ...], object] = {}
+        # tag-value tuple -> {"trace_id", "value", "ts"}: the max-valued
+        # exemplar per label set (histograms only; see Histogram.observe).
+        self._exemplars: Dict[Tuple[str, ...], Dict[str, Any]] = {}
         # Re-creating a metric with the same name (e.g. inside a task body
         # run many times on one worker) aliases the canonical instance's
         # storage instead of growing the registry without bound.
@@ -75,6 +78,9 @@ class Metric:
                         f"different type/tag_keys/boundaries")
                 self._data = prior._data
                 self._lock = prior._lock
+                if not hasattr(prior, "_exemplars"):
+                    prior._exemplars = {}
+                self._exemplars = prior._exemplars
             else:
                 _registry[self._name] = self
         _ensure_flusher()
@@ -109,7 +115,12 @@ class Metric:
         with self._lock:
             data = {",".join(k): v if not isinstance(v, list) else list(v)
                     for k, v in self._data.items()}
-        return {**self.info, "data": data}
+            exemplars = {",".join(k): dict(v)
+                         for k, v in self._exemplars.items()}
+        snap = {**self.info, "data": data}
+        if exemplars:
+            snap["exemplars"] = exemplars
+        return snap
 
 
 class Counter(Metric):
@@ -158,7 +169,14 @@ class Histogram(Metric):
         return out
 
     def observe(self, value: float,
-                tags: Optional[Dict[str, str]] = None) -> None:
+                tags: Optional[Dict[str, str]] = None, *,
+                trace_id: Optional[str] = None) -> None:
+        """Record one observation. ``trace_id`` optionally links an
+        exemplar: per label set, the max-valued observation's trace_id
+        is kept (replaced when a new value >= the stored one), so a
+        latency histogram points straight at the slowest request's
+        retrievable trace. The exemplar rides a dedicated kwarg — it
+        never widens the declared tag_keys / label set."""
         key = self._tag_tuple(tags)
         with self._lock:
             cell = self._data.get(key)
@@ -172,6 +190,12 @@ class Histogram(Metric):
             cell[len(self.boundaries)] += 1          # +inf bucket
             cell[len(self.boundaries) + 1] += value  # sum
             cell[len(self.boundaries) + 2] += 1      # count
+            if trace_id is not None:
+                prior = self._exemplars.get(key)
+                if prior is None or float(value) >= prior["value"]:
+                    self._exemplars[key] = {
+                        "trace_id": str(trace_id),
+                        "value": float(value), "ts": time.time()}
 
 
 # --------------------------------------------------------------------- flush

@@ -507,3 +507,51 @@ def summary_actors() -> List[Dict[str, Any]]:
         agg[cls][a.get("state", "UNKNOWN")] += 1
     return [{"class": cls, **dict(states)}
             for cls, states in sorted(agg.items())]
+
+
+# ------------------------------------------------------------------- traces
+
+
+def get_trace(trace_id: str) -> Optional[Dict[str, Any]]:
+    """One request's causal tree from the GCS trace store, or None.
+    Returns the assembled tree (``root``/``orphans``/``num_spans`` from
+    ``tracing.build_trace_tree``) plus the store's verdict: ``complete``
+    (the root span arrived and tail-sampling kept it), ``dur`` (root
+    duration), ``error`` and ``keep_reason``. An in-flight trace comes
+    back partial with ``complete`` False — debugging never waits on
+    sampling."""
+    from ray_tpu.util.tracing import build_trace_tree
+
+    rec = _gcs().call("get_trace", trace_id=trace_id, timeout=30)
+    if rec is None:
+        return None
+    tree = build_trace_tree(rec.get("spans") or [])
+    tree.update({
+        "trace_id": trace_id,
+        "complete": bool(rec.get("complete")),
+        "dur": rec.get("dur"),
+        "error": rec.get("error", False),
+        "keep_reason": rec.get("keep_reason"),
+    })
+    return tree
+
+
+def list_traces(limit: int = 100) -> List[Dict[str, Any]]:
+    """Summaries of kept traces, newest first (trace_id, root_name, ts,
+    dur, error, keep_reason, num_spans)."""
+    return _gcs().call("list_traces", limit=limit, timeout=30)
+
+
+def trace_critical_path(tree_or_id: Any) -> Dict[str, Any]:
+    """Critical path of a trace: pass either a tree from
+    :func:`get_trace` or a bare trace_id string. Answers "where did
+    this request's time go" — the dominant hop is the one with the most
+    self-time along the longest-duration root-to-leaf walk."""
+    from ray_tpu.util.tracing import critical_path
+
+    tree = tree_or_id
+    if isinstance(tree_or_id, str):
+        tree = get_trace(tree_or_id)
+        if tree is None:
+            raise ValueError(f"no trace {tree_or_id!r} in the store")
+    return critical_path(tree)

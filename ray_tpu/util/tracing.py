@@ -1,40 +1,172 @@
-"""Lightweight task tracing (reference:
+"""Request-scoped distributed tracing (reference:
 `python/ray/util/tracing/tracing_helper.py`, which wraps every remote
 call/execution in OpenTelemetry spans and propagates context in task specs).
 
-Here the runtime *already* propagates trace lineage natively: every
-``TaskSpec`` carries ``parent_task_id``/``depth``, and the worker records
-PENDING/RUNNING/FINISHED lifecycle events into the head's task-event ring
-buffer. This module adds the user-facing span API on top:
+Two planes live here:
+
+* **Task lineage** — the runtime already propagates
+  ``parent_task_id``/``depth`` on every ``TaskSpec`` and records
+  PENDING/RUNNING/FINISHED lifecycle events into the head's task-event
+  ring; ``span_tree()`` reconstructs that cross-task call tree.
+
+* **Request-scoped traces** — a ``TraceContext`` (trace_id / span_id /
+  parent_span_id / baggage) rides a contextvar inside a process and a
+  compact wire dict (``{"t", "s", "b"}``) across ``.remote()`` calls:
+  the submitting worker stamps ``current_trace().to_wire()`` onto the
+  TaskSpec, the executing worker restores it around the task body, so
+  spans recorded anywhere downstream parent under the span that was
+  active at submit time. Trace-tagged SPAN events ride the same
+  ``push_task_events`` channel and land in the GCS's tail-sampled
+  ``TraceStore`` (ray_tpu/observability/traces.py); read them back with
+  ``util.state.get_trace()`` / ``list_traces()`` /
+  ``trace_critical_path()``.
+
+Typical use::
 
     from ray_tpu.util import tracing
 
-    @ray_tpu.remote
-    def step():
-        with tracing.span("load"):
-            ...
-        with tracing.span("compute", attrs={"n": 4}):
-            ...
+    with tracing.trace_root("serve.request") as tc:
+        with tracing.span("route"):
+            ref = replica.handle.remote(req)      # context rides along
+        out = ray_tpu.get(ref)
+    print(tc.trace_id)                            # retrievable trace
 
-Spans attach to the current task (or the driver) and export through the
-same GCS ring buffer; ``ray_tpu.timeline()`` renders them as nested rows
-and ``span_tree()`` reconstructs the cross-task call tree from
-``parent_task_id`` links — the role OpenTelemetry context propagation
-plays in the reference.
+The wire format deliberately drops ``parent_span_id``: the receiver
+parents to the *sender's* span, so the sender's own parent link never
+travels.
 """
 
 from __future__ import annotations
 
+import contextvars
+import os
 import time
+import uuid
 from contextlib import contextmanager
+from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
+
+# ------------------------------------------------------------- context
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class TraceContext:
+    """One hop of a request-scoped trace. ``span_id`` is the identity of
+    the currently-active span; anything recorded beneath it parents
+    there. ``baggage`` is small propagated metadata (e.g. SLO lane) —
+    copied, never merged, on each hop."""
+
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+    baggage: Dict[str, Any] = field(default_factory=dict)
+
+    def to_wire(self) -> Dict[str, Any]:
+        """Compact dict for the TaskSpec. The parent link never travels:
+        the receiver parents to the sender's span itself."""
+        return {"t": self.trace_id, "s": self.span_id,
+                "b": dict(self.baggage)}
+
+    @classmethod
+    def from_wire(cls, wire: Optional[Dict[str, Any]]
+                  ) -> Optional["TraceContext"]:
+        if not wire:
+            return None
+        return cls(trace_id=wire["t"], span_id=wire["s"],
+                   parent_span_id=None,
+                   baggage=dict(wire.get("b") or {}))
+
+
+_CURRENT: contextvars.ContextVar[Optional[TraceContext]] = \
+    contextvars.ContextVar("ray_tpu_trace_context", default=None)
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The TraceContext active on this thread/coroutine, or None."""
+    return _CURRENT.get()
+
+
+def child_context() -> Optional[TraceContext]:
+    """A fresh context parented under the active span (same trace, new
+    span_id, baggage copied), or None when no trace is active."""
+    tc = _CURRENT.get()
+    if tc is None:
+        return None
+    return TraceContext(trace_id=tc.trace_id, span_id=new_span_id(),
+                        parent_span_id=tc.span_id,
+                        baggage=dict(tc.baggage))
+
+
+def current_wire_context() -> Optional[Dict[str, Any]]:
+    """``current_trace().to_wire()`` or None — what ``.remote()`` stamps
+    onto the TaskSpec."""
+    tc = _CURRENT.get()
+    return tc.to_wire() if tc is not None else None
+
+
+def activate_wire_context(wire: Optional[Dict[str, Any]]
+                          ) -> Optional[contextvars.Token]:
+    """Executing-worker side: restore the caller's context around a task
+    body. Returns a token for ``deactivate_context`` (None when there
+    was nothing to restore — pass it back unconditionally)."""
+    tc = TraceContext.from_wire(wire)
+    if tc is None:
+        return None
+    return _CURRENT.set(tc)
+
+
+def deactivate_context(token: Optional[contextvars.Token]) -> None:
+    if token is not None:
+        _CURRENT.reset(token)
+
+
+@contextmanager
+def trace_root(name: str, attrs: Optional[Dict[str, Any]] = None,
+               baggage: Optional[Dict[str, Any]] = None
+               ) -> Iterator[TraceContext]:
+    """Open a new trace: fresh trace_id, root span active for the block.
+    The recorded root span is tagged ``attrs["trace_root"]`` — the
+    signal the GCS TraceStore completes (and tail-samples) a trace on."""
+    tc = TraceContext(trace_id=new_trace_id(), span_id=new_span_id(),
+                      parent_span_id=None, baggage=dict(baggage or {}))
+    token = _CURRENT.set(tc)
+    start = time.time()
+    attrs = dict(attrs) if attrs else {}
+    attrs["trace_root"] = True
+    try:
+        yield tc
+    except BaseException as e:
+        attrs["error"] = type(e).__name__
+        raise
+    finally:
+        _CURRENT.reset(token)
+        record_span(name, start, time.time() - start, attrs,
+                    trace={"trace_id": tc.trace_id,
+                           "span_id": tc.span_id,
+                           "parent_span_id": None})
 
 
 def record_span(name: str, start: float, dur: float,
-                attrs: Optional[Dict[str, Any]] = None) -> None:
+                attrs: Optional[Dict[str, Any]] = None, *,
+                trace: Optional[Dict[str, Any]] = None) -> None:
     """Record a span with explicit wall-clock start/duration — for
     callers that reconstruct lifecycle phases after the fact (the LLM
-    engine's queued/prefill/decode phases, jit-compile events)."""
+    engine's queued/prefill/decode phases, jit-compile events).
+
+    Trace fields are stamped exactly once: an explicit ``trace`` dict
+    (``trace_id``/``span_id``/``parent_span_id``) wins outright;
+    otherwise the ambient context, if any, contributes the trace_id and
+    parents a *fresh* span id under the active span. ``span()`` and
+    ``trace_root()`` always pass ``trace=`` explicitly, so a span is
+    never double-tagged by its own ambient push."""
     from ray_tpu._private.worker import global_worker_or_none
 
     w = global_worker_or_none()
@@ -47,18 +179,40 @@ def record_span(name: str, start: float, dur: float,
             "task_id": tid.binary() if tid else b"driver",
             "name": name, "job_id": b"", "state": "SPAN",
             "ts": start, "dur": dur,
-            "owner_pid": __import__("os").getpid(),
+            "owner_pid": os.getpid(),
             "attrs": attrs or {},
         }
+        if trace is None:
+            tc = _CURRENT.get()
+            if tc is not None:
+                trace = {"trace_id": tc.trace_id,
+                         "span_id": new_span_id(),
+                         "parent_span_id": tc.span_id}
+        if trace is not None and trace.get("trace_id"):
+            event["trace_id"] = trace["trace_id"]
+            event["span_id"] = trace.get("span_id")
+            event["parent_span_id"] = trace.get("parent_span_id")
         with w._task_events_lock:
             w._task_events.append(event)
+        if event.get("trace_id"):
+            # Traced spans feed the GCS TraceStore; nudge the debounced
+            # flush so traces assemble on a sub-second cadence instead
+            # of waiting for the 100-event batch threshold.
+            flush = getattr(w, "flush_task_events_soon", None)
+            if flush is not None:
+                flush()
 
 
 @contextmanager
 def span(name: str, attrs: Optional[Dict[str, Any]] = None) -> Iterator[None]:
-    """Record a named span inside the current task/driver. A raising
-    body still records the span, tagged ``attrs["error"]`` with the
-    exception type so timelines distinguish failures from successes."""
+    """Record a named span inside the current task/driver. When a trace
+    is active, the block runs under a child context (so nested spans and
+    ``.remote()`` calls parent here) and the recorded SPAN event carries
+    the trace fields. A raising body still records the span, tagged
+    ``attrs["error"]`` with the exception type so timelines distinguish
+    failures from successes."""
+    child = child_context()
+    token = _CURRENT.set(child) if child is not None else None
     start = time.time()
     attrs = dict(attrs) if attrs else {}
     try:
@@ -67,12 +221,87 @@ def span(name: str, attrs: Optional[Dict[str, Any]] = None) -> Iterator[None]:
         attrs["error"] = type(e).__name__
         raise
     finally:
-        record_span(name, start, time.time() - start, attrs)
+        if token is not None:
+            _CURRENT.reset(token)
+        record_span(name, start, time.time() - start, attrs,
+                    trace=({"trace_id": child.trace_id,
+                            "span_id": child.span_id,
+                            "parent_span_id": child.parent_span_id}
+                           if child is not None else {}))
+
+
+# ----------------------------------------------------- tree / analysis
+
+
+def build_trace_tree(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Assemble normalized span dicts (trace_id/span_id/parent_span_id/
+    name/ts/dur/attrs) into one causal tree. Never drops anything:
+    spans whose parent did not arrive (a crashed or late hop) surface
+    in ``orphans``; extra parentless spans beyond the root do too."""
+    nodes: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        sid = s.get("span_id")
+        if sid is None or sid in nodes:
+            continue
+        nodes[sid] = {
+            "span_id": sid,
+            "parent_span_id": s.get("parent_span_id"),
+            "name": s.get("name"),
+            "ts": s.get("ts"), "dur": s.get("dur", 0.0),
+            "attrs": dict(s.get("attrs") or {}),
+            "children": [],
+        }
+    rootless: List[Dict[str, Any]] = []
+    orphans: List[Dict[str, Any]] = []
+    for node in nodes.values():
+        parent = node["parent_span_id"]
+        if parent is None:
+            rootless.append(node)
+        elif parent in nodes:
+            nodes[parent]["children"].append(node)
+        else:
+            orphans.append(node)
+    for node in nodes.values():
+        node["children"].sort(key=lambda c: c["ts"] or 0.0)
+    rootless.sort(key=lambda n: n["ts"] or 0.0)
+    root = next((n for n in rootless if n["attrs"].get("trace_root")),
+                rootless[0] if rootless else None)
+    orphans.extend(n for n in rootless if n is not root)
+    return {"num_spans": len(spans), "root": root, "orphans": orphans}
+
+
+def critical_path(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Walk the tree root-down, always descending into the
+    longest-duration child: the hops a request's latency actually
+    flowed through. Each hop's ``self_s`` is its duration minus its
+    children's (time spent *in* that hop, not waiting below it); the
+    ``dominant`` hop is where the request's time went."""
+    root = tree.get("root") if "root" in tree else tree
+    if not root:
+        return {"path": [], "dominant": None,
+                "dominant_self_s": 0.0, "total_s": 0.0}
+    path = []
+    node = root
+    while node is not None:
+        kids = node.get("children") or []
+        dur = node.get("dur") or 0.0
+        self_s = max(0.0, dur - sum(c.get("dur") or 0.0 for c in kids))
+        path.append({"name": node.get("name"),
+                     "span_id": node.get("span_id"),
+                     "dur": dur, "self_s": self_s})
+        node = (max(kids, key=lambda c: c.get("dur") or 0.0)
+                if kids else None)
+    dominant = max(path, key=lambda h: h["self_s"])
+    return {"path": path, "dominant": dominant["name"],
+            "dominant_self_s": dominant["self_s"],
+            "total_s": root.get("dur") or 0.0}
 
 
 def span_tree() -> List[Dict[str, Any]]:
     """The cross-task call tree: each node is a task with its lifecycle
-    timestamps, user spans, and children (tasks it submitted)."""
+    timestamps, user spans, and children (tasks it submitted). SPAN
+    events whose task node fell out of the lifecycle ring are surfaced
+    under a synthetic ``(orphaned-spans)`` root, never dropped."""
     import ray_tpu
 
     events = ray_tpu.task_events()
@@ -91,9 +320,15 @@ def span_tree() -> List[Dict[str, Any]]:
         node["states"][e["state"]] = e["ts"]
         if e.get("parent_task_id"):
             node["parent_task_id"] = e["parent_task_id"]
+    lost: List[Dict[str, Any]] = []
     for tid, sp in spans.items():
         if tid in nodes:
             nodes[tid]["spans"] = sorted(sp, key=lambda s: s["ts"])
+        else:
+            for s in sp:
+                s = dict(s)
+                s["attrs"] = dict(s["attrs"]) | {"orphan": True}
+                lost.append(s)
     roots = []
     for node in nodes.values():
         parent = node.pop("parent_task_id", None)
@@ -102,4 +337,8 @@ def span_tree() -> List[Dict[str, Any]]:
             pnode["children"].append(node)
         else:
             roots.append(node)
+    if lost:
+        roots.append({"task_id": None, "name": "(orphaned-spans)",
+                      "orphan": True, "states": {}, "children": [],
+                      "spans": sorted(lost, key=lambda s: s["ts"])})
     return roots

@@ -10,6 +10,11 @@ NO_UNIT = Histogram("serve_latency",                # metric-histogram-suffix
 PID_GAUGE = Gauge("worker_rss_bytes",               # metric-gauge-pid-tag
                   tag_keys=("pid", "node"))
 
+TRACED = Histogram("serve_admit_wait_seconds",      # metric-exemplar-tag
+                   tag_keys=("trace_id",),
+                   boundaries=[0.01, 0.1, 1.0])
+TRACED.observe(0.5, tags={"trace_id": "abc123"})    # metric-exemplar-tag
+
 FIRST = Counter("serve_handled", tag_keys=("route",))
 SECOND = Counter("serve_handled", tag_keys=("route", "code"))  # redeclared
 

@@ -7,6 +7,8 @@ LATENCY = Histogram("serve_latency_seconds",
                     boundaries=[0.1, 1.0, 10.0])
 RSS = Gauge("worker_rss_bytes", tag_keys=("node",))
 
+LATENCY.observe(0.5, trace_id="abc123")   # exemplar kwarg: fine
+
 FIRST = Counter("serve_handled", tag_keys=("route",))
 SECOND = Counter("serve_handled", tag_keys=("route",))   # identical: fine
 

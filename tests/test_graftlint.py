@@ -61,7 +61,8 @@ PASS_CASES = [
      {"lock-cycle", "lock-blocking-call"}),
     ("metric-declarations", "metrics_bad.py", "metrics_clean.py",
      {"metric-name", "metric-family", "metric-histogram-suffix",
-      "metric-gauge-pid-tag", "metric-redeclared", "metric-exposition"}),
+      "metric-gauge-pid-tag", "metric-redeclared", "metric-exposition",
+      "metric-exemplar-tag"}),
     ("event-schema", "events_bad", "events_clean",
      {"event-unregistered-emit", "event-dead-type",
       "event-undocumented-type"}),

@@ -1,0 +1,91 @@
+"""Wire trace contexts survive the replica request envelope's
+ObjectRef materialization path (the PR-15 regression surface).
+
+`Replica.handle_request` hides the logical call args inside a
+(method_name, args, kwargs) envelope, so the replica materializes
+ObjectRef elements itself with `ray_tpu.get` — an extra in-process
+resolution step that runs AFTER the executing worker has restored the
+caller's wire trace context. These tests pin the contract that the
+restored context is still active when the user callable runs: the
+inner `ray_tpu.get` must neither clobber nor re-parent it.
+
+Kept separate from tests/test_tracing.py (which is an exact 13-test
+executable spec).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.util.tracing import current_trace, trace_root
+
+
+@pytest.fixture(autouse=True)
+def _serve_cleanup(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+@serve.deployment
+class TraceProbe:
+    """Reports the trace context active when the callable body runs,
+    alongside the (materialized) argument it received."""
+
+    def __call__(self, payload, extra=None):
+        ctx = current_trace()
+        return {
+            "payload_type": type(payload).__name__,
+            "payload": payload,
+            "extra": extra,
+            "trace_id": ctx.trace_id if ctx else None,
+            "span_id": ctx.span_id if ctx else None,
+        }
+
+
+def test_wire_context_survives_ref_arg_materialization():
+    handle = serve.run(TraceProbe.bind(), name="probe-args")
+    ref = ray_tpu.put([1, 2, 3])
+    with trace_root("envelope.test") as tc:
+        active = current_trace()
+        out = handle.remote(ref).result(timeout=60)
+    # The ref materialized in the replica process (list, not ObjectRef)...
+    assert out["payload_type"] == "list"
+    assert out["payload"] == [1, 2, 3]
+    # ...and the callable still saw the caller's ACTIVE context: same
+    # trace, parented at the span the caller had live at submit time.
+    assert out["trace_id"] == tc.trace_id
+    assert out["span_id"] == active.span_id
+
+
+def test_wire_context_survives_ref_kwarg_materialization():
+    handle = serve.run(TraceProbe.bind(), name="probe-kwargs")
+    arr = np.arange(8, dtype=np.int32)
+    with trace_root("envelope.kwargs") as tc:
+        out = handle.remote(0, extra=ray_tpu.put(arr)).result(timeout=60)
+    assert np.array_equal(out["extra"], arr)
+    assert out["trace_id"] == tc.trace_id
+
+
+def test_untraced_envelope_call_stays_untraced():
+    # No ambient context at submit -> the replica must not invent one,
+    # even though it runs ray_tpu.get internally to materialize the ref.
+    handle = serve.run(TraceProbe.bind(), name="probe-untraced")
+    assert current_trace() is None
+    out = handle.remote(ray_tpu.put("x")).result(timeout=60)
+    assert out["payload"] == "x"
+    assert out["trace_id"] is None
+
+
+def test_contexts_stay_separated_across_envelope_calls():
+    # Two sequential traced calls through the same replica: the second
+    # request's restored context must be its own, not a leak of the
+    # first (the thread-pool worker thread is reused).
+    handle = serve.run(TraceProbe.bind(), name="probe-sep")
+    seen = []
+    for i in range(2):
+        with trace_root(f"envelope.sep{i}") as tc:
+            out = handle.remote(ray_tpu.put(i)).result(timeout=60)
+        assert out["trace_id"] == tc.trace_id
+        seen.append(out["trace_id"])
+    assert seen[0] != seen[1]
