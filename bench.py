@@ -1407,6 +1407,85 @@ def _bench_sched_phase_overhead() -> dict:
     }
 
 
+def _bench_train_goodput_overhead() -> dict:
+    """Per-step cost of the training goodput instrumentation
+    (observability/goodput.py: StepPhases timers + the per-step
+    block_until_ready fence + step-row publish). Same tiny sharded
+    train loop (train/jax_backend.run_pod_training) with the env knob
+    on vs off, several repeats per leg; the instrumented loop adds a
+    handful of perf_counter() calls, one device fence, and one
+    fire-and-forget RPC per step, so the per-step delta must sit
+    inside repeat-to-repeat noise — `within_noise` records the
+    verdict (cf. _bench_sched_phase_overhead)."""
+    import statistics
+
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.train.jax_backend import run_pod_training
+
+    config = LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=128, max_seq_len=64)
+    # Each run_pod_training call pays a fresh XLA compile that dwarfs
+    # the actual steps (seconds vs tens of ms), so per-step =
+    # train_seconds/steps would just benchmark compile variance.
+    # Difference two step counts per run-pair instead: the compile
+    # constant cancels and what remains is the steady per-step wall.
+    steps_lo, steps_hi, repeats = 4, 20, 3
+
+    def _steady_per_step() -> float:
+        lo = run_pod_training(model_config=config,
+                              mesh_axes={"data": -1}, steps=steps_lo,
+                              weight_update="sharded")
+        hi = run_pod_training(model_config=config,
+                              mesh_axes={"data": -1}, steps=steps_hi,
+                              weight_update="sharded")
+        return ((hi["train_seconds"] - lo["train_seconds"])
+                / (steps_hi - steps_lo))
+
+    per_step: dict = {}
+    iqrs: dict = {}
+    samples: dict = {"1": [], "0": []}
+    # Interleave the legs so host drift (cache/thermal/background)
+    # lands on both sides evenly instead of biasing whichever leg
+    # ran second.
+    for _ in range(repeats):
+        for flag in ("1", "0"):
+            os.environ["RAY_TPU_train_goodput_instrumentation"] = flag
+            try:
+                samples[flag].append(_steady_per_step())
+            finally:
+                os.environ.pop("RAY_TPU_train_goodput_instrumentation",
+                               None)
+    for flag in ("1", "0"):
+        per_step[flag] = statistics.median(samples[flag])
+        iqrs[flag] = float(np.percentile(samples[flag], 75)
+                           - np.percentile(samples[flag], 25))
+    delta = per_step["1"] - per_step["0"]
+    noise = max(iqrs.values())
+    within = abs(delta) <= max(noise, 0.1 * per_step["0"])
+    return {
+        "metric": "train_goodput_overhead_ms",
+        "value": round(delta * 1000, 4),
+        "unit": "ms",
+        "vs_baseline": None,
+        "detail": {
+            "per_step_on_ms": round(per_step["1"] * 1000, 4),
+            "per_step_off_ms": round(per_step["0"] * 1000, 4),
+            "noise_floor_ms": round(noise * 1000, 4),
+            "within_noise": within,
+            "steps_per_leg": [steps_lo, steps_hi],
+            "repeats_per_mode": repeats,
+            "note": "steady per-step train wall ((T_hi-T_lo)/"
+                    "(steps_hi-steps_lo), compile cancelled), goodput "
+                    "instrumentation on minus off; within_noise "
+                    "compares the delta against the larger "
+                    "repeat-to-repeat IQR (floor: 10% of baseline)",
+        },
+    }
+
+
 def _bench_ppo_env_steps() -> dict:
     """Decoupled (Podracer) vs colocated PPO acting throughput on the
     CPU-virtual-device path. The config is deliberately learning-heavy
@@ -1803,6 +1882,15 @@ def main() -> None:
         print(json.dumps(_bench_sched_phase_overhead()))
     except Exception as e:
         print(json.dumps({"metric": "sched_phase_overhead_ms",
+                          "value": None, "unit": "ms",
+                          "vs_baseline": None, "error": repr(e)[:300]}))
+
+    # Training goodput instrumentation overhead: the same tiny sharded
+    # train loop with the phase ledger on vs off, in-process.
+    try:
+        print(json.dumps(_bench_train_goodput_overhead()))
+    except Exception as e:
+        print(json.dumps({"metric": "train_goodput_overhead_ms",
                           "value": None, "unit": "ms",
                           "vs_baseline": None, "error": repr(e)[:300]}))
 

@@ -10,6 +10,8 @@ each rule:
 - framework metrics belong to a registered family prefix;
 - histograms end in ``_seconds``/``_bytes``;
 - gauges must not declare a ``pid`` tag key;
+- names ending ``_ratio`` must be Gauges (a ratio is a point-in-time
+  fraction; a ``_ratio`` Counter sums into nonsense);
 - redeclarations agree on type/tag_keys/boundaries (cross-file — the
   runtime registry only catches collisions that co-execute in one
   process);
@@ -124,7 +126,7 @@ class MetricsPass(LintPass):
     rules = ("metric-unlintable-name", "metric-name", "metric-family",
              "metric-histogram-suffix", "metric-gauge-pid-tag",
              "metric-redeclared", "metric-exposition",
-             "metric-exemplar-tag")
+             "metric-exemplar-tag", "metric-ratio-gauge")
     description = ("metric naming/family/unit/tag contract + cross-file "
                    "redeclaration consistency + Prometheus exposition "
                    "suffix discipline (ex scripts/check_metrics.py)")
@@ -183,6 +185,15 @@ class MetricsPass(LintPass):
                 f"registered families {sorted(set(_FAMILIES))}; prefix it "
                 f"with its subsystem family (or extend _FAMILIES in "
                 f"ray_tpu/_private/lint/passes/metrics.py)")
+        if name.endswith("_ratio") and d["class"] != "Gauge":
+            yield mod.finding(
+                "metric-ratio-gauge", line,
+                f"{d['class'].lower()} {name!r} ends in _ratio but "
+                f"ratios are point-in-time fractions — declare it a "
+                f"Gauge (a _ratio counter accumulates into a "
+                f"meaningless sum and rate() of it is garbage; a "
+                f"_ratio histogram buckets a bounded [0,1] value "
+                f"nobody quantiles)")
         if d["class"] == "Histogram" and \
                 not name.endswith(("_seconds", "_bytes")):
             yield mod.finding(

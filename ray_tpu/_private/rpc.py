@@ -839,6 +839,28 @@ class RpcClient:
             return await fut
         return await asyncio.wait_for(fut, timeout)
 
+    def cast(self, method: str, timeout: Optional[float] = 10.0,
+             **payload):
+        """Fire-and-forget call: schedule `method` on the io loop and
+        return immediately; the reply (and any error) is swallowed.
+
+        For telemetry-grade RPCs on hot paths — train-step heartbeats,
+        metric rows — where the caller must never block on, or fail
+        because of, the control plane. The ``timeout`` still bounds the
+        in-flight call so a dead peer cannot accumulate pending futures.
+        """
+
+        async def _fire():
+            try:
+                await self.acall(method, timeout=timeout, **payload)
+            except Exception:
+                pass  # best-effort by contract
+
+        try:
+            self._io.submit(_fire())
+        except RuntimeError:
+            pass  # io loop stopping: drop, same contract
+
     def call(self, method: str, timeout: Optional[float] = None, **payload):
         """Blocking call from any non-loop thread.
 

@@ -186,6 +186,23 @@ _PANELS: List[Dict[str, str]] = [
      "expr": "rate(rtpu_rl_infer_requests_total[5m]) / "
              "rate(rtpu_rl_infer_batches_total[5m])",
      "unit": "short"},
+    # --- training goodput & stragglers (observability/goodput.py) ---
+    {"title": "Train goodput ratio",
+     "expr": "rtpu_train_goodput_ratio",
+     "unit": "percentunit"},
+    {"title": "Train step phase breakdown p50",
+     "expr": 'histogram_quantile(0.5, '
+             'rate(rtpu_train_step_phase_seconds_bucket[5m]))',
+     "legend": "{{phase}}", "unit": "s"},
+    {"title": "Train lost seconds by cause",
+     "expr": "rate(rtpu_train_lost_seconds_total[5m])",
+     "legend": "{{cause}}", "unit": "s"},
+    {"title": "Train stragglers / stalls",
+     "expr": 'rate(rtpu_cluster_events_total'
+             '{type="TRAIN_STRAGGLER"}[5m])',
+     "expr_b": 'rate(rtpu_cluster_events_total'
+               '{type="TRAIN_STALL"}[5m])',
+     "unit": "short"},
 ]
 
 

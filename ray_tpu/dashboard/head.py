@@ -21,6 +21,10 @@ Endpoints:
   GET /api/rl         decoupled-RL rollup: acting vs learning
                       throughput, weight version/staleness, sample
                       queue depth, inference batching factor
+  GET /api/train      training goodput & straggler rollup: per-worker
+                      step matrix rows (?worker=, ?limit=), goodput
+                      ratio + lost seconds by cause, per-phase means,
+                      stall/straggler flags
   GET /api/memory     per-node object-store introspection + spill metrics
   GET /api/data       data-pipeline (DatasetStats) metric summary
   GET /api/events     ClusterEventLog (failure forensics) with ?type=,
@@ -30,7 +34,7 @@ Endpoints:
                       NODE_REMOVED, LEASE_RECLAIMED, TASK_RETRY,
                       SPILL_PRESSURE, JOB_STARTED, JOB_FINISHED,
                       AUTOSCALE_UP, AUTOSCALE_DOWN, PREEMPT_RESCHEDULE,
-                      BACKPRESSURE_ADJUST.
+                      BACKPRESSURE_ADJUST, TRAIN_STRAGGLER, TRAIN_STALL.
   GET /api/controller control-plane decision log (serve autoscaler,
                       data backpressure, memory preemption) with
                       ?controller=, ?action=, ?limit= filters; each row
@@ -362,6 +366,28 @@ class DashboardHead:
         summary["rollup"] = rollup
         return web.json_response(summary)
 
+    async def train_stats(self, req) -> web.Response:
+        """Training goodput & straggler page: the GCS cross-worker
+        rollup (per-worker steps / stall / straggler flags, cluster
+        goodput ratio, lost seconds by cause, per-phase means), the
+        recent step-matrix rows (?worker= and ?limit= filter them), and
+        the cluster-folded ``train_*`` metric series."""
+        try:
+            limit = int(req.query.get("limit", 50))
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        summary = await self._gcs.acall("train_summary", timeout=10)
+        rows = await self._gcs.acall(
+            "list_train_steps", worker=req.query.get("worker"),
+            limit=limit, timeout=10)
+        metrics = await self._gcs.acall(
+            "user_metrics_summary", prefixes=["train_"], timeout=10)
+        return web.json_response({
+            "summary": summary or {},
+            "steps": rows or [],
+            "metrics": metrics or {},
+        })
+
     async def memory(self, req) -> web.Response:
         """Object-store memory introspection: live per-node snapshots
         straight from each raylet's store (same numbers
@@ -648,6 +674,7 @@ class DashboardHead:
         app.router.add_get("/api/trace", self.trace)
         app.router.add_get("/api/serve", self.serve_stats)
         app.router.add_get("/api/rl", self.rl_stats)
+        app.router.add_get("/api/train", self.train_stats)
         app.router.add_get("/api/memory", self.memory)
         app.router.add_get("/api/data", self.data_stats)
         app.router.add_get("/api/events", self.events)

@@ -16,6 +16,15 @@ shared instrumentation layer every hot path reports through:
   for the continuous-batching LLM engine.
 - ``train``: step-duration / samples-per-sec / loss reporting for
   ``train`` sessions and RLlib learners.
+- ``goodput``: the train-tier goodput & straggler plane — the
+  :class:`StepPhases` per-step phase ledger
+  (``rtpu_train_step_phase_seconds{phase}`` + ``train.step`` spans),
+  the :class:`GoodputLedger` productive-vs-lost wall-clock accounting
+  (``rtpu_train_goodput_ratio``,
+  ``rtpu_train_lost_seconds_total{cause}``), the
+  :class:`StragglerDetector` over the GCS cross-worker step matrix
+  (``report/list_train_steps``), and the hooks the GCS stall watchdog
+  builds TRAIN_STRAGGLER / TRAIN_STALL events from.
 - ``rl``: the decoupled-RL (podracer) plane — env-step vs
   learner-sample throughput counters, weight version/staleness gauges
   for the versioned WeightStore channel, sample-queue depth and
@@ -86,6 +95,20 @@ from ray_tpu.observability.events import (  # noqa: F401
     classify_worker_exit,
     make_event,
 )
+from ray_tpu.observability.goodput import (  # noqa: F401
+    GOODPUT_CAUSES,
+    TRAIN_PHASES,
+    GoodputLedger,
+    StepPhases,
+    StragglerDetector,
+    classify_phase,
+    goodput_enabled,
+    goodput_metrics,
+    publish_train_done,
+    publish_train_step,
+    record_checkpoint,
+    record_recompile,
+)
 from ray_tpu.observability.object_store import (  # noqa: F401
     object_store_metrics,
     register_store_sampler,
@@ -123,4 +146,8 @@ __all__ = [
     "SCHED_PHASES", "SCHED_SEGMENT_LABELS", "StackSampler",
     "capture_thread_stacks", "collapse", "format_thread_stacks",
     "merge_counts", "observe_sched_phases", "render_speedscope",
+    "GOODPUT_CAUSES", "TRAIN_PHASES", "GoodputLedger", "StepPhases",
+    "StragglerDetector", "classify_phase", "goodput_enabled",
+    "goodput_metrics", "publish_train_done", "publish_train_step",
+    "record_checkpoint", "record_recompile",
 ]

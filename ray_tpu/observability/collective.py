@@ -137,6 +137,15 @@ def record_overlap(op: str, backend: str, issued_to_awaited_s: float,
     tags = {"op": op, "backend": backend}
     m.exposed_seconds.observe(exposed, tags)
     m.hidden_seconds.observe(hidden, tags)
+    try:
+        # The live train step (if any) carves exposed time out of its
+        # compute phase — the exposed_collective column of the step
+        # ledger reuses this hook instead of re-timing the collective.
+        from ray_tpu.observability.goodput import note_exposed_collective
+
+        note_exposed_collective(exposed)
+    except Exception:
+        pass
     return {
         "exposed_s": exposed,
         "hidden_s": hidden,

@@ -52,6 +52,16 @@ EVENT_TYPES: Dict[str, str] = {
                           "normal retry path.",
     "BACKPRESSURE_ADJUST": "A data executor retuned its inflight/queued "
                            "limits from the backpressure gauges.",
+    # Train goodput / straggler plane (observability/goodput.py + the
+    # GCS step matrix): both carry the forensics inline — the straggler
+    # flag names the dominant phase, the stall event attaches the
+    # auto-captured thread stacks of the stalled worker.
+    "TRAIN_STRAGGLER": "A train worker's step time exceeded the pod "
+                       "median by the straggler threshold (the event "
+                       "names the dominant phase).",
+    "TRAIN_STALL": "A train worker missed its step-report heartbeats; "
+                   "thread stacks were auto-captured from the stalled "
+                   "worker and attached.",
 }
 
 # Worker exit taxonomy (reference: `WorkerExitType`). The raylet picks
@@ -85,6 +95,8 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "AUTOSCALE_DOWN": "INFO",
     "PREEMPT_RESCHEDULE": "WARNING",
     "BACKPRESSURE_ADJUST": "INFO",
+    "TRAIN_STRAGGLER": "WARNING",
+    "TRAIN_STALL": "ERROR",
 }
 
 _EXIT_SEVERITY = {

@@ -145,6 +145,15 @@ class TrackedJit:
         except Exception:
             pass  # telemetry must never break the hot path
         try:
+            # Compile wall time is lost training time: the goodput
+            # ledger books it as "recompiling" when a train loop is
+            # live in this process (no-op otherwise).
+            from ray_tpu.observability.goodput import record_recompile
+
+            record_recompile(seconds)
+        except Exception:
+            pass
+        try:
             import time
 
             from ray_tpu.util.tracing import record_span

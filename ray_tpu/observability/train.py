@@ -12,6 +12,13 @@ Two singletons feeding the same registry the serving metrics use:
 
 Step-duration histograms use coarser boundaries than the serving set:
 training steps live in the 10ms..minutes range.
+
+The per-step *phase* decomposition (data-wait/h2d/compute/...), the
+goodput ledger, and the cross-worker step matrix live next door in
+``observability.goodput``; :func:`record_report_step` is the bridge
+for report-driven user loops — each ``train.report()`` gap doubles as
+a step-heartbeat row so the GCS straggler detector and stall watchdog
+cover custom loops that never touch ``StepPhases``.
 """
 
 from __future__ import annotations
@@ -84,6 +91,30 @@ def learner_metrics() -> LearnerMetrics:
         if _learner is None:
             _learner = LearnerMetrics()
         return _learner
+
+
+def record_report_step(rank: int, step: int,
+                       step_s: "float | None") -> None:
+    """Publish one report-driven step row into the GCS step matrix.
+
+    Called by the train session per ``train.report()`` with the
+    inter-report gap: no phase breakdown (the user loop is opaque),
+    but the row IS the worker's step heartbeat — a custom loop that
+    stops reporting trips the stall watchdog, and one consistently
+    slower than its peers is flagged TRAIN_STRAGGLER on wall time.
+    """
+    try:
+        from ray_tpu.observability.goodput import (
+            goodput_enabled, publish_train_step)
+
+        if step_s is None or not goodput_enabled():
+            return
+        publish_train_step({
+            "worker": f"rank{int(rank)}", "step": int(step),
+            "wall_s": float(step_s), "phases": {},
+        })
+    except Exception:
+        pass  # telemetry must never fail a training step
 
 
 def batch_num_samples(batch) -> int:

@@ -111,21 +111,36 @@ class Learner:
         import time
 
         from ray_tpu.observability import batch_num_samples, learner_metrics
+        from ray_tpu.observability.goodput import (StepPhases,
+                                                   goodput_enabled)
         from ray_tpu.util.tracing import span
 
         lm = learner_metrics()
+        sp = None
+        if goodput_enabled():
+            sp = StepPhases(step=int(rng_seed),
+                            worker=f"learner{jax.process_index()}")
         t0 = time.perf_counter()
         # tree.map so nested multi-agent batches ({module_id: {k: v}})
         # shard leaf-wise exactly like flat single-agent ones.
         with span("learner.update"):
+            t_h2d = time.perf_counter()
             global_batch = jax.tree.map(
                 lambda v: jax.make_array_from_process_local_data(
                     self._data_sh, np.asarray(v)), batch)
+            if sp is not None:
+                sp.add("h2d", time.perf_counter() - t_h2d)
+            t_step = time.perf_counter()
             self._state, metrics = self._update_fn(
                 self._state, global_batch, jax.random.key(rng_seed))
+            if sp is not None:
+                jax.block_until_ready(metrics)
+                sp.add("compute", time.perf_counter() - t_step)
         lm.update_seconds.observe(time.perf_counter() - t0)
         lm.updates.inc()
         lm.samples.inc(batch_num_samples(batch))
+        if sp is not None:
+            sp.finish()
         out: Dict[str, Any] = {}
         for k, v in metrics.items():
             if np.ndim(v) == 0:

@@ -82,19 +82,34 @@ class LearnerGroup:
         import time
 
         from ray_tpu.observability import learner_metrics
+        from ray_tpu.observability.goodput import (StepPhases,
+                                                   goodput_enabled)
         from ray_tpu.util.tracing import span
 
         n = self.num_learners
         self._step += 1
+        # Driver-side decomposition only: publish=False keeps the
+        # coordinator's rows out of the GCS step matrix so the
+        # straggler median is computed over actual learners.
+        sp = (StepPhases(step=self._step, worker="learner-group")
+              if goodput_enabled() else None)
         t0 = time.perf_counter()
         with span("learner_group.update",
                   attrs={"learners": n, "step": self._step}):
+            t_split = time.perf_counter()
             shards = _split_batch(batch, n)
+            if sp is not None:
+                sp.add("data_wait", time.perf_counter() - t_split)
+            t_run = time.perf_counter()
             refs = [w.execute.remote(_learner_update, shards[i], self._step)
                     for i, w in enumerate(self._group.workers)]
             metrics = ray_tpu.get(refs, timeout=600)
+            if sp is not None:
+                sp.add("compute", time.perf_counter() - t_run)
         learner_metrics().group_update_seconds.observe(
             time.perf_counter() - t0)
+        if sp is not None:
+            sp.finish(publish=False)
         return metrics[0]
 
     def foreach_learner(self, method: str, *args, **kwargs) -> List[Any]:

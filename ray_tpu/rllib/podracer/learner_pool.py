@@ -94,6 +94,16 @@ class _LearnerWorker:
         self._staleness_hist: Dict[int, int] = {}
         self._last_metrics: Dict[str, float] = {}
 
+        from ray_tpu.observability.goodput import (GoodputLedger,
+                                                   goodput_enabled,
+                                                   set_active_ledger)
+
+        self._goodput_on = goodput_enabled()
+        self._ledger = (GoodputLedger(worker=f"learner-{self._rank}")
+                        if self._goodput_on else None)
+        if self._ledger is not None:
+            set_active_ledger(self._ledger)
+
     def ready(self) -> int:
         return self._version
 
@@ -109,6 +119,9 @@ class _LearnerWorker:
         work (the producer stopped or fell behind)."""
         import time
 
+        from ray_tpu.observability.goodput import (StepPhases,
+                                                   goodput_metrics,
+                                                   publish_train_done)
         from ray_tpu.observability.rl import rl_metrics
         from ray_tpu.util.queue import Empty
 
@@ -116,16 +129,26 @@ class _LearnerWorker:
         consumed = applied = dropped = 0
         pending: List[Any] = []
         while consumed < max_updates:
+            data_wait_s = 0.0
             if not pending:
+                t_q = time.perf_counter()
                 try:
                     got = self._queue.get(timeout=idle_timeout_s)
                 except Empty:
                     break
+                data_wait_s = time.perf_counter() - t_q
                 # A list item is a chunk of minibatches (producers
                 # amortize the queue round trip); a dict is one batch.
                 pending = list(got) if isinstance(got, list) else [got]
             item = pending.pop(0)
             consumed += 1
+            sp = None
+            if self._goodput_on:
+                sp = StepPhases(step=self._consumed + consumed,
+                                worker=f"learner-{self._rank}",
+                                ledger=self._ledger)
+                if data_wait_s:
+                    sp.add("data_wait", data_wait_s)
             behavior = int(item.pop("weight_version", self._version))
             staleness = max(0, self._version - behavior)
             self._max_staleness = max(self._max_staleness, staleness)
@@ -135,23 +158,56 @@ class _LearnerWorker:
             if staleness > self._clip:
                 dropped += 1
                 m.dropped_stale.inc()
+                if sp is not None:
+                    sp.finish()
                 continue
-            if self._delay > 0:
-                time.sleep(self._delay)
-            batch = self._pad_rows(item)
-            rows = len(next(iter(batch.values())))
-            self._state, metrics = self._step(self._state, batch)
+            if sp is not None:
+                with sp.phase("compute"):
+                    if self._delay > 0:
+                        time.sleep(self._delay)
+                    batch = self._pad_rows(item)
+                    rows = len(next(iter(batch.values())))
+                    self._state, metrics = self._step(self._state, batch)
+                    # np.asarray fences the device work inside the
+                    # timed compute section.
+                    self._last_metrics = {
+                        k: float(np.asarray(v))
+                        for k, v in metrics.items()}
+            else:
+                if self._delay > 0:
+                    time.sleep(self._delay)
+                batch = self._pad_rows(item)
+                rows = len(next(iter(batch.values())))
+                self._state, metrics = self._step(self._state, batch)
+                self._last_metrics = {
+                    k: float(np.asarray(v)) for k, v in metrics.items()}
             applied += 1
             m.samples.inc(rows)
-            self._last_metrics = {
-                k: float(np.asarray(v)) for k, v in metrics.items()}
             if (self._store is not None and self._publish_interval > 0
                     and applied % self._publish_interval == 0):
-                self._version = self._store.publish(self.get_weights())
+                if sp is not None:
+                    with sp.phase("weight_publish"):
+                        self._version = self._store.publish(
+                            self.get_weights())
+                else:
+                    self._version = self._store.publish(
+                        self.get_weights())
+            if sp is not None:
+                sp.finish()
         if self._store is not None and applied > 0:
             # End-of-kick publish: one version per kick by default, so
             # staleness counts kicks-behind, not minibatches-behind.
+            t_pub = time.perf_counter()
             self._version = self._store.publish(self.get_weights())
+            if self._ledger is not None:
+                pub_s = time.perf_counter() - t_pub
+                goodput_metrics().step_phase_seconds.observe(
+                    pub_s, {"phase": "weight_publish"})
+                self._ledger.book_phases({"weight_publish": pub_s})
+        if self._goodput_on:
+            # A kick that ends is idle, not stalled: tell the watchdog
+            # to stop expecting heartbeats until the next kick reports.
+            publish_train_done(f"learner-{self._rank}")
         self._consumed += consumed
         self._applied += applied
         self._dropped += dropped
@@ -185,6 +241,8 @@ class _LearnerWorker:
             "staleness_hist": dict(self._staleness_hist),
             "last_metrics": dict(self._last_metrics),
         }
+        if self._ledger is not None:
+            out["goodput"] = self._ledger.snapshot()
         out.update(kick)
         return out
 

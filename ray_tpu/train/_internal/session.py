@@ -124,12 +124,19 @@ class _TrainSession:
             # a monotonic index for the same reason).
             from ray_tpu.util.tracing import span
 
+            t0 = time.perf_counter()
             with span("train.checkpoint_persist",
                       attrs={"rank": self.context.world_rank}):
                 persisted = checkpoint.persist(
                     self.context.storage_dir,
                     name=f"checkpoint_{self._report_counter:06d}"
                          f"_rank{self.context.world_rank}")
+            try:
+                from ray_tpu.observability.goodput import record_checkpoint
+
+                record_checkpoint(time.perf_counter() - t0)
+            except Exception:
+                pass  # telemetry must never fail a training step
             self._report_counter += 1
             self.latest_checkpoint = persisted
             ckpt_path = persisted.path
@@ -144,6 +151,8 @@ class _TrainSession:
         try:
             from ray_tpu.observability import train_metrics
 
+            from ray_tpu.observability.train import record_report_step
+
             tm = train_metrics()
             now = time.monotonic()
             tm.reports.inc()
@@ -153,6 +162,10 @@ class _TrainSession:
             else:
                 step_s = None
             self._last_report_ts = now
+            self._telemetry_steps = getattr(
+                self, "_telemetry_steps", 0) + 1
+            record_report_step(self.context.world_rank,
+                               self._telemetry_steps, step_s)
             if isinstance(metrics, dict):
                 for key in ("loss", "total_loss", "train_loss"):
                     if isinstance(metrics.get(key), (int, float)):

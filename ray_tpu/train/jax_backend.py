@@ -155,12 +155,29 @@ def run_pod_training(model_config=None, mesh_axes=None, steps: int = 4,
     pipelined chunk-by-chunk under the optimizer math instead of running
     as one exposed collective.  Requires a pure data mesh (the chunk
     schedule owns the whole flat parameter vector).
+
+    When ``train_goodput_instrumentation`` is on (default), the loop
+    runs under the per-step phase ledger (`observability.goodput`):
+    each step is decomposed into h2d/compute/exposed-collective/
+    weight-publish phases (``rtpu_train_step_phase_seconds{phase}`` +
+    ``train.step`` spans), the warmup compile step is booked as
+    ``recompiling`` lost time, and each step publishes a heartbeat row
+    into the GCS step matrix (straggler + stall detection). The
+    returned dict then carries ``goodput`` (the worker ledger
+    snapshot) and ``phase_seconds`` (per-phase sums over the timed
+    steps).
     """
     import time
 
     import jax
     import numpy as np
     import optax
+
+    from ray_tpu._private.config import GlobalConfig
+    from ray_tpu.observability.goodput import (
+        GoodputLedger, StepPhases, goodput_metrics, publish_train_done,
+        set_active_ledger,
+    )
 
     from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn
     from ray_tpu.parallel import (
@@ -217,25 +234,71 @@ def run_pod_training(model_config=None, mesh_axes=None, steps: int = 4,
     if batch_size % data_shards:
         batch_size = ((batch_size + data_shards - 1)
                       // data_shards) * data_shards
+    instrument = bool(GlobalConfig.train_goodput_instrumentation)
+    worker_label = f"train-{jax.process_index()}"
+    ledger = GoodputLedger(worker=worker_label) if instrument else None
+    if ledger is not None:
+        set_active_ledger(ledger)
+
     rng = np.random.RandomState(seed)
-    batch = {"tokens": jax.device_put(
-        rng.randint(0, model_config.vocab_size,
-                    (batch_size, seq_len)).astype("int32"), bsh)}
+    host_tokens = rng.randint(0, model_config.vocab_size,
+                              (batch_size, seq_len)).astype("int32")
+    t_h2d = time.perf_counter()
+    batch = {"tokens": jax.device_put(host_tokens, bsh)}
+    if ledger is not None:
+        # One-off input transfer: an h2d histogram sample + stalled
+        # ledger time (a real input pipeline pays this per step).
+        h2d_s = time.perf_counter() - t_h2d
+        goodput_metrics().step_phase_seconds.observe(
+            h2d_s, {"phase": "h2d"})
+        ledger.book_phases({"h2d": h2d_s})
     tokens_per_step = batch_size * (seq_len - 1)  # next-token targets
 
+    t_compile = time.perf_counter()
     state, metrics = step(state, batch)  # compile + warmup
     jax.block_until_ready(metrics["loss"])
+    if ledger is not None:
+        # The compile+warmup step is wall time the pod spent not
+        # training — exactly what a preemption/resume re-pays.
+        ledger.lose("recompiling", time.perf_counter() - t_compile)
+
+    step_rows = []
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch)
-        if report is not None:
-            report({"loss": float(metrics["loss"]),
-                    "step": int(metrics["step"])})
+    for i in range(steps):
+        if ledger is not None:
+            sp = StepPhases(step=i, worker=worker_label, ledger=ledger)
+            with sp.phase("compute"):
+                state, metrics = step(state, batch)
+                # Phase attribution needs the step's device work fenced
+                # inside its timed section (dispatch alone is ~free).
+                jax.block_until_ready(metrics["loss"])
+            if report is not None:
+                with sp.phase("weight_publish"):
+                    report({"loss": float(metrics["loss"]),
+                            "step": int(metrics["step"])})
+            step_rows.append(sp.finish())
+        else:
+            state, metrics = step(state, batch)
+            if report is not None:
+                report({"loss": float(metrics["loss"]),
+                        "step": int(metrics["step"])})
     jax.block_until_ready(metrics["loss"])
     elapsed = time.perf_counter() - t0
     loss = float(metrics["loss"])
     tokens_per_sec = tokens_per_step * steps / max(elapsed, 1e-9)
+    extra = {}
+    if ledger is not None:
+        phase_seconds: dict = {}
+        for row in step_rows:
+            for phase, dur in row["phases"].items():
+                phase_seconds[phase] = phase_seconds.get(phase, 0.0) + dur
+        extra = {"goodput": ledger.snapshot(),
+                 "phase_seconds": phase_seconds,
+                 "step_walls": [row["wall_s"] for row in step_rows]}
+        set_active_ledger(None)
+        publish_train_done(worker_label)
     return {
+        **extra,
         "n_devices": n_devices,
         "mesh": {name: int(size) for name, size
                  in zip(mesh.axis_names, mesh.devices.shape)},

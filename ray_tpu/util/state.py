@@ -260,6 +260,26 @@ def summary_events() -> Dict[str, Any]:
     return _gcs().call("summary_cluster_events", timeout=30)
 
 
+def train_summary() -> Dict[str, Any]:
+    """The training goodput & straggler rollup from the GCS step
+    matrix: per-worker step counts / mean step wall / stall and
+    straggler flags, the cluster goodput ratio (productive seconds
+    over accounted wall), lost seconds by cause
+    (stalled/recompiling/restarting/checkpointing), per-phase mean
+    seconds, and the recent TRAIN_STRAGGLER flags. Answers "which
+    worker is slowing the pod, and in which phase?" without logs."""
+    return _gcs().call("train_summary", timeout=30)
+
+
+def list_train_steps(worker: Optional[str] = None,
+                     limit: int = 200) -> List[Dict[str, Any]]:
+    """Newest-last rows of the cross-worker train step matrix (worker,
+    step, wall_s, per-phase seconds, goodput snapshot), optionally
+    filtered by worker label (e.g. ``train-0``, ``learner-1``)."""
+    return _gcs().call("list_train_steps", worker=worker, limit=limit,
+                       timeout=30)
+
+
 def get_log(task_id: Optional[str] = None, actor_id: Optional[str] = None,
             worker_id: Optional[str] = None,
             tail: int = 100) -> List[str]:

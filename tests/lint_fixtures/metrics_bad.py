@@ -15,6 +15,10 @@ TRACED = Histogram("serve_admit_wait_seconds",      # metric-exemplar-tag
                    boundaries=[0.01, 0.1, 1.0])
 TRACED.observe(0.5, tags={"trace_id": "abc123"})    # metric-exemplar-tag
 
+RATIO_COUNTER = Counter("train_goodput_bad_ratio")  # metric-ratio-gauge
+RATIO_HIST = Histogram("serve_hit_bad_ratio",       # metric-ratio-gauge
+                       boundaries=[0.5, 1.0])       # (+histogram-suffix)
+
 FIRST = Counter("serve_handled", tag_keys=("route",))
 SECOND = Counter("serve_handled", tag_keys=("route", "code"))  # redeclared
 

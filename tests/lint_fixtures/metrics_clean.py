@@ -6,6 +6,7 @@ REQUESTS = Counter("serve_requests")
 LATENCY = Histogram("serve_latency_seconds",
                     boundaries=[0.1, 1.0, 10.0])
 RSS = Gauge("worker_rss_bytes", tag_keys=("node",))
+FRACTION = Gauge("train_demo_goodput_ratio")   # ratio as Gauge: fine
 
 LATENCY.observe(0.5, trace_id="abc123")   # exemplar kwarg: fine
 
