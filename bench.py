@@ -1486,6 +1486,110 @@ def _bench_train_goodput_overhead() -> dict:
     }
 
 
+def _bench_serve_accounting_overhead() -> dict:
+    """Per-request cost of the serve accounting instrumentation
+    (observability/accounting.py: RequestMeter attach + block-second
+    interval bookkeeping + per-tick chip-second credit + the finish
+    fold). A Poisson-arrival serve leg on a tiny paged engine with the
+    env knob on vs off (the gate latches at engine construction, so
+    each leg builds a fresh engine and warms it outside the timed
+    window); the metered path adds a few monotonic() reads and dict
+    bumps per scheduling event, so both tokens/s and p99 TTFT must sit
+    inside repeat-to-repeat noise — `within_noise` records the verdict
+    (cf. _bench_train_goodput_overhead)."""
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, Request
+
+    config = LlamaConfig.tiny()
+    params = init_params(config, jax.random.key(0))
+    n_requests, repeats = 48, 3
+
+    def _leg():
+        engine = LLMEngine(params, config, EngineConfig(
+            num_slots=4, max_seq_len=64, prefill_buckets=(8, 16),
+            kv_layout="paged", kv_block_size=8))
+        engine.warmup()
+        rng = np.random.RandomState(42)
+        prompts = [rng.randint(0, config.vocab_size,
+                               rng.randint(4, 16)).tolist()
+                   for _ in range(n_requests)]
+        # Poisson batch arrivals: k new requests join per decode tick.
+        arrivals = np.clip(rng.poisson(2.0, size=n_requests), 1, None)
+        handles = []
+        i = 0
+        t0 = time.perf_counter()
+        while i < n_requests:
+            for _ in range(int(arrivals[i % len(arrivals)])):
+                if i >= n_requests:
+                    break
+                handles.append(engine.submit(Request(
+                    prompt=prompts[i], max_tokens=8,
+                    tenant=f"tenant-{i % 5}")))
+                i += 1
+            engine.step()
+        engine.drain()
+        wall = time.perf_counter() - t0
+        toks = sum(len(h.tokens) for h in handles)
+        ttfts = sorted(h.ttft_s for h in handles
+                       if h.ttft_s is not None)
+        p99 = ttfts[min(int(len(ttfts) * 0.99), len(ttfts) - 1)]
+        return toks / wall, p99
+
+    samples = {"1": {"tps": [], "p99": []},
+               "0": {"tps": [], "p99": []}}
+    # Interleave the legs so host drift lands on both sides evenly.
+    for _ in range(repeats):
+        for flag in ("1", "0"):
+            os.environ["RAY_TPU_serve_accounting_instrumentation"] = flag
+            try:
+                tps, p99 = _leg()
+            finally:
+                os.environ.pop(
+                    "RAY_TPU_serve_accounting_instrumentation", None)
+            samples[flag]["tps"].append(tps)
+            samples[flag]["p99"].append(p99)
+
+    med = {f: {k: statistics.median(v) for k, v in s.items()}
+           for f, s in samples.items()}
+    iqr = {f: {k: float(np.percentile(v, 75) - np.percentile(v, 25))
+               for k, v in s.items()}
+           for f, s in samples.items()}
+    tps_delta = med["1"]["tps"] - med["0"]["tps"]
+    p99_delta = med["1"]["p99"] - med["0"]["p99"]
+    tps_noise = max(iqr["1"]["tps"], iqr["0"]["tps"])
+    p99_noise = max(iqr["1"]["p99"], iqr["0"]["p99"])
+    within = (abs(tps_delta) <= max(tps_noise, 0.1 * med["0"]["tps"])
+              and abs(p99_delta) <= max(p99_noise,
+                                        0.1 * med["0"]["p99"]))
+    return {
+        "metric": "serve_accounting_overhead_pct",
+        "value": round(100.0 * tps_delta / med["0"]["tps"], 3),
+        "unit": "%",
+        "vs_baseline": None,
+        "detail": {
+            "tokens_per_sec_on": round(med["1"]["tps"], 2),
+            "tokens_per_sec_off": round(med["0"]["tps"], 2),
+            "p99_ttft_on_ms": round(med["1"]["p99"] * 1000, 3),
+            "p99_ttft_off_ms": round(med["0"]["p99"] * 1000, 3),
+            "tps_noise_floor": round(tps_noise, 2),
+            "p99_noise_floor_ms": round(p99_noise * 1000, 3),
+            "within_noise": within,
+            "requests_per_leg": n_requests,
+            "repeats_per_mode": repeats,
+            "note": "Poisson serve leg (tiny paged engine, 5 tenants), "
+                    "accounting instrumentation on minus off; "
+                    "within_noise requires BOTH tokens/s and p99 TTFT "
+                    "deltas inside the larger repeat-to-repeat IQR "
+                    "(floor: 10% of the off leg)",
+        },
+    }
+
+
 def _bench_ppo_env_steps() -> dict:
     """Decoupled (Podracer) vs colocated PPO acting throughput on the
     CPU-virtual-device path. The config is deliberately learning-heavy
@@ -1892,6 +1996,15 @@ def main() -> None:
     except Exception as e:
         print(json.dumps({"metric": "train_goodput_overhead_ms",
                           "value": None, "unit": "ms",
+                          "vs_baseline": None, "error": repr(e)[:300]}))
+
+    # Serve accounting instrumentation overhead: Poisson serve leg on a
+    # tiny paged engine, RequestMeter plane on vs off, in-process.
+    try:
+        print(json.dumps(_bench_serve_accounting_overhead()))
+    except Exception as e:
+        print(json.dumps({"metric": "serve_accounting_overhead_pct",
+                          "value": None, "unit": "%",
                           "vs_baseline": None, "error": repr(e)[:300]}))
 
     # Closed-loop serve autoscaling under a stepped Poisson load (the

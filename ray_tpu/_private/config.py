@@ -213,6 +213,49 @@ _define("serve_peer_pull_min_blocks", int, 4,
         "Minimum expected-hit advantage (in blocks) a peer must hold "
         "over the chosen replica before the router pulls KV blocks "
         "from it instead of letting the replica recompute.")
+_define("serve_accounting_instrumentation", bool, True,
+        "Per-request cost accounting on serve LLM engines "
+        "(observability.accounting.RequestMeter): prefill tokens "
+        "computed vs avoided, decode tokens, KV block-seconds, "
+        "chip-seconds per phase, folded into the tenant ledger and "
+        "published to the GCS accounting ring. Off = the unmetered "
+        "engine; the serve_accounting_overhead bench prices the delta.")
+_define("serve_accounting_buffer_size", int, 4096,
+        "Bound on the GCS serve-accounting ring "
+        "(report_serve_accounting / list_serve_accounting rows across "
+        "all replicas).")
+_define("serve_accounting_top_n", int, 8,
+        "How many tenants the accounting summaries rank by cost "
+        "(serve_accounting_summary / GET /api/accounting top lists).")
+_define("serve_accounting_max_tenants", int, 64,
+        "Bound on distinct tenant rows a TenantLedger holds; overflow "
+        "tenants fold into the '__other__' rollup row, which also caps "
+        "the cardinality of the rtpu_serve_tenant_* counter label.")
+_define("serve_slo_ttft_ms", str, "interactive=500,*=2000",
+        "Per-lane TTFT targets (ms) for SLO attainment: "
+        "'lane=ms,...' with '*' as the default lane. A bare number "
+        "applies to every lane.")
+_define("serve_slo_tpot_ms", str, "interactive=200,*=1000",
+        "Per-lane TPOT (per-output-token) targets in ms; same format "
+        "as serve_slo_ttft_ms.")
+_define("serve_slo_objective", float, 0.99,
+        "Fraction of requests per lane that must meet their TTFT/TPOT "
+        "targets; 1 - objective is the error budget the burn rate is "
+        "measured against.")
+_define("serve_slo_burn_fast_window_s", float, 60.0,
+        "Fast window of the multi-window SLO burn-rate evaluation "
+        "(catches sharp regressions within about a minute).")
+_define("serve_slo_burn_slow_window_s", float, 3600.0,
+        "Slow window of the SLO burn-rate evaluation (the fast window "
+        "only fires when the slow window is also consuming budget, so "
+        "a one-blip spike never pages).")
+_define("serve_slo_burn_threshold", float, 10.0,
+        "Fast-window burn rate at or above which (with the slow "
+        "window also >= 1.0) an SLO_BURN cluster event fires; the "
+        "episode clears when the fast burn drops below half this.")
+_define("serve_slo_min_samples", int, 3,
+        "Minimum fast-window observations before a lane's burn rate "
+        "is trusted enough to fire SLO_BURN.")
 _define("data_backpressure_interval_s", float, 1.0,
         "Minimum spacing between backpressure re-evaluations per "
         "executor (the tuner is pulled from the launch loop; this "

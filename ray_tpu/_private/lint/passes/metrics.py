@@ -16,7 +16,11 @@ each rule:
   runtime registry only catches collisions that co-execute in one
   process);
 - hand-rolled Prometheus exposition (``# TYPE`` lines inside string
-  literals) reserves ``_total`` for counters and requires it of them.
+  literals) reserves ``_total`` for counters and requires it of them;
+- declared tag keys must not be unbounded identifiers (tenant, model,
+  request_id, ...) — per-entity attribution routes through the
+  accounting plane's bounded fold (observability/accounting.py), the
+  only module exempt from the rule.
 """
 
 from __future__ import annotations
@@ -56,6 +60,20 @@ _FAMILIES = (
 _EXPOSITION_TYPE_RE = re.compile(
     r"#\s*TYPE\s+([a-zA-Z_:][a-zA-Z0-9_:]*)\s+"
     r"(counter|gauge|histogram|summary)\b")
+
+# Tag keys whose value space is an unbounded identifier: each distinct
+# value mints a new Prometheus series, so a declared label of this shape
+# is a cardinality bomb. Per-tenant/per-model attribution belongs in the
+# accounting plane (observability/accounting.py), whose TenantLedger
+# folds rows into a bounded top-N before anything reaches a label.
+# (trace_id is excluded here: the metric-exemplar-tag rule owns it.)
+_UNBOUNDED_TAGS = ("tenant", "model", "request_id", "user", "user_id",
+                   "session_id", "job_id", "task_id", "actor_id",
+                   "object_id")
+
+# Emit sites allowed to carry unbounded-id labels: the accounting plane
+# bounds them (max_tenants fold + __other__ overflow) before export.
+_CARDINALITY_EXEMPT_SUFFIXES = ("observability/accounting.py",)
 
 
 def _metric_bindings(tree: ast.Module) -> Dict[str, str]:
@@ -126,7 +144,8 @@ class MetricsPass(LintPass):
     rules = ("metric-unlintable-name", "metric-name", "metric-family",
              "metric-histogram-suffix", "metric-gauge-pid-tag",
              "metric-redeclared", "metric-exposition",
-             "metric-exemplar-tag", "metric-ratio-gauge")
+             "metric-exemplar-tag", "metric-ratio-gauge",
+             "metric-label-cardinality")
     description = ("metric naming/family/unit/tag contract + cross-file "
                    "redeclaration consistency + Prometheus exposition "
                    "suffix discipline (ex scripts/check_metrics.py)")
@@ -211,6 +230,19 @@ class MetricsPass(LintPass):
                 f"observe(..., trace_id=) kwarg and must not widen the "
                 f"declared label set (per-trace labels are unbounded "
                 f"cardinality)")
+        if tag_keys and not mod.relpath.replace(
+                "\\", "/").endswith(_CARDINALITY_EXEMPT_SUFFIXES):
+            for t in tag_keys:
+                if t in _UNBOUNDED_TAGS:
+                    yield mod.finding(
+                        "metric-label-cardinality", line,
+                        f"metric {name!r} declares unbounded-id tag key "
+                        f"{t!r} — each distinct value mints a new "
+                        f"series; route per-{t} attribution through the "
+                        f"accounting plane "
+                        f"(ray_tpu/observability/accounting.py), whose "
+                        f"TenantLedger folds rows into a bounded set "
+                        f"before any label is emitted")
         if d["class"] == "Gauge" and tag_keys and "pid" in tag_keys:
             yield mod.finding(
                 "metric-gauge-pid-tag", line,

@@ -203,6 +203,32 @@ _PANELS: List[Dict[str, str]] = [
      "expr_b": 'rate(rtpu_cluster_events_total'
                '{type="TRAIN_STALL"}[5m])',
      "unit": "short"},
+    # --- per-request cost accounting & SLO plane (observability/accounting) ---
+    {"title": "Tenant chip-seconds/sec",
+     "expr": "rate(rtpu_serve_tenant_chip_seconds_total[5m])",
+     "legend": "{{tenant}}", "unit": "s"},
+    {"title": "Tenant tokens/sec",
+     "expr": "rate(rtpu_serve_tenant_tokens_total[1m])",
+     "legend": "{{tenant}}", "unit": "short"},
+    {"title": "Tenant KV block-seconds/sec",
+     "expr": "rate(rtpu_serve_tenant_block_seconds_total[5m])",
+     "legend": "{{tenant}}", "unit": "s"},
+    {"title": "Request cost p50/p99 (chip-seconds)",
+     "expr": 'histogram_quantile(0.5, '
+             'rate(rtpu_serve_request_cost_chip_seconds_bucket[5m]))',
+     "expr_b": 'histogram_quantile(0.99, '
+               'rate(rtpu_serve_request_cost_chip_seconds_bucket[5m]))',
+     "unit": "s"},
+    {"title": "SLO attainment per lane",
+     "expr": "rtpu_serve_slo_attainment_ratio",
+     "legend": "{{lane}}", "unit": "percentunit"},
+    {"title": "SLO burn rate (fast vs slow)",
+     "expr": 'rtpu_serve_slo_burn_rate{window="fast"}',
+     "expr_b": 'rtpu_serve_slo_burn_rate{window="slow"}',
+     "legend": "{{lane}}/{{window}}", "unit": "short"},
+    {"title": "SLO burn events",
+     "expr": 'rate(rtpu_cluster_events_total{type="SLO_BURN"}[5m])',
+     "unit": "short"},
 ]
 
 

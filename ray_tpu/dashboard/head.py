@@ -25,6 +25,11 @@ Endpoints:
                       step matrix rows (?worker=, ?limit=), goodput
                       ratio + lost seconds by cause, per-phase means,
                       stall/straggler flags
+  GET /api/accounting serve cost accounting & SLO attainment: top-N
+                      tenants by chip-seconds (?top_n=), per-lane
+                      attainment/burn, per-request cost rows
+                      (?tenant=, ?lane=, ?trace_id=, ?limit=), and the
+                      serve_tenant_*/serve_request_cost_* metric series
   GET /api/memory     per-node object-store introspection + spill metrics
   GET /api/data       data-pipeline (DatasetStats) metric summary
   GET /api/events     ClusterEventLog (failure forensics) with ?type=,
@@ -34,7 +39,8 @@ Endpoints:
                       NODE_REMOVED, LEASE_RECLAIMED, TASK_RETRY,
                       SPILL_PRESSURE, JOB_STARTED, JOB_FINISHED,
                       AUTOSCALE_UP, AUTOSCALE_DOWN, PREEMPT_RESCHEDULE,
-                      BACKPRESSURE_ADJUST, TRAIN_STRAGGLER, TRAIN_STALL.
+                      BACKPRESSURE_ADJUST, TRAIN_STRAGGLER, TRAIN_STALL,
+                      SLO_BURN.
   GET /api/controller control-plane decision log (serve autoscaler,
                       data backpressure, memory preemption) with
                       ?controller=, ?action=, ?limit= filters; each row
@@ -388,6 +394,38 @@ class DashboardHead:
             "metrics": metrics or {},
         })
 
+    async def accounting(self, req) -> web.Response:
+        """Serve cost accounting & SLO attainment: the GCS summary
+        (top-N tenants by chip-seconds, per-lane SLO attainment/burn),
+        recent per-request cost rows (?tenant=, ?lane=, ?trace_id= and
+        ?limit= filter them), and the cluster-folded accounting metric
+        series. ?trace_id= additionally surfaces that request's own
+        cost row inside the summary (acceptance path for the
+        x-trace-id a routed request returned)."""
+        try:
+            limit = int(req.query.get("limit", 50))
+            top_n = int(req.query.get("top_n", 0)) or None
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        trace_id = req.query.get("trace_id")
+        summary = await self._gcs.acall(
+            "serve_accounting_summary", top_n=top_n, trace_id=trace_id,
+            timeout=10)
+        rows = await self._gcs.acall(
+            "list_serve_accounting",
+            tenant=req.query.get("tenant"),
+            lane=req.query.get("lane"),
+            trace_id=trace_id, limit=limit, timeout=10)
+        metrics = await self._gcs.acall(
+            "user_metrics_summary",
+            prefixes=["serve_tenant_", "serve_request_cost_"],
+            timeout=10)
+        return web.json_response({
+            "summary": summary or {},
+            "requests": rows or [],
+            "metrics": metrics or {},
+        })
+
     async def memory(self, req) -> web.Response:
         """Object-store memory introspection: live per-node snapshots
         straight from each raylet's store (same numbers
@@ -675,6 +713,7 @@ class DashboardHead:
         app.router.add_get("/api/serve", self.serve_stats)
         app.router.add_get("/api/rl", self.rl_stats)
         app.router.add_get("/api/train", self.train_stats)
+        app.router.add_get("/api/accounting", self.accounting)
         app.router.add_get("/api/memory", self.memory)
         app.router.add_get("/api/data", self.data_stats)
         app.router.add_get("/api/events", self.events)

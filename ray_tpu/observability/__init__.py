@@ -63,12 +63,37 @@ shared instrumentation layer every hot path reports through:
   the :class:`Hysteresis` hold-delay/cooldown gate shared by the serve
   autoscaler and the data backpressure tuner.
 
+- ``accounting``: the per-request cost accounting & SLO attainment
+  plane for the serving tier — the :class:`RequestMeter` attached to
+  every engine request (prefill tokens computed vs avoided, decode
+  tokens, speculative accept ratio, KV block-seconds, queue-wait and
+  chip-seconds per phase, stamped ``{tenant, model, lane, trace_id}``),
+  the :class:`TenantLedger` fold published to the GCS over bounded
+  ``report/list_serve_accounting`` RPCs, and the :class:`SLOTracker`
+  multi-window burn-rate evaluation of TTFT/TPOT attainment per lane
+  that emits the typed ``SLO_BURN`` cluster event
+  (``rtpu_serve_request_cost_*``, ``rtpu_serve_tenant_*_total{tenant}``,
+  ``rtpu_serve_slo_attainment_ratio{lane}``, ``GET /api/accounting``).
+
 Everything exports through the existing plane: metric objects are
 ``ray_tpu.util.metrics`` Counters/Gauges/Histograms (flushed to the GCS
 ``/metrics`` scrape endpoint with the ``rtpu_`` prefix), spans are
 ``ray_tpu.util.tracing`` events (rendered by ``ray_tpu.timeline()``).
 """
 
+from ray_tpu.observability.accounting import (  # noqa: F401
+    COST_PHASES,
+    RequestMeter,
+    SLOTracker,
+    TenantLedger,
+    TokenReconciler,
+    accounting_enabled,
+    accounting_metrics,
+    fold_finished,
+    publish_serve_row,
+    slo_targets,
+    tenant_ledger,
+)
 from ray_tpu.observability.jit import (  # noqa: F401
     RecompileWarning,
     TrackedJit,
@@ -150,4 +175,7 @@ __all__ = [
     "StragglerDetector", "classify_phase", "goodput_enabled",
     "goodput_metrics", "publish_train_done", "publish_train_step",
     "record_checkpoint", "record_recompile",
+    "COST_PHASES", "RequestMeter", "SLOTracker", "TenantLedger",
+    "TokenReconciler", "accounting_enabled", "accounting_metrics",
+    "fold_finished", "publish_serve_row", "slo_targets", "tenant_ledger",
 ]

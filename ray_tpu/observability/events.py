@@ -62,6 +62,13 @@ EVENT_TYPES: Dict[str, str] = {
     "TRAIN_STALL": "A train worker missed its step-report heartbeats; "
                    "thread stacks were auto-captured from the stalled "
                    "worker and attached.",
+    # Serve cost-accounting / SLO plane (observability/accounting.py +
+    # the GCS accounting ring): the burn event carries the fast/slow
+    # burn rates and attainment so the autoscaler / quota controllers
+    # can act on it without a second lookup.
+    "SLO_BURN": "A serve lane is burning its SLO error budget: both "
+                "the fast and slow burn-rate windows exceed their "
+                "thresholds for TTFT/TPOT attainment.",
 }
 
 # Worker exit taxonomy (reference: `WorkerExitType`). The raylet picks
@@ -97,6 +104,7 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "BACKPRESSURE_ADJUST": "INFO",
     "TRAIN_STRAGGLER": "WARNING",
     "TRAIN_STALL": "ERROR",
+    "SLO_BURN": "WARNING",
 }
 
 _EXIT_SEVERITY = {

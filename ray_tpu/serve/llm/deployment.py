@@ -138,7 +138,8 @@ class LLMServer:
                 stop=tuple(request.get("stop", ())),
                 slo=str(request.get("slo", "interactive")),
                 chunked_prefill=bool(
-                    request.get("chunked_prefill", False))))
+                    request.get("chunked_prefill", False)),
+                tenant=str(request.get("tenant", "default"))))
             try:
                 tokens = handle.result(timeout=float(
                     request.get("timeout_s", 300.0)))

@@ -48,11 +48,14 @@ class DecodeServer(LLMServer):
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             stop=tuple(request.get("stop", ())),
-            slo=str(request.get("slo", "interactive")))
+            slo=str(request.get("slo", "interactive")),
+            tenant=str(request.get("tenant", "default")))
         with span("llm.disagg_decode",
                   attrs={"prompt_len": len(req.prompt),
                          "adopted_blocks": state.n_blocks}):
-            handle = KVImporter(self._engine).adopt(req, state)
+            handle = KVImporter(self._engine).adopt(
+                req, state,
+                meter_snapshot=prefill_result.get("meter"))
             try:
                 tokens = handle.result(timeout=float(
                     request.get("timeout_s", 300.0)))

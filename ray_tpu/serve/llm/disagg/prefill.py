@@ -60,7 +60,8 @@ class PrefillServer(LLMServer):
             stop=tuple(request.get("stop", ())),
             slo=str(request.get("slo", "interactive")),
             prefill_only=True,
-            chunked_prefill=True)
+            chunked_prefill=True,
+            tenant=str(request.get("tenant", "default")))
         with span("llm.disagg_prefill",
                   attrs={"prompt_len": len(prompt)}):
             try:
@@ -79,5 +80,11 @@ class PrefillServer(LLMServer):
                 "tpot_s": handle.tpot_s,
             },
             "kv_state": handle.kv_state,
+            # Cost meter snapshot rides next to the KVState (NOT inside
+            # it — KVState is a strict device-payload schema): the
+            # decode tier's meter absorbs it so prefill chip-seconds
+            # land on the migrated request's single ledger row.
+            "meter": (handle.meter.snapshot()
+                      if handle.meter is not None else None),
             "request": dict(request),
         }

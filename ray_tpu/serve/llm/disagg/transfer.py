@@ -55,14 +55,19 @@ class KVImporter:
         self._engine = engine
 
     def adopt(self, request: Any, state: Any, *,
-              front: bool = False):
+              front: bool = False,
+              meter_snapshot: Optional[dict] = None):
         """All-or-nothing adoption via ``LLMEngine.submit_adopted``:
         the request queues until the allocator can cover every block
         the sequence may ever need (evicting cold prefix entries if
         that closes the gap), then one scatter lands the blocks and
         decoding continues token-for-token where the exporter
-        stopped."""
-        return self._engine.submit_adopted(request, state, front=front)
+        stopped. ``meter_snapshot`` is the prefill-side cost meter
+        (PrefillServer result key "meter") — absorbed into the
+        decode-side meter so the migration bills ONE ledger row."""
+        return self._engine.submit_adopted(
+            request, state, front=front,
+            meter_snapshot=meter_snapshot)
 
     def stats(self) -> dict:
         s = self._engine.stats()

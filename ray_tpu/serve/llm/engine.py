@@ -222,6 +222,11 @@ class Request:
     # prefix-hits them), one chunk per scheduler step — so interactive
     # admissions interleave instead of stalling behind one long prefill.
     chunked_prefill: bool = False
+    # Cost-accounting identity: whose ledger row this request bills to
+    # (observability/accounting.py). The schema is ready for the
+    # multiplexing roadmap item; until then callers that don't care
+    # all bill to "default".
+    tenant: str = "default"
 
 
 class RequestHandle:
@@ -258,10 +263,15 @@ class RequestHandle:
         # same explicit id.
         self.trace: Optional[Any] = None
         self.trace_span_id: Optional[str] = None
+        # Cost accounting (observability/accounting.py): attached at
+        # submit when the plane is enabled, integrated by the scheduler
+        # thread, finalized + folded at finish. None when disabled.
+        self.meter: Optional[Any] = None
         self._done = threading.Event()
         self._engine: Optional["LLMEngine"] = None
         self._chunk_ends: List[int] = []   # chunked-prefill boundaries
         self._chunk_idx = 0
+        self._adopted_submit = False   # arrived via submit_adopted
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -487,6 +497,17 @@ class LLMEngine:
                 donate_argnums=(1, 2, 3))
         self._metrics = serve_metrics()
         ensure_sampler_registered()
+
+        # Per-request cost accounting (observability/accounting.py).
+        # The gate is latched once per engine: meters attach at submit,
+        # so flipping the knob mid-flight would half-meter requests.
+        from ray_tpu.observability.accounting import accounting_enabled
+
+        self._acct = accounting_enabled()
+        mc = model_config
+        self._model_label = (
+            f"llama_d{getattr(mc, 'dim', 0)}"
+            f"_l{getattr(mc, 'n_layers', 0)}")
 
     # ------------------------------------------------------------ programs
 
@@ -775,13 +796,34 @@ class LLMEngine:
                     f"num_kv_blocks or lower max_tokens")
         handle._engine = self
         self._capture_trace(handle)
+        self._attach_meter(handle)
         with self._lock:
             self._queues[request.slo].append(handle)
         self._work.set()
         return handle
 
+    def _attach_meter(self, handle: RequestHandle) -> None:
+        """Attach a cost meter (after _capture_trace: the meter is
+        stamped with the captured trace id)."""
+        if not self._acct:
+            return
+        try:
+            from ray_tpu.observability.accounting import RequestMeter
+
+            req = handle.request
+            handle.meter = RequestMeter(
+                tenant=req.tenant, model=self._model_label,
+                lane=req.slo,
+                trace_id=(handle.trace.trace_id if handle.trace
+                          else None),
+                request_id=handle.request_id)
+        except Exception:
+            handle.meter = None   # accounting must never break submit
+
     def submit_adopted(self, request: Request, state: Any, *,
-                       front: bool = False) -> RequestHandle:
+                       front: bool = False,
+                       meter_snapshot: Optional[Dict[str, Any]] = None
+                       ) -> RequestHandle:
         """Submit a request whose prefill already ran elsewhere: `state`
         is the kv_cache.KVState exported by the prefill tier (or by
         preemption). Admission imports the blocks into this engine's
@@ -822,7 +864,14 @@ class LLMEngine:
                 f"pool only has {c.pool_blocks}")
         handle = RequestHandle(next(self._ids), request)
         handle._engine = self
+        handle._adopted_submit = True
         self._capture_trace(handle)
+        self._attach_meter(handle)
+        if handle.meter is not None and meter_snapshot:
+            # The prefill tier's meter rides next to the KVState so the
+            # migrated request lands on ONE ledger row (prefill
+            # chip-seconds and all).
+            handle.meter.absorb(meter_snapshot)
         handle.tokens = list(state.tokens)
         handle.kv_state = state
         with self._lock:
@@ -935,18 +984,23 @@ class LLMEngine:
                     break
                 end = handle._chunk_ends[handle._chunk_idx]
                 slot = self._free[0]
+                t_chunk = time.monotonic()
                 if not self._admit_paged(handle, slot, upto=end,
                                          throwaway=True):
                     self._requeue(handle)
                     if req.slo == "interactive":
                         self._admit_blocked = True
                     break
+                if handle.meter is not None:
+                    handle.meter.note_chip(
+                        "prefill", time.monotonic() - t_chunk)
                 chunk_budget -= 1
                 handle._chunk_idx += 1
                 self._requeue(handle)
                 continue
             slot = self._free.popleft()
             fresh = handle.kv_state is None
+            t_admit = time.monotonic()
             if not fresh:
                 ok = self._admit_adopted(handle, slot)
             elif self._paged:
@@ -971,10 +1025,20 @@ class LLMEngine:
                 break
             if self._draft is not None and fresh:
                 self._draft_admit(list(req.prompt), slot)
+            if handle.meter is not None:
+                # Admission dispatch (insert/adopt + draft seed) billed
+                # as this request's prefill chip-time; fresh admissions
+                # resume-from-preempt included — the adopt scatter is
+                # real chip work this request caused.
+                handle.meter.note_chip(
+                    "prefill", time.monotonic() - t_admit)
             if handle.admitted_at is None:
                 handle.admitted_at = time.monotonic()
                 self._metrics.queue_wait.observe(
                     handle.admitted_at - handle.submitted_at)
+                if handle.meter is not None:
+                    handle.meter.note_queue_wait(
+                        handle.admitted_at - handle.submitted_at)
             st = self._slots[slot]
             if st.uses:
                 self._slot_reuses += 1
@@ -1092,6 +1156,12 @@ class LLMEngine:
         if not throwaway:
             self._tables[slot] = row
             self._slot_blocks[slot] = blocks
+            if handle.meter is not None:
+                # Block-seconds meter opens here; _release_slot closes
+                # it with the same count (all blocks alloc up front).
+                # Throwaway chunk admissions skip it — their KV is
+                # cache-owned the moment the insert returns.
+                handle.meter.blocks_acquired(len(blocks))
 
         if promote:
             # Land the tier links in new_blocks[:n_pro] BEFORE the
@@ -1150,6 +1220,8 @@ class LLMEngine:
         row[:need_total] = blocks
         self._tables[slot] = row
         self._slot_blocks[slot] = blocks
+        if handle.meter is not None:
+            handle.meter.blocks_acquired(len(blocks))
 
         nb = c.max_blocks_per_slot
         # Padding rows scatter to pool_blocks (out of bounds → dropped).
@@ -1216,6 +1288,7 @@ class LLMEngine:
         already copied the data; `BlockAllocator.donate` asserts the
         refs are live) instead of plain freeing."""
         st = self._slots[slot]
+        handle = st.handle
         st.handle = None
         self._active[slot] = False
         self._temp[slot] = 0.0
@@ -1223,6 +1296,13 @@ class LLMEngine:
         if self._paged and self._slot_blocks[slot]:
             # Drop this sequence's refs; blocks shared with the prefix
             # cache (or other sequences) stay resident.
+            if handle is not None and handle.meter is not None:
+                # Close the block-seconds interval symmetrically with
+                # the acquisition count; preempt → resume reopens it
+                # at re-admission, so occupancy stays monotone and
+                # never double-counts.
+                handle.meter.blocks_released(
+                    len(self._slot_blocks[slot]))
             if donate:
                 self._allocator.donate(self._slot_blocks[slot])
             else:
@@ -1687,6 +1767,40 @@ class LLMEngine:
                 trace=req_trace)
         except Exception:
             pass  # telemetry must never break the scheduler
+        self._account_finished(handle, e2e)
+
+    def _account_finished(self, handle: RequestHandle,
+                          e2e: float) -> None:
+        """Close the request's cost meter. A "prefill" finish does NOT
+        fold — its snapshot rides the disagg hand-off next to the
+        KVState and the decode tier's meter absorbs it, so the whole
+        migrated request lands on one ledger row."""
+        meter = handle.meter
+        if meter is None:
+            return
+        try:
+            computed = handle.prefilled_tokens
+            avoided = 0
+            if not handle._adopted_submit:
+                # prefix/tier hits = prompt positions this engine never
+                # prefilled. Adopted submissions skip the credit: their
+                # prompt was prefilled (and already credited) by the
+                # exporting engine.
+                avoided = max(len(handle.request.prompt) - computed, 0)
+            meter.note_prefill(computed, avoided)
+            if handle.finish_reason == "prefill":
+                if handle.ttft_s is not None:
+                    meter.ttft_s = handle.ttft_s
+                return
+            from ray_tpu.observability.accounting import fold_finished
+
+            row = meter.finalize(
+                handle.finish_reason or "unknown",
+                len(handle.tokens), ttft_s=handle.ttft_s,
+                tpot_s=handle.tpot_s, e2e_s=e2e)
+            fold_finished(row)
+        except Exception:
+            pass  # accounting must never break the scheduler
 
     def step(self) -> bool:
         """One scheduler iteration: process cancellations, apply the
@@ -1721,7 +1835,19 @@ class LLMEngine:
             return bool(inserted) or did_cancel or did_ctrl
         live = np.nonzero(self._active)[0]
         if self._spec_ready(live):
+            t_tick = time.monotonic()
             toks_host, n_emit = self._spec_tick()
+            self._credit_decode(live, time.monotonic() - t_tick)
+            if self._acct:
+                # Per-slot speculative accounting: a live slot's round
+                # proposed spec_k - 1 drafts and accepted n_emit - 1.
+                k_prop = self.config.spec_k - 1
+                for slot in live:
+                    s = int(slot)
+                    h = self._slots[s].handle
+                    if h is not None and h.meter is not None \
+                            and int(n_emit[s]) > 0:
+                        h.meter.note_spec(k_prop, int(n_emit[s]) - 1)
             for slot in live:
                 s = int(slot)
                 for k in range(int(n_emit[s])):
@@ -1731,6 +1857,7 @@ class LLMEngine:
                     self._emit(s, int(toks_host[k, s]))
             self._update_gauges()
             return True
+        t_tick = time.monotonic()
         if self._paged:
             self._cache, self._tok, self._pos, self._key, toks = \
                 self._jit_tick(
@@ -1743,6 +1870,7 @@ class LLMEngine:
                     self.params, self._cache, self._tok, self._pos,
                     self._active.copy(), self._temp.copy(), self._key)
         toks_host = np.asarray(toks)                # [K, B]
+        self._credit_decode(live, time.monotonic() - t_tick)
         for slot in live:
             s = int(slot)
             for k in range(toks_host.shape[0]):
@@ -1752,6 +1880,20 @@ class LLMEngine:
                 self._emit(s, int(toks_host[k, s]))
         self._update_gauges()
         return True
+
+    def _credit_decode(self, live, dt: float) -> None:
+        """Split one decode/verify tick's wall time evenly across the
+        slots that were live in it (an attribution, not a hardware
+        counter — documented as approximate in accounting.py). Runs
+        BEFORE the emit loop so a request finishing this tick still
+        gets billed for it."""
+        if not self._acct or dt <= 0 or len(live) == 0:
+            return
+        share = dt / len(live)
+        for slot in live:
+            h = self._slots[int(slot)].handle
+            if h is not None and h.meter is not None:
+                h.meter.note_chip("decode", share)
 
     def _spec_ready(self, live) -> bool:
         """A speculative round runs only when EVERY live slot

@@ -421,8 +421,10 @@ class LLMRouter:
         from ray_tpu.util.tracing import trace_root
 
         lane = str(request.get("slo", "interactive"))
+        tenant = str(request.get("tenant", "default"))
         with trace_root("serve.request",
                         attrs={"lane": lane,
+                               "tenant": tenant,
                                "prompt_len": len(request.get(
                                    "prompt", ()))},
                         baggage={"slo": lane}) as tc:

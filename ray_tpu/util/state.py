@@ -280,6 +280,31 @@ def list_train_steps(worker: Optional[str] = None,
                        timeout=30)
 
 
+def serve_accounting(top_n: Optional[int] = None,
+                     trace_id: Optional[str] = None) -> Dict[str, Any]:
+    """The serve cost-accounting rollup from the GCS accounting ring:
+    top-N tenants by chip-seconds (tokens, KV block-seconds, prefill
+    computed/avoided per tenant — "which tenant is eating the
+    fleet?"), per-lane SLO attainment and burn rates (fast/slow
+    windows), and ring occupancy. Pass the ``x-trace-id`` a routed
+    request returned as ``trace_id`` to also get that request's own
+    cost row under ``"request"``."""
+    return _gcs().call("serve_accounting_summary", top_n=top_n,
+                       trace_id=trace_id, timeout=30)
+
+
+def list_serve_accounting(tenant: Optional[str] = None,
+                          lane: Optional[str] = None,
+                          trace_id: Optional[str] = None,
+                          limit: int = 200) -> List[Dict[str, Any]]:
+    """Newest-last per-request cost rows from the GCS accounting ring
+    (tenant, lane, trace_id, tokens, block-seconds, chip-seconds per
+    phase, speculative counts, TTFT/TPOT), optionally filtered."""
+    return _gcs().call("list_serve_accounting", tenant=tenant,
+                       lane=lane, trace_id=trace_id, limit=limit,
+                       timeout=30)
+
+
 def get_log(task_id: Optional[str] = None, actor_id: Optional[str] = None,
             worker_id: Optional[str] = None,
             tail: int = 100) -> List[str]:

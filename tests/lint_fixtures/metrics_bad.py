@@ -22,6 +22,11 @@ RATIO_HIST = Histogram("serve_hit_bad_ratio",       # metric-ratio-gauge
 FIRST = Counter("serve_handled", tag_keys=("route",))
 SECOND = Counter("serve_handled", tag_keys=("route", "code"))  # redeclared
 
+PER_TENANT = Counter("serve_req_tokens_total",      # metric-label-cardinality
+                     tag_keys=("tenant",))
+PER_REQ = Gauge("serve_inflight_cost",              # metric-label-cardinality
+                tag_keys=("lane", "request_id"))
+
 EXPOSITION = """
 # TYPE serve_queue_total gauge
 serve_queue_total 3

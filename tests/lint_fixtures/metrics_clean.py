@@ -13,6 +13,9 @@ LATENCY.observe(0.5, trace_id="abc123")   # exemplar kwarg: fine
 FIRST = Counter("serve_handled", tag_keys=("route",))
 SECOND = Counter("serve_handled", tag_keys=("route",))   # identical: fine
 
+LANE_COST = Gauge("serve_lane_cost_estimate",    # bounded label set: fine
+                  tag_keys=("lane", "pool"))
+
 EXPOSITION = """
 # TYPE serve_queue gauge
 serve_queue 3

@@ -62,7 +62,8 @@ PASS_CASES = [
     ("metric-declarations", "metrics_bad.py", "metrics_clean.py",
      {"metric-name", "metric-family", "metric-histogram-suffix",
       "metric-gauge-pid-tag", "metric-redeclared", "metric-exposition",
-      "metric-exemplar-tag", "metric-ratio-gauge"}),
+      "metric-exemplar-tag", "metric-ratio-gauge",
+      "metric-label-cardinality"}),
     ("event-schema", "events_bad", "events_clean",
      {"event-unregistered-emit", "event-dead-type",
       "event-undocumented-type"}),
