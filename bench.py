@@ -1590,6 +1590,126 @@ def _bench_serve_accounting_overhead() -> dict:
     }
 
 
+def _bench_xla_attribution_overhead() -> dict:
+    """Per-call cost of the XLA program attribution plane
+    (observability/xla.py: the compile-time cost/memory capture plus
+    the every-Nth-call block_until_ready wall fence). Same Poisson
+    serve harness as _bench_serve_accounting_overhead with the
+    ``xla_attribution_instrumentation`` knob on vs off — the knob (and
+    the sampling period) latch at TrackedJit construction, so each leg
+    builds a fresh engine. Each leg runs the request mix once untimed
+    first — so every XLA program the window will hit is already
+    compiled and the one-time cost/memory captures have drained off the
+    background worker — then times a steady-state pass: the capture is
+    once-per-program for the life of the process, not a per-call cost,
+    and folding it into a 0.2 s window on a one-core host would
+    measure capture amortization instead of hot-path overhead. The on
+    leg samples aggressively (every 16th call, far hotter than the
+    default 64) and must STILL sit inside repeat-to-repeat noise on
+    both tokens/s and p99 TTFT: the fence is one synchronization the
+    engine's host loop mostly pays anyway."""
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine, Request
+
+    config = LlamaConfig.tiny()
+    params = init_params(config, jax.random.key(0))
+    n_requests, repeats = 48, 3
+
+    def _leg():
+        engine = LLMEngine(params, config, EngineConfig(
+            num_slots=4, max_seq_len=64, prefill_buckets=(8, 16),
+            kv_layout="paged", kv_block_size=8))
+        engine.warmup()
+        rng = np.random.RandomState(42)
+        prompts = [rng.randint(0, config.vocab_size,
+                               rng.randint(4, 16)).tolist()
+                   for _ in range(n_requests)]
+        arrivals = np.clip(rng.poisson(2.0, size=n_requests), 1, None)
+
+        def _run():
+            handles = []
+            i = 0
+            t0 = time.perf_counter()
+            while i < n_requests:
+                for _ in range(int(arrivals[i % len(arrivals)])):
+                    if i >= n_requests:
+                        break
+                    handles.append(engine.submit(Request(
+                        prompt=prompts[i], max_tokens=8)))
+                    i += 1
+                engine.step()
+            engine.drain()
+            wall = time.perf_counter() - t0
+            toks = sum(len(h.tokens) for h in handles)
+            ttfts = sorted(h.ttft_s for h in handles
+                           if h.ttft_s is not None)
+            p99 = ttfts[min(int(len(ttfts) * 0.99), len(ttfts) - 1)]
+            return toks / wall, p99
+
+        _run()  # untimed: compile every program the window will hit
+        from ray_tpu.observability import xla as _xla
+
+        _xla.flush_captures()  # one-time captures stay out of the window
+        return _run()
+
+    samples = {"1": {"tps": [], "p99": []},
+               "0": {"tps": [], "p99": []}}
+    # Interleave the legs so host drift lands on both sides evenly.
+    for _ in range(repeats):
+        for flag in ("1", "0"):
+            os.environ["RAY_TPU_xla_attribution_instrumentation"] = flag
+            os.environ["RAY_TPU_xla_wall_sample_every"] = "16"
+            try:
+                tps, p99 = _leg()
+            finally:
+                os.environ.pop(
+                    "RAY_TPU_xla_attribution_instrumentation", None)
+                os.environ.pop("RAY_TPU_xla_wall_sample_every", None)
+            samples[flag]["tps"].append(tps)
+            samples[flag]["p99"].append(p99)
+
+    med = {f: {k: statistics.median(v) for k, v in s.items()}
+           for f, s in samples.items()}
+    iqr = {f: {k: float(np.percentile(v, 75) - np.percentile(v, 25))
+               for k, v in s.items()}
+           for f, s in samples.items()}
+    tps_delta = med["1"]["tps"] - med["0"]["tps"]
+    p99_delta = med["1"]["p99"] - med["0"]["p99"]
+    tps_noise = max(iqr["1"]["tps"], iqr["0"]["tps"])
+    p99_noise = max(iqr["1"]["p99"], iqr["0"]["p99"])
+    within = (abs(tps_delta) <= max(tps_noise, 0.1 * med["0"]["tps"])
+              and abs(p99_delta) <= max(p99_noise,
+                                        0.1 * med["0"]["p99"]))
+    return {
+        "metric": "xla_attribution_overhead_pct",
+        "value": round(100.0 * tps_delta / med["0"]["tps"], 3),
+        "unit": "%",
+        "vs_baseline": None,
+        "detail": {
+            "tokens_per_sec_on": round(med["1"]["tps"], 2),
+            "tokens_per_sec_off": round(med["0"]["tps"], 2),
+            "p99_ttft_on_ms": round(med["1"]["p99"] * 1000, 3),
+            "p99_ttft_off_ms": round(med["0"]["p99"] * 1000, 3),
+            "tps_noise_floor": round(tps_noise, 2),
+            "p99_noise_floor_ms": round(p99_noise * 1000, 3),
+            "within_noise": within,
+            "wall_sample_every": 16,
+            "requests_per_leg": n_requests,
+            "repeats_per_mode": repeats,
+            "note": "Poisson serve leg (tiny paged engine), XLA "
+                    "attribution on (sampling every 16th call) minus "
+                    "off; within_noise requires BOTH tokens/s and p99 "
+                    "TTFT deltas inside the larger repeat-to-repeat "
+                    "IQR (floor: 10% of the off leg)",
+        },
+    }
+
+
 def _bench_ppo_env_steps() -> dict:
     """Decoupled (Podracer) vs colocated PPO acting throughput on the
     CPU-virtual-device path. The config is deliberately learning-heavy
@@ -2004,6 +2124,15 @@ def main() -> None:
         print(json.dumps(_bench_serve_accounting_overhead()))
     except Exception as e:
         print(json.dumps({"metric": "serve_accounting_overhead_pct",
+                          "value": None, "unit": "%",
+                          "vs_baseline": None, "error": repr(e)[:300]}))
+
+    # XLA program attribution overhead: the same Poisson serve leg with
+    # the cost-capture + wall-sampling plane on vs off, in-process.
+    try:
+        print(json.dumps(_bench_xla_attribution_overhead()))
+    except Exception as e:
+        print(json.dumps({"metric": "xla_attribution_overhead_pct",
                           "value": None, "unit": "%",
                           "vs_baseline": None, "error": repr(e)[:300]}))
 

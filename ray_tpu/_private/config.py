@@ -362,6 +362,34 @@ _define("train_stall_min_timeout_s", float, 10.0,
         "on the CPU tier) don't declare a stall on scheduler jitter.")
 _define("train_stall_check_interval_s", float, 1.0,
         "Period of the GCS train stall watchdog sweep.")
+_define("xla_attribution_instrumentation", bool, True,
+        "Per-program XLA cost attribution on tracked_jit wrappers "
+        "(observability.xla.ProgramRegistry): cost_analysis/"
+        "memory_analysis capture on compile, MFU/MBU + roofline "
+        "verdicts from sampled walls, rows into the GCS "
+        "report_xla_programs ring, and the PERF_REGRESSION sentinel. "
+        "Off = plain trace/compile counters only; the "
+        "xla_attribution_overhead bench prices the delta.")
+_define("xla_wall_sample_every", int, 64,
+        "Sample every Nth steady-state call of a tracked jitted "
+        "function with block_until_ready to measure an honest "
+        "execution wall (feeds MFU/MBU). 0 disables wall sampling — "
+        "no fence ever runs on the hot path; rows then carry cost/"
+        "memory analysis but no utilization ratios.")
+_define("xla_programs_buffer_size", int, 4096,
+        "Bound on the GCS XLA program ring (report_xla_programs / "
+        "list_xla_programs rows across all processes).")
+_define("xla_regression_ratio", float, 1.5,
+        "Regression sentinel threshold: a re-compile whose flops or "
+        "peak HBM bytes — or a sampled wall whose EWMA — exceeds the "
+        "function's baseline by this factor fires one PERF_REGRESSION "
+        "cluster event per drifted-dimension episode (re-arms when the "
+        "dimension returns within the ratio). 0 disables the sentinel.")
+_define("xla_comm_bound_fraction", float, 0.5,
+        "Exposed-collective fraction of a sampled program wall above "
+        "which the roofline verdict is 'comm-bound' instead of "
+        "compute-/memory-bound (fed by the split-phase overlap "
+        "accounting in observability.collective).")
 _define("jit_recompile_warn_budget", int, 8,
         "Default trace budget of observability.tracked_jit wrappers: a "
         "tracked jitted function that traces more programs than this "

@@ -193,6 +193,18 @@ class GcsServer:
         self._serve_ledger = None   # lazy accounting.TenantLedger
         self._serve_slo = None      # lazy accounting.SLOTracker
 
+        # XLA program-attribution ring (observability/xla.py): every
+        # tracked_jit publishes its compiled programs' cost rows here
+        # (flops, HBM bytes, sampled MFU/MBU, roofline verdict). The
+        # ring keeps row history; ``xla_latest`` keeps only each
+        # program's newest row — the fleet's current program set that
+        # the summary ranks by FLOPs, HBM, and lost-to-roofline
+        # headroom.
+        self.xla_programs: deque = deque(
+            maxlen=GlobalConfig.xla_programs_buffer_size)
+        self._xla_seq = 0
+        self.xla_latest: Dict[tuple, Dict[str, Any]] = {}
+
         self._reschedule_on_start: List[bytes] = []
         self._register_handlers()
         # Actor/PG lifecycle transitions all publish; piggyback snapshot
@@ -341,6 +353,7 @@ class GcsServer:
             "report_train_steps", "list_train_steps", "train_summary",
             "report_serve_accounting", "list_serve_accounting",
             "serve_accounting_summary",
+            "report_xla_programs", "list_xla_programs", "xla_summary",
             "get_trace", "list_traces", "trace_stats",
         ]:
             s.register(name, getattr(self, f"_h_{name}"))
@@ -753,6 +766,112 @@ class GcsServer:
                 (rec for rec in reversed(self.serve_accounting)
                  if rec["trace_id"] == trace_id), None)
         return out
+
+    # ---------------------------------------------- xla program costs
+    _XLA_FLOAT_FIELDS = (
+        "flops", "bytes_accessed", "transcendentals", "arg_bytes",
+        "out_bytes", "temp_bytes", "alias_bytes", "peak_hbm_bytes",
+        "compile_seconds", "wall_s", "achieved_flops_per_s",
+        "achieved_bytes_per_s", "mfu", "mbu", "exposed_comm_fraction",
+        "lost_roofline_s_per_call", "lost_roofline_s_total")
+
+    async def _h_report_xla_programs(self, row=None, rows=None):
+        """Tracked-jit processes publish program cost rows here: one on
+        every compile (cost/memory analysis) and one per sampled wall
+        (MFU/MBU + verdict refresh). Batched via ``rows`` when a
+        publisher catches up."""
+        for r in list(rows or []) + ([row] if row else []):
+            try:
+                self._ingest_xla_row(dict(r))
+            except Exception as e:
+                print(f"[gcs] WARNING: dropping malformed xla program "
+                      f"row: {type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
+        return True
+
+    def _ingest_xla_row(self, row: dict) -> None:
+        fn = str(row.get("fn") or "")
+        signature = str(row.get("signature") or "")
+        if not fn or not signature:
+            raise ValueError("xla program row needs fn and signature")
+        rec: Dict[str, Any] = {"fn": fn, "signature": signature}
+        for key in self._XLA_FLOAT_FIELDS:
+            v = row.get(key)
+            rec[key] = None if v is None else float(v)
+        rec["calls"] = int(row.get("calls") or 0)
+        rec["samples"] = int(row.get("samples") or 0)
+        rec["verdict"] = str(row.get("verdict") or "unsampled")
+        rec["spec"] = str(row.get("spec") or "unknown")
+        rec["measurement"] = str(row.get("measurement") or "unknown")
+        rec["pid"] = int(row.get("pid") or 0)
+        node_id = row.get("node_id")
+        rec["node_id"] = node_id.hex() if hasattr(node_id, "hex") \
+            else node_id
+        rec["recv_ts"] = time.time()
+        self._xla_seq += 1
+        rec["seq"] = self._xla_seq
+        self.xla_programs.append(rec)
+        self.xla_latest[(rec["node_id"], rec["pid"], fn, signature)] = rec
+        # The latest-view is bounded by the same knob as the ring:
+        # evict the stalest program when a churning fleet overflows it.
+        while len(self.xla_latest) > (self.xla_programs.maxlen or 0) > 0:
+            oldest = min(self.xla_latest,
+                         key=lambda k: self.xla_latest[k]["seq"])
+            del self.xla_latest[oldest]
+
+    async def _h_list_xla_programs(self, fn=None, verdict=None,
+                                   limit=200):
+        """Newest-last slice of the program-row ring, optionally
+        filtered by function name or roofline verdict."""
+        out = []
+        for rec in self.xla_programs:
+            if fn is not None and rec["fn"] != fn:
+                continue
+            if verdict is not None and rec["verdict"] != verdict:
+                continue
+            out.append(rec)
+        return out[-max(int(limit), 0):]
+
+    async def _h_xla_summary(self, top_n=8):
+        """The rollup behind ``util.state.xla_summary()`` and
+        ``GET /api/programs``: the fleet's current program set ranked
+        by cumulative FLOPs, peak HBM bytes, and lost-to-roofline
+        headroom seconds, plus verdict/measurement counts (an all-cpu
+        ``measurements`` dict says the ratios prove plumbing, not
+        performance)."""
+        top_n = max(int(top_n), 1)
+        rows = list(self.xla_latest.values())
+
+        def total_flops(r):
+            return (r["flops"] or 0.0) * max(r["calls"], 1)
+
+        sampled = [r for r in rows
+                   if r.get("lost_roofline_s_total") is not None]
+        verdicts: Dict[str, int] = defaultdict(int)
+        measurements: Dict[str, int] = defaultdict(int)
+        for r in rows:
+            verdicts[r["verdict"]] += 1
+            measurements[r["measurement"]] += 1
+        return {
+            "programs": len(rows),
+            "rows_in_buffer": len(self.xla_programs),
+            "rows_recorded": self._xla_seq,
+            "total_flops": sum(total_flops(r) for r in rows),
+            "total_peak_hbm_bytes": sum(
+                r["peak_hbm_bytes"] or 0.0 for r in rows),
+            "lost_roofline_s_total": sum(
+                r["lost_roofline_s_total"] for r in sampled),
+            "verdicts": dict(verdicts),
+            "measurements": dict(measurements),
+            "top_by_flops": sorted(
+                rows, key=total_flops, reverse=True)[:top_n],
+            "top_by_hbm": sorted(
+                rows, key=lambda r: r["peak_hbm_bytes"] or 0.0,
+                reverse=True)[:top_n],
+            "top_by_headroom": sorted(
+                sampled, key=lambda r: r["lost_roofline_s_total"],
+                reverse=True)[:top_n],
+        }
 
     async def _train_watchdog_loop(self):
         """Stall watchdog: a worker that published step rows and then

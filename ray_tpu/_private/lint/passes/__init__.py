@@ -9,6 +9,7 @@ from ray_tpu._private.lint.passes import (  # noqa: F401
     donation,
     events,
     jit_hygiene,
+    jit_tracking,
     locks,
     lockset,
     metrics,

@@ -1,0 +1,133 @@
+"""jit-tracking: hot-path programs must compile through tracked_jit.
+
+The XLA attribution plane (observability/xla.py) only sees programs
+that compile through :func:`ray_tpu.observability.tracked_jit` — a raw
+``jax.jit(...)`` in a hot-path package is a program with no trace
+counters, no cost/memory analysis row, no MFU/MBU, and no regression
+sentinel: invisible to every "which program is eating the fleet?"
+question the plane answers. This pass rejects raw jit in the packages
+whose programs the plane is meant to cover (``serve/``, ``train/``,
+``rllib/``, ``parallel/``); deliberately untracked programs take the
+standard inline suppression (``# graftlint: disable=jit-untracked``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Set
+
+from ray_tpu._private.lint.core import (
+    Finding, LintPass, ModuleInfo, register,
+)
+
+# Path segments of the packages whose jitted programs the attribution
+# plane must see. Everything else (observability itself, util, tests)
+# may use raw jax.jit freely.
+_HOT_PACKAGES = {"serve", "train", "rllib", "parallel"}
+
+# Fixture twins live under tests/lint_fixtures/, outside the hot
+# packages; scope them in by basename so the rule-set test can drive
+# the pass against them.
+_FIXTURE_PREFIX = "jit_untracked"
+
+
+def _in_scope(relpath: str) -> bool:
+    parts = relpath.replace(os.sep, "/").split("/")
+    if os.path.basename(relpath).startswith(_FIXTURE_PREFIX):
+        return True
+    return any(p in _HOT_PACKAGES for p in parts)
+
+
+def _jax_aliases(tree: ast.Module) -> Set[str]:
+    """Names the ``jax`` module is imported as."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax":
+                    out.add(alias.asname or "jax")
+    return out
+
+
+def _jit_names(tree: ast.Module) -> Set[str]:
+    """Bare names bound to ``jax.jit`` (``from jax import jit [as j]``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit":
+                    out.add(alias.asname or "jit")
+    return out
+
+
+@register
+class JitTrackingPass(LintPass):
+    name = "jit-tracking"
+    rules = ("jit-untracked",)
+    description = ("raw jax.jit in hot-path packages (serve/train/"
+                   "rllib/parallel) must route through tracked_jit so "
+                   "the XLA attribution plane sees the program")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not _in_scope(mod.relpath):
+            return []
+        jax_aliases = _jax_aliases(mod.tree)
+        jit_names = _jit_names(mod.tree)
+        if not jax_aliases and not jit_names:
+            return []
+
+        def is_raw_jit_ref(node: ast.expr) -> bool:
+            if isinstance(node, ast.Attribute) and node.attr == "jit":
+                base = node.value
+                return isinstance(base, ast.Name) and \
+                    base.id in jax_aliases
+            if isinstance(node, ast.Name):
+                return node.id in jit_names
+            return False
+
+        def is_partial_jit(node: ast.expr) -> bool:
+            # partial(jax.jit, ...) — the factory form.
+            if not isinstance(node, ast.Call):
+                return False
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else ""
+            return fname == "partial" and bool(node.args) and \
+                is_raw_jit_ref(node.args[0])
+
+        out: List[Finding] = []
+
+        def flag(node: ast.AST, form: str) -> None:
+            out.append(mod.finding(
+                "jit-untracked", node,
+                f"raw {form} in hot-path package: programs compiled "
+                f"here are invisible to the XLA attribution plane "
+                f"(no cost row, MFU/MBU, or regression sentinel) — "
+                f"use ray_tpu.observability.tracked_jit, or suppress "
+                f"a deliberately untracked program inline"))
+
+        # partial(jax.jit, ...) nodes already reported through the call
+        # applying them — don't double-flag the inner factory.
+        applied: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and is_partial_jit(node.func):
+                applied.add(id(node.func))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                # jax.jit(f, ...) / jit(f, ...) — including the
+                # factory-then-apply partial(jax.jit, ...)(f).
+                if is_raw_jit_ref(node.func):
+                    flag(node, "jax.jit(...) call")
+                elif is_partial_jit(node.func):
+                    flag(node, "partial(jax.jit, ...)(...) call")
+                elif is_partial_jit(node) and id(node) not in applied:
+                    # Bare partial(jax.jit, ...) used as a decorator or
+                    # stored factory: the jit still compiles untracked.
+                    flag(node, "partial(jax.jit, ...) factory")
+            elif isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    if is_raw_jit_ref(dec):
+                        flag(dec, "@jax.jit decorator")
+        return out

@@ -55,6 +55,7 @@ _FAMILIES = (
     "serve_",         # LLM serving latency/queue metrics
     "train_",         # train-session report metrics
     "worker_",        # per-worker process gauges
+    "xla_",           # program cost/roofline attribution (xla.py)
 )
 
 _EXPOSITION_TYPE_RE = re.compile(

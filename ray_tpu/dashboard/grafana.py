@@ -229,6 +229,25 @@ _PANELS: List[Dict[str, str]] = [
     {"title": "SLO burn events",
      "expr": 'rate(rtpu_cluster_events_total{type="SLO_BURN"}[5m])',
      "unit": "short"},
+    # --- XLA program cost & roofline attribution (observability/xla) ---
+    {"title": "Program MFU / MBU",
+     "expr": "rtpu_xla_program_mfu",
+     "expr_b": "rtpu_xla_program_mbu",
+     "legend": "{{fn}}", "unit": "percentunit"},
+    {"title": "Program FLOPs / peak HBM bytes",
+     "expr": "rtpu_xla_program_flops",
+     "expr_b": "rtpu_xla_program_bytes_hbm",
+     "legend": "{{fn}}", "unit": "short"},
+    {"title": "Sampled program wall p50/p99",
+     "expr": 'histogram_quantile(0.5, '
+             'rate(rtpu_xla_program_wall_seconds_bucket[5m]))',
+     "expr_b": 'histogram_quantile(0.99, '
+               'rate(rtpu_xla_program_wall_seconds_bucket[5m]))',
+     "legend": "{{fn}}", "unit": "s"},
+    {"title": "Perf regression events",
+     "expr": 'rate(rtpu_cluster_events_total'
+             '{type="PERF_REGRESSION"}[5m])',
+     "unit": "short"},
 ]
 
 

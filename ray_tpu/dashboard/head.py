@@ -30,6 +30,12 @@ Endpoints:
                       attainment/burn, per-request cost rows
                       (?tenant=, ?lane=, ?trace_id=, ?limit=), and the
                       serve_tenant_*/serve_request_cost_* metric series
+  GET /api/programs   XLA program cost & roofline attribution: the
+                      fleet's compiled-program set ranked by FLOPs,
+                      peak HBM bytes, and lost-to-roofline headroom
+                      (?top_n=), per-program rows with MFU/MBU and
+                      verdicts (?fn=, ?verdict=, ?limit=), and the
+                      xla_program_* metric series
   GET /api/memory     per-node object-store introspection + spill metrics
   GET /api/data       data-pipeline (DatasetStats) metric summary
   GET /api/events     ClusterEventLog (failure forensics) with ?type=,
@@ -40,7 +46,7 @@ Endpoints:
                       SPILL_PRESSURE, JOB_STARTED, JOB_FINISHED,
                       AUTOSCALE_UP, AUTOSCALE_DOWN, PREEMPT_RESCHEDULE,
                       BACKPRESSURE_ADJUST, TRAIN_STRAGGLER, TRAIN_STALL,
-                      SLO_BURN.
+                      SLO_BURN, PERF_REGRESSION.
   GET /api/controller control-plane decision log (serve autoscaler,
                       data backpressure, memory preemption) with
                       ?controller=, ?action=, ?limit= filters; each row
@@ -426,6 +432,33 @@ class DashboardHead:
             "metrics": metrics or {},
         })
 
+    async def programs(self, req) -> web.Response:
+        """XLA program cost & roofline attribution: the GCS summary
+        (current program set ranked by cumulative FLOPs, peak HBM
+        bytes, and lost-to-roofline headroom, with verdict/measurement
+        counts), recent program rows (?fn=, ?verdict= and ?limit=
+        filter them), and the cluster-folded ``xla_program_*`` metric
+        series. Rows tagged ``measurement: "cpu"`` carry nominal-spec
+        ratios — plumbing proof, not performance."""
+        try:
+            limit = int(req.query.get("limit", 50))
+            top_n = int(req.query.get("top_n", 8))
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        summary = await self._gcs.acall(
+            "xla_summary", top_n=top_n, timeout=10)
+        rows = await self._gcs.acall(
+            "list_xla_programs", fn=req.query.get("fn"),
+            verdict=req.query.get("verdict"), limit=limit, timeout=10)
+        metrics = await self._gcs.acall(
+            "user_metrics_summary", prefixes=["xla_program_"],
+            timeout=10)
+        return web.json_response({
+            "summary": summary or {},
+            "programs": rows or [],
+            "metrics": metrics or {},
+        })
+
     async def memory(self, req) -> web.Response:
         """Object-store memory introspection: live per-node snapshots
         straight from each raylet's store (same numbers
@@ -714,6 +747,7 @@ class DashboardHead:
         app.router.add_get("/api/rl", self.rl_stats)
         app.router.add_get("/api/train", self.train_stats)
         app.router.add_get("/api/accounting", self.accounting)
+        app.router.add_get("/api/programs", self.programs)
         app.router.add_get("/api/memory", self.memory)
         app.router.add_get("/api/data", self.data_stats)
         app.router.add_get("/api/events", self.events)

@@ -63,6 +63,26 @@ shared instrumentation layer every hot path reports through:
   the :class:`Hysteresis` hold-delay/cooldown gate shared by the serve
   autoscaler and the data backpressure tuner.
 
+- ``xla`` / ``chipspec``: the fleet-wide XLA program cost & roofline
+  attribution plane — on first compile every :class:`TrackedJit`
+  program's ``cost_analysis()`` (FLOPs, HBM bytes accessed,
+  transcendentals) and ``memory_analysis()`` (argument/output/temp/peak
+  HBM bytes) land in the per-process :class:`ProgramRegistry`; every
+  ``xla_wall_sample_every``-th steady-state call is fenced to sample an
+  honest execution wall, which divided by the chip-spec peak table
+  (``chipspec``: v4/v5e/v5p, CPU rows tagged ``measurement: cpu``)
+  yields MFU/MBU and a compute-/memory-/comm-bound roofline verdict
+  (the last folding the exposed-collective seconds the sampled call
+  straddled). Rows publish over bounded GCS
+  ``report/list_xla_programs`` RPCs, roll up via
+  ``util.state.xla_summary()`` / ``GET /api/programs``, and export as
+  ``rtpu_xla_program_{flops,bytes_hbm,mfu,mbu}`` gauges plus the
+  exemplar-carrying ``rtpu_xla_program_wall_seconds`` histogram. The
+  regression sentinel baselines each function's first program and emits
+  one typed ``PERF_REGRESSION`` cluster event per drift episode when a
+  re-compile's FLOPs/peak-HBM or a sampled wall moves past
+  ``xla_regression_ratio``.
+
 - ``accounting``: the per-request cost accounting & SLO attainment
   plane for the serving tier — the :class:`RequestMeter` attached to
   every engine request (prefill tokens computed vs avoided, decode
@@ -94,11 +114,25 @@ from ray_tpu.observability.accounting import (  # noqa: F401
     slo_targets,
     tenant_ledger,
 )
+from ray_tpu.observability.chipspec import (  # noqa: F401
+    ChipSpec,
+    local_spec,
+    lookup,
+)
 from ray_tpu.observability.jit import (  # noqa: F401
     RecompileWarning,
     TrackedJit,
     jit_stats,
     tracked_jit,
+)
+from ray_tpu.observability.xla import (  # noqa: F401
+    ProgramRegistry,
+    attribution_enabled,
+    flush_captures,
+    local_programs,
+    program_registry,
+    wall_sample_every,
+    xla_metrics,
 )
 from ray_tpu.observability.device import (  # noqa: F401
     sample_device_metrics,
@@ -178,4 +212,8 @@ __all__ = [
     "COST_PHASES", "RequestMeter", "SLOTracker", "TenantLedger",
     "TokenReconciler", "accounting_enabled", "accounting_metrics",
     "fold_finished", "publish_serve_row", "slo_targets", "tenant_ledger",
+    "ChipSpec", "local_spec", "lookup",
+    "ProgramRegistry", "attribution_enabled", "flush_captures",
+    "local_programs", "program_registry", "wall_sample_every",
+    "xla_metrics",
 ]

@@ -30,6 +30,11 @@ from contextlib import contextmanager
 
 _collective = None
 _lock = threading.Lock()
+# Process-lifetime total of exposed split-phase seconds. The XLA
+# attribution sampler diffs this around a sampled call to decide
+# whether a program's wall is dominated by exposed communication
+# (the "comm-bound" roofline verdict).
+_exposed_total = 0.0
 
 # Collective latencies straddle microseconds (small psum over ICI) to
 # seconds (pod-scale gather on a cold link).
@@ -129,10 +134,13 @@ def record_overlap(op: str, backend: str, issued_to_awaited_s: float,
     ``{"exposed_s", "hidden_s", "exposed_fraction"}`` for callers (bench)
     that also report the numbers directly.
     """
+    global _exposed_total
     span = max(float(issued_to_awaited_s), 0.0)
     covered = max(float(compute_covered_s), 0.0)
     exposed = max(0.0, span - covered)
     hidden = span - exposed
+    with _lock:
+        _exposed_total += exposed
     m = collective_metrics()
     tags = {"op": op, "backend": backend}
     m.exposed_seconds.observe(exposed, tags)
@@ -151,3 +159,12 @@ def record_overlap(op: str, backend: str, issued_to_awaited_s: float,
         "hidden_s": hidden,
         "exposed_fraction": exposed / span if span > 0 else 0.0,
     }
+
+
+def cumulative_exposed_seconds() -> float:
+    """Process-lifetime exposed split-phase collective seconds.  The
+    XLA attribution plane reads the delta of this across a sampled
+    program execution: when most of a sampled wall is exposed
+    communication, the program's roofline verdict is "comm-bound"."""
+    with _lock:
+        return _exposed_total

@@ -69,6 +69,13 @@ EVENT_TYPES: Dict[str, str] = {
     "SLO_BURN": "A serve lane is burning its SLO error budget: both "
                 "the fast and slow burn-rate windows exceed their "
                 "thresholds for TTFT/TPOT attainment.",
+    # XLA attribution plane (observability/xla.py): the regression
+    # sentinel compares every re-compile's cost analysis and every
+    # sampled wall against the function's baseline program.
+    "PERF_REGRESSION": "A tracked program's FLOPs, peak HBM bytes, or "
+                       "sampled wall drifted past xla_regression_ratio "
+                       "times its baseline (the event names the "
+                       "program and the drifted dimension).",
 }
 
 # Worker exit taxonomy (reference: `WorkerExitType`). The raylet picks
@@ -105,6 +112,7 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "TRAIN_STRAGGLER": "WARNING",
     "TRAIN_STALL": "ERROR",
     "SLO_BURN": "WARNING",
+    "PERF_REGRESSION": "WARNING",
 }
 
 _EXIT_SEVERITY = {

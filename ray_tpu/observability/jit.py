@@ -22,11 +22,21 @@ argument signature that caused the re-trace.
 Budgets are per-instance (a fresh engine legitimately re-traces its own
 programs); the counters aggregate per function name across instances
 and processes.
+
+On top of the trace guard rides the XLA attribution plane
+(observability/xla.py): each new program's ``cost_analysis()`` /
+``memory_analysis()`` is captured through the :meth:`compiled` accessor
+(one shared AOT artifact per signature, built on the plane's background
+capture worker so the extra compile never lands on the caller), and
+every ``xla_wall_sample_every``-th steady-state call
+is fenced with ``block_until_ready`` to sample an honest execution wall
+(0 disables sampling: the fence never runs on the hot path).
 """
 
 from __future__ import annotations
 
 import threading
+import time
 import warnings
 from typing import Any, Callable, Dict, Optional
 
@@ -98,6 +108,7 @@ class TrackedJit:
 
         self.name = name or getattr(fn, "__name__", "jitted")
         self.traces = 0
+        self.calls = 0
         if trace_budget is None:
             from ray_tpu._private.config import GlobalConfig
 
@@ -105,30 +116,48 @@ class TrackedJit:
         self.trace_budget = trace_budget
         self._warned = False
         self._fn = fn
+        self._jit_kwargs = dict(jit_kwargs)
+        # AOT artifacts per argument signature, shared between the
+        # attribution hook and compiled() callers — one lowered program
+        # instead of a re-lower per consumer.
+        self._compiled_cache: Dict[str, Any] = {}
+        # While the attribution hook lowers through the jit wrapper the
+        # probe still runs under tracing; this re-entrancy flag keeps
+        # those internal traces out of the user-facing counters.
+        self._suppress = threading.local()
+        from ray_tpu.observability import xla as _xla
+
+        self._sample_every = _xla.wall_sample_every() \
+            if _xla.attribution_enabled() else 0
 
         def probe(*args, **kwargs):
             # Runs only under tracing: count the new program here. The
             # mutation is the whole point — it fires once per trace, not
             # per call, which is exactly what a retrace counter wants.
-            self.traces += 1  # graftlint: disable=jit-global-mutation
-            with _lock:
-                st = _stats.setdefault(self.name, {
-                    "traces": 0, "compiles": 0,
-                    "compile_seconds_total": 0.0})
-                st["traces"] += 1
+            if not getattr(self._suppress, "on", False):
+                self.traces += 1  # graftlint: disable=jit-global-mutation
+                with _lock:
+                    st = _stats.setdefault(self.name, {
+                        "traces": 0, "compiles": 0,
+                        "compile_seconds_total": 0.0})
+                    st["traces"] += 1
             return fn(*args, **kwargs)
 
         self._jitted = jax.jit(probe, **jit_kwargs)
 
     def __call__(self, *args, **kwargs):
-        import time
-
+        self.calls += 1
+        sample = (self._sample_every > 0
+                  and self.calls % self._sample_every == 0)
+        exposed0 = _cumulative_exposed() if sample else 0.0
         before = self.traces
         t0 = time.perf_counter()
         out = self._jitted(*args, **kwargs)
         if self.traces > before:
             dt = time.perf_counter() - t0
             self._on_compile(dt, args, kwargs)
+        elif sample:
+            self._sample_wall(out, t0, exposed0, args, kwargs)
         return out
 
     def _on_compile(self, seconds: float, args, kwargs) -> None:
@@ -154,12 +183,19 @@ class TrackedJit:
         except Exception:
             pass
         try:
-            import time
-
             from ray_tpu.util.tracing import record_span
 
             record_span("jit_compile", time.time() - seconds, seconds,
                         attrs={"fn": self.name, "traces": self.traces})
+        except Exception:
+            pass
+        try:
+            # XLA attribution: capture this program's cost/memory
+            # analysis into the per-process ProgramRegistry.
+            from ray_tpu.observability import xla as _xla
+
+            if _xla.attribution_enabled():
+                _xla.on_tracked_compile(self, seconds, args, kwargs)
         except Exception:
             pass
         if (self.trace_budget and self.traces > self.trace_budget
@@ -172,8 +208,113 @@ class TrackedJit:
                 f"check for varying shapes/dtypes/static args on the "
                 f"hot path", RecompileWarning, stacklevel=4)
 
+    def _sample_wall(self, out, t0: float, exposed0: float,
+                     args, kwargs) -> None:
+        """Fence the sampled call and hand its wall (plus the exposed
+        collective seconds it straddled) to the attribution plane."""
+        try:
+            import jax
+
+            jax.block_until_ready(out)
+            wall = time.perf_counter() - t0
+            exposed = max(_cumulative_exposed() - exposed0, 0.0)
+            from ray_tpu.observability import xla as _xla
+
+            _xla.on_tracked_sample(self, _arg_signature(args, kwargs),
+                                   wall, exposed)
+        except Exception:
+            pass  # sampling must never break the hot path
+
+    # -- AOT surface -------------------------------------------------
+
+    def _abstract_args(self, args, kwargs):
+        """Shape/dtype skeletons of a call: lowering through these never
+        touches (possibly donated, possibly dead) device buffers."""
+        import jax
+
+        def one(a):
+            shape = getattr(a, "shape", None)
+            dtype = getattr(a, "dtype", None)
+            if shape is not None and dtype is not None:
+                return jax.ShapeDtypeStruct(shape, dtype)
+            return a
+
+        static_nums = self._jit_kwargs.get("static_argnums") or ()
+        if isinstance(static_nums, int):
+            static_nums = (static_nums,)
+        static_names = self._jit_kwargs.get("static_argnames") or ()
+        if isinstance(static_names, str):
+            static_names = (static_names,)
+        abs_args = tuple(
+            a if i in static_nums else jax.tree_util.tree_map(one, a)
+            for i, a in enumerate(args))
+        abs_kwargs = {
+            k: (v if k in static_names
+                else jax.tree_util.tree_map(one, v))
+            for k, v in kwargs.items()}
+        return abs_args, abs_kwargs
+
+    def compiled(self, *args, **kwargs):
+        """AOT-compiled artifact for this call signature (lower +
+        compile, cached per signature). The attribution hook and user
+        code share the one artifact, so asking for ``cost_analysis()``
+        never re-lowers a program the wrapper already built. Returns
+        None when the backend cannot lower (telemetry callers treat
+        that as "no analysis")."""
+        key = _arg_signature(args, kwargs)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        try:
+            abs_args, abs_kwargs = self._abstract_args(args, kwargs)
+            self._suppress.on = True
+            try:
+                artifact = self._jitted.lower(
+                    *abs_args, **abs_kwargs).compile()
+            finally:
+                self._suppress.on = False
+            self._compiled_cache[key] = artifact
+            return artifact
+        except Exception:
+            return None
+
     def lower(self, *args, **kwargs):
         return self._jitted.lower(*args, **kwargs)
+
+    def eval_shape(self, *args, **kwargs):
+        """Shape evaluation against the RAW function: never traces the
+        probe, so speculative shape queries cannot inflate the
+        trace/compile counters or mark a program as seen."""
+        import jax
+
+        return jax.eval_shape(self._fn, *args, **kwargs)
+
+    def clear_cache(self) -> None:
+        """Drop the jit trace cache AND the AOT artifact cache together
+        — after this, the next call re-traces (and re-counts) like a
+        fresh wrapper, and ``compiled()`` re-lowers."""
+        self._compiled_cache.clear()
+        try:
+            self._jitted.clear_cache()
+        except Exception:
+            pass
+
+    # jax.clear_caches()-era spelling; same semantics.
+    clear_caches = clear_cache
+
+
+def _cumulative_exposed() -> float:
+    """Total exposed split-phase collective seconds this process has
+    booked so far (observability/collective.py); 0.0 when the plane is
+    unused. Deltas around a sampled call feed the comm-bound verdict."""
+    try:
+        from ray_tpu.observability.collective import (
+            cumulative_exposed_seconds,
+        )
+
+        return cumulative_exposed_seconds()
+    except Exception:
+        return 0.0
 
 
 def tracked_jit(fn: Optional[Callable] = None, *,

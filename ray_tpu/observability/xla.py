@@ -1,0 +1,541 @@
+"""Per-program XLA cost & roofline attribution plane.
+
+Every other observability plane (traces PR 17, goodput PR 18, request
+cost PR 19) stops at wall-clock time; this one reaches into the
+compiler.  :class:`~ray_tpu.observability.jit.TrackedJit` already
+intercepts every trace/compile — on each new program this module
+captures XLA's own ``cost_analysis()`` (flops, bytes accessed,
+transcendentals) and ``memory_analysis()`` (argument/output/temp/peak
+HBM bytes) into a per-process :class:`ProgramRegistry` row keyed by
+``(fn, program_signature)``.  The capture itself (an AOT compile of
+the program's shape skeleton) runs on a serialized background worker —
+the hot path queues a closure of ShapeDtypeStructs and returns; tests
+synchronize with :func:`flush_captures`.  Steady-state execution walls are sampled
+every Nth call (``xla_wall_sample_every``; 0 keeps ``block_until_ready``
+entirely off the hot path) and divided into the chip-spec peaks
+(observability/chipspec.py) to derive:
+
+- **MFU** — achieved FLOP/s over the chip's peak FLOP/s,
+- **MBU** — achieved HBM bytes/s over the chip's peak bandwidth,
+- a **roofline verdict** — ``comm-bound`` when the exposed-collective
+  fraction of the sampled wall (PR-12's overlap accounting) exceeds
+  ``xla_comm_bound_fraction``, else ``compute-bound``/``memory-bound``
+  by whichever side of the roofline the program sits on, and
+- **lost-to-roofline headroom** — sampled wall minus the roofline-ideal
+  wall, the seconds/call the fleet could reclaim at 100% utilization.
+
+Rows publish fire-and-forget into the bounded GCS ring
+(``report_xla_programs``; ``util.state.xla_summary()`` /
+``GET /api/programs`` roll the fleet up) and export as the
+``rtpu_xla_program_{flops,bytes_hbm,mfu,mbu}`` gauge families plus the
+``rtpu_xla_program_wall_seconds`` histogram (trace exemplars).
+
+The **regression sentinel** closes the loop: the first program a
+function compiles becomes its baseline (flops, peak HBM, sampled wall);
+any later re-compile or wall sample drifting past
+``xla_regression_ratio`` emits ONE typed ``PERF_REGRESSION`` cluster
+event naming the program and the drifted dimension, and re-arms only
+when the dimension returns within the ratio (one event per episode —
+a recompile that silently doubles FLOPs is visible the moment it
+happens, and a noisy wall cannot page once per sample).
+
+On CPU backends every row is tagged ``measurement: "cpu"`` (nominal
+chipspec peaks): the plumbing is identical, the ratios prove wiring,
+not performance.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.observability import chipspec
+
+_lock = threading.Lock()
+_registry: Optional["ProgramRegistry"] = None
+_metrics = None
+
+# Sampled program walls: sub-millisecond CPU ticks to multi-second
+# pod-scale steps.
+_WALL_BOUNDARIES = (0.0001, 0.0005, 0.001, 0.005, 0.025, 0.1, 0.5,
+                    2.0, 10.0, 60.0)
+
+_EWMA_ALPHA = 0.3
+
+
+# ------------------------------------------------------------------ knobs
+
+def attribution_enabled() -> bool:
+    """The ``xla_attribution_instrumentation`` master switch."""
+    try:
+        from ray_tpu._private.config import GlobalConfig
+
+        return bool(GlobalConfig.xla_attribution_instrumentation)
+    except Exception:
+        return False
+
+
+def wall_sample_every() -> int:
+    """Sampling period of steady-state walls; 0 disables sampling (and
+    with it every ``block_until_ready`` the plane would issue)."""
+    try:
+        from ray_tpu._private.config import GlobalConfig
+
+        return max(int(GlobalConfig.xla_wall_sample_every), 0)
+    except Exception:
+        return 0
+
+
+# ---------------------------------------------------------------- metrics
+
+class XlaMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Gauge, Histogram
+
+        tag_keys = ("fn",)
+        self.flops = Gauge(
+            "xla_program_flops", tag_keys=tag_keys,
+            description="XLA cost-analysis FLOPs of the newest compiled "
+                        "program per tracked function.")
+        self.bytes_hbm = Gauge(
+            "xla_program_bytes_hbm", tag_keys=tag_keys,
+            description="Peak HBM bytes (argument+output+temp-alias) of "
+                        "the newest compiled program per tracked "
+                        "function.")
+        self.mfu = Gauge(
+            "xla_program_mfu", tag_keys=tag_keys,
+            description="Model FLOP utilization of the newest sampled "
+                        "wall: achieved FLOP/s over the chip-spec peak "
+                        "(CPU rows use the nominal cpu spec — plumbing, "
+                        "not performance).")
+        self.mbu = Gauge(
+            "xla_program_mbu", tag_keys=tag_keys,
+            description="Memory-bandwidth utilization of the newest "
+                        "sampled wall: achieved HBM bytes/s over the "
+                        "chip-spec peak bandwidth.")
+        self.wall_seconds = Histogram(
+            "xla_program_wall_seconds", boundaries=_WALL_BOUNDARIES,
+            tag_keys=tag_keys,
+            description="Sampled steady-state execution wall of tracked "
+                        "programs (every xla_wall_sample_every-th call, "
+                        "block_until_ready-fenced).")
+
+
+def xla_metrics() -> XlaMetrics:
+    global _metrics
+    with _lock:
+        if _metrics is None:
+            _metrics = XlaMetrics()
+        return _metrics
+
+
+# --------------------------------------------------------------- registry
+
+def _cost_dict(compiled) -> Dict[str, float]:
+    """Normalize ``compiled.cost_analysis()`` — a dict on some backends,
+    a list of per-computation dicts on others (CPU jax 0.4.x)."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost or {})
+
+
+def _memory_dict(compiled) -> Dict[str, float]:
+    """Flatten ``compiled.memory_analysis()`` (CompiledMemoryStats) into
+    the row fields.  Peak HBM follows XLA's own accounting: arguments +
+    outputs + temps, minus bytes aliased between them."""
+    mem = compiled.memory_analysis()
+    arg = float(getattr(mem, "argument_size_in_bytes", 0) or 0)
+    out = float(getattr(mem, "output_size_in_bytes", 0) or 0)
+    temp = float(getattr(mem, "temp_size_in_bytes", 0) or 0)
+    alias = float(getattr(mem, "alias_size_in_bytes", 0) or 0)
+    return {
+        "arg_bytes": arg,
+        "out_bytes": out,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+        "peak_hbm_bytes": max(arg + out + temp - alias, 0.0),
+    }
+
+
+class ProgramRegistry:
+    """Per-process table of compiled-program cost rows, keyed by
+    ``(fn, signature)``, plus the per-function regression sentinel."""
+
+    # Sentinel dimensions and the row/baseline field each compares.
+    _SENTINEL_DIMS = ("flops", "peak_hbm_bytes", "wall_s")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # fn -> {"flops", "peak_hbm_bytes", "wall_s"} of its FIRST
+        # program — the drift reference.
+        self._baselines: Dict[str, Dict[str, float]] = {}
+        # fn -> set of dimensions currently in a fired episode.
+        self._episodes: Dict[str, set] = {}
+
+    # -- capture ----------------------------------------------------
+
+    def record_compile(self, fn: str, signature: str, compiled,
+                       compile_seconds: float,
+                       calls: int = 0) -> Optional[Dict[str, Any]]:
+        """Capture one newly compiled program's cost/memory analysis.
+        Returns the (published) row, or None when the backend exposes
+        no analysis for it."""
+        try:
+            cost = _cost_dict(compiled)
+            mem = _memory_dict(compiled)
+        except Exception:
+            return None
+        spec = chipspec.local_spec()
+        row = {
+            "fn": fn,
+            "signature": signature,
+            "flops": float(cost.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(
+                cost.get("bytes accessed", 0.0) or 0.0),
+            "transcendentals": float(
+                cost.get("transcendentals", 0.0) or 0.0),
+            **mem,
+            "compile_seconds": float(compile_seconds),
+            "calls": int(calls),
+            "samples": 0,
+            "wall_s": None,
+            "achieved_flops_per_s": None,
+            "achieved_bytes_per_s": None,
+            "mfu": None,
+            "mbu": None,
+            "exposed_comm_fraction": 0.0,
+            "verdict": "unsampled",
+            "lost_roofline_s_per_call": None,
+            "lost_roofline_s_total": None,
+            "spec": spec.spec,
+            "measurement": spec.measurement,
+            "pid": os.getpid(),
+            "ts": time.time(),
+        }
+        with self._lock:
+            fresh_program = (fn, signature) not in self._rows
+            self._rows[(fn, signature)] = row
+            baseline = self._baselines.get(fn)
+            if baseline is None:
+                # First program of this function: it IS the baseline.
+                self._baselines[fn] = {
+                    "flops": row["flops"],
+                    "peak_hbm_bytes": row["peak_hbm_bytes"],
+                    "wall_s": None,
+                    "signature": signature,
+                }
+                baseline = None
+        try:
+            m = xla_metrics()
+            tags = {"fn": fn}
+            m.flops.set(row["flops"], tags=tags)
+            m.bytes_hbm.set(row["peak_hbm_bytes"], tags=tags)
+        except Exception:
+            pass
+        if baseline is not None and fresh_program:
+            # A re-compile of a function with a baseline: check the
+            # static dimensions for drift right now — a recompile that
+            # doubles FLOPs must be visible before any wall sample.
+            self._check_drift(fn, row, baseline,
+                              dims=("flops", "peak_hbm_bytes"))
+        _publish_row(row)
+        return row
+
+    def record_sample(self, fn: str, signature: str, wall_s: float,
+                      exposed_comm_s: float = 0.0,
+                      calls: int = 0,
+                      trace_id: Optional[str] = None
+                      ) -> Optional[Dict[str, Any]]:
+        """Fold one sampled steady-state wall into the program's row:
+        EWMA wall, achieved rates, MFU/MBU, roofline verdict, headroom.
+        No-op for programs the registry never captured."""
+        wall_s = float(wall_s)
+        if wall_s <= 0:
+            return None
+        with self._lock:
+            row = self._rows.get((fn, signature))
+            if row is None:
+                return None
+            prev = row["wall_s"]
+            row["wall_s"] = (wall_s if prev is None else
+                             _EWMA_ALPHA * wall_s
+                             + (1 - _EWMA_ALPHA) * prev)
+            row["samples"] += 1
+            if calls:
+                row["calls"] = int(calls)
+            row["ts"] = time.time()
+            self._derive_locked(row, exposed_comm_s / wall_s)
+            baseline = self._baselines.get(fn)
+            if baseline is not None and baseline["wall_s"] is None \
+                    and baseline["signature"] == signature:
+                baseline["wall_s"] = row["wall_s"]
+            row = dict(row)
+        try:
+            m = xla_metrics()
+            tags = {"fn": fn}
+            m.wall_seconds.observe(wall_s, tags=tags, trace_id=trace_id)
+            if row["mfu"] is not None:
+                m.mfu.set(row["mfu"], tags=tags)
+            if row["mbu"] is not None:
+                m.mbu.set(row["mbu"], tags=tags)
+        except Exception:
+            pass
+        if baseline is not None:
+            self._check_drift(fn, row, baseline, dims=("wall_s",))
+        _publish_row(row)
+        return row
+
+    def _derive_locked(self, row: Dict[str, Any],
+                       exposed_fraction: float) -> None:
+        """Recompute the derived columns of one row in place (holding
+        the registry lock)."""
+        wall = row["wall_s"]
+        row["achieved_flops_per_s"] = row["flops"] / wall
+        row["achieved_bytes_per_s"] = row["bytes_accessed"] / wall
+        row["exposed_comm_fraction"] = min(max(exposed_fraction, 0.0),
+                                           1.0)
+        spec = chipspec.lookup(row["spec"])
+        if not spec.known:
+            row["mfu"] = None
+            row["mbu"] = None
+            row["lost_roofline_s_per_call"] = None
+            row["lost_roofline_s_total"] = None
+            row["verdict"] = "unknown"
+            return
+        row["mfu"] = row["achieved_flops_per_s"] / spec.peak_flops
+        row["mbu"] = (row["achieved_bytes_per_s"]
+                      / spec.peak_hbm_bytes_per_s)
+        # Roofline-ideal wall: the slower of "all flops at peak" and
+        # "all bytes at peak bandwidth". What the sampled wall spends
+        # beyond that is reclaimable headroom.
+        ideal = max(row["flops"] / spec.peak_flops,
+                    row["bytes_accessed"] / spec.peak_hbm_bytes_per_s)
+        lost = max(wall - ideal, 0.0)
+        row["lost_roofline_s_per_call"] = lost
+        row["lost_roofline_s_total"] = lost * max(row["calls"], 1)
+        try:
+            from ray_tpu._private.config import GlobalConfig
+
+            comm_threshold = float(GlobalConfig.xla_comm_bound_fraction)
+        except Exception:
+            comm_threshold = 0.5
+        if row["exposed_comm_fraction"] > comm_threshold:
+            row["verdict"] = "comm-bound"
+        elif row["mfu"] >= row["mbu"]:
+            row["verdict"] = "compute-bound"
+        else:
+            row["verdict"] = "memory-bound"
+
+    # -- regression sentinel ----------------------------------------
+
+    def _check_drift(self, fn: str, row: Dict[str, Any],
+                     baseline: Dict[str, float], dims) -> None:
+        """Compare ``row`` against the function's baseline on ``dims``;
+        fire PERF_REGRESSION once per drifted-dimension episode."""
+        try:
+            from ray_tpu._private.config import GlobalConfig
+
+            ratio_limit = float(GlobalConfig.xla_regression_ratio)
+        except Exception:
+            ratio_limit = 1.5
+        if ratio_limit <= 0:
+            return
+        for dim in dims:
+            base = baseline.get(dim)
+            cur = row.get(dim)
+            if not base or cur is None:
+                continue
+            ratio = float(cur) / float(base)
+            with self._lock:
+                episode = self._episodes.setdefault(fn, set())
+                if ratio > ratio_limit:
+                    if dim in episode:
+                        continue  # already fired this episode
+                    episode.add(dim)
+                    fire = True
+                else:
+                    episode.discard(dim)  # back within: re-arm
+                    fire = False
+            if fire:
+                _emit_regression(fn, row, dim, ratio, float(base),
+                                 float(cur))
+
+    # -- views ------------------------------------------------------
+
+    def rows(self):
+        with self._lock:
+            return [dict(r) for r in self._rows.values()]
+
+    def row(self, fn: str, signature: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            r = self._rows.get((fn, signature))
+            return dict(r) if r else None
+
+    def baseline(self, fn: str) -> Optional[Dict[str, float]]:
+        with self._lock:
+            b = self._baselines.get(fn)
+            return dict(b) if b else None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+            self._baselines.clear()
+            self._episodes.clear()
+
+
+def program_registry() -> ProgramRegistry:
+    """The per-process registry singleton."""
+    global _registry
+    with _lock:
+        if _registry is None:
+            _registry = ProgramRegistry()
+        return _registry
+
+
+# ---------------------------------------------------- TrackedJit bridge
+
+_capture_pool = None
+_pending_captures: list = []
+
+
+def _capture_executor():
+    """One serialized background worker for AOT capture compiles: the
+    ``compiled()`` call behind ``cost_analysis()`` is a real XLA
+    compile (minutes at pod scale), and paying it inline would double
+    every tracked compile wall. The wrapper's suppression flag is
+    thread-local, so the worker's internal traces never touch the
+    user-facing counters."""
+    global _capture_pool
+    with _lock:
+        if _capture_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _capture_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="xla-capture")
+        return _capture_pool
+
+
+def _capture(fn: str, signature: str, tracked, abs_args, abs_kwargs,
+             seconds: float) -> None:
+    try:
+        compiled = tracked.compiled(*abs_args, **abs_kwargs)
+        if compiled is None:
+            return
+        program_registry().record_compile(
+            fn, signature, compiled, seconds,
+            calls=getattr(tracked, "calls", 0))
+    except Exception:
+        pass  # a failed capture must never poison the worker
+
+
+def on_tracked_compile(tracked, seconds: float, args, kwargs) -> None:
+    """Attribution hook ``TrackedJit._on_compile`` calls on every new
+    program: queue a background capture of its cost/memory analysis.
+    Only the cheap argument abstraction happens on the caller — the
+    closure holds ShapeDtypeStructs, never (possibly donated) device
+    buffers, and the capture compile itself runs off the hot path."""
+    if not attribution_enabled():
+        return
+    from ray_tpu.observability.jit import _arg_signature
+
+    signature = _arg_signature(args, kwargs)
+    try:
+        abs_args, abs_kwargs = tracked._abstract_args(args, kwargs)
+    except Exception:
+        return
+    fut = _capture_executor().submit(
+        _capture, tracked.name, signature, tracked, abs_args,
+        abs_kwargs, seconds)
+    with _lock:
+        _pending_captures.append(fut)
+        # Bound the ledger: stragglers past this are unreachable from
+        # flush_captures but still run to completion on the worker.
+        del _pending_captures[:-256]
+
+
+def flush_captures(timeout: float = 30.0) -> bool:
+    """Block until every queued compile capture has landed in the
+    registry (tests and benches synchronize on this before asserting;
+    production code never needs it). True when the queue drained."""
+    import concurrent.futures
+
+    with _lock:
+        pending = _pending_captures[:]
+        _pending_captures.clear()
+    if not pending:
+        return True
+    concurrent.futures.wait(pending, timeout=timeout)
+    return all(f.done() for f in pending)
+
+
+def on_tracked_sample(tracked, signature: str, wall_s: float,
+                      exposed_comm_s: float) -> None:
+    """Sampled-wall hook: fold one fenced execution wall into the row,
+    stamping the live trace (if any) as the metric exemplar."""
+    trace_id = None
+    try:
+        from ray_tpu.util.tracing import current_trace
+
+        ctx = current_trace()
+        if ctx is not None:
+            trace_id = getattr(ctx, "trace_id", None)
+    except Exception:
+        pass
+    program_registry().record_sample(
+        tracked.name, signature, wall_s,
+        exposed_comm_s=exposed_comm_s,
+        calls=getattr(tracked, "calls", 0), trace_id=trace_id)
+
+
+# ------------------------------------------------------------ publication
+
+def _publish_row(row: Dict[str, Any]) -> bool:
+    """Fire-and-forget report of one program row into the GCS ring
+    (``report_xla_programs``). False (silently) outside a connected
+    worker — a bare process still gets the local registry + metrics."""
+    try:
+        from ray_tpu._private.worker import global_worker_or_none
+
+        w = global_worker_or_none()
+        if w is None or getattr(w, "_dead", False):
+            return False
+        payload = dict(row)
+        payload.setdefault("node_id", w.node_id)
+        w.gcs.cast("report_xla_programs", row=payload)
+        return True
+    except Exception:
+        return False
+
+
+def _emit_regression(fn: str, row: Dict[str, Any], dim: str,
+                     ratio: float, base: float, cur: float) -> None:
+    """One typed PERF_REGRESSION cluster event naming the program and
+    the drifted dimension."""
+    message = (f"program {fn!r} {row.get('signature', '')}: {dim} "
+               f"drifted to {ratio:.2f}x its baseline "
+               f"({base:.4g} -> {cur:.4g})")
+    try:
+        from ray_tpu._private.worker import global_worker_or_none
+
+        w = global_worker_or_none()
+        if w is None or getattr(w, "_dead", False):
+            return
+        w.gcs.call(
+            "report_cluster_event", event_type="PERF_REGRESSION",
+            message=message,
+            extra={"fn": fn, "signature": row.get("signature"),
+                   "dimension": dim, "ratio": ratio,
+                   "baseline": base, "current": cur,
+                   "measurement": row.get("measurement")},
+            timeout=5)
+    except Exception:
+        pass  # the sentinel must never take down the sampled call
+
+
+def local_programs():
+    """This process's registry rows (fleet view: util.state.xla_summary)."""
+    return program_registry().rows()

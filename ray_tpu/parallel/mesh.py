@@ -88,6 +88,36 @@ def discover_devices() -> List:
     return list(jax.devices())
 
 
+def device_inventory(devices: Optional[Sequence] = None
+                     ) -> Dict[str, object]:
+    """Structured accelerator inventory: count, platforms, chip
+    generation/kind, and the chip-spec peaks the XLA attribution plane
+    divides by (observability/chipspec.py). Unknown kinds degrade to
+    ``spec: "unknown"`` with no peaks — never fabricated numbers."""
+    from ray_tpu.observability import chipspec
+
+    devices = list(devices if devices is not None else discover_devices())
+    platforms = sorted({getattr(d, "platform", "?") for d in devices})
+    kinds = sorted({str(getattr(d, "device_kind", None)
+                        or getattr(d, "platform", "?"))
+                    for d in devices})
+    # One spec per inventory: heterogeneous kinds degrade to unknown
+    # rather than averaging peaks that don't share a roofline.
+    if len(kinds) == 1:
+        spec = chipspec.lookup(kinds[0])
+    else:
+        spec = chipspec.UNKNOWN
+    return {
+        "devices": len(devices),
+        "platforms": platforms,
+        "device_kinds": kinds,
+        "spec": spec.spec,
+        "measurement": spec.measurement,
+        "peak_flops": spec.peak_flops,
+        "peak_hbm_bytes_per_s": spec.peak_hbm_bytes_per_s,
+    }
+
+
 def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
     """Build a Mesh from {axis: size}; one axis may be -1 (absorbs the rest).
 
@@ -102,7 +132,8 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
     def _inventory() -> str:
         # "what did JAX actually discover" — the first question every
         # mesh-shape mismatch report needs answered.
-        platforms = sorted({getattr(d, "platform", "?") for d in devices})
+        inv = device_inventory(devices)
+        platforms = inv["platforms"]
         listing = ", ".join(str(d) for d in devices[:8])
         if n > 8:
             listing += f", ... ({n - 8} more)"
@@ -111,8 +142,10 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
                     f"{jax.process_count()}")
         except Exception:
             topo = ""
+        kinds = "/".join(inv["device_kinds"]) or "none"
         return (f"discovered {n} device(s) on platform "
-                f"{'/'.join(platforms) or 'none'}: [{listing}]{topo}")
+                f"{'/'.join(platforms) or 'none'} "
+                f"(chip {kinds}, spec {inv['spec']}): [{listing}]{topo}")
 
     sizes = dict(axes)
     wild = [k for k, v in sizes.items() if v == -1]

@@ -149,8 +149,10 @@ def build_train_step(
         return new_state, {"loss": loss, "grad_norm": grad_norm,
                            "step": new_state.step}
 
-    return jax.jit(
-        step_fn,
+    from ray_tpu.observability.jit import tracked_jit
+
+    return tracked_jit(
+        step_fn, name="train_step",
         in_shardings=(None, batch_shardings),
         donate_argnums=(0,),
     )
@@ -160,4 +162,7 @@ def build_eval_step(loss_fn, mesh, batch_shardings):
     def eval_fn(params, batch):
         return loss_fn(params, batch)
 
-    return jax.jit(eval_fn, in_shardings=(None, batch_shardings))
+    from ray_tpu.observability.jit import tracked_jit
+
+    return tracked_jit(eval_fn, name="eval_step",
+                       in_shardings=(None, batch_shardings))

@@ -117,9 +117,12 @@ def create_zero_state(params, optimizer, mesh, axis_name: str = "data",
         lambda l: P(axis_name) if getattr(l, "shape", ()) == (shard,)
         else P(),
         opt_shape)
-    opt_state = jax.jit(shard_map(
+    from ray_tpu.observability.jit import tracked_jit
+
+    opt_state = tracked_jit(shard_map(
         init_shard, mesh=mesh, in_specs=P(),
-        out_specs=out_specs, check_rep=False))(flat)
+        out_specs=out_specs, check_rep=False),
+        name="zero_init_shard")(flat)
     ef = None
     if error_feedback:
         ef = jax.device_put(
@@ -315,11 +318,14 @@ def build_zero_train_step(
                 ef=None if state.ef is None else P(axis_name, None))
             metric_specs = {"loss": P(), "grad_norm": P(), "step": P()}
             batch_specs = jax.tree.map(lambda _: batch_spec, batch)
-            fn = jax.jit(shard_map(
+            from ray_tpu.observability.jit import tracked_jit
+
+            fn = tracked_jit(shard_map(
                 step_fn, mesh=mesh,
                 in_specs=(state_specs, batch_specs),
                 out_specs=(state_specs, metric_specs),
-                check_rep=False), donate_argnums=(0,))
+                check_rep=False), name="zero_train_step",
+                donate_argnums=(0,))
             jitted_cache[cache_key] = fn
         return fn(state, batch)
 

@@ -127,7 +127,9 @@ class BC:
         """Greedy rollouts of the cloned policy in the probe env."""
         module = self.module_spec.build()
         params = self.get_policy_params()
-        fwd = jax.jit(module.forward_inference)
+        from ray_tpu.observability.jit import tracked_jit
+
+        fwd = tracked_jit(module.forward_inference, name="bc_eval_fwd")
         returns: List[float] = []
         env = make_env(self.config.env, seed=self.config.seed + 999)
         for ep in range(num_episodes):

@@ -239,7 +239,9 @@ class CQL:
         """Deterministic (tanh-mean) rollouts in the probe env."""
         module = self.module_spec.build()
         params = self.get_policy_params()
-        fwd = jax.jit(module.forward_train)
+        from ray_tpu.observability.jit import tracked_jit
+
+        fwd = tracked_jit(module.forward_train, name="cql_eval_fwd")
         returns = []
         env = make_env(self.config.env, seed=self.config.seed + 999)
         for ep in range(num_episodes):

@@ -105,7 +105,9 @@ class ES(Algorithm):
             updates, new_opt = self._opt.update(-g, opt_state, flat)
             return optax.apply_updates(flat, updates), new_opt
 
-        self._combine = jax.jit(_combine)
+        from ray_tpu.observability.jit import tracked_jit
+
+        self._combine = tracked_jit(_combine, name="es_combine")
         self._total_episodes = 0
 
     def training_step(self) -> Dict[str, Any]:

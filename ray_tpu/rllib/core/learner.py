@@ -66,7 +66,11 @@ class Learner:
                 {**state, "params": new_params, "opt_state": new_opt})
             return new_state, metrics
 
-        self._update_fn = jax.jit(_update, donate_argnums=(0,))
+        from ray_tpu.observability.jit import tracked_jit
+
+        self._update_fn = tracked_jit(
+            _update, name=f"{type(self).__name__}_update",
+            donate_argnums=(0,))
 
     def _make_optimizer(self):
         """Hook: subclasses may change clipping/optimizer structure (the

@@ -50,10 +50,13 @@ class EnvRunner:
         self._theta_version = -1
         self._envs = [make_env(env_spec, seed=seed * 10007 + i)
                       for i in range(num_envs)]
+        from ray_tpu.observability.jit import tracked_jit
+
         with jax.default_device(self._cpu):
             self._module = module_spec.build()
             self._params = self._module.init(jax.random.key(seed))
-            self._fwd = jax.jit(self._module.forward_exploration)
+            self._fwd = tracked_jit(self._module.forward_exploration,
+                                    name="env_runner_fwd")
         self._rng = jax.random.key(seed + 1)
         self._obs = np.stack([e.reset(seed=seed * 31 + i)[0]
                               for i, e in enumerate(self._envs)])
@@ -148,7 +151,11 @@ class EnvRunner:
         returns, lengths = [], []
         with jax.default_device(self._cpu):
             if self._infer is None and not recurrent:
-                self._infer = jax.jit(self._module.forward_inference)
+                from ray_tpu.observability.jit import tracked_jit
+
+                self._infer = tracked_jit(
+                    self._module.forward_inference,
+                    name="env_runner_infer")
             obs = np.stack([
                 e.reset(seed=self._seed * 7919 + 1000 + i)[0]
                 for i, e in enumerate(self._envs)])

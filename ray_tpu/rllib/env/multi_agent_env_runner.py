@@ -48,11 +48,15 @@ class MultiAgentEnvRunner:
             for li, (ei, a) in enumerate(lanes):
                 self._env_lanes[ei].append((mid, li, a))
 
+        from ray_tpu.observability.jit import tracked_jit
+
         with jax.default_device(self._cpu):
             self._module = multi_module_spec.build()
             self._params = self._module.init(jax.random.key(seed))
-            self._fwd = {mid: jax.jit(self._module[mid].forward_exploration)
-                         for mid in self._module_ids}
+            self._fwd = {mid: tracked_jit(
+                self._module[mid].forward_exploration,
+                name=f"ma_env_runner_fwd_{mid}")
+                for mid in self._module_ids}
         self._rng = jax.random.key(seed + 1)
 
         # Current per-lane obs (zeros while inactive) and active flags.

@@ -47,7 +47,10 @@ class InferenceServer:
 
         self._module = module_spec.build()
         self._params = self._module.init(jax.random.key(seed))
-        self._fwd = jax.jit(self._module.forward_exploration)
+        from ray_tpu.observability.jit import tracked_jit
+
+        self._fwd = tracked_jit(self._module.forward_exploration,
+                                name="inference_server_fwd")
         self._rng = jax.random.key(seed + 1)
 
         self._store = weight_store

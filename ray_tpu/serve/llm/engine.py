@@ -2152,8 +2152,11 @@ def static_batch_generate(params, model_config, requests: List[Request],
     from ray_tpu.models.llama import generate
 
     steps = steps or max(r.max_tokens for r in requests)
-    gen = jax.jit(lambda p, t: generate(p, t, model_config,
-                                        max_new_tokens=steps))
+    from ray_tpu.observability.jit import tracked_jit
+
+    gen = tracked_jit(lambda p, t: generate(p, t, model_config,
+                                            max_new_tokens=steps),
+                      name="llm_generate_batch")
     if warmup:                              # compile outside the timings
         np.asarray(gen(params, jnp.zeros((batch_size, pad_to),
                                          jnp.int32)))

@@ -305,6 +305,30 @@ def list_serve_accounting(tenant: Optional[str] = None,
                        timeout=30)
 
 
+def xla_summary(top_n: int = 8) -> Dict[str, Any]:
+    """The fleet's compiled-program cost rollup from the GCS XLA ring:
+    the current program set (one row per tracked function × argument
+    signature × process) ranked by cumulative FLOPs, peak HBM bytes,
+    and lost-to-roofline headroom seconds, plus roofline-verdict and
+    measurement counts. Answers "which program is eating the fleet,
+    and is it compute-, memory-, or comm-bound?" — rows whose
+    ``measurement`` is ``"cpu"`` carry nominal-spec ratios that prove
+    the plumbing, not performance."""
+    return _gcs().call("xla_summary", top_n=top_n, timeout=30)
+
+
+def list_xla_programs(fn: Optional[str] = None,
+                      verdict: Optional[str] = None,
+                      limit: int = 200) -> List[Dict[str, Any]]:
+    """Newest-last program cost rows from the GCS XLA ring (fn,
+    signature, flops, bytes accessed, HBM breakdown, sampled wall,
+    MFU/MBU, roofline verdict), optionally filtered by function name
+    or verdict (``compute-bound`` / ``memory-bound`` / ``comm-bound``
+    / ``unsampled`` / ``unknown``)."""
+    return _gcs().call("list_xla_programs", fn=fn, verdict=verdict,
+                       limit=limit, timeout=30)
+
+
 def get_log(task_id: Optional[str] = None, actor_id: Optional[str] = None,
             worker_id: Optional[str] = None,
             tail: int = 100) -> List[str]:

@@ -1,0 +1,26 @@
+"""Fixture: raw jax.jit in a hot-path module — every form flagged."""
+from functools import partial
+
+import jax
+from jax import jit
+
+
+def step(x):
+    return x + 1
+
+
+# Direct call forms: the program compiles with no trace counters and
+# no attribution row.
+update = jax.jit(step, donate_argnums=(0,))
+update_bare = jit(step)
+
+# Factory form stored for later application.
+make_step = partial(jax.jit, static_argnums=(1,))
+
+# Factory-then-apply in one expression.
+fast_step = partial(jax.jit, static_argnums=(1,))(step)
+
+
+@jax.jit
+def tick(x):
+    return x * 2

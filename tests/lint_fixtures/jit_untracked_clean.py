@@ -1,0 +1,21 @@
+"""Fixture twin: hot-path programs routed through tracked_jit (silent)."""
+import jax
+
+from ray_tpu.observability.jit import tracked_jit
+
+
+def step(x):
+    return x + 1
+
+
+update = tracked_jit(step, name="step", donate_argnums=(0,))
+
+
+@tracked_jit(name="tick")
+def tick(x):
+    return x * 2
+
+
+# The sanctioned escape hatch: a deliberately untracked program takes
+# the inline suppression and stays invisible on purpose.
+debug_step = jax.jit(step)  # graftlint: disable=jit-untracked

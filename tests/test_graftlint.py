@@ -37,6 +37,8 @@ PASS_CASES = [
     ("jit-hygiene", "jit_bad.py", "jit_clean.py",
      {"jit-impure-call", "jit-global-mutation",
       "jit-unhashable-static", "jit-traced-branch"}),
+    ("jit-tracking", "jit_untracked_bad.py", "jit_untracked_clean.py",
+     {"jit-untracked"}),
     ("async-blocking", "async_bad.py", "async_clean.py",
      {"async-blocking-call", "async-unawaited-wait",
       "async-blocking-transitive"}),
